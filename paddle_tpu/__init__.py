@@ -1,0 +1,51 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference: mozga-intel/Paddle).
+
+The public surface mirrors `import paddle.fluid as fluid`:
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.fc(input=x, size=1)
+    ...
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={...}, fetch_list=[...])
+
+Execution is whole-program XLA compilation (core/lowering.py), autodiff is
+jax.vjp over op lowering rules (core/backward.py), and multi-device runs ride
+jax.sharding Meshes (parallel/).
+"""
+from .core import framework
+from .core.framework import (Program, Operator, Variable, Parameter,
+                             default_main_program, default_startup_program,
+                             program_guard, switch_main_program,
+                             switch_startup_program)
+from .core.executor import Executor, Scope, global_scope, scope_guard
+from .core.backward import append_backward
+from .core.lod import LoDTensor, create_lod_tensor
+from .core.param_attr import ParamAttr
+from .core import initializer
+from .core import unique_name
+from .places import CPUPlace, CUDAPlace, TPUPlace, is_compiled_with_cuda, \
+    is_compiled_with_tpu
+
+from . import ops as _ops  # registers all op lowerings
+from . import layers
+from . import optimizer
+from . import regularizer
+from . import clip
+from .clip import ErrorClipByValue, GradientClipByValue, GradientClipByNorm, \
+    GradientClipByGlobalNorm
+from . import nets
+from . import io
+from .io import save_params, load_params, save_persistables, \
+    load_persistables, save_inference_model, load_inference_model
+from . import metrics
+from . import profiler
+from .data_feeder import DataFeeder
+from . import backward
+from .parallel.parallel_executor import ParallelExecutor
+
+Tensor = LoDTensor
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+from . import framework, registry, lowering, executor, backward
+from .framework import (Program, Block, Operator, Variable, Parameter,
+                        default_main_program, default_startup_program,
+                        program_guard, switch_main_program,
+                        switch_startup_program)
+from .executor import Executor, Scope, global_scope, scope_guard
+from .backward import append_backward
+from .lod import LoDTensor, create_lod_tensor

@@ -1,0 +1,136 @@
+"""append_backward: build gradient ops into the Program.
+
+Parity: python/paddle/fluid/backward.py + the reference's per-op GradOpMaker
+machinery (paddle/fluid/framework/grad_op_desc_maker.h). The reference needs a
+hand-written grad kernel per op; here every forward op gets a single generic
+"grad_of" op whose lowering computes input grads with jax.vjp of the forward
+lowering rule (core/lowering.py:_lower_grad_of). Gradient accumulation for
+fan-out (the reference's inserted sum_op after @RENAME@ bookkeeping) is
+handled by emitting grad ops in reverse topological order and accumulating
+into <var>@GRAD at lowering time.
+"""
+from .framework import Variable, grad_var_name, GRAD_SUFFIX
+from . import registry
+
+
+def _op_path(block, loss_name, no_grad_set):
+    """Ops on a path from any differentiable input to the loss, plus the set
+    of vars that need gradients (parity: backward.py _find_op_path_)."""
+    # backward sweep: vars needing grads
+    needed = {loss_name}
+    path_flags = [False] * len(block.ops)
+    for idx in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[idx]
+        outs = set(op.all_output_vars())
+        if outs & needed:
+            path_flags[idx] = True
+            for name in op.all_input_vars():
+                if name in no_grad_set:
+                    continue
+                v = block.vars.get(name)
+                if v is not None and v.stop_gradient:
+                    continue
+                needed.add(name)
+    return path_flags, needed
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Append gradient ops for `loss` to its program.
+
+    Returns [(Parameter, grad Variable)] like the reference.
+    """
+    block = loss.block
+    program = block.program
+    no_grad = set(no_grad_set or ())
+    for v in block.vars.values():
+        if v.stop_gradient:
+            no_grad.add(v.name)
+
+    path_flags, needed = _op_path(block, loss.name, no_grad)
+    fwd_len = len(block.ops)
+
+    # d(loss)/d(loss) = 1
+    loss_grad = block.create_var(
+        name=grad_var_name(loss.name), shape=loss.shape, dtype=loss.dtype)
+    block.append_op(
+        type="fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={"shape": list(loss.shape or (1,)), "value": 1.0,
+               "dtype": loss.dtype},
+        infer_shape=False)
+
+    # A var "has a grad" once some consumer's grad op has (started) writing it.
+    has_grad = {loss.name}
+    for idx in range(fwd_len - 1, -1, -1):
+        if not path_flags[idx]:
+            continue
+        op = block.ops[idx]
+        if not registry.is_registered(op.type):
+            raise NotImplementedError(
+                "no lowering registered for op %r; cannot differentiate" % op.type)
+        out_grads = {}
+        produces = False
+        for slot, names in op.outputs.items():
+            out_grads[slot] = [grad_var_name(n) if n in has_grad else ""
+                               for n in names]
+            produces = produces or any(out_grads[slot])
+        if not produces:
+            continue
+
+        grad_in_names = []   # read by the grad op (for dependency analysis)
+        grad_out = {}        # slot -> grad var names written
+        for slot, names in op.inputs.items():
+            grad_in_names.extend(names)
+            outs = []
+            for n in names:
+                if n in no_grad or n not in needed:
+                    outs.append("")
+                else:
+                    outs.append(grad_var_name(n))
+            grad_out["InGrad::" + slot] = outs
+        for slot, gnames in out_grads.items():
+            grad_in_names.extend([g for g in gnames if g])
+
+        # declare grad vars in the block
+        for slot, outs in grad_out.items():
+            src = op.inputs[slot.split("::", 1)[1]]
+            for n, g in zip(src, outs):
+                if g and g not in block.vars:
+                    v = block.vars.get(n)
+                    block.create_var(
+                        name=g,
+                        shape=v.shape if v is not None else None,
+                        dtype=v.dtype if v is not None else "float32")
+
+        gop = block.append_op(
+            type="grad_of",
+            inputs={"Dep": grad_in_names},
+            outputs=grad_out,
+            attrs={
+                "fwd_type": op.type,
+                "fwd_uid": op.uid,
+                "fwd_attrs": dict(op.attrs),
+                "fwd_inputs": {s: list(n) for s, n in op.inputs.items()},
+                "fwd_outputs": {s: list(n) for s, n in op.outputs.items()},
+                "no_grad_names": tuple(no_grad),
+                "__accumulate_outputs__": True,
+            },
+            infer_shape=False)
+        for slot, outs in grad_out.items():
+            for g in outs:
+                if g:
+                    has_grad.add(g[:-len(GRAD_SUFFIX)])
+
+    # collect (param, grad) pairs
+    if parameter_list is not None:
+        params = [block.var_recursive(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = [p for p in block.program.all_parameters() if p.trainable]
+    pairs = []
+    for p in params:
+        g = block.vars.get(grad_var_name(p.name))
+        if g is not None and p.name in needed:
+            pairs.append((p, g))
+    return pairs

@@ -1,0 +1,179 @@
+"""Executor + Scope.
+
+Parity: python/paddle/fluid/executor.py and paddle/fluid/framework/
+{executor.cc,scope.cc}. API-identical `Executor(place).run(program, feed,
+fetch_list)`; internally each distinct (program version, feed signature,
+fetch list) is lowered ONCE to a jitted XLA computation and cached —
+subsequent runs are a single device dispatch, vs. the reference's per-op
+kernel launches every run.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import lowering
+from .framework import default_main_program, convert_dtype
+
+
+class Scope(object):
+    """Name -> host/device array store (parity: framework::Scope)."""
+
+    def __init__(self):
+        self._vars = {}
+        self._lods = {}
+        self._rng_counter = 0
+
+    def set(self, name, value, lod=None):
+        self._vars[name] = value
+        if lod is not None:
+            self._lods[name] = lod
+
+    def get(self, name):
+        return self._vars.get(name)
+
+    def has(self, name):
+        return name in self._vars
+
+    def find_var(self, name):
+        return _ScopeVar(self, name) if name in self._vars else None
+
+    def var(self, name):
+        if name not in self._vars:
+            self._vars[name] = None
+        return _ScopeVar(self, name)
+
+    def names(self):
+        return list(self._vars)
+
+    def drop(self, name):
+        self._vars.pop(name, None)
+        self._lods.pop(name, None)
+
+    def next_seed(self):
+        self._rng_counter += 1
+        return self._rng_counter
+
+
+class _ScopeVar(object):
+    def __init__(self, scope, name):
+        self.scope = scope
+        self.name = name
+
+    def get_tensor(self):
+        return self.scope.get(self.name)
+
+    def set(self, value, place=None):
+        self.scope.set(self.name, value)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+def _feed_signature(feed):
+    sig = []
+    for name in sorted(feed):
+        a = feed[name]
+        sig.append((name, tuple(np.shape(a)), str(np.asarray(a).dtype)
+                    if not hasattr(a, "dtype") else str(a.dtype)))
+    return tuple(sig)
+
+
+def as_numpy(tensor):
+    return np.asarray(tensor)
+
+
+class Executor(object):
+    def __init__(self, place=None):
+        from ..places import CPUPlace
+        self.place = place if place is not None else CPUPlace()
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        if program is None:
+            program = default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
+        feed_arrays = {}
+        for name, value in feed.items():
+            var = _find_feed_var(program, name)
+            arr = _to_array(value, var)
+            feed_arrays[name] = arr
+
+        feed_names = sorted(feed_arrays)
+        key = (id(program), program._version, _feed_signature(feed_arrays),
+               tuple(fetch_names))
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            state_rw, state_ro, state_out = lowering.analyze_state(
+                program, feed_names, scope.names())
+            fn = lowering.build_program_fn(
+                program, feed_names, fetch_names, state_rw, state_ro,
+                state_out)
+            jitted = jax.jit(fn, donate_argnums=(1,))
+            entry = (jitted, state_rw, state_ro, state_out)
+            if use_program_cache:
+                self._cache[key] = entry
+        jitted, state_rw, state_ro, state_out = entry
+
+        def read_state(names):
+            vals = []
+            for n in names:
+                v = scope.get(n)
+                if v is None:
+                    raise RuntimeError(
+                        "persistable variable %r is not initialized in the "
+                        "scope; run the startup program first" % n)
+                vals.append(v)
+            return vals
+
+        seed = np.uint32(scope.next_seed())
+        fetches, new_state = jitted(
+            [feed_arrays[n] for n in feed_names],
+            read_state(state_rw), read_state(state_ro), seed)
+        for n, v in zip(state_out, new_state):
+            scope.set(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+
+def _find_feed_var(program, name):
+    for block in program.blocks:
+        if name in block.vars:
+            return block.vars[name]
+    return None
+
+
+def _to_array(value, var=None):
+    from .lod import LoDTensor
+    if isinstance(value, LoDTensor):
+        raise NotImplementedError(
+            "LoDTensor feeds land with the sequence milestone (SURVEY.md §7 "
+            "M6); feed the padded dense array for now")
+    arr = np.asarray(value)
+    if var is not None and var.dtype is not None:
+        arr = arr.astype(convert_dtype(var.dtype), copy=False)
+    return jnp.asarray(arr)

@@ -1,0 +1,387 @@
+"""Graph IR: Program / Block / Operator / Variable / Parameter.
+
+Parity: python/paddle/fluid/framework.py and paddle/fluid/framework/{program_desc,
+block_desc,op_desc,var_desc}.{cc,h} in the reference. Same define-then-run model:
+layer functions append Operators to the current Block of the default Program; an
+Executor later runs the Program. TPU-native difference: the Program is lowered
+whole into a single XLA computation (see core/lowering.py) instead of being
+interpreted op-by-op, so the IR here is pure Python (no protobuf round-trip on
+the hot path); `Program.to_string` provides the debug/serialization surface.
+"""
+import contextlib
+import copy
+import re
+
+import numpy as np
+
+from . import unique_name
+
+GRAD_SUFFIX = "@GRAD"
+
+_dtype_aliases = {
+    "float32": "float32",
+    "float64": "float64",
+    "float16": "float16",
+    "bfloat16": "bfloat16",
+    "int8": "int8",
+    "int16": "int16",
+    "int32": "int32",
+    "int64": "int64",
+    "uint8": "uint8",
+    "bool": "bool",
+}
+
+
+def convert_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+    else:
+        key = np.dtype(dtype).name
+    if key not in _dtype_aliases:
+        raise ValueError("unsupported dtype: %s" % dtype)
+    return _dtype_aliases[key]
+
+
+def grad_var_name(name):
+    return name + GRAD_SUFFIX
+
+
+class Variable(object):
+    """A named tensor in a Block.
+
+    Parity: fluid.framework.Variable. Carries static shape (-1 = dynamic batch
+    dim), dtype string, lod_level (number of variable-length sequence levels;
+    see core/lod.py), persistable (lives in the Scope across runs) and
+    stop_gradient flags.
+    """
+
+    def __init__(self, block, name=None, shape=None, dtype="float32",
+                 lod_level=0, persistable=False, stop_gradient=False,
+                 is_data=False, initializer=None, type=None, capacity=None):
+        self.block = block
+        if name is None:
+            name = unique_name.generate("_generated_var")
+        self.name = name
+        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype) if dtype is not None else None
+        self.lod_level = lod_level
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.initializer = initializer
+        # type: None (dense tensor) | 'tensor_array' | 'rank_table'
+        self.type = type
+        self.capacity = capacity
+        self.op = None  # producer op, set by append_op
+
+    # ---- convenience -------------------------------------------------
+    @property
+    def grad_name(self):
+        return grad_var_name(self.name)
+
+    def astype(self, dtype):
+        from ..layers import tensor as _tensor
+        return _tensor.cast(self, dtype)
+
+    def __repr__(self):
+        return "Variable(%s, shape=%s, dtype=%s, lod=%d%s)" % (
+            self.name, self.shape, self.dtype, self.lod_level,
+            ", persistable" if self.persistable else "")
+
+    __str__ = __repr__
+
+
+class Parameter(Variable):
+    """Trainable persistable Variable.
+
+    Parity: fluid.framework.Parameter — carries optimize/regularizer/clip attrs.
+    """
+
+    def __init__(self, block, shape, dtype, **kwargs):
+        self.trainable = kwargs.pop("trainable", True)
+        self.optimize_attr = kwargs.pop("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kwargs.pop("regularizer", None)
+        self.gradient_clip_attr = kwargs.pop("gradient_clip_attr", None)
+        self.do_model_average = kwargs.pop("do_model_average", None)
+        kwargs.setdefault("persistable", True)
+        super(Parameter, self).__init__(block, shape=shape, dtype=dtype, **kwargs)
+        self.stop_gradient = False
+
+
+class Operator(object):
+    """A node in the op graph.
+
+    Parity: fluid.framework.Operator / op_desc.cc. inputs/outputs map slot
+    names to lists of Variable *names* (string refs into the Block), matching
+    the reference's OpDesc. attrs are plain Python values; sub-blocks (While,
+    conditional_block) are referenced by block index in attrs['sub_block'].
+    """
+
+    _uid_counter = [0]
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = type
+        # Stable op identity: salts the per-op PRNG stream so that re-lowering
+        # the op inside jax.vjp (backward) reproduces identical randomness.
+        Operator._uid_counter[0] += 1
+        self.uid = Operator._uid_counter[0]
+        self.inputs = {}   # slot -> [var name]
+        self.outputs = {}  # slot -> [var name]
+        self.attrs = dict(attrs) if attrs else {}
+        if inputs:
+            for slot, vs in inputs.items():
+                self.inputs[slot] = [v.name if isinstance(v, Variable) else v
+                                     for v in _as_list(vs)]
+        if outputs:
+            for slot, vs in outputs.items():
+                self.outputs[slot] = [v.name if isinstance(v, Variable) else v
+                                      for v in _as_list(vs)]
+
+    def input(self, slot):
+        return self.inputs.get(slot, [])
+
+    def output(self, slot):
+        return self.outputs.get(slot, [])
+
+    @property
+    def input_names(self):
+        return list(self.inputs)
+
+    @property
+    def output_names(self):
+        return list(self.outputs)
+
+    def all_input_vars(self):
+        return [n for vs in self.inputs.values() for n in vs]
+
+    def all_output_vars(self):
+        return [n for vs in self.outputs.values() for n in vs]
+
+    def has_attr(self, name):
+        return name in self.attrs
+
+    def attr(self, name):
+        return self.attrs[name]
+
+    def __repr__(self):
+        ins = ", ".join("%s=%s" % (k, v) for k, v in self.inputs.items())
+        outs = ", ".join("%s=%s" % (k, v) for k, v in self.outputs.items())
+        return "{%s} = %s(%s) attrs=%s" % (outs, self.type, ins, self.attrs)
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class Block(object):
+    """A sequence of Operators plus a symbol table of Variables.
+
+    Parity: fluid.framework.Block / block_desc.cc, including parent-block
+    variable lookup for sub-blocks of control-flow ops.
+    """
+
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars = {}
+        self.ops = []
+
+    @property
+    def parent_block(self):
+        if self.parent_idx < 0:
+            return None
+        return self.program.blocks[self.parent_idx]
+
+    def create_var(self, **kwargs):
+        v = Variable(self, **kwargs)
+        self.vars[v.name] = v
+        self.program._bump_version()
+        return v
+
+    def create_parameter(self, shape, dtype, name=None, **kwargs):
+        if name is None:
+            name = unique_name.generate("_param")
+        p = Parameter(self, shape=shape, dtype=dtype, name=name, **kwargs)
+        self.vars[name] = p
+        self.program._bump_version()
+        return p
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def has_var_recursive(self, name):
+        b = self
+        while b is not None:
+            if name in b.vars:
+                return True
+            b = b.parent_block
+        return False
+
+    def var(self, name):
+        v = self.vars.get(name)
+        if v is None:
+            raise ValueError("Variable %r not found in block %d" % (name, self.idx))
+        return v
+
+    def var_recursive(self, name):
+        b = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = b.parent_block
+        raise ValueError("Variable %r not found (searched up from block %d)"
+                         % (name, self.idx))
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None,
+                  infer_shape=True):
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        for vs in (outputs or {}).values():
+            for v in _as_list(vs):
+                if isinstance(v, Variable):
+                    v.op = op
+        self.program._bump_version()
+        if infer_shape:
+            from . import registry
+            registry.infer_and_set_shapes(self, op)
+        return op
+
+    def prepend_op(self, type, inputs=None, outputs=None, attrs=None,
+                   infer_shape=True):
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(0, op)
+        self.program._bump_version()
+        if infer_shape:
+            from . import registry
+            registry.infer_and_set_shapes(self, op)
+        return op
+
+    def __repr__(self):
+        lines = ["block %d (parent %d):" % (self.idx, self.parent_idx)]
+        for v in self.vars.values():
+            lines.append("  " + repr(v))
+        for op in self.ops:
+            lines.append("  " + repr(op))
+        return "\n".join(lines)
+
+
+class Program(object):
+    """A list of Blocks; block 0 is the global block.
+
+    Parity: fluid.framework.Program / program_desc.cc. `_version` is bumped on
+    every mutation and keys the Executor's compile cache (the reference
+    re-interprets every run; we re-jit only when the graph actually changed).
+    """
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self._version = 0
+        self._seed = None  # program-level rng seed override
+        self.random_seed = 0
+
+    def _bump_version(self):
+        self._version += 1
+
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def create_block(self, parent_idx=None):
+        new_idx = len(self.blocks)
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        self.blocks.append(Block(self, new_idx, parent))
+        self.current_block_idx = new_idx
+        self._bump_version()
+        return self.current_block()
+
+    def rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+        self._bump_version()
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    def list_vars(self):
+        for blk in self.blocks:
+            for v in blk.vars.values():
+                yield v
+
+    # ---- clone / prune (parity: Program.clone, Program.prune) --------
+    def clone(self, for_test=False):
+        p = copy.deepcopy(self)
+        if for_test:
+            p._set_test_mode()
+        return p
+
+    def _set_test_mode(self):
+        for blk in self.blocks:
+            for op in blk.ops:
+                if "is_test" in _TEST_MODE_OPS.get(op.type, ()):
+                    op.attrs["is_test"] = True
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        return "\n".join(repr(b) for b in self.blocks)
+
+    __repr__ = to_string
+    __str__ = to_string
+
+
+# ops that behave differently at inference time
+_TEST_MODE_OPS = {
+    "dropout": ("is_test",),
+    "batch_norm": ("is_test",),
+    "nce": ("is_test",),
+}
+
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_main_program():
+    return _main_program_
+
+
+def default_startup_program():
+    return _startup_program_
+
+
+def switch_main_program(program):
+    global _main_program_
+    old = _main_program_
+    _main_program_ = program
+    return old
+
+
+def switch_startup_program(program):
+    global _startup_program_
+    old = _startup_program_
+    _startup_program_ = program
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    old_main = switch_main_program(main_program)
+    old_startup = None
+    if startup_program is not None:
+        old_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_startup is not None:
+            switch_startup_program(old_startup)

@@ -1,0 +1,284 @@
+"""Whole-program lowering: Program -> one pure JAX function -> one XLA module.
+
+This replaces the reference's op-by-op interpreter
+(paddle/fluid/framework/executor.cc: Executor::RunPreparedContext walks the
+BlockDesc and launches a kernel per OpDesc). On TPU the right execution model
+is to trace the entire Program once into a single XLA computation: XLA then
+fuses elementwise chains into the matmuls/convs, plans memory, and overlaps
+collectives — none of which an op-at-a-time interpreter can do.
+
+Gradient ops ("grad_of" appended by core/backward.py) lower via jax.vjp of the
+forward op's registered rule; recomputed forward subexpressions are
+deduplicated by XLA CSE, so the backward pass costs the same as hand-written
+grad kernels (reference: paddle/fluid/operators/*_grad kernels).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import registry
+from .framework import GRAD_SUFFIX
+
+# Lowering rules for ops that need access to the full env / program structure
+# (control flow with sub-blocks, tensor arrays). Signature:
+#   fn(ctx, op, env) -> None   (mutates env)
+_SPECIAL = {}
+
+
+def register_special(type):
+    def deco(fn):
+        _SPECIAL[type] = fn
+        return fn
+    return deco
+
+
+class LowerCtx(object):
+    """Per-trace context handed to op lowering rules."""
+
+    def __init__(self, program, base_key=None, is_startup=False, mesh=None):
+        self.program = program
+        self.base_key = base_key
+        self.is_startup = is_startup
+        self.is_abstract = False
+        self.mesh = mesh
+        self._op_salt = 0
+        self._op_calls = 0
+
+    def begin_op(self, salt):
+        self._op_salt = salt
+        self._op_calls = 0
+
+    def rng(self, salt=0, seed=0):
+        """Deterministic key derived from (run seed, op uid, call index within
+        the op). Re-lowering the same forward op inside jax.vjp (backward)
+        replays the identical key stream, so dropout masks / random inits are
+        grad-consistent and XLA CSE dedupes the recomputation.
+
+        A nonzero user `seed` (the op's seed attr — fluid's reproducibility
+        contract) pins the key independent of the run counter, so the op
+        produces identical randomness on every run of every process."""
+        self._op_calls += 1
+        base = jax.random.key(seed) if seed else self.base_key
+        return jax.random.fold_in(
+            base,
+            (self._op_salt * 1000003 + self._op_calls * 97 + salt) & 0x7FFFFFFF)
+
+
+class Env(object):
+    """Name -> traced value mapping for one lowering pass."""
+
+    def __init__(self):
+        self.values = {}
+
+    def read(self, name):
+        if name not in self.values:
+            raise KeyError("variable %r read before it was written; "
+                           "is it fed / initialized?" % name)
+        return self.values[name]
+
+    def read_opt(self, name):
+        return self.values.get(name)
+
+    def write(self, name, value):
+        self.values[name] = value
+
+    def accumulate(self, name, value):
+        cur = self.values.get(name)
+        self.values[name] = value if cur is None else cur + value
+
+    def __contains__(self, name):
+        return name in self.values
+
+
+def lower_block(ctx, block, env):
+    for op in block.ops:
+        lower_op(ctx, op, env)
+
+
+def lower_op(ctx, op, env):
+    if op.type in _SPECIAL:
+        _SPECIAL[op.type](ctx, op, env)
+        return
+    if op.type == "grad_of":
+        _lower_grad_of(ctx, op, env)
+        return
+    od = registry.get(op.type)
+    ins = {slot: [env.read(n) for n in names]
+           for slot, names in op.inputs.items()}
+    ctx.begin_op(op.uid)
+    outs = od.lower(ctx, ins, op.attrs)
+    _write_outputs(op, outs, env)
+
+
+def _write_outputs(op, outs, env):
+    acc = op.attrs.get("__accumulate_outputs__", False)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for name, val in zip(names, vals):
+            if not name:
+                continue
+            if acc:
+                env.accumulate(name, val)
+            else:
+                env.write(name, val)
+
+
+def _is_float(x):
+    return jnp.issubdtype(jnp.result_type(x), jnp.floating)
+
+
+def _lower_grad_of(ctx, op, env):
+    """Lower a generic gradient op via jax.vjp of the forward rule.
+
+    The grad op (built by core/backward.py) carries the forward op's type,
+    attrs, and input/output name maps. Cotangents for forward outputs come
+    from env (<out>@GRAD); outputs missing a grad var get zeros. Produced
+    input grads are ACCUMULATED into <in>@GRAD names, which is correct
+    because backward.py emits grad ops in reverse topological order.
+    """
+    fwd_type = op.attrs["fwd_type"]
+    fwd_attrs = op.attrs["fwd_attrs"]
+    fwd_inputs = op.attrs["fwd_inputs"]    # slot -> [names]
+    fwd_outputs = op.attrs["fwd_outputs"]  # slot -> [names]
+    od = registry.get(fwd_type)
+
+    fwd_in_vals = {slot: [env.read(n) for n in names]
+                   for slot, names in fwd_inputs.items()}
+    fwd_uid = op.attrs.get("fwd_uid", 0)
+
+    # Differentiate only w.r.t. floating-point inputs.
+    diff_keys = []
+    for slot, vals in fwd_in_vals.items():
+        for i, v in enumerate(vals):
+            if _is_float(v):
+                diff_keys.append((slot, i))
+    diff_primal = {k: fwd_in_vals[k[0]][k[1]] for k in diff_keys}
+
+    # Forward outputs in deterministic order; only float outputs carry cotangents.
+    out_order = [(slot, i, n)
+                 for slot, names in sorted(fwd_outputs.items())
+                 for i, n in enumerate(names) if n]
+
+    def f(diff):
+        ins = {slot: list(vals) for slot, vals in fwd_in_vals.items()}
+        for (slot, i), v in diff.items():
+            ins[slot][i] = v
+        ctx.begin_op(fwd_uid)  # replay the forward op's exact PRNG stream
+        outs = od.lower(ctx, ins, fwd_attrs)
+        flat = []
+        for slot, i, n in out_order:
+            flat.append(outs[slot][i])
+        return flat
+
+    primals, vjp_fn = jax.vjp(f, diff_primal)
+
+    cotangents = []
+    for (slot, i, n), p in zip(out_order, primals):
+        g = env.read_opt(n + GRAD_SUFFIX)
+        if not _is_float(p):
+            g = jnp.zeros(p.shape, jax.dtypes.float0)
+        elif g is None:
+            g = jnp.zeros_like(p)
+        else:
+            g = jnp.asarray(g, p.dtype)
+            if g.shape != p.shape:
+                g = jnp.broadcast_to(g, p.shape)
+        cotangents.append(g)
+
+    in_grads = vjp_fn(cotangents)[0]
+
+    for (slot, i), g in in_grads.items():
+        names = fwd_inputs[slot]
+        name = names[i]
+        stop = op.attrs.get("no_grad_names", ())
+        if name in stop:
+            continue
+        env.accumulate(name + GRAD_SUFFIX, g)
+
+
+def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
+                     state_out, mesh=None):
+    """Build the pure function for a Program.
+
+    fn(feed_vals, state_rw_vals, state_ro_vals, seed)
+        -> (fetch_vals, new_state_vals)
+
+    state_rw: persistable vars both read and overwritten — safe to donate
+    (in-place parameter update on device). state_ro: read-only persistables
+    (e.g. the learning-rate var) — must NOT be donated, the Scope keeps them.
+    state_out: all persistables written (order of the returned new state).
+    """
+    def fn(feed_vals, state_rw_vals, state_ro_vals, seed):
+        base_key = jax.random.fold_in(
+            jax.random.key(program.random_seed), seed)
+        ctx = LowerCtx(program, base_key=base_key, mesh=mesh)
+        env = Env()
+        for n, v in zip(feed_names, feed_vals):
+            env.write(n, v)
+        for n, v in zip(state_rw, state_rw_vals):
+            env.write(n, v)
+        for n, v in zip(state_ro, state_ro_vals):
+            env.write(n, v)
+        lower_block(ctx, program.global_block(), env)
+        fetches = [env.read(n) for n in fetch_names]
+        new_state = [env.read(n) for n in state_out]
+        return fetches, new_state
+
+    return fn
+
+
+def analyze_state(program, feed_names, scope_names):
+    """Decide which persistable vars are program state.
+
+    Returns (state_rw, state_ro, state_out):
+      state_rw — read from Scope AND overwritten (donate: in-place update)
+      state_ro — read from Scope, never written (do not donate)
+      state_out — all persistables written (order of returned new state)
+    """
+    feed = set(feed_names)
+    written = set()
+    state_in = []
+    state_out = []
+    seen_out = set()
+
+    def visit_read(name):
+        if name in feed or name in written or name in seen_in:
+            return
+        v = _find_var(program, name)
+        if v is not None and v.persistable:
+            seen_in.add(name)
+            state_in.append(name)
+
+    seen_in = set()
+    for op in _all_ops(program):
+        for name in op.all_input_vars():
+            visit_read(name)
+        for name in op.all_output_vars():
+            if not name:
+                continue
+            written.add(name)
+            v = _find_var(program, name)
+            if v is not None and v.persistable and name not in seen_out:
+                seen_out.add(name)
+                state_out.append(name)
+    state_rw = [n for n in state_in if n in seen_out]
+    state_ro = [n for n in state_in if n not in seen_out]
+    return state_rw, state_ro, state_out
+
+
+def _all_ops(program):
+    # grad_of ops list their reads (fwd inputs + out-grads) in op.inputs, so a
+    # plain walk sees every data dependency (backward.py guarantees this).
+    for block in program.blocks:
+        for op in block.ops:
+            yield op
+
+
+def _find_var(program, name):
+    for block in program.blocks:
+        if name in block.vars:
+            return block.vars[name]
+    return None

@@ -1,0 +1,119 @@
+"""Operator registry: op type -> JAX lowering rule (+ optional shape inference).
+
+Parity: the reference's OpInfoMap / OpKernel registration
+(paddle/fluid/framework/op_registry.h, op_info.cc). Where the reference
+registers separate CPU/CUDA kernels per op and grad-op kernels per grad op,
+here each op registers ONE pure-JAX lowering rule; XLA specializes it per
+backend, and the backward pass derives gradients from the same rule via
+jax.vjp (see core/lowering.py) so no per-op grad kernels exist at all.
+
+Shape inference (the reference's InferShape methods) is generic: run the
+lowering rule under jax.eval_shape on ShapeDtypeStructs. A custom `infer`
+can override for ops whose output shape can't be derived that way
+(data-dependent shapes, sub-block ops).
+"""
+import numpy as np
+
+# sentinel substituted for the dynamic batch dim (-1) during abstract shape
+# inference; mapped back to -1 on outputs. A large prime no real layer dim
+# should collide with.
+BATCH_SENTINEL = 1021
+
+
+class OpDef(object):
+    def __init__(self, type, lower, infer=None, uses_rng=False):
+        self.type = type
+        self.lower = lower
+        self.infer = infer
+        self.uses_rng = uses_rng
+
+
+_OPS = {}
+
+
+def register(type, lower=None, infer=None, uses_rng=False):
+    """Register an op. Usable as decorator: @register('relu')."""
+    def deco(fn):
+        _OPS[type] = OpDef(type, fn, infer=infer, uses_rng=uses_rng)
+        return fn
+    if lower is not None:
+        return deco(lower)
+    return deco
+
+
+def get(type):
+    od = _OPS.get(type)
+    if od is None:
+        raise NotImplementedError("op %r has no registered TPU lowering" % type)
+    return od
+
+
+def is_registered(type):
+    return type in _OPS
+
+
+def single(ins, slot, default=None):
+    """Fetch the single value of an input slot (helper for lowering rules)."""
+    vs = ins.get(slot)
+    if not vs:
+        return default
+    return vs[0]
+
+
+class AbstractCtx(object):
+    """LowerCtx stand-in used during eval_shape-based inference."""
+    is_startup = False
+    is_abstract = True
+    mesh = None
+
+    def rng(self, salt=0, seed=0):
+        import jax
+        return jax.random.fold_in(jax.random.key(0), salt)
+
+    def begin_op(self, salt):
+        pass
+
+
+def _struct_for(var):
+    import jax
+    if var.shape is None:
+        return None
+    shape = tuple(BATCH_SENTINEL if d == -1 else d for d in var.shape)
+    return jax.ShapeDtypeStruct(shape, np.dtype(var.dtype))
+
+
+def infer_and_set_shapes(block, op):
+    """Set output Variable shapes/dtypes by abstractly evaluating the lowering.
+
+    Mirrors OpDesc::InferShape/InferVarType in the reference, but with zero
+    per-op code in the common case.
+    """
+    if not is_registered(op.type):
+        return  # ops lowered specially (grad_of, control-flow) set shapes themselves
+    od = get(op.type)
+    out_vars = {slot: [block.var_recursive(n) for n in names]
+                for slot, names in op.outputs.items()}
+    if od.infer is not None:
+        od.infer(block, op, out_vars)
+        return
+    import jax
+    try:
+        ins = {}
+        for slot, names in op.inputs.items():
+            structs = [_struct_for(block.var_recursive(n)) for n in names]
+            if any(s is None for s in structs):
+                return  # un-inferable input; leave outputs as declared
+            ins[slot] = structs
+        ctx = AbstractCtx()
+        outs = jax.eval_shape(lambda i: od.lower(ctx, i, op.attrs), ins)
+    except Exception:
+        return  # inference is best-effort; executor lowering gives real errors
+    for slot, structs in outs.items():
+        if slot not in out_vars:
+            continue
+        for var, st in zip(out_vars[slot], structs):
+            if st is None:
+                continue
+            var.shape = tuple(-1 if d == BATCH_SENTINEL else int(d)
+                              for d in st.shape)
+            var.dtype = np.dtype(st.dtype).name

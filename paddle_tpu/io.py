@@ -1,0 +1,150 @@
+"""Model persistence: save/load params, persistables, inference models,
+checkpoints.
+
+Parity: python/paddle/fluid/io.py. Storage format is a directory of .npy
+files (one per var, like the reference's one-file-per-var LoDTensor dumps)
+plus a JSON manifest; `save_inference_model` additionally pickles the pruned
+inference Program. Orbax-grade sharded checkpointing for the distributed path
+lives in parallel/checkpoint.py; this module is the single-host surface.
+"""
+import json
+import os
+import pickle
+
+import numpy as np
+
+from .core.framework import Program, Parameter, Variable, default_main_program
+from .core.executor import global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "get_inference_program",
+    "save_checkpoint", "load_checkpoint",
+]
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _var_list(main_program, predicate, vars):
+    if vars is not None:
+        return [v if isinstance(v, Variable) else
+                main_program.global_block().var(v) for v in vars]
+    if main_program is None:
+        main_program = default_main_program()
+    return [v for v in main_program.list_vars() if predicate(v)]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    vars = _var_list(main_program, predicate or is_persistable, vars)
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    manifest = {}
+    for v in vars:
+        val = scope.get(v.name)
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        safe = v.name.replace("/", "__")
+        np.save(os.path.join(dirname, safe + ".npy"), arr)
+        manifest[v.name] = {"file": safe + ".npy", "shape": list(arr.shape),
+                            "dtype": str(arr.dtype)}
+    with open(os.path.join(dirname, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def save_params(executor, dirname, main_program=None, vars=None,
+                filename=None):
+    save_vars(executor, dirname, main_program, vars, is_parameter, filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, None, is_persistable, filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    with open(os.path.join(dirname, "manifest.json")) as f:
+        manifest = json.load(f)
+    scope = global_scope()
+    want = None
+    if vars is not None or main_program is not None:
+        want = set(v.name for v in
+                   _var_list(main_program, predicate or is_persistable, vars))
+    for name, meta in manifest.items():
+        if want is not None and name not in want:
+            continue
+        arr = np.load(os.path.join(dirname, meta["file"]))
+        scope.set(name, arr)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, is_parameter, filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, is_persistable, filename)
+
+
+def get_inference_program(target_vars, main_program=None):
+    if main_program is None:
+        main_program = default_main_program()
+    return main_program.clone(for_test=True)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    """Parity: fluid.io.save_inference_model — prunes to the inference
+    sub-graph, stores program + params."""
+    if main_program is None:
+        main_program = default_main_program()
+    inference_program = main_program.clone(for_test=True)
+    target_names = [v if isinstance(v, str) else v.name for v in target_vars]
+    os.makedirs(dirname, exist_ok=True)
+    meta = {"feed": list(feeded_var_names), "fetch": target_names}
+    with open(os.path.join(dirname, "__model_meta__.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(dirname, model_filename or "__model__"), "wb") as f:
+        pickle.dump(inference_program, f)
+    save_params(executor, dirname, main_program)
+    return inference_program
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__"), "rb") as f:
+        program = pickle.load(f)
+    with open(os.path.join(dirname, "__model_meta__.json")) as f:
+        meta = json.load(f)
+    load_params(executor, dirname)
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch"]]
+    return program, meta["feed"], fetch_vars
+
+
+def save_checkpoint(executor, checkpoint_dir, main_program=None,
+                    trainer_id=0, step=0):
+    """Checkpoint/resume (parity: fluid.io checkpoint utilities)."""
+    d = os.path.join(checkpoint_dir, "step_%d" % step)
+    save_persistables(executor, d, main_program)
+    with open(os.path.join(checkpoint_dir, "LATEST"), "w") as f:
+        f.write(str(step))
+
+
+def load_checkpoint(executor, checkpoint_dir, main_program=None):
+    latest = os.path.join(checkpoint_dir, "LATEST")
+    if not os.path.exists(latest):
+        return None
+    with open(latest) as f:
+        step = int(f.read().strip())
+    load_persistables(executor,
+                      os.path.join(checkpoint_dir, "step_%d" % step),
+                      main_program)
+    return step

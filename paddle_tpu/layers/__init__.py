@@ -1,0 +1,18 @@
+"""fluid.layers-equivalent namespace.
+
+Parity: python/paddle/fluid/layers/__init__.py — flat re-export of nn, ops,
+tensor, io, control_flow (+ detection/metric added with their milestones).
+"""
+from . import nn
+from .nn import *          # noqa: F401,F403
+from . import ops
+from .ops import *         # noqa: F401,F403
+from . import tensor
+from .tensor import *      # noqa: F401,F403
+from . import io
+from .io import *          # noqa: F401,F403
+from .math_op_patch import monkey_patch_variable
+
+monkey_patch_variable()
+
+__all__ = (nn.__all__ + ops.__all__ + tensor.__all__ + io.__all__)

@@ -1,0 +1,21 @@
+"""Data-input layers.
+
+Parity: python/paddle/fluid/layers/io.py — `data` declares a feed Variable
+(batch dim prepended as -1, like the reference's append_batch_size).
+"""
+from ..core.framework import default_main_program, default_startup_program
+
+__all__ = ["data"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=None, stop_gradient=True):
+    shape = list(shape)
+    if append_batch_size:
+        if all(s >= 0 for s in shape):
+            shape = [-1] + shape
+        # if user already put a -1 in shape, don't prepend another batch dim
+    main = default_main_program().global_block().create_var(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        stop_gradient=stop_gradient, is_data=True)
+    return main

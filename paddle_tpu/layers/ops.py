@@ -1,0 +1,100 @@
+"""Auto-generated thin layer wrappers over registered ops.
+
+Parity: python/paddle/fluid/layers/ops.py + layer_function_generator.py —
+the reference generates these from OpProto; here they are generated from a
+slot-spec table. Both calling styles work: `mean(x)` and `mean(x=var)`.
+"""
+from ..core.framework import Variable
+from ..core.layer_helper import LayerHelper
+
+__activations__ = [
+    "sigmoid", "logsigmoid", "exp", "relu", "tanh", "tanh_shrink",
+    "softshrink", "sqrt", "abs", "ceil", "floor", "cos", "sin", "round",
+    "reciprocal", "log", "square", "softplus", "softsign", "brelu",
+    "leaky_relu", "soft_relu", "elu", "relu6", "pow", "stanh", "hard_shrink",
+    "thresholded_relu", "hard_sigmoid", "swish",
+]
+
+__all__ = [
+    "mean", "mul", "reshape", "scale", "sigmoid_cross_entropy_with_logits",
+    "elementwise_add", "elementwise_div", "elementwise_sub", "elementwise_mul",
+    "elementwise_max", "elementwise_min", "elementwise_pow", "clip",
+    "clip_by_norm", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "uniform_random", "uniform_random_batch_size_like", "gaussian_random",
+    "gaussian_random_batch_size_like", "cumsum", "scatter", "sum", "gather",
+    "fill_constant_batch_size_like", "squeeze", "unsqueeze",
+] + __activations__
+
+# op type -> (input slots [(slot, kw, required)], output slots, out dtype fn)
+_UNARY = [("X", "x", True)]
+_BINARY = [("X", "x", True), ("Y", "y", True)]
+
+_SPECS = {
+    "mean": (_UNARY, ["Out"]),
+    "mul": (_BINARY, ["Out"]),
+    "reshape": (_UNARY, ["Out"]),
+    "scale": (_UNARY, ["Out"]),
+    "sigmoid_cross_entropy_with_logits":
+        ([("X", "x", True), ("Label", "label", True)], ["Out"]),
+    "clip": (_UNARY, ["Out"]),
+    "clip_by_norm": (_UNARY, ["Out"]),
+    "logical_not": (_UNARY, ["Out"]),
+    "cumsum": (_UNARY, ["Out"]),
+    "scatter": ([("X", "x", True), ("Ids", "ids", True),
+                 ("Updates", "updates", True)], ["Out"]),
+    "gather": ([("X", "x", True), ("Index", "index", True)], ["Out"]),
+    "sum": ([("X", "x", True)], ["Out"]),
+    "uniform_random": ([], ["Out"]),
+    "gaussian_random": ([], ["Out"]),
+    "uniform_random_batch_size_like": ([("Input", "input", True)], ["Out"]),
+    "gaussian_random_batch_size_like": ([("Input", "input", True)], ["Out"]),
+    "fill_constant_batch_size_like": ([("Input", "input", True)], ["Out"]),
+    "squeeze": (_UNARY, ["Out"]),
+    "unsqueeze": (_UNARY, ["Out"]),
+}
+for _a in __activations__:
+    _SPECS[_a] = (_UNARY, ["Out"])
+for _e in ["elementwise_add", "elementwise_div", "elementwise_sub",
+           "elementwise_mul", "elementwise_max", "elementwise_min",
+           "elementwise_pow"]:
+    _SPECS[_e] = (_BINARY, ["Out"])
+for _l in ["logical_and", "logical_or", "logical_xor"]:
+    _SPECS[_l] = (_BINARY, ["Out"])
+
+
+def generate_layer_fn(op_type):
+    in_slots, out_slots = _SPECS[op_type]
+
+    def layer_fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        act = kwargs.pop("act", None)
+        inputs = {}
+        pos = list(args)
+        dtype = kwargs.pop("dtype", None)
+        for slot, kw, required in in_slots:
+            v = kwargs.pop(kw, None)
+            if v is None and pos:
+                v = pos.pop(0)
+            if v is None:
+                if required:
+                    raise ValueError("%s missing input %r" % (op_type, kw))
+                continue
+            inputs[slot] = v if isinstance(v, (list, tuple)) else [v]
+            if dtype is None:
+                first = inputs[slot][0]
+                if isinstance(first, Variable):
+                    dtype = first.dtype
+        helper = LayerHelper(op_type, name=name, act=act)
+        outs = {s: [helper.create_variable_for_type_inference(
+            dtype or "float32")] for s in out_slots}
+        helper.append_op(type=op_type, inputs=inputs, outputs=outs,
+                         attrs=kwargs)
+        out = outs[out_slots[0]][0]
+        return helper.append_activation(out)
+
+    layer_fn.__name__ = op_type
+    return layer_fn
+
+
+for _op in set(__all__):
+    globals()[_op] = generate_layer_fn(_op)

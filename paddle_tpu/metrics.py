@@ -1,0 +1,130 @@
+"""Host-side streaming metrics.
+
+Parity: python/paddle/fluid/metrics.py + evaluator.py (Accuracy, ChunkEvaluator,
+EditDistance, DetectionMAP are graph-side; these accumulate across batches).
+"""
+import numpy as np
+
+__all__ = ["MetricBase", "CompositeMetric", "Accuracy", "ChunkEvaluator",
+           "EditDistance", "Auc"]
+
+
+class MetricBase(object):
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        for attr, value in self.__dict__.items():
+            if attr.startswith("_"):
+                continue
+            if isinstance(value, (int, float)):
+                setattr(self, attr, 0)
+            elif isinstance(value, np.ndarray):
+                setattr(self, attr, np.zeros_like(value))
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super(CompositeMetric, self).__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, *args, **kwargs):
+        for m in self._metrics:
+            m.update(*args, **kwargs)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Accuracy(MetricBase):
+    def __init__(self, name=None):
+        super(Accuracy, self).__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1):
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no batches accumulated")
+        return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    def __init__(self, name=None):
+        super(ChunkEvaluator, self).__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0)
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super(EditDistance, self).__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        d = np.asarray(distances)
+        self.total_distance += float(d.sum())
+        self.seq_num += int(seq_num)
+        self.instance_error += int((d > 0).sum())
+
+    def eval(self):
+        avg = self.total_distance / max(self.seq_num, 1)
+        err_rate = self.instance_error / max(self.seq_num, 1)
+        return avg, err_rate
+
+
+class Auc(MetricBase):
+    def __init__(self, name=None, num_thresholds=200):
+        super(Auc, self).__init__(name)
+        self._num_thresholds = num_thresholds
+        self.tp = np.zeros(num_thresholds, dtype=np.int64)
+        self.fp = np.zeros(num_thresholds, dtype=np.int64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(-1)
+        pos_score = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 \
+            else preds.reshape(-1)
+        bucket = np.clip((pos_score * self._num_thresholds).astype(int),
+                         0, self._num_thresholds - 1)
+        for b, l in zip(bucket, labels):
+            if l > 0:
+                self.tp[b] += 1
+            else:
+                self.fp[b] += 1
+
+    def eval(self):
+        tp_c = np.cumsum(self.tp[::-1])[::-1].astype(float)
+        fp_c = np.cumsum(self.fp[::-1])[::-1].astype(float)
+        tpr = tp_c / max(tp_c[0], 1)
+        fpr = fp_c / max(fp_c[0], 1)
+        return float(-np.trapezoid(tpr, fpr))

@@ -1,0 +1,103 @@
+"""Composite network helpers.
+
+Parity: python/paddle/fluid/nets.py — simple_img_conv_pool, img_conv_group,
+sequence_conv_pool, glu, scaled_dot_product_attention.
+"""
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "glu", "scaled_dot_product_attention"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, act, param_attr=None,
+                         pool_type="max", use_cudnn=True, use_mkldnn=False):
+    conv_out = layers.conv2d(input=input, num_filters=num_filters,
+                             filter_size=filter_size, param_attr=param_attr,
+                             act=act, use_cudnn=use_cudnn)
+    return layers.pool2d(input=conv_out, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride,
+                         use_cudnn=use_cudnn)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True,
+                   use_mkldnn=False):
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def __extend_list__(obj):
+        if not hasattr(obj, "__len__"):
+            return [obj] * len(conv_num_filter)
+        return list(obj)
+
+    conv_padding = __extend_list__(conv_padding)
+    conv_filter_size = __extend_list__(conv_filter_size)
+    param_attr = __extend_list__(param_attr)
+    conv_with_batchnorm = __extend_list__(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = __extend_list__(conv_batchnorm_drop_rate)
+
+    for i in range(len(conv_num_filter)):
+        local_conv_act = conv_act
+        if conv_with_batchnorm[i]:
+            local_conv_act = None
+        tmp = layers.conv2d(input=tmp, num_filters=conv_num_filter[i],
+                            filter_size=conv_filter_size[i],
+                            padding=conv_padding[i], param_attr=param_attr[i],
+                            act=local_conv_act, use_cudnn=use_cudnn)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            drop_rate = conv_batchnorm_drop_rate[i]
+            if abs(drop_rate) > 1e-5:
+                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
+    return layers.pool2d(input=tmp, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride,
+                         use_cudnn=use_cudnn)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max"):
+    conv_out = layers.sequence_conv(input=input, num_filters=num_filters,
+                                    filter_size=filter_size,
+                                    param_attr=param_attr, act=act)
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)
+
+
+def glu(input, dim=-1):
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    act_b = layers.sigmoid(x=b)
+    return layers.elementwise_mul(x=a, y=act_b)
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Parity: fluid.nets.scaled_dot_product_attention (3-D q/k/v)."""
+    if num_heads != 1:
+        # split heads: [B, T, D] -> [B, heads, T, D/heads]
+        def _split_heads(x):
+            reshaped = layers.reshape(
+                x=x, shape=[x.shape[0] if x.shape[0] > 0 else -1, x.shape[1],
+                            num_heads, x.shape[2] // num_heads])
+            return layers.transpose(x=reshaped, perm=[0, 2, 1, 3])
+        q, k, v = map(_split_heads, (queries, keys, values))
+    else:
+        q, k, v = queries, keys, values
+    key_dim = float(k.shape[-1])
+    scaled_q = layers.scale(x=q, scale=key_dim ** -0.5)
+    product = layers.matmul(x=scaled_q, y=k, transpose_y=True)
+    weights = layers.reshape(
+        x=layers.softmax(layers.reshape(
+            x=product, shape=[-1, product.shape[-1]])),
+        shape=[d if d > 0 else -1 for d in product.shape[:-1]] +
+              [product.shape[-1]])
+    if dropout_rate:
+        weights = layers.dropout(x=weights, dropout_prob=dropout_rate)
+    ctx_multiheads = layers.matmul(weights, v)
+    if num_heads == 1:
+        return ctx_multiheads
+    t = layers.transpose(ctx_multiheads, perm=[0, 2, 1, 3])
+    return layers.reshape(x=t, shape=[t.shape[0] if t.shape[0] > 0 else -1,
+                                      t.shape[1],
+                                      t.shape[2] * t.shape[3]])

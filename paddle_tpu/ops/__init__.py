@@ -1,0 +1,6 @@
+"""Op lowering rules. Importing this package registers all ops."""
+from . import basic      # noqa: F401
+from . import nn_ops     # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import sequence_ops   # noqa: F401
+from . import control_ops    # noqa: F401

@@ -1,0 +1,6 @@
+"""Control-flow op lowerings (While, conditional_block, tensor arrays).
+
+Parity: paddle/fluid/operators/{while_op,conditional_block_op,
+array_operator,tensor_array_read_write}.cc. Filled out with the
+control-flow milestone.
+"""

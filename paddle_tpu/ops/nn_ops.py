@@ -1,0 +1,444 @@
+"""NN op lowerings: conv, pool, norms, losses, embedding, dropout.
+
+Parity: paddle/fluid/operators/{conv_op,conv_cudnn_op,conv_transpose_op,
+pool_op,batch_norm_op,layer_norm_op,dropout_op,softmax_op,cross_entropy_op,
+softmax_with_cross_entropy_op,sigmoid_cross_entropy_with_logits_op,
+lookup_table_op,accuracy_op,smooth_l1_loss_op,log_loss_op,huber_loss_op,
+lrn_op,maxout_op,label_smooth_op,nce_op}.{cc,cu,h}.
+
+TPU notes: convs/matmuls keep fluid's NCHW layout at the IR level — XLA's TPU
+layout assignment transposes to the MXU-friendly layout internally, so parity
+of semantics costs nothing. bf16 inputs get f32 accumulation via
+preferred_element_type.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register, single
+
+
+def _out(x):
+    return {"Out": [x]}
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+# ---------------------------------------------------------------------------
+# convolution family (MXU)
+# ---------------------------------------------------------------------------
+
+@register("conv2d")
+def _conv2d(ctx, ins, attrs):
+    x = single(ins, "Input")    # NCHW
+    w = single(ins, "Filter")   # OIHW
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    return _conv2d(ctx, ins, attrs)
+
+
+@register("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    x = single(ins, "Input")    # NCHW
+    w = single(ins, "Filter")   # IOHW in fluid transpose conv
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    out = lax.conv_transpose(
+        x, w,
+        strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# pooling (reference: pool_op.cc; cuDNN pooling → lax.reduce_window)
+# ---------------------------------------------------------------------------
+
+@register("pool2d")
+def _pool2d(ctx, ins, attrs):
+    x = single(ins, "X")  # NCHW
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling"):
+        ksize = (x.shape[2], x.shape[3])
+        pads = (0, 0)
+        strides = (1, 1)
+    window = (1, 1) + ksize
+    strides4 = (1, 1) + strides
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(x, init, lax.max, window, strides4, padding)
+    else:
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides4, padding)
+        if attrs.get("exclusive", True) and (pads[0] or pads[1]):
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides4, padding)
+            out = s / cnt
+        else:
+            out = s / float(ksize[0] * ksize[1])
+    return _out(out.astype(x.dtype))
+
+
+@register("maxout")
+def _maxout(ctx, ins, attrs):
+    x = single(ins, "X")  # NCHW
+    g = attrs["groups"]
+    n, c, h, w = x.shape
+    return _out(jnp.max(x.reshape(n, c // g, g, h, w), axis=2))
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+@register("batch_norm")
+def _batch_norm(ctx, ins, attrs):
+    x = single(ins, "X")          # NCHW or NC
+    scale = single(ins, "Scale")  # [C]
+    bias = single(ins, "Bias")
+    mean = single(ins, "Mean")      # moving mean (persistable)
+    var = single(ins, "Variance")   # moving variance (persistable)
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    layout = attrs.get("data_layout", "NCHW")
+
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (1 if layout == "NCHW" and x.ndim > 2 else x.ndim - 1))
+    caxis = 1 if (layout == "NCHW" and x.ndim > 2) else x.ndim - 1
+    bshape = [1] * x.ndim
+    bshape[caxis] = x.shape[caxis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        xf = x.astype(jnp.float32)
+        use_mean = jnp.mean(xf, axis=axes)
+        use_var = jnp.var(xf, axis=axes)
+        # moving averages updated OUTSIDE the grad path
+        use_mean_s = lax.stop_gradient(use_mean)
+        use_var_s = lax.stop_gradient(use_var)
+        mean_out = momentum * mean + (1 - momentum) * use_mean_s
+        var_out = momentum * var + (1 - momentum) * use_var_s
+        saved_mean = use_mean
+        saved_var = use_var
+
+    inv = lax.rsqrt(use_var.astype(jnp.float32) + eps)
+    y = (x.astype(jnp.float32) - use_mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": [y.astype(x.dtype)],
+            "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+
+
+@register("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    x = single(ins, "X")
+    scale = single(ins, "Scale")
+    bias = single(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    lead = int(np.prod(x.shape[:begin]))
+    x2 = x.reshape(lead, -1).astype(jnp.float32)
+    mean = jnp.mean(x2, axis=1, keepdims=True)
+    var = jnp.var(x2, axis=1, keepdims=True)
+    y = (x2 - mean) * lax.rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale.reshape(1, -1)
+    if bias is not None:
+        y = y + bias.reshape(1, -1)
+    return {"Y": [y.reshape(x.shape).astype(x.dtype)],
+            "Mean": [mean.reshape(lead)], "Variance": [var.reshape(lead)]}
+
+
+@register("lrn")
+def _lrn(ctx, ins, attrs):
+    x = single(ins, "X")  # NCHW
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+@register("l2_normalize")
+def _l2_norm_op(ctx, ins, attrs):
+    x = single(ins, "X")
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+# ---------------------------------------------------------------------------
+# dropout (reference: dropout_op.cc — Mask output keeps fwd/bwd consistent)
+# ---------------------------------------------------------------------------
+
+@register("dropout", uses_rng=True)
+def _dropout(ctx, ins, attrs):
+    x = single(ins, "X")
+    p = attrs.get("dropout_prob", 0.5)
+    if attrs.get("is_test", False):
+        # fluid's default "downgrade_in_infer": scale at inference
+        return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(ctx.rng(seed=attrs.get("seed", 0)), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    return {"Out": [x * mask], "Mask": [mask]}
+
+
+# ---------------------------------------------------------------------------
+# softmax & losses
+# ---------------------------------------------------------------------------
+
+@register("softmax")
+def _softmax(ctx, ins, attrs):
+    return _out(jax.nn.softmax(single(ins, "X"), axis=-1))
+
+
+@register("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return _out(jax.nn.log_softmax(single(ins, "X"), axis=-1))
+
+
+def _gather_label_logits(logp, label):
+    lab = label.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(logp.shape[0])
+    return logp[rows, lab]
+
+
+@register("cross_entropy")
+def _cross_entropy(ctx, ins, attrs):
+    x = single(ins, "X")        # probabilities [N, C]
+    label = single(ins, "Label")
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), axis=-1,
+                        keepdims=True)
+    else:
+        picked = _gather_label_logits(jnp.log(jnp.maximum(x, 1e-20)), label)
+        loss = -picked.reshape(-1, 1)
+    return {"Y": [loss]}
+
+
+@register("softmax_with_cross_entropy")
+def _softmax_xent(ctx, ins, attrs):
+    logits = single(ins, "Logits")
+    label = single(ins, "Label")
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        loss = -_gather_label_logits(logp, label).reshape(-1, 1)
+    return {"Softmax": [jnp.exp(logp).astype(logits.dtype)],
+            "Loss": [loss.astype(logits.dtype)]}
+
+
+@register("sigmoid_cross_entropy_with_logits")
+def _sigmoid_xent(ctx, ins, attrs):
+    x = single(ins, "X")
+    label = single(ins, "Label")
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return _out(loss)
+
+
+@register("square_error_cost")
+def _square_error(ctx, ins, attrs):
+    x, y = single(ins, "X"), single(ins, "Y")
+    return _out(jnp.square(x - y))
+
+
+@register("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    x, y = single(ins, "X"), single(ins, "Y")
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    iw = single(ins, "InsideWeight")
+    ow = single(ins, "OutsideWeight")
+    if iw is not None:
+        diff = diff * iw
+    ad = jnp.abs(diff)
+    elem = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    if ow is not None:
+        elem = elem * ow
+    loss = jnp.sum(elem.reshape(elem.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [loss], "Diff": [diff]}
+
+
+@register("log_loss")
+def _log_loss(ctx, ins, attrs):
+    p = single(ins, "Predicted")
+    label = single(ins, "Labels")
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": [loss]}
+
+
+@register("huber_loss")
+def _huber_loss(ctx, ins, attrs):
+    x, y = single(ins, "X"), single(ins, "Y")
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    logits = single(ins, "Logits")
+    labels = single(ins, "Labels")
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)]}
+
+
+@register("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    label = single(ins, "Label")
+    left = single(ins, "Left")
+    right = single(ins, "Right")
+    d = left - right
+    return _out(jnp.log1p(jnp.exp(d)) - label * d)
+
+
+@register("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    label = single(ins, "Label")
+    x1, x2 = single(ins, "X1"), single(ins, "X2")
+    margin = attrs.get("margin", 0.0)
+    act = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [act], "Activated": [(act > 0).astype(x1.dtype)]}
+
+
+@register("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    x = single(ins, "X")
+    eps = attrs.get("epsilon", 0.0)
+    dist = single(ins, "PriorDist")
+    if dist is not None:
+        out = (1 - eps) * x + eps * dist
+    else:
+        out = (1 - eps) * x + eps / x.shape[-1]
+    return _out(out)
+
+
+# ---------------------------------------------------------------------------
+# embedding (reference: lookup_table_op — the pserver sparse path's hot op)
+# ---------------------------------------------------------------------------
+
+@register("lookup_table")
+def _lookup_table(ctx, ins, attrs):
+    w = single(ins, "W")        # [V, D]
+    ids = single(ins, "Ids")    # [N, 1] int64
+    flat = ids.reshape(-1).astype(jnp.int32)
+    padding_idx = attrs.get("padding_idx", -1)
+    out = jnp.take(w, flat, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((flat == padding_idx)[:, None], 0.0, out)
+    out_shape = tuple(ids.shape[:-1]) + (w.shape[-1],) \
+        if ids.shape and ids.shape[-1] == 1 else tuple(ids.shape) + (w.shape[-1],)
+    return _out(out.reshape(out_shape))
+
+
+# ---------------------------------------------------------------------------
+# metrics (reference: accuracy_op.cc, auc_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("accuracy")
+def _accuracy(ctx, ins, attrs):
+    pred_idx = single(ins, "Indices")   # [N, k] from topk
+    label = single(ins, "Label")        # [N, 1]
+    n = pred_idx.shape[0]
+    correct = jnp.any(pred_idx.astype(jnp.int64) ==
+                      label.astype(jnp.int64).reshape(-1, 1), axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    return {"Accuracy": [(num_correct / n).reshape(1)],
+            "Correct": [num_correct.astype(jnp.int32).reshape(1)],
+            "Total": [jnp.full((1,), n, jnp.int32)]}
+
+
+@register("auc")
+def _auc(ctx, ins, attrs):
+    # streaming AUC state lives in persistable vars updated here
+    pred = single(ins, "Predict")
+    label = single(ins, "Label").reshape(-1)
+    tp_in = single(ins, "TP")  # stat buckets [num_thresholds]
+    fp_in = single(ins, "FP")
+    num_t = attrs.get("num_thresholds", 200)
+    pos_score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 else pred.reshape(-1)
+    bucket = jnp.clip((pos_score * num_t).astype(jnp.int32), 0, num_t - 1)
+    is_pos = (label > 0).astype(jnp.int64)
+    tp = tp_in + jnp.zeros_like(tp_in).at[bucket].add(is_pos)
+    fp = fp_in + jnp.zeros_like(fp_in).at[bucket].add(1 - is_pos)
+    # integrate over thresholds (cumulative from high score to low)
+    tp_c = jnp.cumsum(tp[::-1])[::-1].astype(jnp.float64)
+    fp_c = jnp.cumsum(fp[::-1])[::-1].astype(jnp.float64)
+    tot_pos = jnp.maximum(tp_c[0], 1)
+    tot_neg = jnp.maximum(fp_c[0], 1)
+    tpr = tp_c / tot_pos
+    fpr = fp_c / tot_neg
+    auc = -jnp.trapezoid(tpr, fpr)
+    return {"AUC": [auc.astype(jnp.float32).reshape(1)],
+            "TPOut": [tp], "FPOut": [fp]}
+
+
+# ---------------------------------------------------------------------------
+# nce (reference: nce_op.cc) — negative sampling loss
+# ---------------------------------------------------------------------------
+
+@register("nce", uses_rng=True)
+def _nce(ctx, ins, attrs):
+    x = single(ins, "Input")          # [N, D]
+    label = single(ins, "Label")      # [N, num_true]
+    w = single(ins, "Weight")         # [V, D]
+    b = single(ins, "Bias")           # [V]
+    num_neg = attrs.get("num_neg_samples", 10)
+    num_total = attrs.get("num_total_classes")
+    n = x.shape[0]
+    label = label.reshape(n, -1).astype(jnp.int32)
+    num_true = label.shape[1]
+    neg = jax.random.randint(ctx.rng(seed=attrs.get("seed", 0)), (n, num_neg), 0, num_total)
+    samples = jnp.concatenate([label, neg], axis=1)      # [N, T+S]
+    sw = jnp.take(w, samples.reshape(-1), axis=0).reshape(n, -1, w.shape[1])
+    logits = jnp.einsum("nd,nsd->ns", x, sw)
+    if b is not None:
+        logits = logits + jnp.take(b.reshape(-1), samples.reshape(-1)).reshape(n, -1)
+    labels01 = jnp.concatenate(
+        [jnp.ones((n, num_true)), jnp.zeros((n, num_neg))], axis=1)
+    ce = jnp.maximum(logits, 0) - logits * labels01 + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    cost = jnp.sum(ce, axis=1, keepdims=True)
+    return {"Cost": [cost], "SampleLogits": [logits], "SampleLabels": [samples]}

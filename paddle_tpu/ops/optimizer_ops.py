@@ -1,0 +1,165 @@
+"""Optimizer update-rule op lowerings.
+
+Parity: paddle/fluid/operators/{sgd_op,momentum_op,adam_op,adagrad_op,
+adamax_op,decayed_adagrad_op,adadelta_op,rmsprop_op,ftrl_op}.{cc,cu,h}.
+Each writes ParamOut (and accumulator outs) under the SAME var name as the
+input, so the executor's state write-back gives in-place-update semantics
+without aliasing machinery. All accumulator math in f32 even when params are
+bf16 (accumulators are created f32 by the Optimizer classes).
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register, single
+
+
+@register("sgd")
+def _sgd(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    lr = single(ins, "LearningRate").reshape(())
+    return {"ParamOut": [(p - lr * g).astype(p.dtype)]}
+
+
+@register("momentum")
+def _momentum(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    v = single(ins, "Velocity")
+    lr = single(ins, "LearningRate").reshape(())
+    mu = attrs["mu"]
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out.astype(p.dtype)], "VelocityOut": [v_out]}
+
+
+@register("adam")
+def _adam(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    m = single(ins, "Moment1")
+    v = single(ins, "Moment2")
+    lr = single(ins, "LearningRate").reshape(())
+    b1p = single(ins, "Beta1Pow").reshape(())
+    b2p = single(ins, "Beta2Pow").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    gf = g.astype(jnp.float32)
+    m_out = b1 * m + (1 - b1) * gf
+    v_out = b2 * v + (1 - b2) * jnp.square(gf)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - lr_t * m_out / (jnp.sqrt(v_out) + eps)
+    return {"ParamOut": [p_out.astype(p.dtype)],
+            "Moment1Out": [m_out], "Moment2Out": [v_out]}
+
+
+@register("adam_beta_pow_update")
+def _adam_beta_pow(ctx, ins, attrs):
+    b1p = single(ins, "Beta1Pow")
+    b2p = single(ins, "Beta2Pow")
+    return {"Beta1PowOut": [b1p * attrs.get("beta1", 0.9)],
+            "Beta2PowOut": [b2p * attrs.get("beta2", 0.999)]}
+
+
+@register("adagrad")
+def _adagrad(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    mom = single(ins, "Moment")
+    lr = single(ins, "LearningRate").reshape(())
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = mom + jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [m_out]}
+
+
+@register("adamax")
+def _adamax(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    m = single(ins, "Moment")
+    inf_norm = single(ins, "InfNorm")
+    lr = single(ins, "LearningRate").reshape(())
+    b1p = single(ins, "Beta1Pow").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1 - b1) * g
+    n_out = jnp.maximum(b2 * inf_norm, jnp.abs(g) + eps)
+    p_out = p - (lr / (1 - b1p)) * (m_out / n_out)
+    return {"ParamOut": [p_out.astype(p.dtype)],
+            "MomentOut": [m_out], "InfNormOut": [n_out]}
+
+
+@register("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    mom = single(ins, "Moment")
+    lr = single(ins, "LearningRate").reshape(())
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = decay * mom + (1 - decay) * jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [m_out]}
+
+
+@register("adadelta")
+def _adadelta(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    avg_sq_g = single(ins, "AvgSquaredGrad")
+    avg_sq_u = single(ins, "AvgSquaredUpdate")
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g2 = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    return {"ParamOut": [(p + update).astype(p.dtype)],
+            "AvgSquaredGradOut": [g2], "AvgSquaredUpdateOut": [u2]}
+
+
+@register("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    ms = single(ins, "MeanSquare")
+    mom = single(ins, "Moment")
+    lr = single(ins, "LearningRate").reshape(())
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out + eps)
+    return {"ParamOut": [(p - mom_out).astype(p.dtype)],
+            "MeanSquareOut": [ms_out], "MomentOut": [mom_out]}
+
+
+@register("ftrl")
+def _ftrl(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    sq_acc = single(ins, "SquaredAccumulator")
+    lin_acc = single(ins, "LinearAccumulator")
+    lr = single(ins, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    new_sq = sq_acc + jnp.square(g)
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq_acc)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) - jnp.power(sq_acc, -power)) / lr
+    new_lin = lin_acc + g - sigma * p
+    if power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -power) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    p_out = pre / denom
+    return {"ParamOut": [p_out.astype(p.dtype)],
+            "SquaredAccumOut": [new_sq], "LinearAccumOut": [new_lin]}

@@ -1,0 +1,4 @@
+"""Sequence / LoD op lowerings (filled out with the sequence milestone).
+
+Parity: paddle/fluid/operators/sequence_*.cc, gru_op.cc, lstm_op.cc.
+"""

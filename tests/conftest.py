@@ -1,0 +1,15 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh so
+sharding tests work on any machine (SURVEY.md §4). The image pins
+JAX_PLATFORMS=axon (the real TPU tunnel) via jax config at import, so we
+must override the config value itself, not just the env var."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # real chip is for bench.py, not tests
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,73 @@
+"""End-to-end: fit_a_line linear regression (BASELINE.json config #1).
+
+Parity: python/paddle/fluid/tests/book/test_fit_a_line.py — same program
+construction, trained on synthetic y = Xw + b + noise; loss must drop.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_fit_a_line_converges():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(x=cost)
+        sgd = fluid.optimizer.SGD(learning_rate=0.1)
+        sgd.minimize(avg_cost)
+
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(13, 1).astype("float32")
+    def batch(n=32):
+        xs = rng.rand(n, 13).astype("float32")
+        ys = xs @ true_w + 0.1
+        return xs, ys
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for i in range(200):
+            xs, ys = batch()
+            loss, = exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[avg_cost])
+            losses.append(float(loss[0]))
+    assert losses[-1] < losses[0] * 0.2, losses[::40]
+    assert losses[-1] < 0.1, losses[::40]
+
+
+def test_fetch_weights_and_grad():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1,
+                               param_attr=fluid.ParamAttr(name="w"),
+                               bias_attr=fluid.ParamAttr(name="b"))
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.append_backward(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xs = np.ones((8, 4), dtype="float32")
+        ys = np.zeros((8, 1), dtype="float32")
+        gw, gb = exe.run(main, feed={"x": xs, "y": ys},
+                         fetch_list=["w@GRAD", "b@GRAD"])
+        # analytic: d/dw mean((xw+b)^2) = 2*mean(x*(xw+b))
+        w = np.asarray(scope.get("w"))
+        b = np.asarray(scope.get("b"))
+        pred_np = xs @ w + b
+        expect_gw = 2 * xs.T @ pred_np / 8
+        expect_gb = 2 * pred_np.mean(0)
+        np.testing.assert_allclose(gw, expect_gw, rtol=1e-4)
+        np.testing.assert_allclose(gb, expect_gb, rtol=1e-4)
