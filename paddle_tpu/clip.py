@@ -91,7 +91,6 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         block = grad.block
         scale_name = self.group_name + "@CLIP_SCALE"
         if not block.has_var(scale_name):
-            from .layers import ops as lops, tensor as ltensor, nn as lnn
             sums = []
             for _, g in group:
                 sq = block.create_var(dtype=g.dtype, shape=(1,))

@@ -78,6 +78,23 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         if not produces:
             continue
 
+        # error clipping (parity: reference backward.py error_clip_callback):
+        # by this point every consumer's grad op has contributed to the
+        # out-grads, so clipping here clips the fully-accumulated gradient.
+        for slot, names in op.outputs.items():
+            for n, g in zip(names, out_grads[slot]):
+                if not g:
+                    continue
+                v = block.vars.get(n)
+                if v is not None and v.error_clip is not None:
+                    block.append_op(
+                        type="clip",
+                        inputs={"X": [g]},
+                        outputs={"Out": [g]},
+                        attrs={"min": v.error_clip.min,
+                               "max": v.error_clip.max},
+                        infer_shape=False)
+
         grad_in_names = []   # read by the grad op (for dependency analysis)
         grad_out = {}        # slot -> grad var names written
         for slot, names in op.inputs.items():

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from . import lowering
 from .framework import default_main_program, convert_dtype
+from .utils import find_var as _find_feed_var
 
 
 class Scope(object):
@@ -128,7 +129,7 @@ class Executor(object):
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             state_rw, state_ro, state_out = lowering.analyze_state(
-                program, feed_names, scope.names())
+                program, feed_names)
             fn = lowering.build_program_fn(
                 program, feed_names, fetch_names, state_rw, state_ro,
                 state_out)
@@ -150,9 +151,10 @@ class Executor(object):
             return vals
 
         seed = np.uint32(scope.next_seed())
-        fetches, new_state = jitted(
-            [feed_arrays[n] for n in feed_names],
-            read_state(state_rw), read_state(state_ro), seed)
+        with jax.default_device(self.place.device()):
+            fetches, new_state = jitted(
+                [feed_arrays[n] for n in feed_names],
+                read_state(state_rw), read_state(state_ro), seed)
         for n, v in zip(state_out, new_state):
             scope.set(n, v)
         if return_numpy:
@@ -160,11 +162,6 @@ class Executor(object):
         return fetches
 
 
-def _find_feed_var(program, name):
-    for block in program.blocks:
-        if name in block.vars:
-            return block.vars[name]
-    return None
 
 
 def _to_array(value, var=None):

@@ -71,6 +71,7 @@ class Variable(object):
         self.stop_gradient = stop_gradient
         self.is_data = is_data
         self.initializer = initializer
+        self.error_clip = None  # BaseErrorClipAttr; applied by append_backward
         # type: None (dense tensor) | 'tensor_array' | 'rank_table'
         self.type = type
         self.capacity = capacity

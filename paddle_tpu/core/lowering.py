@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from . import registry
 from .framework import GRAD_SUFFIX
+from .utils import find_var as _find_var
 
 # Lowering rules for ops that need access to the full env / program structure
 # (control flow with sub-blocks, tensor arrays). Signature:
@@ -230,8 +231,8 @@ def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
     return fn
 
 
-def analyze_state(program, feed_names, scope_names):
-    """Decide which persistable vars are program state.
+def analyze_state(program, feed_names):
+    """Decide which persistable vars are program state (static analysis).
 
     Returns (state_rw, state_ro, state_out):
       state_rw — read from Scope AND overwritten (donate: in-place update)
@@ -277,8 +278,3 @@ def _all_ops(program):
             yield op
 
 
-def _find_var(program, name):
-    for block in program.blocks:
-        if name in block.vars:
-            return block.vars[name]
-    return None

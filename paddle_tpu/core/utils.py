@@ -1,0 +1,16 @@
+"""Small shared helpers (single home for cross-module utilities)."""
+
+
+def pair(v):
+    """Normalize an int-or-2-seq into a (h, w) tuple."""
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+def find_var(program, name):
+    """Look a var up across all blocks of a program (None if absent)."""
+    for block in program.blocks:
+        if name in block.vars:
+            return block.vars[name]
+    return None

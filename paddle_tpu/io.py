@@ -55,7 +55,8 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         safe = v.name.replace("/", "__")
         np.save(os.path.join(dirname, safe + ".npy"), arr)
         manifest[v.name] = {"file": safe + ".npy", "shape": list(arr.shape),
-                            "dtype": str(arr.dtype)}
+                            "dtype": str(arr.dtype),
+                            "is_param": is_parameter(v)}
     with open(os.path.join(dirname, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)
 
@@ -70,7 +71,7 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
+              predicate=None, filename=None, params_only=False):
     with open(os.path.join(dirname, "manifest.json")) as f:
         manifest = json.load(f)
     scope = global_scope()
@@ -81,12 +82,15 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     for name, meta in manifest.items():
         if want is not None and name not in want:
             continue
+        if params_only and want is None and not meta.get("is_param", True):
+            continue  # no program to filter by: fall back to manifest kinds
         arr = np.load(os.path.join(dirname, meta["file"]))
         scope.set(name, arr)
 
 
 def load_params(executor, dirname, main_program=None, filename=None):
-    load_vars(executor, dirname, main_program, None, is_parameter, filename)
+    load_vars(executor, dirname, main_program, None, is_parameter, filename,
+              params_only=True)
 
 
 def load_persistables(executor, dirname, main_program=None, filename=None):

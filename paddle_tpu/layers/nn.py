@@ -11,6 +11,7 @@ from ..core.layer_helper import LayerHelper
 from ..core.initializer import ConstantInitializer, NormalInitializer
 from ..core.param_attr import ParamAttr
 from ..core import unique_name
+from ..core.utils import pair as _pair
 
 __all__ = [
     "fc", "embedding", "conv2d", "conv2d_transpose", "pool2d", "batch_norm",
@@ -211,8 +212,11 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
         dtype=dtype, stop_gradient=True)
     saved_variance = helper.create_variable_for_type_inference(
         dtype=dtype, stop_gradient=True)
-    batch_norm_out = input if in_place else \
-        helper.create_variable_for_type_inference(dtype)
+    # in_place is accepted for API parity but always materializes a fresh
+    # var: aliasing Y onto X would make the vjp backward replay read the
+    # normalized output as its input (XLA buffer reuse makes the "in place"
+    # memory saving moot anyway).
+    batch_norm_out = helper.create_variable_for_type_inference(dtype)
 
     helper.append_op(
         type="batch_norm",
@@ -558,7 +562,3 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     return counter
 
 
-def _pair(v):
-    if isinstance(v, (list, tuple)):
-        return tuple(int(x) for x in v)
-    return (int(v), int(v))

@@ -70,7 +70,7 @@ def generate_layer_fn(op_type):
         act = kwargs.pop("act", None)
         inputs = {}
         pos = list(args)
-        dtype = kwargs.pop("dtype", None)
+        dtype = kwargs.get("dtype")  # stays in kwargs → reaches op attrs too
         for slot, kw, required in in_slots:
             v = kwargs.pop(kw, None)
             if v is None and pos:

@@ -18,16 +18,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register, single
+from ..core.utils import pair as _pair
 
 
 def _out(x):
     return {"Out": [x]}
-
-
-def _pair(v):
-    if isinstance(v, (list, tuple)):
-        return tuple(int(x) for x in v)
-    return (int(v), int(v))
 
 
 # ---------------------------------------------------------------------------

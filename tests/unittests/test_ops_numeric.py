@@ -1,0 +1,248 @@
+"""Per-op numeric tests vs numpy references (SURVEY.md §4).
+
+Parity: the reference's test_*_op.py files, collapsed into table-driven
+checks through the real executor path.
+"""
+import numpy as np
+import pytest
+
+from op_test import check_forward, check_grad_fd, run_op
+
+rng = np.random.RandomState(1234)
+
+
+def _x(*shape):
+    return rng.randn(*shape).astype("float32")
+
+
+ACT_CASES = [
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("relu", lambda x: np.maximum(x, 0)),
+    ("tanh", np.tanh),
+    ("exp", np.exp),
+    ("sqrt", np.sqrt),
+    ("square", np.square),
+    ("abs", np.abs),
+    ("log", np.log),
+    ("softsign", lambda x: x / (1 + np.abs(x))),
+    ("reciprocal", lambda x: 1.0 / x),
+]
+
+
+@pytest.mark.parametrize("op,ref", ACT_CASES, ids=[c[0] for c in ACT_CASES])
+def test_activation_forward(op, ref):
+    x = _x(3, 7)
+    if op in ("sqrt", "log"):
+        x = np.abs(x) + 1.0
+    if op == "reciprocal":
+        x = x + 3.0 * np.sign(x)  # keep away from 0
+    check_forward(op, {"X": x}, ref(x), rtol=1e-4)
+
+
+def test_elementwise_broadcast_axis():
+    x = _x(2, 3, 4, 5)
+    y = _x(3, 4)
+    got = run_op("elementwise_add", {"X": x, "Y": y}, {"axis": 1})[0]
+    np.testing.assert_allclose(got, x + y.reshape(1, 3, 4, 1), rtol=1e-6)
+
+
+def test_elementwise_trailing_broadcast():
+    x = _x(2, 3, 4)
+    y = _x(4)
+    got = run_op("elementwise_mul", {"X": x, "Y": y}, {"axis": -1})[0]
+    np.testing.assert_allclose(got, x * y, rtol=1e-6)
+
+
+def test_mul_num_col_dims():
+    x = _x(2, 3, 4)
+    y = _x(12, 5)
+    got = run_op("mul", {"X": x, "Y": y},
+                 {"x_num_col_dims": 1, "y_num_col_dims": 1})[0]
+    np.testing.assert_allclose(got, (x.reshape(2, 12) @ y).reshape(2, 5),
+                               rtol=1e-4)
+
+
+def test_matmul_transpose():
+    x, y = _x(4, 6), _x(8, 6)
+    got = run_op("matmul", {"X": x, "Y": y}, {"transpose_Y": True})[0]
+    np.testing.assert_allclose(got, x @ y.T, rtol=1e-4)
+
+
+def test_softmax_forward():
+    x = _x(5, 9)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    check_forward("softmax", {"X": x}, e / e.sum(-1, keepdims=True), rtol=1e-4)
+
+
+def test_softmax_with_cross_entropy():
+    logits = _x(6, 10)
+    labels = rng.randint(0, 10, (6, 1)).astype("int64")
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = -np.log(p[np.arange(6), labels[:, 0]]).reshape(6, 1)
+    got = run_op("softmax_with_cross_entropy",
+                 {"Logits": logits, "Label": labels},
+                 out_slots=("Loss",))[0]
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+
+def test_cross_entropy_soft_label():
+    p = np.abs(_x(4, 5)) + 0.1
+    p = p / p.sum(-1, keepdims=True)
+    soft = np.abs(_x(4, 5))
+    soft = soft / soft.sum(-1, keepdims=True)
+    expect = -(soft * np.log(p)).sum(-1, keepdims=True)
+    got = run_op("cross_entropy", {"X": p.astype("float32"),
+                                   "Label": soft.astype("float32")},
+                 {"soft_label": True}, out_slots=("Y",))[0]
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+
+def test_pool2d_max_and_avg():
+    x = _x(2, 3, 8, 8)
+    got_max = run_op("pool2d", {"X": x},
+                     {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]})[0]
+    expect = x.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(got_max, expect, rtol=1e-6)
+    got_avg = run_op("pool2d", {"X": x},
+                     {"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]})[0]
+    expect = x.reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(got_avg, expect, rtol=1e-5)
+
+
+def test_conv2d_identity_kernel():
+    x = _x(1, 1, 5, 5)
+    w = np.zeros((1, 1, 3, 3), dtype="float32")
+    w[0, 0, 1, 1] = 1.0  # identity 3x3 kernel
+    got = run_op("conv2d", {"Input": x, "Filter": w},
+                 {"strides": [1, 1], "paddings": [1, 1],
+                  "dilations": [1, 1], "groups": 1},
+                 out_slots=("Output",))[0]
+    np.testing.assert_allclose(got, x, rtol=1e-5)
+
+
+def test_conv2d_vs_scipy_style():
+    x = _x(2, 3, 6, 6)
+    w = _x(4, 3, 3, 3)
+    got = run_op("conv2d", {"Input": x, "Filter": w},
+                 {"strides": [1, 1], "paddings": [0, 0],
+                  "dilations": [1, 1], "groups": 1},
+                 out_slots=("Output",))[0]
+    # direct loop reference
+    expect = np.zeros((2, 4, 4, 4), dtype="float64")
+    for n in range(2):
+        for o in range(4):
+            for i in range(4):
+                for j in range(4):
+                    expect[n, o, i, j] = np.sum(
+                        x[n, :, i:i + 3, j:j + 3] * w[o])
+    np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_lookup_table():
+    w = _x(10, 4)
+    ids = rng.randint(0, 10, (6, 1)).astype("int64")
+    got = run_op("lookup_table", {"W": w, "Ids": ids}, {"padding_idx": -1})[0]
+    np.testing.assert_allclose(got, w[ids[:, 0]], rtol=1e-6)
+
+
+def test_reduce_ops():
+    x = _x(3, 4, 5)
+    check_forward("reduce_sum", {"X": x}, x.sum(1), {"dim": 1}, rtol=1e-4)
+    check_forward("reduce_mean", {"X": x}, x.mean(), {"reduce_all": True},
+                  rtol=1e-4)
+    check_forward("reduce_max", {"X": x}, x.max(2), {"dim": 2}, rtol=1e-6)
+
+
+def test_concat_split_reshape_transpose():
+    a, b = _x(2, 3), _x(2, 5)
+    got = run_op("concat", {"X": [a, b]}, {"axis": 1})[0]
+    np.testing.assert_allclose(got, np.concatenate([a, b], 1))
+    x = _x(4, 6)
+    got = run_op("transpose", {"X": x}, {"axis": [1, 0]})[0]
+    np.testing.assert_allclose(got, x.T)
+    got = run_op("reshape", {"X": x}, {"shape": [2, 12]})[0]
+    np.testing.assert_allclose(got, x.reshape(2, 12))
+
+
+def test_topk_and_one_hot():
+    x = _x(3, 8)
+    vals, idx = run_op("topk", {"X": x}, {"k": 2},
+                       out_slots=("Out", "Indices"))
+    expect_idx = np.argsort(-x, axis=1)[:, :2]
+    np.testing.assert_allclose(np.sort(vals), np.sort(
+        np.take_along_axis(x, expect_idx, 1)), rtol=1e-6)
+    ids = rng.randint(0, 5, (4, 1)).astype("int64")
+    got = run_op("one_hot", {"X": ids}, {"depth": 5})[0]
+    np.testing.assert_allclose(got, np.eye(5)[ids[:, 0]])
+
+
+def test_layer_norm_forward():
+    x = _x(4, 10)
+    scale = np.abs(_x(10)) + 0.5
+    bias = _x(10)
+    mean = x.mean(1, keepdims=True)
+    var = x.var(1)
+    expect = (x - mean) / np.sqrt(var[:, None] + 1e-5) * scale + bias
+    got = run_op("layer_norm", {"X": x, "Scale": scale, "Bias": bias},
+                 {"epsilon": 1e-5, "begin_norm_axis": 1},
+                 out_slots=("Y",))[0]
+    np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+
+# ---- gradient checks (finite differences through the executor) ----------
+
+def test_grad_mul():
+    check_grad_fd("mul", {"X": _x(3, 4), "Y": _x(4, 5)}, "X",
+                  {"x_num_col_dims": 1, "y_num_col_dims": 1})
+
+
+def test_grad_softmax():
+    check_grad_fd("softmax", {"X": _x(3, 5)}, "X")
+
+
+def test_grad_tanh():
+    check_grad_fd("tanh", {"X": _x(4, 4)}, "X")
+
+
+def test_grad_elementwise_broadcast():
+    # grad wrt the broadcast side must sum over broadcast dims
+    check_grad_fd("elementwise_add", {"X": _x(4, 3), "Y": _x(3)}, "Y",
+                  {"axis": -1})
+
+
+def test_grad_conv2d():
+    check_grad_fd("conv2d",
+                  {"Input": _x(1, 2, 4, 4), "Filter": _x(2, 2, 3, 3)},
+                  "Filter",
+                  {"strides": [1, 1], "paddings": [1, 1],
+                   "dilations": [1, 1], "groups": 1},
+                  out_slots=("Output",))
+
+
+def test_grad_pool_avg():
+    check_grad_fd("pool2d", {"X": _x(1, 1, 4, 4)}, "X",
+                  {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+                   "paddings": [0, 0]})
+
+
+def test_grad_layer_norm():
+    check_grad_fd("layer_norm",
+                  {"X": _x(3, 6), "Scale": np.ones(6, "float32"),
+                   "Bias": np.zeros(6, "float32")}, "X",
+                  {"epsilon": 1e-5, "begin_norm_axis": 1},
+                  out_slots=("Y",))
+
+
+def test_grad_lookup_table():
+    w = _x(7, 3)
+    ids = rng.randint(0, 7, (5, 1)).astype("int64")
+    got = run_op("lookup_table", {"W": w, "Ids": ids}, {"padding_idx": -1},
+                 fetch_grads=("W",))
+    grad_w = got[-1]
+    expect = np.zeros_like(w)
+    for i in ids[:, 0]:
+        expect[i] += 1.0
+    np.testing.assert_allclose(grad_w, expect, rtol=1e-5)
