@@ -1,0 +1,71 @@
+"""Headline benchmark: ResNet-50 ImageNet training throughput on TPU.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": "images/sec/chip",
+"vs_baseline": N}. Baseline = 300 images/sec/chip (Paddle Fluid on V100,
+fp32, the era's published ResNet-50 number — BASELINE.json north star says
+"≥ Paddle's own V100 images/sec/chip").
+
+Runs on whatever accelerator jax exposes (the axon TPU v5e chip in this
+image); synthetic data, full training step (fwd + bwd + momentum update).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models.image_classification import build_train
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main_prog, startup):
+        image, label, avg_cost, acc = build_train(
+            model="resnet50", class_dim=1000, image_shape=(3, 224, 224),
+            learning_rate=0.1, momentum=0.9)
+
+    place = fluid.TPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    # one-time host→device transfer; the timed loop feeds device-resident
+    # arrays (a real input pipeline would double-buffer the same way)
+    import jax.numpy as jnp
+    xs = jnp.asarray(rng.rand(batch, 3, 224, 224).astype("float32"))
+    ys = jnp.asarray(rng.randint(0, 1000, (batch, 1)).astype("int32"))
+    jax.block_until_ready((xs, ys))
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(warmup):
+            loss, = exe.run(main_prog, feed={"image": xs, "label": ys},
+                            fetch_list=[avg_cost])
+        assert np.isfinite(loss).all(), "non-finite loss in warmup"
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = exe.run(main_prog, feed={"image": xs, "label": ys},
+                          fetch_list=[avg_cost], return_numpy=False)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_imagenet_train_throughput",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / 300.0, 3),
+        "batch": batch,
+        "device": str(jax.devices()[0]),
+        "loss": float(np.asarray(loss).reshape(-1)[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

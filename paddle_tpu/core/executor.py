@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from . import lowering
 from .framework import default_main_program, convert_dtype
+from .lod import LoDTensor
 from .utils import find_var as _find_feed_var
 
 
@@ -120,6 +121,15 @@ class Executor(object):
         feed_arrays = {}
         for name, value in feed.items():
             var = _find_feed_var(program, name)
+            if isinstance(value, LoDTensor):
+                # sequence feed: expand to padded dense + lengths companion
+                padded, lengths = value.to_padded()
+                if var is not None and var.dtype is not None:
+                    padded = padded.astype(convert_dtype(var.dtype),
+                                           copy=False)
+                feed_arrays[name] = jnp.asarray(padded)
+                feed_arrays[name + "@SEQLEN"] = jnp.asarray(lengths)
+                continue
             arr = _to_array(value, var)
             feed_arrays[name] = arr
 
@@ -165,11 +175,8 @@ class Executor(object):
 
 
 def _to_array(value, var=None):
-    from .lod import LoDTensor
-    if isinstance(value, LoDTensor):
-        raise NotImplementedError(
-            "LoDTensor feeds land with the sequence milestone (SURVEY.md §7 "
-            "M6); feed the padded dense array for now")
+    if isinstance(value, jax.Array):
+        return value  # already device-resident: never round-trip via host
     arr = np.asarray(value)
     if var is not None and var.dtype is not None:
         arr = arr.astype(convert_dtype(var.dtype), copy=False)

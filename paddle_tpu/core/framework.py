@@ -72,6 +72,10 @@ class Variable(object):
         self.is_data = is_data
         self.initializer = initializer
         self.error_clip = None  # BaseErrorClipAttr; applied by append_backward
+        # name of the int32 [num_seqs] companion tensor holding true sequence
+        # lengths; set for lod_level>0 vars (SURVEY.md §6.3: LoD → dense
+        # padded + lengths-as-device-tensor)
+        self.seq_len_var = None
         # type: None (dense tensor) | 'tensor_array' | 'rank_table'
         self.type = type
         self.capacity = capacity
@@ -244,14 +248,36 @@ class Block(object):
     def all_parameters(self):
         return [v for v in self.vars.values() if isinstance(v, Parameter)]
 
+    # ops whose outputs are per-sequence (not per-timestep): do not inherit lod
+    _LOD_CLEARING_OPS = frozenset([
+        "sequence_pool", "sequence_last_step", "sequence_first_step",
+        "reduce_sum", "reduce_mean", "mean", "cross_entropy", "topk",
+        "accuracy", "lod_tensor_to_array",
+    ])
+
     def append_op(self, type, inputs=None, outputs=None, attrs=None,
                   infer_shape=True):
         op = Operator(self, type, inputs, outputs, attrs)
         self.ops.append(op)
+        out_vars = []
         for vs in (outputs or {}).values():
             for v in _as_list(vs):
                 if isinstance(v, Variable):
                     v.op = op
+                    out_vars.append(v)
+        # propagate sequence structure: timestep-preserving ops hand their
+        # first sequence-input's lod/lengths to outputs (reference: runtime
+        # LoD copy in op kernels; here it's static graph metadata)
+        if type not in Block._LOD_CLEARING_OPS:
+            for vs in (inputs or {}).values():
+                src = next((v for v in _as_list(vs) if isinstance(v, Variable)
+                            and v.lod_level > 0), None)
+                if src is not None:
+                    for ov in out_vars:
+                        if ov.lod_level == 0:
+                            ov.lod_level = src.lod_level
+                            ov.seq_len_var = src.seq_len_var
+                    break
         self.program._bump_version()
         if infer_shape:
             from . import registry

@@ -15,7 +15,18 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
         if all(s >= 0 for s in shape):
             shape = [-1] + shape
         # if user already put a -1 in shape, don't prepend another batch dim
-    main = default_main_program().global_block().create_var(
+    block = default_main_program().global_block()
+    if lod_level > 0:
+        # padded-dense sequence layout: [num_seqs, max_len, *feature] plus an
+        # int32 lengths companion (SURVEY.md §6.3). The reference feeds a flat
+        # [total_tokens, *feature] LoDTensor; the Executor converts.
+        shape = [shape[0], -1] + shape[1:]
+        seq_len = block.create_var(
+            name=name + "@SEQLEN", shape=[-1], dtype="int32",
+            stop_gradient=True, is_data=True)
+    main = block.create_var(
         name=name, shape=shape, dtype=dtype, lod_level=lod_level,
         stop_gradient=stop_gradient, is_data=True)
+    if lod_level > 0:
+        main.seq_len_var = name + "@SEQLEN"
     return main

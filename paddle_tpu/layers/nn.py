@@ -36,8 +36,13 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     mul_results = []
     for input_var, param_attr in helper.iter_inputs_and_params():
         input_shape = input_var.shape
+        flatten = num_flatten_dims
+        if input_var.lod_level > 0 and num_flatten_dims == 1:
+            # sequence input in padded [B, T, D] layout: the reference's flat
+            # [total_tokens, D] fc is a per-timestep projection here
+            flatten = len(input_shape) - 1
         param_shape = [
-            int(np.prod(input_shape[num_flatten_dims:]))
+            int(np.prod(input_shape[flatten:]))
         ] + [size]
         w = helper.create_parameter(
             attr=param_attr, shape=param_shape, dtype=dtype, is_bias=False)
@@ -46,7 +51,7 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
             type="mul",
             inputs={"X": [input_var], "Y": [w]},
             outputs={"Out": [tmp]},
-            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1})
+            attrs={"x_num_col_dims": flatten, "y_num_col_dims": 1})
         mul_results.append(tmp)
 
     if len(mul_results) == 1:
@@ -55,7 +60,7 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         pre_bias = helper.create_variable_for_type_inference(dtype)
         helper.append_op(type="sum", inputs={"X": mul_results},
                          outputs={"Out": [pre_bias]})
-    pre_activation = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    pre_activation = helper.append_bias_op(pre_bias, dim_start=flatten)
     return helper.append_activation(pre_activation)
 
 

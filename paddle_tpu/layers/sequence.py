@@ -1,0 +1,236 @@
+"""Sequence layers (LoD-aware).
+
+Parity: the sequence_* / dynamic_* functions of python/paddle/fluid/layers/nn.py.
+"""
+from ..core.framework import Variable
+from ..core.layer_helper import LayerHelper
+from ..core.param_attr import ParamAttr
+
+__all__ = [
+    "sequence_pool", "sequence_first_step", "sequence_last_step",
+    "sequence_softmax", "sequence_conv", "sequence_expand", "sequence_reshape",
+    "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit", "lstm_unit",
+    "lod_reset", "row_conv",
+]
+
+
+def _seq_len(helper, x):
+    if x.seq_len_var is None:
+        raise ValueError(
+            "%r is not a sequence (lod_level=0); sequence layers need an "
+            "input produced from a lod_level>0 data layer" % x.name)
+    return helper.block.var_recursive(x.seq_len_var)
+
+
+def sequence_pool(input, pool_type, is_test=False):
+    helper = LayerHelper("sequence_pool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_pool",
+        inputs={"X": [input], "XLen": [_seq_len(helper, input)]},
+        outputs={"Out": [out]},
+        attrs={"pooltype": pool_type.upper()})
+    out.lod_level = 0
+    out.seq_len_var = None
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_softmax",
+        inputs={"X": [input], "XLen": [_seq_len(helper, input)]},
+        outputs={"Out": [out]})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None):
+    helper = LayerHelper("sequence_conv", **locals())
+    dtype = helper.input_dtype()
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    filter_param = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="sequence_conv",
+        inputs={"X": [input], "Filter": [filter_param],
+                "XLen": [_seq_len(helper, input)]},
+        outputs={"Out": [pre_bias]},
+        attrs={"contextStride": filter_stride,
+               "contextStart": -int(filter_size // 2),
+               "contextLength": filter_size})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=2)
+    return helper.append_activation(pre_act)
+
+
+def sequence_expand(x, y, name=None):
+    helper = LayerHelper("sequence_expand", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_expand",
+        inputs={"X": [x], "Y": [y], "YLen": [_seq_len(helper, y)]},
+        outputs={"Out": [out]})
+    out.lod_level = max(y.lod_level, 1)
+    out.seq_len_var = y.seq_len_var
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="reshape",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": [0, -1, new_dim]})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    helper = LayerHelper("lod_reset", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="lod_reset", inputs={"X": [x]},
+                    outputs={"Out": [out]})
+    if y is not None:
+        out.lod_level = y.lod_level
+        out.seq_len_var = y.seq_len_var
+    return out
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """Parity: fluid.layers.dynamic_lstm — input must be [.., 4*hidden]
+    (pre-projected by an fc), size = 4*hidden."""
+    helper = LayerHelper("dynamic_lstm", **locals())
+    hidden = size // 4
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[hidden, 4 * hidden], dtype=dtype)
+    bias_size = [1, 7 * hidden if use_peepholes else 4 * hidden]
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=bias_size, dtype=dtype, is_bias=True)
+    hidden_out = helper.create_variable_for_type_inference(dtype)
+    cell_out = helper.create_variable_for_type_inference(dtype)
+    batch_gate = helper.create_variable_for_type_inference(dtype)
+    batch_cell_pre_act = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias],
+              "XLen": [_seq_len(helper, input)]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    helper.append_op(
+        type="lstm", inputs=inputs,
+        outputs={"Hidden": [hidden_out], "Cell": [cell_out],
+                 "BatchGate": [batch_gate],
+                 "BatchCellPreAct": [batch_cell_pre_act]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation})
+    return hidden_out, cell_out
+
+
+def dynamic_lstmp(input, size, proj_size, **kwargs):
+    """Projected LSTM — lowered as LSTM + projection fc (reference lstmp_op)."""
+    from . import nn
+    hidden, cell = dynamic_lstm(input, size, **kwargs)
+    proj = nn.fc(input=hidden, size=proj_size, bias_attr=False)
+    return proj, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None):
+    """Parity: fluid.layers.dynamic_gru — input [.., 3*size]."""
+    helper = LayerHelper("dynamic_gru", **locals())
+    dtype = helper.input_dtype()
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, 3 * size], dtype=dtype)
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[1, 3 * size], dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    batch_gate = helper.create_variable_for_type_inference(dtype)
+    batch_reset = helper.create_variable_for_type_inference(dtype)
+    batch_hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias],
+              "XLen": [_seq_len(helper, input)]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    helper.append_op(
+        type="gru", inputs=inputs,
+        outputs={"Hidden": [hidden], "BatchGate": [batch_gate],
+                 "BatchResetHiddenPrev": [batch_reset],
+                 "BatchHidden": [batch_hidden]},
+        attrs={"is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "activation": candidate_activation})
+    return hidden
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    """Parity: fluid.layers.gru_unit (one step; used in DynamicRNN decoders)."""
+    helper = LayerHelper("gru_unit", **locals())
+    dtype = helper.input_dtype()
+    size = size // 3
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, 3 * size], dtype=dtype)
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[1, 3 * size], dtype=dtype, is_bias=True)
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_hidden_pre = helper.create_variable_for_type_inference(dtype)
+    updated_hidden = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="gru_unit",
+        inputs={"Input": [input], "HiddenPrev": [hidden],
+                "Weight": [weight], "Bias": [bias]},
+        outputs={"Hidden": [updated_hidden], "Gate": [gate],
+                 "ResetHiddenPrev": [reset_hidden_pre]},
+        attrs={"activation": activation, "gate_activation": gate_activation})
+    return updated_hidden, reset_hidden_pre, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Parity: fluid.layers.lstm_unit — fc(x_t ++ h_prev) then lstm_unit op."""
+    from . import nn, tensor
+    size = cell_t_prev.shape[-1]
+    concat_out = tensor.concat(input=[x_t, hidden_t_prev], axis=-1)
+    fc_out = nn.fc(input=concat_out, size=4 * size, param_attr=param_attr,
+                   bias_attr=bias_attr)
+    helper = LayerHelper("lstm_unit", **locals())
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [fc_out], "C_prev": [cell_t_prev]},
+        outputs={"C": [c], "H": [h]},
+        attrs={"forget_bias": forget_bias})
+    return h, c
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", **locals())
+    dtype = helper.input_dtype()
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    filter_param = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="row_conv",
+        inputs={"X": [input], "Filter": [filter_param],
+                "XLen": [_seq_len(helper, input)]},
+        outputs={"Out": [out]})
+    return helper.append_activation(out)

@@ -1,0 +1,134 @@
+"""Image classification models: ResNet, VGG, AlexNet-ish.
+
+Parity: benchmark/paddle/image/{resnet.py,vgg.py,alexnet.py} and the fluid
+book chapter 03 (image_classification). ResNet-50 is the flagship/benchmark
+model (BASELINE.json north star).
+"""
+import paddle_tpu as fluid
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
+                  act=None, is_test=False):
+    conv = fluid.layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=stride, padding=(filter_size - 1) // 2, groups=groups,
+        act=None, bias_attr=False)
+    return fluid.layers.batch_norm(input=conv, act=act, is_test=is_test)
+
+
+def shortcut(input, ch_out, stride, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, is_test=is_test)
+    return input
+
+
+def bottleneck_block(input, num_filters, stride, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu", is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride, act="relu",
+                          is_test=is_test)
+    conv2 = conv_bn_layer(conv1, num_filters * 4, 1, act=None,
+                          is_test=is_test)
+    short = shortcut(input, num_filters * 4, stride, is_test=is_test)
+    return fluid.layers.elementwise_add(x=short, y=conv2, act="relu")
+
+
+def basic_block(input, num_filters, stride, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 3, stride=stride, act="relu",
+                          is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, act=None, is_test=is_test)
+    short = shortcut(input, num_filters, stride, is_test=is_test)
+    return fluid.layers.elementwise_add(x=short, y=conv1, act="relu")
+
+
+RESNET_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+    """ResNet for 224x224 ImageNet (reference: benchmark resnet.py layers=50)."""
+    kind, counts = RESNET_CFG[depth]
+    block_fn = bottleneck_block if kind == "bottleneck" else basic_block
+    conv = conv_bn_layer(input, 64, 7, stride=2, act="relu", is_test=is_test)
+    pool = fluid.layers.pool2d(input=conv, pool_size=3, pool_stride=2,
+                               pool_padding=1, pool_type="max")
+    filters = [64, 128, 256, 512]
+    for stage, n in enumerate(counts):
+        for i in range(n):
+            stride = 2 if i == 0 and stage > 0 else 1
+            pool = block_fn(pool, filters[stage], stride, is_test=is_test)
+    pool = fluid.layers.pool2d(input=pool, pool_type="avg",
+                               global_pooling=True)
+    return fluid.layers.fc(input=pool, size=class_dim, act="softmax")
+
+
+def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
+    """Reference: fluid book ch.03 resnet_cifar10."""
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv = conv_bn_layer(input, 16, 3, act="relu", is_test=is_test)
+    for stage, nf in enumerate([16, 32, 64]):
+        for i in range(n):
+            stride = 2 if i == 0 and stage > 0 else 1
+            conv = basic_block(conv, nf, stride, is_test=is_test)
+    pool = fluid.layers.pool2d(input=conv, pool_type="avg",
+                               global_pooling=True)
+    return fluid.layers.fc(input=pool, size=class_dim, act="softmax")
+
+
+def vgg16(input, class_dim=1000, is_test=False):
+    """Reference: benchmark vgg.py / book ch.03 vgg_bn_drop."""
+    def conv_block(ipt, num_filter, groups):
+        return fluid.nets.img_conv_group(
+            input=ipt, pool_size=2, pool_stride=2,
+            conv_num_filter=[num_filter] * groups, conv_filter_size=3,
+            conv_act="relu", conv_with_batchnorm=True, pool_type="max")
+
+    conv1 = conv_block(input, 64, 2)
+    conv2 = conv_block(conv1, 128, 2)
+    conv3 = conv_block(conv2, 256, 3)
+    conv4 = conv_block(conv3, 512, 3)
+    conv5 = conv_block(conv4, 512, 3)
+    fc1 = fluid.layers.fc(input=conv5, size=4096, act=None)
+    bn = fluid.layers.batch_norm(input=fc1, act="relu", is_test=is_test)
+    drop = fluid.layers.dropout(x=bn, dropout_prob=0.5, is_test=is_test)
+    fc2 = fluid.layers.fc(input=drop, size=4096, act=None)
+    return fluid.layers.fc(input=fc2, size=class_dim, act="softmax")
+
+
+def build_train(model="resnet50", class_dim=1000, image_shape=(3, 224, 224),
+                learning_rate=0.01, momentum=0.9, is_test=False,
+                use_softmax_xent_fusion=True):
+    """Build the full training graph (reference: benchmark/fluid style).
+
+    Returns (image, label, avg_cost, acc_top1).
+    """
+    image = fluid.layers.data(name="image", shape=list(image_shape),
+                              dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    if model.startswith("resnet"):
+        depth = int(model[len("resnet"):] or 50)
+        if image_shape[-1] <= 64:
+            predict = resnet_cifar10(image, class_dim,
+                                     depth if depth in (20, 32, 44, 56) else 32,
+                                     is_test=is_test)
+        else:
+            predict = resnet_imagenet(image, class_dim, depth,
+                                      is_test=is_test)
+    elif model == "vgg16":
+        predict = vgg16(image, class_dim, is_test=is_test)
+    else:
+        raise ValueError("unknown model %r" % model)
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    acc = fluid.layers.accuracy(input=predict, label=label)
+    if not is_test:
+        opt = fluid.optimizer.Momentum(learning_rate=learning_rate,
+                                       momentum=momentum)
+        opt.minimize(avg_cost)
+    return image, label, avg_cost, acc

@@ -1,4 +1,321 @@
-"""Sequence / LoD op lowerings (filled out with the sequence milestone).
+"""Sequence / recurrent op lowerings over the padded-dense layout.
 
-Parity: paddle/fluid/operators/sequence_*.cc, gru_op.cc, lstm_op.cc.
+Parity: paddle/fluid/operators/{sequence_pool_op,sequence_softmax_op,
+sequence_conv_op,sequence_expand_op,sequence_reshape_op,lod_reset_op,
+lstm_op,gru_op,row_conv_op}.{cc,cu,h}.
+
+Layout contract (SURVEY.md §6.3): a lod_level-1 tensor is a padded dense
+array X [num_seqs, max_len, *feature] plus XLen int32 [num_seqs] of true
+lengths. The reference walks host-side LoD offsets per op; here every op is
+a masked/vectorized XLA computation with static shapes. The recurrences
+(dynamic_lstm/dynamic_gru) are lax.scan over time with the gate matmuls
+batched onto the MXU.
 """
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register, single
+
+
+def _mask(xlen, max_len, dtype=jnp.float32):
+    """[B, T] 1/0 validity mask from lengths."""
+    t = jnp.arange(max_len, dtype=jnp.int32)
+    return (t[None, :] < xlen.astype(jnp.int32)[:, None]).astype(dtype)
+
+
+def _feat_mask(x, xlen):
+    """mask broadcastable over x's feature dims."""
+    m = _mask(xlen, x.shape[1], x.dtype)
+    return m.reshape(m.shape + (1,) * (x.ndim - 2))
+
+
+@register("sequence_pool")
+def _sequence_pool(ctx, ins, attrs):
+    x = single(ins, "X")          # [B, T, ...]
+    xlen = single(ins, "XLen")    # [B]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    m = _feat_mask(x, xlen)
+    denom = jnp.maximum(xlen.astype(x.dtype), 1).reshape(
+        (-1,) + (1,) * (x.ndim - 2))
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / denom
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(denom)
+    elif ptype == "MAX":
+        neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(xlen.astype(jnp.int32) - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    # MaxIndex output (reference) only needed for MAX grad — vjp handles it
+    return {"Out": [out]}
+
+
+@register("sequence_last_step")
+def _sequence_last_step(ctx, ins, attrs):
+    return _sequence_pool(ctx, ins, dict(attrs, pooltype="LAST"))
+
+
+@register("sequence_first_step")
+def _sequence_first_step(ctx, ins, attrs):
+    return _sequence_pool(ctx, ins, dict(attrs, pooltype="FIRST"))
+
+
+@register("sequence_softmax")
+def _sequence_softmax(ctx, ins, attrs):
+    x = single(ins, "X")        # [B, T] or [B, T, 1]
+    xlen = single(ins, "XLen")
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    logits = x.reshape(x.shape[0], x.shape[1]) if squeeze else x
+    m = _mask(xlen, logits.shape[1], logits.dtype)
+    neg = jnp.asarray(-1e30, logits.dtype)
+    out = jax.nn.softmax(jnp.where(m > 0, logits, neg), axis=1) * m
+    if squeeze:
+        out = out.reshape(x.shape)
+    return {"Out": [out]}
+
+
+@register("sequence_conv")
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window conv over time (reference: sequence_conv_op).
+
+    Filter [ctx_len * D, F]; context window centered per contextStart.
+    """
+    x = single(ins, "X")         # [B, T, D]
+    w = single(ins, "Filter")    # [ctx_len*D, F]
+    xlen = single(ins, "XLen")
+    ctx_len = attrs.get("contextLength", 3)
+    ctx_start = attrs.get("contextStart", -(ctx_len // 2))
+    b, t, d = x.shape
+    xm = x * _feat_mask(x, xlen)
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(xm, -off, axis=1)
+        if off > 0:    # rolled forward: zero the tail
+            valid = jnp.arange(t) < (t - off)
+        elif off < 0:  # rolled backward: zero the head
+            valid = jnp.arange(t) >= (-off)
+        else:
+            valid = jnp.ones(t, bool)
+        cols.append(shifted * valid[None, :, None].astype(x.dtype))
+    ctx_mat = jnp.concatenate(cols, axis=-1)        # [B, T, ctx_len*D]
+    out = jnp.einsum("btc,cf->btf", ctx_mat, w)
+    out = out * _feat_mask(out, xlen)
+    return {"Out": [out]}
+
+
+@register("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    """Expand each row of X to match Y's sequence lengths.
+
+    Padded-layout semantics: X [B, 1-or-T, ...] or [B, ...]; output repeats
+    X's per-sequence row across Y's max_len timesteps (masked).
+    """
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    ylen = single(ins, "YLen")
+    t = y.shape[1]
+    if x.ndim == y.ndim:          # padded [B, Tx, ...]: row 0 is the entry
+        head = x[:, 0]
+    else:                          # [B, ...] per-sequence row
+        head = x
+    rep = jnp.broadcast_to(head[:, None], (x.shape[0], t) + head.shape[1:])
+    return {"Out": [rep * _feat_mask(rep, ylen)]}
+
+
+@register("lod_reset")
+def _lod_reset(ctx, ins, attrs):
+    x = single(ins, "X")
+    return {"Out": [x]}
+
+
+@register("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (reference: row_conv_op, DeepSpeech2)."""
+    x = single(ins, "X")        # [B, T, D]
+    w = single(ins, "Filter")   # [future_ctx, D]
+    xlen = single(ins, "XLen")
+    fut = w.shape[0]
+    xm = x * _feat_mask(x, xlen)
+    out = jnp.zeros_like(x)
+    t = x.shape[1]
+    for k in range(fut):
+        shifted = jnp.roll(xm, -k, axis=1)
+        valid = (jnp.arange(t) < (t - k)).astype(x.dtype)
+        out = out + shifted * valid[None, :, None] * w[k][None, None, :]
+    return {"Out": [out * _feat_mask(x, xlen)]}
+
+
+# ---------------------------------------------------------------------------
+# recurrences: LSTM / GRU via lax.scan (reference: lstm_op.cc, gru_op.cc —
+# there a C++ loop over LoD-sorted batches calling cuBLAS per step; here one
+# scan whose per-step gate matmul is a single MXU batched matmul)
+# ---------------------------------------------------------------------------
+
+def _lstm_act(name):
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}[name]
+
+
+@register("lstm")
+def _lstm(ctx, ins, attrs):
+    """dynamic_lstm: input [B, T, 4D] (pre-projected by an fc), weight
+    [D, 4D] recurrent, bias [1, 4D] (+[1, 3D] peepholes if use_peepholes).
+
+    Gate order (reference lstm_op): input, forget, cell(candidate), output.
+    """
+    x = single(ins, "Input")       # [B, T, 4D]
+    w = single(ins, "Weight")      # [D, 4D]
+    bias = single(ins, "Bias")     # [1, 4D(+3D)]
+    h0 = single(ins, "H0")
+    c0 = single(ins, "C0")
+    xlen = single(ins, "XLen")
+    d = w.shape[0]
+    b, t, _ = x.shape
+    use_peep = attrs.get("use_peepholes", False)
+    gact = _lstm_act(attrs.get("gate_activation", "sigmoid"))
+    cact = _lstm_act(attrs.get("cell_activation", "tanh"))
+    hact = _lstm_act(attrs.get("candidate_activation", "tanh"))
+    is_rev = attrs.get("is_reverse", False)
+
+    bias = bias.reshape(-1)
+    gate_bias = bias[:4 * d]
+    if use_peep:
+        w_ic, w_fc, w_oc = (bias[4 * d:5 * d], bias[5 * d:6 * d],
+                            bias[6 * d:7 * d])
+    h_prev = h0 if h0 is not None else jnp.zeros((b, d), x.dtype)
+    c_prev = c0 if c0 is not None else jnp.zeros((b, d), x.dtype)
+
+    m = _mask(xlen, t, x.dtype)                     # [B, T]
+    xs = jnp.swapaxes(x, 0, 1)                      # [T, B, 4D]
+    ms = m.T[:, :, None]                            # [T, B, 1]
+    if is_rev:
+        xs = xs[::-1]
+        ms = ms[::-1]
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, mt = inp
+        gates = xt + h_prev @ w + gate_bias         # [B, 4D]
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if use_peep:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i = gact(gi)
+        f = gact(gf)
+        c_new = f * c_prev + i * cact(gc)
+        if use_peep:
+            go = go + c_new * w_oc
+        o = gact(go)
+        h_new = o * hact(c_new)
+        # masked carry: padding steps keep previous state
+        h = mt * h_new + (1 - mt) * h_prev
+        c = mt * c_new + (1 - mt) * c_prev
+        return (h, c), (h, c)
+
+    (hT, cT), (hs, cs) = lax.scan(step, (h_prev, c_prev), (xs, ms))
+    if is_rev:
+        hs, cs = hs[::-1], cs[::-1]
+    hidden = jnp.swapaxes(hs, 0, 1)                 # [B, T, D]
+    cell = jnp.swapaxes(cs, 0, 1)
+    return {"Hidden": [hidden], "Cell": [cell],
+            "BatchGate": [x], "BatchCellPreAct": [cell]}
+
+
+@register("gru")
+def _gru(ctx, ins, attrs):
+    """dynamic_gru: input [B, T, 3D] pre-projected, weight packed
+    [D, 3D] = [update|reset (2D) ; candidate (D)] as in gru_op.cc.
+    """
+    x = single(ins, "Input")     # [B, T, 3D]
+    w = single(ins, "Weight")    # [D, 3D]
+    bias = single(ins, "Bias")   # [1, 3D]
+    h0 = single(ins, "H0")
+    xlen = single(ins, "XLen")
+    d = w.shape[0]
+    b, t, _ = x.shape
+    gact = _lstm_act(attrs.get("gate_activation", "sigmoid"))
+    cact = _lstm_act(attrs.get("activation", "tanh"))
+    is_rev = attrs.get("is_reverse", False)
+
+    w_g = w[:, :2 * d]      # update+reset recurrent weights
+    w_c = w[:, 2 * d:]      # candidate recurrent weights
+    bias = bias.reshape(-1) if bias is not None else jnp.zeros(3 * d, x.dtype)
+    h_prev = h0 if h0 is not None else jnp.zeros((b, d), x.dtype)
+
+    m = _mask(xlen, t, x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = m.T[:, :, None]
+    if is_rev:
+        xs = xs[::-1]
+        ms = ms[::-1]
+
+    def step(h_prev, inp):
+        xt, mt = inp
+        xu = xt[:, :2 * d] + h_prev @ w_g + bias[:2 * d]
+        u, r = jnp.split(gact(xu), 2, axis=-1)
+        c = cact(xt[:, 2 * d:] + (r * h_prev) @ w_c + bias[2 * d:])
+        h_new = u * h_prev + (1 - u) * c
+        h = mt * h_new + (1 - mt) * h_prev
+        return h, h
+
+    hT, hs = lax.scan(step, h_prev, (xs, ms))
+    if is_rev:
+        hs = hs[::-1]
+    hidden = jnp.swapaxes(hs, 0, 1)
+    return {"Hidden": [hidden], "BatchGate": [x],
+            "BatchResetHiddenPrev": [hidden], "BatchHidden": [hidden]}
+
+
+@register("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """Single GRU step (reference: gru_unit_op) — used inside DynamicRNN."""
+    x = single(ins, "Input")        # [B, 3D]
+    h_prev = single(ins, "HiddenPrev")
+    w = single(ins, "Weight")       # [D, 3D]
+    bias = single(ins, "Bias")
+    d = w.shape[0]
+    gact = _lstm_act({1: "sigmoid", 0: "identity", 2: "tanh",
+                      3: "relu"}.get(attrs.get("gate_activation", 1),
+                                     "sigmoid")
+                     if isinstance(attrs.get("gate_activation", 1), int)
+                     else attrs.get("gate_activation", "sigmoid"))
+    cact = _lstm_act({1: "sigmoid", 0: "identity", 2: "tanh",
+                      3: "relu"}.get(attrs.get("activation", 2), "tanh")
+                     if isinstance(attrs.get("activation", 2), int)
+                     else attrs.get("activation", "tanh"))
+    if bias is not None:
+        x = x + bias.reshape(-1)
+    xu = x[:, :2 * d] + h_prev @ w[:, :2 * d]
+    u, r = jnp.split(gact(xu), 2, axis=-1)
+    c = cact(x[:, 2 * d:] + (r * h_prev) @ w[:, 2 * d:])
+    h = u * h_prev + (1 - u) * c
+    return {"Hidden": [h], "Gate": [xu], "ResetHiddenPrev": [r * h_prev]}
+
+
+@register("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """Single LSTM step (reference: lstm_unit_op): X [B, 4D] pre-gates."""
+    x = single(ins, "X")
+    c_prev = single(ins, "C_prev")
+    forget_bias = attrs.get("forget_bias", 0.0)
+    d = x.shape[-1] // 4
+    gi, gf, gc, go = jnp.split(x, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    o = jax.nn.sigmoid(go)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = o * jnp.tanh(c)
+    return {"C": [c], "H": [h]}

@@ -27,15 +27,26 @@ from .mesh import data_parallel_mesh, replicated, batch_sharded, NamedSharding, 
 class ParallelExecutor(object):
     def __init__(self, use_cuda=None, loss_name=None, main_program=None,
                  num_threads=None, allow_op_delay=False, share_vars_from=None,
-                 use_tpu=None, devices=None, mesh=None):
+                 use_tpu=None, devices=None, mesh=None, param_shardings=None,
+                 batch_axis="dp"):
         self._program = main_program if main_program is not None \
             else default_main_program()
         self.mesh = mesh if mesh is not None else data_parallel_mesh(
             devices=devices)
+        # param name -> PartitionSpec for model/tensor parallelism; anything
+        # absent is replicated (pure data parallel, the reference's only mode)
+        self._param_shardings = dict(param_shardings or {})
+        self._batch_axis = batch_axis
         self._cache = {}
         self._scope = global_scope()
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
+
+    def _state_sharding(self, name):
+        spec = self._param_shardings.get(name)
+        if spec is None:
+            return replicated(self.mesh)
+        return NamedSharding(self.mesh, spec)
 
     @property
     def device_count(self):
@@ -68,20 +79,21 @@ class ParallelExecutor(object):
                 state_out, mesh=self.mesh)
             rep = replicated(self.mesh)
             in_shardings = (
-                [batch_sharded(self.mesh, np.asarray(feed_arrays[n]).ndim)
+                [batch_sharded(self.mesh, np.asarray(feed_arrays[n]).ndim,
+                               axis_name=self._batch_axis)
                  for n in feed_names],
-                [rep] * len(state_rw),
-                [rep] * len(state_ro),
+                [self._state_sharding(n) for n in state_rw],
+                [self._state_sharding(n) for n in state_ro],
                 rep,
             )
+            out_shardings = (rep,
+                             [self._state_sharding(n) for n in state_out])
             jitted = jax.jit(fn, in_shardings=in_shardings,
-                             out_shardings=(rep, rep),
+                             out_shardings=out_shardings,
                              donate_argnums=(1,))
             entry = (jitted, state_rw, state_ro, state_out)
             self._cache[key] = entry
         jitted, state_rw, state_ro, state_out = entry
-
-        rep = replicated(self.mesh)
 
         def read_state(names):
             vals = []
@@ -91,14 +103,16 @@ class ParallelExecutor(object):
                     raise RuntimeError(
                         "persistable var %r not initialized; run the startup "
                         "program with Executor first" % n)
-                if not (isinstance(v, jax.Array) and v.sharding == rep):
-                    v = jax.device_put(v, rep)
+                want = self._state_sharding(n)
+                if not (isinstance(v, jax.Array) and v.sharding == want):
+                    v = jax.device_put(v, want)
                 vals.append(v)
             return vals
 
         feed_vals = [jax.device_put(
             feed_arrays[n],
-            batch_sharded(self.mesh, np.asarray(feed_arrays[n]).ndim))
+            batch_sharded(self.mesh, np.asarray(feed_arrays[n]).ndim,
+                          axis_name=self._batch_axis))
             for n in feed_names]
 
         seed = jnp.asarray(np.uint32(scope.next_seed()))

@@ -1,0 +1,49 @@
+"""Book: understand_sentiment (conv + stacked LSTM) convergence smoke.
+
+Parity: python/paddle/fluid/tests/book/test_understand_sentiment.py.
+Synthetic task: positive class iff sequence contains mostly high-id tokens.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+from paddle_tpu.models import understand_sentiment
+
+DICT = 200
+
+
+def synth_batch(rng, n=16):
+    seqs, labels = [], []
+    for _ in range(n):
+        length = rng.randint(3, 12)
+        label = rng.randint(0, 2)
+        if label == 1:
+            toks = rng.randint(DICT // 2, DICT, size=(length, 1))
+        else:
+            toks = rng.randint(0, DICT // 2, size=(length, 1))
+        seqs.append(toks.astype("int64"))
+        labels.append([label])
+    return (LoDTensor.from_sequences(seqs),
+            np.asarray(labels, dtype="int64"))
+
+
+@pytest.mark.parametrize("net", ["conv", "lstm"])
+def test_sentiment_converges(net):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        data, label, avg_cost, acc = understand_sentiment.build(
+            net=net, dict_dim=DICT, learning_rate=0.01)
+
+    rng = np.random.RandomState(5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        accs = []
+        for i in range(40):
+            words, labels = synth_batch(rng)
+            loss, a = exe.run(main, feed={"words": words, "label": labels},
+                              fetch_list=[avg_cost, acc])
+            accs.append(float(a[0]))
+    assert np.mean(accs[-8:]) > 0.75, (net, accs[::8])

@@ -1,0 +1,38 @@
+"""Book: word2vec N-gram LM convergence smoke (imikolov-style synthetic)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import word2vec
+
+DICT = 50
+
+
+def test_word2vec_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words, avg_cost = word2vec.build(dict_size=DICT, embed_size=16,
+                                         hidden_size=64, learning_rate=1.0)
+
+    rng = np.random.RandomState(11)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+
+    def batch(n=64):
+        # deterministic successor language: next = successor of last word
+        ctx = rng.randint(0, DICT, size=(n, 4))
+        nxt = (ctx[:, 3] + 1) % DICT
+        feeds = {name: ctx[:, i:i + 1].astype("int64")
+                 for i, name in enumerate(
+                     ("firstw", "secondw", "thirdw", "forthw"))}
+        feeds["nextw"] = nxt.reshape(-1, 1).astype("int64")
+        return feeds
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for i in range(300):
+            loss, = exe.run(main, feed=batch(), fetch_list=[avg_cost])
+            losses.append(float(loss[0]))
+    assert losses[-1] < losses[0] * 0.8, losses[::75]
+    # shared embedding parameter exists exactly once
+    assert "shared_w" in [p.name for p in main.all_parameters()]

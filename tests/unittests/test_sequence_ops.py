@@ -1,0 +1,161 @@
+"""Sequence/LoD op tests (SURVEY.md §4): padded-layout semantics vs numpy
+references computed from the original variable-length sequences.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor, create_lod_tensor
+
+rng = np.random.RandomState(7)
+
+
+def _run_seq_layer(build_fn, lod_tensor, extra_feed=None, fetch_extra=()):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        out = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": lod_tensor}
+        feed.update(extra_feed or {})
+        res = exe.run(main, feed=feed,
+                      fetch_list=[out] + list(fetch_extra))
+    return res
+
+
+SEQS = [rng.randn(3, 4).astype("float32"),
+        rng.randn(5, 4).astype("float32"),
+        rng.randn(1, 4).astype("float32")]
+LOD_X = LoDTensor.from_sequences(SEQS)
+
+
+@pytest.mark.parametrize("ptype,ref", [
+    ("sum", lambda s: s.sum(0)),
+    ("average", lambda s: s.mean(0)),
+    ("sqrt", lambda s: s.sum(0) / np.sqrt(len(s))),
+    ("max", lambda s: s.max(0)),
+    ("last", lambda s: s[-1]),
+    ("first", lambda s: s[0]),
+])
+def test_sequence_pool(ptype, ref):
+    def build():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                              lod_level=1)
+        return fluid.layers.sequence_pool(input=x, pool_type=ptype)
+    got, = _run_seq_layer(build, LOD_X)
+    expect = np.stack([ref(s) for s in SEQS])
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_softmax():
+    seqs = [rng.randn(3, 1).astype("float32"),
+            rng.randn(6, 1).astype("float32")]
+    lod = LoDTensor.from_sequences(seqs)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                              lod_level=1)
+        return fluid.layers.sequence_softmax(input=x)
+    got, = _run_seq_layer(build, lod)
+    # got is padded [2, T, 1]; per-sequence softmax over true lengths
+    for i, s in enumerate(seqs):
+        e = np.exp(s[:, 0] - s[:, 0].max())
+        np.testing.assert_allclose(got[i, :len(s), 0], e / e.sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(got[i, len(s):], 0.0, atol=1e-6)
+
+
+def test_sequence_expand():
+    x_seqs = [rng.randn(1, 3).astype("float32"),
+              rng.randn(1, 3).astype("float32")]
+    y_seqs = [rng.randn(2, 5).astype("float32"),
+              rng.randn(4, 5).astype("float32")]
+    x_lod = LoDTensor.from_sequences(x_seqs)
+    y_lod = LoDTensor.from_sequences(y_seqs)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              lod_level=1)
+        y = fluid.layers.data(name="y", shape=[5], dtype="float32",
+                              lod_level=1)
+        return fluid.layers.sequence_expand(x=x, y=y)
+    got, = _run_seq_layer(build, x_lod, extra_feed={"y": y_lod})
+    for i, (xs, ys) in enumerate(zip(x_seqs, y_seqs)):
+        for t in range(len(ys)):
+            np.testing.assert_allclose(got[i, t], xs[0], rtol=1e-6)
+
+
+def test_dynamic_lstm_shapes_and_padding_invariance():
+    """Padding must not affect outputs at valid positions."""
+    def make(seqs):
+        lod = LoDTensor.from_sequences(seqs)
+
+        def build():
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32",
+                                  lod_level=1)
+            fc1 = fluid.layers.fc(
+                input=x, size=32, bias_attr=False,
+                param_attr=fluid.ParamAttr(
+                    name="proj_w",
+                    initializer=fluid.initializer.Constant(0.05)))
+            hidden, cell = fluid.layers.dynamic_lstm(
+                input=fc1, size=32, use_peepholes=False,
+                param_attr=fluid.ParamAttr(
+                    name="lstm_w",
+                    initializer=fluid.initializer.Constant(0.1)),
+                bias_attr=fluid.ParamAttr(
+                    name="lstm_b",
+                    initializer=fluid.initializer.Constant(0.0)))
+            return hidden
+        return build, lod
+
+    s1 = rng.randn(4, 8).astype("float32")
+    s2 = rng.randn(2, 8).astype("float32")
+    build, lod = make([s1, s2])
+    got, = _run_seq_layer(build, lod)
+    assert got.shape[0] == 2 and got.shape[2] == 8  # hidden = 32/4
+    # same sequences alone (different padding lengths) give same prefix
+    build1, lod1 = make([s1])
+    alone, = _run_seq_layer(build1, lod1)
+    np.testing.assert_allclose(got[0, :4], alone[0, :4], rtol=1e-4,
+                               atol=1e-5)
+    build2, lod2 = make([s2])
+    alone2, = _run_seq_layer(build2, lod2)
+    np.testing.assert_allclose(got[1, :2], alone2[0, :2], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_dynamic_gru_runs():
+    seqs = [rng.randn(3, 9).astype("float32"),
+            rng.randn(5, 9).astype("float32")]
+    lod = LoDTensor.from_sequences(seqs)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[9], dtype="float32",
+                              lod_level=1)
+        gru = fluid.layers.dynamic_gru(input=x, size=3)
+        return fluid.layers.sequence_last_step(input=gru)
+    got, = _run_seq_layer(build, lod)
+    assert got.shape == (2, 3)
+    assert np.isfinite(got).all()
+
+
+def test_data_feeder_lod():
+    feeder = _make_feeder()
+    rows = [([1, 2, 3], 0), ([4, 5], 1)]
+    feed = feeder.feed(rows)
+    assert isinstance(feed["words"], LoDTensor)
+    np.testing.assert_array_equal(feed["words"].seq_lengths(), [3, 2])
+    assert feed["label"].shape == (2, 1)
+
+
+def _make_feeder():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        return fluid.DataFeeder(feed_list=[words, label],
+                                place=fluid.CPUPlace(), program=main)
