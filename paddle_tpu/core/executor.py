@@ -130,6 +130,19 @@ class Executor(object):
                 feed_arrays[name] = jnp.asarray(padded)
                 feed_arrays[name + "@SEQLEN"] = jnp.asarray(lengths)
                 continue
+            if var is not None and var.lod_level > 0:
+                try:  # ragged python lists make np.ndim itself raise
+                    ndim = np.ndim(value)
+                except ValueError:
+                    ndim = -1
+                if ndim != len(var.shape or ()) or \
+                        name + "@SEQLEN" not in feed:
+                    raise TypeError(
+                        "variable %r is a sequence (lod_level=%d): feed a "
+                        "LoDTensor (fluid.create_lod_tensor / "
+                        "LoDTensor.from_sequences), or a padded [num_seqs, "
+                        "max_len, ...] array plus %r lengths" %
+                        (name, var.lod_level, name + "@SEQLEN"))
             arr = _to_array(value, var)
             feed_arrays[name] = arr
 
