@@ -45,6 +45,10 @@ class LowerCtx(object):
         self.mesh = mesh
         self._op_salt = 0
         self._op_calls = 0
+        # traced iteration counters of enclosing lax.scan/while_loop bodies
+        # (pushed by control-flow lowerings) — folded into every key so
+        # dropout/random ops inside loops vary per time step.
+        self._loop_iters = []
 
     def begin_op(self, salt):
         self._op_salt = salt
@@ -61,9 +65,12 @@ class LowerCtx(object):
         produces identical randomness on every run of every process."""
         self._op_calls += 1
         base = jax.random.key(seed) if seed else self.base_key
-        return jax.random.fold_in(
+        key = jax.random.fold_in(
             base,
             (self._op_salt * 1000003 + self._op_calls * 97 + salt) & 0x7FFFFFFF)
+        for it in self._loop_iters:
+            key = jax.random.fold_in(key, it)
+        return key
 
 
 class Env(object):

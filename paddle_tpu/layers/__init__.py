@@ -13,9 +13,11 @@ from . import io
 from .io import *          # noqa: F401,F403
 from . import sequence
 from .sequence import *    # noqa: F401,F403
+from . import control_flow
+from .control_flow import *  # noqa: F401,F403
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()
 
 __all__ = (nn.__all__ + ops.__all__ + tensor.__all__ + io.__all__ +
-           sequence.__all__)
+           sequence.__all__ + control_flow.__all__)

@@ -10,7 +10,7 @@ __all__ = [
     "sequence_pool", "sequence_first_step", "sequence_last_step",
     "sequence_softmax", "sequence_conv", "sequence_expand", "sequence_reshape",
     "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit", "lstm_unit",
-    "lod_reset", "row_conv",
+    "lod_reset", "row_conv", "beam_search", "beam_search_decode",
 ]
 
 
@@ -234,3 +234,78 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
                 "XLen": [_seq_len(helper, input)]},
         outputs={"Out": [out]})
     return helper.append_activation(out)
+
+
+def beam_search(pre_ids, ids, scores, beam_size, end_id, level=0,
+                pre_scores=None, return_parent_idx=False, name=None):
+    """One beam-search expansion step, dense [batch, beam] layout.
+
+    Parity: python/paddle/fluid/layers/nn.py beam_search /
+    operators/beam_search_op.cc. The reference tracks beams in 2-level-LoD
+    candidate lists; on TPU each batch row always holds exactly `beam_size`
+    beams so the decode loop stays one lax.while_loop of static shapes.
+
+    Dense contract: `scores` is [batch, beam, vocab] next-token log-probs,
+    `pre_ids`/`pre_scores` are [batch, beam]. Returns (selected_ids,
+    selected_scores) and, if return_parent_idx, the [batch, beam] parent
+    beam index needed by beam_search_decode. `ids` (the reference's topk
+    candidate path) is accepted and ignored — the op does its own top-k
+    over beam*vocab.
+
+    IMPORTANT (step 0): when all beams of a row start identical (the usual
+    [start_token]*beam init), initialize pre_scores to [0, -1e9, -1e9, ...]
+    per row, NOT all zeros — otherwise the top-k over beam*vocab selects the
+    same best token once per duplicate beam and the search degenerates to
+    beam_size copies of greedy decoding.
+    """
+    helper = LayerHelper("beam_search", **locals())
+    if pre_scores is None:
+        raise ValueError(
+            "TPU beam_search needs pre_scores (cumulative log-probs); pass "
+            "the previous step's selected_scores")
+    selected_ids = helper.create_variable_for_type_inference(pre_ids.dtype)
+    selected_scores = helper.create_variable_for_type_inference(scores.dtype)
+    parent_idx = helper.create_variable_for_type_inference("int32")
+    for v, sh in ((selected_ids, pre_ids.shape),
+                  (selected_scores, pre_ids.shape),
+                  (parent_idx, pre_ids.shape)):
+        v.shape = sh
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                "scores": [scores]},
+        outputs={"selected_ids": [selected_ids],
+                 "selected_scores": [selected_scores],
+                 "parent_idx": [parent_idx]},
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id),
+               "level": level},
+        infer_shape=False)
+    if return_parent_idx:
+        return selected_ids, selected_scores, parent_idx
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, scores, parent_idx=None, beam_size=None,
+                       end_id=0, name=None):
+    """Backtrack per-step beam arrays into final sentences.
+
+    Parity: python/paddle/fluid/layers/nn.py beam_search_decode /
+    operators/beam_search_decode_op.cc. `ids`/`scores` are the TensorArrays
+    written each step; `parent_idx` the array of parent beam indices from
+    beam_search(return_parent_idx=True). Returns (sentence_ids [B, beam, T]
+    end_id-padded, sentence_scores [B, beam]).
+    """
+    helper = LayerHelper("beam_search_decode", **locals())
+    if parent_idx is None:
+        raise ValueError("TPU beam_search_decode needs the parent_idx array "
+                         "(beam_search(..., return_parent_idx=True))")
+    sentence_ids = helper.create_variable_for_type_inference(ids.dtype)
+    sentence_scores = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "ParentIdx": [parent_idx], "Scores": [scores]},
+        outputs={"SentenceIds": [sentence_ids],
+                 "SentenceScores": [sentence_scores]},
+        attrs={"end_id": int(end_id)},
+        infer_shape=False)
+    return sentence_ids, sentence_scores

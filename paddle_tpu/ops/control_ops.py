@@ -1,6 +1,466 @@
-"""Control-flow op lowerings (While, conditional_block, tensor arrays).
+"""Control-flow op lowerings: loops, conditionals, tensor arrays, rank tables.
 
-Parity: paddle/fluid/operators/{while_op,conditional_block_op,
-array_operator,tensor_array_read_write}.cc. Filled out with the
-control-flow milestone.
+Parity: paddle/fluid/operators/{while_op,conditional_block_op,array_operator,
+tensor_array_read_write_op,lod_rank_table_op,max_sequence_len_op,
+shrink_rnn_memory_op,lod_tensor_to_array_op,array_to_lod_tensor_op,
+reorder_lod_tensor_by_rank_op,compare_op,increment_op,beam_search_op,
+beam_search_decode_op}.{cc,cu,h} and the reference's recurrent_op.cc.
+
+TPU-first design (SURVEY.md §6.4):
+- `while` lowers to one `lax.while_loop` whose carry is (iter, cond, written
+  outer vars incl. tensor arrays) — the reference re-enters the op-by-op
+  interpreter per iteration with fresh step-Scopes.
+- `rnn_scan` (the lowering target of Dynamic/StaticRNN) is a single
+  `lax.scan` over time with per-row length masking: memories freeze and
+  outputs zero once t >= seqlen. This replaces the reference's
+  lod_tensor_to_array + shrink_memory + while machinery (sorted shrinking
+  batches) with fixed-shape masked compute — what XLA wants. Because it is a
+  registered pure rule, `grad_of` differentiates it with jax.vjp and BPTT
+  falls out of lax.scan's transpose; the reference needs while_grad_op and
+  hand-maintained step-scope stacks.
+- LoDTensorArray = fixed-capacity stacked buffer + current length
+  (dynamic_update_slice writes). Capacity is static (XLA) — taken from the
+  array var's declared capacity, default 256.
+- conditional_block evaluates the sub-block and `where`-selects against the
+  out vars' previous values (scalar-cond form used by Switch / LR schedules);
+  the non-scalar form (IfElse) runs the block unconditionally and lets
+  merge_lod_tensor's row mask do the select — compute-both-and-mask instead
+  of the reference's split/merge of ragged sub-batches.
 """
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import registry
+from ..core.registry import register, single
+from ..core import lowering
+from ..core.lowering import register_special, Env, lower_block
+
+DEFAULT_ARRAY_CAPACITY = 256
+
+
+# ---------------------------------------------------------------------------
+# pytree value types threaded through the env / loop carries
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+class TensorArray(object):
+    """LoDTensorArray value: stacked buffer [capacity, ...] + length scalar.
+
+    Parity: paddle/fluid/framework/lod_tensor_array.h (a std::vector of
+    LoDTensors on host). Fixed capacity makes it a legal XLA loop carry.
+    """
+
+    def __init__(self, buffer, length):
+        self.buffer = buffer
+        self.length = length
+
+    def tree_flatten(self):
+        return (self.buffer, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def write(self, i, x):
+        i = jnp.asarray(i, jnp.int32).reshape(())
+        buf = lax.dynamic_update_index_in_dim(
+            self.buffer, jnp.asarray(x, self.buffer.dtype), i, axis=0)
+        return TensorArray(buf, jnp.maximum(self.length, i + 1))
+
+    def read(self, i):
+        i = jnp.asarray(i, jnp.int32).reshape(())
+        return lax.dynamic_index_in_dim(self.buffer, i, axis=0,
+                                        keepdims=False)
+
+    @staticmethod
+    def empty(shape, dtype, capacity=DEFAULT_ARRAY_CAPACITY):
+        return TensorArray(jnp.zeros((capacity,) + tuple(shape), dtype),
+                           jnp.zeros((), jnp.int32))
+
+
+@jax.tree_util.register_pytree_node_class
+class RankTable(object):
+    """lod_rank_table value: sequence lengths sorted descending + the
+    permutation that sorts them (reference: framework/lod_rank_table.h)."""
+
+    def __init__(self, lengths, index):
+        self.lengths = lengths  # int32 [num_seqs], descending
+        self.index = index      # int32 [num_seqs], original positions
+
+    def tree_flatten(self):
+        return (self.lengths, self.index), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+# increment / compare / is_empty lowerings live in ops/basic.py
+
+# ---------------------------------------------------------------------------
+# tensor arrays (special: they produce/consume TensorArray env values)
+# ---------------------------------------------------------------------------
+
+def _env_array(ctx, op, env, name, like=None):
+    """Fetch the TensorArray for `name`, creating an empty one on first
+    write (capacity from the array var's attr, element shape from `like`)."""
+    arr = env.read_opt(name)
+    if arr is not None:
+        return arr
+    if like is None:
+        raise ValueError("tensor array %r read before any write" % name)
+    var = lowering._find_var(ctx.program, name)
+    cap = getattr(var, "capacity", None) or DEFAULT_ARRAY_CAPACITY
+    return TensorArray.empty(np.shape(like), jnp.result_type(like), cap)
+
+
+@register_special("write_to_array")
+def _write_to_array(ctx, op, env):
+    x = env.read(op.inputs["X"][0])
+    i = env.read(op.inputs["I"][0])
+    out = op.outputs["Out"][0]
+    arr = _env_array(ctx, op, env, out, like=x)
+    env.write(out, arr.write(i, x))
+
+
+@register_special("read_from_array")
+def _read_from_array(ctx, op, env):
+    arr = env.read(op.inputs["X"][0])
+    i = env.read(op.inputs["I"][0])
+    env.write(op.outputs["Out"][0], arr.read(i))
+
+
+@register_special("lod_array_length")
+def _lod_array_length(ctx, op, env):
+    arr = env.read(op.inputs["X"][0])
+    env.write(op.outputs["Out"][0], arr.length.reshape((1,)))
+
+
+@register_special("lod_rank_table")
+def _lod_rank_table(ctx, op, env):
+    xlen = env.read(op.inputs["XLen"][0]).astype(jnp.int32)
+    # stable descending sort (matches reference LoDRankTable ordering)
+    order = jnp.argsort(-xlen, stable=True).astype(jnp.int32)
+    env.write(op.outputs["Out"][0], RankTable(xlen[order], order))
+
+
+@register_special("max_sequence_len")
+def _max_sequence_len(ctx, op, env):
+    rt = env.read(op.inputs["RankTable"][0])
+    env.write(op.outputs["Out"][0], rt.lengths[0].reshape((1,)))
+
+
+@register_special("reorder_lod_tensor_by_rank")
+def _reorder_by_rank(ctx, op, env):
+    x = env.read(op.inputs["X"][0])
+    rt = env.read(op.inputs["RankTable"][0])
+    env.write(op.outputs["Out"][0], jnp.take(x, rt.index, axis=0))
+    if op.inputs.get("XLen") and op.outputs.get("OutLen"):
+        xl = env.read(op.inputs["XLen"][0])
+        env.write(op.outputs["OutLen"][0], jnp.take(xl, rt.index, axis=0))
+
+
+@register_special("shrink_rnn_memory")
+def _shrink_rnn_memory(ctx, op, env):
+    # The reference shrinks the batch to sequences still alive at step I
+    # (sorted-by-length layout). The padded-dense design keeps shapes static
+    # and masks updates inside rnn_scan instead, so this is identity.
+    env.write(op.outputs["Out"][0], env.read(op.inputs["X"][0]))
+
+
+@register_special("lod_tensor_to_array")
+def _lod_tensor_to_array(ctx, op, env):
+    # [B, T, ...] padded sequence -> time-major array of [B, ...] steps.
+    x = env.read(op.inputs["X"][0])
+    xt = jnp.moveaxis(x, 1, 0)
+    env.write(op.outputs["Out"][0],
+              TensorArray(xt, jnp.asarray(x.shape[1], jnp.int32)))
+
+
+@register_special("array_to_lod_tensor")
+def _array_to_lod_tensor(ctx, op, env):
+    # Output is [B, capacity, ...]: XLA cannot produce a data-dependent time
+    # dim, so the written length goes out as a per-row lengths companion
+    # (OutLen) and downstream sequence ops mask the zero tail.
+    arr = env.read(op.inputs["X"][0])
+    out = jnp.moveaxis(arr.buffer, 0, 1)
+    env.write(op.outputs["Out"][0], out)
+    if op.outputs.get("OutLen"):
+        env.write(op.outputs["OutLen"][0],
+                  jnp.full((out.shape[0],), arr.length, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# while
+# ---------------------------------------------------------------------------
+
+@register_special("while")
+def _while(ctx, op, env):
+    """lax.while_loop over the sub-block.
+
+    carry = (iter_counter, cond, *carry_vars). carry_names (computed at build
+    time by layers.control_flow.While.complete) are the vars written inside
+    the sub-block that live in an ancestor block. Tensor arrays in the carry
+    must be written at least once before the loop so their buffers exist
+    (the usual fluid idiom: array_write(init, i=0, array) precedes While).
+    """
+    sub = ctx.program.blocks[op.attrs["sub_block"]]
+    cond_name = op.inputs["Condition"][0]
+    carry_names = list(op.attrs["carry_names"])
+    missing = [n for n in carry_names if n not in env]
+    if missing:
+        raise ValueError(
+            "While loop carries %r, but they have no value before the loop. "
+            "XLA loop carries need an initial value: assign / array_write / "
+            "fill_constant each of them before `with while_op.block():`."
+            % missing)
+
+    init = (jnp.zeros((), jnp.int32),
+            jnp.reshape(env.read(cond_name), ()).astype(bool),
+            tuple(env.read(n) for n in carry_names))
+
+    def cond_fn(carry):
+        return carry[1]
+
+    def body_fn(carry):
+        it, _, vals = carry
+        benv = Env()
+        benv.values = dict(env.values)
+        for n, v in zip(carry_names, vals):
+            benv.write(n, v)
+        ctx._loop_iters.append(it)
+        try:
+            lower_block(ctx, sub, benv)
+        finally:
+            ctx._loop_iters.pop()
+        new_vals = tuple(
+            jnp.asarray(benv.read(n), jnp.result_type(v))
+            if not isinstance(v, (TensorArray, RankTable)) else benv.read(n)
+            for n, v in zip(carry_names, vals))
+        return (it + 1,
+                jnp.reshape(benv.read(cond_name), ()).astype(bool), new_vals)
+
+    _, _, final = lax.while_loop(cond_fn, body_fn, init)
+    for n, v in zip(carry_names, final):
+        env.write(n, v)
+    env.write(cond_name, jnp.zeros((1,), bool))
+
+
+# ---------------------------------------------------------------------------
+# conditional_block (Switch / IfElse)
+# ---------------------------------------------------------------------------
+
+@register_special("conditional_block")
+def _conditional_block(ctx, op, env):
+    sub = ctx.program.blocks[op.attrs["sub_block"]]
+    out_names = list(op.attrs["out_names"])
+
+    def run_block():
+        benv = Env()
+        benv.values = dict(env.values)
+        lower_block(ctx, sub, benv)
+        return [benv.read(n) for n in out_names]
+
+    if not op.attrs.get("is_scalar_condition", True):
+        # IfElse form: merge_lod_tensor's row mask does the select; the
+        # block itself runs unconditionally on the full batch.
+        for n, v in zip(out_names, run_block()):
+            env.write(n, v)
+        return
+
+    cond = jnp.reshape(env.read(op.inputs["Cond"][0]), ()).astype(bool)
+    # Blocks are pure, so compute the block unconditionally and where-select
+    # against each out var's previous value (zeros if first write) — Switch
+    # cases each overwrite the same out vars, last-where with exclusive
+    # conditions reproduces first-match-wins. XLA dedupes the shared work.
+    outs = run_block()
+    for n, o in zip(out_names, outs):
+        p = env.read_opt(n)
+        if p is None:
+            p = jnp.zeros_like(o)
+        else:
+            p = jnp.broadcast_to(jnp.asarray(p, o.dtype), o.shape)
+        env.write(n, jnp.where(cond, o, p))
+
+
+@register("split_lod_tensor")
+def _split_lod_tensor(ctx, ins, attrs):
+    # compute-both-and-mask: both branches see the full batch (see module doc)
+    x = single(ins, "X")
+    return {"OutTrue": [x], "OutFalse": [x]}
+
+
+@register("merge_lod_tensor")
+def _merge_lod_tensor(ctx, ins, attrs):
+    x_true = single(ins, "InTrue")
+    x_false = single(ins, "InFalse")
+    mask = single(ins, "Mask")  # [B, 1] bool/float
+    m = jnp.reshape(mask, (-1,) + (1,) * (x_true.ndim - 1)).astype(bool)
+    return {"Out": [jnp.where(m, x_true,
+                              jnp.asarray(x_false, x_true.dtype))]}
+
+
+# ---------------------------------------------------------------------------
+# rnn_scan — the lowering target of DynamicRNN / StaticRNN
+# ---------------------------------------------------------------------------
+
+def _rnn_scan_lower(ctx, ins, attrs):
+    sub = ctx.program.blocks[attrs["sub_block"]]
+    xs = ins.get("X", [])                 # step inputs [B, T, feat...]
+    boots = ins.get("Boot", [])           # memory boot values [B, h]
+    statics = ins.get("Static", [])       # closed-over reads
+    seqlen = single(ins, "SeqLen")        # [B] int32 or None (StaticRNN)
+
+    in_names = attrs["in_names"]          # placeholders inside sub-block
+    static_names = attrs["static_names"]
+    pre_names = attrs["pre_names"]        # memory placeholders
+    update_names = attrs["update_names"]  # vars holding the new memory value
+    out_names = attrs["out_names"]        # per-step outputs to stack
+
+    T = int(attrs["max_len"]) if attrs.get("max_len") else xs[0].shape[1]
+    xs_t = [jnp.moveaxis(x, 1, 0) for x in xs]  # [T, B, ...]
+
+    def step(carry, xt):
+        t, mems = carry
+        benv = Env()
+        for n, v in zip(static_names, statics):
+            benv.write(n, v)
+        for n, v in zip(pre_names, mems):
+            benv.write(n, v)
+        for n, v in zip(in_names, xt):
+            benv.write(n, v)
+        ctx._loop_iters.append(t)
+        try:
+            lower_block(ctx, sub, benv)
+        finally:
+            ctx._loop_iters.pop()
+        new_mems = [jnp.asarray(benv.read(n), jnp.result_type(m))
+                    for n, m in zip(update_names, mems)]
+        outs = [benv.read(n) for n in out_names]
+        if seqlen is not None:
+            alive = t < seqlen.astype(jnp.int32)  # [B]
+
+            def sel(new, old):
+                m = alive.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(m, new, jnp.asarray(old, new.dtype))
+
+            new_mems = [sel(nm, pm) for nm, pm in zip(new_mems, mems)]
+            outs = [sel(o, jnp.zeros_like(o)) for o in outs]
+        return (t + 1, tuple(new_mems)), tuple(outs)
+
+    (_, final_mems), stacked = lax.scan(
+        step, (jnp.zeros((), jnp.int32), tuple(boots)), tuple(xs_t),
+        length=T)
+    outs = [jnp.moveaxis(o, 0, 1) for o in stacked]  # [B, T, ...]
+    return {"Out": outs, "LastMem": list(final_mems)}
+
+
+def _rnn_scan_infer(block, op, out_vars):
+    sub = block.program.blocks[op.attrs["sub_block"]]
+    T = op.attrs.get("max_len")
+    if not T and op.inputs.get("X"):
+        x0 = block.var_recursive(op.inputs["X"][0])
+        T = x0.shape[1] if x0.shape is not None else None
+    for name, inner in zip(op.outputs.get("Out", ()),
+                           op.attrs["out_names"]):
+        iv = sub.var_recursive(inner)
+        ov = block.var_recursive(name)
+        if iv.shape is not None:
+            ov.shape = (iv.shape[0], T if T else -1) + tuple(iv.shape[1:])
+        ov.dtype = iv.dtype
+    for name, inner in zip(op.outputs.get("LastMem", ()),
+                           op.attrs["update_names"]):
+        iv = sub.var_recursive(inner)
+        ov = block.var_recursive(name)
+        ov.shape, ov.dtype = iv.shape, iv.dtype
+
+
+registry.register("rnn_scan", _rnn_scan_lower, infer=_rnn_scan_infer)
+
+
+# ---------------------------------------------------------------------------
+# beam search (dense [batch, beam] layout)
+# ---------------------------------------------------------------------------
+
+@register_special("beam_search")
+def _beam_search(ctx, op, env):
+    """One step of beam search in dense [batch, beam] layout.
+
+    Parity: paddle/fluid/operators/beam_search_op.cc, which grows/prunes
+    LoD-encoded candidate lists on the host. Here each batch row always
+    keeps exactly `beam_size` beams (finished beams are frozen: their only
+    legal expansion is end_id at zero added cost), so shapes stay static
+    for XLA and the whole decode loop lives in one lax.while_loop.
+
+    inputs:  pre_ids [B,K] int, pre_scores [B,K] f32 (cumulative log-prob),
+             scores [B,K,V] f32 (log-probs of the next token per beam)
+    outputs: selected_ids [B,K], selected_scores [B,K],
+             parent_idx [B,K] int32 (which source beam each came from)
+    """
+    pre_ids = env.read(op.inputs["pre_ids"][0])
+    pre_scores = env.read(op.inputs["pre_scores"][0])
+    scores = env.read(op.inputs["scores"][0])
+    beam_size = int(op.attrs["beam_size"])
+    end_id = int(op.attrs["end_id"])
+
+    B, K, V = scores.shape
+    finished = (pre_ids == end_id)  # [B,K]
+
+    # expansion scores: live beams add token log-prob; finished beams can
+    # only "extend" with end_id at zero cost (keeps their total fixed).
+    total = pre_scores[:, :, None] + scores            # [B,K,V]
+    only_end = jnp.full((K, V), -1e9, scores.dtype).at[:, end_id].set(0.0)
+    total = jnp.where(finished[:, :, None],
+                      pre_scores[:, :, None] + only_end[None], total)
+
+    flat = total.reshape(B, K * V)
+    top_scores, top_idx = lax.top_k(flat, beam_size)   # [B,K]
+    parent = (top_idx // V).astype(jnp.int32)
+    token = (top_idx % V).astype(pre_ids.dtype)
+    env.write(op.outputs["selected_ids"][0], token)
+    env.write(op.outputs["selected_scores"][0], top_scores)
+    if op.outputs.get("parent_idx"):
+        env.write(op.outputs["parent_idx"][0], parent)
+
+
+@register_special("beam_search_decode")
+def _beam_search_decode(ctx, op, env):
+    """Backtrack beam-search step arrays into full sequences.
+
+    Parity: paddle/fluid/operators/beam_search_decode_op.cc (host-side LoD
+    backtrace). Here: reverse lax.scan over the (ids, parents) TensorArrays.
+
+    inputs:  Ids (TensorArray of [B,K] tokens), ParentIdx (TensorArray of
+             [B,K] parent beam indices), Scores (TensorArray of cumulative
+             [B,K] scores — the last written entry is the final total)
+    outputs: SentenceIds [B,K,C] (end_id-padded), SentenceScores [B,K]
+    """
+    ids_arr = env.read(op.inputs["Ids"][0])
+    par_arr = env.read(op.inputs["ParentIdx"][0])
+    scores_arr = env.read(op.inputs["Scores"][0])
+    scores = scores_arr.read(scores_arr.length - 1)
+    end_id = int(op.attrs["end_id"])
+
+    buf_ids = ids_arr.buffer      # [C, B, K]
+    buf_par = par_arr.buffer      # [C, B, K]
+    C, B, K = buf_ids.shape
+    n = ids_arr.length            # actual steps written
+
+    binx = jnp.arange(B)[:, None]                      # [B,1]
+    init_beam = jnp.tile(jnp.arange(K)[None], (B, 1))  # [B,K]
+
+    def back(beam, t):
+        valid = t < n
+        tok = jnp.where(valid, buf_ids[t][binx, beam],
+                        jnp.asarray(end_id, buf_ids.dtype))
+        prev = jnp.where(valid, buf_par[t][binx, beam], beam)
+        return prev.astype(jnp.int32), tok
+
+    _, toks = lax.scan(back, init_beam.astype(jnp.int32),
+                       jnp.arange(C - 1, -1, -1))
+    sentences = jnp.moveaxis(toks[::-1], 0, 2)         # [B,K,C]
+    env.write(op.outputs["SentenceIds"][0], sentences)
+    env.write(op.outputs["SentenceScores"][0], scores)

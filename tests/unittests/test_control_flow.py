@@ -1,0 +1,240 @@
+"""Control-flow tests: While, arrays, Switch, IfElse, StaticRNN, DynamicRNN.
+
+Parity model: python/paddle/fluid/tests/unittests/{test_while_op,
+test_array_read_write,test_switch,test_ifelse,test_recurrent_op,
+test_dyn_rnn}.py
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def fresh_programs():
+    return fluid.Program(), fluid.Program()
+
+
+def run(main, startup, feed, fetch_list):
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch_list)
+
+
+def test_while_sum_of_array():
+    # sum d0+d1+d2 via array reads in a while loop (ref: test_while_op.py)
+    main, startup = fresh_programs()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        d0 = layers.data("d0", shape=[10], append_batch_size=False)
+        d1 = layers.data("d1", shape=[10], append_batch_size=False)
+        d2 = layers.data("d2", shape=[10], append_batch_size=False)
+        i = layers.zeros(shape=[1], dtype="int32")
+        i.stop_gradient = True
+        arr = layers.array_write(d0, i)
+        i = layers.increment(i, in_place=False)
+        arr = layers.array_write(d1, i, array=arr)
+        i = layers.increment(i, in_place=False)
+        layers.array_write(d2, i, array=arr)
+
+        j = layers.zeros(shape=[1], dtype="int32")
+        j.stop_gradient = True
+        acc = layers.zeros(shape=[10], dtype="float32")
+        n = layers.fill_constant(shape=[1], dtype="int32", value=3)
+        cond = layers.less_than(x=j, y=n)
+        w = layers.While(cond=cond)
+        with w.block():
+            x = layers.array_read(arr, j)
+            layers.sums(input=[acc, x], out=acc)
+            j = layers.increment(j)
+            layers.less_than(x=j, y=n, cond=cond)
+
+    xs = [np.random.RandomState(s).rand(10).astype("float32")
+          for s in (0, 1, 2)]
+    out, = run(main, startup, {"d0": xs[0], "d1": xs[1], "d2": xs[2]}, [acc])
+    np.testing.assert_allclose(np.asarray(out), xs[0] + xs[1] + xs[2],
+                               rtol=1e-6)
+
+
+def test_array_read_write_roundtrip():
+    main, startup = fresh_programs()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], append_batch_size=False)
+        i0 = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        i1 = layers.fill_constant(shape=[1], dtype="int32", value=1)
+        arr = layers.array_write(x, i0)
+        two_x = layers.scale(x=x, scale=2.0)
+        layers.array_write(two_x, i1, array=arr)
+        r0 = layers.array_read(arr, i0)
+        r1 = layers.array_read(arr, i1)
+        length = layers.array_length(arr)
+    xv = np.arange(4).astype("float32")
+    r0v, r1v, n = run(main, startup, {"x": xv}, [r0, r1, length])
+    np.testing.assert_allclose(np.asarray(r0v), xv)
+    np.testing.assert_allclose(np.asarray(r1v), 2 * xv)
+    assert int(np.asarray(n)[0]) == 2
+
+
+def test_switch_first_match_wins():
+    # LR-schedule style switch (ref: test_switch.py)
+    for x_val, expect in [(0.1, 10.0), (0.6, 20.0), (2.0, 30.0)]:
+        main, startup = fresh_programs()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = layers.fill_constant(shape=[1], dtype="float32", value=x_val)
+            zero = layers.fill_constant(shape=[1], dtype="float32", value=0.5)
+            one = layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+            out = layers.create_global_var(
+                shape=[1], value=-1.0, dtype="float32", persistable=True)
+            with layers.Switch() as switch:
+                with switch.case(layers.less_than(x=x, y=zero)):
+                    layers.assign(layers.fill_constant(
+                        shape=[1], dtype="float32", value=10.0), out)
+                with switch.case(layers.less_than(x=x, y=one)):
+                    layers.assign(layers.fill_constant(
+                        shape=[1], dtype="float32", value=20.0), out)
+                with switch.default():
+                    layers.assign(layers.fill_constant(
+                        shape=[1], dtype="float32", value=30.0), out)
+        got, = run(main, startup, {}, [out])
+        assert float(np.asarray(got)[0]) == expect, (x_val, got)
+
+
+def test_ifelse_rowwise():
+    # rows < 0 negated, rows >= 0 doubled (ref: test_ifelse.py style)
+    main, startup = fresh_programs()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1])
+        zero = layers.fill_constant_batch_size_like(
+            input=x, shape=[-1, 1], dtype="float32", value=0.0)
+        cond = layers.less_than(x=x, y=zero)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            xi = ie.input(x)
+            ie.output(layers.scale(x=xi, scale=-1.0))
+        with ie.false_block():
+            xi = ie.input(x)
+            ie.output(layers.scale(x=xi, scale=2.0))
+        out = ie()[0]
+    xv = np.array([[-1.0], [2.0], [-3.0], [4.0]], dtype="float32")
+    got, = run(main, startup, {"x": xv}, [out])
+    np.testing.assert_allclose(np.asarray(got),
+                               np.where(xv < 0, -xv, 2 * xv))
+
+
+def test_static_rnn_matches_numpy():
+    B, T, D, H = 3, 5, 4, 6
+    main, startup = fresh_programs()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, D])
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[H], batch_ref=x, init_value=0.0)
+            nh = layers.fc(input=[xt, h], size=H, act="tanh",
+                           bias_attr=False)
+            rnn.update_memory(h, nh)
+            rnn.output(nh)
+        out = rnn()
+        loss = layers.mean(layers.reduce_sum(out, dim=[1, 2]))
+        fluid.append_backward(loss)
+
+    xv = np.random.RandomState(0).randn(B, T, D).astype("float32")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        outv, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        # numpy reference
+        params = [v for v in main.global_block().all_parameters()]
+        ws = {p.name: np.asarray(scope.get(p.name)) for p in params}
+        assert len(ws) == 2  # one weight per fc input ([xt, h])
+        names = sorted(ws)
+        w_x, w_h = ws[names[0]], ws[names[1]]
+        hs = np.zeros((B, H), np.float32)
+        ref = []
+        for t in range(T):
+            hs = np.tanh(xv[:, t] @ w_x + hs @ w_h)
+            ref.append(hs)
+        ref = np.stack(ref, axis=1)
+        np.testing.assert_allclose(np.asarray(outv), ref, rtol=2e-5,
+                                   atol=2e-5)
+        # gradient flows to both weights
+        g, = exe.run(main, feed={"x": xv},
+                     fetch_list=[names[0] + "@GRAD"])
+        assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_dynamic_rnn_masks_past_length():
+    B, D, H = 3, 4, 5
+    lengths = [2, 4, 1]
+    main, startup = fresh_programs()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[D], lod_level=1)
+        rnn = layers.DynamicRNN()
+        with rnn.block():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[H], value=0.0)
+            nh = layers.fc(input=[xt, h], size=H, act="tanh",
+                           bias_attr=False)
+            rnn.update_memory(h, nh)
+            rnn.output(nh)
+        out = rnn()
+        final = layers.sequence_last_step(out)
+        loss = layers.mean(layers.reduce_sum(final, dim=[1]))
+        fluid.append_backward(loss)
+
+    rng = np.random.RandomState(0)
+    seqs = [rng.randn(n, D).astype("float32") for n in lengths]
+    lod_x = fluid.LoDTensor.from_sequences(seqs)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        outv, finv = exe.run(main, feed={"x": lod_x},
+                             fetch_list=[out, final])
+        outv = np.asarray(outv)
+        finv = np.asarray(finv)
+        params = sorted(v.name for v in main.global_block().all_parameters())
+        w_x = np.asarray(scope.get(params[0]))
+        w_h = np.asarray(scope.get(params[1]))
+        T = outv.shape[1]
+        for b, n in enumerate(lengths):
+            hs = np.zeros((H,), np.float32)
+            for t in range(n):
+                hs = np.tanh(seqs[b][t] @ w_x + hs @ w_h)
+                np.testing.assert_allclose(outv[b, t], hs, rtol=2e-5,
+                                           atol=2e-5)
+            # outputs past the true length are zeroed
+            assert np.all(outv[b, n:] == 0)
+            # last step == state at true length, not at padded end
+            np.testing.assert_allclose(finv[b], hs, rtol=2e-5, atol=2e-5)
+
+
+def test_beam_search_step_and_decode():
+    # greedy check: beam_search with K=2 picks the top-2 continuations
+    B, K, V = 2, 2, 5
+    main, startup = fresh_programs()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        pre_ids = layers.data("pre_ids", shape=[K], append_batch_size=False,
+                              dtype="int64")
+        pre_scores = layers.data("pre_scores", shape=[K],
+                                 append_batch_size=False)
+        probs = layers.data("probs", shape=[K, V], append_batch_size=False)
+        ids, scores = layers.beam_search(
+            pre_ids=pre_ids, pre_scores=pre_scores, ids=None, scores=probs,
+            beam_size=K, end_id=0)
+    pre_ids_v = np.array([[1, 2], [0, 3]], dtype="int64")  # row1 beam0 done
+    pre_sc = np.zeros((B, K), np.float32)
+    logp = np.log(np.full((B, K, V), 1e-9, np.float32))
+    logp[0, 0, 3] = np.log(0.9)
+    logp[0, 1, 4] = np.log(0.8)
+    logp[1, 1, 2] = np.log(0.7)
+    out_ids, out_scores = run(
+        main, startup,
+        {"pre_ids": pre_ids_v.reshape(B, K), "pre_scores": pre_sc,
+         "probs": logp.reshape(B, K, V)}, [ids, scores])
+    out_ids = np.asarray(out_ids)
+    assert out_ids[0, 0] == 3 and out_ids[0, 1] == 4
+    # finished beam (id 0) stays on end_id with unchanged score
+    assert 0 in out_ids[1]
