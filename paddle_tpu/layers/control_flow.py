@@ -214,8 +214,12 @@ def shrink_memory(x, i, table):
 def lod_tensor_to_array(x, table=None):
     helper = LayerHelper("lod_tensor_to_array", **locals())
     arr = create_array(x.dtype)
+    inputs = {"X": [x]}
+    if table is not None:
+        inputs["RankTable"] = [table]
+        arr.rank_table_var = table.name
     helper.append_op(type="lod_tensor_to_array",
-                     inputs={"X": [x]}, outputs={"Out": [arr]},
+                     inputs=inputs, outputs={"Out": [arr]},
                      infer_shape=False)
     if x.shape is not None:
         arr.shape = (x.shape[0],) + tuple(x.shape[2:])
@@ -228,8 +232,13 @@ def array_to_lod_tensor(x, table=None):
     out_len = helper.block.create_var(
         name=out.name + "@SEQLEN", shape=[-1], dtype="int32",
         stop_gradient=True)
+    inputs = {"X": [x]}
+    if table is not None:
+        inputs["RankTable"] = [table]
+    elif getattr(x, "rank_table_var", None):
+        inputs["RankTable"] = [x.rank_table_var]
     helper.append_op(type="array_to_lod_tensor",
-                     inputs={"X": [x]},
+                     inputs=inputs,
                      outputs={"Out": [out], "OutLen": [out_len]},
                      infer_shape=False)
     # time dim is the array capacity; the written length rides the lengths
@@ -298,9 +307,11 @@ class While(object):
         out_vars = [parent_block.var_recursive(n) for n in carry
                     if parent_block.has_var_recursive(n)]
 
+        # carried vars are listed as inputs too ("X") so state analysis loads
+        # persistable carries from the Scope before marking them written
         parent_block.append_op(
             type="while",
-            inputs={"Condition": [self.cond_var]},
+            inputs={"Condition": [self.cond_var], "X": out_vars},
             outputs={"Out": out_vars},
             attrs={"sub_block": while_block.idx,
                    "carry_names": [v.name for v in out_vars]},
@@ -348,9 +359,13 @@ class ConditionalBlock(object):
         out_names = [n for n in sorted(_written_names(inside_block))
                      if not inside_block.has_var(n)
                      and parent_block.has_var_recursive(n)]
+        # OutPrev: the out vars' previous values are read by the not-taken
+        # branch, so they must appear as inputs for state analysis to load
+        # scope-initialized (persistable) values
         parent_block.append_op(
             type="conditional_block",
-            inputs={"Cond": [v.name for v in self.inputs]},
+            inputs={"Cond": [v.name for v in self.inputs],
+                    "OutPrev": out_names},
             outputs={"Out": out_names},
             attrs={"sub_block": inside_block.idx,
                    "out_names": out_names,

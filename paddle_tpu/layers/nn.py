@@ -21,6 +21,7 @@ __all__ = [
     "reduce_prod", "split", "l2_normalize", "cos_sim", "dropout",
     "smooth_l1", "autoincreased_step_counter", "transpose", "im2sequence",
     "multiplex", "label_smooth", "nce", "lrn", "maxout", "relu", "log",
+    "expand", "sequence_mask",
 ]
 
 
@@ -567,3 +568,40 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     return counter
 
 
+
+
+def expand(x, expand_times, name=None):
+    """Tile x along each dim. Parity: fluid.layers.expand / expand_op.cc."""
+    helper = LayerHelper("expand", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="expand", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"expand_times": list(expand_times)})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """[N] lengths -> [N, maxlen] 0/1 mask. Parity: fluid.layers.sequence_mask
+    / sequence_mask_op.h. `maxlen` may be an int or a Variable whose dim 1
+    supplies the static length (TPU needs a static bound)."""
+    helper = LayerHelper("sequence_mask", **locals())
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [x]}
+    attrs = {"out_dtype": dtype}
+    if isinstance(maxlen, Variable):
+        inputs["MaxLenRef"] = [maxlen]
+    elif maxlen is not None:
+        attrs["maxlen"] = int(maxlen)
+    else:
+        raise ValueError("TPU sequence_mask needs a static maxlen (int or a "
+                         "Variable whose second dim provides it)")
+    helper.append_op(type="sequence_mask", inputs=inputs,
+                     outputs={"Y": [out]}, attrs=attrs, infer_shape=False)
+    if isinstance(maxlen, Variable):
+        m = maxlen.shape[1] if maxlen.shape is not None else -1
+    else:
+        m = int(maxlen)
+    if x.shape is not None:
+        out.shape = (x.shape[0], m)
+    out.stop_gradient = True
+    return out

@@ -65,6 +65,19 @@ class TensorArray(object):
         return cls(*children)
 
     def write(self, i, x):
+        # Out-of-capacity writes with a concrete index fail at trace time.
+        # A traced index (inside lax loops) cannot be checked without a
+        # host sync; XLA clamps it — size create_array(capacity=...) to the
+        # loop bound (layers like decoder_decode use max_length + 1).
+        cap = self.buffer.shape[0]
+        try:
+            if int(i) >= cap:
+                raise IndexError(
+                    "tensor array write at index %d exceeds capacity %d; "
+                    "pass a larger capacity to create_array()" % (int(i), cap))
+        except (TypeError, jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError):
+            pass
         i = jnp.asarray(i, jnp.int32).reshape(())
         buf = lax.dynamic_update_index_in_dim(
             self.buffer, jnp.asarray(x, self.buffer.dtype), i, axis=0)
@@ -174,7 +187,13 @@ def _shrink_rnn_memory(ctx, op, env):
 @register_special("lod_tensor_to_array")
 def _lod_tensor_to_array(ctx, op, env):
     # [B, T, ...] padded sequence -> time-major array of [B, ...] steps.
+    # With a RankTable input, rows are permuted into rank (descending-length)
+    # order first, matching reorder_lod_tensor_by_rank on companion tensors
+    # (the reference idiom pairs the two; array_to_lod_tensor undoes it).
     x = env.read(op.inputs["X"][0])
+    if op.inputs.get("RankTable"):
+        rt = env.read(op.inputs["RankTable"][0])
+        x = jnp.take(x, rt.index, axis=0)
     xt = jnp.moveaxis(x, 1, 0)
     env.write(op.outputs["Out"][0],
               TensorArray(xt, jnp.asarray(x.shape[1], jnp.int32)))
@@ -187,6 +206,11 @@ def _array_to_lod_tensor(ctx, op, env):
     # (OutLen) and downstream sequence ops mask the zero tail.
     arr = env.read(op.inputs["X"][0])
     out = jnp.moveaxis(arr.buffer, 0, 1)
+    if op.inputs.get("RankTable"):
+        # undo the rank permutation applied by lod_tensor_to_array
+        rt = env.read(op.inputs["RankTable"][0])
+        inv = jnp.argsort(rt.index)
+        out = jnp.take(out, inv, axis=0)
     env.write(op.outputs["Out"][0], out)
     if op.outputs.get("OutLen"):
         env.write(op.outputs["OutLen"][0],

@@ -232,9 +232,12 @@ def _log_softmax(ctx, ins, attrs):
 
 
 def _gather_label_logits(logp, label):
+    # [..., C] logits + [..., 1] (or [...]) labels -> [...] picked values
+    lead = logp.shape[:-1]
+    flat = logp.reshape(-1, logp.shape[-1])
     lab = label.reshape(-1).astype(jnp.int32)
-    rows = jnp.arange(logp.shape[0])
-    return logp[rows, lab]
+    rows = jnp.arange(flat.shape[0])
+    return flat[rows, lab].reshape(lead)
 
 
 @register("cross_entropy")
@@ -246,7 +249,7 @@ def _cross_entropy(ctx, ins, attrs):
                         keepdims=True)
     else:
         picked = _gather_label_logits(jnp.log(jnp.maximum(x, 1e-20)), label)
-        loss = -picked.reshape(-1, 1)
+        loss = -picked[..., None]
     return {"Y": [loss]}
 
 
@@ -258,7 +261,7 @@ def _softmax_xent(ctx, ins, attrs):
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:
-        loss = -_gather_label_logits(logp, label).reshape(-1, 1)
+        loss = -_gather_label_logits(logp, label)[..., None]
     return {"Softmax": [jnp.exp(logp).astype(logits.dtype)],
             "Loss": [loss.astype(logits.dtype)]}
 

@@ -319,3 +319,14 @@ def _lstm_unit(ctx, ins, attrs):
     c = f * c_prev + i * jnp.tanh(gc)
     h = o * jnp.tanh(c)
     return {"C": [c], "H": [h]}
+
+
+@register("sequence_mask")
+def _sequence_mask(ctx, ins, attrs):
+    """lengths [N] -> [N, maxlen] mask. Parity: sequence_mask_op.h."""
+    x = single(ins, "X").astype(jnp.int32)
+    ref = single(ins, "MaxLenRef")
+    maxlen = ref.shape[1] if ref is not None else int(attrs["maxlen"])
+    t = jnp.arange(maxlen, dtype=jnp.int32)
+    mask = (t[None, :] < x[:, None])
+    return {"Y": [mask.astype(np.dtype(attrs.get("out_dtype", "int64")))]}
