@@ -45,6 +45,9 @@ from . import profiler
 from .data_feeder import DataFeeder
 from . import backward
 from .parallel.parallel_executor import ParallelExecutor
+from . import transpiler
+from .transpiler import DistributeTranspiler
+from .transpiler import distributed_spliter
 
 Tensor = LoDTensor
 

@@ -1,1 +1,6 @@
 """Multi-device / multi-host parallelism over jax.sharding Meshes."""
+from .mesh import make_mesh, data_parallel_mesh, replicated, batch_sharded, \
+    Mesh, NamedSharding, P
+from .parallel_executor import ParallelExecutor
+from .ring_attention import ring_attention, ring_attention_sharded, \
+    attention_reference, sequence_parallel_specs

@@ -1,0 +1,150 @@
+"""Ring attention: exact attention over sequences sharded across chips.
+
+TPU-first long-context support (SURVEY.md §2 "long-context"). The reference
+(mozga-intel/Paddle, March 2018) has no attention-parallelism at all — its
+ring is the pserver update ring (python/paddle/v2/master, pserver/). Here the
+ring is over the `sp` mesh axis: Q/K/V live sharded on the sequence dim, each
+chip holds one block, and K/V blocks rotate around the ring via ppermute over
+ICI while every chip accumulates its Q-block's attention with an online
+(flash-style, numerically stable) softmax. Peak memory per chip is O(T/sp · T/sp)
+instead of O(T·T), and no chip ever materializes the full sequence.
+
+Layout convention: [batch, seq, heads, head_dim] ("BTHD"), sharded P(dp, sp)
+on (batch, seq). Works under jit inside a Mesh context; differentiable
+(jax.grad flows through shard_map + ppermute, giving the ring backward pass
+with reverse-direction permutes automatically).
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from .mesh import P
+
+__all__ = ["ring_attention", "attention_reference", "ring_attention_sharded",
+           "sequence_parallel_specs"]
+
+_NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, causal=False, scale=None):
+    """Dense single-device attention, [B,T,H,D]. The numerical reference the
+    ring path must match; also the fallback when no `sp` axis exists."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool))
+        logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_attend(q, k, v, m, l, o, q_off, k_off, causal, scale):
+    """One online-softmax accumulation step against a single K/V block.
+
+    q: [B,Tq,H,D]  k,v: [B,Tk,H,D]  m,l: [B,H,Tq]  o: [B,Tq,H,D]
+    q_off/k_off: global position offsets of the blocks (for causal mask).
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B,H,Tq,Tk]
+    if causal:
+        qpos = q_off + jnp.arange(q.shape[1])
+        kpos = k_off + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    m_blk = jnp.max(logits, axis=-1)                      # [B,H,Tq]
+    m_new = jnp.maximum(m, m_blk)
+    p = jnp.exp(logits - m_new[..., None])                # [B,H,Tq,Tk]
+    if causal:
+        # fully-masked rows would give exp(NEG_INF - NEG_INF) = 1 everywhere;
+        # force masked entries to exact zero so l stays 0 and the final
+        # clamp yields a zero output row
+        p = jnp.where(logits <= _NEG_INF * 0.5, 0.0, p)
+    corr = jnp.exp(m - m_new)                             # [B,H,Tq]
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    # o is [B,Tq,H,D]; corr broadcasts as [B,Tq,H,1]
+    corr_o = jnp.transpose(corr, (0, 2, 1))[..., None]
+    o_new = o * corr_o + jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return m_new, l_new, o_new
+
+
+def _ring_body(axis_name, n, causal, scale, t_q, t_k):
+    def body(step, carry):
+        k, v, m, l, o, q, my_idx = carry
+        # block currently held arrived from device (my_idx - step) mod n
+        src = jnp.mod(my_idx - step, n)
+        m, l, o = _block_attend(q, k, v, m, l, o,
+                                q_off=my_idx * t_q, k_off=src * t_k,
+                                causal=causal, scale=scale)
+        # rotate K/V one hop around the ring (skip after the last block so
+        # the loop does exactly n-1 permutes)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k, v = lax.cond(
+            step < n - 1,
+            lambda kv: tuple(lax.ppermute(x, axis_name, perm) for x in kv),
+            lambda kv: kv, (k, v))
+        return (k, v, m, l, o, q, my_idx)
+    return body
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
+                   vary_axes=None):
+    """Per-shard ring attention; call inside shard_map over `axis_name`.
+
+    q,k,v: the LOCAL sequence blocks [B, T/sp, H, D]. Returns local output
+    block [B, T/sp, H, D]. Exact (not approximate): matches
+    attention_reference on the gathered result to fp32 tolerance.
+    """
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    # accumulators start as constants; mark them device-varying over the ring
+    # axis so the fori_loop carry type is stable under shard_map
+    axes = tuple(vary_axes or (axis_name,))
+    if hasattr(lax, "pcast"):
+        vary = lambda x: lax.pcast(x, axes, to="varying")
+    else:  # older jax
+        vary = lambda x: lax.pvary(x, axes)
+    m0 = vary(jnp.full((b, h, t_q), _NEG_INF, dtype=jnp.float32))
+    l0 = vary(jnp.zeros((b, h, t_q), dtype=jnp.float32))
+    o0 = vary(jnp.zeros(q.shape, dtype=jnp.float32))
+    body = _ring_body(axis_name, n, causal, scale, t_q, t_k)
+    _, _, m, l, o, _, _ = lax.fori_loop(
+        0, n, body, (k, v, m0, l0, o0, q.astype(jnp.float32), my_idx))
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (strict causal pad) → 0 out
+    out = o / jnp.transpose(l, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)
+
+
+def sequence_parallel_specs(batch_axis="dp", seq_axis="sp"):
+    """PartitionSpecs for BTHD activations under sequence parallelism."""
+    return P(batch_axis, seq_axis, None, None)
+
+
+def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None,
+                           batch_axis="dp", seq_axis="sp"):
+    """Global-view ring attention: q,k,v are full [B,T,H,D] arrays (or GSPMD
+    -sharded); shard_map splits them over (dp, sp) and runs the ring.
+    """
+    if batch_axis in mesh.axis_names:
+        spec = sequence_parallel_specs(batch_axis, seq_axis)
+        vary_axes = (batch_axis, seq_axis)
+    else:
+        spec = P(None, seq_axis, None, None)
+        vary_axes = (seq_axis,)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                          scale=scale, vary_axes=vary_axes),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

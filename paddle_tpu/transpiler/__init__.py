@@ -1,1 +1,4 @@
-
+"""Program transpilers: distribution + memory optimization."""
+from . import distributed_spliter
+from .distribute_transpiler import DistributeTranspiler, VarBlock, \
+    split_dense_variable, same_or_split_var

@@ -1,0 +1,260 @@
+"""DistributeTranspiler: the pserver-sharded update must be numerically
+identical to the monolithic update, both in the simulated program-rewrite
+path (trainer program + per-endpoint pserver programs) and in the GSPMD
+lowering (parameter_shardings on a ParallelExecutor).
+
+Parity: python/paddle/fluid/tests/unittests/test_dist_transpiler-era behavior.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.transpiler import (DistributeTranspiler, distributed_spliter,
+                                   split_dense_variable, same_or_split_var)
+
+
+def _build(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=64, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9) \
+            .minimize(loss)
+    return main, startup, loss
+
+
+def _data(n=32, seed=3):
+    rng = np.random.RandomState(seed)
+    xs = rng.rand(n, 64).astype("float32")
+    ys = (xs.sum(1, keepdims=True) * 0.05).astype("float32")
+    return xs, ys
+
+
+def test_split_dense_variable_geometry():
+    class V(object):
+        def __init__(self, name, shape):
+            self.name, self.shape = name, shape
+    blocks = split_dense_variable([V("w", (64, 64))], 2, min_block_size=1024)
+    assert len(blocks) == 2
+    assert sum(b.size for b in blocks) == 64 * 64
+    # row alignment: every offset is a multiple of the trailing dim
+    assert all(b.offset % 64 == 0 for b in blocks)
+    # small vars stay whole
+    assert len(split_dense_variable([V("b", (8,))], 4,
+                                    min_block_size=1024)) == 1
+
+
+def test_spliter_policies():
+    eps = ["ps0", "ps1", "ps2"]
+    names = ["a", "b", "c", "d"]
+    rr = distributed_spliter.round_robin(names, eps)
+    assert rr == ["ps0", "ps1", "ps2", "ps0"]
+    h1 = distributed_spliter.hash_name(names, eps)
+    assert h1 == distributed_spliter.hash_name(names, eps)  # deterministic
+    assert set(h1) <= set(eps)
+    assert same_or_split_var("w.block0", "w")
+    assert not same_or_split_var("w2", "w")
+
+
+def test_pserver_simulation_matches_monolithic():
+    xs, ys = _data()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    # -- monolithic baseline ------------------------------------------------
+    main, startup, loss = _build()
+    base_scope = fluid.Scope()
+    with fluid.scope_guard(base_scope):
+        exe.run(startup)
+        init = {n: np.asarray(base_scope.get(n)) for n in base_scope.names()}
+        base_losses = [float(exe.run(main, feed={"x": xs, "y": ys},
+                                     fetch_list=[loss])[0][0])
+                       for _ in range(4)]
+
+    # -- simulated pserver run on an identically-initialized model ----------
+    main2, startup2, loss2 = _build()
+    t = DistributeTranspiler()
+    t.transpile(0, program=main2, pservers="ps0,ps1", trainers=1)
+    # the 64x64 weight splits across both endpoints; bias vars stay whole
+    assert len(t.param_blocks) >= 3
+    assert set(t.eplist) == {"ps0", "ps1"}
+
+    trainer_prog = t.get_trainer_program()
+    assert any(op.type == "send" for op in trainer_prog.global_block().ops)
+    assert not any(op.type == "momentum"
+                   for op in trainer_prog.global_block().ops)
+
+    pserver_progs = {ep: t.get_pserver_program(ep)
+                     for ep in t.pserver_endpoints}
+    for ep, prog in pserver_progs.items():
+        ops = prog.global_block().ops
+        assert ops[-1].type == "listen_and_serv"
+        assert any(op.type == "momentum" for op in ops)
+
+    trainer_scope = fluid.Scope()
+    with fluid.scope_guard(trainer_scope):
+        exe.run(startup2)
+        for n, v in init.items():
+            trainer_scope.set(n, v)
+        trainer_scope._rng_counter = 0
+
+    pserver_scopes = {ep: fluid.Scope() for ep in t.pserver_endpoints}
+    for ep in t.pserver_endpoints:
+        t.scatter_scope(trainer_scope, pserver_scopes[ep], ep,
+                        pserver_progs[ep])
+
+    dist_losses = []
+    grad_names = sorted(set(t.param_grad_map.values()))
+    for _ in range(4):
+        with fluid.scope_guard(trainer_scope):
+            outs = exe.run(trainer_prog, feed={"x": xs, "y": ys},
+                           fetch_list=[loss2.name] + grad_names)
+        dist_losses.append(float(outs[0][0]))
+        grads = dict(zip(grad_names, outs[1:]))
+        # ship grad blocks to their pserver, run its optimize block
+        for ep, prog in pserver_progs.items():
+            feed = {}
+            for blk, e, bid in t._numbered_blocks():
+                if e != ep:
+                    continue
+                g = grads[t.param_grad_map[blk.varname]].reshape(-1)
+                feed["%s.block%d" % (t.param_grad_map[blk.varname], bid)] = \
+                    g[blk.offset:blk.offset + blk.size]
+            fetches = [n for n, v in prog.global_block().vars.items()
+                       if ".block" in n and v.persistable]
+            with fluid.scope_guard(pserver_scopes[ep]):
+                exe.run(prog, feed=feed, fetch_list=fetches)
+        t.gather_scope(pserver_scopes, trainer_scope)
+
+    np.testing.assert_allclose(dist_losses, base_losses, rtol=1e-5, atol=1e-6)
+    assert dist_losses[-1] < dist_losses[0]
+
+
+def test_pserver_adam_scalar_state_not_sliced():
+    """Regression: Adam's Beta1Pow/Beta2Pow (numel 1) must stay replicated
+    scalars on the pserver even when a parameter also has numel 1 (the fc
+    bias) — a numel-based match would freeze them in a dead block copy and
+    silently diverge from step 2 on."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)  # bias has numel 1
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, pservers="ps0,ps1", trainers=1)
+    for ep in t.pserver_endpoints:
+        prog = t.get_pserver_program(ep)
+        for name in prog.global_block().vars:
+            assert not (("beta1_pow" in name or "beta2_pow" in name
+                         or "learning_rate" in name) and ".block" in name), \
+                name
+        # the adam op and its companion must share the SAME beta pow vars
+        ops = prog.global_block().ops
+        adam = [op for op in ops if op.type == "adam"]
+        bump = [op for op in ops if op.type == "adam_beta_pow_update"]
+        if adam and bump:
+            assert adam[0].input("Beta1Pow") == bump[0].input("Beta1Pow")
+
+    # end-to-end: Adam pserver simulation matches monolithic for 3 steps
+    xs, ys = _data()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        init = {n: np.asarray(scope.get(n)) for n in scope.names()}
+        base = [float(exe.run(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])[0][0]) for _ in range(3)]
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main2, startup2):
+        x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss2 = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss2)
+    t2 = DistributeTranspiler()
+    t2.transpile(0, program=main2, pservers="ps0,ps1", trainers=1)
+    trainer_prog = t2.get_trainer_program()
+    pserver_progs = {ep: t2.get_pserver_program(ep)
+                     for ep in t2.pserver_endpoints}
+    tscope = fluid.Scope()
+    with fluid.scope_guard(tscope):
+        exe.run(startup2)
+        for n, v in init.items():
+            tscope.set(n, v)
+        tscope._rng_counter = 0
+    pscopes = {ep: fluid.Scope() for ep in t2.pserver_endpoints}
+    for ep in t2.pserver_endpoints:
+        t2.scatter_scope(tscope, pscopes[ep], ep, pserver_progs[ep])
+    grad_names = sorted(set(t2.param_grad_map.values()))
+    dist = []
+    for _ in range(3):
+        with fluid.scope_guard(tscope):
+            outs = exe.run(trainer_prog, feed={"x": xs, "y": ys},
+                           fetch_list=[loss2.name] + grad_names)
+        dist.append(float(outs[0][0]))
+        grads = dict(zip(grad_names, outs[1:]))
+        for ep, prog in pserver_progs.items():
+            feed = {}
+            for blk, e, bid in t2._numbered_blocks():
+                if e != ep:
+                    continue
+                g = grads[t2.param_grad_map[blk.varname]].reshape(-1)
+                feed["%s.block%d" % (t2.param_grad_map[blk.varname], bid)] = \
+                    g[blk.offset:blk.offset + blk.size]
+            fetches = [n for n, v in prog.global_block().vars.items()
+                       if v.persistable]
+            with fluid.scope_guard(pscopes[ep]):
+                exe.run(prog, feed=feed, fetch_list=fetches)
+        t2.gather_scope(pscopes, tscope)
+    np.testing.assert_allclose(dist, base, rtol=1e-5, atol=1e-6)
+
+
+def test_parameter_shardings_parallel_executor():
+    import jax
+    from paddle_tpu.parallel.mesh import make_mesh
+    assert len(jax.devices()) == 8
+    xs, ys = _data()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    main, startup, loss = _build()
+    s1 = fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        init = {n: np.asarray(s1.get(n)) for n in s1.names()}
+        base = [float(exe.run(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])[0][0]) for _ in range(3)]
+
+    main2, startup2, loss2 = _build()
+    t = DistributeTranspiler()
+    t.transpile(0, program=main2, pservers="ps0,ps1,ps2,ps3", trainers=1,
+                split_method=distributed_spliter.hash_name)
+    mesh = make_mesh({"dp": 8})
+    shardings = t.parameter_shardings(mesh, axis="dp")
+    assert any(s is not None for s in shardings.values())
+    # the split weight's momentum accumulator shards with it
+    w = [p for p in t.param_grad_map if len(t.blocks_of[p]) > 1][0]
+    acc = t.param_update_op[w].input("Velocity")[0]
+    assert acc in shardings
+
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        exe.run(startup2)
+        for n, v in init.items():
+            s2.set(n, v)
+        s2._rng_counter = 0
+        pexe = fluid.ParallelExecutor(main_program=main2,
+                                      loss_name=loss2.name, mesh=mesh,
+                                      param_shardings=shardings)
+        par = [float(pexe.run(fetch_list=[loss2],
+                              feed={"x": xs, "y": ys})[0][0])
+               for _ in range(3)]
+    np.testing.assert_allclose(par, base, rtol=1e-4, atol=1e-5)
