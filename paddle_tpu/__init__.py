@@ -48,6 +48,11 @@ from .parallel.parallel_executor import ParallelExecutor
 from . import transpiler
 from .transpiler import DistributeTranspiler
 from .transpiler import distributed_spliter
+from . import reader
+from .reader import batch
+from . import datasets
+from . import recordio
+from . import recordio_writer
 
 Tensor = LoDTensor
 

@@ -1,0 +1,48 @@
+"""CIFAR-10 / CIFAR-100.
+
+Parity: python/paddle/v2/dataset/cifar.py — train10/test10/train100/test100
+yield (float32[3072] in [0,1], int label). Synthetic fallback: per-class
+color-texture templates + noise (CHW layout like the real pickles).
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100", "convert"]
+
+_TRAIN_N, _TEST_N = common.synthetic_size(1024, 256)
+
+
+def _reader_creator(split_name, n, num_classes):
+    tag = "cifar%d" % num_classes
+
+    def reader():
+        tmpl_rng = common.synthetic_rng(tag, "templates")
+        templates = tmpl_rng.rand(num_classes, 3072).astype(np.float32)
+        rng = common.synthetic_rng(tag, split_name)
+        labels = rng.randint(0, num_classes, n)
+        for lab in labels:
+            img = templates[lab] + rng.randn(3072).astype(np.float32) * 0.25
+            yield np.clip(img, 0.0, 1.0), int(lab)
+    return reader
+
+
+def train10():
+    return _reader_creator("train", _TRAIN_N, 10)
+
+
+def test10():
+    return _reader_creator("test", _TEST_N, 10)
+
+
+def train100():
+    return _reader_creator("train", _TRAIN_N, 100)
+
+
+def test100():
+    return _reader_creator("test", _TEST_N, 100)
+
+
+def convert(path):
+    common.convert(path, train10(), 1000, "cifar_train10")
+    common.convert(path, test10(), 1000, "cifar_test10")

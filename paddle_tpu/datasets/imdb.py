@@ -1,0 +1,59 @@
+"""IMDB movie-review sentiment.
+
+Parity: python/paddle/v2/dataset/imdb.py — build_dict, word_dict,
+train(word_idx)/test(word_idx) yield (word-id sequence, 0/1 label).
+Synthetic fallback: two sentiment-biased unigram distributions over the
+vocabulary, so an LSTM/conv classifier genuinely separates them.
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ["build_dict", "word_dict", "train", "test", "convert"]
+
+_VOCAB = 5148  # matches the book chapter's cutoff-150 dict size era
+_TRAIN_N, _TEST_N = common.synthetic_size(600, 200)
+
+
+def build_dict(pattern=None, cutoff=150):
+    """Vocabulary dict word -> id; '<unk>' is the last id (reference puts
+    <unk> at len(dict))."""
+    d = common.word_dict(_VOCAB - 1)
+    d["<unk>"] = len(d)
+    return d
+
+
+def word_dict():
+    return build_dict()
+
+
+def _reader_creator(split_name, n, word_idx):
+    vocab = len(word_idx)
+
+    def reader():
+        rng = common.synthetic_rng("imdb", split_name)
+        # positive reviews draw from the front of the vocab, negative from
+        # the back; overlap keeps the task non-trivial
+        for i in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 64))
+            if label:
+                ids = rng.randint(0, int(vocab * 0.6), length)
+            else:
+                ids = rng.randint(int(vocab * 0.4), vocab, length)
+            yield ids.astype(np.int64).tolist(), label
+    return reader
+
+
+def train(word_idx):
+    return _reader_creator("train", _TRAIN_N, word_idx)
+
+
+def test(word_idx):
+    return _reader_creator("test", _TEST_N, word_idx)
+
+
+def convert(path):
+    w = word_dict()
+    common.convert(path, train(w), 1000, "imdb_train")
+    common.convert(path, test(w), 1000, "imdb_test")

@@ -1,0 +1,75 @@
+"""imikolov (PTB-style) language-model dataset.
+
+Parity: python/paddle/v2/dataset/imikolov.py — build_dict, train/test with
+DataType.NGRAM ((w0..wn-1) tuples) or DataType.SEQ ((src, trg) shifted
+sequences). Synthetic fallback: a fixed random bigram chain, so N-gram and
+RNN LMs genuinely reduce perplexity.
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ["build_dict", "train", "test", "DataType", "convert"]
+
+_TRAIN_N, _TEST_N = common.synthetic_size(800, 200)
+
+
+class DataType(object):
+    NGRAM = 1
+    SEQ = 2
+
+
+def build_dict(min_word_freq=50):
+    """word -> id; '<s>', '<e>', '<unk>' included (reference semantics)."""
+    d = common.word_dict(2072, extra=("<s>", "<e>", "<unk>"))
+    return d
+
+
+def _sentences(split_name, n, vocab):
+    """Markov-chain sentences: next word depends on current (learnable)."""
+    chain_rng = common.synthetic_rng("imikolov", "chain")
+    # each word has a small successor set
+    succ = chain_rng.randint(3, vocab, size=(vocab, 4))
+    rng = common.synthetic_rng("imikolov", split_name)
+    for _ in range(n):
+        length = int(rng.randint(5, 20))
+        w = int(rng.randint(3, vocab))
+        sent = [w]
+        for _ in range(length - 1):
+            w = int(succ[w, rng.randint(0, 4)])
+            sent.append(w)
+        yield sent
+
+
+def _reader_creator(split_name, n, word_idx, ngram_n, data_type):
+    vocab = len(word_idx)
+
+    def reader():
+        start, end = word_idx["<s>"], word_idx["<e>"]
+        for sent in _sentences(split_name, n, vocab):
+            if data_type == DataType.NGRAM:
+                s = [start] + sent + [end]
+                if len(s) >= ngram_n:
+                    s = np.asarray(s, dtype=np.int64)
+                    for i in range(ngram_n, len(s) + 1):
+                        yield tuple(s[i - ngram_n:i])
+            elif data_type == DataType.SEQ:
+                s = [start] + sent + [end]
+                yield s[:-1], s[1:]
+            else:
+                raise ValueError("Unknown data type %r" % data_type)
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return _reader_creator("train", _TRAIN_N, word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return _reader_creator("test", _TEST_N, word_idx, n, data_type)
+
+
+def convert(path):
+    w = build_dict()
+    common.convert(path, train(w, 5), 1000, "imikolov_train")
+    common.convert(path, test(w, 5), 1000, "imikolov_test")

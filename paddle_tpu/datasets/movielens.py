@@ -1,0 +1,121 @@
+"""MovieLens-1M recommender dataset.
+
+Parity: python/paddle/v2/dataset/movielens.py — train()/test() yield
+(user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+[rating]); plus max_user_id/max_movie_id/max_job_id/age_table and the
+MovieInfo/UserInfo tables. Synthetic fallback: latent-factor ratings
+(user·movie affinity), so the recommender model genuinely learns.
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_movie_title_dict", "max_movie_id",
+           "max_user_id", "max_job_id", "age_table", "movie_categories",
+           "convert", "MovieInfo", "UserInfo"]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_N_USERS = 944       # ml-100k-scale ids, 1-based like the real data
+_N_MOVIES = 1683
+_N_JOBS = 21
+_N_CATEGORIES = 18
+_TITLE_VOCAB = 1024
+_TRAIN_N, _TEST_N = common.synthetic_size(2000, 400)
+
+
+class MovieInfo(object):
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index, [c for c in self.categories],
+                [t for t in self.title]]
+
+
+class UserInfo(object):
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age, self.job_id]
+
+
+def max_user_id():
+    return _N_USERS - 1
+
+
+def max_movie_id():
+    return _N_MOVIES - 1
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {"cat%d" % i: i for i in range(_N_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return common.word_dict(_TITLE_VOCAB)
+
+
+def _tables():
+    rng = common.synthetic_rng("movielens", "tables")
+    movies = {}
+    for mid in range(1, _N_MOVIES):
+        ncat = int(rng.randint(1, 4))
+        cats = rng.choice(_N_CATEGORIES, ncat, replace=False).tolist()
+        tlen = int(rng.randint(1, 6))
+        title = rng.randint(0, _TITLE_VOCAB, tlen).tolist()
+        movies[mid] = MovieInfo(mid, cats, title)
+    users = {}
+    for uid in range(1, _N_USERS):
+        users[uid] = UserInfo(
+            uid, "M" if rng.rand() < 0.5 else "F",
+            age_table[int(rng.randint(0, len(age_table)))],
+            int(rng.randint(0, _N_JOBS)))
+    # latent factors driving ratings
+    uf = rng.randn(_N_USERS, 8).astype(np.float32)
+    mf = rng.randn(_N_MOVIES, 8).astype(np.float32)
+    return users, movies, uf, mf
+
+
+def movie_info():
+    return _tables()[1]
+
+
+def user_info():
+    return _tables()[0]
+
+
+def _reader_creator(split_name, n):
+    def reader():
+        users, movies, uf, mf = _tables()
+        rng = common.synthetic_rng("movielens", split_name)
+        for _ in range(n):
+            uid = int(rng.randint(1, _N_USERS))
+            mid = int(rng.randint(1, _N_MOVIES))
+            raw = float(uf[uid] @ mf[mid]) / 4.0 + rng.randn() * 0.2
+            rating = float(np.clip(np.round(raw + 3.0), 1, 5))
+            yield tuple(users[uid].value() + movies[mid].value() + [[rating]])
+    return reader
+
+
+def train():
+    return _reader_creator("train", _TRAIN_N)
+
+
+def test():
+    return _reader_creator("test", _TEST_N)
+
+
+def convert(path):
+    common.convert(path, train(), 1000, "movielens_train")
+    common.convert(path, test(), 1000, "movielens_test")

@@ -1,0 +1,46 @@
+"""UCI housing regression dataset (506 samples, 13 features).
+
+Parity: python/paddle/v2/dataset/uci_housing.py — train()/test() yield
+(feature_vector[13] float32, [price] float32), features normalized. Synthetic
+fallback: a fixed random linear model + noise, so fit_a_line genuinely
+converges on it.
+"""
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "feature_num", "convert"]
+
+feature_num = 13
+_TRAIN_N, _TEST_N = 404, 102  # the real 80/20 split of 506
+
+
+def _make(split_name, n):
+    rng = common.synthetic_rng("uci_housing", "model")  # shared true model
+    w = rng.randn(feature_num).astype(np.float32)
+    b = np.float32(rng.randn() * 2)
+    rng = common.synthetic_rng("uci_housing", split_name)
+    xs = rng.randn(n, feature_num).astype(np.float32)
+    ys = xs @ w + b + rng.randn(n).astype(np.float32) * 0.1
+    return xs, ys.astype(np.float32)
+
+
+def _reader_creator(split_name, n):
+    def reader():
+        xs, ys = _make(split_name, n)
+        for x, y in zip(xs, ys):
+            yield x, np.array([y], dtype=np.float32)
+    return reader
+
+
+def train():
+    return _reader_creator("train", _TRAIN_N)
+
+
+def test():
+    return _reader_creator("test", _TEST_N)
+
+
+def convert(path):
+    common.convert(path, train(), 1000, "uci_housing_train")
+    common.convert(path, test(), 1000, "uci_housing_test")

@@ -1,1 +1,30 @@
+"""Native (C++) runtime pieces, loaded via ctypes.
 
+Parity: the reference keeps its data path native (paddle/fluid/recordio/*.cc);
+so do we. Libraries build lazily on first use (`make` + g++); every consumer
+has a pure-Python fallback so the framework works without a toolchain.
+"""
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIBS = {}
+
+
+def load_library(name, make_target=None):
+    """dlopen lib<name>.so from this directory, building it via make if
+    missing. Returns None (caller falls back to Python) on any failure."""
+    if name in _LIBS:
+        return _LIBS[name]
+    path = os.path.join(_DIR, "lib%s.so" % name)
+    lib = None
+    try:
+        if not os.path.exists(path):
+            subprocess.run(["make", "-C", _DIR, make_target or "all"],
+                           check=True, capture_output=True, timeout=120)
+        lib = ctypes.CDLL(path)
+    except Exception:
+        lib = None
+    _LIBS[name] = lib
+    return lib

@@ -73,8 +73,8 @@ def split_dense_variable(var_list, service_count, min_block_size=1024,
         numel = int(np.prod(var.shape))
         split_count = service_count
         block_size = (numel + split_count - 1) // split_count
-        if max_block_size > numel > min_block_size:
-            block_size = max(block_size, min_block_size)
+        # never split below min_block_size (fewer, larger blocks instead)
+        block_size = max(block_size, min_block_size)
         # align to whole rows so optimizer slices keep row semantics
         if len(var.shape) >= 2:
             dim1 = int(np.prod(var.shape[1:]))

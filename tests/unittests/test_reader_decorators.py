@@ -1,0 +1,210 @@
+"""Reader decorators (parity: python/paddle/v2/reader/tests/decorator_test
+.py behaviors) + dataset smoke: every dataset module yields records of the
+documented shape, deterministically."""
+import numpy as np
+import pytest
+
+from paddle_tpu import reader
+from paddle_tpu import datasets
+
+
+def _range_reader(n):
+    return lambda: iter(range(n))
+
+
+def test_map_readers():
+    r = reader.map_readers(lambda a, b: a + b, _range_reader(5),
+                           _range_reader(5))
+    assert list(r()) == [0, 2, 4, 6, 8]
+
+
+def test_shuffle_is_permutation():
+    r = reader.shuffle(_range_reader(20), 7)
+    out = list(r())
+    assert sorted(out) == list(range(20))
+
+
+def test_chain_and_firstn():
+    r = reader.chain(_range_reader(3), _range_reader(2))
+    assert list(r()) == [0, 1, 2, 0, 1]
+    assert list(reader.firstn(_range_reader(100), 4)()) == [0, 1, 2, 3]
+
+
+def test_compose():
+    r = reader.compose(_range_reader(3),
+                       lambda: iter([(10, 11), (20, 21), (30, 31)]))
+    assert list(r()) == [(0, 10, 11), (1, 20, 21), (2, 30, 31)]
+    misaligned = reader.compose(_range_reader(3), _range_reader(4))
+    with pytest.raises(reader.ComposeNotAligned):
+        list(misaligned())
+    ok = reader.compose(_range_reader(3), _range_reader(4),
+                        check_alignment=False)
+    assert len(list(ok())) == 3
+
+
+def test_buffered_preserves_order():
+    assert list(reader.buffered(_range_reader(50), 8)()) == list(range(50))
+
+
+def test_xmap_readers():
+    out = list(reader.xmap_readers(lambda x: x * 2, _range_reader(30),
+                                   3, 5)())
+    assert sorted(out) == [2 * i for i in range(30)]
+    ordered = list(reader.xmap_readers(lambda x: x * 2, _range_reader(30),
+                                       3, 5, order=True)())
+    assert ordered == [2 * i for i in range(30)]
+
+
+def test_batch():
+    bs = list(reader.batch(_range_reader(7), 3)())
+    assert bs == [[0, 1, 2], [3, 4, 5], [6]]
+    bs = list(reader.batch(_range_reader(7), 3, drop_last=True)())
+    assert bs == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_buffered_propagates_errors():
+    def bad():
+        yield 1
+        yield 2
+        raise ValueError("boom")
+    out = []
+    with pytest.raises(ValueError, match="boom"):
+        for x in reader.buffered(bad, 4)():
+            out.append(x)
+    assert out == [1, 2]
+
+
+def test_xmap_propagates_mapper_errors():
+    def mapper(x):
+        if x == 5:
+            raise RuntimeError("mapper died")
+        return x
+    with pytest.raises(RuntimeError, match="mapper died"):
+        list(reader.xmap_readers(mapper, _range_reader(10), 2, 4)())
+
+
+def test_split_dense_min_block_floor():
+    from paddle_tpu.transpiler import split_dense_variable
+
+    class V(object):
+        def __init__(self, name, shape):
+            self.name, self.shape = name, shape
+    blocks = split_dense_variable([V("w", (2_000_000,))], 4096,
+                                  min_block_size=1024)
+    assert all(b.size >= 1024 for b in blocks[:-1])
+    assert sum(b.size for b in blocks) == 2_000_000
+
+
+def test_recordio_chunking_parity(tmp_path):
+    from paddle_tpu import recordio
+    from paddle_tpu.native import load_library
+    if load_library("recordio") is None:
+        pytest.skip("no native toolchain")
+    recs = [b"abcd"] * 2000
+    p1, p2 = str(tmp_path / "n.rio"), str(tmp_path / "p.rio")
+    kw = dict(max_num_records=100000, max_chunk_bytes=4096)
+    recordio.write_records(p1, recs, use_native=True, **kw)
+    recordio.write_records(p2, recs, use_native=False, **kw)
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+
+
+# ---------------------------------------------------------------- datasets
+
+def test_uci_housing():
+    s = next(iter(datasets.uci_housing.train()()))
+    assert s[0].shape == (13,) and s[1].shape == (1,)
+    # deterministic across calls
+    s2 = next(iter(datasets.uci_housing.train()()))
+    np.testing.assert_array_equal(s[0], s2[0])
+
+
+def test_mnist():
+    img, lab = next(iter(datasets.mnist.train()()))
+    assert img.shape == (784,) and img.min() >= -1 and img.max() <= 1
+    assert 0 <= lab < 10
+
+
+def test_cifar():
+    img, lab = next(iter(datasets.cifar.train10()()))
+    assert img.shape == (3072,) and 0 <= lab < 10
+    img, lab = next(iter(datasets.cifar.test100()()))
+    assert 0 <= lab < 100
+
+
+def test_imdb():
+    w = datasets.imdb.word_dict()
+    doc, label = next(iter(datasets.imdb.train(w)()))
+    assert all(0 <= t < len(w) for t in doc) and label in (0, 1)
+
+
+def test_imikolov():
+    w = datasets.imikolov.build_dict()
+    gram = next(iter(datasets.imikolov.train(w, 5)()))
+    assert len(gram) == 5
+    src, trg = next(iter(datasets.imikolov.train(
+        w, 5, datasets.imikolov.DataType.SEQ)()))
+    assert src[1:] == trg[:-1]
+
+
+def test_movielens():
+    s = next(iter(datasets.movielens.train()()))
+    uid, gender, age, job, mid, cats, title, rating = s
+    assert 1 <= uid <= datasets.movielens.max_user_id()
+    assert gender in (0, 1) and 0 <= age < len(datasets.movielens.age_table)
+    assert isinstance(cats, list) and isinstance(title, list)
+    assert 1.0 <= rating[0] <= 5.0
+
+
+def test_conll05():
+    w, v, l = datasets.conll05.get_dict()
+    rec = next(iter(datasets.conll05.test()()))
+    assert len(rec) == 9
+    lens = {len(f) for f in rec}
+    assert len(lens) == 1  # all 9 sequences aligned
+    assert all(x < len(l) for x in rec[8])
+    emb = datasets.conll05.get_embedding()
+    assert emb.shape == (len(w), 32)
+
+
+def test_wmt():
+    src, trg, nxt = next(iter(datasets.wmt14.train(1000)()))
+    assert trg[0] == 0 and nxt[-1] == 1 and trg[1:] == nxt[:-1]
+    src, trg, nxt = next(iter(datasets.wmt16.train(800, 900, "de")()))
+    assert trg[1:] == nxt[:-1]
+
+
+def test_mq2007():
+    rel, feat = next(iter(datasets.mq2007.train("pointwise")()))
+    assert feat.shape == (46,) and rel in (0, 1, 2)
+    lab, hi, lo = next(iter(datasets.mq2007.train("pairwise")()))
+    assert hi.shape == lo.shape == (46,)
+    rels, feats = next(iter(datasets.mq2007.train("listwise")()))
+    assert feats.shape[1] == 46 and len(rels) == feats.shape[0]
+
+
+def test_sentiment():
+    doc, label = next(iter(datasets.sentiment.train()()))
+    assert label in (0, 1)
+
+
+def test_flowers_and_voc():
+    img, lab = next(iter(datasets.flowers.train()()))
+    assert img.shape == (3 * 224 * 224,) and 0 <= lab < 102
+    mapped = datasets.flowers.train(mapper=lambda s: (s[0] * 2, s[1]))
+    img2, _ = next(iter(mapped()))
+    np.testing.assert_allclose(img2[:9], img[:9] * 2)
+    img, mask = next(iter(datasets.voc2012.train()()))
+    assert img.shape[0] == 3 and mask.shape == img.shape[1:]
+    assert mask.max() < 21
+
+
+def test_dataset_convert_roundtrip(tmp_path):
+    from paddle_tpu import recordio_writer
+    datasets.common.convert(str(tmp_path), datasets.uci_housing.test(),
+                            50, "uci_test")
+    import glob
+    shards = sorted(glob.glob(str(tmp_path / "uci_test-*.recordio")))
+    assert len(shards) >= 2  # 102 samples / 50 per shard
+    total = sum(len(list(recordio_writer.recordio_reader(s)()))
+                for s in shards)
+    assert total == 102
