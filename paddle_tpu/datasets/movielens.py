@@ -66,7 +66,15 @@ def get_movie_title_dict():
     return common.word_dict(_TITLE_VOCAB)
 
 
+_TABLES_CACHE = None
+
+
 def _tables():
+    # memoized like the reference's module-global MOVIE_INFO/USER_INFO
+    # (python/paddle/v2/dataset/movielens.py __initialize_meta_info__)
+    global _TABLES_CACHE
+    if _TABLES_CACHE is not None:
+        return _TABLES_CACHE
     rng = common.synthetic_rng("movielens", "tables")
     movies = {}
     for mid in range(1, _N_MOVIES):
@@ -84,7 +92,8 @@ def _tables():
     # latent factors driving ratings
     uf = rng.randn(_N_USERS, 8).astype(np.float32)
     mf = rng.randn(_N_MOVIES, 8).astype(np.float32)
-    return users, movies, uf, mf
+    _TABLES_CACHE = (users, movies, uf, mf)
+    return _TABLES_CACHE
 
 
 def movie_info():

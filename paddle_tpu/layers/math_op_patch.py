@@ -55,6 +55,7 @@ def monkey_patch_variable():
     Variable.__rdiv__ = _elementwise_method("elementwise_div", reverse=True)
     Variable.__rtruediv__ = Variable.__rdiv__
     Variable.__pow__ = _elementwise_method("elementwise_pow")
+    Variable.__rpow__ = _elementwise_method("elementwise_pow", reverse=True)
     Variable.__neg__ = lambda self: self * (-1.0)
     Variable.__lt__ = _compare_method("less_than")
     Variable.__le__ = _compare_method("less_equal")

@@ -60,13 +60,14 @@ class VarBlock(object):
         return "%s:%d:%d" % (self.varname, self.offset, self.size)
 
 
-def split_dense_variable(var_list, service_count, min_block_size=1024,
-                         max_block_size=1048576):
+def split_dense_variable(var_list, service_count, min_block_size=1024):
     """Split each variable into roughly service_count aligned blocks.
 
     Same contract as the reference's split_dense_variable: variables smaller
     than min_block_size stay whole; otherwise aim for one block per service,
     each a multiple of the trailing-dim size so slices stay row-aligned.
+    (The reference's max_block_size cap is dropped: blocks here are sharding
+    metadata, not RPC payloads, so there is no upper size constraint.)
     """
     blocks = []
     for var in var_list:

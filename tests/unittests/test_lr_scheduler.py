@@ -1,0 +1,142 @@
+"""LR decay schedules vs closed-form numpy, over several executor steps.
+
+Parity: reference tests/unittests/test_learning_rate_decay.py — run the
+program N times, compare the fetched lr against the python formula at each
+step.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def exponential(step, lr, decay_steps, decay_rate, staircase):
+    d = step / decay_steps
+    if staircase:
+        d = math.floor(d)
+    return lr * decay_rate ** d
+
+
+def natural_exp(step, lr, decay_steps, decay_rate, staircase):
+    d = step / decay_steps
+    if staircase:
+        d = math.floor(d)
+    return lr * math.exp(-decay_rate * d)
+
+
+def inverse_time(step, lr, decay_steps, decay_rate, staircase):
+    d = step / decay_steps
+    if staircase:
+        d = math.floor(d)
+    return lr / (1 + decay_rate * d)
+
+
+def polynomial(step, lr, decay_steps, end_lr, power, cycle):
+    if cycle:
+        div = math.ceil(step / decay_steps)
+        if step == 0:
+            div = 1
+        decay_steps = decay_steps * div
+    else:
+        step = min(step, decay_steps)
+    return (lr - end_lr) * ((1 - step / decay_steps) ** power) + end_lr
+
+
+def piecewise(step, boundaries, values):
+    for b, v in zip(boundaries, values):
+        if step < b:
+            return v
+    return values[-1]
+
+
+def _run_schedule(build_fn, expect_fn, steps=10):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        lr = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in range(steps):
+            got, = exe.run(main, feed={}, fetch_list=[lr])
+            want = expect_fn(step)
+            np.testing.assert_allclose(
+                np.asarray(got).ravel()[0], want, rtol=1e-5,
+                err_msg="step %d" % step)
+
+
+@pytest.mark.parametrize("staircase", [False, True])
+def test_exponential_decay(staircase):
+    _run_schedule(
+        lambda: fluid.layers.exponential_decay(1.0, 5, 0.5, staircase),
+        lambda s: exponential(s, 1.0, 5, 0.5, staircase))
+
+
+@pytest.mark.parametrize("staircase", [False, True])
+def test_natural_exp_decay(staircase):
+    _run_schedule(
+        lambda: fluid.layers.natural_exp_decay(1.0, 5, 0.5, staircase),
+        lambda s: natural_exp(s, 1.0, 5, 0.5, staircase))
+
+
+@pytest.mark.parametrize("staircase", [False, True])
+def test_inverse_time_decay(staircase):
+    _run_schedule(
+        lambda: fluid.layers.inverse_time_decay(1.0, 5, 0.5, staircase),
+        lambda s: inverse_time(s, 1.0, 5, 0.5, staircase))
+
+
+@pytest.mark.parametrize("cycle", [False, True])
+def test_polynomial_decay(cycle):
+    _run_schedule(
+        lambda: fluid.layers.polynomial_decay(1.0, 5, 0.01, 2.0, cycle),
+        lambda s: polynomial(s, 1.0, 5, 0.01, 2.0, cycle))
+
+
+def test_piecewise_decay():
+    boundaries = [3, 6, 9]
+    values = [1.0, 0.5, 0.25, 0.1]
+    _run_schedule(
+        lambda: fluid.layers.piecewise_decay(boundaries, values),
+        lambda s: piecewise(s, boundaries, values), steps=12)
+
+
+def test_noam_decay():
+    d_model, warmup = 64, 4
+    def expect(step):
+        s = step + 1  # noam counts from 1
+        return (d_model ** -0.5) * min(s ** -0.5, s * warmup ** -1.5)
+    _run_schedule(
+        lambda: fluid.layers.noam_decay(d_model, warmup),
+        expect)
+
+
+def test_decayed_lr_drives_optimizer():
+    """SGD with exponential_decay: param delta shrinks as lr decays."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(y)
+        lr = fluid.layers.exponential_decay(0.1, 1, 0.5)
+        opt = fluid.optimizer.SGD(learning_rate=lr)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xs = np.ones((2, 4), dtype="float32")
+    w_name = main.global_block().all_parameters()[0].name
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        lrs, ws = [], [np.array(scope.find_var(w_name).get_tensor())]
+        for _ in range(3):
+            got, = exe.run(main, feed={"x": xs}, fetch_list=[lr])
+            lrs.append(float(np.asarray(got).ravel()[0]))
+            ws.append(np.array(scope.find_var(w_name).get_tensor()))
+    np.testing.assert_allclose(lrs, [0.1, 0.05, 0.025], rtol=1e-6)
+    # grad is constant (mean of fc over constant input), so each update's
+    # step size is proportional to the decayed lr: deltas halve every step
+    deltas = [np.abs(ws[i + 1] - ws[i]).sum() for i in range(3)]
+    np.testing.assert_allclose(deltas[1] / deltas[0], 0.5, rtol=1e-4)
+    np.testing.assert_allclose(deltas[2] / deltas[1], 0.5, rtol=1e-4)
