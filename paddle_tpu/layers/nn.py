@@ -21,7 +21,8 @@ __all__ = [
     "reduce_prod", "split", "l2_normalize", "cos_sim", "dropout",
     "smooth_l1", "autoincreased_step_counter", "transpose", "im2sequence",
     "multiplex", "label_smooth", "nce", "lrn", "maxout", "relu", "log",
-    "expand", "sequence_mask",
+    "expand", "sequence_mask", "linear_chain_crf", "crf_decoding",
+    "chunk_eval",
 ]
 
 
@@ -605,3 +606,92 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         out.shape = (x.shape[0], m)
     out.stop_gradient = True
     return out
+
+
+def _crf_seq_len(helper, x):
+    from .sequence import _seq_len
+    return _seq_len(helper, x)
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """Linear-chain CRF negative log-likelihood, one cost per sequence.
+
+    Parity: fluid.layers.linear_chain_crf (reference nn.py:786) over
+    linear_chain_crf_op.h. Creates the [size+2, size] transition parameter
+    (row 0 start, row 1 end, rows 2.. tag->tag); returns LogLikelihood
+    [num_seqs, 1]. The reference's Alpha/EmissionExps/TransitionExps
+    outputs existed only to feed the hand-written grad kernel and have no
+    equivalent here (jax.vjp re-derives the backward pass).
+    """
+    helper = LayerHelper("linear_chain_crf", **locals())
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size],
+        dtype=helper.input_dtype())
+    ll = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Transition": [transition],
+                "Label": [label], "XLen": [_crf_seq_len(helper, input)]},
+        outputs={"LogLikelihood": [ll]})
+    ll.lod_level = 0
+    ll.seq_len_var = None
+    ll.shape = (-1, 1)
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decode with the trained CRF transitions.
+
+    Parity: fluid.layers.crf_decoding (reference nn.py:812) over
+    crf_decoding_op.h. Without label: the best tag path (sequence, int64).
+    With label: per-token 1/0 correctness indicators.
+    """
+    helper = LayerHelper("crf_decoding", **locals())
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size],
+        dtype=helper.input_dtype())
+    path = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": [input], "Transition": [transition],
+              "XLen": [_crf_seq_len(helper, input)]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [path]})
+    path.stop_gradient = True
+    return path
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """Chunk-level precision/recall/F1 (IOB/IOE/IOBES/plain schemes).
+
+    Parity: fluid.layers.chunk_eval (reference nn.py:1014) over
+    chunk_eval_op.h; label encodes (chunk_type, tag) as
+    chunk_type * num_tag_types + tag.
+    """
+    helper = LayerHelper("chunk_eval", **locals())
+    precision = helper.create_variable_for_type_inference("float32")
+    recall = helper.create_variable_for_type_inference("float32")
+    f1_score = helper.create_variable_for_type_inference("float32")
+    num_infer = helper.create_variable_for_type_inference("int64")
+    num_label = helper.create_variable_for_type_inference("int64")
+    num_correct = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label],
+                "XLen": [_crf_seq_len(helper, input)]},
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1_score], "NumInferChunks": [num_infer],
+                 "NumLabelChunks": [num_label],
+                 "NumCorrectChunks": [num_correct]},
+        attrs={"num_chunk_types": num_chunk_types,
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    for v in (precision, recall, f1_score, num_infer, num_label, num_correct):
+        v.lod_level = 0
+        v.seq_len_var = None
+        v.shape = (1,)
+        v.stop_gradient = True
+    return (precision, recall, f1_score, num_infer, num_label, num_correct)

@@ -4,3 +4,4 @@ from . import nn_ops     # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import sequence_ops   # noqa: F401
 from . import control_ops    # noqa: F401
+from . import crf_ops        # noqa: F401

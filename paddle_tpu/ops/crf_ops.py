@@ -1,0 +1,251 @@
+"""Linear-chain CRF ops: forward NLL, Viterbi decode, chunk evaluation.
+
+Parity: paddle/fluid/operators/{linear_chain_crf_op,crf_decoding_op,
+chunk_eval_op}.h. The reference walks each sequence host-side with
+nested per-tag loops; here everything is a batched `lax.scan` over the
+padded-dense layout ([B, T, D] + XLen), so the whole batch's DP runs as
+one fused XLA loop on device and the gradient of the forward NLL comes
+from jax.vjp instead of the hand-written LinearChainCRFGradOpKernel.
+
+Transition layout (linear_chain_crf_op.h:150-162): Transition is
+[D+2, D]; row 0 = start weights, row 1 = end weights, rows 2.. =
+w[2+j, i] = score of tag j -> tag i. LogLikelihood output is the
+per-sequence negative log likelihood [num_seqs, 1] (the reference
+returns -(score - logZ); linear_chain_crf_op.h:194).
+
+The reference computes in exp space with per-step L1 renormalization to
+avoid under/overflow (NormalizeL1 at linear_chain_crf_op.h:167); in log
+space logsumexp gives the same numerics without the trick.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register, single
+
+
+def _split_transition(w):
+    return w[0], w[1], w[2:]  # start [D], end [D], trans [D, D] (j -> i)
+
+
+def _squeeze_label(label):
+    if label.ndim == 3:
+        label = label.reshape(label.shape[0], label.shape[1])
+    return label.astype(jnp.int32)
+
+
+@register("linear_chain_crf")
+def _linear_chain_crf(ctx, ins, attrs):
+    x = single(ins, "Emission")       # [B, T, D]
+    w = single(ins, "Transition")     # [D+2, D]
+    label = _squeeze_label(single(ins, "Label"))  # [B, T]
+    xlen = single(ins, "XLen").astype(jnp.int32)  # [B]
+    b_, t_, d = x.shape
+    start, end, trans = _split_transition(w)
+    tmask = (jnp.arange(t_, dtype=jnp.int32)[None, :] < xlen[:, None])
+
+    # ---- log partition via forward algorithm ----
+    alpha0 = start[None, :] + x[:, 0]                       # [B, D]
+
+    def fwd(alpha, inp):
+        xk, mk = inp                                        # [B, D], [B]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) + xk
+        return jnp.where(mk[:, None], nxt, alpha), None
+
+    if t_ > 1:
+        xs = jnp.moveaxis(x[:, 1:], 1, 0)                   # [T-1, B, D]
+        ms = jnp.moveaxis(tmask[:, 1:], 1, 0)               # [T-1, B]
+        alpha, _ = lax.scan(fwd, alpha0, (xs, ms))
+    else:
+        alpha = alpha0
+    log_z = jax.nn.logsumexp(alpha + end[None, :], axis=1)  # [B]
+
+    # ---- gold path score ----
+    emit = jnp.take_along_axis(x, label[:, :, None], axis=2)[:, :, 0]
+    emit_score = jnp.sum(emit * tmask, axis=1)
+    tr = trans[label[:, :-1], label[:, 1:]] if t_ > 1 else jnp.zeros((b_, 0))
+    trans_score = jnp.sum(tr * tmask[:, 1:], axis=1)
+    last = jnp.maximum(xlen - 1, 0)
+    last_label = jnp.take_along_axis(label, last[:, None], axis=1)[:, 0]
+    score = start[label[:, 0]] + emit_score + trans_score + end[last_label]
+
+    nll = jnp.where(xlen > 0, log_z - score, 0.0)
+    return {"LogLikelihood": [nll[:, None].astype(x.dtype)]}
+
+
+@register("crf_decoding")
+def _crf_decoding(ctx, ins, attrs):
+    x = single(ins, "Emission")      # [B, T, D]
+    w = single(ins, "Transition")    # [D+2, D]
+    xlen = single(ins, "XLen").astype(jnp.int32)
+    label = ins.get("Label")
+    b_, t_, d = x.shape
+    start, end, trans = _split_transition(w)
+    tmask = (jnp.arange(t_, dtype=jnp.int32)[None, :] < xlen[:, None])
+
+    # Viterbi forward: alpha[k, i] = best score ending at tag i; track argmax.
+    alpha0 = start[None, :] + x[:, 0]
+
+    def fwd(alpha, inp):
+        xk, mk = inp
+        scores = alpha[:, :, None] + trans[None]            # [B, j, i]
+        best = jnp.max(scores, axis=1) + xk
+        track = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        alpha = jnp.where(mk[:, None], best, alpha)
+        return alpha, track
+
+    if t_ > 1:
+        xs = jnp.moveaxis(x[:, 1:], 1, 0)
+        ms = jnp.moveaxis(tmask[:, 1:], 1, 0)
+        alpha, tracks = lax.scan(fwd, alpha0, (xs, ms))     # tracks [T-1,B,D]
+    else:
+        alpha = alpha0
+        tracks = jnp.zeros((0, b_, d), jnp.int32)
+
+    best_last = jnp.argmax(alpha + end[None, :], axis=1).astype(jnp.int32)
+
+    # backtrack from each sequence's true last position. Walking k=T-2..0:
+    # if position k+1 is within the sequence, follow the tracked argmax;
+    # at k+1 == len-1 the path restarts from best_last.
+    def bwd(cur, inp):
+        track_k, k = inp                                    # [B, D], scalar
+        is_last = (k + 1) == xlen - 1
+        nxt = jnp.where(is_last, best_last, cur)
+        prev = jnp.take_along_axis(track_k, nxt[:, None], axis=1)[:, 0]
+        in_seq = (k + 1) <= xlen - 1
+        out_k = jnp.where(in_seq, prev, 0)
+        return out_k, out_k
+
+    if t_ > 1:
+        ks = jnp.arange(t_ - 2, -1, -1, dtype=jnp.int32)
+        init = jnp.where(xlen - 1 == t_ - 1, best_last, 0)
+        _, rev_path = lax.scan(bwd, init, (tracks[::-1], ks))
+        path_head = rev_path[::-1]                          # [T-1, B]
+        path = jnp.concatenate(
+            [jnp.moveaxis(path_head, 0, 1),
+             jnp.zeros((b_, 1), jnp.int32)], axis=1)
+        # position len-1 of each row holds best_last
+        path = jnp.where(jnp.arange(t_)[None, :] == (xlen - 1)[:, None],
+                         best_last[:, None], path)
+    else:
+        path = best_last[:, None]
+    path = jnp.where(tmask, path, 0)
+
+    if label:
+        lbl = _squeeze_label(label[0])
+        out = jnp.where(tmask, (lbl == path).astype(jnp.int32), 0)
+        return {"ViterbiPath": [out.astype(jnp.int64)]}
+    return {"ViterbiPath": [path.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval (chunk_eval_op.h GetSegments/ChunkBegin/ChunkEnd, vectorized)
+# ---------------------------------------------------------------------------
+
+_SCHEMES = {
+    # scheme: (num_tag_types, begin, inside, end, single); -1 = absent
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_flags(label, valid, num_chunk_types, scheme):
+    """begin[i], next_end[i] per position, vectorized.
+
+    The reference's stateful walk satisfies the invariant
+    in_chunk[i] == (type[i] != other) for every label sequence, which makes
+    ChunkBegin/ChunkEnd pure functions of consecutive (tag, type) pairs.
+    """
+    num_tag, tag_b, tag_i, tag_e, tag_s = _SCHEMES[scheme]
+    other = num_chunk_types
+    tag = label % num_tag
+    typ = jnp.where(valid, label // num_tag, other)
+    b_, t_ = label.shape
+
+    prev_tag = jnp.concatenate([jnp.full((b_, 1), -1, tag.dtype),
+                                tag[:, :-1]], axis=1)
+    prev_typ = jnp.concatenate([jnp.full((b_, 1), other, typ.dtype),
+                                typ[:, :-1]], axis=1)
+
+    def chunk_begin(ptag, ptyp, tag, typ):
+        res = jnp.where(
+            ptyp == other, typ != other,
+            jnp.where(
+                typ == other, False,
+                jnp.where(
+                    typ != ptyp, True,
+                    (tag == tag_b) | (tag == tag_s) |
+                    (((tag == tag_i) | (tag == tag_e)) &
+                     ((ptag == tag_e) | (ptag == tag_s))))))
+        return res & (typ != other)
+
+    def chunk_end(ptag, ptyp, tag, typ):
+        # "does a chunk open at i-1 close before i": reference ChunkEnd
+        return jnp.where(
+            ptyp == other, False,
+            jnp.where(
+                typ == other, True,
+                jnp.where(
+                    typ != ptyp, True,
+                    jnp.where(
+                        (ptag == tag_b) | (ptag == tag_i),
+                        (tag == tag_b) | (tag == tag_s),
+                        (ptag == tag_e) | (ptag == tag_s)))))
+
+    begin = chunk_begin(prev_tag, prev_typ, tag, typ) & valid
+    # end_at[i]: position i is the last token of a chunk
+    nxt_tag = jnp.concatenate([tag[:, 1:],
+                               jnp.full((b_, 1), -1, tag.dtype)], axis=1)
+    nxt_typ = jnp.concatenate([typ[:, 1:],
+                               jnp.full((b_, 1), other, typ.dtype)], axis=1)
+    end_at = (typ != other) & chunk_end(tag, typ, nxt_tag, nxt_typ) & valid
+
+    # next_end[i] = first j >= i with end_at[j] (reverse cumulative min)
+    idx = jnp.arange(t_, dtype=jnp.int32)[None, :]
+    cand = jnp.where(end_at, idx, t_ + 1)
+    next_end = lax.cummin(cand[:, ::-1], axis=1)[:, ::-1]
+    return begin, next_end, typ
+
+
+@register("chunk_eval")
+def _chunk_eval(ctx, ins, attrs):
+    inference = _squeeze_label(single(ins, "Inference"))  # [B, T]
+    label = _squeeze_label(single(ins, "Label"))
+    xlen = single(ins, "XLen").astype(jnp.int32)
+    num_chunk_types = int(attrs["num_chunk_types"])
+    scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = list(attrs.get("excluded_chunk_types", []) or [])
+    t_ = label.shape[1]
+    valid = (jnp.arange(t_, dtype=jnp.int32)[None, :] < xlen[:, None])
+
+    beg_l, end_l, typ_l = _chunk_flags(label, valid, num_chunk_types, scheme)
+    beg_i, end_i, typ_i = _chunk_flags(inference, valid, num_chunk_types,
+                                       scheme)
+
+    def included(typ):
+        inc = jnp.ones(typ.shape, bool)
+        for e in excluded:
+            inc &= typ != e
+        return inc
+
+    n_label = jnp.sum((beg_l & included(typ_l)).astype(jnp.int64))
+    n_infer = jnp.sum((beg_i & included(typ_i)).astype(jnp.int64))
+    correct = (beg_l & beg_i & (typ_l == typ_i) & (end_l == end_i) &
+               included(typ_l))
+    n_correct = jnp.sum(correct.astype(jnp.int64))
+
+    nc = n_correct.astype(jnp.float32)
+    precision = jnp.where(n_infer > 0, nc / n_infer, 0.0)
+    recall = jnp.where(n_label > 0, nc / n_label, 0.0)
+    f1 = jnp.where(n_correct > 0,
+                   2 * precision * recall / (precision + recall), 0.0)
+    return {"Precision": [precision.reshape(1)],
+            "Recall": [recall.reshape(1)],
+            "F1-Score": [f1.reshape(1)],
+            "NumInferChunks": [n_infer.reshape(1)],
+            "NumLabelChunks": [n_label.reshape(1)],
+            "NumCorrectChunks": [n_correct.reshape(1)]}

@@ -1,0 +1,83 @@
+"""Book: label_semantic_roles (db-lstm + CRF) convergence smoke.
+
+Parity: python/paddle/fluid/tests/book/test_label_semantic_roles.py —
+tiny dims, synthetic conll05 records, CRF NLL must drop and chunk F1
+must be computable.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+from paddle_tpu.datasets import conll05
+from paddle_tpu.models import label_semantic_roles
+
+WORD_DICT, VERB_DICT, LABEL_DICT = 60, 8, 9
+
+
+def synth_batch(rng, n=8):
+    """Labels depend deterministically on words so the CRF can learn."""
+    word2label = (np.arange(WORD_DICT) % LABEL_DICT)
+    cols = [[] for _ in range(9)]
+    for _ in range(n):
+        length = rng.randint(3, 8)
+        words = rng.randint(0, WORD_DICT, length)
+        pred_pos = rng.randint(0, length)
+        verb = rng.randint(0, VERB_DICT)
+        mark = np.zeros(length, dtype="int64")
+        mark[pred_pos] = 1
+
+        def ctx(off):
+            i = min(max(pred_pos + off, 0), length - 1)
+            return np.full(length, words[i], dtype="int64")
+
+        seqs = [words, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                np.full(length, verb, dtype="int64"), mark,
+                word2label[words]]
+        for c, s in zip(cols, seqs):
+            c.append(np.asarray(s, dtype="int64").reshape(-1, 1))
+    return [LoDTensor.from_sequences(c) for c in cols]
+
+
+def test_srl_crf_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        feed_names, avg_cost, crf_decode, chunk = \
+            label_semantic_roles.build_train(
+                word_dict_len=WORD_DICT, label_dict_len=LABEL_DICT,
+                pred_dict_len=VERB_DICT, word_dim=16, mark_dim=4,
+                hidden_dim=16, depth=2, lr=0.03, mix_hidden_lr=1.0)
+    precision, recall, f1 = chunk[:3]
+
+    rng = np.random.RandomState(11)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # book parity: the reference loads a pretrained word embedding into
+        # the frozen 'emb' parameter after startup (load_parameter in
+        # test_label_semantic_roles.py). Here "pretrained" = label-informative.
+        word2label = np.arange(WORD_DICT) % LABEL_DICT
+        emb = 0.1 * np.random.RandomState(1).randn(WORD_DICT, 16).astype("f")
+        emb[np.arange(WORD_DICT), word2label] += 2.0
+        scope.find_var("emb").set(emb)
+        losses, f1s = [], []
+        for i in range(120):
+            batch = synth_batch(rng)
+            feed = dict(zip(feed_names, batch))
+            loss, f1v = exe.run(main, feed=feed, fetch_list=[avg_cost, f1])
+            losses.append(float(np.ravel(loss)[0]))
+            f1s.append(float(np.ravel(f1v)[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-10:]) < 0.2 * np.mean(losses[:10]), \
+        losses[::10]
+    assert f1s[-1] > f1s[0]  # chunk F1 improves as the CRF learns
+
+
+def test_srl_dataset_shapes():
+    """conll05 synthetic records have the 9-column book layout."""
+    sample = next(conll05.test()())
+    assert len(sample) == 9
+    lens = {len(col) for col in sample}
+    assert len(lens) == 1  # all columns aligned
+    w, v, l = conll05.get_dict()
+    assert len(w) == 4000 and len(v) == 300 and len(l) == 59

@@ -1,0 +1,233 @@
+"""CRF ops vs brute-force numpy references.
+
+Parity: reference tests/unittests/{test_linear_chain_crf_op,
+test_crf_decoding_op,test_chunk_eval_op}.py — same transition layout
+(row 0 start, row 1 end, rows 2.. tag->tag) and the same stateful
+chunk-segment walk re-implemented here in python as ground truth.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+def path_score(x, w, path):
+    """Score of one tag path. x [T,D]; w [D+2,D]."""
+    s = w[0, path[0]] + x[0, path[0]] + w[1, path[-1]]
+    for k in range(1, len(path)):
+        s += x[k, path[k]] + w[2 + path[k - 1], path[k]]
+    return s
+
+
+def brute_nll(x, w, label):
+    """-(score(label) - logZ) by enumerating all paths."""
+    t, d = x.shape
+    scores = [path_score(x, w, p) for p in itertools.product(range(d),
+                                                             repeat=t)]
+    log_z = np.log(np.sum(np.exp(np.array(scores) - np.max(scores)))) + \
+        np.max(scores)
+    return log_z - path_score(x, w, label)
+
+
+def brute_viterbi(x, w):
+    t, d = x.shape
+    best, best_s = None, -np.inf
+    for p in itertools.product(range(d), repeat=t):
+        s = path_score(x, w, p)
+        if s > best_s:
+            best, best_s = p, s
+    return list(best)
+
+
+@pytest.fixture
+def crf_case():
+    rng = np.random.RandomState(42)
+    b, t, d = 4, 5, 3
+    x = rng.randn(b, t, d).astype("float32")
+    w = (0.5 * rng.randn(d + 2, d)).astype("float32")
+    xlen = np.array([5, 3, 1, 4], dtype="int32")
+    label = rng.randint(0, d, (b, t)).astype("int64")
+    return x, w, xlen, label
+
+
+def test_linear_chain_crf_vs_bruteforce(crf_case):
+    x, w, xlen, label = crf_case
+    nll, = run_op(
+        "linear_chain_crf",
+        {"Emission": x, "Transition": w, "Label": label, "XLen": xlen},
+        out_slots=("LogLikelihood",))
+    nll = np.asarray(nll)
+    assert nll.shape == (4, 1)
+    for i, L in enumerate(xlen):
+        want = brute_nll(x[i, :L], w, label[i, :L].tolist())
+        np.testing.assert_allclose(nll[i, 0], want, rtol=2e-4,
+                                   err_msg="seq %d" % i)
+
+
+def test_linear_chain_crf_grad_finite_diff(crf_case):
+    """d(sum nll)/dTransition via the program backward vs central diff."""
+    x, w, xlen, label = crf_case
+    out = run_op(
+        "linear_chain_crf",
+        {"Emission": x, "Transition": w, "Label": label, "XLen": xlen},
+        out_slots=("LogLikelihood",), fetch_grads=("Transition", "Emission"))
+    _, gw, gx = [np.asarray(o) for o in out]
+
+    def total(w_):
+        return sum(brute_nll(x[i, :L], w_, label[i, :L].tolist())
+                   for i, L in enumerate(xlen))  # harness loss = sum of nll
+
+    eps = 1e-2
+    for idx in [(0, 0), (1, 2), (3, 1), (4, 2)]:
+        wp, wm = w.copy(), w.copy()
+        wp[idx] += eps
+        wm[idx] -= eps
+        fd = (total(wp) - total(wm)) / (2 * eps)
+        np.testing.assert_allclose(gw[idx], fd, rtol=2e-2, atol=1e-3,
+                                   err_msg="dw%s" % (idx,))
+
+
+def test_crf_decoding_vs_bruteforce(crf_case):
+    x, w, xlen, _ = crf_case
+    path, = run_op(
+        "crf_decoding",
+        {"Emission": x, "Transition": w, "XLen": xlen},
+        out_slots=("ViterbiPath",))
+    path = np.asarray(path)
+    for i, L in enumerate(xlen):
+        want = brute_viterbi(x[i, :L], w)
+        np.testing.assert_array_equal(path[i, :L], want, "seq %d" % i)
+        np.testing.assert_array_equal(path[i, L:], 0)
+
+
+def test_crf_decoding_with_label(crf_case):
+    x, w, xlen, _ = crf_case
+    # label = viterbi path for seqs 0/1, something else for 2/3
+    gold = np.zeros((4, 5), dtype="int64")
+    for i, L in enumerate(xlen):
+        gold[i, :L] = brute_viterbi(x[i, :L], w)
+    gold[2, 0] = (gold[2, 0] + 1) % 3
+    gold[3, 1] = (gold[3, 1] + 1) % 3
+    hit, = run_op(
+        "crf_decoding",
+        {"Emission": x, "Transition": w, "XLen": xlen, "Label": gold},
+        out_slots=("ViterbiPath",))
+    hit = np.asarray(hit)
+    assert hit[0, :5].tolist() == [1] * 5
+    assert hit[1, :3].tolist() == [1] * 3
+    assert hit[2, 0] == 0
+    assert hit[3, 1] == 0
+    np.testing.assert_array_equal(hit[1, 3:], 0)  # padding stays 0
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval ground truth: direct port of chunk_eval_op.h's stateful walk
+# ---------------------------------------------------------------------------
+
+SCHEMES = {"IOB": (2, 0, 1, -1, -1), "IOE": (2, -1, 0, 1, -1),
+           "IOBES": (4, 0, 1, 2, 3), "plain": (1, -1, -1, -1, -1)}
+
+
+def ref_segments(labels, num_chunk_types, scheme):
+    num_tag, tag_b, tag_i, tag_e, tag_s = SCHEMES[scheme]
+    other = num_chunk_types
+
+    def chunk_end(pt, pty, t, ty):
+        if pty == other: return False
+        if ty == other: return True
+        if ty != pty: return True
+        if pt == tag_b: return t in (tag_b, tag_s)
+        if pt == tag_i: return t in (tag_b, tag_s)
+        if pt == tag_e: return True
+        if pt == tag_s: return True
+        return False
+
+    def chunk_begin(pt, pty, t, ty):
+        if pty == other: return ty != other
+        if ty == other: return False
+        if ty != pty: return True
+        if t == tag_b: return True
+        if t == tag_i: return pt in (tag_e, tag_s)
+        if t == tag_e: return pt in (tag_e, tag_s)
+        if t == tag_s: return True
+        return False
+
+    segs, in_chunk, start = [], False, 0
+    tag, typ = -1, other
+    for i, lab in enumerate(labels):
+        pt, pty = tag, typ
+        tag, typ = lab % num_tag, lab // num_tag
+        if in_chunk and chunk_end(pt, pty, tag, typ):
+            segs.append((start, i - 1, pty))
+            in_chunk = False
+        if chunk_begin(pt, pty, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, len(labels) - 1, typ))
+    return segs
+
+
+def ref_chunk_counts(infer, label, lens, num_chunk_types, scheme,
+                     excluded=()):
+    ni = nl = nc = 0
+    for i, L in enumerate(lens):
+        si = [s for s in ref_segments(infer[i][:L], num_chunk_types, scheme)
+              if s[2] not in excluded]
+        sl = [s for s in ref_segments(label[i][:L], num_chunk_types, scheme)
+              if s[2] not in excluded]
+        ni += len(si)
+        nl += len(sl)
+        nc += len([s for s in si if s in sl])
+    return ni, nl, nc
+
+
+@pytest.mark.parametrize("scheme", ["IOB", "IOE", "IOBES", "plain"])
+def test_chunk_eval_random(scheme):
+    rng = np.random.RandomState(7)
+    num_chunk_types = 3
+    num_tag = SCHEMES[scheme][0]
+    n_labels = num_chunk_types * num_tag + 1  # + the "other" label
+    b, t = 6, 12
+    lens = rng.randint(1, t + 1, b).astype("int32")
+    infer = rng.randint(0, n_labels, (b, t)).astype("int64")
+    label = rng.randint(0, n_labels, (b, t)).astype("int64")
+    # make some agreement so correct count is non-trivial
+    label[:3] = infer[:3]
+
+    outs = run_op(
+        "chunk_eval",
+        {"Inference": infer, "Label": label, "XLen": lens},
+        attrs={"num_chunk_types": num_chunk_types, "chunk_scheme": scheme},
+        out_slots=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                   "NumLabelChunks", "NumCorrectChunks"))
+    p, r, f1, ni, nl, nc = [np.asarray(o).ravel()[0] for o in outs]
+    wi, wl, wc = ref_chunk_counts(infer, label, lens, num_chunk_types, scheme)
+    assert (ni, nl, nc) == (wi, wl, wc), scheme
+    wp = wc / wi if wi else 0.0
+    wr = wc / wl if wl else 0.0
+    np.testing.assert_allclose(p, wp, rtol=1e-6)
+    np.testing.assert_allclose(r, wr, rtol=1e-6)
+    wf = 2 * wp * wr / (wp + wr) if wc else 0.0
+    np.testing.assert_allclose(f1, wf, rtol=1e-6)
+
+
+def test_chunk_eval_excluded_types():
+    rng = np.random.RandomState(3)
+    b, t, nct = 4, 10, 3
+    lens = rng.randint(2, t + 1, b).astype("int32")
+    infer = rng.randint(0, nct * 2 + 1, (b, t)).astype("int64")
+    label = infer.copy()
+    label[2:] = rng.randint(0, nct * 2 + 1, (2, t))
+    outs = run_op(
+        "chunk_eval",
+        {"Inference": infer, "Label": label, "XLen": lens},
+        attrs={"num_chunk_types": nct, "chunk_scheme": "IOB",
+               "excluded_chunk_types": [1]},
+        out_slots=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                   "NumLabelChunks", "NumCorrectChunks"))
+    ni, nl, nc = [int(np.asarray(o).ravel()[0]) for o in outs[3:]]
+    wi, wl, wc = ref_chunk_counts(infer, label, lens, nct, "IOB",
+                                  excluded=(1,))
+    assert (ni, nl, nc) == (wi, wl, wc)
