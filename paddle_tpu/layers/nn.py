@@ -22,7 +22,8 @@ __all__ = [
     "smooth_l1", "autoincreased_step_counter", "transpose", "im2sequence",
     "multiplex", "label_smooth", "nce", "lrn", "maxout", "relu", "log",
     "expand", "sequence_mask", "linear_chain_crf", "crf_decoding",
-    "chunk_eval",
+    "chunk_eval", "warpctc", "ctc_greedy_decoder", "sequence_erase",
+    "edit_distance",
 ]
 
 
@@ -538,14 +539,21 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
 
 
 def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
-    """Parity: fluid.layers.im2sequence (OCR path)."""
+    """Parity: fluid.layers.im2sequence (OCR path). Output is a sequence:
+    one timestep per output pixel, feature = C*kh*kw patch."""
     helper = LayerHelper("im2sequence", **locals())
     out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.block.create_var(
+        name=out.name + "@SEQLEN", shape=[-1], dtype="int32",
+        stop_gradient=True)
     helper.append_op(
-        type="im2sequence", inputs={"X": [input]}, outputs={"Out": [out]},
+        type="im2sequence", inputs={"X": [input]},
+        outputs={"Out": [out], "OutLen": [out_len]},
         attrs={"kernels": list(_pair(filter_size)),
                "strides": list(_pair(stride)),
                "paddings": list(_pair(padding)) * 2})
+    out.lod_level = 1
+    out.seq_len_var = out_len.name
     return out
 
 
@@ -695,3 +703,108 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
         v.shape = (1,)
         v.stop_gradient = True
     return (precision, recall, f1_score, num_infer, num_label, num_correct)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss on unnormalized logit sequences, one loss per sequence.
+
+    Parity: fluid.layers.warpctc (reference nn.py:2620) over warpctc_op;
+    the warp-ctc library's internal softmax is part of the op. Returns
+    Loss [num_seqs, 1].
+    """
+    helper = LayerHelper("warpctc", **locals())
+    loss_out = helper.create_variable_for_type_inference(input.dtype)
+    grad_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="warpctc",
+        inputs={"Logits": [input], "Label": [label],
+                "XLen": [_crf_seq_len(helper, input)],
+                "LabelLen": [_crf_seq_len(helper, label)]},
+        outputs={"Loss": [loss_out], "WarpCTCGrad": [grad_out]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times})
+    loss_out.lod_level = 0
+    loss_out.seq_len_var = None
+    loss_out.shape = (-1, 1)
+    return loss_out
+
+
+def _erase_or_align_out(helper, op_type, inputs, attrs, dtype="int64"):
+    """Emit an op that compacts sequences (new data + new lengths)."""
+    out = helper.create_variable_for_type_inference(dtype)
+    out_len = helper.block.create_var(
+        name=out.name + "@SEQLEN", shape=[-1], dtype="int32",
+        stop_gradient=True)
+    out_slot = "Output" if op_type == "ctc_align" else "Out"
+    helper.append_op(
+        type=op_type, inputs=inputs,
+        outputs={out_slot: [out], "OutLen": [out_len]}, attrs=attrs,
+        infer_shape=False)
+    out.lod_level = 1
+    out.seq_len_var = out_len.name
+    out.stop_gradient = True
+    return out
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """Greedy CTC decode: argmax per step, merge repeats, drop blanks.
+
+    Parity: fluid.layers.ctc_greedy_decoder (reference nn.py:2478):
+    top_k(k=1) + ctc_align(merge_repeated=True).
+    """
+    helper = LayerHelper("ctc_greedy_decoder", **locals())
+    topk_out = helper.create_variable_for_type_inference(input.dtype)
+    topk_indices = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="topk", inputs={"X": [input]},
+        outputs={"Out": [topk_out], "Indices": [topk_indices]},
+        attrs={"k": 1})
+    out = _erase_or_align_out(
+        helper, "ctc_align",
+        {"Input": [topk_indices], "XLen": [_crf_seq_len(helper, input)]},
+        {"merge_repeated": True, "blank": blank})
+    if input.shape is not None:
+        out.shape = (input.shape[0], input.shape[1])
+    return out
+
+
+def sequence_erase(input, tokens):
+    """Remove the given token ids from each sequence (compacting it).
+
+    Parity: sequence_erase_op (used by edit_distance's ignored_tokens)."""
+    helper = LayerHelper("sequence_erase", **locals())
+    out = _erase_or_align_out(
+        helper, "sequence_erase",
+        {"X": [input], "XLen": [_crf_seq_len(helper, input)]},
+        {"tokens": list(tokens)})
+    if input.shape is not None:
+        out.shape = tuple(input.shape[:2])
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  name=None):
+    """Levenshtein distance between hypothesis and reference sequences.
+
+    Parity: fluid.layers.edit_distance (reference nn.py:2532). Returns
+    (distances [num_seqs, 1] float32, sequence_num [1] int64).
+    """
+    helper = LayerHelper("edit_distance", **locals())
+    if ignored_tokens:
+        input = sequence_erase(input, ignored_tokens)
+        label = sequence_erase(label, ignored_tokens)
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="edit_distance",
+        inputs={"Hyps": [input], "Refs": [label],
+                "HypsLen": [_crf_seq_len(helper, input)],
+                "RefsLen": [_crf_seq_len(helper, label)]},
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized})
+    for v in (out, seq_num):
+        v.lod_level = 0
+        v.seq_len_var = None
+        v.stop_gradient = True
+    out.shape = (-1, 1)
+    seq_num.shape = (1,)
+    return out, seq_num

@@ -26,6 +26,11 @@ from jax import lax
 from ..core.registry import register, single
 
 
+def _i64():
+    """int64 when x64 is enabled, else a warning-free int32."""
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
 def _split_transition(w):
     return w[0], w[1], w[2:]  # start [D], end [D], trans [D, D] (j -> i)
 
@@ -136,8 +141,8 @@ def _crf_decoding(ctx, ins, attrs):
     if label:
         lbl = _squeeze_label(label[0])
         out = jnp.where(tmask, (lbl == path).astype(jnp.int32), 0)
-        return {"ViterbiPath": [out.astype(jnp.int64)]}
-    return {"ViterbiPath": [path.astype(jnp.int64)]}
+        return {"ViterbiPath": [out.astype(_i64())]}
+    return {"ViterbiPath": [path.astype(_i64())]}
 
 
 # ---------------------------------------------------------------------------
@@ -232,11 +237,11 @@ def _chunk_eval(ctx, ins, attrs):
             inc &= typ != e
         return inc
 
-    n_label = jnp.sum((beg_l & included(typ_l)).astype(jnp.int64))
-    n_infer = jnp.sum((beg_i & included(typ_i)).astype(jnp.int64))
+    n_label = jnp.sum((beg_l & included(typ_l)).astype(_i64()))
+    n_infer = jnp.sum((beg_i & included(typ_i)).astype(_i64()))
     correct = (beg_l & beg_i & (typ_l == typ_i) & (end_l == end_i) &
                included(typ_l))
-    n_correct = jnp.sum(correct.astype(jnp.int64))
+    n_correct = jnp.sum(correct.astype(_i64()))
 
     nc = n_correct.astype(jnp.float32)
     precision = jnp.where(n_infer > 0, nc / n_infer, 0.0)

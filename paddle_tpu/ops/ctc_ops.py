@@ -1,0 +1,197 @@
+"""CTC family: warpctc loss, ctc_align (greedy-decode merge), edit_distance,
+sequence_erase.
+
+Parity: paddle/fluid/operators/{warpctc_op,ctc_align_op,edit_distance_op,
+sequence_erase_op}.{h,cc,cu}. The reference offloads the CTC loss to the
+warp-ctc CUDA library and walks sequences host-side for align/erase/edit
+distance; here each is a batched XLA computation over the padded-dense
+layout:
+
+- warpctc: log-space alpha recursion over the 2U+1 extended label states,
+  one lax.scan over time for the whole batch (warp-ctc's softmax is
+  included: input is unnormalized logits). Gradient falls out of jax.vjp
+  of the scan, replacing the library's hand-computed WarpCTCGrad.
+- edit_distance: Levenshtein DP, scanned over hypothesis positions with
+  the insertion recurrence closed into a cumulative min (d[i][j] =
+  min_k<=j(cand[k] + j - k) = cummin(cand[k]-k)+j), so the inner loop is
+  a vector op, not a scan.
+- ctc_align / sequence_erase: keep-mask + stable-argsort compaction
+  (kept tokens move to the front, new lengths = mask sum).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register, single
+
+_NEG = -1e30
+
+
+def _i64():
+    """int64 when x64 is enabled, else a warning-free int32."""
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
+def _squeeze2d(x):
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x.reshape(x.shape[0], x.shape[1])
+    return x
+
+
+@register("warpctc")
+def _warpctc(ctx, ins, attrs):
+    logits = single(ins, "Logits")                  # [B, T, C]
+    label = _squeeze2d(single(ins, "Label")).astype(jnp.int32)  # [B, U]
+    xlen = single(ins, "XLen").astype(jnp.int32)    # [B]
+    llen = single(ins, "LabelLen").astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = bool(attrs.get("norm_by_times", False))
+
+    b_, t_, c = logits.shape
+    u = label.shape[1]
+    s = 2 * u + 1
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((b_, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    # skip transition s-2 -> s allowed for non-blank states with
+    # ext[s] != ext[s-2]
+    skip_ok = jnp.zeros((b_, s), bool)
+    if u > 1:
+        skip_ok = skip_ok.at[:, 3::2].set(label[:, 1:] != label[:, :-1])
+    # states beyond 2*llen never feed the final selection (transitions only
+    # move forward), so padded label content is harmless.
+
+    lp_ext = jnp.take_along_axis(
+        lp, jnp.broadcast_to(ext[:, None, :], (b_, t_, s)), axis=2)
+
+    alpha0 = jnp.full((b_, s), _NEG)
+    alpha0 = alpha0.at[:, 0].set(lp_ext[:, 0, 0])
+    if s > 1:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(llen > 0, lp_ext[:, 0, 1], _NEG))
+
+    def shift(a, k):
+        return jnp.concatenate(
+            [jnp.full((b_, k), _NEG, a.dtype), a[:, :-k]], axis=1)
+
+    def step(alpha, inp):
+        lp_t, valid = inp                            # [B, S], [B]
+        stay = alpha
+        diag = shift(alpha, 1)
+        skip = jnp.where(skip_ok, shift(alpha, 2), _NEG)
+        m = jnp.maximum(jnp.maximum(stay, diag), skip)
+        tot = m + jnp.log(jnp.exp(stay - m) + jnp.exp(diag - m) +
+                          jnp.exp(skip - m))
+        new = tot + lp_t
+        return jnp.where(valid[:, None], new, alpha), None
+
+    if t_ > 1:
+        tmask = (jnp.arange(1, t_, dtype=jnp.int32)[:, None] <
+                 xlen[None, :])                      # [T-1, B]
+        alpha, _ = lax.scan(step, alpha0,
+                            (jnp.moveaxis(lp_ext[:, 1:], 1, 0), tmask))
+    else:
+        alpha = alpha0
+
+    # final: states 2*llen (trailing blank) and 2*llen-1 (last label)
+    f_blank = jnp.take_along_axis(alpha, (2 * llen)[:, None], axis=1)[:, 0]
+    lbl_idx = jnp.maximum(2 * llen - 1, 0)
+    f_label = jnp.where(
+        llen > 0,
+        jnp.take_along_axis(alpha, lbl_idx[:, None], axis=1)[:, 0], _NEG)
+    m = jnp.maximum(f_blank, f_label)
+    ll = m + jnp.log(jnp.exp(f_blank - m) + jnp.exp(f_label - m))
+    loss = -ll
+    if norm_by_times:
+        # reference semantics (warpctc_op.h WarpCTCGradKernel): the LOSS
+        # value stays raw; only the gradient is normalized by the number of
+        # timesteps. value == loss, d(value) == d(loss)/T:
+        t_norm = jnp.maximum(xlen, 1).astype(loss.dtype)
+        scaled = loss / t_norm
+        loss = lax.stop_gradient(loss - scaled) + scaled
+    loss = loss[:, None].astype(logits.dtype)
+    return {"Loss": [loss], "WarpCTCGrad": [jnp.zeros_like(logits)]}
+
+
+def _compact(x, keep, pad_value=0):
+    """Move kept tokens to the front of each row, pad the rest."""
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    out = jnp.take_along_axis(x, order, axis=1)
+    kept = jnp.take_along_axis(keep, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return jnp.where(kept, out, pad_value), new_len
+
+
+@register("ctc_align")
+def _ctc_align(ctx, ins, attrs):
+    x = _squeeze2d(single(ins, "Input")).astype(jnp.int32)  # [B, T]
+    xlen = single(ins, "XLen").astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    merge = bool(attrs.get("merge_repeated", True))
+    b_, t_ = x.shape
+    valid = (jnp.arange(t_, dtype=jnp.int32)[None, :] < xlen[:, None])
+    prev = jnp.concatenate([jnp.full((b_, 1), -1, x.dtype), x[:, :-1]],
+                           axis=1)
+    keep = (x != blank) & valid
+    if merge:
+        keep &= (x != prev)
+    out, new_len = _compact(x, keep)
+    return {"Output": [out.astype(_i64())], "OutLen": [new_len]}
+
+
+@register("sequence_erase")
+def _sequence_erase(ctx, ins, attrs):
+    x = _squeeze2d(single(ins, "X")).astype(jnp.int32)
+    xlen = single(ins, "XLen").astype(jnp.int32)
+    tokens = list(attrs.get("tokens", []) or [])
+    b_, t_ = x.shape
+    valid = (jnp.arange(t_, dtype=jnp.int32)[None, :] < xlen[:, None])
+    keep = valid
+    for tok in tokens:
+        keep &= (x != int(tok))
+    out, new_len = _compact(x, keep)
+    return {"Out": [out.astype(_i64())], "OutLen": [new_len]}
+
+
+@register("edit_distance")
+def _edit_distance(ctx, ins, attrs):
+    hyp = _squeeze2d(single(ins, "Hyps")).astype(jnp.int32)   # [B, U1]
+    ref = _squeeze2d(single(ins, "Refs")).astype(jnp.int32)   # [B, U2]
+    hlen = single(ins, "HypsLen").astype(jnp.int32)
+    rlen = single(ins, "RefsLen").astype(jnp.int32)
+    normalized = bool(attrs.get("normalized", True))
+    b_, u1 = hyp.shape
+    u2 = ref.shape[1]
+
+    jcol = jnp.arange(u2 + 1, dtype=jnp.float32)[None, :]     # [1, U2+1]
+    row0 = jnp.broadcast_to(jcol, (b_, u2 + 1))               # d[0][j] = j
+
+    def step(prev, hyp_i):
+        # prev: d[i-1][*] [B, U2+1]; hyp_i: [B]
+        cost = (hyp_i[:, None] != ref).astype(jnp.float32)    # [B, U2]
+        # substitute/match (diagonal) vs delete-from-hyp (above)
+        cand = jnp.minimum(prev[:, :-1] + cost, prev[:, 1:] + 1.0)
+        cand = jnp.concatenate([prev[:, :1] + 1.0, cand], axis=1)
+        # insertions: row[j] = min_{k<=j}(cand[k] + j - k)
+        row = lax.cummin(cand - jcol, axis=1) + jcol
+        return row, row
+
+    if u1 > 0:
+        _, rows = lax.scan(step, row0, jnp.moveaxis(hyp, 1, 0))
+        table = jnp.concatenate([row0[None], rows], axis=0)   # [U1+1, B, U2+1]
+    else:
+        table = row0[None]
+    # pick d[hlen][rlen] per row
+    d_h = jnp.take_along_axis(
+        jnp.moveaxis(table, 0, 1),                            # [B, U1+1, U2+1]
+        hlen[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [B, U2+1]
+    dist = jnp.take_along_axis(d_h, rlen[:, None], axis=1)[:, 0]
+    if normalized:
+        dist = dist / jnp.maximum(rlen, 1).astype(dist.dtype)
+    seq_num = jnp.asarray([b_], _i64())
+    return {"Out": [dist[:, None].astype(jnp.float32)],
+            "SequenceNum": [seq_num]}

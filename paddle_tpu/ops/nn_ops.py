@@ -440,3 +440,27 @@ def _nce(ctx, ins, attrs):
     ce = jnp.maximum(logits, 0) - logits * labels01 + jnp.log1p(jnp.exp(-jnp.abs(logits)))
     cost = jnp.sum(ce, axis=1, keepdims=True)
     return {"Cost": [cost], "SampleLogits": [logits], "SampleLabels": [samples]}
+
+
+@register("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    """Patches -> per-image sequence (reference im2sequence_op.h Im2Col).
+
+    Input [B, C, H, W] -> Out [B, oh*ow, C*kh*kw] + OutLen (= oh*ow for
+    every image; static shapes make it a constant vector). Feature order is
+    channel-major (c, kh, kw) like the reference's im2col."""
+    x = single(ins, "X")
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    up, left, down, right = (pads if len(pads) == 4 else
+                             [pads[0], pads[1], pads[0], pads[1]])
+    b, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=(sh, sw),
+        padding=((up, down), (left, right)))    # [B, C*kh*kw, oh, ow]
+    f = patches.shape[1]
+    oh, ow = patches.shape[2], patches.shape[3]
+    out = patches.reshape(b, f, oh * ow).transpose(0, 2, 1)
+    out_len = jnp.full((b,), oh * ow, jnp.int32)
+    return {"Out": [out], "OutLen": [out_len]}

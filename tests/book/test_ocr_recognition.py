@@ -1,0 +1,64 @@
+"""OCR-CTC (CRNN) convergence smoke.
+
+Synthetic task: each image is a sequence of vertical bar glyphs, one per
+character; the CTC net must learn to read them. Loss must drop and the
+greedy-decode edit distance must improve.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+from paddle_tpu.models import ocr_recognition
+
+NUM_CLASSES = 4     # characters 0..3; blank = 4
+H, W = 16, 64       # -> conv /8 -> 2x8 feature map -> 8 timesteps
+GLYPH_W = 16
+MAX_CHARS = 4       # CTC feasibility: T=8 >= U + adjacent-repeats (<= 7)
+
+
+def render(chars):
+    """Deterministic glyphs: char c = solid stripe at row band c."""
+    img = np.zeros((1, H, W), dtype="float32")
+    for i, c in enumerate(chars):
+        x0 = i * GLYPH_W
+        y0 = c * (H // NUM_CLASSES)
+        img[0, y0:y0 + H // NUM_CLASSES, x0:x0 + GLYPH_W] = 1.0
+    return img
+
+
+def synth_batch(rng, n=16):
+    imgs, labels = [], []
+    for _ in range(n):
+        k = rng.randint(2, MAX_CHARS + 1)
+        chars = rng.randint(0, NUM_CLASSES, k)
+        imgs.append(render(chars))
+        labels.append(np.asarray(chars, dtype="int64").reshape(-1, 1))
+    return np.stack(imgs), LoDTensor.from_sequences(labels)
+
+
+def test_ocr_ctc_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        images = fluid.layers.data(
+            name="pixel", shape=[1, H, W], dtype="float32")
+        label = fluid.layers.data(
+            name="label", shape=[1], dtype="int64", lod_level=1)
+        sum_cost, decoded, error, seq_num = ocr_recognition.ctc_train_net(
+            images, label, NUM_CLASSES, learning_rate=3e-3,
+            rnn_hidden_size=32, channels=(8, 16, 32))
+
+    rng = np.random.RandomState(0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses, errs = [], []
+        for i in range(60):
+            imgs, labels = synth_batch(rng)
+            loss, ev = exe.run(main, feed={"pixel": imgs, "label": labels},
+                               fetch_list=[sum_cost, error])
+            losses.append(float(np.ravel(loss)[0]))
+            errs.append(float(np.mean(ev)))
+    assert np.isfinite(losses).all(), losses[-5:]
+    assert np.mean(losses[-10:]) < 0.5 * np.mean(losses[:10]), losses[::10]
+    assert np.mean(errs[-10:]) < np.mean(errs[:10]), errs[::10]

@@ -1,0 +1,146 @@
+"""CTC ops vs brute-force numpy references.
+
+Parity: reference tests/unittests/{test_warpctc_op,test_ctc_align_op,
+test_edit_distance_op,test_sequence_erase_op}.py.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+def ctc_collapse(path, blank):
+    out, prev = [], None
+    for p in path:
+        if p != blank and p != prev:
+            out.append(p)
+        prev = p
+    return out
+
+
+def brute_ctc_nll(logits, label, blank):
+    """-log P(label | logits) by enumerating all alignment paths."""
+    t, c = logits.shape
+    ex = np.exp(logits - logits.max(axis=1, keepdims=True))
+    probs = ex / ex.sum(axis=1, keepdims=True)
+    total = 0.0
+    for path in itertools.product(range(c), repeat=t):
+        if ctc_collapse(path, blank) == list(label):
+            total += np.prod([probs[i, p] for i, p in enumerate(path)])
+    return -np.log(total)
+
+
+def levenshtein(a, b):
+    d = np.zeros((len(a) + 1, len(b) + 1))
+    d[:, 0] = np.arange(len(a) + 1)
+    d[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return d[len(a), len(b)]
+
+
+@pytest.mark.parametrize("blank", [0, 2])
+def test_warpctc_vs_bruteforce(blank):
+    rng = np.random.RandomState(0)
+    b, t, c, u = 3, 5, 4, 2
+    logits = rng.randn(b, t, c).astype("float32")
+    xlen = np.array([5, 4, 3], dtype="int32")
+    llen = np.array([2, 1, 2], dtype="int32")
+    label = np.zeros((b, u), dtype="int64")
+    nonblank = [k for k in range(c) if k != blank]
+    for i in range(b):
+        # consecutive labels distinct not required; test both
+        label[i, :llen[i]] = rng.choice(nonblank, llen[i])
+    label[2, 0] = label[2, 1] = nonblank[0]  # repeated label case
+
+    loss, _ = run_op(
+        "warpctc",
+        {"Logits": logits, "Label": label, "XLen": xlen, "LabelLen": llen},
+        attrs={"blank": blank}, out_slots=("Loss", "WarpCTCGrad"))
+    loss = np.asarray(loss)
+    for i in range(b):
+        want = brute_ctc_nll(logits[i, :xlen[i]], label[i, :llen[i]], blank)
+        np.testing.assert_allclose(loss[i, 0], want, rtol=1e-4,
+                                   err_msg="seq %d" % i)
+
+
+def test_warpctc_grad_finite_diff():
+    rng = np.random.RandomState(1)
+    b, t, c = 2, 4, 3
+    logits = rng.randn(b, t, c).astype("float32")
+    xlen = np.array([4, 3], dtype="int32")
+    llen = np.array([2, 1], dtype="int32")
+    label = np.array([[1, 2], [2, 0]], dtype="int64")
+    outs = run_op(
+        "warpctc",
+        {"Logits": logits, "Label": label, "XLen": xlen, "LabelLen": llen},
+        attrs={"blank": 0}, out_slots=("Loss", "WarpCTCGrad"),
+        fetch_grads=("Logits",))
+    g = np.asarray(outs[-1])
+
+    def total(lg):
+        return sum(brute_ctc_nll(lg[i, :xlen[i]], label[i, :llen[i]], 0)
+                   for i in range(b))
+
+    eps = 1e-3
+    for idx in [(0, 0, 1), (0, 3, 0), (1, 2, 2), (1, 0, 0)]:
+        lp, lm = logits.copy(), logits.copy()
+        lp[idx] += eps
+        lm[idx] -= eps
+        fd = (total(lp) - total(lm)) / (2 * eps)
+        np.testing.assert_allclose(g[idx], fd, rtol=2e-2, atol=1e-4,
+                                   err_msg=str(idx))
+    # padded positions get zero gradient
+    np.testing.assert_allclose(g[1, 3], 0.0, atol=1e-7)
+
+
+def test_ctc_align():
+    x = np.array([[0, 1, 1, 0, 2, 2, 0, 3],
+                  [1, 1, 2, 0, 0, 1, 0, 0]], dtype="int64")
+    xlen = np.array([8, 6], dtype="int32")
+    out, olen = run_op(
+        "ctc_align", {"Input": x, "XLen": xlen},
+        attrs={"blank": 0, "merge_repeated": True},
+        out_slots=("Output", "OutLen"))
+    out, olen = np.asarray(out), np.asarray(olen)
+    assert olen.tolist() == [3, 3]
+    assert out[0, :3].tolist() == [1, 2, 3]  # adjacent 2s merge
+    assert out[1, :3].tolist() == [1, 2, 1]  # blank separates the 1s
+    assert (out[0, 3:] == 0).all() and (out[1, 3:] == 0).all()
+
+
+def test_sequence_erase():
+    x = np.array([[3, 5, 2, 5, 9], [5, 5, 1, 0, 0]], dtype="int64")
+    xlen = np.array([5, 3], dtype="int32")
+    out, olen = run_op(
+        "sequence_erase", {"X": x, "XLen": xlen},
+        attrs={"tokens": [5]}, out_slots=("Out", "OutLen"))
+    assert np.asarray(olen).tolist() == [3, 1]
+    assert np.asarray(out)[0, :3].tolist() == [3, 2, 9]
+    assert np.asarray(out)[1, :1].tolist() == [1]
+
+
+@pytest.mark.parametrize("normalized", [False, True])
+def test_edit_distance_random(normalized):
+    rng = np.random.RandomState(5)
+    b, u1, u2 = 6, 7, 6
+    hyp = rng.randint(1, 5, (b, u1)).astype("int64")
+    ref = rng.randint(1, 5, (b, u2)).astype("int64")
+    hlen = rng.randint(0, u1 + 1, b).astype("int32")
+    rlen = rng.randint(1, u2 + 1, b).astype("int32")
+    out, n = run_op(
+        "edit_distance",
+        {"Hyps": hyp, "Refs": ref, "HypsLen": hlen, "RefsLen": rlen},
+        attrs={"normalized": normalized}, out_slots=("Out", "SequenceNum"))
+    out = np.asarray(out)
+    assert int(np.asarray(n)[0]) == b
+    for i in range(b):
+        want = levenshtein(hyp[i, :hlen[i]].tolist(), ref[i, :rlen[i]].tolist())
+        if normalized:
+            want = want / max(rlen[i], 1)
+        np.testing.assert_allclose(out[i, 0], want, rtol=1e-6,
+                                   err_msg="seq %d" % i)
