@@ -124,15 +124,15 @@ class Operator(object):
     conditional_block) are referenced by block index in attrs['sub_block'].
     """
 
-    _uid_counter = [0]
-
     def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
         self.block = block
         self.type = type
         # Stable op identity: salts the per-op PRNG stream so that re-lowering
         # the op inside jax.vjp (backward) reproduces identical randomness.
-        Operator._uid_counter[0] += 1
-        self.uid = Operator._uid_counter[0]
+        # PROGRAM-local (not process-global): a given program builds the same
+        # uids no matter what other programs were created before it, so
+        # random inits are reproducible across processes and test orderings.
+        self.uid = block.program._next_op_uid()
         self.inputs = {}   # slot -> [var name]
         self.outputs = {}  # slot -> [var name]
         self.attrs = dict(attrs) if attrs else {}
@@ -317,6 +317,11 @@ class Program(object):
         self._version = 0
         self._seed = None  # program-level rng seed override
         self.random_seed = 0
+        self._op_uid_counter = 0
+
+    def _next_op_uid(self):
+        self._op_uid_counter += 1
+        return self._op_uid_counter
 
     def _bump_version(self):
         self._version += 1

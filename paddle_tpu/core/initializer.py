@@ -126,6 +126,25 @@ class BilinearInitializer(Initializer):
             infer_shape=False)
 
 
+class NumpyArrayInitializer(Initializer):
+    """Initialize a parameter from a fixed numpy array (e.g. sinusoid
+    position-encoding tables, pretrained embeddings)."""
+
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="assign_value",
+            outputs={"Out": [var]},
+            attrs={"shape": list(self.value.shape),
+                   # ndarray, NOT a python list: large pretrained tables
+                   # must not be exploded into boxed floats per element
+                   "values": self.value,
+                   "dtype": var.dtype},
+            infer_shape=False)
+
+
 # fluid-style aliases
 Constant = ConstantInitializer
 Uniform = UniformInitializer

@@ -20,6 +20,22 @@ import numpy as np
 BATCH_SENTINEL = 1021
 
 
+def int_dtype():
+    """int64 when x64 is enabled, else a warning-free int32 (shared by
+    lowering rules that declare int64 outputs)."""
+    import jax
+    import jax.numpy as jnp
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
+def squeeze_label(label):
+    """[B, T, 1] int label tensor -> [B, T] int32 (shared by CRF/CTC ops)."""
+    import jax.numpy as jnp
+    if label.ndim == 3 and label.shape[-1] == 1:
+        label = label.reshape(label.shape[0], label.shape[1])
+    return label.astype(jnp.int32)
+
+
 class OpDef(object):
     def __init__(self, type, lower, infer=None, uses_rng=False):
         self.type = type

@@ -1,0 +1,278 @@
+"""Transformer (Attention is All You Need) built from fluid layers.
+
+Parity: the fluid benchmark transformer family SURVEY.md §2 lists
+("transformer & OCR-CTC"); same program structure as Paddle's
+models/transformer: multi_head_attention / positionwise_feed_forward /
+pre_post_process_layer helpers, sinusoid position encoding as a frozen
+embedding table, attention-bias feeds for padding/causal masks, label
+smoothing + per-token weighted cross entropy, Adam + noam warmup.
+
+TPU notes: the whole model is dense [batch, max_len, d_model] with masks
+carried as additive bias tensors — no dynamic shapes anywhere, so the
+single jitted program covers every batch; attention matmuls land on the
+MXU in one fused XLA graph.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+POS_ENC_PARAM_NAMES = ("src_pos_enc_table", "trg_pos_enc_table")
+
+
+def position_encoding_init(n_position, d_model):
+    """Sinusoid table [n_position, d_model]."""
+    pos = np.arange(n_position)[:, None].astype("float64")
+    dim = np.arange(d_model)[None, :].astype("float64")
+    angle = pos / np.power(10000, 2 * (dim // 2) / d_model)
+    table = np.zeros((n_position, d_model))
+    table[:, 0::2] = np.sin(angle[:, 0::2])
+    table[:, 1::2] = np.cos(angle[:, 1::2])
+    return table.astype("float32")
+
+
+def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
+                         d_model, n_head=1, dropout_rate=0.0):
+    """q/k/v fc -> split heads -> scaled dot-product + bias -> combine."""
+    keys = queries if keys is None else keys
+    values = keys if values is None else values
+
+    q = fluid.layers.fc(input=queries, size=d_key * n_head,
+                        bias_attr=False, num_flatten_dims=2)
+    k = fluid.layers.fc(input=keys, size=d_key * n_head,
+                        bias_attr=False, num_flatten_dims=2)
+    v = fluid.layers.fc(input=values, size=d_value * n_head,
+                        bias_attr=False, num_flatten_dims=2)
+
+    def split_heads(x, d):
+        # [B, T, H*d] -> [B, H, T, d]
+        reshaped = fluid.layers.reshape(x, shape=[0, -1, n_head, d])
+        return fluid.layers.transpose(reshaped, perm=[0, 2, 1, 3])
+
+    q = split_heads(q, d_key)
+    k = split_heads(k, d_key)
+    v = split_heads(v, d_value)
+
+    product = fluid.layers.matmul(x=q, y=k, transpose_y=True)
+    product = fluid.layers.scale(x=product, scale=d_key ** -0.5)
+    if attn_bias is not None:
+        product = product + attn_bias
+    weights = fluid.layers.softmax(product)
+    if dropout_rate:
+        weights = fluid.layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = fluid.layers.matmul(weights, v)              # [B, H, T, dv]
+    ctx = fluid.layers.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = fluid.layers.reshape(ctx, shape=[0, -1, n_head * d_value])
+    return fluid.layers.fc(input=ctx, size=d_model, bias_attr=False,
+                           num_flatten_dims=2)
+
+
+def positionwise_feed_forward(x, d_inner_hid, d_model):
+    hidden = fluid.layers.fc(input=x, size=d_inner_hid, num_flatten_dims=2,
+                             act="relu")
+    return fluid.layers.fc(input=hidden, size=d_model, num_flatten_dims=2)
+
+
+def pre_post_process_layer(prev_out, out, process_cmd, dropout_rate=0.0):
+    """'a': residual add, 'n': layer_norm, 'd': dropout."""
+    for cmd in process_cmd:
+        if cmd == "a":
+            out = out + prev_out if prev_out is not None else out
+        elif cmd == "n":
+            out = fluid.layers.layer_norm(
+                out, begin_norm_axis=len(out.shape) - 1,
+                param_attr=fluid.initializer.Constant(1.0),
+                bias_attr=fluid.initializer.Constant(0.0))
+        elif cmd == "d":
+            if dropout_rate:
+                out = fluid.layers.dropout(out, dropout_prob=dropout_rate)
+    return out
+
+
+def prepare_encoder(src_word, src_pos, src_vocab_size, src_emb_dim,
+                    src_max_len, dropout_rate=0.0, pos_enc_param_name=None):
+    """word emb * sqrt(d) + frozen sinusoid position emb."""
+    word_emb = fluid.layers.embedding(
+        src_word, size=[src_vocab_size, src_emb_dim],
+        param_attr=fluid.ParamAttr(
+            initializer=fluid.initializer.Normal(0., src_emb_dim ** -0.5)))
+    word_emb = fluid.layers.scale(x=word_emb, scale=src_emb_dim ** 0.5)
+    pos_enc = fluid.layers.embedding(
+        src_pos, size=[src_max_len, src_emb_dim],
+        param_attr=fluid.ParamAttr(
+            name=pos_enc_param_name, trainable=False,
+            initializer=fluid.initializer.NumpyArrayInitializer(
+                position_encoding_init(src_max_len, src_emb_dim))))
+    enc_input = word_emb + pos_enc
+    if dropout_rate:
+        enc_input = fluid.layers.dropout(enc_input,
+                                         dropout_prob=dropout_rate)
+    return enc_input
+
+
+def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
+                  d_inner_hid, dropout_rate=0.0):
+    attn_output = multi_head_attention(
+        pre_post_process_layer(None, enc_input, "n"), None, None, attn_bias,
+        d_key, d_value, d_model, n_head, dropout_rate)
+    attn_output = pre_post_process_layer(enc_input, attn_output, "da",
+                                         dropout_rate)
+    ffd_output = positionwise_feed_forward(
+        pre_post_process_layer(None, attn_output, "n"), d_inner_hid, d_model)
+    return pre_post_process_layer(attn_output, ffd_output, "da",
+                                  dropout_rate)
+
+
+def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
+                  n_head, d_key, d_value, d_model, d_inner_hid,
+                  dropout_rate=0.0):
+    slf_attn_output = multi_head_attention(
+        pre_post_process_layer(None, dec_input, "n"), None, None,
+        slf_attn_bias, d_key, d_value, d_model, n_head, dropout_rate)
+    slf_attn_output = pre_post_process_layer(dec_input, slf_attn_output,
+                                             "da", dropout_rate)
+    enc_attn_output = multi_head_attention(
+        pre_post_process_layer(None, slf_attn_output, "n"), enc_output,
+        enc_output, dec_enc_attn_bias, d_key, d_value, d_model, n_head,
+        dropout_rate)
+    enc_attn_output = pre_post_process_layer(slf_attn_output,
+                                             enc_attn_output, "da",
+                                             dropout_rate)
+    ffd_output = positionwise_feed_forward(
+        pre_post_process_layer(None, enc_attn_output, "n"), d_inner_hid,
+        d_model)
+    return pre_post_process_layer(enc_attn_output, ffd_output, "da",
+                                  dropout_rate)
+
+
+def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
+            d_inner_hid, dropout_rate=0.0):
+    for _ in range(n_layer):
+        enc_input = encoder_layer(enc_input, attn_bias, n_head, d_key,
+                                  d_value, d_model, d_inner_hid,
+                                  dropout_rate)
+    return pre_post_process_layer(None, enc_input, "n")
+
+
+def decoder(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
+            n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
+            dropout_rate=0.0):
+    for _ in range(n_layer):
+        dec_input = decoder_layer(dec_input, enc_output, slf_attn_bias,
+                                  dec_enc_attn_bias, n_head, d_key, d_value,
+                                  d_model, d_inner_hid, dropout_rate)
+    return pre_post_process_layer(None, dec_input, "n")
+
+
+FEED_NAMES = ["src_word", "src_pos", "trg_word", "trg_pos",
+              "src_slf_attn_bias", "trg_slf_attn_bias", "trg_src_attn_bias",
+              "lbl_word", "lbl_weight"]
+
+
+def make_inputs(max_length, n_head):
+    """Declare the 9 dense feeds (the classic transformer feed design)."""
+    src_word = fluid.layers.data("src_word", [max_length], dtype="int64")
+    src_pos = fluid.layers.data("src_pos", [max_length], dtype="int64")
+    trg_word = fluid.layers.data("trg_word", [max_length], dtype="int64")
+    trg_pos = fluid.layers.data("trg_pos", [max_length], dtype="int64")
+    src_slf = fluid.layers.data(
+        "src_slf_attn_bias", [n_head, max_length, max_length])
+    trg_slf = fluid.layers.data(
+        "trg_slf_attn_bias", [n_head, max_length, max_length])
+    trg_src = fluid.layers.data(
+        "trg_src_attn_bias", [n_head, max_length, max_length])
+    lbl_word = fluid.layers.data("lbl_word", [max_length, 1], dtype="int64")
+    lbl_weight = fluid.layers.data("lbl_weight", [max_length, 1])
+    return (src_word, src_pos, trg_word, trg_pos, src_slf, trg_slf, trg_src,
+            lbl_word, lbl_weight)
+
+
+def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
+                n_head=4, d_key=16, d_value=16, d_model=64, d_inner_hid=128,
+                dropout_rate=0.0, label_smooth_eps=0.0):
+    """Build the training graph; returns (sum_cost, avg_cost, predict)."""
+    (src_word, src_pos, trg_word, trg_pos, src_slf_attn_bias,
+     trg_slf_attn_bias, trg_src_attn_bias, lbl_word,
+     lbl_weight) = make_inputs(max_length, n_head)
+
+    enc_input = prepare_encoder(
+        src_word, src_pos, src_vocab_size, d_model, max_length,
+        dropout_rate, pos_enc_param_name=POS_ENC_PARAM_NAMES[0])
+    enc_output = encoder(enc_input, src_slf_attn_bias, n_layer, n_head,
+                         d_key, d_value, d_model, d_inner_hid, dropout_rate)
+
+    dec_input = prepare_encoder(
+        trg_word, trg_pos, trg_vocab_size, d_model, max_length,
+        dropout_rate, pos_enc_param_name=POS_ENC_PARAM_NAMES[1])
+    dec_output = decoder(dec_input, enc_output, trg_slf_attn_bias,
+                         trg_src_attn_bias, n_layer, n_head, d_key, d_value,
+                         d_model, d_inner_hid, dropout_rate)
+
+    predict = fluid.layers.fc(input=dec_output, size=trg_vocab_size,
+                              bias_attr=False, num_flatten_dims=2)
+    predict_2d = fluid.layers.reshape(predict, shape=[-1, trg_vocab_size])
+    lbl_flat = fluid.layers.reshape(lbl_word, shape=[-1, 1])
+    if label_smooth_eps:
+        smoothed = fluid.layers.label_smooth(
+            fluid.layers.one_hot(lbl_flat, depth=trg_vocab_size),
+            epsilon=label_smooth_eps)
+        cost = fluid.layers.softmax_with_cross_entropy(
+            logits=predict_2d, label=smoothed, soft_label=True)
+    else:
+        cost = fluid.layers.softmax_with_cross_entropy(
+            logits=predict_2d, label=lbl_flat)
+    weight_flat = fluid.layers.reshape(lbl_weight, shape=[-1, 1])
+    weighted_cost = cost * weight_flat
+    sum_cost = fluid.layers.reduce_sum(weighted_cost)
+    token_num = fluid.layers.reduce_sum(weight_flat)
+    token_num.stop_gradient = True
+    avg_cost = sum_cost / token_num
+    return sum_cost, avg_cost, predict
+
+
+def build_train(src_vocab_size, trg_vocab_size, max_length, d_model=64,
+                warmup_steps=40, learning_rate=1.0, **kwargs):
+    sum_cost, avg_cost, predict = transformer(
+        src_vocab_size, trg_vocab_size, max_length, d_model=d_model,
+        **kwargs)
+    lr = fluid.layers.noam_decay(d_model, warmup_steps, learning_rate)
+    optimizer = fluid.optimizer.Adam(learning_rate=lr, beta1=0.9,
+                                     beta2=0.98, epsilon=1e-9)
+    optimizer.minimize(avg_cost)
+    return sum_cost, avg_cost, predict
+
+
+def prepare_batch(src_seqs, trg_seqs, max_length, n_head, pad_id=0):
+    """Pack python token lists into the 9 dense feed arrays."""
+    b = len(src_seqs)
+    feeds = {}
+    src = np.full((b, max_length), pad_id, "int64")
+    src_pos = np.zeros((b, max_length), "int64")
+    trg = np.full((b, max_length), pad_id, "int64")
+    trg_pos = np.zeros((b, max_length), "int64")
+    lbl = np.full((b, max_length, 1), pad_id, "int64")
+    lbl_w = np.zeros((b, max_length, 1), "float32")
+    neg = -1e9
+    src_bias = np.zeros((b, n_head, max_length, max_length), "float32")
+    trg_bias = np.zeros((b, n_head, max_length, max_length), "float32")
+    cross_bias = np.zeros((b, n_head, max_length, max_length), "float32")
+    causal = np.triu(np.full((max_length, max_length), neg, "float32"), 1)
+    for i, (s, t) in enumerate(zip(src_seqs, trg_seqs)):
+        s = list(s)[:max_length]
+        # teacher forcing: input <s>+t[:-1], label t
+        t_in = [1] + list(t[:-1])
+        t_in = t_in[:max_length]
+        src[i, :len(s)] = s
+        src_pos[i, :len(s)] = np.arange(len(s))
+        trg[i, :len(t_in)] = t_in
+        trg_pos[i, :len(t_in)] = np.arange(len(t_in))
+        tl = min(len(t), max_length)
+        lbl[i, :tl, 0] = list(t)[:tl]
+        lbl_w[i, :tl, 0] = 1.0
+        src_bias[i, :, :, len(s):] = neg
+        trg_bias[i] = causal[None]
+        trg_bias[i, :, :, len(t_in):] = neg
+        cross_bias[i, :, :, len(s):] = neg
+    return {"src_word": src, "src_pos": src_pos, "trg_word": trg,
+            "trg_pos": trg_pos, "src_slf_attn_bias": src_bias,
+            "trg_slf_attn_bias": trg_bias, "trg_src_attn_bias": cross_bias,
+            "lbl_word": lbl, "lbl_weight": lbl_w}

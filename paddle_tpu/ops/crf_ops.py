@@ -23,22 +23,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.registry import register, single
-
-
-def _i64():
-    """int64 when x64 is enabled, else a warning-free int32."""
-    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+from ..core.registry import (register, single, int_dtype as _i64,
+                             squeeze_label as _squeeze_label)
 
 
 def _split_transition(w):
     return w[0], w[1], w[2:]  # start [D], end [D], trans [D, D] (j -> i)
-
-
-def _squeeze_label(label):
-    if label.ndim == 3:
-        label = label.reshape(label.shape[0], label.shape[1])
-    return label.astype(jnp.int32)
 
 
 @register("linear_chain_crf")

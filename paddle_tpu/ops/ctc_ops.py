@@ -24,26 +24,16 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.registry import register, single
+from ..core.registry import (register, single, int_dtype as _i64,
+                             squeeze_label as _squeeze2d)
 
 _NEG = -1e30
-
-
-def _i64():
-    """int64 when x64 is enabled, else a warning-free int32."""
-    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
-
-
-def _squeeze2d(x):
-    if x.ndim == 3 and x.shape[-1] == 1:
-        x = x.reshape(x.shape[0], x.shape[1])
-    return x
 
 
 @register("warpctc")
 def _warpctc(ctx, ins, attrs):
     logits = single(ins, "Logits")                  # [B, T, C]
-    label = _squeeze2d(single(ins, "Label")).astype(jnp.int32)  # [B, U]
+    label = _squeeze2d(single(ins, "Label"))  # [B, U] int32
     xlen = single(ins, "XLen").astype(jnp.int32)    # [B]
     llen = single(ins, "LabelLen").astype(jnp.int32)
     blank = int(attrs.get("blank", 0))
@@ -128,7 +118,7 @@ def _compact(x, keep, pad_value=0):
 
 @register("ctc_align")
 def _ctc_align(ctx, ins, attrs):
-    x = _squeeze2d(single(ins, "Input")).astype(jnp.int32)  # [B, T]
+    x = _squeeze2d(single(ins, "Input"))  # [B, T] int32
     xlen = single(ins, "XLen").astype(jnp.int32)
     blank = int(attrs.get("blank", 0))
     merge = bool(attrs.get("merge_repeated", True))
@@ -145,7 +135,7 @@ def _ctc_align(ctx, ins, attrs):
 
 @register("sequence_erase")
 def _sequence_erase(ctx, ins, attrs):
-    x = _squeeze2d(single(ins, "X")).astype(jnp.int32)
+    x = _squeeze2d(single(ins, "X"))
     xlen = single(ins, "XLen").astype(jnp.int32)
     tokens = list(attrs.get("tokens", []) or [])
     b_, t_ = x.shape
@@ -159,8 +149,8 @@ def _sequence_erase(ctx, ins, attrs):
 
 @register("edit_distance")
 def _edit_distance(ctx, ins, attrs):
-    hyp = _squeeze2d(single(ins, "Hyps")).astype(jnp.int32)   # [B, U1]
-    ref = _squeeze2d(single(ins, "Refs")).astype(jnp.int32)   # [B, U2]
+    hyp = _squeeze2d(single(ins, "Hyps"))   # [B, U1] int32
+    ref = _squeeze2d(single(ins, "Refs"))   # [B, U2] int32
     hlen = single(ins, "HypsLen").astype(jnp.int32)
     rlen = single(ins, "RefsLen").astype(jnp.int32)
     normalized = bool(attrs.get("normalized", True))
