@@ -42,6 +42,13 @@ from .io import save_params, load_params, save_persistables, \
     load_persistables, save_inference_model, load_inference_model
 from . import metrics
 from . import profiler
+from . import evaluator
+from . import average
+from .average import WeightedAverage
+from . import debuger
+from . import graphviz
+from . import memory_optimization_transpiler
+from .memory_optimization_transpiler import memory_optimize, release_memory
 from .data_feeder import DataFeeder
 from . import backward
 from .parallel.parallel_executor import ParallelExecutor

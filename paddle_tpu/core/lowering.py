@@ -181,6 +181,11 @@ def _lower_grad_of(ctx, op, env):
             flat.append(outs[slot][i])
         return flat
 
+    if getattr(ctx.program, "_rematerialize", False):
+        # memory_optimization_transpiler.enable_rematerialization: recompute
+        # this op's forward in the backward pass instead of keeping residuals
+        # (jax.checkpoint blocks XLA from CSE-ing it with the forward pass).
+        f = jax.checkpoint(f)
     primals, vjp_fn = jax.vjp(f, diff_primal)
 
     cotangents = []
@@ -238,14 +243,17 @@ def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
     return fn
 
 
-def analyze_state(program, feed_names):
+def analyze_state(program, feed_names, fetch_names=()):
     """Decide which persistable vars are program state (static analysis).
 
     Returns (state_rw, state_ro, state_out):
       state_rw — read from Scope AND overwritten (donate: in-place update)
       state_ro — read from Scope, never written (do not donate)
       state_out — all persistables written (order of returned new state)
-    """
+
+    `fetch_names` count as reads: fetching a persistable var no op produces
+    (the evaluator.eval pattern — an empty program fetching state) reads it
+    straight from the Scope."""
     feed = set(feed_names)
     written = set()
     state_in = []
@@ -272,6 +280,12 @@ def analyze_state(program, feed_names):
             if v is not None and v.persistable and name not in seen_out:
                 seen_out.add(name)
                 state_out.append(name)
+    # fetches of persistable vars NO op writes read straight from the Scope
+    # (evaluator.eval: empty program fetching accumulated state). Processed
+    # after the op walk so fetching a var this program produces stays a
+    # plain fetch, not a scope read.
+    for name in fetch_names:
+        visit_read(name)
     state_rw = [n for n in state_in if n in seen_out]
     state_ro = [n for n in state_in if n not in seen_out]
     return state_rw, state_ro, state_out

@@ -1,0 +1,79 @@
+"""Program debugging: text pretty-printer and graphviz rendering.
+
+Parity: python/paddle/fluid/debuger.py — pprint_program_codes /
+pprint_block_codes (C-like program listing) and draw_block_graphviz
+(op/var dependency graph). Works on this framework's Program/Block/
+Operator IR.
+"""
+from .graphviz import Graph
+
+__all__ = ["pprint_program_codes", "pprint_block_codes",
+           "draw_block_graphviz"]
+
+
+def _var_repr(block, name):
+    var = block.var_recursive(name) if block.has_var_recursive(name) \
+        else None
+    if var is None or var.shape is None:
+        return name
+    return "%s[%s|%s]" % (name, var.dtype,
+                          "x".join(str(d) for d in var.shape))
+
+
+def pprint_block_codes(block, show_backward=False):
+    lines = ["block_%d {" % block.idx]
+    for var in sorted(block.vars.values(), key=lambda v: v.name):
+        if not show_backward and "@GRAD" in var.name:
+            continue
+        kind = "param" if getattr(var, "trainable", None) is not None \
+            else "var"
+        lines.append("  %s %s" % (kind, _var_repr(block, var.name)))
+    for op in block.ops:
+        if not show_backward and op.type == "grad_of":
+            continue
+        outs = ", ".join(n for ns in op.outputs.values() for n in ns if n)
+        ins = ", ".join(n for ns in op.inputs.values() for n in ns)
+        attrs = ", ".join(
+            "%s=%r" % (k, v) for k, v in sorted(op.attrs.items())
+            if not k.startswith("__") and k not in ("sub_block",)
+            and not isinstance(v, (list, dict)) or
+            (isinstance(v, list) and len(v) <= 6))
+        lines.append("  %s = %s(%s)%s" % (
+            outs or "_", op.type, ins, " {%s}" % attrs if attrs else ""))
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program, show_backward=False):
+    return "\n".join(pprint_block_codes(b, show_backward)
+                     for b in program.blocks)
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Write the block's op/var graph as graphviz dot (+png if `dot` is
+    installed). Returns the dot path."""
+    graph = Graph("program_block_%d" % block.idx, rankdir="TB")
+    highlights = set(highlights or [])
+    var_nodes = {}
+
+    def var_node(name):
+        if name not in var_nodes:
+            attrs = {"shape": "box"}
+            if name in highlights:
+                attrs.update({"style": "filled", "fillcolor": "yellow"})
+            var_nodes[name] = graph.add_node(_var_repr(block, name),
+                                             prefix="var", **attrs)
+        return var_nodes[name]
+
+    for op in block.ops:
+        op_node = graph.add_node(op.type, prefix="op", shape="ellipse",
+                                 style="filled", fillcolor="lightgrey")
+        for names in op.inputs.values():
+            for n in names:
+                graph.add_edge(var_node(n), op_node)
+        for names in op.outputs.values():
+            for n in names:
+                if n:
+                    graph.add_edge(op_node, var_node(n))
+    graph.show(path)
+    return path

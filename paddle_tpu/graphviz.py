@@ -1,0 +1,91 @@
+"""Minimal graphviz dot writer.
+
+Parity: python/paddle/fluid/graphviz.py — enough surface (Graph, add_node,
+add_edge, Node/Edge attrs, code emission) for debuger.draw_block_graphviz;
+`show` writes the .dot and best-effort invokes `dot` if present.
+"""
+import os
+import subprocess
+
+__all__ = ["Graph"]
+
+
+def crepr(v):
+    if isinstance(v, str):
+        return '"%s"' % v
+    return str(v)
+
+
+class Rank(object):
+    def __init__(self, kind, name, priority):
+        self.kind = kind
+        self.name = name
+        self.priority = priority
+        self.nodes = []
+
+
+class Node(object):
+    counter = 1
+
+    def __init__(self, label, prefix, description="", **attrs):
+        self.label = label
+        self.name = "%s_%d" % (prefix, Node.counter)
+        Node.counter += 1
+        self.attrs = attrs
+        self.attrs["label"] = label
+
+    def __str__(self):
+        return "%s [%s];" % (self.name, ",".join(
+            "%s=%s" % (k, crepr(v)) for k, v in sorted(self.attrs.items())))
+
+
+class Edge(object):
+    def __init__(self, source, target, **attrs):
+        self.source = source
+        self.target = target
+        self.attrs = attrs
+
+    def __str__(self):
+        attrs = ",".join("%s=%s" % (k, crepr(v))
+                         for k, v in sorted(self.attrs.items()))
+        return "%s -> %s%s;" % (self.source.name, self.target.name,
+                                " [%s]" % attrs if attrs else "")
+
+
+class Graph(object):
+    def __init__(self, title, **attrs):
+        self.title = title
+        self.attrs = attrs
+        self.nodes = []
+        self.edges = []
+
+    def add_node(self, label, prefix="node", description="", **attrs):
+        node = Node(label, prefix, description, **attrs)
+        self.nodes.append(node)
+        return node
+
+    def add_edge(self, source, target, **attrs):
+        edge = Edge(source, target, **attrs)
+        self.edges.append(edge)
+        return edge
+
+    def code(self):
+        lines = ["digraph G {"]
+        lines += ['  label = %s;' % crepr(self.title)]
+        for k, v in sorted(self.attrs.items()):
+            lines.append("  %s=%s;" % (k, crepr(v)))
+        lines += ["  " + str(n) for n in self.nodes]
+        lines += ["  " + str(e) for e in self.edges]
+        lines.append("}")
+        return "\n".join(lines)
+
+    def show(self, path):
+        with open(path, "w") as f:
+            f.write(self.code())
+        img_path = os.path.splitext(path)[0] + ".png"
+        try:
+            subprocess.run(["dot", "-Tpng", path, "-o", img_path],
+                           check=False, capture_output=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            pass  # graphviz binary not installed; .dot file still written
+        return path

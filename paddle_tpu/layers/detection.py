@@ -1,0 +1,244 @@
+"""Detection layers (SSD family).
+
+Parity: python/paddle/fluid/layers/detection.py — multi_box_head,
+bipartite_match, target_assign, detection_output, ssd_loss, iou_similarity,
+box_coder, prior_box. Ground-truth inputs are lod_level-1 data layers
+(padded [B, G, ...] + lengths in this framework).
+
+ssd_loss lowers to ONE fused op (ops/detection_ops.py _ssd_loss) computing
+the same composition the reference builds from ~10 ops; the individual ops
+are also registered for direct use. detection_map is provided host-side as
+metrics.DetectionMAP (the reference's detection_map op is a CPU-only
+accumulator; a host metric is the TPU-native equivalent).
+"""
+from ..core.layer_helper import LayerHelper
+from ..core.framework import Variable
+from .sequence import _seq_len
+from . import tensor
+
+__all__ = [
+    "prior_box", "iou_similarity", "box_coder", "bipartite_match",
+    "target_assign", "ssd_loss", "detection_output", "multi_box_head",
+]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=None, offset=0.5, name=None):
+    """Generate SSD prior boxes for one feature map (prior_box_op.h)."""
+    helper = LayerHelper("prior_box", **locals())
+    boxes = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    steps = steps or [0.0, 0.0]
+    helper.append_op(
+        type="prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [variances]},
+        attrs={"min_sizes": list(min_sizes),
+               "max_sizes": list(max_sizes or []),
+               "aspect_ratios": list(aspect_ratios or [1.0]),
+               "variances": list(variance), "flip": flip, "clip": clip,
+               "step_w": steps[0], "step_h": steps[1], "offset": offset})
+    boxes.stop_gradient = True
+    variances.stop_gradient = True
+    return boxes, variances
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    helper = LayerHelper("box_coder", **locals())
+    out = helper.create_variable_for_type_inference(prior_box.dtype)
+    helper.append_op(
+        type="box_coder",
+        inputs={"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                "TargetBox": [target_box]},
+        outputs={"OutputBox": [out]},
+        attrs={"code_type": code_type, "box_normalized": box_normalized})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """dist_matrix: lod_level-1 [B, G, M] (gt rows per image)."""
+    helper = LayerHelper("bipartite_match", **locals())
+    match_indices = helper.create_variable_for_type_inference("int32")
+    match_distance = helper.create_variable_for_type_inference(
+        dist_matrix.dtype)
+    helper.append_op(
+        type="bipartite_match",
+        inputs={"DistMat": [dist_matrix],
+                "GtLen": [_seq_len(helper, dist_matrix)]},
+        outputs={"ColToRowMatchIndices": [match_indices],
+                 "ColToRowMatchDist": [match_distance]},
+        attrs={"match_type": match_type or "bipartite",
+               "dist_threshold": dist_threshold or 0.5})
+    for v in (match_indices, match_distance):
+        v.lod_level = 0
+        v.seq_len_var = None
+        v.stop_gradient = True
+    return match_indices, match_distance
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    helper = LayerHelper("target_assign", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_weight = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        outputs={"Out": [out], "OutWeight": [out_weight]},
+        attrs={"mismatch_value": mismatch_value or 0})
+    for v in (out, out_weight):
+        v.lod_level = 0
+        v.seq_len_var = None
+        v.stop_gradient = True
+    return out, out_weight
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None):
+    """SSD multibox loss -> [batch, 1] (detection.py:348).
+
+    Single fused op; see ops/detection_ops.py _ssd_loss for the exact
+    composition parity."""
+    helper = LayerHelper("ssd_loss", **locals())
+    if mining_type != "max_negative":
+        raise ValueError("Only support mining_type == max_negative now.")
+    loss = helper.create_variable_for_type_inference(location.dtype)
+    inputs = {"Location": [location], "Confidence": [confidence],
+              "GtBox": [gt_box], "GtLabel": [gt_label],
+              "GtLen": [_seq_len(helper, gt_box)],
+              "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="ssd_loss",
+        inputs=inputs,
+        outputs={"Loss": [loss]},
+        attrs={"background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "neg_pos_ratio": neg_pos_ratio, "neg_overlap": neg_overlap,
+               "loc_loss_weight": loc_loss_weight,
+               "conf_loss_weight": conf_loss_weight,
+               "match_type": match_type, "normalize": normalize})
+    loss.lod_level = 0
+    loss.seq_len_var = None
+    loss.shape = (-1, 1)
+    return loss
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """Decode + multiclass NMS -> [B, keep_top_k, 6] (-1 padded) + lengths.
+
+    Parity: detection.py:46 (box_coder decode + softmax + multiclass_nms).
+    The reference returns a LoD [total_kept, 6]; here the dense padded
+    equivalent with a @SEQLEN companion."""
+    from . import nn
+    helper = LayerHelper("detection_output", **locals())
+    decoded_box = box_coder(
+        prior_box=prior_box, prior_box_var=prior_box_var, target_box=loc,
+        code_type="decode_center_size")
+    scores = nn.softmax(input=scores)
+    scores = nn.transpose(scores, perm=[0, 2, 1])
+    scores.stop_gradient = True
+
+    out = helper.create_variable_for_type_inference(loc.dtype)
+    out_len = helper.block.create_var(
+        name=out.name + "@SEQLEN", shape=[-1], dtype="int32",
+        stop_gradient=True)
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [decoded_box], "Scores": [scores]},
+        outputs={"Out": [out], "OutLen": [out_len]},
+        attrs={"background_label": background_label,
+               "nms_threshold": nms_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "score_threshold": score_threshold,
+               "nms_eta": nms_eta})
+    out.lod_level = 1
+    out.seq_len_var = out_len.name
+    out.stop_gradient = True
+    return out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """SSD head over multiple feature maps (detection.py:566).
+
+    Returns (mbox_locs [B, M, 4], mbox_confs [B, M, C], boxes [M, 4],
+    variances [M, 4])."""
+    from . import nn
+    from . import ops as _ops
+
+    n = len(inputs)
+    if min_sizes is None:
+        assert min_ratio is not None and max_ratio is not None
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n - 2)) if n > 2 else 0
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+    if not isinstance(aspect_ratios[0], (list, tuple)):
+        aspect_ratios = [aspect_ratios] * n
+
+    mbox_locs, mbox_confs, box_list, var_list = [], [], [], []
+    for i, input in enumerate(inputs):
+        min_s = min_sizes[i]
+        max_s = max_sizes[i] if max_sizes else None
+        min_s = min_s if isinstance(min_s, (list, tuple)) else [min_s]
+        max_s = (max_s if isinstance(max_s, (list, tuple)) else [max_s]) \
+            if max_s is not None else []
+        step = steps[i] if steps else [step_w[i] if step_w else 0.0,
+                                       step_h[i] if step_h else 0.0]
+        box, var = prior_box(
+            input, image, min_s, max_s, aspect_ratios[i], variance, flip,
+            clip, step if isinstance(step, (list, tuple)) else [step, step],
+            offset)
+        from ..ops.detection_ops import _expand_aspect_ratios
+        expanded = _expand_aspect_ratios(aspect_ratios[i], flip)
+        n_non_unit = sum(1 for a in expanded if abs(a - 1.0) > 1e-6)
+        # per min_size: ar=1 prior (+ max prior) + one per non-unit ratio
+        num_priors = len(min_s) * (1 + n_non_unit) + \
+            (len(max_s) if max_s else 0)
+
+        loc = nn.conv2d(input=input, num_filters=num_priors * 4,
+                        filter_size=kernel_size, padding=pad, stride=stride)
+        loc = nn.transpose(loc, perm=[0, 2, 3, 1])
+        loc = _ops.reshape(x=loc, shape=[0, -1, 4])
+        mbox_locs.append(loc)
+
+        conf = nn.conv2d(input=input, num_filters=num_priors * num_classes,
+                         filter_size=kernel_size, padding=pad, stride=stride)
+        conf = nn.transpose(conf, perm=[0, 2, 3, 1])
+        conf = _ops.reshape(x=conf, shape=[0, -1, num_classes])
+        mbox_confs.append(conf)
+
+        box_list.append(_ops.reshape(x=box, shape=[-1, 4]))
+        var_list.append(_ops.reshape(x=var, shape=[-1, 4]))
+
+    mbox_locs_concat = tensor.concat(mbox_locs, axis=1)
+    mbox_confs_concat = tensor.concat(mbox_confs, axis=1)
+    box_concat = tensor.concat(box_list, axis=0)
+    var_concat = tensor.concat(var_list, axis=0)
+    for v in (box_concat, var_concat):
+        v.stop_gradient = True
+    return mbox_locs_concat, mbox_confs_concat, box_concat, var_concat

@@ -128,3 +128,105 @@ class Auc(MetricBase):
         tpr = tp_c / max(tp_c[0], 1)
         fpr = fp_c / max(fp_c[0], 1)
         return float(-np.trapezoid(tpr, fpr))
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision over accumulated detections.
+
+    Parity: paddle/fluid/operators/detection_map_op.h semantics (score-sorted
+    greedy TP/FP assignment at an IoU threshold, 11point or integral AP),
+    computed host-side from fetched numpy results instead of an in-graph
+    CPU-only accumulator op.
+
+    update(nmsed_out [B, K, 6] (-1 padded), nmsed_lens [B],
+           gt_boxes: list of [Gi, 4], gt_labels: list of [Gi]) per batch.
+    """
+
+    def __init__(self, overlap_threshold=0.5, ap_version="integral",
+                 name=None):
+        super(DetectionMAP, self).__init__(name)
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._dets = []   # (class, score, box, image_id)
+        self._gts = []    # (class, box, image_id)
+        self._img = 0
+
+    def update(self, nmsed_out, nmsed_lens, gt_boxes, gt_labels):
+        nmsed_out = np.asarray(nmsed_out)
+        nmsed_lens = np.ravel(np.asarray(nmsed_lens))
+        for i in range(nmsed_out.shape[0]):
+            img = self._img + i
+            for j in range(int(nmsed_lens[i])):
+                lab, score = nmsed_out[i, j, 0], nmsed_out[i, j, 1]
+                self._dets.append((int(lab), float(score),
+                                   nmsed_out[i, j, 2:6].copy(), img))
+            gb = np.asarray(gt_boxes[i]).reshape(-1, 4)
+            gl = np.ravel(np.asarray(gt_labels[i]))
+            for g in range(gb.shape[0]):
+                self._gts.append((int(gl[g]), gb[g].copy(), img))
+        self._img += nmsed_out.shape[0]
+
+    @staticmethod
+    def _iou(a, b):
+        iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = iw * ih
+        ua = max(0.0, a[2] - a[0]) * max(0.0, a[3] - a[1]) + \
+            max(0.0, b[2] - b[0]) * max(0.0, b[3] - b[1]) - inter
+        return inter / ua if ua > 0 else 0.0
+
+    def _ap(self, recall, precision):
+        if self.ap_version == "11point":
+            ap = 0.0
+            for t in np.arange(0.0, 1.1, 0.1):
+                p = np.max(precision[recall >= t]) if \
+                    np.any(recall >= t) else 0.0
+                ap += p / 11.0
+            return ap
+        # integral
+        ap = 0.0
+        prev_r = 0.0
+        for r, p in zip(recall, precision):
+            ap += p * (r - prev_r)
+            prev_r = r
+        return ap
+
+    def eval(self):
+        classes = sorted({c for c, _, _ in self._gts})
+        aps = []
+        for cls in classes:
+            gts = [(b, i) for c, b, i in self._gts if c == cls]
+            npos = len(gts)
+            dets = sorted((d for d in self._dets if d[0] == cls),
+                          key=lambda d: -d[1])
+            used = set()
+            tp = np.zeros(len(dets))
+            fp = np.zeros(len(dets))
+            for k, (_, score, box, img) in enumerate(dets):
+                # reference protocol (detection_map_op.h / VOC): argmax over
+                # ALL gts of the image; a detection whose best gt is already
+                # claimed counts FP (no re-matching to the second-best gt)
+                best, best_g = 0.0, -1
+                for gi, (gb, gimg) in enumerate(gts):
+                    if gimg != img:
+                        continue
+                    ov = self._iou(box, gb)
+                    if ov > best:
+                        best, best_g = ov, gi
+                if (best >= self.overlap_threshold and best_g >= 0 and
+                        best_g not in used):
+                    tp[k] = 1
+                    used.add(best_g)
+                else:
+                    fp[k] = 1
+            if npos == 0:
+                continue
+            tp_c = np.cumsum(tp)
+            fp_c = np.cumsum(fp)
+            recall = tp_c / npos
+            precision = tp_c / np.maximum(tp_c + fp_c, 1e-9)
+            aps.append(self._ap(recall, precision))
+        return float(np.mean(aps)) if aps else 0.0

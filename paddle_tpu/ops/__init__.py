@@ -6,3 +6,4 @@ from . import sequence_ops   # noqa: F401
 from . import control_ops    # noqa: F401
 from . import crf_ops        # noqa: F401
 from . import ctc_ops        # noqa: F401
+from . import detection_ops  # noqa: F401

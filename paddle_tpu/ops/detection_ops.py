@@ -1,0 +1,421 @@
+"""SSD detection ops: prior_box, iou_similarity, box_coder, bipartite_match,
+target_assign, mine_hard_examples, multiclass_nms, fused ssd_loss.
+
+Parity: paddle/fluid/operators/{prior_box_op,iou_similarity_op,box_coder_op,
+bipartite_match_op,target_assign_op,mine_hard_examples_op,multiclass_nms_op}
+.{h,cc} and the ssd_loss layer composition in
+python/paddle/fluid/layers/detection.py:348.
+
+Layout: ground-truth boxes/labels are padded dense [B, G, ...] + GtLen
+(the reference walks LoD offsets host-side). The greedy bipartite match
+and NMS become fixed-trip-count lax loops over the small G / keep_top_k
+dims, batched over B — device-resident instead of the reference's
+CPU-only kernels.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register, single
+
+_EPS = 1e-6
+
+
+# ---------------------------------------------------------------------------
+# geometry helpers (shared by op lowerings and the fused ssd_loss)
+# ---------------------------------------------------------------------------
+
+def iou_matrix(x, y):
+    """x [..., N, 4], y [..., M, 4] -> IoU [..., N, M] (corner encoding).
+
+    Matches iou_similarity_op.h IOUSimilarityFunctor (no +1 pixel; plain
+    normalized coordinates)."""
+    x1, y1, x2, y2 = [x[..., :, None, i] for i in range(4)]
+    a1, b1, a2, b2 = [y[..., None, :, i] for i in range(4)]
+    iw = jnp.maximum(jnp.minimum(x2, a2) - jnp.maximum(x1, a1), 0.0)
+    ih = jnp.maximum(jnp.minimum(y2, b2) - jnp.maximum(y1, b1), 0.0)
+    inter = iw * ih
+    area_x = jnp.maximum(x2 - x1, 0.0) * jnp.maximum(y2 - y1, 0.0)
+    area_y = jnp.maximum(a2 - a1, 0.0) * jnp.maximum(b2 - b1, 0.0)
+    union = area_x + area_y - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _center_size(box):
+    w = box[..., 2] - box[..., 0]
+    h = box[..., 3] - box[..., 1]
+    cx = (box[..., 2] + box[..., 0]) / 2
+    cy = (box[..., 3] + box[..., 1]) / 2
+    return cx, cy, w, h
+
+
+def encode_center_size(target, prior, prior_var):
+    """target [..., 4] vs prior [..., 4] (broadcastable) -> offsets.
+
+    box_coder_op.h EncodeCenterSize."""
+    pcx, pcy, pw, ph = _center_size(prior)
+    tcx, tcy, tw, th = _center_size(target)
+    out = jnp.stack([
+        (tcx - pcx) / pw / prior_var[..., 0],
+        (tcy - pcy) / ph / prior_var[..., 1],
+        jnp.log(jnp.abs(tw / pw)) / prior_var[..., 2],
+        jnp.log(jnp.abs(th / ph)) / prior_var[..., 3],
+    ], axis=-1)
+    return out
+
+
+def decode_center_size(target, prior, prior_var):
+    """box_coder_op.h DecodeCenterSize: target offsets -> corner boxes."""
+    pcx, pcy, pw, ph = _center_size(prior)
+    cx = prior_var[..., 0] * target[..., 0] * pw + pcx
+    cy = prior_var[..., 1] * target[..., 1] * ph + pcy
+    w = jnp.exp(prior_var[..., 2] * target[..., 2]) * pw
+    h = jnp.exp(prior_var[..., 3] * target[..., 3]) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def bipartite_match_batch(dist, gt_valid):
+    """Greedy global-max matching per batch row.
+
+    dist [B, G, M], gt_valid [B, G] -> (match_idx [B, M] int32 (-1 = none),
+    match_dist [B, M]). Mirrors BipartiteMatchKernel::BipartiteMatch: repeat
+    G times: take the global (row, col) argmax over unmatched rows/cols with
+    dist >= EPS; assign col -> row."""
+    b, g, m = dist.shape
+    dist = jnp.where(gt_valid[:, :, None], dist, 0.0)
+
+    def body(_, carry):
+        match_idx, match_dist, row_free = carry
+        cand = jnp.where(row_free[:, :, None] & (match_idx == -1)[:, None, :],
+                         dist, -1.0)
+        flat = cand.reshape(b, g * m)
+        best = jnp.argmax(flat, axis=1)
+        best_val = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        r, c = best // m, best % m
+        ok = best_val >= _EPS
+        match_idx = jnp.where(
+            ok[:, None] & (jnp.arange(m)[None, :] == c[:, None]),
+            r[:, None].astype(jnp.int32), match_idx)
+        match_dist = jnp.where(
+            ok[:, None] & (jnp.arange(m)[None, :] == c[:, None]),
+            best_val[:, None], match_dist)
+        row_free = row_free & ~(ok[:, None] &
+                                (jnp.arange(g)[None, :] == r[:, None]))
+        return match_idx, match_dist, row_free
+
+    init = (jnp.full((b, m), -1, jnp.int32), jnp.zeros((b, m)),
+            gt_valid)
+    match_idx, match_dist, _ = lax.fori_loop(0, g, body, init)
+    return match_idx, match_dist
+
+
+def argmax_match_fill(dist, gt_valid, match_idx, match_dist, threshold):
+    """ArgMaxMatch (match_type='per_prediction'): for still-unmatched
+    columns, match to the argmax row if dist >= threshold."""
+    masked = jnp.where(gt_valid[:, :, None], dist, -1.0)
+    best_r = jnp.argmax(masked, axis=1).astype(jnp.int32)     # [B, M]
+    best_v = jnp.max(masked, axis=1)
+    fill = (match_idx == -1) & (best_v >= threshold)
+    return (jnp.where(fill, best_r, match_idx),
+            jnp.where(fill, best_v, match_dist))
+
+
+# ---------------------------------------------------------------------------
+# op lowerings
+# ---------------------------------------------------------------------------
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+@register("prior_box")
+def _prior_box(ctx, ins, attrs):
+    x = single(ins, "Input")    # feature map [B, C, fh, fw]
+    img = single(ins, "Image")  # [B, C, ih, iw]
+    min_sizes = [float(v) for v in attrs["min_sizes"]]
+    max_sizes = [float(v) for v in attrs.get("max_sizes", []) or []]
+    ars = _expand_aspect_ratios(
+        [float(v) for v in attrs.get("aspect_ratios", [1.0])],
+        bool(attrs.get("flip", False)))
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(attrs.get("clip", False))
+    offset = float(attrs.get("offset", 0.5))
+    fh, fw = x.shape[2], x.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = float(attrs.get("step_w", 0) or 0) or iw / fw
+    step_h = float(attrs.get("step_h", 0) or 0) or ih / fh
+
+    # per-cell half-sizes, in reference order: [min, (max,) ar!=1...] per s
+    half = []
+    for s, ms in enumerate(min_sizes):
+        half.append((ms / 2.0, ms / 2.0))
+        if max_sizes:
+            mx = np.sqrt(ms * max_sizes[s]) / 2.0
+            half.append((mx, mx))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            half.append((ms * np.sqrt(ar) / 2.0, ms / np.sqrt(ar) / 2.0))
+    half = np.asarray(half, np.float32)              # [P, 2] (w, h)
+    p = half.shape[0]
+
+    cx = (np.arange(fw) + offset) * step_w           # [fw]
+    cy = (np.arange(fh) + offset) * step_h           # [fh]
+    cxg, cyg = np.meshgrid(cx, cy)                   # [fh, fw]
+    boxes = np.stack([
+        (cxg[:, :, None] - half[None, None, :, 0]) / iw,
+        (cyg[:, :, None] - half[None, None, :, 1]) / ih,
+        (cxg[:, :, None] + half[None, None, :, 0]) / iw,
+        (cyg[:, :, None] + half[None, None, :, 1]) / ih,
+    ], axis=-1).astype(np.float32)                   # [fh, fw, P, 4]
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          (fh, fw, p, 4)).copy()
+    return {"Boxes": [jnp.asarray(boxes)], "Variances": [jnp.asarray(var)]}
+
+
+@register("iou_similarity")
+def _iou_similarity(ctx, ins, attrs):
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    return {"Out": [iou_matrix(x, y)]}
+
+
+@register("box_coder")
+def _box_coder(ctx, ins, attrs):
+    prior = single(ins, "PriorBox")        # [M, 4]
+    prior_var = single(ins, "PriorBoxVar")  # [M, 4]
+    target = single(ins, "TargetBox")
+    code_type = attrs.get("code_type", "encode_center_size")
+    if code_type == "encode_center_size":
+        # target [N, 4] x prior [M, 4] -> [N, M, 4]
+        out = encode_center_size(target[:, None, :], prior[None, :, :],
+                                 prior_var[None, :, :])
+    else:
+        # target [N, M, 4] offsets vs prior [M, 4] -> [N, M, 4]
+        out = decode_center_size(target, prior[None, :, :],
+                                 prior_var[None, :, :])
+    return {"OutputBox": [out]}
+
+
+@register("bipartite_match")
+def _bipartite_match(ctx, ins, attrs):
+    dist = single(ins, "DistMat")          # [B, G, M]
+    glen = single(ins, "GtLen").astype(jnp.int32)
+    g = dist.shape[1]
+    gt_valid = jnp.arange(g, dtype=jnp.int32)[None, :] < glen[:, None]
+    midx, mdist = bipartite_match_batch(dist, gt_valid)
+    mtype = attrs.get("match_type", "bipartite")
+    if mtype == "per_prediction":
+        midx, mdist = argmax_match_fill(
+            dist, gt_valid, midx, mdist,
+            float(attrs.get("dist_threshold", 0.5)))
+    return {"ColToRowMatchIndices": [midx], "ColToRowMatchDist": [mdist]}
+
+
+@register("target_assign")
+def _target_assign(ctx, ins, attrs):
+    """Gather per-prior targets by match indices (target_assign_op.h).
+
+    X [B, G, K] (gt feature), MatchIndices [B, M] -> Out [B, M, K];
+    unmatched (= -1) get mismatch_value and weight 0."""
+    x = single(ins, "X")
+    midx = single(ins, "MatchIndices")
+    mismatch = attrs.get("mismatch_value", 0)
+    safe = jnp.maximum(midx, 0)
+    out = jnp.take_along_axis(x, safe[:, :, None], axis=1)
+    matched = (midx >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch, x.dtype))
+    w = matched.astype(jnp.float32)
+    return {"Out": [out], "OutWeight": [w]}
+
+
+@register("mine_hard_examples")
+def _mine_hard_examples(ctx, ins, attrs):
+    """max_negative mining: among unmatched priors, keep the
+    neg_pos_ratio * num_pos with highest conf loss (mine_hard_examples_op.cc).
+    Output NegMask [B, M] (1 = selected negative) — dense stand-in for the
+    reference's LoD NegIndices."""
+    cls_loss = single(ins, "ClsLoss")        # [B, M]
+    midx = single(ins, "MatchIndices")       # [B, M]
+    mdist = single(ins, "MatchDist")
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    thresh = float(attrs.get("neg_dist_threshold", 0.5))
+    b, m = cls_loss.shape
+    eligible = (midx == -1) & (mdist < thresh)
+    num_pos = jnp.sum((midx != -1).astype(jnp.int32), axis=1)
+    neg_sel = jnp.minimum((num_pos.astype(jnp.float32) * ratio)
+                          .astype(jnp.int32),
+                          jnp.sum(eligible.astype(jnp.int32), axis=1))
+    loss = jnp.where(eligible, cls_loss, -jnp.inf)
+    order = jnp.argsort(-loss, axis=1)                  # descending
+    rank_of = jnp.argsort(order, axis=1)                # rank per prior
+    neg_mask = eligible & (rank_of < neg_sel[:, None])
+    return {"NegMask": [neg_mask.astype(jnp.float32)]}
+
+
+def _nms_single(boxes, scores, score_threshold, nms_threshold, nms_top_k,
+                eta):
+    """Greedy NMS for one class: returns keep mask [M] (multiclass_nms_op.cc
+    NMSFast)."""
+    m = boxes.shape[0]
+    valid = scores > score_threshold
+    neg = jnp.asarray(-jnp.inf, scores.dtype)
+    s = jnp.where(valid, scores, neg)
+    order = jnp.argsort(-s)
+    if nms_top_k > 0 and nms_top_k < m:
+        order = order[:nms_top_k]
+    sboxes = boxes[order]
+    svalid = valid[order]
+    n = order.shape[0]
+    ious = iou_matrix(sboxes, sboxes)                   # [n, n]
+
+    def body(i, carry):
+        # keep i if no higher-ranked kept box overlaps > adaptive threshold
+        keep, thresh = carry
+        over = (ious[i] > thresh) & keep & (jnp.arange(n) < i)
+        ki = svalid[i] & ~jnp.any(over)
+        # NMSFast: adaptive threshold decays after each kept box (eta < 1)
+        thresh = jnp.where(ki & (eta < 1.0) & (thresh > 0.5), thresh * eta,
+                           thresh)
+        return keep.at[i].set(ki), thresh
+
+    keep_sorted, _ = lax.fori_loop(
+        0, n, body, (jnp.zeros((n,), bool), jnp.asarray(nms_threshold)))
+    keep = jnp.zeros((m,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+@register("multiclass_nms")
+def _multiclass_nms(ctx, ins, attrs):
+    """BBoxes [B, M, 4], Scores [B, C, M] -> Out [B, keep_top_k, 6]
+    ([label, score, xmin, ymin, xmax, ymax], -1-padded) + OutLen."""
+    bboxes = single(ins, "BBoxes")
+    scores = single(ins, "Scores")
+    background = int(attrs.get("background_label", 0))
+    score_threshold = float(attrs.get("score_threshold", 0.01))
+    nms_top_k = int(attrs.get("nms_top_k", 400))
+    nms_threshold = float(attrs.get("nms_threshold", 0.45))
+    keep_top_k = int(attrs.get("keep_top_k", 200))
+    eta = float(attrs.get("nms_eta", 1.0))
+    b, m = bboxes.shape[0], bboxes.shape[1]
+    c = scores.shape[1]
+
+    def per_image(boxes, sc):
+        # keep mask per class
+        keeps = []
+        for cls in range(c):
+            if cls == background:
+                keeps.append(jnp.zeros((m,), bool))
+                continue
+            keeps.append(_nms_single(boxes, sc[cls], score_threshold,
+                                     nms_threshold, nms_top_k, eta))
+        keep = jnp.stack(keeps)                          # [C, M]
+        flat_score = jnp.where(keep, sc, -jnp.inf).reshape(-1)  # [C*M]
+        k = min(keep_top_k, c * m)
+        top_s, top_i = lax.top_k(flat_score, k)
+        cls_i = (top_i // m).astype(jnp.float32)
+        box_i = top_i % m
+        sel = jnp.take(boxes, box_i, axis=0)             # [k, 4]
+        good = top_s > -jnp.inf
+        out = jnp.concatenate([
+            jnp.where(good, cls_i, -1.0)[:, None],
+            jnp.where(good, top_s, -1.0)[:, None],
+            jnp.where(good[:, None], sel, -1.0)], axis=1)
+        return out, jnp.sum(good.astype(jnp.int32))
+
+    outs, lens = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [outs], "OutLen": [lens]}
+
+
+@register("ssd_loss")
+def _ssd_loss(ctx, ins, attrs):
+    """Fused SSD loss (detection.py:348 ssd_loss layer composition):
+    iou -> bipartite(+per_prediction) match -> encode loc targets ->
+    smooth_l1 loc loss + softmax conf loss -> hard negative mining ->
+    normalized weighted sum. One op instead of ~10, same math."""
+    loc = single(ins, "Location")        # [B, M, 4]
+    conf = single(ins, "Confidence")     # [B, M, C]
+    gt_box = single(ins, "GtBox")        # [B, G, 4]
+    gt_label = single(ins, "GtLabel")    # [B, G] or [B, G, 1]
+    glen = single(ins, "GtLen").astype(jnp.int32)
+    prior = single(ins, "PriorBox")      # [M, 4]
+    prior_var = single(ins, "PriorBoxVar")  # [M, 4] (optional; default 1s)
+    if prior_var is None:
+        prior_var = jnp.ones_like(prior)
+    if gt_label.ndim == 3:
+        gt_label = gt_label[:, :, 0]
+    gt_label = gt_label.astype(jnp.int32)
+    background = int(attrs.get("background_label", 0))
+    overlap_threshold = float(attrs.get("overlap_threshold", 0.5))
+    neg_overlap = float(attrs.get("neg_overlap", 0.5))
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    mtype = attrs.get("match_type", "per_prediction")
+
+    b, m = loc.shape[0], loc.shape[1]
+    g = gt_box.shape[1]
+    gt_valid = jnp.arange(g, dtype=jnp.int32)[None, :] < glen[:, None]
+
+    iou = iou_matrix(gt_box, prior[None])               # [B, G, M]
+    midx, mdist = bipartite_match_batch(iou, gt_valid)
+    if mtype == "per_prediction":
+        midx, mdist = argmax_match_fill(iou, gt_valid, midx, mdist,
+                                        overlap_threshold)
+
+    matched = midx >= 0                                  # [B, M]
+    safe = jnp.maximum(midx, 0)
+    # conf target: matched -> gt label, else background
+    tgt_label = jnp.take_along_axis(gt_label, safe, axis=1)
+    tgt_label = jnp.where(matched, tgt_label, background)
+    # loc target: encoded offsets of matched gt vs prior
+    tgt_box = jnp.take_along_axis(gt_box, safe[:, :, None], axis=1)
+    loc_tgt = encode_center_size(tgt_box, prior[None], prior_var[None])
+
+    # conf loss: softmax cross entropy per prior
+    lp = jax.nn.log_softmax(conf, axis=-1)
+    conf_loss = -jnp.take_along_axis(lp, tgt_label[:, :, None],
+                                     axis=2)[:, :, 0]   # [B, M]
+
+    # hard negative mining (max_negative)
+    eligible = (~matched) & (mdist < neg_overlap)
+    num_pos = jnp.sum(matched.astype(jnp.int32), axis=1)
+    neg_sel = jnp.minimum((num_pos.astype(jnp.float32) * neg_pos_ratio)
+                          .astype(jnp.int32),
+                          jnp.sum(eligible.astype(jnp.int32), axis=1))
+    neg_loss = jnp.where(eligible, conf_loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank_of = jnp.argsort(order, axis=1)
+    neg_mask = eligible & (rank_of < neg_sel[:, None])
+
+    conf_weight = matched.astype(jnp.float32) + neg_mask.astype(jnp.float32)
+
+    # loc loss: smooth l1 on matched priors
+    diff = loc - lax.stop_gradient(loc_tgt)
+    ad = jnp.abs(diff)
+    smooth = jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5)
+    loc_loss = jnp.sum(smooth, axis=2) * matched.astype(jnp.float32)
+
+    # per-image sum over priors -> [B, 1]; normalize by the total matched
+    # count = reduce_sum(target_loc_weight) (detection.py:556-560)
+    loss = (conf_w * conf_loss * lax.stop_gradient(conf_weight) +
+            loc_w * loc_loss)                            # [B, M]
+    loss = jnp.sum(loss, axis=1, keepdims=True)          # [B, 1]
+    if bool(attrs.get("normalize", True)):
+        normalizer = jnp.maximum(
+            lax.stop_gradient(jnp.sum(num_pos).astype(jnp.float32)), 1.0)
+        loss = loss / normalizer
+    return {"Loss": [loss]}

@@ -73,7 +73,7 @@ class ParallelExecutor(object):
         entry = self._cache.get(key)
         if entry is None:
             state_rw, state_ro, state_out = lowering.analyze_state(
-                program, feed_names)
+                program, feed_names, fetch_names)
             fn = lowering.build_program_fn(
                 program, feed_names, fetch_names, state_rw, state_ro,
                 state_out, mesh=self.mesh)

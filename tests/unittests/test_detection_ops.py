@@ -1,0 +1,229 @@
+"""Detection ops vs numpy references.
+
+Parity: reference tests/unittests/{test_prior_box_op,test_iou_similarity_op,
+test_box_coder_op,test_bipartite_match_op,test_multiclass_nms_op}.py and a
+full SSD pipeline smoke (multi_box_head -> ssd_loss -> detection_output).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+from op_test import run_op
+
+
+def np_iou(a, b):
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = iw * ih
+    ua = max(0, a[2] - a[0]) * max(0, a[3] - a[1]) + \
+        max(0, b[2] - b[0]) * max(0, b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_iou_similarity():
+    rng = np.random.RandomState(0)
+    x = np.sort(rng.rand(5, 2, 2), axis=2).reshape(5, 4).astype("f")
+    x = x[:, [0, 2, 1, 3]]
+    y = np.sort(rng.rand(7, 2, 2), axis=2).reshape(7, 4).astype("f")
+    y = y[:, [0, 2, 1, 3]]
+    out, = run_op("iou_similarity", {"X": x, "Y": y})
+    out = np.asarray(out)
+    for i in range(5):
+        for j in range(7):
+            np.testing.assert_allclose(out[i, j], np_iou(x[i], y[j]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(1)
+    prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.3, 0.2, 0.9, 0.8]], "f")
+    var = np.full((2, 4), 0.1, "f")
+    target = np.array([[0.2, 0.2, 0.6, 0.7]], "f")
+    enc, = run_op("box_coder",
+                  {"PriorBox": prior, "PriorBoxVar": var,
+                   "TargetBox": target},
+                  attrs={"code_type": "encode_center_size"},
+                  out_slots=("OutputBox",))
+    enc = np.asarray(enc)          # [1, 2, 4]
+    # manual encode vs prior 0
+    pw, ph = 0.4, 0.4
+    pcx, pcy = 0.3, 0.3
+    tcx, tcy, tw, th = 0.4, 0.45, 0.4, 0.5
+    want = [(tcx - pcx) / pw / 0.1, (tcy - pcy) / ph / 0.1,
+            np.log(tw / pw) / 0.1, np.log(th / ph) / 0.1]
+    np.testing.assert_allclose(enc[0, 0], want, rtol=1e-4, atol=1e-5)
+    # decode round-trips to the target box
+    dec, = run_op("box_coder",
+                  {"PriorBox": prior, "PriorBoxVar": var, "TargetBox": enc},
+                  attrs={"code_type": "decode_center_size"},
+                  out_slots=("OutputBox",))
+    dec = np.asarray(dec)
+    np.testing.assert_allclose(dec[0, 0], target[0], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(dec[0, 1], target[0], rtol=1e-4, atol=1e-5)
+
+
+def ref_bipartite(dist):
+    """Port of BipartiteMatchKernel::BipartiteMatch."""
+    g, m = dist.shape
+    match = -np.ones(m, dtype=int)
+    mdist = np.zeros(m)
+    row_pool = list(range(g))
+    while row_pool:
+        best = (-1, -1, -1.0)
+        for j in range(m):
+            if match[j] != -1:
+                continue
+            for r in row_pool:
+                if dist[r, j] < 1e-6:
+                    continue
+                if dist[r, j] > best[2]:
+                    best = (r, j, dist[r, j])
+        if best[0] == -1:
+            break
+        match[best[1]] = best[0]
+        mdist[best[1]] = best[2]
+        row_pool.remove(best[0])
+    return match, mdist
+
+
+def test_bipartite_match_vs_reference():
+    rng = np.random.RandomState(2)
+    b, g, m = 3, 4, 6
+    dist = rng.rand(b, g, m).astype("f")
+    dist[1, 2:] = 0.0  # only 2 valid gt rows worth of signal
+    glen = np.array([4, 2, 3], "int32")
+    midx, mdist = run_op(
+        "bipartite_match", {"DistMat": dist, "GtLen": glen},
+        out_slots=("ColToRowMatchIndices", "ColToRowMatchDist"))
+    midx, mdist = np.asarray(midx), np.asarray(mdist)
+    for i in range(b):
+        want_idx, want_dist = ref_bipartite(dist[i, :glen[i]])
+        np.testing.assert_array_equal(midx[i], want_idx, "img %d" % i)
+        np.testing.assert_allclose(mdist[i], want_dist, rtol=1e-5)
+
+
+def test_prior_box_geometry():
+    x = np.zeros((1, 8, 4, 4), "f")
+    img = np.zeros((1, 3, 32, 32), "f")
+    boxes, var = run_op(
+        "prior_box", {"Input": x, "Image": img},
+        attrs={"min_sizes": [8.0], "max_sizes": [16.0],
+               "aspect_ratios": [2.0], "flip": True, "clip": True,
+               "variances": [0.1, 0.1, 0.2, 0.2]},
+        out_slots=("Boxes", "Variances"))
+    boxes, var = np.asarray(boxes), np.asarray(var)
+    # priors: min, sqrt(min*max), ar=2, ar=0.5 -> 4
+    assert boxes.shape == (4, 4, 4, 4)
+    assert var.shape == (4, 4, 4, 4)
+    # cell (0,0): center = 0.5*8=4 -> first prior [0, 0, 8, 8]/32
+    np.testing.assert_allclose(boxes[0, 0, 0], [0, 0, 0.25, 0.25],
+                               atol=1e-6)
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+
+
+def test_multiclass_nms():
+    # two overlapping boxes of class 1: keep higher-score one
+    boxes = np.array([[[0.1, 0.1, 0.5, 0.5],
+                       [0.12, 0.12, 0.52, 0.52],
+                       [0.6, 0.6, 0.9, 0.9]]], "f")
+    scores = np.zeros((1, 3, 3), "f")   # [B, C, M]
+    scores[0, 1] = [0.9, 0.8, 0.02]     # class 1
+    scores[0, 2] = [0.01, 0.01, 0.7]    # class 2
+    out, olen = run_op(
+        "multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+        attrs={"background_label": 0, "score_threshold": 0.05,
+               "nms_threshold": 0.4, "nms_top_k": 10, "keep_top_k": 5},
+        out_slots=("Out", "OutLen"))
+    out, olen = np.asarray(out), np.asarray(olen)
+    assert olen[0] == 2
+    kept = out[0, :2]
+    assert kept[0][0] == 1.0 and abs(kept[0][1] - 0.9) < 1e-6
+    assert kept[1][0] == 2.0 and abs(kept[1][1] - 0.7) < 1e-6
+    np.testing.assert_allclose(kept[0][2:], boxes[0, 0], rtol=1e-6)
+    assert (out[0, 2:] == -1).all()
+
+
+def test_ssd_pipeline_trains():
+    """multi_box_head -> ssd_loss decreases; detection_output runs."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        image = fluid.layers.data(name="image", shape=[3, 32, 32])
+        gt_box = fluid.layers.data(name="gt_box", shape=[4], lod_level=1)
+        gt_label = fluid.layers.data(name="gt_label", shape=[1],
+                                     dtype="int64", lod_level=1)
+        conv = fluid.layers.conv2d(image, 16, 3, padding=1, act="relu",
+                                   stride=2)
+        conv2 = fluid.layers.conv2d(conv, 32, 3, padding=1, act="relu",
+                                    stride=2)
+        locs, confs, box, var = fluid.layers.multi_box_head(
+            inputs=[conv, conv2], image=image, base_size=32, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]], min_sizes=[4.0, 8.0],
+            max_sizes=[8.0, 16.0], flip=True, clip=True)
+        loss = fluid.layers.ssd_loss(locs, confs, gt_box, gt_label, box, var)
+        loss = fluid.layers.reduce_sum(loss)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        nmsed = fluid.layers.detection_output(locs, confs, box, var,
+                                              score_threshold=0.01)
+
+    rng = np.random.RandomState(0)
+
+    def batch(n=4):
+        imgs = rng.rand(n, 3, 32, 32).astype("f")
+        gb, gl = [], []
+        for _ in range(n):
+            k = rng.randint(1, 3)
+            b0 = np.sort(rng.rand(k, 2, 2), axis=1)  # valid corner boxes
+            gb.append(np.stack([b0[:, 0, 0], b0[:, 0, 1],
+                                b0[:, 1, 0], b0[:, 1, 1]], 1).astype("f"))
+            gl.append(rng.randint(1, 3, (k, 1)).astype("int64"))
+        return imgs, LoDTensor.from_sequences(gb), LoDTensor.from_sequences(gl)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for i in range(25):
+            imgs, gb, gl = batch()
+            l, = exe.run(main, feed={"image": imgs, "gt_box": gb,
+                                     "gt_label": gl}, fetch_list=[loss])
+            losses.append(float(np.ravel(l)[0]))
+        imgs, gb, gl = batch()
+        det, = exe.run(main, feed={"image": imgs, "gt_box": gb,
+                                   "gt_label": gl}, fetch_list=[nmsed])
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses[::5]
+    det = np.asarray(det)
+    assert det.shape[0] == 4 and det.shape[2] == 6
+
+
+def test_ssd_loss_default_prior_var():
+    """ssd_loss with prior_box_var=None (documented default) must work."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        loc = fluid.layers.data(name="loc", shape=[8, 4])
+        conf = fluid.layers.data(name="conf", shape=[8, 3])
+        gt_box = fluid.layers.data(name="gt_box", shape=[4], lod_level=1)
+        gt_label = fluid.layers.data(name="gt_label", shape=[1],
+                                     dtype="int64", lod_level=1)
+        pb = fluid.layers.data(name="pb", shape=[8, 4],
+                               append_batch_size=False)
+        loss = fluid.layers.ssd_loss(loc, conf, gt_box, gt_label, pb)
+    rng = np.random.RandomState(0)
+    pbv = np.sort(rng.rand(8, 2, 2), axis=1).reshape(8, 4).astype("f")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out, = exe.run(main, feed={
+            "loc": rng.randn(2, 8, 4).astype("f"),
+            "conf": rng.randn(2, 8, 3).astype("f"),
+            "gt_box": LoDTensor.from_sequences(
+                [pbv[:2].copy(), pbv[3:4].copy()]),
+            "gt_label": LoDTensor.from_sequences(
+                [np.array([[1], [2]], "int64"), np.array([[1]], "int64")]),
+            "pb": pbv}, fetch_list=[loss])
+    assert np.asarray(out).shape == (2, 1)
+    assert np.isfinite(np.asarray(out)).all()
