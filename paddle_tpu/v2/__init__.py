@@ -1,0 +1,38 @@
+"""paddle.v2 compatibility shim (legacy trainer API, tier 3).
+
+Parity: python/paddle/v2/__init__.py surface — layer/activation/data_type/
+attr/pooling/networks/optimizer/parameters/trainer/event/inference/
+minibatch/dataset/reader — implemented as a thin eager layer over the
+paddle_tpu fluid core (SURVEY.md §2 "Legacy v2 API"): every v2 layer call
+appends ops to the default fluid program; trainer.SGD drives the fluid
+Executor. The gserver/trainer_config_helpers machinery the reference
+wraps is subsumed by the fluid op set.
+"""
+from .. import datasets as dataset          # noqa: F401
+from .. import reader                       # noqa: F401
+from ..reader import batch                  # noqa: F401
+from . import activation                    # noqa: F401
+from . import attr                          # noqa: F401
+from . import data_type                     # noqa: F401
+from . import pooling                       # noqa: F401
+from . import layer                         # noqa: F401
+from . import networks                      # noqa: F401
+from . import optimizer                     # noqa: F401
+from . import parameters                    # noqa: F401
+from . import trainer                       # noqa: F401
+from . import event                         # noqa: F401
+from . import inference                     # noqa: F401
+from .inference import infer                # noqa: F401
+from . import topology                      # noqa: F401
+from . import minibatch                     # noqa: F401
+
+__all__ = ["init", "dataset", "reader", "batch", "layer", "activation",
+           "data_type", "attr", "pooling", "networks", "optimizer",
+           "parameters", "trainer", "event", "inference", "infer",
+           "topology", "minibatch"]
+
+
+def init(**kwargs):
+    """paddle.v2.init(use_gpu=..., trainer_count=...): device selection is
+    jax-managed; accepted for compatibility."""
+    return None

@@ -1,0 +1,28 @@
+"""v2 activation objects (python/paddle/v2/activation.py)."""
+
+
+class BaseActivation(object):
+    name = None
+
+    def __repr__(self):
+        return "Activation(%s)" % self.name
+
+
+def _make(name, fluid_name):
+    cls = type(name, (BaseActivation,), {"name": fluid_name})
+    return cls
+
+
+Linear = _make("Linear", None)
+Relu = _make("Relu", "relu")
+Tanh = _make("Tanh", "tanh")
+Sigmoid = _make("Sigmoid", "sigmoid")
+Softmax = _make("Softmax", "softmax")
+Exp = _make("Exp", "exp")
+Log = _make("Log", "log")
+Square = _make("Square", "square")
+Sqrt = _make("Sqrt", "sqrt")
+Abs = _make("Abs", "abs")
+SoftRelu = _make("SoftRelu", "softplus")
+BRelu = _make("BRelu", "brelu")
+STanh = _make("STanh", "stanh")

@@ -1,0 +1,19 @@
+"""v2 parameter attributes (python/paddle/v2/attr.py)."""
+from ..core.param_attr import ParamAttr
+
+
+def Param(name=None, is_static=False, initial_std=None, initial_mean=None,
+          l2_rate=None, learning_rate=None, **kwargs):
+    from ..core.initializer import NormalInitializer
+    from ..regularizer import L2Decay
+    init = None
+    if initial_std is not None or initial_mean is not None:
+        init = NormalInitializer(initial_mean or 0.0, initial_std or 1.0)
+    reg = L2Decay(l2_rate) if l2_rate else None
+    return ParamAttr(name=name, initializer=init, regularizer=reg,
+                     learning_rate=learning_rate or 1.0,
+                     trainable=not is_static)
+
+
+ParameterAttribute = Param
+Extra = dict

@@ -1,0 +1,32 @@
+"""v2 input type declarations (python/paddle/v2/data_type.py)."""
+
+
+class InputType(object):
+    def __init__(self, dim, seq_type, dtype):
+        self.dim = dim
+        self.seq_type = seq_type  # 0 = no sequence, 1 = sequence
+        self.dtype = dtype
+
+
+def dense_vector(dim, seq_type=0):
+    return InputType(dim, seq_type, "float32")
+
+
+def dense_vector_sequence(dim):
+    return dense_vector(dim, 1)
+
+
+def integer_value(value_range, seq_type=0):
+    return InputType(value_range, seq_type, "int64")
+
+
+def integer_value_sequence(value_range):
+    return integer_value(value_range, 1)
+
+
+def sparse_binary_vector(dim, seq_type=0):
+    return InputType(dim, seq_type, "int64")
+
+
+def sparse_float_vector(dim, seq_type=0):
+    return InputType(dim, seq_type, "float32")

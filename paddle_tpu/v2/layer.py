@@ -1,0 +1,81 @@
+"""v2 layers: eager shims over fluid layers (python/paddle/v2/layer.py).
+
+Each call appends ops to the default fluid programs; the returned fluid
+Variable doubles as the v2 "layer output" handle (it carries .name for
+feeding, which is all the v2 trainer needs).
+"""
+import paddle_tpu as fluid
+from .activation import BaseActivation
+from . import data_type as _dt
+
+__all__ = ["data", "fc", "embedding", "classification_cost",
+           "cross_entropy_cost", "square_error_cost", "lstmemory",
+           "max_id", "concat", "pool", "dropout"]
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    if isinstance(act, type) and issubclass(act, BaseActivation):
+        act = act()
+    return act.name
+
+
+def data(name, type):
+    lod = 1 if type.seq_type else 0
+    shape = [1] if type.dtype == "int64" else [type.dim]
+    v = fluid.layers.data(name=name, shape=shape, dtype=type.dtype,
+                          lod_level=lod)
+    v.v2_type = type
+    return v
+
+
+def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None):
+    inputs = input if isinstance(input, (list, tuple)) else input
+    return fluid.layers.fc(input=inputs, size=size, act=_act_name(act),
+                           param_attr=param_attr, bias_attr=bias_attr)
+
+
+def embedding(input, size, param_attr=None):
+    dict_size = getattr(input, "v2_type", None)
+    dim = dict_size.dim if dict_size else None
+    return fluid.layers.embedding(input=input, size=[dim, size],
+                                  param_attr=param_attr)
+
+
+def classification_cost(input, label):
+    cost = fluid.layers.cross_entropy(input=input, label=label)
+    return fluid.layers.mean(x=cost)
+
+
+cross_entropy_cost = classification_cost
+
+
+def square_error_cost(input, label):
+    cost = fluid.layers.square_error_cost(input=input, label=label)
+    return fluid.layers.mean(x=cost)
+
+
+def lstmemory(input, size=None, reverse=False, act=None, **kwargs):
+    hidden = size or input.shape[-1] // 4
+    h, c = fluid.layers.dynamic_lstm(
+        input=input, size=hidden * 4, is_reverse=reverse,
+        candidate_activation=_act_name(act) or "tanh")
+    return h
+
+
+def max_id(input):
+    return fluid.layers.argmax(input, axis=-1)
+
+
+def concat(input, axis=1):
+    return fluid.layers.concat(input=list(input), axis=axis)
+
+
+def pool(input, pooling_type=None):
+    name = pooling_type.name if pooling_type else "max"
+    return fluid.layers.sequence_pool(input=input, pool_type=name)
+
+
+def dropout(input, dropout_rate):
+    return fluid.layers.dropout(x=input, dropout_prob=dropout_rate)

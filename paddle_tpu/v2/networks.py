@@ -1,0 +1,36 @@
+"""v2 composite networks (python/paddle/v2/networks.py) over fluid.nets."""
+import paddle_tpu as fluid
+from .layer import _act_name
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "simple_lstm"]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, **kwargs):
+    return fluid.nets.simple_img_conv_pool(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        pool_size=pool_size, pool_stride=pool_stride, act=_act_name(act))
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_filter_size=3,
+                   conv_act=None, conv_with_batchnorm=False, pool_stride=1,
+                   pool_type="max", **kwargs):
+    return fluid.nets.img_conv_group(
+        input=input, conv_num_filter=conv_num_filter, pool_size=pool_size,
+        conv_filter_size=conv_filter_size, conv_act=_act_name(conv_act),
+        conv_with_batchnorm=conv_with_batchnorm, pool_stride=pool_stride,
+        pool_type=pool_type)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, act=None,
+                       pool_type="max", **kwargs):
+    return fluid.nets.sequence_conv_pool(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        act=_act_name(act), pool_type=pool_type)
+
+
+def simple_lstm(input, size, **kwargs):
+    fc = fluid.layers.fc(input=input, size=size * 4)
+    h, c = fluid.layers.dynamic_lstm(input=fc, size=size * 4)
+    return h

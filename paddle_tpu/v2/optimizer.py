@@ -1,0 +1,55 @@
+"""v2 optimizers (python/paddle/v2/optimizer.py) -> fluid optimizers."""
+import paddle_tpu as fluid
+
+__all__ = ["Momentum", "Adam", "Adamax", "AdaGrad", "DecayedAdaGrad",
+           "AdaDelta", "RMSProp", "ModelAverage", "L2Regularization"]
+
+
+class Optimizer(object):
+    def __init__(self, fluid_opt):
+        self.fluid_opt = fluid_opt
+
+
+def Momentum(momentum=None, learning_rate=1e-3, sparse=False, **kwargs):
+    return Optimizer(fluid.optimizer.Momentum(
+        learning_rate=learning_rate, momentum=momentum or 0.0))
+
+
+def Adam(beta1=0.9, beta2=0.999, epsilon=1e-8, learning_rate=1e-3, **kw):
+    return Optimizer(fluid.optimizer.Adam(
+        learning_rate=learning_rate, beta1=beta1, beta2=beta2,
+        epsilon=epsilon))
+
+
+def Adamax(beta1=0.9, beta2=0.999, learning_rate=1e-3, **kwargs):
+    return Optimizer(fluid.optimizer.Adamax(
+        learning_rate=learning_rate, beta1=beta1, beta2=beta2))
+
+
+def AdaGrad(learning_rate=1e-3, **kwargs):
+    return Optimizer(fluid.optimizer.Adagrad(learning_rate=learning_rate))
+
+
+def DecayedAdaGrad(rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+    return Optimizer(fluid.optimizer.DecayedAdagrad(
+        learning_rate=learning_rate, decay=rho, epsilon=epsilon))
+
+
+def AdaDelta(rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+    return Optimizer(fluid.optimizer.Adadelta(
+        learning_rate=learning_rate, rho=rho, epsilon=epsilon))
+
+
+def RMSProp(rho=0.95, epsilon=1e-6, learning_rate=1e-3, **kwargs):
+    return Optimizer(fluid.optimizer.RMSProp(
+        learning_rate=learning_rate, rho=rho, epsilon=epsilon))
+
+
+def ModelAverage(average_window=0.5, **kwargs):
+    return Optimizer(fluid.optimizer.ModelAverage(
+        average_window_rate=average_window))
+
+
+def L2Regularization(rate):
+    from ..regularizer import L2Decay
+    return L2Decay(rate)

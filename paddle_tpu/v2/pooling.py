@@ -1,0 +1,21 @@
+"""v2 pooling types (python/paddle/v2/pooling.py)."""
+
+
+class BasePoolingType(object):
+    name = None
+
+
+class Max(BasePoolingType):
+    name = "max"
+
+
+class Avg(BasePoolingType):
+    name = "average"
+
+
+class Sum(BasePoolingType):
+    name = "sum"
+
+
+class SquareRootN(BasePoolingType):
+    name = "sqrt"
