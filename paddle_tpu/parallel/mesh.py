@@ -28,13 +28,28 @@ def make_mesh(axes, devices=None):
     """axes: dict axis_name -> size (use -1 once for 'remaining devices')."""
     devices = devices if devices is not None else jax.devices()
     sizes = dict(axes)
+    if any(s < 1 and s != -1 for s in sizes.values()) \
+            or list(sizes.values()).count(-1) > 1:
+        raise ValueError("make_mesh: axis sizes must be positive, with at "
+                         "most one -1 wildcard; got %r" % (axes,))
     known = int(np.prod([s for s in sizes.values() if s != -1]))
+    if any(v == -1 for v in sizes.values()) and known > len(devices):
+        raise ValueError(
+            "make_mesh: fixed axes in %r already need %d devices but only "
+            "%d are available, leaving none for the -1 wildcard"
+            % (axes, known, len(devices)))
     for k, v in sizes.items():
         if v == -1:
             sizes[k] = len(devices) // known
     names = tuple(sizes)
     shape = tuple(sizes[n] for n in names)
     total = int(np.prod(shape))
+    if any(s < 1 for s in shape) or len(devices) < total:
+        raise ValueError(
+            "make_mesh: axes %r need %d devices but only %d are available "
+            "(run under an n-device backend, e.g. XLA_FLAGS="
+            "--xla_force_host_platform_device_count=%d with JAX_PLATFORMS=cpu)"
+            % (dict(zip(names, shape)), total, len(devices), total))
     arr = np.asarray(devices[:total]).reshape(shape)
     return Mesh(arr, names)
 
