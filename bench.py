@@ -21,15 +21,16 @@ def main():
     import paddle_tpu as fluid
     from paddle_tpu.models.image_classification import build_train
 
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    dtype = os.environ.get("BENCH_DTYPE", "bf16")  # bf16 | fp32
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.unique_name.guard(), fluid.program_guard(main_prog, startup):
         image, label, avg_cost, acc = build_train(
             model="resnet50", class_dim=1000, image_shape=(3, 224, 224),
-            learning_rate=0.1, momentum=0.9)
+            learning_rate=0.1, momentum=0.9, use_bf16=(dtype == "bf16"))
 
     place = fluid.TPUPlace()
     exe = fluid.Executor(place)
@@ -62,6 +63,7 @@ def main():
         "unit": "images/sec/chip",
         "vs_baseline": round(ips / 300.0, 3),
         "batch": batch,
+        "dtype": dtype,
         "device": str(jax.devices()[0]),
         "loss": float(np.asarray(loss).reshape(-1)[0]),
     }))

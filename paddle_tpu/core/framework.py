@@ -318,6 +318,7 @@ class Program(object):
         self._seed = None  # program-level rng seed override
         self.random_seed = 0
         self._op_uid_counter = 0
+        self._amp = False  # bf16 mixed precision (enable_mixed_precision)
 
     def _next_op_uid(self):
         self._op_uid_counter += 1
@@ -351,6 +352,19 @@ class Program(object):
         for blk in self.blocks:
             for v in blk.vars.values():
                 yield v
+
+    def enable_mixed_precision(self, enable=True):
+        """TPU bf16 training path (SURVEY §7 M5; no 2018-fluid counterpart).
+
+        When on, the lowering pass runs the MXU contractions (conv2d, mul,
+        matmul) in bfloat16 (f32 accumulation where the backend provides it:
+        explicit for mul/matmul, the MXU's internal accumulate for conv),
+        keeps normalization statistics and losses in float32, and leaves
+        every parameter in the Scope as a float32 master copy — so
+        optimizers, checkpoints and the user API are unchanged. Purely a
+        compile-time switch: no graph rewrite, no extra state."""
+        self._amp = bool(enable)
+        self._bump_version()
 
     # ---- clone / prune (parity: Program.clone, Program.prune) --------
     def clone(self, for_test=False):

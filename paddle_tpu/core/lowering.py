@@ -34,6 +34,38 @@ def register_special(type):
     return deco
 
 
+# --- bf16 mixed precision (Program.enable_mixed_precision) -----------------
+# Ops whose MXU contraction runs in bfloat16 under AMP. They return bf16
+# outputs, so bf16 propagates through the elementwise/norm chains between
+# them without touching any other rule (batch_norm/layer_norm already
+# compute statistics in f32 regardless of input dtype). Accumulation:
+# mul/matmul request f32 via preferred_element_type; conv relies on the TPU
+# MXU's internal f32 accumulate (see ops/nn_ops.py).
+_AMP_BF16_OPS = frozenset({
+    "conv2d", "depthwise_conv2d", "conv2d_transpose", "mul", "matmul"})
+# Numerically sensitive ops: force their float inputs back up to f32 so the
+# loss/probability path never rounds through bf16.
+_AMP_F32_OPS = frozenset({
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "mean"})
+
+
+def _amp_cast_ins(ins, dtype, from_dtype):
+    def cast(v):
+        if hasattr(v, "dtype") and v.dtype == from_dtype:
+            return v.astype(dtype)
+        return v
+    return {slot: [cast(v) for v in vals] for slot, vals in ins.items()}
+
+
+def _apply_amp(op_type, ins):
+    if op_type in _AMP_BF16_OPS:
+        return _amp_cast_ins(ins, jnp.bfloat16, jnp.float32)
+    if op_type in _AMP_F32_OPS:
+        return _amp_cast_ins(ins, jnp.float32, jnp.bfloat16)
+    return ins
+
+
 class LowerCtx(object):
     """Per-trace context handed to op lowering rules."""
 
@@ -43,6 +75,7 @@ class LowerCtx(object):
         self.is_startup = is_startup
         self.is_abstract = False
         self.mesh = mesh
+        self.amp = bool(getattr(program, "_amp", False))
         self._op_salt = 0
         self._op_calls = 0
         # traced iteration counters of enclosing lax.scan/while_loop bodies
@@ -114,6 +147,8 @@ def lower_op(ctx, op, env):
     od = registry.get(op.type)
     ins = {slot: [env.read(n) for n in names]
            for slot, names in op.inputs.items()}
+    if ctx.amp:
+        ins = _apply_amp(op.type, ins)
     ctx.begin_op(op.uid)
     outs = od.lower(ctx, ins, op.attrs)
     _write_outputs(op, outs, env)
@@ -174,6 +209,11 @@ def _lower_grad_of(ctx, op, env):
         ins = {slot: list(vals) for slot, vals in fwd_in_vals.items()}
         for (slot, i), v in diff.items():
             ins[slot][i] = v
+        if ctx.amp:
+            # the casts live inside the vjp, so bf16 ops get bf16 activation
+            # cotangents while f32 master params receive f32 grads (the vjp
+            # of the f32->bf16 cast upcasts)
+            ins = _apply_amp(fwd_type, ins)
         ctx.begin_op(fwd_uid)  # replay the forward op's exact PRNG stream
         outs = od.lower(ctx, ins, fwd_attrs)
         flat = []

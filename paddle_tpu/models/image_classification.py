@@ -103,11 +103,17 @@ def vgg16(input, class_dim=1000, is_test=False):
 
 def build_train(model="resnet50", class_dim=1000, image_shape=(3, 224, 224),
                 learning_rate=0.01, momentum=0.9, is_test=False,
-                use_softmax_xent_fusion=True):
+                use_softmax_xent_fusion=True, use_bf16=False):
     """Build the full training graph (reference: benchmark/fluid style).
+
+    use_bf16 turns on the TPU mixed-precision path for the enclosing main
+    program (Program.enable_mixed_precision): bf16 MXU compute, f32 master
+    params — SURVEY §7 M5.
 
     Returns (image, label, avg_cost, acc_top1).
     """
+    if use_bf16:
+        fluid.default_main_program().enable_mixed_precision()
     image = fluid.layers.data(name="image", shape=list(image_shape),
                               dtype="float32")
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")

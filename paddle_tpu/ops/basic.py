@@ -128,7 +128,11 @@ def _matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if attrs.get("transpose_Y"):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    out = jnp.matmul(x, y)
+    if x.dtype == jnp.bfloat16 or y.dtype == jnp.bfloat16:
+        out = jnp.matmul(x, y, preferred_element_type=jnp.float32) \
+            .astype(jnp.promote_types(x.dtype, y.dtype))
+    else:
+        out = jnp.matmul(x, y)
     alpha = attrs.get("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha

@@ -8,8 +8,9 @@ lrn_op,maxout_op,label_smooth_op,nce_op}.{cc,cu,h}.
 
 TPU notes: convs/matmuls keep fluid's NCHW layout at the IR level — XLA's TPU
 layout assignment transposes to the MXU-friendly layout internally, so parity
-of semantics costs nothing. bf16 inputs get f32 accumulation via
-preferred_element_type.
+of semantics costs nothing. bf16 convs run bf16-in/bf16-out and rely on the
+TPU MXU's internal f32 accumulate (an explicit preferred_element_type breaks
+conv's grad rule); mul/matmul request f32 accumulation explicitly.
 """
 import numpy as np
 
@@ -37,14 +38,17 @@ def _conv2d(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dil = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
+    # bf16 operands stay bf16 end-to-end: the TPU MXU accumulates in f32
+    # internally, and conv's transpose (grad) rule rejects the
+    # preferred_element_type + downcast pattern (f32 cotangent meets bf16
+    # filter), so an explicit f32 accumulate would break training.
     out = lax.conv_general_dilated(
         x, w,
         window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dil,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        feature_group_count=groups)
     return {"Output": [out.astype(x.dtype)]}
 
 

@@ -1,0 +1,95 @@
+"""bf16 mixed-precision training path (Program.enable_mixed_precision).
+
+The 2018 reference had no AMP; this is the TPU bf16 path SURVEY §7 M5
+commits to: MXU contractions (conv2d/mul/matmul) in bfloat16, f32 master
+parameters in the Scope, losses/statistics in f32.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build_tiny(lr=0.1):
+    x = fluid.layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    c = fluid.layers.conv2d(input=x, num_filters=8, filter_size=3,
+                            padding=1, act="relu")
+    pred = fluid.layers.fc(input=c, size=10, act="softmax")
+    cost = fluid.layers.mean(x=fluid.layers.cross_entropy(input=pred,
+                                                          label=y))
+    fluid.optimizer.SGD(learning_rate=lr).minimize(cost)
+    return cost
+
+
+def _train(amp, steps=30, seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        cost = _build_tiny(lr=0.1)
+    if amp:
+        main.enable_mixed_precision()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(seed)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xs = rng.rand(32, 3, 8, 8).astype("float32")
+        ys = rng.randint(0, 10, (32, 1)).astype("int64")
+        for _ in range(steps):
+            loss, = exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[cost])
+            losses.append(float(np.asarray(loss).reshape(-1)[0]))
+        params = {v.name: np.asarray(scope.get(v.name))
+                  for v in main.global_block().all_parameters()}
+    return losses, params
+
+
+def test_amp_converges_and_tracks_fp32():
+    l32, p32 = _train(amp=False)
+    lbf, pbf = _train(amp=True)
+    assert np.all(np.isfinite(lbf))
+    # fixed batch: both must converge
+    assert lbf[-1] < lbf[0] * 0.5
+    assert l32[-1] < l32[0] * 0.5
+    # loss trajectories agree to bf16 rounding noise
+    np.testing.assert_allclose(lbf, l32, rtol=0.05, atol=0.05)
+
+
+def test_amp_keeps_f32_master_params():
+    _, params = _train(amp=True, steps=2)
+    for name, val in params.items():
+        assert val.dtype == np.float32, (name, val.dtype)
+
+
+def test_amp_version_bump_recompiles():
+    # toggling AMP on an already-compiled program must invalidate the
+    # executor cache (the flag is part of the compiled artifact)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        cost = _build_tiny()
+    v0 = main._version
+    main.enable_mixed_precision()
+    assert main._version > v0
+
+
+@pytest.mark.parametrize("op", ["mul", "matmul", "conv2d"])
+def test_bf16_inputs_give_bf16_outputs(op):
+    """The AMP dtype contract: bf16 compute ops return bf16, keeping the
+    activation chain in bf16 between casts (accumulation precision itself
+    is the MXU's f32 accumulate / preferred_element_type, which XLA owns)."""
+    import jax.numpy as jnp
+    from paddle_tpu.core import registry
+    od = registry.get(op)
+    if op == "conv2d":
+        ins = {"Input": [jnp.ones((2, 3, 8, 8), jnp.bfloat16)],
+               "Filter": [jnp.ones((4, 3, 3, 3), jnp.bfloat16)]}
+        attrs = {"strides": [1, 1], "paddings": [1, 1]}
+    else:
+        ins = {"X": [jnp.ones((4, 8), jnp.bfloat16)],
+               "Y": [jnp.ones((8, 4), jnp.bfloat16)]}
+        attrs = {}
+    outs = od.lower(None, ins, attrs)
+    out = list(outs.values())[0][0]
+    assert out.dtype == jnp.bfloat16
+    assert float(out.reshape(-1)[0]) > 0
