@@ -21,6 +21,7 @@ from .core.framework import (Program, Operator, Variable, Parameter,
                              program_guard, switch_main_program,
                              switch_startup_program)
 from .core.executor import Executor, Scope, global_scope, scope_guard
+from .core.readers import EOFException
 from .core.backward import append_backward
 from .core.lod import LoDTensor, create_lod_tensor
 from .core.param_attr import ParamAttr

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from . import lowering
+from . import readers
 from .framework import default_main_program, convert_dtype
 from .lod import LoDTensor
 from .utils import find_var as _find_feed_var
@@ -146,6 +147,31 @@ class Executor(object):
             arr = _to_array(value, var)
             feed_arrays[name] = arr
 
+        # io pre-pass: reader ops execute host-side (core/readers.py).
+        # create_* ops build ReaderState objects in the scope; each `read`
+        # op pops the next record and injects it as a feed of the jitted
+        # program (EOFException propagates to the caller — check
+        # reader.eof() first). Global block only: file IO inside traced
+        # control flow has no TPU lowering.
+        for op in program.global_block().ops:
+            if op.type == "read":
+                state = scope.get(op.inputs["Reader"][0])
+                if state is None:
+                    raise RuntimeError(
+                        "reader %r has no state; run the startup program "
+                        "first" % op.inputs["Reader"][0])
+                record = state.next()
+                out_names = op.outputs["Out"]
+                if len(record) != len(out_names):
+                    raise ValueError(
+                        "reader yielded %d fields but read_file declared %d"
+                        % (len(record), len(out_names)))
+                for out_name, val in zip(out_names, record):
+                    feed_arrays[out_name] = _to_array(
+                        val, _find_feed_var(program, out_name))
+            elif readers.is_host_io_op(op.type):
+                readers.run_host_io_op(op, scope)
+
         feed_names = sorted(feed_arrays)
         key = (id(program), program._version, _feed_signature(feed_arrays),
                tuple(fetch_names))
@@ -189,7 +215,13 @@ class Executor(object):
 
 def _to_array(value, var=None):
     if isinstance(value, jax.Array):
-        return value  # already device-resident: never round-trip via host
+        # already device-resident: never round-trip via host, but still
+        # honor the declared dtype (device-side cast is a cheap XLA op)
+        if var is not None and var.dtype is not None:
+            want = convert_dtype(var.dtype)
+            if str(value.dtype) != want:
+                value = value.astype(want)
+        return value
     arr = np.asarray(value)
     if var is not None and var.dtype is not None:
         arr = arr.astype(convert_dtype(var.dtype), copy=False)

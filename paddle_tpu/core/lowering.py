@@ -133,7 +133,10 @@ class Env(object):
 
 
 def lower_block(ctx, block, env):
+    from .readers import is_host_io_op
     for op in block.ops:
+        if is_host_io_op(op.type):
+            continue  # executed host-side by the Executor's io pre-pass
         lower_op(ctx, op, env)
 
 
@@ -334,8 +337,12 @@ def analyze_state(program, feed_names, fetch_names=()):
 def _all_ops(program):
     # grad_of ops list their reads (fwd inputs + out-grads) in op.inputs, so a
     # plain walk sees every data dependency (backward.py guarantees this).
+    # Host io ops (readers) are excluded: their reader vars hold host-side
+    # ReaderState, never traced arrays, and `read` outputs arrive as feeds.
+    from .readers import is_host_io_op
     for block in program.blocks:
         for op in block.ops:
-            yield op
+            if not is_host_io_op(op.type):
+                yield op
 
 

@@ -1,0 +1,364 @@
+"""In-graph file readers: host-side reader state + device prefetch.
+
+Parity: python/paddle/fluid/layers/io.py:262-366 (open_recordio_file,
+open_files, create_shuffle_reader, create_double_buffer_reader,
+create_multi_pass_reader, read_file) and the C++ reader ops under
+paddle/fluid/operators/reader/ (create_recordio_file_reader_op.cc,
+open_files_op.cc, create_shuffle_reader_op.cc,
+create_double_buffer_reader_op.cc, create_multi_pass_reader_op.cc).
+
+TPU-native split: the reference executes `read` as a graph op popping from a
+C++ threaded reader. Under whole-program XLA jit, file IO cannot live inside
+the traced computation — so reader STATE is a host-side object stored in the
+Scope under the reader variable's name, and the Executor runs the reader ops
+in a host pre-pass: `create_*` ops build ReaderState objects, and each `read`
+op pops the next batch and injects it as a feed of the jitted program. The
+double-buffer decorator gives the async input pipeline: a background thread
+stages the next batch onto the device (jax.device_put) while the current
+step runs, so the host→device copy overlaps compute exactly like the
+reference's double_buffer reader overlapped H2D with CUDA streams.
+"""
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["EOFException", "HOST_IO_OPS", "run_host_io_op", "is_host_io_op"]
+
+
+class EOFException(Exception):
+    """Raised by a `read` op when the underlying reader is exhausted
+    (parity: the reference reader's has_next() turning false;
+    `reader.eof()` is the polite way to check first)."""
+
+
+# op types the Executor runs host-side instead of lowering to XLA
+HOST_IO_OPS = frozenset({
+    "create_recordio_file_reader", "open_files", "create_shuffle_reader",
+    "create_double_buffer_reader", "create_multi_pass_reader", "read"})
+
+
+def is_host_io_op(op_type):
+    return op_type in HOST_IO_OPS
+
+
+class ReaderBase(object):
+    """Host-side reader state. next() returns one record (tuple of arrays)
+    or raises EOFException; eof() peeks; reset() restarts; close() releases
+    threads/files (called when a startup re-run displaces the state)."""
+
+    def __init__(self):
+        self._peeked = None
+
+    def next(self):
+        if self._peeked is not None:
+            out, self._peeked = self._peeked, None
+            return out
+        return self._next()
+
+    def eof(self):
+        if self._peeked is not None:
+            return False
+        try:
+            self._peeked = self._next()
+            return False
+        except EOFException:
+            return True
+
+    def reset(self):
+        self._peeked = None
+        self._reset()
+
+    def close(self):
+        self._peeked = None
+
+    def _next(self):
+        raise NotImplementedError
+
+    def _reset(self):
+        raise NotImplementedError
+
+
+class IteratorReader(ReaderBase):
+    """Reader over a restartable sample-iterator factory."""
+
+    def __init__(self, creator):
+        super(IteratorReader, self).__init__()
+        self._creator = creator
+        self._it = creator()
+
+    def _next(self):
+        try:
+            return next(self._it)
+        except StopIteration:
+            raise EOFException()
+
+    def _reset(self):
+        self._it = self._creator()
+
+
+class RecordIOReader(IteratorReader):
+    def __init__(self, filename):
+        from ..recordio_writer import recordio_reader
+        super(RecordIOReader, self).__init__(recordio_reader(filename))
+
+
+class MultiFileReader(ReaderBase):
+    """thread_num threads scan the files concurrently into a shared queue;
+    record order across files is nondeterministic, like the reference's
+    open_files (open_files_op.cc uses a thread pool the same way)."""
+
+    def __init__(self, filenames, thread_num=1, queue_capacity=64):
+        super(MultiFileReader, self).__init__()
+        self._filenames = list(filenames)
+        self._thread_num = max(1, int(thread_num))
+        self._capacity = queue_capacity
+        self._gen = 0
+        self._threads = []
+        self._q = None
+
+    def _start(self):
+        from ..recordio_writer import recordio_reader
+        self._q = queue.Queue(self._capacity)
+        self._pending = list(self._filenames)
+        self._lock = threading.Lock()
+        self._live = self._thread_num
+        self._gen += 1
+        gen, q, lock = self._gen, self._q, self._lock
+
+        def worker():
+            try:
+                while gen == self._gen:
+                    with lock:
+                        if not self._pending:
+                            break
+                        fname = self._pending.pop(0)
+                    for rec in recordio_reader(fname)():
+                        q.put(rec)
+                        if gen != self._gen:
+                            return
+            except Exception as e:  # bad/corrupt file: surface, don't hang
+                q.put(_ReaderError(e))
+                return
+            finally:
+                with lock:
+                    self._live -= 1
+                    if self._live == 0 and gen == self._gen:
+                        q.put(_EOF_SENTINEL)
+
+        self._threads = [threading.Thread(target=worker, daemon=True)
+                         for _ in range(self._thread_num)]
+        for t in self._threads:
+            t.start()
+
+    def _next(self):
+        if self._q is None:  # lazy start: no thread/file leak if displaced
+            self._start()
+        item = self._q.get()
+        if item is _EOF_SENTINEL:
+            raise EOFException()
+        if isinstance(item, _ReaderError):
+            raise item.error
+        return item
+
+    def _stop(self):
+        # unblock workers parked on a full queue, then wait them out
+        self._gen += 1
+        while any(t.is_alive() for t in self._threads):
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            for t in self._threads:
+                t.join(timeout=0.05)
+        self._threads = []
+        self._q = None
+
+    def _reset(self):
+        if self._threads:
+            self._stop()
+        # lazy: the next read starts fresh threads
+
+    def close(self):
+        super(MultiFileReader, self).close()
+        if self._threads:
+            self._stop()
+
+
+_EOF_SENTINEL = object()
+
+
+class ShuffleReader(ReaderBase):
+    """Reservoir of buffer_size records, yielded in random order
+    (parity: create_shuffle_reader_op.cc)."""
+
+    def __init__(self, underlying, buffer_size, seed=0):
+        super(ShuffleReader, self).__init__()
+        self._under = underlying
+        self._size = int(buffer_size)
+        self._seed = seed
+        self._rng = np.random.RandomState(seed)
+        self._buf = []
+
+    def _fill(self):
+        while len(self._buf) < self._size:
+            try:
+                self._buf.append(self._under.next())
+            except EOFException:
+                break
+        self._rng.shuffle(self._buf)
+
+    def _next(self):
+        if not self._buf:
+            self._fill()
+        if not self._buf:
+            raise EOFException()
+        return self._buf.pop()
+
+    def _reset(self):
+        self._buf = []
+        self._rng = np.random.RandomState(self._seed)
+        self._under.reset()
+
+
+class MultiPassReader(ReaderBase):
+    """Replays the underlying reader pass_num times
+    (parity: create_multi_pass_reader_op.cc)."""
+
+    def __init__(self, underlying, pass_num):
+        super(MultiPassReader, self).__init__()
+        self._under = underlying
+        self._pass_num = int(pass_num)
+        self._pass = 0
+
+    def _next(self):
+        try:
+            return self._under.next()
+        except EOFException:
+            self._pass += 1
+            if self._pass >= self._pass_num:
+                raise
+            self._under.reset()
+            return self._under.next()
+
+    def _reset(self):
+        self._pass = 0
+        self._under.reset()
+
+
+class DoubleBufferReader(ReaderBase):
+    """Async device staging: a daemon thread pulls records from the
+    underlying reader, copies them to the accelerator (jax.device_put) and
+    parks up to `capacity` staged batches in a queue. The Executor's next
+    step finds its input already device-resident — host→device copy overlaps
+    the previous step's compute (parity:
+    create_double_buffer_reader_op.cc's cudaStream prefetch)."""
+
+    def __init__(self, underlying, capacity=2, place=None):
+        super(DoubleBufferReader, self).__init__()
+        self._under = underlying
+        self._capacity = max(1, int(capacity))
+        self._place = place
+        self._gen = 0
+        self._start()
+
+    def _device(self):
+        if self._place is not None:
+            try:
+                return self._place.device()
+            except Exception:
+                return None
+        return None
+
+    def _start(self):
+        self._q = queue.Queue(self._capacity)
+        self._gen += 1
+        gen, q, dev = self._gen, self._q, self._device()
+
+        def worker():
+            import jax
+            while gen == self._gen:
+                try:
+                    rec = self._under.next()
+                except EOFException:
+                    q.put(_EOF_SENTINEL)
+                    return
+                except Exception as e:  # propagate reader errors to next()
+                    q.put(_ReaderError(e))
+                    return
+                staged = tuple(
+                    jax.device_put(np.asarray(f), dev) if dev is not None
+                    else jax.device_put(np.asarray(f)) for f in rec)
+                q.put(staged)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def _next(self):
+        item = self._q.get()
+        if item is _EOF_SENTINEL:
+            raise EOFException()
+        if isinstance(item, _ReaderError):
+            raise item.error
+        return item
+
+    def _stop(self):
+        """Stop the worker BEFORE touching the underlying reader: a worker
+        blocked in q.put finishes its put once we drain, re-checks the
+        generation and exits — so it can never steal a record from the
+        freshly reset underlying stream."""
+        self._gen += 1
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+
+    def _reset(self):
+        self._stop()
+        self._under.reset()
+        self._start()
+
+    def close(self):
+        super(DoubleBufferReader, self).close()
+        self._stop()
+
+
+class _ReaderError(object):
+    def __init__(self, error):
+        self.error = error
+
+
+def run_host_io_op(op, scope):
+    """Execute a reader-creation op host-side (Executor pre-pass). `read`
+    ops are handled separately by the Executor (they inject feeds)."""
+    out_name = op.outputs["Out"][0]
+    if op.type == "create_recordio_file_reader":
+        state = RecordIOReader(op.attrs["filename"])
+    elif op.type == "open_files":
+        state = MultiFileReader(op.attrs["file_names"],
+                                op.attrs.get("thread_num", 1))
+    else:
+        under = scope.get(op.inputs["UnderlyingReader"][0])
+        if under is None:
+            raise RuntimeError(
+                "underlying reader %r not created yet; run the startup "
+                "program first" % op.inputs["UnderlyingReader"][0])
+        if op.type == "create_shuffle_reader":
+            state = ShuffleReader(under, op.attrs["buffer_size"],
+                                  seed=op.attrs.get("seed", 0))
+        elif op.type == "create_multi_pass_reader":
+            state = MultiPassReader(under, op.attrs["pass_num"])
+        elif op.type == "create_double_buffer_reader":
+            state = DoubleBufferReader(
+                under, capacity=op.attrs.get("capacity", 2),
+                place=op.attrs.get("__place__"))
+        else:
+            raise KeyError("unknown host io op %r" % op.type)
+    old = scope.get(out_name)
+    if old is not None and hasattr(old, "close"):
+        old.close()  # startup re-run: release the displaced reader's threads
+    scope.set(out_name, state)

@@ -44,12 +44,31 @@ def convert_reader_to_recordio_file(
         compressor=recordio.Compressor.Gzip, max_num_records=1000,
         feed_order=None):
     """Write every sample of reader_creator() into `filename`. Returns the
-    record count. `feeder`/`feed_order` are accepted for API parity; samples
-    are serialized directly (already-dense TPU layout, no LoD protos)."""
+    record count.
+
+    With a `feeder` (DataFeeder), each item from the reader is a minibatch
+    (the reference's convert pattern: a paddle.batch-ed reader) and is run
+    through feeder.feed() so every record holds one batched array per feed
+    var, ordered by `feed_order` (defaults to the feeder's feed list). Dense
+    vars only — sequence (lod_level>0) vars have no recordio layout here.
+    Without a feeder, samples are serialized directly."""
     count = 0
+    if feeder is not None and feed_order is None:
+        feed_order = feeder.feed_names
     with recordio.Writer(filename, compressor=compressor,
                          max_num_records=max_num_records) as w:
         for sample in reader_creator():
+            if feeder is not None:
+                d = feeder.feed(sample)
+                fields = []
+                for name in feed_order:
+                    val = d[name]
+                    if not isinstance(val, np.ndarray):
+                        raise NotImplementedError(
+                            "recordio conversion supports dense feed vars "
+                            "only; %r is a sequence (lod_level>0)" % name)
+                    fields.append(val)
+                sample = tuple(fields)
             w.write(_serialize_sample(sample))
             count += 1
     return count
