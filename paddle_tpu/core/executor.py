@@ -173,7 +173,8 @@ class Executor(object):
                 readers.run_host_io_op(op, scope)
 
         feed_names = sorted(feed_arrays)
-        key = (id(program), program._version, _feed_signature(feed_arrays),
+        key = (getattr(program, "_uid", None) or id(program),
+               program._version, _feed_signature(feed_arrays),
                tuple(fetch_names))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:

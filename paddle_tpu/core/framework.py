@@ -10,6 +10,7 @@ the hot path); `Program.to_string` provides the debug/serialization surface.
 """
 import contextlib
 import copy
+import itertools
 import re
 
 import numpy as np
@@ -311,6 +312,8 @@ class Program(object):
     re-interprets every run; we re-jit only when the graph actually changed).
     """
 
+    _uid_counter = itertools.count(1)
+
     def __init__(self):
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
@@ -319,6 +322,10 @@ class Program(object):
         self.random_seed = 0
         self._op_uid_counter = 0
         self._amp = False  # bf16 mixed precision (enable_mixed_precision)
+        # process-unique identity for the Executor's compile cache: id() of
+        # a GC'd program can be recycled by a new one, silently serving a
+        # stale jitted fn; this never recycles
+        self._uid = next(Program._uid_counter)
 
     def _next_op_uid(self):
         self._op_uid_counter += 1
@@ -369,6 +376,7 @@ class Program(object):
     # ---- clone / prune (parity: Program.clone, Program.prune) --------
     def clone(self, for_test=False):
         p = copy.deepcopy(self)
+        p._uid = next(Program._uid_counter)  # a clone is a distinct program
         if for_test:
             p._set_test_mode()
         return p
@@ -379,11 +387,87 @@ class Program(object):
                 if "is_test" in _TEST_MODE_OPS.get(op.type, ()):
                     op.attrs["is_test"] = True
 
+    def prune(self, targets, for_test=False):
+        """Return a copy containing only the ops/vars the targets depend on
+        (parity: fluid.framework.Program.prune, framework.py:1002).
+
+        Backward slice from the target variables: optimizer/backward ops,
+        metrics branches and anything else not on a target's path are
+        dropped — the inference-serving subgraph. Sub-blocks of kept
+        control-flow ops survive intact; orphaned sub-blocks are emptied
+        (block indices stay stable for attrs['sub_block'] refs). for_test
+        additionally flips is_test attrs, sparing a second deepcopy vs
+        prune().clone(for_test=True)."""
+        p = self.clone(for_test=for_test)
+        if not isinstance(targets, (list, tuple)):
+            targets = [targets]
+        needed = set()
+        for t in targets:
+            name = t.name if isinstance(t, Variable) else t
+            needed.add(name)
+            v = p.global_block().vars.get(name)
+            if v is not None and getattr(v, "seq_len_var", None):
+                needed.add(v.seq_len_var)
+
+        def op_reads(op):
+            names = [n for ns in op.inputs.values() for n in ns if n]
+            for idx in _sub_block_indices(op):
+                for sop in p.blocks[idx].ops:
+                    names.extend(op_reads(sop))
+            return names
+
+        kept = []
+        for op in reversed(p.global_block().ops):
+            if any(n in needed
+                   for ns in op.outputs.values() for n in ns if n):
+                kept.append(op)
+                needed.update(op_reads(op))
+        kept.reverse()
+        p.global_block().ops = kept
+
+        # empty unreachable sub-blocks (their ops would otherwise leak into
+        # state analysis via _all_ops)
+        reachable = {0}
+        frontier = list(kept)
+        while frontier:
+            op = frontier.pop()
+            for idx in _sub_block_indices(op):
+                if idx not in reachable:
+                    reachable.add(idx)
+                    frontier.extend(p.blocks[idx].ops)
+        for blk in p.blocks:
+            if blk.idx not in reachable:
+                blk.ops = []
+                blk.vars = {}
+
+        # drop global vars nothing kept references
+        used = set(needed)
+        for op in kept:
+            for ns in op.outputs.values():
+                used.update(n for n in ns if n)
+        blk = p.global_block()
+        blk.vars = {k: v for k, v in blk.vars.items() if k in used}
+        p._bump_version()
+        return p
+
     def to_string(self, throw_on_error=False, with_details=False):
         return "\n".join(repr(b) for b in self.blocks)
 
     __repr__ = to_string
     __str__ = to_string
+
+
+def _sub_block_indices(op):
+    """Block indices an op's attrs reference (sub_block is the convention;
+    grad_of ops may carry fwd attrs with one too)."""
+    out = []
+    for key, val in op.attrs.items():
+        if key.endswith("sub_block") and isinstance(val, int):
+            out.append(val)
+        elif key == "fwd_attrs" and isinstance(val, dict) \
+                and isinstance(val.get("sub_block"), int):
+            out.append(val["sub_block"])
+    return out
 
 
 # ops that behave differently at inference time

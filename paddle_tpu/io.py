@@ -3,9 +3,11 @@ checkpoints.
 
 Parity: python/paddle/fluid/io.py. Storage format is a directory of .npy
 files (one per var, like the reference's one-file-per-var LoDTensor dumps)
-plus a JSON manifest; `save_inference_model` additionally pickles the pruned
-inference Program. Orbax-grade sharded checkpointing for the distributed path
-lives in parallel/checkpoint.py; this module is the single-host surface.
+plus a JSON manifest; `save_inference_model` prunes to the fetch subgraph
+(Program.prune) and stores it in the versioned self-describing desc format
+(core/program_desc.py — the reference's ProgramDesc proto equivalent).
+Orbax-grade sharded checkpointing for the distributed path lives in
+parallel/checkpoint.py; this module is the single-host surface.
 """
 import json
 import os
@@ -15,6 +17,7 @@ import numpy as np
 
 from .core.framework import Program, Parameter, Variable, default_main_program
 from .core.executor import global_scope
+from .core import program_desc as _program_desc
 
 __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
@@ -107,25 +110,32 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None):
     """Parity: fluid.io.save_inference_model — prunes to the inference
-    sub-graph, stores program + params."""
+    sub-graph (Program.prune: backward/optimizer ops and unrelated branches
+    dropped), stores the versioned program desc + only the params the
+    pruned graph reads."""
     if main_program is None:
         main_program = default_main_program()
-    inference_program = main_program.clone(for_test=True)
     target_names = [v if isinstance(v, str) else v.name for v in target_vars]
+    inference_program = main_program.prune(target_names, for_test=True)
     os.makedirs(dirname, exist_ok=True)
     meta = {"feed": list(feeded_var_names), "fetch": target_names}
     with open(os.path.join(dirname, "__model_meta__.json"), "w") as f:
         json.dump(meta, f)
     with open(os.path.join(dirname, model_filename or "__model__"), "wb") as f:
-        pickle.dump(inference_program, f)
-    save_params(executor, dirname, main_program)
+        f.write(_program_desc.program_to_bytes(inference_program))
+    save_params(executor, dirname, inference_program)
     return inference_program
 
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
     with open(os.path.join(dirname, model_filename or "__model__"), "rb") as f:
-        program = pickle.load(f)
+        raw = f.read()
+    if raw[:1] == b"\x80":  # pickle protocol >= 2: round-1 legacy artifact
+        program = pickle.loads(raw)
+        program._uid = next(Program._uid_counter)  # predates _uid; no id()
+    else:
+        program = _program_desc.program_from_bytes(raw)
     with open(os.path.join(dirname, "__model_meta__.json")) as f:
         meta = json.load(f)
     load_params(executor, dirname)

@@ -1,0 +1,58 @@
+"""v2 training events (python/paddle/v2/event.py).
+
+The trainer invokes event_handler with these before/after every pass and
+iteration; `with_metric` carries the evaluator metrics of the span.
+"""
+__all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration",
+           "EndForwardBackward", "TestResult"]
+
+
+class WithMetric(object):
+    def __init__(self, evaluator):
+        self.evaluator = evaluator
+
+    @property
+    def metrics(self):
+        return dict(self.evaluator or {})
+
+
+class TestResult(WithMetric):
+    """Result of trainer.test: mean cost + metrics over the test reader."""
+
+    def __init__(self, evaluator, cost):
+        super(TestResult, self).__init__(evaluator)
+        self.cost = cost
+
+
+class BeginPass(object):
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id, evaluator=None, gm=None):
+        super(EndPass, self).__init__(evaluator)
+        self.pass_id = pass_id
+        self.gm = gm
+
+
+class BeginIteration(object):
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndForwardBackward(object):
+    def __init__(self, pass_id, batch_id, gm=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.gm = gm
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id, batch_id, cost, evaluator=None, gm=None):
+        super(EndIteration, self).__init__(evaluator)
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        self.gm = gm

@@ -1,0 +1,68 @@
+"""v2 inference (python/paddle/v2/inference.py).
+
+Inference(output_layer, parameters) prunes the captured main program to the
+output layer's forward subgraph (Program.prune + clone(for_test)), so
+optimizer/backward ops appended by a trainer never run — then executes it
+batch by batch in the Parameters' scope.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+__all__ = ["infer", "Inference"]
+
+
+class Inference(object):
+    def __init__(self, output_layer, parameters):
+        outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+            else [output_layer]
+        self.output_names = [o.name for o in outputs]
+        self.__parameters__ = parameters
+        topo = parameters.topology
+        self.__program__ = topo.main_program.prune(outputs, for_test=True)
+        self._exe = fluid.Executor(fluid.CPUPlace())
+
+    def _feeder(self, feeding):
+        data_layers = self.__parameters__.topology.data_layers()
+        names = list(data_layers)
+        if feeding is not None:
+            if isinstance(feeding, dict):
+                names = [n for n, _ in
+                         sorted(feeding.items(), key=lambda kv: kv[1])]
+            else:
+                names = list(feeding)
+        # only keep data layers the pruned graph still reads
+        gvars = self.__program__.global_block().vars
+        names = [n for n in names if n in gvars]
+        return fluid.DataFeeder(
+            feed_list=names, program=self.__parameters__.topology.main_program)
+
+    def iter_infer_field(self, field, **kwargs):
+        for result in self.iter_infer(**kwargs):
+            yield result
+
+    def iter_infer(self, input, feeding=None):
+        feeder = self._feeder(feeding)
+        with fluid.scope_guard(self.__parameters__.scope):
+            self.__parameters__._materialize()
+            outs = self._exe.run(self.__program__,
+                                 feed=feeder.feed(input),
+                                 fetch_list=self.output_names)
+        yield [np.asarray(o) for o in outs]
+
+    def infer(self, input, field="value", feeding=None, **kwargs):
+        rets = []
+        for outs in self.iter_infer(input=input, feeding=feeding):
+            rets.extend(outs)
+        if len(rets) == 1:
+            return rets[0]
+        return rets
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    """paddle.infer(...): one-shot inference over a minibatch
+    (reference: inference.py:32's module-level helper)."""
+    return Inference(output_layer=output_layer,
+                     parameters=parameters).infer(input=input,
+                                                  feeding=feeding,
+                                                  field=field)

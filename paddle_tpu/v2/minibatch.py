@@ -1,0 +1,5 @@
+"""v2 minibatch (python/paddle/v2/minibatch.py): group a sample reader's
+output into lists of batch_size samples."""
+from ..reader import batch
+
+__all__ = ["batch"]

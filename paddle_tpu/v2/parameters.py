@@ -1,0 +1,130 @@
+"""v2 Parameters (python/paddle/v2/parameters.py).
+
+Dict-like view of the model's trainable parameters. The reference wrapped
+GradientMachine parameter buffers; here Parameters owns the fluid Scope the
+trainer/inferencer run in, materializing it from the startup program on
+first use (a temp-scope run that only fills names still missing, so a
+later-appended optimizer's accumulators initialize without resetting
+already-trained weights). to_tar/from_tar round-trip values as a tar of
+.npy members, like the reference's tar checkpoints.
+"""
+import io as _io
+import tarfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+from .topology import Topology
+
+__all__ = ["Parameters", "create"]
+
+
+class Parameters(object):
+    def __init__(self, topology):
+        self.topology = topology
+        self.scope = fluid.Scope()
+        self._exe = fluid.Executor(fluid.CPUPlace())
+
+    # -- materialization ----------------------------------------------------
+    def _param_names(self):
+        return [p.name for p in
+                self.topology.main_program.global_block().all_parameters()]
+
+    def _materialize(self):
+        """Run the startup program for any persistable var not yet present
+        (first call fills everything; later calls only fill vars appended
+        since — e.g. optimizer accumulators — keeping trained values).
+        No-op while the startup program is unchanged, so per-batch get()
+        calls don't re-execute initialization."""
+        version = self.topology.startup_program._version
+        if getattr(self, "_materialized_version", None) == version:
+            return
+        temp = fluid.Scope()
+        with fluid.scope_guard(temp):
+            self._exe.run(self.topology.startup_program)
+        for name in temp.names():
+            if not self.scope.has(name):
+                self.scope.set(name, temp.get(name))
+        self._materialized_version = version
+
+    # -- dict-like surface --------------------------------------------------
+    def names(self):
+        return self._param_names()
+
+    def keys(self):
+        return self.names()
+
+    def has_key(self, key):
+        return key in self.names()
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def __contains__(self, key):
+        return self.has_key(key)
+
+    def __len__(self):
+        return len(self.names())
+
+    def get(self, name):
+        self._materialize()
+        val = self.scope.get(name)
+        if val is None:
+            raise KeyError("no parameter %r" % name)
+        return np.asarray(val)
+
+    __getitem__ = get
+
+    def set(self, name, value):
+        self._materialize()
+        if not self.scope.has(name):
+            raise KeyError("no parameter %r" % name)
+        cur = self.scope.get(name)
+        value = np.asarray(value)
+        if cur is not None and tuple(np.shape(cur)) != value.shape:
+            value = value.reshape(np.shape(cur))
+        self.scope.set(name, value)
+
+    __setitem__ = set
+
+    def get_shape(self, name):
+        v = self.topology.main_program.global_block().vars.get(name)
+        if v is None or v.shape is None:
+            return tuple(np.shape(self.get(name)))
+        return tuple(v.shape)
+
+    # -- tar serialization (reference: Parameters.to_tar/from_tar) ----------
+    def to_tar(self, f):
+        self._materialize()
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self.names():
+                buf = _io.BytesIO()
+                np.save(buf, self.get(name), allow_pickle=False)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name + ".npy")
+                info.size = len(data)
+                tar.addfile(info, _io.BytesIO(data))
+
+    def from_tar(self, f):
+        self._materialize()
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                name = member.name[:-4] if member.name.endswith(".npy") \
+                    else member.name
+                arr = np.load(_io.BytesIO(tar.extractfile(member).read()),
+                              allow_pickle=False)
+                self.set(name, arr)
+        return self
+
+    @staticmethod
+    def from_tar_file(f):
+        raise NotImplementedError(
+            "standalone tar loading needs a topology; build the model and "
+            "use parameters.create(cost).from_tar(f)")
+
+
+def create(layers):
+    """paddle.parameters.create(cost): capture the current default programs
+    and return the Parameters handle the trainer/inferencer will run in."""
+    topo = layers if isinstance(layers, Topology) else Topology(layers)
+    return Parameters(topo)

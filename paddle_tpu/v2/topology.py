@@ -1,0 +1,42 @@
+"""v2 Topology: the captured model graph (python/paddle/v2/topology.py).
+
+The reference serialized a gserver ModelConfig proto from the layer DAG.
+Here the v2 layer calls have already built fluid programs, so Topology just
+captures the default main/startup programs plus the ordered data layers —
+everything the trainer / inference engine needs.
+"""
+from .. import framework as _fw
+
+__all__ = ["Topology"]
+
+
+class Topology(object):
+    def __init__(self, layers, extra_layers=None):
+        if not isinstance(layers, (list, tuple)):
+            layers = [layers]
+        self.layers = list(layers)
+        if extra_layers is not None:
+            extra = extra_layers if isinstance(extra_layers, (list, tuple)) \
+                else [extra_layers]
+            self.layers.extend(extra)
+        self.main_program = _fw.default_main_program()
+        self.startup_program = _fw.default_startup_program()
+
+    def data_layers(self):
+        """Ordered {name: Variable} of data layers (creation order — the
+        default reader column order, like the reference's data_type())."""
+        out = {}
+        for name, var in self.main_program.global_block().vars.items():
+            if getattr(var, "is_data", False) and "@SEQLEN" not in name:
+                out[name] = var
+        return out
+
+    def data_type(self):
+        """[(name, v2 InputType-or-dtype)] in data-layer order."""
+        return [(name, getattr(var, "v2_type", var.dtype))
+                for name, var in self.data_layers().items()]
+
+    def proto(self):
+        """The serialized model config (reference: ModelConfig proto); here
+        the printable program desc serves the same debugging role."""
+        return self.main_program.to_string()

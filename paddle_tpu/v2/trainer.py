@@ -1,0 +1,112 @@
+"""v2 SGD trainer (python/paddle/v2/trainer.py:37).
+
+The reference combined a GradientMachine, a ParameterUpdater and a
+DataFeeder into the classic train loop with BeginPass/BeginIteration/
+EndIteration/EndPass events. Here the loop drives the fluid Executor over
+the captured topology: the update_equation's fluid optimizer is appended to
+the captured main program once, and each batch is one jitted
+forward+backward+update step on the accelerator.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from . import event as v2_event
+from . import optimizer as v2_optimizer
+from .parameters import Parameters
+from .topology import Topology
+
+__all__ = ["SGD"]
+
+
+def default_event_handler(event):
+    pass
+
+
+class SGD(object):
+    """SGD(cost, parameters, update_equation).train(reader, num_passes,
+    event_handler, feeding) — the full legacy surface; is_local/pserver_spec
+    accepted for parity (distribution is the fluid DistributeTranspiler's
+    job in this stack)."""
+
+    def __init__(self, cost, parameters, update_equation, extra_layers=None,
+                 is_local=True, pserver_spec=None, use_etcd=True):
+        if not isinstance(parameters, Parameters):
+            raise TypeError("parameters should be "
+                            "paddle.v2.parameters.Parameters")
+        if not isinstance(update_equation, v2_optimizer.Optimizer):
+            raise TypeError("update equation parameter must be "
+                            "paddle.v2.optimizer.Optimizer")
+        self.__topology__ = parameters.topology
+        if extra_layers is not None:
+            extra = extra_layers if isinstance(extra_layers, (list, tuple)) \
+                else [extra_layers]
+            self.__topology__.layers.extend(extra)
+        self.__parameters__ = parameters
+        self.__optimizer__ = update_equation
+        self.cost = cost if not isinstance(cost, (list, tuple)) else cost[0]
+        self._exe = fluid.Executor(fluid.CPUPlace())
+        # forward-only clone BEFORE optimizer ops, for test()/metrics
+        self.__test_program__ = \
+            self.__topology__.main_program.clone(for_test=True)
+        with fluid.program_guard(self.__topology__.main_program,
+                                 self.__topology__.startup_program):
+            update_equation.fluid_opt.minimize(self.cost)
+
+    def _feeder(self, feeding):
+        data_layers = self.__topology__.data_layers()
+        names = list(data_layers)
+        if feeding is not None:
+            if isinstance(feeding, dict):
+                names = [n for n, _ in
+                         sorted(feeding.items(), key=lambda kv: kv[1])]
+            else:
+                names = list(feeding)
+        prog = self.__topology__.main_program
+        return fluid.DataFeeder(feed_list=names, program=prog)
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        if event_handler is None:
+            event_handler = default_event_handler
+        self.__parameters__._materialize()  # params + optimizer accumulators
+        feeder = self._feeder(feeding)
+        main = self.__topology__.main_program
+        with fluid.scope_guard(self.__parameters__.scope):
+            for pass_id in range(num_passes):
+                event_handler(v2_event.BeginPass(pass_id))
+                pass_costs = []
+                for batch_id, data_batch in enumerate(reader()):
+                    event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                    cost, = self._exe.run(main,
+                                          feed=feeder.feed(data_batch),
+                                          fetch_list=[self.cost])
+                    cost = float(np.asarray(cost).reshape(-1)[0])
+                    pass_costs.append(cost)
+                    event_handler(v2_event.EndForwardBackward(
+                        pass_id=pass_id, batch_id=batch_id, gm=None))
+                    event_handler(v2_event.EndIteration(
+                        pass_id=pass_id, batch_id=batch_id, cost=cost,
+                        evaluator={"cost": cost}, gm=None))
+                event_handler(v2_event.EndPass(
+                    pass_id,
+                    evaluator={"cost": float(np.mean(pass_costs))
+                               if pass_costs else float("nan")},
+                    gm=None))
+
+    def test(self, reader, feeding=None):
+        """Mean cost over the reader on the forward-only (is_test) graph."""
+        feeder = self._feeder(feeding)
+        total, n = 0.0, 0
+        with fluid.scope_guard(self.__parameters__.scope):
+            self.__parameters__._materialize()
+            for data_batch in reader():
+                cost, = self._exe.run(self.__test_program__,
+                                      feed=feeder.feed(data_batch),
+                                      fetch_list=[self.cost.name])
+                total += float(np.asarray(cost).reshape(-1)[0]) \
+                    * len(data_batch)
+                n += len(data_batch)
+        mean = total / max(n, 1)
+        return v2_event.TestResult(evaluator={"cost": mean}, cost=mean)
+
+    def save_parameter_to_tar(self, f):
+        self.__parameters__.to_tar(f)

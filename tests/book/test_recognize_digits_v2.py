@@ -1,0 +1,94 @@
+"""Legacy v2 high-level API: recognize_digits-style training via
+paddle.v2.trainer.SGD with event handlers, test(), and paddle.infer.
+
+Parity: python/paddle/v2/trainer.py:37 (SGD.train event loop),
+v2/inference.py (Inference/infer), v2/parameters.py (create/to_tar),
+and the book's recognize_digits v2 example structure.
+"""
+import io
+
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.v2 as paddle
+
+
+def _mlp(images):
+    h1 = paddle.layer.fc(input=images, size=64,
+                         act=paddle.activation.Relu())
+    h2 = paddle.layer.fc(input=h1, size=32, act=paddle.activation.Relu())
+    return paddle.layer.fc(input=h2, size=10,
+                           act=paddle.activation.Softmax())
+
+
+def _synthetic_mnist(rng, n_batches=12, batch_size=32):
+    centers = rng.randn(10, 784).astype("float32")
+
+    def reader():
+        for _ in range(n_batches):
+            ys = rng.randint(0, 10, batch_size)
+            xs = (centers[ys] +
+                  0.15 * rng.randn(batch_size, 784)).astype("float32")
+            yield [(x, int(y)) for x, y in zip(xs, ys)]
+
+    return reader, centers
+
+
+def test_v2_trainer_recognize_digits():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        images = paddle.layer.data(
+            name="pixel", type=paddle.data_type.dense_vector(784))
+        label = paddle.layer.data(
+            name="label", type=paddle.data_type.integer_value(10))
+        predict = _mlp(images)
+        cost = paddle.layer.classification_cost(input=predict, label=label)
+
+        parameters = paddle.parameters.create(cost)
+        optimizer = paddle.optimizer.Momentum(learning_rate=0.1,
+                                              momentum=0.9)
+        trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                     update_equation=optimizer)
+
+        rng = np.random.RandomState(7)
+        reader, centers = _synthetic_mnist(rng)
+
+        seen = {"begin_pass": 0, "end_pass": 0, "iters": 0}
+        costs = []
+
+        def event_handler(event):
+            if isinstance(event, paddle.event.BeginPass):
+                seen["begin_pass"] += 1
+            elif isinstance(event, paddle.event.EndPass):
+                seen["end_pass"] += 1
+                assert "cost" in event.metrics
+            elif isinstance(event, paddle.event.EndIteration):
+                seen["iters"] += 1
+                costs.append(event.cost)
+                assert event.pass_id >= 0 and event.batch_id >= 0
+
+        trainer.train(reader=reader, num_passes=3,
+                      event_handler=event_handler)
+        assert seen == {"begin_pass": 3, "end_pass": 3, "iters": 36}
+        assert costs[-1] < costs[0] * 0.2, (costs[0], costs[-1])
+
+        # test() runs the forward-only clone
+        result = trainer.test(reader=reader)
+        assert result.cost < costs[0]
+
+        # inference on the pruned forward graph classifies cluster centers
+        probe = [(centers[k] + 0.05 * rng.randn(784).astype("float32"),)
+                 for k in (2, 5, 8)]
+        out = paddle.infer(output_layer=predict, parameters=parameters,
+                           input=probe)
+        assert out.shape == (3, 10)
+        assert list(out.argmax(axis=1)) == [2, 5, 8]
+
+        # parameter tar round-trip restores identical inference
+        buf = io.BytesIO()
+        parameters.to_tar(buf)
+        buf.seek(0)
+        p2 = paddle.parameters.create(cost).from_tar(buf)
+        out2 = paddle.infer(output_layer=predict, parameters=p2,
+                            input=probe)
+        np.testing.assert_allclose(out, out2, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,103 @@
+"""save/load_inference_model with pruning + versioned desc serialization.
+
+Parity: python/paddle/fluid/io.py (save_inference_model stores the pruned
+ProgramDesc proto + params); here the desc is the JSON format of
+core/program_desc.py.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import program_desc
+
+
+def _build_and_train(exe):
+    x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=12, act="relu")
+    pred = fluid.layers.fc(input=h, size=1)
+    cost = fluid.layers.mean(
+        x=fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(cost)
+    return pred, cost
+
+
+def test_save_inference_model_prunes_and_roundtrips(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        pred, cost = _build_and_train(exe=None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(3)
+    xs = rng.rand(8, 6).astype("float32")
+    ys = rng.rand(8, 1).astype("float32")
+    d = str(tmp_path / "model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[cost])
+        want, = exe.run(main.prune(pred), feed={"x": xs}, fetch_list=[pred])
+        saved = fluid.io.save_inference_model(d, ["x"], [pred], exe, main)
+
+    # pruned: strictly fewer ops, no grads/optimizer state updates
+    assert len(saved.global_block().ops) < len(main.global_block().ops) / 2
+    assert all(op.type != "grad_of" for op in saved.global_block().ops)
+
+    # artifact is the versioned JSON desc, not a pickle
+    with open(os.path.join(d, "__model__"), "rb") as f:
+        desc = json.loads(f.read().decode("utf-8"))
+    assert desc["format_version"] == program_desc.FORMAT_VERSION
+
+    # reload in THIS process into a clean scope: same forward outputs
+    with fluid.scope_guard(fluid.Scope()):
+        prog, feed_names, fetch_vars = fluid.io.load_inference_model(d, exe)
+        assert feed_names == ["x"]
+        got, = exe.run(prog, feed={"x": xs},
+                       fetch_list=[v.name for v in fetch_vars])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_inference_model_loads_in_fresh_process(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        pred, cost = _build_and_train(exe=None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(5)
+    xs = rng.rand(4, 6).astype("float32")
+    d = str(tmp_path / "model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": xs,
+                            "y": rng.rand(4, 1).astype("float32")},
+                fetch_list=[cost])
+        fluid.io.save_inference_model(d, ["x"], [pred], exe, main)
+        want, = exe.run(main.prune(pred), feed={"x": xs}, fetch_list=[pred])
+    np.save(str(tmp_path / "xs.npy"), xs)
+    np.save(str(tmp_path / "want.npy"), np.asarray(want))
+
+    script = """
+import os, sys
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as fluid
+d, base = sys.argv[1], sys.argv[2]
+xs = np.load(os.path.join(base, "xs.npy"))
+want = np.load(os.path.join(base, "want.npy"))
+exe = fluid.Executor(fluid.CPUPlace())
+prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+got, = exe.run(prog, feed={feeds[0]: xs},
+               fetch_list=[v.name for v in fetches])
+np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+print("FRESH-OK")
+"""
+    env = dict(os.environ, PYTHONPATH="/root/repo")
+    out = subprocess.run(
+        [sys.executable, "-c", script, d, str(tmp_path)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "FRESH-OK" in out.stdout

@@ -1,0 +1,89 @@
+"""Program.prune: backward slice to the fetch subgraph.
+
+Parity: python/paddle/fluid/framework.py:1002 (Program.prune).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build():
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=16, act="relu")
+    pred = fluid.layers.fc(input=h, size=1)
+    cost = fluid.layers.mean(
+        x=fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(cost)
+    return pred, cost
+
+
+def test_prune_drops_backward_and_optimizer_ops():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        pred, cost = _build()
+    full_ops = len(main.global_block().ops)
+    pruned = main.prune(pred)
+    kept_ops = pruned.global_block().ops
+    assert len(kept_ops) < full_ops / 2
+    types = {op.type for op in kept_ops}
+    assert "grad_of" not in types
+    assert "momentum" not in types and "sgd" not in types
+    # label input is not needed for pred
+    assert "y" not in pruned.global_block().vars
+    # original untouched
+    assert len(main.global_block().ops) == full_ops
+
+
+def test_pruned_program_runs_and_matches_full_forward():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        pred, cost = _build()
+    pruned = main.prune(pred)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    xs = rng.rand(4, 8).astype("float32")
+    ys = rng.rand(4, 1).astype("float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        # full program updates params; fetch pred BEFORE it runs the update
+        full_out, = exe.run(pruned, feed={"x": xs}, fetch_list=[pred])
+        pruned_out, = exe.run(pruned, feed={"x": xs}, fetch_list=[pred])
+        np.testing.assert_allclose(full_out, pruned_out)
+        # pruned program must not touch parameters: run it twice, params same
+        before = {v.name: np.asarray(fluid.global_scope().get(v.name)).copy()
+                  for v in main.global_block().all_parameters()}
+        exe.run(pruned, feed={"x": xs}, fetch_list=[pred])
+        for name, val in before.items():
+            np.testing.assert_array_equal(
+                val, np.asarray(fluid.global_scope().get(name)))
+
+
+def test_prune_keeps_control_flow_subgraph():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64", value=3)
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        arr = fluid.layers.array_write(x, i)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            val = fluid.layers.array_read(arr, i)
+            nxt = fluid.layers.scale(x=val, scale=2.0)
+            i2 = fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.array_write(nxt, i2, array=arr)
+            fluid.layers.less_than(x=i2, y=limit, cond=cond)
+        out = fluid.layers.array_read(arr, limit)
+        # an unrelated branch that prune should drop
+        junk = fluid.layers.fc(input=x, size=3)
+    pruned = main.prune(out)
+    types = {op.type for op in pruned.global_block().ops}
+    assert "while" in types
+    assert "mul" not in types  # the fc branch is gone
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.ones((1, 4), dtype="float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got, = exe.run(pruned, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(got, xs * 8.0)
