@@ -104,11 +104,75 @@ def as_numpy(tensor):
     return np.asarray(tensor)
 
 
+def _array_safety_enabled():
+    """In-graph TensorArray overflow checking (default ON). The check costs
+    one scalar device->host sync per run for programs that contain tensor
+    arrays (zero for programs that don't) — a latency-critical decode loop
+    that provably sizes its arrays can set FLAGS_tensor_array_safety=0 to
+    keep fully-async dispatch."""
+    import os
+    return os.environ.get("FLAGS_tensor_array_safety", "1") not in (
+        "0", "false", "False")
+
+
+def _raise_program_errors(errors):
+    """Raise on tripped in-graph assertion flags (one host sync of the
+    combined '__any__' scalar in the common clean case). jit returns dicts
+    in sorted-key order, so prefer the message that names a variable over
+    the generic sub-block one when both tripped."""
+    if not errors or not bool(errors["__any__"]):
+        return
+    tripped = [msg for msg, flag in errors.items()
+               if msg != "__any__" and bool(flag)]
+    if tripped:
+        named = [m for m in tripped if m.startswith("tensor array '")]
+        raise RuntimeError((named or tripped)[0])
+
+
+def _nan_inf_enabled(flag):
+    """Resolve a check_nan_inf setting: explicit flag wins, else the
+    FLAGS_check_nan_inf env var (parity: the reference's gflag of the same
+    name guarding TensorContainsNAN/Inf sweeps, operator.cc)."""
+    if flag is not None:
+        return bool(flag)
+    import os
+    return os.environ.get("FLAGS_check_nan_inf", "") not in ("", "0",
+                                                             "false", "False")
+
+
+def check_finite(named_arrays, context=""):
+    """Raise naming the first variable containing NaN/Inf.
+
+    Parity: paddle/fluid/framework/tensor_util.cc:163 TensorContainsNAN /
+    TensorContainsInf + the executor's FLAGS_check_nan_inf sweep. TPU-native
+    form: one `jnp.isfinite(...).all()` reduction per floating array (device
+    side), host-synced only in debug mode where this runs.
+    """
+    for name, v in named_arrays:
+        if v is None:
+            continue
+        dt = getattr(v, "dtype", None)
+        if dt is None or not jnp.issubdtype(jnp.asarray(v).dtype,
+                                            jnp.floating):
+            continue
+        if not bool(jnp.isfinite(v).all()):
+            a = np.asarray(v, dtype=np.float32)
+            kind = "NaN" if np.isnan(a).any() else "Inf"
+            raise RuntimeError(
+                "Operator output variable %r contains %s%s (first bad of "
+                "%d elements; enable smaller LR / grad clipping, or inspect "
+                "with fluid.debuger)" %
+                (name, kind, " after %s" % context if context else "",
+                 a.size))
+
+
 class Executor(object):
-    def __init__(self, place=None):
+    def __init__(self, place=None, check_nan_inf=None):
         from ..places import CPUPlace
         self.place = place if place is not None else CPUPlace()
         self._cache = {}
+        self._check_nan_inf = _nan_inf_enabled(check_nan_inf)
+        self._array_safety = _array_safety_enabled()
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True):
@@ -182,7 +246,7 @@ class Executor(object):
                 program, feed_names, fetch_names)
             fn = lowering.build_program_fn(
                 program, feed_names, fetch_names, state_rw, state_ro,
-                state_out)
+                state_out, collect_errors=True)
             jitted = jax.jit(fn, donate_argnums=(1,))
             entry = (jitted, state_rw, state_ro, state_out)
             if use_program_cache:
@@ -202,11 +266,21 @@ class Executor(object):
 
         seed = np.uint32(scope.next_seed())
         with jax.default_device(self.place.device()):
-            fetches, new_state = jitted(
+            fetches, new_state, errors = jitted(
                 [feed_arrays[n] for n in feed_names],
                 read_state(state_rw), read_state(state_ro), seed)
+        # write state back BEFORE any error raise: state_rw inputs were
+        # donated to the jit, so on an exception path the scope must already
+        # hold the (valid) output buffers or it is left pointing at deleted
+        # arrays and the caller can't even checkpoint/inspect.
         for n, v in zip(state_out, new_state):
             scope.set(n, v)
+        if self._array_safety:
+            _raise_program_errors(errors)
+        if self._check_nan_inf:
+            check_finite(
+                list(zip(fetch_names, fetches)) +
+                list(zip(state_out, new_state)), context="Executor.run")
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

@@ -140,6 +140,17 @@ def lower_block(ctx, block, env):
         lower_op(ctx, op, env)
 
 
+# Reserved env name carrying the OR of sub-block-confined TensorArray
+# overflow flags. Control-flow lowerings thread it through their loop
+# carries so flags raised inside nested lax bodies reach the top level.
+PROGRAM_ERR = "__tensor_array_overflow__"
+
+
+def accumulate_error(env, flag):
+    cur = env.read_opt(PROGRAM_ERR)
+    env.write(PROGRAM_ERR, flag if cur is None else cur | flag)
+
+
 def lower_op(ctx, op, env):
     if op.type in _SPECIAL:
         _SPECIAL[op.type](ctx, op, env)
@@ -154,6 +165,9 @@ def lower_op(ctx, op, env):
         ins = _apply_amp(op.type, ins)
     ctx.begin_op(op.uid)
     outs = od.lower(ctx, ins, op.attrs)
+    err = outs.pop("__errors__", None) if isinstance(outs, dict) else None
+    if err is not None:
+        accumulate_error(env, err)
     _write_outputs(op, outs, env)
 
 
@@ -256,16 +270,22 @@ def _lower_grad_of(ctx, op, env):
 
 
 def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
-                     state_out, mesh=None):
+                     state_out, mesh=None, collect_errors=False):
     """Build the pure function for a Program.
 
     fn(feed_vals, state_rw_vals, state_ro_vals, seed)
-        -> (fetch_vals, new_state_vals)
+        -> (fetch_vals, new_state_vals)            # collect_errors=False
+        -> (fetch_vals, new_state_vals, errors)    # collect_errors=True
 
     state_rw: persistable vars both read and overwritten — safe to donate
     (in-place parameter update on device). state_ro: read-only persistables
     (e.g. the learning-rate var) — must NOT be donated, the Scope keeps them.
     state_out: all persistables written (order of the returned new state).
+
+    errors is a {message: bool_scalar} dict of in-graph assertion flags
+    (e.g. TensorArray capacity overflows) the caller must raise on — the
+    checkify-style escape hatch for conditions only detectable inside lax
+    control flow, where Python can't raise.
     """
     def fn(feed_vals, state_rw_vals, state_ro_vals, seed):
         base_key = jax.random.fold_in(
@@ -281,6 +301,30 @@ def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
         lower_block(ctx, program.global_block(), env)
         fetches = [env.read(n) for n in fetch_names]
         new_state = [env.read(n) for n in state_out]
+        if collect_errors:
+            from ..ops.control_ops import TensorArray
+            errors = {}
+            for name, v in env.values.items():
+                if isinstance(v, TensorArray):
+                    errors["tensor array %r overflowed its capacity %d "
+                           "inside traced control flow; pass a larger "
+                           "capacity to create_array()"
+                           % (name, v.buffer.shape[0])] = v.overflow
+            sub_err = env.read_opt(PROGRAM_ERR)
+            if sub_err is not None:
+                errors["a tensor array confined to a loop/conditional "
+                       "sub-block overflowed its capacity inside traced "
+                       "control flow; pass a larger capacity to "
+                       "create_array()"] = sub_err
+            if errors:
+                # one combined scalar: the caller host-syncs only this in
+                # the common (no-error) case, per-message flags only after
+                # it trips
+                any_flag = errors[next(iter(errors))]
+                for f in errors.values():
+                    any_flag = any_flag | f
+                errors["__any__"] = any_flag
+            return fetches, new_state, errors
         return fetches, new_state
 
     return fn

@@ -6,9 +6,10 @@ pprint_block_codes (C-like program listing) and draw_block_graphviz
 Operator IR.
 """
 from .graphviz import Graph
+from .core.executor import check_finite  # noqa: F401 (debug surface)
 
 __all__ = ["pprint_program_codes", "pprint_block_codes",
-           "draw_block_graphviz"]
+           "draw_block_graphviz", "check_finite"]
 
 
 def _var_repr(block, name):

@@ -13,6 +13,7 @@ host-side LoD candidate lists.
 import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu import ParamAttr
+from .common import masked_mean_cost
 
 
 def encoder(dict_size, word_dim=16, hidden_dim=32, is_sparse=False):
@@ -179,13 +180,7 @@ def build_train(dict_size=100, word_dim=16, hidden_dim=32, decoder_size=32,
                         dtype="int64", lod_level=1)
     cost = layers.cross_entropy(input=rnn_out, label=label)  # [B,T,1]
     # masked mean over true target tokens (the reference's flat-LoD mean)
-    label_len = label.block.var_recursive(label.seq_len_var)
-    mask = layers.sequence_mask(label_len, maxlen=rnn_out,
-                                dtype="float32")             # [B,T]
-    masked = layers.elementwise_mul(x=layers.squeeze(x=cost, axes=[2]),
-                                    y=mask)
-    avg_cost = layers.elementwise_div(
-        x=layers.reduce_sum(masked), y=layers.reduce_sum(mask))
+    avg_cost = masked_mean_cost(cost, label, rnn_out)
     opt = (fluid.optimizer.Adam if optimizer == "adam"
            else fluid.optimizer.Adagrad)(learning_rate=learning_rate)
     opt.minimize(avg_cost)

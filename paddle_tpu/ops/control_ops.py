@@ -36,7 +36,8 @@ from jax import lax
 from ..core import registry
 from ..core.registry import register, single
 from ..core import lowering
-from ..core.lowering import register_special, Env, lower_block
+from ..core.lowering import (register_special, Env, lower_block,
+                             PROGRAM_ERR, accumulate_error)
 
 DEFAULT_ARRAY_CAPACITY = 256
 
@@ -53,12 +54,18 @@ class TensorArray(object):
     LoDTensors on host). Fixed capacity makes it a legal XLA loop carry.
     """
 
-    def __init__(self, buffer, length):
+    def __init__(self, buffer, length, overflow=None):
         self.buffer = buffer
         self.length = length
+        # sticky error flag: set by any traced write at index >= capacity.
+        # It rides the pytree through loop carries and is surfaced as an
+        # in-graph error output (lowering.build_program_fn collect_errors);
+        # the Executor raises host-side after the step — the TPU-native
+        # stand-in for checkify inside lax control flow.
+        self.overflow = jnp.zeros((), bool) if overflow is None else overflow
 
     def tree_flatten(self):
-        return (self.buffer, self.length), None
+        return (self.buffer, self.length, self.overflow), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -66,9 +73,10 @@ class TensorArray(object):
 
     def write(self, i, x):
         # Out-of-capacity writes with a concrete index fail at trace time.
-        # A traced index (inside lax loops) cannot be checked without a
-        # host sync; XLA clamps it — size create_array(capacity=...) to the
-        # loop bound (layers like decoder_decode use max_length + 1).
+        # A traced index (inside lax loops) is checked in-graph via the
+        # sticky overflow flag (XLA clamps the store itself) — size
+        # create_array(capacity=...) to the loop bound (layers like
+        # decoder_decode use max_length + 1).
         cap = self.buffer.shape[0]
         try:
             if int(i) >= cap:
@@ -81,7 +89,8 @@ class TensorArray(object):
         i = jnp.asarray(i, jnp.int32).reshape(())
         buf = lax.dynamic_update_index_in_dim(
             self.buffer, jnp.asarray(x, self.buffer.dtype), i, axis=0)
-        return TensorArray(buf, jnp.maximum(self.length, i + 1))
+        over = self.overflow | (i >= cap) | (i < 0)
+        return TensorArray(buf, jnp.maximum(self.length, i + 1), over)
 
     def read(self, i):
         i = jnp.asarray(i, jnp.int32).reshape(())
@@ -112,6 +121,21 @@ class RankTable(object):
 
 
 # increment / compare / is_empty lowerings live in ops/basic.py
+
+
+def _sweep_overflow(benv, incoming):
+    """OR of `incoming`, the sub-env's accumulated error, and every
+    TensorArray overflow flag visible in the sub-env — how a flag raised on
+    an array that never escapes its sub-block still reaches the top level
+    (threaded through the enclosing loop's carry)."""
+    err = incoming
+    sub = benv.read_opt(PROGRAM_ERR)
+    if sub is not None:
+        err = err | sub
+    for v in benv.values.values():
+        if isinstance(v, TensorArray):
+            err = err | v.overflow
+    return err
 
 # ---------------------------------------------------------------------------
 # tensor arrays (special: they produce/consume TensorArray env values)
@@ -242,17 +266,20 @@ def _while(ctx, op, env):
             "fill_constant each of them before `with while_op.block():`."
             % missing)
 
+    err0 = env.read_opt(PROGRAM_ERR)
     init = (jnp.zeros((), jnp.int32),
             jnp.reshape(env.read(cond_name), ()).astype(bool),
-            tuple(env.read(n) for n in carry_names))
+            tuple(env.read(n) for n in carry_names),
+            jnp.zeros((), bool) if err0 is None else err0)
 
     def cond_fn(carry):
         return carry[1]
 
     def body_fn(carry):
-        it, _, vals = carry
+        it, _, vals, err = carry
         benv = Env()
         benv.values = dict(env.values)
+        benv.write(PROGRAM_ERR, err)
         for n, v in zip(carry_names, vals):
             benv.write(n, v)
         ctx._loop_iters.append(it)
@@ -265,12 +292,14 @@ def _while(ctx, op, env):
             if not isinstance(v, (TensorArray, RankTable)) else benv.read(n)
             for n, v in zip(carry_names, vals))
         return (it + 1,
-                jnp.reshape(benv.read(cond_name), ()).astype(bool), new_vals)
+                jnp.reshape(benv.read(cond_name), ()).astype(bool), new_vals,
+                _sweep_overflow(benv, err))
 
-    _, _, final = lax.while_loop(cond_fn, body_fn, init)
+    _, _, final, final_err = lax.while_loop(cond_fn, body_fn, init)
     for n, v in zip(carry_names, final):
         env.write(n, v)
     env.write(cond_name, jnp.zeros((1,), bool))
+    accumulate_error(env, final_err)
 
 
 # ---------------------------------------------------------------------------
@@ -282,17 +311,23 @@ def _conditional_block(ctx, op, env):
     sub = ctx.program.blocks[op.attrs["sub_block"]]
     out_names = list(op.attrs["out_names"])
 
+    zero_err = jnp.zeros((), bool)
+
     def run_block():
         benv = Env()
         benv.values = dict(env.values)
+        benv.write(PROGRAM_ERR, zero_err)  # block-local error contribution
         lower_block(ctx, sub, benv)
-        return [benv.read(n) for n in out_names]
+        return ([benv.read(n) for n in out_names],
+                _sweep_overflow(benv, zero_err))
 
     if not op.attrs.get("is_scalar_condition", True):
         # IfElse form: merge_lod_tensor's row mask does the select; the
         # block itself runs unconditionally on the full batch.
-        for n, v in zip(out_names, run_block()):
+        outs, berr = run_block()
+        for n, v in zip(out_names, outs):
             env.write(n, v)
+        accumulate_error(env, berr)
         return
 
     cond = jnp.reshape(env.read(op.inputs["Cond"][0]), ()).astype(bool)
@@ -300,7 +335,8 @@ def _conditional_block(ctx, op, env):
     # against each out var's previous value (zeros if first write) — Switch
     # cases each overwrite the same out vars, last-where with exclusive
     # conditions reproduces first-match-wins. XLA dedupes the shared work.
-    outs = run_block()
+    outs, berr = run_block()
+    accumulate_error(env, berr & cond)  # untaken branch can't overflow
     for n, o in zip(out_names, outs):
         p = env.read_opt(n)
         if p is None:
@@ -348,8 +384,9 @@ def _rnn_scan_lower(ctx, ins, attrs):
     xs_t = [jnp.moveaxis(x, 1, 0) for x in xs]  # [T, B, ...]
 
     def step(carry, xt):
-        t, mems = carry
+        t, mems, err = carry
         benv = Env()
+        benv.write(PROGRAM_ERR, err)
         for n, v in zip(static_names, statics):
             benv.write(n, v)
         for n, v in zip(pre_names, mems):
@@ -373,13 +410,17 @@ def _rnn_scan_lower(ctx, ins, attrs):
 
             new_mems = [sel(nm, pm) for nm, pm in zip(new_mems, mems)]
             outs = [sel(o, jnp.zeros_like(o)) for o in outs]
-        return (t + 1, tuple(new_mems)), tuple(outs)
+        return (t + 1, tuple(new_mems), _sweep_overflow(benv, err)), \
+            tuple(outs)
 
-    (_, final_mems), stacked = lax.scan(
-        step, (jnp.zeros((), jnp.int32), tuple(boots)), tuple(xs_t),
+    (_, final_mems, final_err), stacked = lax.scan(
+        step, (jnp.zeros((), jnp.int32), tuple(boots),
+               jnp.zeros((), bool)), tuple(xs_t),
         length=T)
     outs = [jnp.moveaxis(o, 0, 1) for o in stacked]  # [B, T, ...]
-    return {"Out": outs, "LastMem": list(final_mems)}
+    # "__errors__" is accumulated into the enclosing env by lower_op
+    return {"Out": outs, "LastMem": list(final_mems),
+            "__errors__": final_err}
 
 
 def _rnn_scan_infer(block, op, out_vars):

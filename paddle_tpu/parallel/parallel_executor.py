@@ -20,7 +20,9 @@ import jax.numpy as jnp
 
 from ..core import lowering
 from ..core.framework import default_main_program
-from ..core.executor import global_scope, _to_array, _feed_signature
+from ..core.executor import (global_scope, _to_array, _feed_signature,
+                             _nan_inf_enabled, _raise_program_errors,
+                             _array_safety_enabled, check_finite)
 from .mesh import data_parallel_mesh, replicated, batch_sharded, NamedSharding, P
 
 
@@ -28,7 +30,7 @@ class ParallelExecutor(object):
     def __init__(self, use_cuda=None, loss_name=None, main_program=None,
                  num_threads=None, allow_op_delay=False, share_vars_from=None,
                  use_tpu=None, devices=None, mesh=None, param_shardings=None,
-                 batch_axis="dp"):
+                 batch_axis="dp", check_nan_inf=None):
         self._program = main_program if main_program is not None \
             else default_main_program()
         self.mesh = mesh if mesh is not None else data_parallel_mesh(
@@ -38,6 +40,8 @@ class ParallelExecutor(object):
         self._param_shardings = dict(param_shardings or {})
         self._batch_axis = batch_axis
         self._cache = {}
+        self._check_nan_inf = _nan_inf_enabled(check_nan_inf)
+        self._array_safety = _array_safety_enabled()
         self._scope = global_scope()
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
@@ -68,7 +72,7 @@ class ParallelExecutor(object):
             feed_arrays[name] = arr
         feed_names = sorted(feed_arrays)
 
-        key = (id(program), program._version,
+        key = (program._uid, program._version,
                _feed_signature(feed_arrays), tuple(fetch_names))
         entry = self._cache.get(key)
         if entry is None:
@@ -76,7 +80,7 @@ class ParallelExecutor(object):
                 program, feed_names, fetch_names)
             fn = lowering.build_program_fn(
                 program, feed_names, fetch_names, state_rw, state_ro,
-                state_out, mesh=self.mesh)
+                state_out, mesh=self.mesh, collect_errors=True)
             rep = replicated(self.mesh)
             in_shardings = (
                 [batch_sharded(self.mesh, np.asarray(feed_arrays[n]).ndim,
@@ -87,7 +91,8 @@ class ParallelExecutor(object):
                 rep,
             )
             out_shardings = (rep,
-                             [self._state_sharding(n) for n in state_out])
+                             [self._state_sharding(n) for n in state_out],
+                             rep)
             jitted = jax.jit(fn, in_shardings=in_shardings,
                              out_shardings=out_shardings,
                              donate_argnums=(1,))
@@ -116,10 +121,19 @@ class ParallelExecutor(object):
             for n in feed_names]
 
         seed = jnp.asarray(np.uint32(scope.next_seed()))
-        fetches, new_state = jitted(feed_vals, read_state(state_rw),
-                                    read_state(state_ro), seed)
+        fetches, new_state, errors = jitted(feed_vals, read_state(state_rw),
+                                            read_state(state_ro), seed)
+        # state write-back precedes any raise: rw inputs were donated (see
+        # Executor.run)
         for n, v in zip(state_out, new_state):
             scope.set(n, v)
+        if self._array_safety:
+            _raise_program_errors(errors)
+        if self._check_nan_inf:
+            check_finite(
+                list(zip(fetch_names, fetches)) +
+                list(zip(state_out, new_state)),
+                context="ParallelExecutor.run")
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

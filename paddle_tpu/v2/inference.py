@@ -23,19 +23,14 @@ class Inference(object):
         self._exe = fluid.Executor(fluid.CPUPlace())
 
     def _feeder(self, feeding):
-        data_layers = self.__parameters__.topology.data_layers()
-        names = list(data_layers)
-        if feeding is not None:
-            if isinstance(feeding, dict):
-                names = [n for n, _ in
-                         sorted(feeding.items(), key=lambda kv: kv[1])]
-            else:
-                names = list(feeding)
-        # only keep data layers the pruned graph still reads
-        gvars = self.__program__.global_block().vars
-        names = [n for n in names if n in gvars]
-        return fluid.DataFeeder(
-            feed_list=names, program=self.__parameters__.topology.main_program)
+        from .topology import make_feeder
+        # feed only data layers the pruned graph still reads — but resolve
+        # column positions against the FULL feeding order, so a pruned-away
+        # layer (e.g. the label) skips its input column instead of shifting
+        # the remaining ones onto wrong columns
+        keep = set(self.__program__.global_block().vars)
+        return make_feeder(self.__parameters__.topology, feeding,
+                           keep_names=keep)
 
     def iter_infer_field(self, field, **kwargs):
         for result in self.iter_infer(**kwargs):

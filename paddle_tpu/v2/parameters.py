@@ -82,7 +82,11 @@ class Parameters(object):
         cur = self.scope.get(name)
         value = np.asarray(value)
         if cur is not None and tuple(np.shape(cur)) != value.shape:
-            value = value.reshape(np.shape(cur))
+            # reference Parameters.__setitem__ raises on mismatch — a silent
+            # reshape would scramble e.g. a transposed weight matrix
+            raise ValueError(
+                "parameter %r has shape %s, cannot set value of shape %s"
+                % (name, tuple(np.shape(cur)), value.shape))
         self.scope.set(name, value)
 
     __setitem__ = set

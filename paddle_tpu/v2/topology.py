@@ -40,3 +40,47 @@ class Topology(object):
         """The serialized model config (reference: ModelConfig proto); here
         the printable program desc serves the same debugging role."""
         return self.main_program.to_string()
+
+
+class _ColumnFeeder(object):
+    """Projects each input row onto explicit source columns before handing
+    it to the (strictly positional) DataFeeder — so a {name: column} feeding
+    dict with gaps, or a pruned-away data layer, never shifts the remaining
+    names onto wrong columns."""
+
+    def __init__(self, feeder, columns):
+        self._feeder = feeder
+        self._columns = columns  # source column index per feed name
+
+    def feed(self, data):
+        rows = [[row[c] for c in self._columns] for row in data]
+        return self._feeder.feed(rows)
+
+
+def make_feeder(topology, feeding=None, keep_names=None):
+    """Resolve the v2 feeding spec into a feeder (shared by trainer.SGD and
+    inference.Inference — reference: v2/trainer.py feeding handling).
+
+    feeding: None, a {name: input-row column} dict, or an ordered name list.
+    keep_names: names the (possibly pruned) program still reads.
+
+    Column semantics (reference parity): an explicit feeding dict/list pins
+    each name to its input-row column — pruned names drop out without
+    shifting the others. With feeding=None the input rows are expected to
+    contain exactly the KEPT data layers in creation order (a v2 inference
+    caller feeds only the columns the pruned topology reads)."""
+    from .. import data_feeder as _df
+    if feeding is None:
+        pairs = list(enumerate(topology.data_layers()))
+    elif isinstance(feeding, dict):
+        pairs = sorted((c, n) for n, c in feeding.items())
+    else:
+        pairs = list(enumerate(feeding))
+    if keep_names is not None:
+        pairs = [(c, n) for c, n in pairs if n in keep_names]
+    if feeding is None:
+        # no explicit columns: rows contain only the kept layers, in order
+        pairs = [(i, n) for i, (_, n) in enumerate(pairs)]
+    names = [n for _, n in pairs]
+    feeder = _df.DataFeeder(feed_list=names, program=topology.main_program)
+    return _ColumnFeeder(feeder, [c for c, _ in pairs])

@@ -53,16 +53,8 @@ class SGD(object):
             update_equation.fluid_opt.minimize(self.cost)
 
     def _feeder(self, feeding):
-        data_layers = self.__topology__.data_layers()
-        names = list(data_layers)
-        if feeding is not None:
-            if isinstance(feeding, dict):
-                names = [n for n, _ in
-                         sorted(feeding.items(), key=lambda kv: kv[1])]
-            else:
-                names = list(feeding)
-        prog = self.__topology__.main_program
-        return fluid.DataFeeder(feed_list=names, program=prog)
+        from .topology import make_feeder
+        return make_feeder(self.__topology__, feeding)
 
     def train(self, reader, num_passes=1, event_handler=None, feeding=None):
         if event_handler is None:

@@ -92,3 +92,20 @@ def test_v2_trainer_recognize_digits():
         out2 = paddle.infer(output_layer=predict, parameters=p2,
                             input=probe)
         np.testing.assert_allclose(out, out2, rtol=1e-5, atol=1e-6)
+
+        # explicit feeding dict with the label column present: pruning the
+        # label layer must NOT shift 'pixel' onto the wrong column (rows
+        # here are (label, pixel) — pixel is column 1)
+        probe_lb = [(int(k), centers[k]) for k in (2, 5, 8)]
+        out3 = paddle.infer(output_layer=predict, parameters=parameters,
+                            input=probe_lb,
+                            feeding={"label": 0, "pixel": 1})
+        assert list(out3.argmax(axis=1)) == [2, 5, 8]
+
+        # wrong-shape parameter assignment must raise, not silently reshape
+        w = parameters["fc_0.w_0"]
+        try:
+            parameters["fc_0.w_0"] = w.T
+            raise AssertionError("shape-mismatched set did not raise")
+        except ValueError:
+            pass

@@ -1,0 +1,86 @@
+"""NaN/Inf failure detection (FLAGS_check_nan_inf parity).
+
+Parity: paddle/fluid/framework/tensor_util.cc:163 TensorContainsNAN/Inf +
+operator.cc's FLAGS_check_nan_inf sweep. Here the debug-mode Executor checks
+every fetch and every updated state array after the jitted step and raises
+naming the first offending variable.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build_explosive(lr):
+    """y = fc(x); square loss; absurd LR so weights blow up in a few steps."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=lr).minimize(cost)
+    return cost
+
+
+def test_exploding_run_raises_with_var_name():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        cost = _build_explosive(lr=1e12)
+    exe = fluid.Executor(fluid.CPUPlace(), check_nan_inf=True)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 4).astype("float32")
+    ys = rng.rand(8, 1).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(RuntimeError) as ei:
+            for _ in range(10):
+                exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[cost])
+        msg = str(ei.value)
+        assert "NaN" in msg or "Inf" in msg
+        # names a concrete variable (loss fetch or a state var like fc_0.w_0)
+        assert "variable" in msg
+
+
+def test_healthy_run_passes_check():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        cost = _build_explosive(lr=0.01)
+    exe = fluid.Executor(fluid.CPUPlace(), check_nan_inf=True)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(5):
+            loss, = exe.run(main,
+                            feed={"x": rng.rand(8, 4).astype("float32"),
+                                  "y": rng.rand(8, 1).astype("float32")},
+                            fetch_list=[cost])
+        assert np.isfinite(np.asarray(loss)).all()
+
+
+def test_env_var_enables_check(monkeypatch):
+    monkeypatch.setenv("FLAGS_check_nan_inf", "1")
+    exe = fluid.Executor(fluid.CPUPlace())
+    assert exe._check_nan_inf
+    monkeypatch.setenv("FLAGS_check_nan_inf", "0")
+    assert not fluid.Executor(fluid.CPUPlace())._check_nan_inf
+
+
+def test_parallel_executor_check_nan_inf():
+    from paddle_tpu.parallel.mesh import data_parallel_mesh
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        cost = _build_explosive(lr=1e12)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pexe = fluid.ParallelExecutor(main_program=main, loss_name=cost.name,
+                                      check_nan_inf=True)
+        with pytest.raises(RuntimeError, match="NaN|Inf"):
+            for _ in range(10):
+                pexe.run(feed={"x": rng.rand(8, 4).astype("float32"),
+                               "y": rng.rand(8, 1).astype("float32")},
+                         fetch_list=[cost])

@@ -46,10 +46,22 @@ def test_pruned_program_runs_and_matches_full_forward():
     ys = rng.rand(4, 1).astype("float32")
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)
-        # full program updates params; fetch pred BEFORE it runs the update
-        full_out, = exe.run(pruned, feed={"x": xs}, fetch_list=[pred])
+        # train one step with the full program, then check the pruned
+        # program computes the true forward at the UPDATED params (numpy
+        # reference), proving it shares state with — but doesn't step — the
+        # training graph
+        full_out, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[pred])
+        w0 = np.asarray(fluid.global_scope().get("fc_0.w_0"))
+        b0 = np.asarray(fluid.global_scope().get("fc_0.w_1"))
+        w1 = np.asarray(fluid.global_scope().get("fc_1.w_0"))
+        b1 = np.asarray(fluid.global_scope().get("fc_1.w_1"))
+        ref = np.maximum(xs @ w0 + b0, 0.0) @ w1 + b1
         pruned_out, = exe.run(pruned, feed={"x": xs}, fetch_list=[pred])
-        np.testing.assert_allclose(full_out, pruned_out)
+        np.testing.assert_allclose(np.asarray(pruned_out), ref, rtol=2e-5)
+        # full fetch was pre-update, so it must differ from the pruned
+        # (post-update) forward — guards against prune returning the
+        # training graph itself
+        assert not np.allclose(np.asarray(full_out), np.asarray(pruned_out))
         # pruned program must not touch parameters: run it twice, params same
         before = {v.name: np.asarray(fluid.global_scope().get(v.name)).copy()
                   for v in main.global_block().all_parameters()}

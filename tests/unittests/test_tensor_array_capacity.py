@@ -1,0 +1,110 @@
+"""TensorArray capacity safety.
+
+Concrete out-of-capacity writes fail at trace time (IndexError); traced
+writes inside lax control flow set the array's sticky overflow flag, which
+build_program_fn surfaces as an in-graph error output and the Executor
+raises on — instead of XLA's silent index clamp corrupting results.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _loop_program(capacity, iters):
+    """While loop writing a fresh value at index i for i in [0, iters)."""
+    counter = layers.zeros(shape=[1], dtype="int32")
+    counter.stop_gradient = True
+    limit = layers.fill_constant(shape=[1], dtype="int32", value=iters)
+    arr = layers.create_array("float32", capacity=capacity)
+    x = layers.fill_constant(shape=[4], dtype="float32", value=1.0)
+    layers.array_write(x, counter, arr)
+
+    cond = layers.less_than(x=counter, y=limit)
+    while_op = layers.While(cond=cond)
+    with while_op.block():
+        v = layers.array_read(arr, counter)
+        v2 = layers.elementwise_add(x=v, y=x)
+        layers.increment(counter, 1, in_place=True)
+        layers.array_write(v2, counter, arr)
+        layers.less_than(x=counter, y=limit, cond=cond)
+    final = layers.array_read(arr, counter)
+    length = layers.array_length(arr)
+    return final, length
+
+
+def test_traced_overflow_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        final, length = _loop_program(capacity=4, iters=10)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="overflowed its capacity 4"):
+            exe.run(main, fetch_list=[final])
+
+
+def test_within_capacity_runs_clean():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        final, length = _loop_program(capacity=16, iters=10)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out, n = exe.run(main, fetch_list=[final, length])
+        # 10 adds of ones onto ones
+        np.testing.assert_allclose(np.asarray(out), np.full(4, 11.0))
+        assert int(np.asarray(n)[0]) == 11
+
+
+def test_subblock_confined_overflow_raises():
+    """An array created AND consumed inside a While body (never a loop
+    carry) still reports overflow: the sticky flag is swept into the loop's
+    error carry and surfaces through the generic sub-block error output."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        counter = layers.zeros(shape=[1], dtype="int32")
+        counter.stop_gradient = True
+        limit = layers.fill_constant(shape=[1], dtype="int32", value=3)
+        acc = layers.fill_constant(shape=[2], dtype="float32", value=0.0)
+        cond = layers.less_than(x=counter, y=limit)
+        while_op = layers.While(cond=cond)
+        with while_op.block():
+            # block-local scratch array; index 5 exceeds capacity 2
+            scratch = layers.create_array("float32", capacity=2)
+            bad_idx = layers.fill_constant(shape=[1], dtype="int32", value=5)
+            x = layers.fill_constant(shape=[2], dtype="float32", value=1.0)
+            layers.array_write(x, bad_idx, scratch)
+            v = layers.array_read(scratch, bad_idx)
+            acc2 = layers.elementwise_add(x=acc, y=v)
+            layers.assign(acc2, acc)
+            layers.increment(counter, 1, in_place=True)
+            layers.less_than(x=counter, y=limit, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="sub-block overflowed"):
+            exe.run(main, fetch_list=[acc])
+
+
+def test_straight_line_overflow_raises():
+    # overflow outside any loop: everything under jit is traced, so this
+    # too is caught by the sticky flag rather than a Python-level check
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        arr = layers.create_array("float32", capacity=2)
+        x = layers.fill_constant(shape=[3], dtype="float32", value=0.5)
+        for i in range(3):  # indices 0,1,2 — 2 exceeds capacity
+            idx = layers.fill_constant(shape=[1], dtype="int32", value=i)
+            layers.array_write(x, idx, arr)
+        out = layers.array_read(arr, idx)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises((RuntimeError, IndexError), match="capacity"):
+            exe.run(main, fetch_list=[out])
