@@ -7,6 +7,8 @@ fetch list) is lowered ONCE to a jitted XLA computation and cached —
 subsequent runs are a single device dispatch, vs. the reference's per-op
 kernel launches every run.
 """
+import time
+
 import numpy as np
 
 import jax
@@ -240,8 +242,10 @@ class Executor(object):
         key = (getattr(program, "_uid", None) or id(program),
                program._version, _feed_signature(feed_arrays),
                tuple(fetch_names))
+        compiled = False
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
+            compiled = True
             state_rw, state_ro, state_out = lowering.analyze_state(
                 program, feed_names, fetch_names)
             fn = lowering.build_program_fn(
@@ -265,16 +269,27 @@ class Executor(object):
             return vals
 
         seed = np.uint32(scope.next_seed())
+        from .. import profiler as _prof
+        profiling = _prof.is_active()
+        t0 = time.perf_counter() if profiling else 0.0
         with jax.default_device(self.place.device()):
             fetches, new_state, errors = jitted(
                 [feed_arrays[n] for n in feed_names],
                 read_state(state_rw), read_state(state_ro), seed)
-        # write state back BEFORE any error raise: state_rw inputs were
-        # donated to the jit, so on an exception path the scope must already
-        # hold the (valid) output buffers or it is left pointing at deleted
-        # arrays and the caller can't even checkpoint/inspect.
+        # write state back BEFORE anything that can raise (including the
+        # profiler's block_until_ready): state_rw inputs were donated to the
+        # jit, so on an exception path the scope must already hold the
+        # (valid) output buffers or it is left pointing at deleted arrays
+        # and the caller can't even checkpoint/inspect.
         for n, v in zip(state_out, new_state):
             scope.set(n, v)
+        if profiling:
+            jax.block_until_ready((fetches, new_state))
+            dt = time.perf_counter() - t0
+            tag = "program_%s(v%d) fetch=%s" % (
+                getattr(program, "_uid", "?"), program._version,
+                ",".join(fetch_names) or "-")
+            _prof.record_run(tag, dt, compiled=compiled)
         if self._array_safety:
             _raise_program_errors(errors)
         if self._check_nan_inf:

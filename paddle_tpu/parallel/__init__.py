@@ -4,3 +4,5 @@ from .mesh import make_mesh, data_parallel_mesh, replicated, batch_sharded, \
 from .parallel_executor import ParallelExecutor
 from .ring_attention import ring_attention, ring_attention_sharded, \
     attention_reference, sequence_parallel_specs
+from .pipeline import pipeline_apply, pipeline_stages_spec, \
+    stack_stage_params, sequential_reference

@@ -13,6 +13,8 @@ the backward pass (what the reference's allow_op_delay tried to approximate
 by hand). The scheduling knobs are accepted and ignored — XLA owns the
 schedule.
 """
+import time as _time
+
 import numpy as np
 
 import jax
@@ -74,8 +76,10 @@ class ParallelExecutor(object):
 
         key = (program._uid, program._version,
                _feed_signature(feed_arrays), tuple(fetch_names))
+        compiled = False
         entry = self._cache.get(key)
         if entry is None:
+            compiled = True
             state_rw, state_ro, state_out = lowering.analyze_state(
                 program, feed_names, fetch_names)
             fn = lowering.build_program_fn(
@@ -121,12 +125,22 @@ class ParallelExecutor(object):
             for n in feed_names]
 
         seed = jnp.asarray(np.uint32(scope.next_seed()))
+        from .. import profiler as _prof
+        profiling = _prof.is_active()
+        t0 = _time.perf_counter() if profiling else 0.0
         fetches, new_state, errors = jitted(feed_vals, read_state(state_rw),
                                             read_state(state_ro), seed)
         # state write-back precedes any raise: rw inputs were donated (see
         # Executor.run)
         for n, v in zip(state_out, new_state):
             scope.set(n, v)
+        if profiling:
+            jax.block_until_ready((fetches, new_state))
+            tag = "pexe_program_%s(v%d)x%d fetch=%s" % (
+                program._uid, program._version, self.device_count,
+                ",".join(fetch_names) or "-")
+            _prof.record_run(tag, _time.perf_counter() - t0,
+                             compiled=compiled)
         if self._array_safety:
             _raise_program_errors(errors)
         if self._check_nan_inf:

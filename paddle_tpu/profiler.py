@@ -1,57 +1,120 @@
 """Profiler.
 
 Parity: python/paddle/fluid/profiler.py (cuda_profiler/profiler context
-managers over platform::Profiler). TPU-native: wraps jax.profiler traces
-(viewable in TensorBoard/XProf) and reports per-run wall times + compile
-cache statistics, which replace the reference's per-op CPU/GPU timeline.
+managers over platform::Profiler, whose report printed an Event table sorted
+by `sorted_key` in {calls,total,max,min,ave}). TPU-native: one jitted XLA
+computation replaces the reference's per-op kernel stream, so the profiled
+unit is the jit entry — per (program, feed-signature) call counts, compile
+time, and blocked run times — plus a jax.profiler trace (TensorBoard/XProf)
+for intra-computation detail.
 """
 import contextlib
 import time
 
 import jax
 
-__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler"]
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "profile_report"]
 
-_records = []
+_active = False
 _trace_dir = None
+_span = [None, None]
+_entries = {}  # tag -> {"calls", "total", "max", "min", "compile_s"}
+
+
+def is_active():
+    return _active
+
+
+def record_run(tag, seconds, compiled=False):
+    """Executor hook: one jitted dispatch of `tag` took `seconds` (blocked).
+    The call that traced+compiled goes to Compile(s) only, so Total/Max/Min
+    stay honest execution times."""
+    e = _entries.setdefault(tag, {"calls": 0, "total": 0.0, "max": 0.0,
+                                  "min": float("inf"), "compile_s": 0.0})
+    e["calls"] += 1
+    if compiled:
+        e["compile_s"] += seconds
+    else:
+        e["total"] += seconds
+        e["max"] = max(e["max"], seconds)
+        e["min"] = min(e["min"], seconds)
+
+
+_SORT_KEYS = ("calls", "total", "max", "min", "ave")
+
+
+def _check_sorted_key(sorted_key):
+    if sorted_key is not None and sorted_key not in _SORT_KEYS:
+        raise ValueError("sorted_key must be one of %s, got %r"
+                         % (list(_SORT_KEYS), sorted_key))
 
 
 @contextlib.contextmanager
 def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
-    """Parity: fluid.profiler.profiler context manager."""
+    """Parity: fluid.profiler.profiler context manager. state accepted for
+    API compatibility (CPU/GPU/All — one device stream on TPU)."""
+    _check_sorted_key(sorted_key)  # fail before the workload, not after
     start_profiler(state, profile_path)
-    yield
-    stop_profiler(sorted_key, profile_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
 
 
 def start_profiler(state="All", profile_path="/tmp/profile"):
-    global _trace_dir
+    global _trace_dir, _active
+    _active = True
     _trace_dir = profile_path
     try:
         jax.profiler.start_trace(profile_path)
     except Exception:
         _trace_dir = None
-    _records.append(("start", time.time()))
+    _span[0] = time.time()
+
+
+def profile_report(sorted_key=None):
+    """The Event-table equivalent: one row per jitted program entry.
+
+    sorted_key: None (insertion order) | 'calls' | 'total' | 'max' | 'min'
+    | 'ave' (reference profiler.py sorted_key contract)."""
+    _check_sorted_key(sorted_key)
+    rows = [(tag, e["calls"], e["total"], e["max"],
+             0.0 if e["min"] == float("inf") else e["min"],
+             e["total"] / max(e["calls"], 1), e["compile_s"])
+            for tag, e in _entries.items()]
+    keyidx = {"calls": 1, "total": 2, "max": 3, "min": 4, "ave": 5}
+    if sorted_key is not None:
+        rows.sort(key=lambda r: r[keyidx[sorted_key]], reverse=True)
+    lines = ["%-40s %8s %10s %10s %10s %10s %10s" %
+             ("Entry", "Calls", "Total(s)", "Max(s)", "Min(s)", "Ave(s)",
+              "Compile(s)")]
+    for tag, calls, total, mx, mn, ave, comp in rows:
+        lines.append("%-40s %8d %10.4f %10.4f %10.4f %10.4f %10.4f"
+                     % (tag[:40], calls, total, mx, mn, ave, comp))
+    return "\n".join(lines)
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    global _trace_dir
+    global _trace_dir, _active
+    _active = False
     if _trace_dir is not None:
         try:
             jax.profiler.stop_trace()
         except Exception:
             pass
         _trace_dir = None
-    _records.append(("stop", time.time()))
-    starts = [t for k, t in _records if k == "start"]
-    stops = [t for k, t in _records if k == "stop"]
-    if starts and stops:
+    _span[1] = time.time()
+    if _span[0] is not None:
         print("[paddle_tpu.profiler] profiled %.3fs; XLA trace at %s"
-              % (stops[-1] - starts[-1], profile_path))
+              % (_span[1] - _span[0], profile_path))
+    if _entries:
+        print(profile_report(sorted_key))
 
 
 def reset_profiler():
-    del _records[:]
+    _entries.clear()
+    _span[0] = _span[1] = None
 
 
 @contextlib.contextmanager

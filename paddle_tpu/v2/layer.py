@@ -31,15 +31,21 @@ def data(name, type):
 
 
 def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None):
-    inputs = input if isinstance(input, (list, tuple)) else input
-    return fluid.layers.fc(input=inputs, size=size, act=_act_name(act),
-                           param_attr=param_attr, bias_attr=bias_attr)
+    # fluid fc accepts a Variable or a list of Variables directly
+    return fluid.layers.fc(input=input, size=size, act=_act_name(act),
+                           param_attr=param_attr, bias_attr=bias_attr,
+                           name=name)
 
 
 def embedding(input, size, param_attr=None):
-    dict_size = getattr(input, "v2_type", None)
-    dim = dict_size.dim if dict_size else None
-    return fluid.layers.embedding(input=input, size=[dim, size],
+    v2_type = getattr(input, "v2_type", None)
+    if v2_type is None or not getattr(v2_type, "dim", None) \
+            or v2_type.dtype != "int64":
+        raise ValueError(
+            "v2 embedding needs its input to be a paddle.layer.data of "
+            "integer_value/integer_value_sequence type (the vocabulary size "
+            "comes from the data type's dim)")
+    return fluid.layers.embedding(input=input, size=[v2_type.dim, size],
                                   param_attr=param_attr)
 
 
