@@ -64,12 +64,21 @@ def _conv2d_transpose(ctx, ins, attrs):
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dil = _pair(attrs.get("dilations", [1, 1]))
+    # Fluid's filter layout [C_in, C_out, kh, kw] is exactly the OIHW layout
+    # of the FORWARD conv this op is the input-gradient of (the transpose
+    # maps the forward conv's O channels back to its I channels), so declare
+    # it "OIHW" and let transpose_kernel swap I/O + flip the taps. And
+    # fluid's `paddings` attr is the FORWARD conv's padding: on the
+    # stride-dilated input the gradient conv pads (effective_k - 1 - pad)
+    # per side, giving the reference output size (H-1)*stride + k - 2*pad.
+    eff = [(w.shape[2] - 1) * dil[0] + 1, (w.shape[3] - 1) * dil[1] + 1]
     out = lax.conv_transpose(
         x, w,
         strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        padding=[(eff[0] - 1 - pads[0], eff[0] - 1 - pads[0]),
+                 (eff[1] - 1 - pads[1], eff[1] - 1 - pads[1])],
         rhs_dilation=dil,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
         transpose_kernel=True)
     return {"Output": [out.astype(x.dtype)]}
 

@@ -14,10 +14,20 @@ Axis conventions used across paddle_tpu:
 import numpy as np
 
 import jax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "data_parallel_mesh", "replicated", "batch_sharded",
-           "Mesh", "NamedSharding", "P"]
+           "vary", "Mesh", "NamedSharding", "P"]
+
+
+def vary(x, axes):
+    """Mark a constant as device-varying over `axes` so shard_map loop
+    carries type-check (jax version compat: pcast on new jax, pvary on
+    older). Shared by ring_attention and pipeline."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, tuple(axes), to="varying")
+    return lax.pvary(x, tuple(axes))
 
 
 def device_count():

@@ -34,18 +34,10 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-from .mesh import P
+from .mesh import P, vary as _vary
 
 __all__ = ["pipeline_apply", "pipeline_stages_spec", "stack_stage_params",
            "sequential_reference"]
-
-
-def _vary(x, axes):
-    """Mark a constant as device-varying so shard_map loop carries type-check
-    (same helper pattern as ring_attention)."""
-    if hasattr(lax, "pcast"):
-        return lax.pcast(x, tuple(axes), to="varying")
-    return lax.pvary(x, tuple(axes))
 
 
 def sequential_reference(stage_fn, stacked_params, x):

@@ -26,7 +26,7 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-from .mesh import P
+from .mesh import P, vary as _vary
 
 __all__ = ["ring_attention", "attention_reference", "ring_attention_sharded",
            "sequence_parallel_specs"]
@@ -112,13 +112,9 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
     # accumulators start as constants; mark them device-varying over the ring
     # axis so the fori_loop carry type is stable under shard_map
     axes = tuple(vary_axes or (axis_name,))
-    if hasattr(lax, "pcast"):
-        vary = lambda x: lax.pcast(x, axes, to="varying")
-    else:  # older jax
-        vary = lambda x: lax.pvary(x, axes)
-    m0 = vary(jnp.full((b, h, t_q), _NEG_INF, dtype=jnp.float32))
-    l0 = vary(jnp.zeros((b, h, t_q), dtype=jnp.float32))
-    o0 = vary(jnp.zeros(q.shape, dtype=jnp.float32))
+    m0 = _vary(jnp.full((b, h, t_q), _NEG_INF, dtype=jnp.float32), axes)
+    l0 = _vary(jnp.zeros((b, h, t_q), dtype=jnp.float32), axes)
+    o0 = _vary(jnp.zeros(q.shape, dtype=jnp.float32), axes)
     body = _ring_body(axis_name, n, causal, scale, t_q, t_k)
     _, _, m, l, o, _, _ = lax.fori_loop(
         0, n, body, (k, v, m0, l0, o0, q.astype(jnp.float32), my_idx))

@@ -28,14 +28,17 @@ def is_active():
 
 def record_run(tag, seconds, compiled=False):
     """Executor hook: one jitted dispatch of `tag` took `seconds` (blocked).
-    The call that traced+compiled goes to Compile(s) only, so Total/Max/Min
-    stay honest execution times."""
-    e = _entries.setdefault(tag, {"calls": 0, "total": 0.0, "max": 0.0,
-                                  "min": float("inf"), "compile_s": 0.0})
+    Calls that traced+compiled are counted separately (Compiles/Compile(s))
+    so Total/Max/Min/Ave stay honest cache-hit execution times."""
+    e = _entries.setdefault(tag, {"calls": 0, "runs": 0, "total": 0.0,
+                                  "max": 0.0, "min": float("inf"),
+                                  "compiles": 0, "compile_s": 0.0})
     e["calls"] += 1
     if compiled:
+        e["compiles"] += 1
         e["compile_s"] += seconds
     else:
+        e["runs"] += 1
         e["total"] += seconds
         e["max"] = max(e["max"], seconds)
         e["min"] = min(e["min"], seconds)
@@ -81,17 +84,18 @@ def profile_report(sorted_key=None):
     _check_sorted_key(sorted_key)
     rows = [(tag, e["calls"], e["total"], e["max"],
              0.0 if e["min"] == float("inf") else e["min"],
-             e["total"] / max(e["calls"], 1), e["compile_s"])
+             e["total"] / max(e["runs"], 1),  # mean over EXEC calls only
+             e["compiles"], e["compile_s"])
             for tag, e in _entries.items()]
     keyidx = {"calls": 1, "total": 2, "max": 3, "min": 4, "ave": 5}
     if sorted_key is not None:
         rows.sort(key=lambda r: r[keyidx[sorted_key]], reverse=True)
-    lines = ["%-40s %8s %10s %10s %10s %10s %10s" %
+    lines = ["%-40s %8s %10s %10s %10s %10s %9s %10s" %
              ("Entry", "Calls", "Total(s)", "Max(s)", "Min(s)", "Ave(s)",
-              "Compile(s)")]
-    for tag, calls, total, mx, mn, ave, comp in rows:
-        lines.append("%-40s %8d %10.4f %10.4f %10.4f %10.4f %10.4f"
-                     % (tag[:40], calls, total, mx, mn, ave, comp))
+              "Compiles", "Compile(s)")]
+    for tag, calls, total, mx, mn, ave, ncomp, comp in rows:
+        lines.append("%-40s %8d %10.4f %10.4f %10.4f %10.4f %9d %10.4f"
+                     % (tag[:40], calls, total, mx, mn, ave, ncomp, comp))
     return "\n".join(lines)
 
 

@@ -1,0 +1,592 @@
+"""Exhaustive op coverage: every name in layers/ops.py.__all__ and
+layers/nn.py.__all__ gets at least one numeric assertion (VERDICT r1 #6).
+
+Parity model: the reference's per-op test_*_op.py files
+(python/paddle/fluid/tests/unittests/), collapsed into table-driven checks
+through the real executor path. Forward checks compare against numpy
+references; gradient checks use central finite differences (op_test
+harness).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import check_forward, check_grad_fd, run_op
+
+rng = np.random.RandomState(77)
+
+
+def _x(*shape):
+    return rng.randn(*shape).astype("float32")
+
+
+def _run_layers(build, feed=None, n_runs=1):
+    """Build a program with `build(fetches: list)` and run it, returning the
+    fetches of the last run."""
+    main, startup = fluid.Program(), fluid.Program()
+    fetches = []
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        build(fetches)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(n_runs):
+            out = exe.run(main, feed=feed or {}, fetch_list=fetches)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# all 30 activations, forward vs numpy (attr defaults exercised)
+# ---------------------------------------------------------------------------
+
+def _np_softshrink(x, lam=0.5):
+    return np.where(x > lam, x - lam, np.where(x < -lam, x + lam, 0.0))
+
+
+ACT_ALL = [
+    ("sigmoid", {}, lambda x: 1 / (1 + np.exp(-x)), None),
+    ("logsigmoid", {}, lambda x: -np.log1p(np.exp(-x)), None),
+    ("exp", {}, np.exp, None),
+    ("relu", {}, lambda x: np.maximum(x, 0), None),
+    ("tanh", {}, np.tanh, None),
+    ("tanh_shrink", {}, lambda x: x - np.tanh(x), None),
+    ("softshrink", {"lambda": 0.3},
+     lambda x: _np_softshrink(x, 0.3), None),
+    ("sqrt", {}, np.sqrt, lambda x: np.abs(x) + 0.5),
+    ("abs", {}, np.abs, None),
+    ("ceil", {}, np.ceil, None),
+    ("floor", {}, np.floor, None),
+    ("cos", {}, np.cos, None),
+    ("sin", {}, np.sin, None),
+    ("round", {}, np.round, None),
+    ("reciprocal", {}, lambda x: 1.0 / x,
+     lambda x: x + 2.0 * np.sign(x)),
+    ("log", {}, np.log, lambda x: np.abs(x) + 0.5),
+    ("square", {}, np.square, None),
+    ("softplus", {}, lambda x: np.log1p(np.exp(x)), None),
+    ("softsign", {}, lambda x: x / (1 + np.abs(x)), None),
+    ("brelu", {"t_min": -0.4, "t_max": 0.9},
+     lambda x: np.clip(x, -0.4, 0.9), None),
+    ("leaky_relu", {"alpha": 0.1},
+     lambda x: np.where(x > 0, x, 0.1 * x), None),
+    ("soft_relu", {"threshold": 40.0},
+     lambda x: np.log1p(np.exp(np.clip(x, -40.0, 40.0))), None),
+    ("elu", {"alpha": 0.7},
+     lambda x: np.where(x > 0, x, 0.7 * (np.exp(x) - 1)), None),
+    ("relu6", {"threshold": 6.0}, lambda x: np.clip(x, 0, 6.0),
+     lambda x: 4.0 * x),
+    ("pow", {"factor": 3.0}, lambda x: np.power(x, 3.0), None),
+    ("stanh", {"scale_a": 0.67, "scale_b": 1.7159},
+     lambda x: 1.7159 * np.tanh(0.67 * x), None),
+    ("hard_shrink", {"threshold": 0.6},
+     lambda x: np.where(np.abs(x) > 0.6, x, 0.0), None),
+    ("thresholded_relu", {"threshold": 0.2},
+     lambda x: np.where(x > 0.2, x, 0.0), None),
+    ("hard_sigmoid", {"slope": 0.2, "offset": 0.5},
+     lambda x: np.clip(0.2 * x + 0.5, 0.0, 1.0), None),
+    ("swish", {"beta": 1.5},
+     lambda x: x / (1 + np.exp(-1.5 * x)), None),
+]
+
+
+@pytest.mark.parametrize("op,attrs,ref,dom",
+                         ACT_ALL, ids=[c[0] for c in ACT_ALL])
+def test_every_activation_forward(op, attrs, ref, dom):
+    x = _x(4, 9)
+    if dom is not None:
+        x = dom(x).astype("float32")
+    check_forward(op, {"X": x}, ref(x), attrs=attrs, rtol=1e-4, atol=1e-5)
+
+
+SMOOTH_ACTS = ["sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink",
+               "square", "softplus", "softsign", "stanh", "swish"]
+
+
+@pytest.mark.parametrize("op", SMOOTH_ACTS)
+def test_smooth_activation_grads(op):
+    check_grad_fd(op, {"X": _x(3, 4)}, "X")
+
+
+def test_piecewise_activation_grads_off_kink():
+    # kinked activations: check grads on inputs pushed away from the kinks
+    x = _x(3, 4)
+    x = x + 0.5 * np.sign(x)
+    check_grad_fd("leaky_relu", {"X": x}, "X", {"alpha": 0.1})
+    check_grad_fd("elu", {"X": x}, "X", {"alpha": 0.7})
+    check_grad_fd("relu", {"X": x}, "X")
+
+
+# ---------------------------------------------------------------------------
+# elementwise family (sub/div/max/min/pow were untested)
+# ---------------------------------------------------------------------------
+
+def test_elementwise_full_family_forward():
+    x, y = _x(3, 4), np.abs(_x(3, 4)) + 0.5
+    check_forward("elementwise_sub", {"X": x, "Y": y}, x - y)
+    check_forward("elementwise_div", {"X": x, "Y": y}, x / y, rtol=1e-4)
+    check_forward("elementwise_max", {"X": x, "Y": y}, np.maximum(x, y))
+    check_forward("elementwise_min", {"X": x, "Y": y}, np.minimum(x, y))
+    xp = np.abs(x) + 0.5
+    check_forward("elementwise_pow", {"X": xp, "Y": y},
+                  np.power(xp, y), rtol=1e-3)
+
+
+def test_elementwise_sub_div_grads():
+    x, y = _x(3, 4), np.abs(_x(3, 4)) + 1.0
+    check_grad_fd("elementwise_sub", {"X": x, "Y": y}, "Y")
+    check_grad_fd("elementwise_div", {"X": x, "Y": y}, "Y", rtol=3e-2)
+
+
+def test_scale_clip_ops():
+    x = _x(4, 5)
+    check_forward("scale", {"X": x}, x * 2.5 + 0.5,
+                  {"scale": 2.5, "bias": 0.5, "bias_after_scale": True})
+    x2 = x + 0.1 * np.sign(x)  # keep away from clip boundaries
+    check_forward("clip", {"X": x2}, np.clip(x2, -0.7, 0.7),
+                  {"min": -0.7, "max": 0.7})
+    n = np.sqrt((x ** 2).sum())
+    check_forward("clip_by_norm", {"X": x}, x * min(1.0, 1.5 / n),
+                  {"max_norm": 1.5}, rtol=1e-4)
+    check_grad_fd("scale", {"X": x}, "X", {"scale": -1.7, "bias": 0.2})
+
+
+def test_logical_ops():
+    a = (rng.rand(4, 3) > 0.5)
+    b = (rng.rand(4, 3) > 0.5)
+    check_forward("logical_and", {"X": a, "Y": b}, a & b)
+    check_forward("logical_or", {"X": a, "Y": b}, a | b)
+    check_forward("logical_xor", {"X": a, "Y": b}, a ^ b)
+    check_forward("logical_not", {"X": a}, ~a)
+
+
+def test_mean_and_sum_ops():
+    x = _x(3, 5)
+    check_forward("mean", {"X": x}, np.asarray([x.mean()]), rtol=1e-5)
+    xs = [_x(2, 3) for _ in range(3)]
+    check_forward("sum", {"X": xs}, xs[0] + xs[1] + xs[2], rtol=1e-5)
+    check_grad_fd("mean", {"X": x}, "X")
+
+
+# ---------------------------------------------------------------------------
+# cumsum / gather / scatter / squeeze / unsqueeze / expand
+# ---------------------------------------------------------------------------
+
+def test_cumsum_variants():
+    x = _x(3, 6)
+    check_forward("cumsum", {"X": x}, np.cumsum(x, 1), {"axis": 1},
+                  rtol=1e-5)
+    check_forward("cumsum", {"X": x}, np.cumsum(x, 1) - x,
+                  {"axis": 1, "exclusive": True}, rtol=1e-5)
+    rev = np.flip(np.cumsum(np.flip(x, 1), 1), 1)
+    check_forward("cumsum", {"X": x}, rev, {"axis": 1, "reverse": True},
+                  rtol=1e-5)
+    check_grad_fd("cumsum", {"X": _x(2, 4)}, "X", {"axis": -1})
+
+
+def test_gather_forward_and_grad():
+    x = _x(8, 3)
+    idx = np.asarray([[1], [6], [1], [0]], dtype="int64")
+    check_forward("gather", {"X": x, "Index": idx}, x[[1, 6, 1, 0]])
+    got = run_op("gather", {"X": x, "Index": idx}, fetch_grads=("X",))
+    grad = got[-1]
+    expect = np.zeros_like(x)
+    for i in (1, 6, 1, 0):
+        expect[i] += 1.0  # duplicate index 1 must accumulate
+    np.testing.assert_allclose(grad, expect, rtol=1e-5)
+
+
+def test_scatter_forward_and_grads():
+    x = _x(6, 3)
+    ids = np.asarray([[4], [0]], dtype="int64")
+    upd = _x(2, 3)
+    expect = x.copy()
+    expect[[4, 0]] = upd
+    check_forward("scatter", {"X": x, "Ids": ids, "Updates": upd}, expect)
+    got = run_op("scatter", {"X": x, "Ids": ids, "Updates": upd},
+                 fetch_grads=("Updates", "X"))
+    grad_upd, grad_x = got[-2], got[-1]
+    np.testing.assert_allclose(grad_upd, np.ones_like(upd), rtol=1e-5)
+    gx = np.ones_like(x)
+    gx[[4, 0]] = 0.0  # overwritten rows get no gradient
+    np.testing.assert_allclose(grad_x, gx, rtol=1e-5)
+
+
+def test_squeeze_unsqueeze():
+    x = _x(3, 1, 4)
+    check_forward("squeeze", {"X": x}, x.reshape(3, 4), {"axes": [1]})
+    check_forward("unsqueeze", {"X": x.reshape(3, 4)}, x, {"axes": [1]})
+
+
+def test_expand_forward_and_grad():
+    x = _x(2, 3)
+    check_forward("expand", {"X": x}, np.tile(x, [2, 1]),
+                  {"expand_times": [2, 1]})
+    got = run_op("expand", {"X": x}, {"expand_times": [3, 2]},
+                 fetch_grads=("X",))
+    np.testing.assert_allclose(got[-1], np.full_like(x, 6.0), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# random ops (moments) + *_batch_size_like shape contracts
+# ---------------------------------------------------------------------------
+
+def test_uniform_and_gaussian_random_moments():
+    got = run_op("uniform_random", {},
+                 {"shape": [2000], "min": 2.0, "max": 4.0})[0]
+    assert got.shape == (2000,)
+    assert got.min() >= 2.0 and got.max() <= 4.0
+    assert abs(got.mean() - 3.0) < 0.1
+    got = run_op("gaussian_random", {},
+                 {"shape": [4000], "mean": 1.0, "std": 2.0})[0]
+    assert abs(got.mean() - 1.0) < 0.15 and abs(got.std() - 2.0) < 0.15
+
+
+def test_batch_size_like_family():
+    ref = _x(6, 3)
+    got = run_op("fill_constant_batch_size_like", {"Input": ref},
+                 {"shape": [-1, 4], "value": 2.5, "dtype": "float32"})[0]
+    np.testing.assert_allclose(got, np.full((6, 4), 2.5))
+    got = run_op("uniform_random_batch_size_like", {"Input": ref},
+                 {"shape": [-1, 500], "min": -1.0, "max": 1.0})[0]
+    assert got.shape == (6, 500)
+    assert got.min() >= -1.0 and got.max() <= 1.0
+    assert abs(got.mean()) < 0.1
+    got = run_op("gaussian_random_batch_size_like", {"Input": ref},
+                 {"shape": [-1, 800], "mean": 0.0, "std": 1.0})[0]
+    assert got.shape == (6, 800)
+    assert abs(got.std() - 1.0) < 0.1
+
+
+def test_sigmoid_cross_entropy_with_logits_numeric():
+    x = _x(4, 5)
+    lab = (rng.rand(4, 5) > 0.5).astype("float32")
+    expect = np.maximum(x, 0) - x * lab + np.log1p(np.exp(-np.abs(x)))
+    check_forward("sigmoid_cross_entropy_with_logits",
+                  {"X": x, "Label": lab}, expect, rtol=1e-4)
+    check_grad_fd("sigmoid_cross_entropy_with_logits",
+                  {"X": x, "Label": lab}, "X")
+
+
+# ---------------------------------------------------------------------------
+# conv2d_transpose / maxout / lrn — numeric refs + grads
+# ---------------------------------------------------------------------------
+
+def _conv2d_transpose_ref(x, w, stride, pad):
+    n, c, h, win = x.shape
+    _, o, kh, kw = w.shape
+    oh = (h - 1) * stride + kh - 2 * pad
+    ow = (win - 1) * stride + kw - 2 * pad
+    full = np.zeros((n, o, (h - 1) * stride + kh, (win - 1) * stride + kw))
+    for b in range(n):
+        for ci in range(c):
+            for i in range(h):
+                for j in range(win):
+                    full[b, :, i * stride:i * stride + kh,
+                         j * stride:j * stride + kw] += \
+                        x[b, ci, i, j] * w[ci]
+    return full[:, :, pad:pad + oh, pad:pad + ow]
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 0), (2, 1)])
+def test_conv2d_transpose_forward(stride, pad):
+    x = _x(2, 3, 4, 4)
+    w = _x(3, 2, 3, 3)  # IOHW
+    expect = _conv2d_transpose_ref(x, w, stride, pad)
+    got = run_op("conv2d_transpose", {"Input": x, "Filter": w},
+                 {"strides": [stride, stride], "paddings": [pad, pad],
+                  "dilations": [1, 1]}, out_slots=("Output",))[0]
+    np.testing.assert_allclose(got, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_conv2d_transpose_grad():
+    check_grad_fd("conv2d_transpose",
+                  {"Input": _x(1, 2, 3, 3), "Filter": _x(2, 2, 3, 3)},
+                  "Input", {"strides": [2, 2], "paddings": [1, 1],
+                            "dilations": [1, 1]},
+                  out_slots=("Output",))
+
+
+def test_maxout_forward_and_grad():
+    x = _x(2, 6, 3, 3)
+    expect = x.reshape(2, 3, 2, 3, 3).max(axis=2)
+    check_forward("maxout", {"X": x}, expect, {"groups": 2})
+    check_grad_fd("maxout", {"X": x}, "X", {"groups": 2})
+
+
+def test_lrn_forward():
+    x = _x(2, 7, 3, 3)
+    n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+    sq = np.square(x)
+    pad = np.pad(sq, ((0, 0), (n // 2, n // 2), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + 7] for i in range(n))
+    expect = x / np.power(k + alpha * acc, beta)
+    got = run_op("lrn", {"X": x},
+                 {"n": n, "k": k, "alpha": alpha, "beta": beta})[0]
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_nce_deterministic_and_shaped():
+    x = _x(5, 4)
+    w = _x(20, 4)
+    b = _x(20)
+    lab = rng.randint(0, 20, (5, 1)).astype("int64")
+    outs1 = run_op("nce", {"Input": x, "Label": lab, "Weight": w, "Bias": b},
+                   {"num_neg_samples": 6, "num_total_classes": 20,
+                    "seed": 7},
+                   out_slots=("Cost", "SampleLogits", "SampleLabels"))
+    cost1, logits1, samples1 = outs1[:3]
+    assert cost1.shape == (5, 1) and (cost1 > 0).all()
+    assert logits1.shape == (5, 7)  # 1 true + 6 sampled
+    # first sampled column is the true label; samples stay in-vocabulary
+    np.testing.assert_array_equal(samples1[:, 0], lab[:, 0])
+    assert (samples1 >= 0).all() and (samples1 < 20).all()
+    # pinned seed attr -> identical resample across runs
+    outs2 = run_op("nce", {"Input": x, "Label": lab, "Weight": w, "Bias": b},
+                   {"num_neg_samples": 6, "num_total_classes": 20,
+                    "seed": 7},
+                   out_slots=("Cost", "SampleLogits", "SampleLabels"))
+    np.testing.assert_allclose(cost1, outs2[0], rtol=1e-6)
+    # and the true-label logit matches x . w[label] + b[label]
+    expect_true = np.einsum("nd,nd->n", x, w[lab[:, 0]]) + b[lab[:, 0]]
+    np.testing.assert_allclose(logits1[:, 0], expect_true, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# layer-level coverage: fc, embedding, dropout, batch_norm, reduce_min/prod,
+# split, smooth_l1, label_smooth, multiplex, cos_sim, l2_normalize,
+# accuracy, sequence_mask, lod_reset, autoincreased_step_counter
+# ---------------------------------------------------------------------------
+
+def test_fc_layer_vs_numpy():
+    x = _x(4, 6)
+
+    def build(f):
+        xv = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        out = fluid.layers.fc(
+            input=xv, size=3,
+            param_attr=fluid.ParamAttr(
+                name="w", initializer=fluid.initializer.Constant(0.5)),
+            bias_attr=fluid.ParamAttr(
+                name="b", initializer=fluid.initializer.Constant(0.25)))
+        f.append(out)
+
+    out, = _run_layers(build, feed={"x": x})
+    np.testing.assert_allclose(out, x @ np.full((6, 3), 0.5) + 0.25,
+                               rtol=1e-4)
+
+
+def test_embedding_layer_vs_numpy():
+    ids = rng.randint(0, 9, (5, 1)).astype("int64")
+
+    def build(f):
+        iv = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            input=iv, size=[9, 4],
+            param_attr=fluid.ParamAttr(
+                name="tbl", initializer=fluid.initializer.Constant(1.0)))
+        f.append(fluid.layers.reduce_sum(emb, dim=-1))
+
+    out, = _run_layers(build, feed={"ids": ids})
+    np.testing.assert_allclose(out.reshape(-1), np.full(5, 4.0), rtol=1e-5)
+
+
+def test_dropout_layer_statistics():
+    x = np.ones((50, 40), dtype="float32")
+
+    def build_train(f):
+        xv = fluid.layers.data(name="x", shape=[40], dtype="float32")
+        f.append(fluid.layers.dropout(xv, dropout_prob=0.3))
+
+    out, = _run_layers(build_train, feed={"x": x})
+    kept = (np.asarray(out) != 0).mean()
+    assert abs(kept - 0.7) < 0.06, kept  # mask keeps ~70%
+
+    def build_test(f):
+        xv = fluid.layers.data(name="x", shape=[40], dtype="float32")
+        f.append(fluid.layers.dropout(xv, dropout_prob=0.3, is_test=True))
+
+    out, = _run_layers(build_test, feed={"x": x})
+    np.testing.assert_allclose(out, x * 0.7, rtol=1e-6)  # downgrade_in_infer
+
+
+def test_batch_norm_inference_numeric():
+    x = _x(6, 3)
+
+    def build(f):
+        xv = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        f.append(fluid.layers.batch_norm(input=xv, is_test=True))
+
+    out, = _run_layers(build, feed={"x": x})
+    # fresh stats: mean 0, var 1, scale 1, bias 0 -> identity (up to eps)
+    np.testing.assert_allclose(out, x, rtol=1e-3, atol=1e-3)
+
+
+def test_reduce_min_prod_layers():
+    x = np.abs(_x(3, 4)) + 0.2
+
+    def build(f):
+        xv = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        f.append(fluid.layers.reduce_min(xv, dim=1))
+        f.append(fluid.layers.reduce_prod(xv, dim=1))
+
+    mn, pr = _run_layers(build, feed={"x": x})
+    np.testing.assert_allclose(mn, x.min(1), rtol=1e-5)
+    np.testing.assert_allclose(pr, x.prod(1), rtol=1e-4)
+
+
+def test_split_layer():
+    x = _x(4, 9)
+
+    def build(f):
+        xv = fluid.layers.data(name="x", shape=[9], dtype="float32")
+        a, b, c = fluid.layers.split(xv, num_or_sections=[2, 3, 4], dim=1)
+        f.extend([a, b, c])
+
+    a, b, c = _run_layers(build, feed={"x": x})
+    np.testing.assert_allclose(a, x[:, :2])
+    np.testing.assert_allclose(b, x[:, 2:5])
+    np.testing.assert_allclose(c, x[:, 5:])
+
+
+def test_smooth_l1_layer_numeric():
+    x, y = _x(4, 3), _x(4, 3)
+
+    def build(f):
+        xv = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[3], dtype="float32")
+        f.append(fluid.layers.smooth_l1(x=xv, y=yv))
+
+    out, = _run_layers(build, feed={"x": x, "y": y})
+    d = x - y
+    per = np.where(np.abs(d) < 1.0, 0.5 * d * d, np.abs(d) - 0.5)
+    np.testing.assert_allclose(out.reshape(-1), per.sum(1), rtol=1e-4)
+
+
+def test_label_smooth_layer_numeric():
+    onehot = np.eye(5, dtype="float32")[rng.randint(0, 5, 4)]
+
+    def build(f):
+        lv = fluid.layers.data(name="l", shape=[5], dtype="float32")
+        f.append(fluid.layers.label_smooth(label=lv, epsilon=0.1))
+
+    out, = _run_layers(build, feed={"l": onehot})
+    np.testing.assert_allclose(out, 0.9 * onehot + 0.1 / 5, rtol=1e-5)
+
+
+def test_multiplex_layer_numeric():
+    a, b = _x(4, 3), _x(4, 3)
+    idx = np.asarray([[0], [1], [1], [0]], dtype="int64")
+
+    def build(f):
+        av = fluid.layers.data(name="a", shape=[3], dtype="float32")
+        bv = fluid.layers.data(name="b", shape=[3], dtype="float32")
+        iv = fluid.layers.data(name="i", shape=[1], dtype="int64")
+        f.append(fluid.layers.multiplex(inputs=[av, bv], index=iv))
+
+    out, = _run_layers(build, feed={"a": a, "b": b, "i": idx})
+    expect = np.where(idx == 0, a, b)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_cos_sim_and_l2_normalize_layers():
+    x, y = _x(4, 6), _x(4, 6)
+
+    def build(f):
+        xv = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[6], dtype="float32")
+        f.append(fluid.layers.cos_sim(X=xv, Y=yv))
+        f.append(fluid.layers.l2_normalize(x=xv, axis=1))
+
+    cs, l2 = _run_layers(build, feed={"x": x, "y": y})
+    expect_cs = (x * y).sum(1) / (np.linalg.norm(x, axis=1) *
+                                  np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(cs.reshape(-1), expect_cs, rtol=1e-4)
+    np.testing.assert_allclose(
+        l2, x / np.linalg.norm(x, axis=1, keepdims=True), rtol=1e-4)
+
+
+def test_accuracy_layer_numeric():
+    probs = np.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]],
+                       dtype="float32")
+    labels = np.asarray([[1], [0], [0], [0]], dtype="int64")  # 3 of 4 right
+
+    def build(f):
+        pv = fluid.layers.data(name="p", shape=[2], dtype="float32")
+        lv = fluid.layers.data(name="l", shape=[1], dtype="int64")
+        f.append(fluid.layers.accuracy(input=pv, label=lv))
+
+    acc, = _run_layers(build, feed={"p": probs, "l": labels})
+    np.testing.assert_allclose(np.asarray(acc).reshape(-1), [0.75],
+                               rtol=1e-6)
+
+
+def test_sequence_mask_and_lod_reset_layers():
+    lens = np.asarray([3, 1, 4], dtype="int32")
+
+    def build(f):
+        lv = fluid.layers.data(name="lens", shape=[1], dtype="int32",
+                               append_batch_size=False)
+        f.append(fluid.layers.sequence_mask(lv, maxlen=5, dtype="float32"))
+        xv = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        f.append(fluid.layers.lod_reset(xv, target_lod=[2, 2]))
+
+    mask, reset = _run_layers(
+        build, feed={"lens": lens, "x": _x(4, 4)})
+    expect = (np.arange(5)[None] < lens[:, None]).astype("float32")
+    np.testing.assert_allclose(mask, expect)
+    assert reset.shape[0] == 4  # data passes through unchanged
+
+
+def test_im2sequence_layer_numeric():
+    x = _x(2, 2, 3, 3)
+
+    def build(f):
+        xv = fluid.layers.data(name="x", shape=[2, 3, 3], dtype="float32")
+        f.append(fluid.layers.im2sequence(xv, filter_size=2, stride=1,
+                                          padding=0))
+
+    out, = _run_layers(build, feed={"x": x})
+    # 2x2 patches of a 3x3 image -> 4 steps, feature = C*2*2 channel-major
+    assert out.shape == (2, 4, 8)
+    np.testing.assert_allclose(
+        out[0, 0], x[0, :, 0:2, 0:2].reshape(-1), rtol=1e-6)
+    np.testing.assert_allclose(
+        out[1, 3], x[1, :, 1:3, 1:3].reshape(-1), rtol=1e-6)
+
+
+def test_ctc_greedy_decoder_layer_numeric():
+    # probs argmax path: [a, a, blank, b] -> merged/deblanked [a, b]
+    T, C, blank = 4, 3, 2
+    probs = np.zeros((1, T, C), dtype="float32")
+    for t, c in enumerate([0, 0, blank, 1]):
+        probs[0, t, c] = 1.0
+    seqs = [probs[0]]
+
+    def build(f):
+        iv = fluid.layers.data(name="p", shape=[C], dtype="float32",
+                               lod_level=1)
+        f.append(fluid.layers.ctc_greedy_decoder(input=iv, blank=blank))
+
+    out, = _run_layers(
+        build, feed={"p": fluid.LoDTensor.from_sequences(seqs)})
+    flat = np.asarray(out).reshape(-1)
+    # decoded prefix [a, b]; tail is zero padding (ctc_align contract)
+    assert flat[:2].tolist() == [0, 1], flat
+    assert (flat[2:] == 0).all()
+
+
+def test_autoincreased_step_counter():
+    # reference semantics: counter initialized to begin - step? No —
+    # begin - 1, then incremented by `step` each run (so the first fetched
+    # value is `begin` exactly when step == 1, the common LR-schedule case)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        counter = fluid.layers.autoincreased_step_counter(begin=5, step=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        vals = [int(np.asarray(exe.run(main, fetch_list=[counter])[0])[0])
+                for _ in range(3)]
+    assert vals == [5, 6, 7], vals

@@ -29,7 +29,8 @@ def test_profiler_records_per_entry_stats(capsys):
     assert "Calls" in out and "Compile(s)" in out
     report = profiler.profile_report(sorted_key="calls")
     # the training program entry ran 4 times; startup ran once each
-    counts = sorted(int(line.split()[-6]) for line in
+    # 7 numeric columns after the (possibly space-containing) tag
+    counts = sorted(int(line.split()[-7]) for line in
                     report.splitlines()[1:])
     assert counts[-1] == 4, report
     with pytest.raises(ValueError, match="sorted_key"):
