@@ -23,7 +23,7 @@ __all__ = [
     "multiplex", "label_smooth", "nce", "lrn", "maxout", "relu", "log",
     "expand", "sequence_mask", "linear_chain_crf", "crf_decoding",
     "chunk_eval", "warpctc", "ctc_greedy_decoder", "sequence_erase",
-    "edit_distance",
+    "edit_distance", "fused_attention",
 ]
 
 
@@ -577,6 +577,25 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     return counter
 
 
+
+
+def fused_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, name=None):
+    """Flash attention over [B, T, H, D] q/k/v (TPU-native addition — the
+    reference era built attention from matmul+softmax ops; this is the
+    fused pallas path, see ops/pallas_kernels.py). For multi-chip sequence
+    parallelism use parallel.ring_attention instead."""
+    helper = LayerHelper("fused_attention", **locals())
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(
+        type="fused_attention", inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"causal": bool(causal),
+               "scale": None if scale is None else float(scale),
+               "block_q": int(block_q), "block_k": int(block_k)})
+    if q.shape is not None:
+        out.shape = tuple(q.shape)
+    return out
 
 
 def expand(x, expand_times, name=None):

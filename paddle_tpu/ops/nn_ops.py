@@ -266,10 +266,32 @@ def _cross_entropy(ctx, ins, attrs):
     return {"Y": [loss]}
 
 
+def _pallas_enabled():
+    """Pallas fused-kernel fast paths: default on when running on real TPU,
+    forced with PADDLE_TPU_PALLAS=1, disabled with =0."""
+    import os
+    flag = os.environ.get("PADDLE_TPU_PALLAS", "")
+    if flag in ("0", "false", "False"):
+        return False
+    if flag in ("1", "true", "True"):
+        return True
+    return jax.default_backend() == "tpu"
+
+
 @register("softmax_with_cross_entropy")
 def _softmax_xent(ctx, ins, attrs):
     logits = single(ins, "Logits")
     label = single(ins, "Label")
+    if not attrs.get("soft_label", False) and logits.ndim == 2 \
+            and _pallas_enabled():
+        # fused pallas path: loss + logsumexp in one VMEM pass, softmax
+        # never materialized in the forward (the dense Softmax slot below
+        # is DCE'd by XLA unless the program actually consumes it)
+        from . import pallas_kernels as pk
+        loss = pk.softmax_xent(logits, label.reshape(-1))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return {"Softmax": [jnp.exp(logp).astype(logits.dtype)],
+                "Loss": [loss.astype(logits.dtype)]}
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
@@ -277,6 +299,23 @@ def _softmax_xent(ctx, ins, attrs):
         loss = -_gather_label_logits(logp, label)[..., None]
     return {"Softmax": [jnp.exp(logp).astype(logits.dtype)],
             "Loss": [loss.astype(logits.dtype)]}
+
+
+@register("fused_attention")
+def _fused_attention(ctx, ins, attrs):
+    """flash attention over [B, T, H, D] q/k/v (TPU-native addition; see
+    ops/pallas_kernels.py). Differentiable via the kernel's custom_vjp."""
+    from . import pallas_kernels as pk
+    q = single(ins, "Q")
+    k = single(ins, "K")
+    v = single(ins, "V")
+    out = pk.flash_attention(
+        q, k, v,
+        causal=attrs.get("causal", False),
+        scale=attrs.get("scale", None),
+        block_q=attrs.get("block_q", 128),
+        block_k=attrs.get("block_k", 128))
+    return _out(out)
 
 
 @register("sigmoid_cross_entropy_with_logits")

@@ -1,0 +1,304 @@
+"""Pallas TPU kernels for the fused hot ops (SURVEY.md §3: "pallas reserved
+for fused softmax-xent, LN, and flash/ring attention").
+
+flash_attention — blockwise online-softmax attention. The [T, T] score
+matrix never hits HBM: each q-block holds running (max, denom, acc) in VMEM
+while k/v blocks stream past, so peak memory is O(T·D) instead of O(T²) and
+the two matmuls per block ride the MXU back to back. Backward is the
+standard flash recompute (block loop over K using the saved logsumexp) in
+plain lax — memory-matched to the forward, differentiable via custom_vjp.
+
+softmax_xent — fused log-softmax + label pick over the vocab dim: one VMEM
+pass computes the loss and the logsumexp residual; the probability matrix is
+only formed in the backward (where it is the gradient anyway).
+
+Both run as real pallas kernels on TPU and fall back to interpret mode on
+CPU (the unit tests exercise the same kernel code path everywhere).
+
+Parity note: the reference has no fused attention (its transformer builds
+q@k^T + softmax + @v from separate ops, paddle/fluid/operators/matmul_op.cc
++ softmax_op.cc); these kernels are the TPU-native upgrade path behind the
+same layer APIs.
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover - pallas tpu backend unavailable
+    pltpu = None
+    _VMEM = None
+
+__all__ = ["flash_attention", "softmax_xent", "attention_available"]
+
+_NEG = -1e30
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+def attention_available():
+    return pltpu is not None
+
+
+def _vmem_spec(*args, **kwargs):
+    if _VMEM is not None:
+        kwargs.setdefault("memory_space", _VMEM)
+    return pl.BlockSpec(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                      block_q, block_k, t_real, t_pad):
+    qb = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale                 # [bq, d]
+    bq, d = q.shape
+    qpos = qb * block_q + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    nk = t_pad // block_k
+    if causal:
+        # only k blocks up to this q block's causal frontier do any work —
+        # skipping the rest halves the attention FLOPs for causal decode
+        nk_dyn = jnp.minimum(nk, ((qb + 1) * block_q + block_k - 1)
+                             // block_k)
+    else:
+        nk_dyn = nk
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        kpos = kb * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k),
+                                                   1)
+        valid = kpos < t_real
+        if causal:
+            valid = valid & (qpos >= kpos)
+        s = jnp.where(valid, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)                         # masked -> 0
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.dot(p, v,
+                                   preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = lax.fori_loop(
+        0, nk_dyn, body,
+        (jnp.full((bq, 1), _NEG, jnp.float32),
+         jnp.zeros((bq, 1), jnp.float32),
+         jnp.zeros((bq, d), jnp.float32)))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q,k,v: [BH, T, D] -> (out [BH, T, D], lse [BH, T])."""
+    bh, t, d = q.shape
+    # pad T so BOTH the q grid and the k loop divide exactly (mismatched
+    # block sizes otherwise drop tail k blocks / leave q rows unwritten)
+    blk = int(np.lcm(block_q, block_k))
+    t_pad = int(-(-t // blk) * blk)
+    if t_pad != t:
+        pad = [(0, 0), (0, t_pad - t), (0, 0)]
+        q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, t_real=t, t_pad=t_pad)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, t_pad // block_q),
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
+            _vmem_spec((1, t_pad, d), lambda b, i: (b, 0, 0)),
+            _vmem_spec((1, t_pad, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
+            _vmem_spec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :t], lse[:, :t]
+
+
+def _flash_bwd(scale, causal, block_k, res, g):
+    """Flash backward: block loop over K with the saved lse (no [T,T] in
+    memory). Plain lax — XLA fuses it fine; the fwd kernel is where VMEM
+    residency matters."""
+    q, k, v, out, lse = res
+    bh, t, d = q.shape
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)   # [BH, T]
+
+    nk = -(-t // block_k)
+    t_pad = nk * block_k
+    if t_pad != t:
+        pad = [(0, 0), (0, t_pad - t), (0, 0)]
+        kf = jnp.pad(kf, pad)
+        vf = jnp.pad(vf, pad)
+    kblocks = kf.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
+    vblocks = vf.reshape(bh, nk, block_k, d).transpose(1, 0, 2, 3)
+
+    qpos = jnp.arange(t)[None, :, None]                      # [1, T, 1]
+
+    def body(dq, blk):
+        kb_idx, kb, vb = blk
+        kpos = kb_idx * block_k + jnp.arange(block_k)[None, None, :]
+        s = jnp.einsum("btd,bsd->bts", qf, kb) * scale       # [BH, T, bk]
+        valid = kpos < t
+        if causal:
+            valid = valid & (qpos >= kpos)
+        p = jnp.where(valid, jnp.exp(s - lse[..., None]), 0.0)
+        dp = jnp.einsum("btd,bsd->bts", gf, vb)
+        ds = p * (dp - delta[..., None]) * scale
+        dv_b = jnp.einsum("bts,btd->bsd", p, gf)
+        dk_b = jnp.einsum("bts,btd->bsd", ds, qf)
+        dq = dq + jnp.einsum("bts,bsd->btd", ds, kb)
+        return dq, (dk_b, dv_b)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_b, dv_b) = lax.scan(
+        body, dq0, (jnp.arange(nk), kblocks, vblocks))
+    dk = dk_b.transpose(1, 0, 2, 3).reshape(bh, t_pad, d)[:, :t]
+    dv = dv_b.transpose(1, 0, 2, 3).reshape(bh, t_pad, d)[:, :t]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_core_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(scale, causal, block_k, res, g)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Exact attention, flash-style. q,k,v: [B, T, H, D] (BTHD, the layout
+    ring_attention uses); returns [B, T, H, D].
+
+    Differentiable; matches attention_reference to fp32 tolerance. On TPU
+    the forward runs as a pallas kernel (online softmax in VMEM); off-TPU
+    it runs the same kernel in interpret mode.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    block_q = max(8, min(block_q, int(-(-t // 8) * 8)))
+    block_k = max(8, min(block_k, int(-(-t // 8) * 8)))
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    out = _flash_core(to_bh(q), to_bh(k), to_bh(v), float(scale),
+                      bool(causal), int(block_q), int(block_k),
+                      bool(interpret))
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# fused softmax + cross-entropy
+# ---------------------------------------------------------------------------
+
+def _xent_kernel(logits_ref, labels_ref, loss_ref, lse_ref):
+    x = logits_ref[:].astype(jnp.float32)                    # [bn, V]
+    lab = labels_ref[:]                                      # [bn, 1] int32
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True))
+    cols = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    picked = jnp.sum(jnp.where(cols == lab, x, 0.0), axis=-1,
+                     keepdims=True)
+    loss_ref[:] = lse - picked
+    lse_ref[:] = lse
+
+
+def _xent_fwd_call(logits, labels, block_n, interpret):
+    n, v = logits.shape
+    n_pad = int(-(-n // block_n) * block_n)
+    lp = jnp.pad(logits, [(0, n_pad - n), (0, 0)]) if n_pad != n else logits
+    lb = labels.reshape(-1, 1).astype(jnp.int32)
+    lb = jnp.pad(lb, [(0, n_pad - n), (0, 0)]) if n_pad != n else lb
+    loss, lse = pl.pallas_call(
+        _xent_kernel,
+        grid=(n_pad // block_n,),
+        in_specs=[
+            _vmem_spec((block_n, v), lambda i: (i, 0)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(lp, lb)
+    return loss[:n], lse[:n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _xent_core(logits, labels, block_n, interpret):
+    loss, _ = _xent_fwd_call(logits, labels, block_n, interpret)
+    return loss
+
+
+def _xent_core_fwd(logits, labels, block_n, interpret):
+    loss, lse = _xent_fwd_call(logits, labels, block_n, interpret)
+    return loss, (logits, labels, lse)
+
+
+def _xent_core_bwd(block_n, interpret, res, g):
+    logits, labels, lse = res
+    p = jnp.exp(logits.astype(jnp.float32) - lse)            # softmax
+    onehot = jax.nn.one_hot(labels.reshape(-1), logits.shape[-1],
+                            dtype=jnp.float32)
+    dlogits = (p - onehot) * g.reshape(-1, 1)
+    return dlogits.astype(logits.dtype), None
+
+
+_xent_core.defvjp(_xent_core_fwd, _xent_core_bwd)
+
+
+def softmax_xent(logits, labels, block_n=8, interpret=None):
+    """Fused log-softmax + NLL. logits [N, V], labels [N] (or [N,1]) int.
+    Returns loss [N, 1] float32. Differentiable (custom_vjp)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    return _xent_core(logits, labels.reshape(-1), int(block_n),
+                      bool(interpret))

@@ -6,3 +6,5 @@ from .ring_attention import ring_attention, ring_attention_sharded, \
     attention_reference, sequence_parallel_specs
 from .pipeline import pipeline_apply, pipeline_stages_spec, \
     stack_stage_params, sequential_reference
+from .distributed import init_distributed, shutdown_distributed, \
+    global_mesh, is_initialized as distributed_is_initialized

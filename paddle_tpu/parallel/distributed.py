@@ -1,0 +1,122 @@
+"""Multi-host runtime glue: jax.distributed over the reference's cluster
+environment contract.
+
+Parity: the reference's multi-node story is env-var driven k8s jobs
+(`benchmark/cluster/vgg16/fluid_trainer.yaml`: TRAINING_ROLE / TRAINERS /
+PSERVERS + `paddle/scripts/cluster_train` discovery) feeding the pserver
+ring built by distribute_transpiler. TPU-native multi-host needs none of
+the pserver machinery — every host runs the SAME SPMD program, and this
+module's job is just to (a) form the jax.distributed process group from the
+cluster env and (b) hand back a GLOBAL mesh spanning every chip on every
+host, so the one-process ParallelExecutor/pipeline/ring-attention code
+works unchanged at multi-host scale (collectives ride ICI within a slice
+and DCN across, inserted by XLA from the same shardings).
+
+Env contract (reference names first, jax-standard fallbacks):
+  TRAINERS / num_processes        — number of host processes in the job
+  TRAINER_ID / process_id         — this process's rank
+  PADDLE_COORDINATOR / coordinator_address — "host:port" of rank 0
+"""
+import os
+
+import jax
+
+from .mesh import make_mesh, Mesh
+
+__all__ = ["init_distributed", "is_initialized", "shutdown_distributed",
+           "global_mesh", "process_count", "process_index",
+           "local_device_count", "global_device_count"]
+
+# _noop: a single-host init_distributed() ran (nothing to rendezvous).
+# _client: jax.distributed.initialize actually joined a process group.
+# Kept separate so a later call WITH a coordinator still rendezvouses even
+# after an early no-op init, and shutdown only tears down a real client.
+_noop = False
+_client = False
+
+
+def _env_int(*names):
+    for n in names:
+        v = os.environ.get(n)
+        if v not in (None, ""):
+            return int(v)
+    return None
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, local_device_ids=None):
+    """Join the multi-host process group (no-op for single-process jobs).
+
+    Arguments fall back to the env contract above. Call once per host
+    process before any jax device use; after it, jax.devices() is GLOBAL
+    (all chips of all hosts) and `global_mesh` can span the pod.
+    """
+    global _noop, _client
+    if _client:
+        return False
+    coordinator_address = coordinator_address or \
+        os.environ.get("PADDLE_COORDINATOR") or \
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num_processes = num_processes if num_processes is not None else \
+        _env_int("TRAINERS", "JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else \
+        _env_int("TRAINER_ID", "JAX_PROCESS_ID")
+
+    if not coordinator_address and (num_processes in (None, 1)):
+        # single-host run: nothing to initialize, jax.devices() is already
+        # the whole world (a later call WITH a coordinator still works)
+        _noop = True
+        return False
+
+    if not coordinator_address:
+        raise ValueError(
+            "multi-process job (TRAINERS=%r) needs a coordinator: set "
+            "PADDLE_COORDINATOR=host:port of rank 0 (or pass "
+            "coordinator_address)" % (num_processes,))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _client = True
+    return True
+
+
+def is_initialized():
+    return _noop or _client
+
+
+def shutdown_distributed():
+    global _noop, _client
+    if _client:
+        jax.distributed.shutdown()
+        _client = False
+    _noop = False
+
+
+def process_count():
+    return jax.process_count()
+
+
+def process_index():
+    return jax.process_index()
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def global_device_count():
+    return jax.device_count()
+
+
+def global_mesh(axes=None, devices=None):
+    """A Mesh over every chip of every host.
+
+    axes: dict axis -> size with at most one -1 wildcard (default
+    {'dp': -1}, pure data parallel). Lay the fastest-varying (model/tensor)
+    axes innermost so their collectives stay on intra-host ICI; the leading
+    dp axis then crosses hosts over DCN — the standard pod layout."""
+    axes = axes or {"dp": -1}
+    devices = devices if devices is not None else jax.devices()
+    return make_mesh(axes, devices)

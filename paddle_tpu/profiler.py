@@ -19,7 +19,8 @@ __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
 _active = False
 _trace_dir = None
 _span = [None, None]
-_entries = {}  # tag -> {"calls", "total", "max", "min", "compile_s"}
+_entries = {}  # tag -> {"calls", "runs", "total", "max", "min",
+#                        "compiles", "compile_s"} (see record_run)
 
 
 def is_active():

@@ -1,0 +1,70 @@
+"""jax.distributed multi-host glue (single-process behaviors + env
+contract; the actual multi-host rendezvous needs real hosts and is covered
+by jax itself)."""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.parallel import distributed as dist
+
+
+def test_single_process_init_is_noop(monkeypatch):
+    monkeypatch.setattr(dist, "_noop", False)
+    monkeypatch.setattr(dist, "_client", False)
+    monkeypatch.delenv("TRAINERS", raising=False)
+    monkeypatch.delenv("PADDLE_COORDINATOR", raising=False)
+    assert dist.init_distributed() is False  # nothing to rendezvous
+    assert dist.is_initialized()
+    assert dist.process_count() == 1
+    assert dist.global_device_count() == dist.local_device_count()
+    dist.shutdown_distributed()
+    assert not dist.is_initialized()
+
+
+def test_noop_init_does_not_block_real_init(monkeypatch):
+    """An early argument-less init (no cluster env) must not swallow a
+    later explicit-coordinator init."""
+    monkeypatch.setattr(dist, "_noop", False)
+    monkeypatch.setattr(dist, "_client", False)
+    monkeypatch.delenv("TRAINERS", raising=False)
+    monkeypatch.delenv("PADDLE_COORDINATOR", raising=False)
+    assert dist.init_distributed() is False  # no-op
+    calls = {}
+
+    def fake_initialize(**kw):
+        calls.update(kw)
+
+    monkeypatch.setattr(dist.jax.distributed, "initialize", fake_initialize)
+    assert dist.init_distributed(coordinator_address="h:1",
+                                 num_processes=4, process_id=2) is True
+    assert calls["coordinator_address"] == "h:1"
+    assert dist._client
+    monkeypatch.setattr(dist.jax.distributed, "shutdown", lambda: None)
+    dist.shutdown_distributed()
+    assert not dist.is_initialized()
+
+
+def test_multi_process_env_requires_coordinator(monkeypatch):
+    monkeypatch.setattr(dist, "_noop", False)
+    monkeypatch.setattr(dist, "_client", False)
+    monkeypatch.setenv("TRAINERS", "4")
+    monkeypatch.delenv("PADDLE_COORDINATOR", raising=False)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    with pytest.raises(ValueError, match="PADDLE_COORDINATOR"):
+        dist.init_distributed()
+    assert not dist.is_initialized()
+
+
+def test_global_mesh_spans_all_devices(monkeypatch):
+    monkeypatch.setattr(dist, "_noop", True)
+    mesh = dist.global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.axis_names == ("dp",)
+    mesh2 = dist.global_mesh({"dp": -1, "mp": 2})
+    assert mesh2.shape["mp"] == 2
+    assert mesh2.shape["dp"] * 2 == len(jax.devices())
+    # inner (mp) axis varies fastest: adjacent devices share a dp row,
+    # keeping tensor-parallel collectives on the innermost (ICI) ring
+    flat = mesh2.devices.reshape(-1)
+    np.testing.assert_array_equal(flat, np.asarray(jax.devices()))
