@@ -579,16 +579,24 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
 
 
 
-def fused_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, name=None):
+def fused_attention(q, k, v, causal=False, scale=None, kv_len=None,
+                    block_q=128, block_k=128, name=None):
     """Flash attention over [B, T, H, D] q/k/v (TPU-native addition — the
     reference era built attention from matmul+softmax ops; this is the
-    fused pallas path, see ops/pallas_kernels.py). For multi-chip sequence
-    parallelism use parallel.ring_attention instead."""
+    fused pallas path, see ops/pallas_kernels.py). kv_len: optional [B]
+    int32 Variable of true key lengths (padded-batch masking + block
+    skipping); defaults to k's sequence-lengths companion when k is a
+    lod_level>0 sequence. For multi-chip sequence parallelism use
+    parallel.ring_attention instead."""
     helper = LayerHelper("fused_attention", **locals())
     out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if kv_len is None and getattr(k, "seq_len_var", None):
+        kv_len = k.block.var_recursive(k.seq_len_var)
+    if kv_len is not None:
+        inputs["KVLen"] = [kv_len]
     helper.append_op(
-        type="fused_attention", inputs={"Q": [q], "K": [k], "V": [v]},
+        type="fused_attention", inputs=inputs,
         outputs={"Out": [out]},
         attrs={"causal": bool(causal),
                "scale": None if scale is None else float(scale),

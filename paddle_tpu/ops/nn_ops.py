@@ -178,6 +178,12 @@ def _layer_norm(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
     lead = int(np.prod(x.shape[:begin]))
+    if scale is not None and bias is not None and _pallas_enabled():
+        from . import pallas_kernels as pk
+        y, mean, var = pk.layer_norm(x.reshape(lead, -1), scale.reshape(-1),
+                                     bias.reshape(-1), eps=eps)
+        return {"Y": [y.reshape(x.shape).astype(x.dtype)],
+                "Mean": [mean], "Variance": [var]}
     x2 = x.reshape(lead, -1).astype(jnp.float32)
     mean = jnp.mean(x2, axis=1, keepdims=True)
     var = jnp.var(x2, axis=1, keepdims=True)
@@ -309,10 +315,12 @@ def _fused_attention(ctx, ins, attrs):
     q = single(ins, "Q")
     k = single(ins, "K")
     v = single(ins, "V")
+    kv_len = single(ins, "KVLen") if ins.get("KVLen") else None
     out = pk.flash_attention(
         q, k, v,
         causal=attrs.get("causal", False),
         scale=attrs.get("scale", None),
+        kv_len=kv_len,
         block_q=attrs.get("block_q", 128),
         block_k=attrs.get("block_k", 128))
     return _out(out)

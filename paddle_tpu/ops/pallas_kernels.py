@@ -35,7 +35,8 @@ except Exception:  # pragma: no cover - pallas tpu backend unavailable
     pltpu = None
     _VMEM = None
 
-__all__ = ["flash_attention", "softmax_xent", "attention_available"]
+__all__ = ["flash_attention", "softmax_xent", "layer_norm",
+           "attention_available"]
 
 _NEG = -1e30
 
@@ -58,12 +59,13 @@ def _vmem_spec(*args, **kwargs):
 # flash attention
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                      block_q, block_k, t_real, t_pad):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref, *,
+                      scale, causal, block_q, block_k, t_pad):
     qb = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale                 # [bq, d]
     bq, d = q.shape
     qpos = qb * block_q + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    kv_len = len_ref[0, 0]                                   # this row's T
 
     nk = t_pad // block_k
     if causal:
@@ -73,6 +75,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                              // block_k)
     else:
         nk_dyn = nk
+    # key-padding early exit: blocks entirely past this row's length
+    nk_dyn = jnp.minimum(nk_dyn, (kv_len + block_k - 1) // block_k)
 
     def body(kb, carry):
         m, l, acc = carry
@@ -81,7 +85,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
         kpos = kb * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k),
                                                    1)
-        valid = kpos < t_real
+        valid = kpos < kv_len
         if causal:
             valid = valid & (qpos >= kpos)
         s = jnp.where(valid, s, _NEG)
@@ -105,8 +109,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    """q,k,v: [BH, T, D] -> (out [BH, T, D], lse [BH, T])."""
+def _flash_fwd(q, k, v, kv_len, scale, causal, block_q, block_k, interpret):
+    """q,k,v: [BH, T, D]; kv_len: [BH] int32 (true key length per row)
+    -> (out [BH, T, D], lse [BH, T])."""
     bh, t, d = q.shape
     # pad T so BOTH the q grid and the k loop divide exactly (mismatched
     # block sizes otherwise drop tail k blocks / leave q rows unwritten)
@@ -115,9 +120,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     if t_pad != t:
         pad = [(0, 0), (0, t_pad - t), (0, 0)]
         q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
+    lens = kv_len.reshape(bh, 1).astype(jnp.int32)
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, t_real=t, t_pad=t_pad)
+        block_k=block_k, t_pad=t_pad)
+    smem = {} if pltpu is None else {"memory_space": pltpu.SMEM}
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, t_pad // block_q),
@@ -125,6 +132,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
             _vmem_spec((1, t_pad, d), lambda b, i: (b, 0, 0)),
             _vmem_spec((1, t_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1), lambda b, i: (b, 0), **smem),
         ],
         out_specs=[
             _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -135,7 +143,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, t_pad), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, lens)
     return out[:, :t], lse[:, :t]
 
 
@@ -143,7 +151,7 @@ def _flash_bwd(scale, causal, block_k, res, g):
     """Flash backward: block loop over K with the saved lse (no [T,T] in
     memory). Plain lax — XLA fuses it fine; the fwd kernel is where VMEM
     residency matters."""
-    q, k, v, out, lse = res
+    q, k, v, kv_len, out, lse = res
     bh, t, d = q.shape
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
@@ -162,11 +170,13 @@ def _flash_bwd(scale, causal, block_k, res, g):
 
     qpos = jnp.arange(t)[None, :, None]                      # [1, T, 1]
 
+    lens = kv_len.reshape(bh, 1, 1)
+
     def body(dq, blk):
         kb_idx, kb, vb = blk
         kpos = kb_idx * block_k + jnp.arange(block_k)[None, None, :]
         s = jnp.einsum("btd,bsd->bts", qf, kb) * scale       # [BH, T, bk]
-        valid = kpos < t
+        valid = kpos < lens
         if causal:
             valid = valid & (qpos >= kpos)
         p = jnp.where(valid, jnp.exp(s - lse[..., None]), 0.0)
@@ -185,33 +195,40 @@ def _flash_bwd(scale, causal, block_k, res, g):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_core(q, k, v, kv_len, scale, causal, block_q, block_k,
+                interpret):
+    out, _ = _flash_fwd(q, k, v, kv_len, scale, causal, block_q, block_k,
+                        interpret)
     return out
 
 
-def _flash_core_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+def _flash_core_fwd(q, k, v, kv_len, scale, causal, block_q, block_k,
+                    interpret):
+    out, lse = _flash_fwd(q, k, v, kv_len, scale, causal, block_q, block_k,
                           interpret)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, kv_len, out, lse)
 
 
 def _flash_core_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    return _flash_bwd(scale, causal, block_k, res, g)
+    dq, dk, dv = _flash_bwd(scale, causal, block_k, res, g)
+    return dq, dk, dv, None
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None):
+def flash_attention(q, k, v, causal=False, scale=None, kv_len=None,
+                    block_q=128, block_k=128, interpret=None):
     """Exact attention, flash-style. q,k,v: [B, T, H, D] (BTHD, the layout
     ring_attention uses); returns [B, T, H, D].
 
-    Differentiable; matches attention_reference to fp32 tolerance. On TPU
-    the forward runs as a pallas kernel (online softmax in VMEM); off-TPU
-    it runs the same kernel in interpret mode.
+    kv_len: optional [B] int true key lengths — keys at position >= kv_len
+    are masked out AND their blocks skipped entirely (the padded-batch
+    regime every fluid sequence model runs in). Differentiable; matches
+    attention_reference to fp32 tolerance. On TPU the forward runs as a
+    pallas kernel (online softmax in VMEM); off-TPU it runs the same
+    kernel in interpret mode.
     """
     if interpret is None:
         interpret = _interpret_default()
@@ -220,11 +237,15 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
         scale = 1.0 / float(np.sqrt(d))
     block_q = max(8, min(block_q, int(-(-t // 8) * 8)))
     block_k = max(8, min(block_k, int(-(-t // 8) * 8)))
+    if kv_len is None:
+        lens = jnp.full((b * h,), t, jnp.int32)
+    else:
+        lens = jnp.repeat(jnp.asarray(kv_len, jnp.int32).reshape(b), h)
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
-    out = _flash_core(to_bh(q), to_bh(k), to_bh(v), float(scale),
+    out = _flash_core(to_bh(q), to_bh(k), to_bh(v), lens, float(scale),
                       bool(causal), int(block_q), int(block_k),
                       bool(interpret))
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
@@ -302,3 +323,90 @@ def softmax_xent(logits, labels, block_n=8, interpret=None):
         interpret = _interpret_default()
     return _xent_core(logits, labels.reshape(-1), int(block_n),
                       bool(interpret))
+
+
+# ---------------------------------------------------------------------------
+# fused layer norm
+# ---------------------------------------------------------------------------
+
+def _ln_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, rstd_ref, *,
+               eps):
+    x = x_ref[:].astype(jnp.float32)                         # [bn, D]
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    rstd = lax.rsqrt(var + eps)
+    y = (x - mu) * rstd * scale_ref[:].astype(jnp.float32) \
+        + bias_ref[:].astype(jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mu
+    rstd_ref[:] = rstd
+
+
+def _ln_fwd_call(x, scale, bias, eps, block_n, interpret):
+    n, d = x.shape
+    n_pad = int(-(-n // block_n) * block_n)
+    xp = jnp.pad(x, [(0, n_pad - n), (0, 0)]) if n_pad != n else x
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=(n_pad // block_n,),
+        in_specs=[
+            _vmem_spec((block_n, d), lambda i: (i, 0)),
+            _vmem_spec((1, d), lambda i: (0, 0)),
+            _vmem_spec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((block_n, d), lambda i: (i, 0)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, d), x.dtype),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp, scale.reshape(1, d), bias.reshape(1, d))
+    return y[:n], mean[:n], rstd[:n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ln_core(x, scale, bias, eps, block_n, interpret):
+    y, _, _ = _ln_fwd_call(x, scale, bias, eps, block_n, interpret)
+    return y
+
+
+def _ln_core_fwd(x, scale, bias, eps, block_n, interpret):
+    y, mean, rstd = _ln_fwd_call(x, scale, bias, eps, block_n, interpret)
+    # residuals must be jax values: a 0-size sentinel carries bias's dtype
+    return y, (x, scale, jnp.zeros((0,), bias.dtype), mean, rstd)
+
+
+def _ln_core_bwd(eps, block_n, interpret, res, g):
+    x, scale, bias_like, mean, rstd = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    xhat = (xf - mean) * rstd                                # [N, D]
+    gs = gf * scale.reshape(1, -1).astype(jnp.float32)
+    dx = rstd * (gs - jnp.mean(gs, axis=-1, keepdims=True)
+                 - xhat * jnp.mean(gs * xhat, axis=-1, keepdims=True))
+    dscale = jnp.sum(gf * xhat, axis=0)
+    dbias = jnp.sum(gf, axis=0)
+    return (dx.astype(x.dtype), dscale.astype(scale.dtype),
+            dbias.astype(bias_like.dtype))
+
+
+_ln_core.defvjp(_ln_core_fwd, _ln_core_bwd)
+
+
+def layer_norm(x, scale, bias, eps=1e-5, block_n=8, interpret=None):
+    """Fused layer norm over the trailing dim of 2D x [N, D]; one VMEM pass
+    computes y + the (mean, rstd) backward residuals. Differentiable
+    (custom_vjp; dense backward — the fwd is the HBM-bound pass worth
+    fusing). Returns (y, mean [N], variance [N]) matching the layer_norm
+    op's output contract; the fetchable mean/variance are plain reductions
+    XLA DCEs when (as usual) nothing consumes them."""
+    if interpret is None:
+        interpret = _interpret_default()
+    y = _ln_core(x, scale, bias, float(eps), int(block_n), bool(interpret))
+    xf = x.astype(jnp.float32)
+    return y, jnp.mean(xf, axis=-1), jnp.var(xf, axis=-1)
