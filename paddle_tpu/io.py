@@ -162,3 +162,26 @@ def load_checkpoint(executor, checkpoint_dir, main_program=None):
                       os.path.join(checkpoint_dir, "step_%d" % step),
                       main_program)
     return step
+
+
+def get_parameter_value(para, executor):
+    """Current value of a Parameter as numpy (reference io.py:430; here
+    values live in the global scope — no fetch program needed)."""
+    import numpy as np
+    from .core.executor import global_scope
+    val = global_scope().get(para.name)
+    if val is None:
+        raise ValueError("parameter %r not initialized in the current "
+                         "scope; run the startup program first" % para.name)
+    return np.asarray(val)
+
+
+def get_parameter_value_by_name(name, executor, program=None):
+    """Reference io.py:447: look the Parameter up by name first (raises if
+    `name` names a non-parameter variable)."""
+    from .core.framework import default_main_program, Parameter
+    program = program or default_main_program()
+    var = program.global_block().var(name)
+    if not isinstance(var, Parameter):
+        raise TypeError("variable %r is not a Parameter" % name)
+    return get_parameter_value(var, executor)

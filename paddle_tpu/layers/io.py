@@ -9,10 +9,10 @@ io pre-pass — see core/readers.py for the TPU-native design).
 from ..core import unique_name
 from ..core.framework import default_main_program, default_startup_program
 
-__all__ = ["data", "open_recordio_file", "open_files", "read_file",
-           "create_shuffle_reader", "create_double_buffer_reader",
-           "create_multi_pass_reader", "shuffle", "double_buffer",
-           "multi_pass"]
+__all__ = ["data", "Send", "Recv", "open_recordio_file", "open_files",
+           "read_file", "create_shuffle_reader",
+           "create_double_buffer_reader", "create_multi_pass_reader",
+           "shuffle", "double_buffer", "multi_pass"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
@@ -39,6 +39,45 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
     if lod_level > 0:
         main.seq_len_var = name + "@SEQLEN"
     return main
+
+
+def Send(endpoints, send_vars, get_vars=None):
+    """Parity: fluid.layers.Send (reference layers/io.py:179) — ship vars
+    to parameter servers. Appended as the same 'send' marker op the
+    DistributeTranspiler emits; under whole-program GSPMD the actual
+    exchange is XLA's reduce-scatter/all-gather over ICI, so the marker
+    records placement (endpoints) and lowers to a no-op."""
+    assert isinstance(send_vars, list)
+    epmap = endpoints.split(",") if isinstance(endpoints, str) \
+        else list(endpoints)
+    block = default_main_program().current_block()
+    block.append_op(
+        type="send",
+        inputs={"X": [v.name if hasattr(v, "name") else v
+                      for v in send_vars]},
+        outputs={},
+        attrs={"endpoints": epmap, "epmap": {}, "sync_mode": True},
+        infer_shape=False)
+    return get_vars
+
+
+def Recv(endpoints, get_vars):
+    """Parity: fluid.layers.Recv (reference layers/io.py:207) — fetch vars
+    from parameter servers. With sharded parameters living device-side,
+    the 'recv' is the identity placement marker (GSPMD all-gathers on
+    read), kept so transpiled programs round-trip."""
+    assert isinstance(get_vars, list)
+    epmap = endpoints.split(",") if isinstance(endpoints, str) \
+        else list(endpoints)
+    block = default_main_program().current_block()
+    names = [v.name if hasattr(v, "name") else v for v in get_vars]
+    block.append_op(
+        type="recv",
+        inputs={},
+        outputs={"Out": names},
+        attrs={"endpoints": epmap, "epmap": {}},
+        infer_shape=False)
+    return get_vars
 
 
 # ---------------------------------------------------------------------------

@@ -169,6 +169,28 @@ def _lstm_act(name):
             "relu": jax.nn.relu, "identity": lambda v: v}[name]
 
 
+def _amp_recurrence(ctx, x_dtype):
+    """AMP discipline for scan recurrences: the per-step gate matmul rides
+    the MXU in bf16 (2x fp32 throughput), but the carried state accumulates
+    in f32 — carrying cell state in bf16 loses the long-horizon additions
+    that make LSTMs work. Applies when the program is AMP or the input
+    already arrived bf16 (from an AMP'd input-projection mul).
+
+    Returns (state_dtype, rmat(h, w)) — shared by _lstm and _gru."""
+    bf = getattr(ctx, "amp", False) or x_dtype == jnp.bfloat16
+    state_dt = jnp.float32 if x_dtype in (jnp.float32, jnp.bfloat16) \
+        else x_dtype
+
+    def rmat(h, wm):
+        if bf:
+            return jnp.matmul(h.astype(jnp.bfloat16),
+                              wm.astype(jnp.bfloat16),
+                              preferred_element_type=jnp.float32)
+        return h @ wm.astype(state_dt)
+
+    return state_dt, rmat
+
+
 @register("lstm")
 def _lstm(ctx, ins, attrs):
     """dynamic_lstm: input [B, T, 4D] (pre-projected by an fc), weight
@@ -190,16 +212,21 @@ def _lstm(ctx, ins, attrs):
     hact = _lstm_act(attrs.get("candidate_activation", "tanh"))
     is_rev = attrs.get("is_reverse", False)
 
-    bias = bias.reshape(-1)
+    state_dt, rmat2 = _amp_recurrence(ctx, x.dtype)
+    rmat = lambda h: rmat2(h, w)
+
+    bias = bias.reshape(-1).astype(state_dt)
     gate_bias = bias[:4 * d]
     if use_peep:
         w_ic, w_fc, w_oc = (bias[4 * d:5 * d], bias[5 * d:6 * d],
                             bias[6 * d:7 * d])
-    h_prev = h0 if h0 is not None else jnp.zeros((b, d), x.dtype)
-    c_prev = c0 if c0 is not None else jnp.zeros((b, d), x.dtype)
+    h_prev = h0.astype(state_dt) if h0 is not None \
+        else jnp.zeros((b, d), state_dt)
+    c_prev = c0.astype(state_dt) if c0 is not None \
+        else jnp.zeros((b, d), state_dt)
 
-    m = _mask(xlen, t, x.dtype)                     # [B, T]
-    xs = jnp.swapaxes(x, 0, 1)                      # [T, B, 4D]
+    m = _mask(xlen, t, state_dt)                    # [B, T]
+    xs = jnp.swapaxes(x, 0, 1).astype(state_dt)     # [T, B, 4D]
     ms = m.T[:, :, None]                            # [T, B, 1]
     if is_rev:
         xs = xs[::-1]
@@ -208,7 +235,7 @@ def _lstm(ctx, ins, attrs):
     def step(carry, inp):
         h_prev, c_prev = carry
         xt, mt = inp
-        gates = xt + h_prev @ w + gate_bias         # [B, 4D]
+        gates = xt + rmat(h_prev) + gate_bias       # [B, 4D]
         gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
         if use_peep:
             gi = gi + c_prev * w_ic
@@ -228,8 +255,8 @@ def _lstm(ctx, ins, attrs):
     (hT, cT), (hs, cs) = lax.scan(step, (h_prev, c_prev), (xs, ms))
     if is_rev:
         hs, cs = hs[::-1], cs[::-1]
-    hidden = jnp.swapaxes(hs, 0, 1)                 # [B, T, D]
-    cell = jnp.swapaxes(cs, 0, 1)
+    hidden = jnp.swapaxes(hs, 0, 1).astype(x.dtype)  # [B, T, D]
+    cell = jnp.swapaxes(cs, 0, 1).astype(x.dtype)
     return {"Hidden": [hidden], "Cell": [cell],
             "BatchGate": [x], "BatchCellPreAct": [cell]}
 
@@ -250,13 +277,17 @@ def _gru(ctx, ins, attrs):
     cact = _lstm_act(attrs.get("activation", "tanh"))
     is_rev = attrs.get("is_reverse", False)
 
+    state_dt, rmat = _amp_recurrence(ctx, x.dtype)
+
     w_g = w[:, :2 * d]      # update+reset recurrent weights
     w_c = w[:, 2 * d:]      # candidate recurrent weights
-    bias = bias.reshape(-1) if bias is not None else jnp.zeros(3 * d, x.dtype)
-    h_prev = h0 if h0 is not None else jnp.zeros((b, d), x.dtype)
+    bias = bias.reshape(-1).astype(state_dt) if bias is not None \
+        else jnp.zeros(3 * d, state_dt)
+    h_prev = h0.astype(state_dt) if h0 is not None \
+        else jnp.zeros((b, d), state_dt)
 
-    m = _mask(xlen, t, x.dtype)
-    xs = jnp.swapaxes(x, 0, 1)
+    m = _mask(xlen, t, state_dt)
+    xs = jnp.swapaxes(x, 0, 1).astype(state_dt)
     ms = m.T[:, :, None]
     if is_rev:
         xs = xs[::-1]
@@ -264,9 +295,9 @@ def _gru(ctx, ins, attrs):
 
     def step(h_prev, inp):
         xt, mt = inp
-        xu = xt[:, :2 * d] + h_prev @ w_g + bias[:2 * d]
+        xu = xt[:, :2 * d] + rmat(h_prev, w_g) + bias[:2 * d]
         u, r = jnp.split(gact(xu), 2, axis=-1)
-        c = cact(xt[:, 2 * d:] + (r * h_prev) @ w_c + bias[2 * d:])
+        c = cact(xt[:, 2 * d:] + rmat(r * h_prev, w_c) + bias[2 * d:])
         h_new = u * h_prev + (1 - u) * c
         h = mt * h_new + (1 - mt) * h_prev
         return h, h
@@ -274,7 +305,7 @@ def _gru(ctx, ins, attrs):
     hT, hs = lax.scan(step, h_prev, (xs, ms))
     if is_rev:
         hs = hs[::-1]
-    hidden = jnp.swapaxes(hs, 0, 1)
+    hidden = jnp.swapaxes(hs, 0, 1).astype(x.dtype)
     return {"Hidden": [hidden], "BatchGate": [x],
             "BatchResetHiddenPrev": [hidden], "BatchHidden": [hidden]}
 

@@ -41,6 +41,21 @@ def _send(ctx, ins, attrs):
     return {}
 
 
+def _recv_special(ctx, op, env):
+    """Placement marker (reference operators/recv_op.cc): the 'fetched'
+    parameters are already device-resident sharded state, GSPMD
+    all-gathers on read — so lowering just asserts they exist."""
+    for n in op.outputs.get("Out", ()):
+        if n not in env:
+            raise ValueError(
+                "recv of %r: variable has no value — parameters must be "
+                "initialized (startup program) before a recv marker" % n)
+
+
+from ..core.lowering import register_special as _register_special  # noqa: E402
+_register_special("recv")(_recv_special)
+
+
 @register("listen_and_serv")
 def _listen_and_serv(ctx, ins, attrs):
     """Marker op (operators/listen_and_serv_op.cc). No server loop on TPU:

@@ -25,11 +25,12 @@ from . import inference                     # noqa: F401
 from .inference import infer                # noqa: F401
 from . import topology                      # noqa: F401
 from . import minibatch                     # noqa: F401
+from . import image                         # noqa: F401
 
 __all__ = ["init", "dataset", "reader", "batch", "layer", "activation",
            "data_type", "attr", "pooling", "networks", "optimizer",
            "parameters", "trainer", "event", "inference", "infer",
-           "topology", "minibatch"]
+           "topology", "minibatch", "image"]
 
 
 def init(**kwargs):

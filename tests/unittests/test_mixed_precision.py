@@ -93,3 +93,51 @@ def test_bf16_inputs_give_bf16_outputs(op):
     out = list(outs.values())[0][0]
     assert out.dtype == jnp.bfloat16
     assert float(out.reshape(-1)[0]) > 0
+
+
+def _train_lstm(amp, steps=40, seed=3):
+    """Sentiment-style LSTM classifier under AMP: bf16 gate matmuls must
+    keep f32 state (ops/sequence_ops.py rmat discipline)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(input=words, size=[50, 16])
+        proj = fluid.layers.fc(input=emb, size=16 * 4)
+        h, c = fluid.layers.dynamic_lstm(input=proj, size=16 * 4)
+        last = fluid.layers.sequence_last_step(input=h)
+        pred = fluid.layers.fc(input=last, size=2, act="softmax")
+        cost = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(cost)
+    if amp:
+        main.enable_mixed_precision()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(seed)
+    seqs, labels = [], []
+    for _ in range(16):
+        lab = rng.randint(0, 2)
+        n = rng.randint(4, 9)
+        lo, hi = (2, 25) if lab == 0 else (25, 48)
+        seqs.append(rng.randint(lo, hi, (n, 1)).astype("int64"))
+        labels.append(lab)
+    feed = {"words": fluid.LoDTensor.from_sequences(seqs),
+            "label": np.asarray(labels, "int64").reshape(-1, 1)}
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            loss, = exe.run(main, feed=feed, fetch_list=[cost])
+            losses.append(float(np.asarray(loss).reshape(-1)[0]))
+    return losses
+
+
+def test_amp_lstm_converges_and_tracks_fp32():
+    l32 = _train_lstm(amp=False)
+    lbf = _train_lstm(amp=True)
+    assert np.all(np.isfinite(lbf))
+    assert lbf[-1] < lbf[0] * 0.5, (lbf[0], lbf[-1])
+    # f32-state discipline keeps the AMP trajectory close to full fp32
+    np.testing.assert_allclose(lbf, l32, rtol=0.2, atol=0.08)

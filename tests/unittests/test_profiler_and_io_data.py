@@ -86,6 +86,29 @@ def test_data_batch_dim_reference_semantics():
     assert tuple(no_batch.shape) == (3, 4)
 
 
+def test_send_recv_layer_markers():
+    """layers.Send/Recv (reference layers/io.py:179,207): placement markers
+    that round-trip through the executor as no-ops over device-resident
+    sharded state."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(input=x, size=2,
+                              param_attr=fluid.ParamAttr(name="sr_w"))
+        g = main.global_block()
+        fluid.layers.Send("ps0:6174,ps1:6174", [g.var("sr_w")])
+        fluid.layers.Recv("ps0:6174,ps1:6174", [g.var("sr_w")])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": np.ones((3, 4), "float32")},
+                       fetch_list=[out])
+    assert np.asarray(got).shape == (3, 2)
+    types = [op.type for op in main.global_block().ops]
+    assert "send" in types and "recv" in types
+
+
 def test_v2_fc_name_passthrough():
     import paddle_tpu.v2 as paddle
     main, startup = fluid.Program(), fluid.Program()

@@ -1,0 +1,124 @@
+"""paddle.v2.image transforms (numpy-native rebuild of v2/image.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.v2 import image
+
+
+def _img(h, w, c=3):
+    rng = np.random.RandomState(0)
+    return rng.randint(0, 256, (h, w, c)).astype("uint8")
+
+
+def test_resize_short_keeps_aspect():
+    im = _img(100, 200)
+    out = image.resize_short(im, 50)
+    assert out.shape == (50, 100, 3)
+    out = image.resize_short(_img(200, 100), 50)
+    assert out.shape == (100, 50, 3)
+
+
+def test_resize_identity_and_downscale_means():
+    im = _img(64, 64)
+    same = image.resize_short(im, 64)
+    np.testing.assert_array_equal(same, im)
+    # 2x downscale of a constant image stays constant
+    const = np.full((64, 64, 3), 77, "uint8")
+    out = image.resize_short(const, 32)
+    np.testing.assert_array_equal(out, np.full((32, 32, 3), 77, "uint8"))
+    # gradient image: downscale preserves the gradient direction/range
+    g = np.tile(np.arange(64, dtype="uint8")[None, :, None], (64, 1, 3))
+    out = image.resize_short(g, 32)
+    assert out[0, 0, 0] < out[0, -1, 0]
+    assert abs(int(out.mean()) - int(g.mean())) <= 1
+
+
+def test_crops_and_flip():
+    im = _img(60, 80)
+    c = image.center_crop(im, 40)
+    assert c.shape == (40, 40, 3)
+    np.testing.assert_array_equal(c, im[10:50, 20:60])
+    r = image.random_crop(im, 40, rng=np.random.RandomState(3))
+    assert r.shape == (40, 40, 3)
+    f = image.left_right_flip(im)
+    np.testing.assert_array_equal(f, im[:, ::-1, :])
+    gray = _img(60, 80)[:, :, 0]
+    np.testing.assert_array_equal(image.left_right_flip(gray, False),
+                                  gray[:, ::-1])
+
+
+def test_to_chw():
+    im = _img(8, 10)
+    chw = image.to_chw(im)
+    assert chw.shape == (3, 8, 10)
+    np.testing.assert_array_equal(chw[1], im[:, :, 1])
+
+
+def test_simple_transform_train_and_test():
+    im = _img(100, 120)
+    rng = np.random.RandomState(5)
+    out = image.simple_transform(im, 64, 56, is_train=True, rng=rng,
+                                 mean=[127.5, 127.5, 127.5])
+    assert out.shape == (3, 56, 56) and out.dtype == np.float32
+    assert out.min() >= -128 and out.max() <= 128
+    out2 = image.simple_transform(im, 64, 56, is_train=False)
+    # deterministic: center crop path
+    out3 = image.simple_transform(im, 64, 56, is_train=False)
+    np.testing.assert_array_equal(out2, out3)
+
+
+def test_random_ops_accept_generator_rng():
+    im = _img(60, 80)
+    g = np.random.default_rng(0)
+    r = image.random_crop(im, 40, rng=g)
+    assert r.shape == (40, 40, 3)
+    out = image.simple_transform(im, 64, 56, is_train=True, rng=g)
+    assert out.shape == (3, 56, 56)
+
+
+def test_batch_images_from_tar_roundtrip(tmp_path):
+    import tarfile
+    tar_path = str(tmp_path / "imgs.tar")
+    payloads = {"a.jpg": b"\xff\xd8fakejpegA", "b.jpg": b"\xff\xd8fakeB",
+                "c.jpg": b"\xff\xd8fake_longer_C"}
+    with tarfile.open(tar_path, "w") as tar:
+        for name, data in payloads.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            import io as _io
+            tar.addfile(info, _io.BytesIO(data))
+    img2label = {"a.jpg": 0, "b.jpg": 1, "c.jpg": 2}
+    meta = image.batch_images_from_tar(tar_path, "test", img2label,
+                                       num_per_batch=2)
+    batch_files = open(meta).read().splitlines()
+    assert len(batch_files) == 2
+    all_imgs, all_labels = [], []
+    for bf in batch_files:
+        imgs, labels = image.load_image_batch(bf)
+        all_imgs.extend(imgs)
+        all_labels.extend(labels.tolist())
+    assert sorted(all_imgs) == sorted(payloads.values())
+    assert sorted(all_labels) == [0, 1, 2]
+
+
+def test_v2_namespace_exposes_image_and_dataset():
+    assert paddle.image is image
+    assert hasattr(paddle.dataset, "mnist")
+    assert callable(paddle.reader.shuffle)
+
+
+def test_io_get_parameter_value():
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(input=x, size=2,
+                        param_attr=fluid.ParamAttr(name="w_io_test"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        val = fluid.io.get_parameter_value_by_name("w_io_test", exe, main)
+        assert val.shape == (4, 2)
+        with pytest.raises(TypeError, match="not a Parameter"):
+            fluid.io.get_parameter_value_by_name("x", exe, main)
