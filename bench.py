@@ -25,12 +25,16 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     dtype = os.environ.get("BENCH_DTYPE", "bf16")  # bf16 | fp32
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.unique_name.guard(), fluid.program_guard(main_prog, startup):
         image, label, avg_cost, acc = build_train(
             model="resnet50", class_dim=1000, image_shape=(3, 224, 224),
             learning_rate=0.1, momentum=0.9, use_bf16=(dtype == "bf16"))
+    if remat:  # trade FLOPs for activation memory (enables larger batch)
+        fluid.memory_optimization_transpiler.enable_rematerialization(
+            main_prog)
 
     place = fluid.TPUPlace()
     exe = fluid.Executor(place)

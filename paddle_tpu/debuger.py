@@ -21,7 +21,23 @@ def _var_repr(block, name):
                           "x".join(str(d) for d in var.shape))
 
 
-def pprint_block_codes(block, show_backward=False):
+def _dependency_order(ops):
+    """Ops re-ordered by dataflow dependencies (native
+    paddle_tpu/native/graph.cc topo sort; program order — already a valid
+    schedule by construction — when the lib is unavailable)."""
+    from .native import graph as _ng
+    uses = [{n for ns in op.inputs.values() for n in ns if n}
+            for op in ops]
+    defs = [{n for ns in op.outputs.values() for n in ns if n}
+            for op in ops]
+    order = _ng.topo_sort(uses, defs)
+    return [ops[i] for i in order] if order is not None else list(ops)
+
+
+def pprint_block_codes(block, show_backward=False, topological=False):
+    """C-like block listing; topological=True prints ops in dataflow
+    dependency order instead of program order (useful to see what a
+    schedule-free view of the graph looks like)."""
     lines = ["block_%d {" % block.idx]
     for var in sorted(block.vars.values(), key=lambda v: v.name):
         if not show_backward and "@GRAD" in var.name:
@@ -29,7 +45,8 @@ def pprint_block_codes(block, show_backward=False):
         kind = "param" if getattr(var, "trainable", None) is not None \
             else "var"
         lines.append("  %s %s" % (kind, _var_repr(block, var.name)))
-    for op in block.ops:
+    ops = _dependency_order(block.ops) if topological else block.ops
+    for op in ops:
         if not show_backward and op.type == "grad_of":
             continue
         outs = ", ".join(n for ns in op.outputs.values() for n in ns if n)

@@ -24,6 +24,7 @@ __all__ = [
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "get_inference_program",
     "save_checkpoint", "load_checkpoint",
+    "get_parameter_value", "get_parameter_value_by_name",
 ]
 
 
@@ -167,8 +168,6 @@ def load_checkpoint(executor, checkpoint_dir, main_program=None):
 def get_parameter_value(para, executor):
     """Current value of a Parameter as numpy (reference io.py:430; here
     values live in the global scope — no fetch program needed)."""
-    import numpy as np
-    from .core.executor import global_scope
     val = global_scope().get(para.name)
     if val is None:
         raise ValueError("parameter %r not initialized in the current "
@@ -179,7 +178,6 @@ def get_parameter_value(para, executor):
 def get_parameter_value_by_name(name, executor, program=None):
     """Reference io.py:447: look the Parameter up by name first (raises if
     `name` names a non-parameter variable)."""
-    from .core.framework import default_main_program, Parameter
     program = program or default_main_program()
     var = program.global_block().var(name)
     if not isinstance(var, Parameter):

@@ -43,6 +43,12 @@ class ControlFlowGraph(object):
             self.defs.append(d)
 
     def liveness(self):
+        # native pass first (paddle_tpu/native/graph.cc — bitset dataflow);
+        # byte-identical Python fallback below
+        from .native import graph as _ng
+        native = _ng.liveness(self.uses, self.defs)
+        if native is not None:
+            return native
         n = len(self.ops)
         live_in = [set() for _ in range(n)]
         live_out = [set() for _ in range(n)]

@@ -1,0 +1,115 @@
+"""ctypes binding for libgraph.so (topo sort + liveness over the op IR).
+
+Callers: memory_optimization_transpiler.ControlFlowGraph.liveness (Python
+dataflow fallback) and debuger.pprint_block_codes(topological=True)
+(program-order fallback). The binding converts a block's op list into CSR
+int arrays, runs the native pass, and unpacks the u64 bitmaps back into
+name sets.
+"""
+import ctypes
+
+import numpy as np
+
+from . import load_library
+
+__all__ = ["available", "liveness", "topo_sort"]
+
+
+def _lib():
+    lib = load_library("graph")
+    if lib is None:
+        return None
+    if not getattr(lib, "_graph_ready", False):
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.paddle_tpu_liveness.argtypes = [
+            ctypes.c_int, ctypes.c_int, i32p, i32p, i32p, i32p, u64p, u64p]
+        lib.paddle_tpu_liveness.restype = ctypes.c_int
+        lib.paddle_tpu_topo_sort.argtypes = [
+            ctypes.c_int, ctypes.c_int, i32p, i32p, i32p, i32p, i32p]
+        lib.paddle_tpu_topo_sort.restype = ctypes.c_int
+        lib._graph_ready = True
+    return lib
+
+
+def available():
+    return _lib() is not None
+
+
+def _csr(sets, var_ids):
+    off = np.zeros(len(sets) + 1, np.int32)
+    ids = []
+    for i, s in enumerate(sets):
+        ids.extend(var_ids[n] for n in sorted(s))
+        off[i + 1] = len(ids)
+    return off, np.asarray(ids, np.int32)
+
+
+def _as_i32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _index_vars(uses, defs):
+    var_ids = {}
+    for s in list(uses) + list(defs):
+        for n in s:
+            var_ids.setdefault(n, len(var_ids))
+    return var_ids
+
+
+def liveness(uses, defs):
+    """uses/defs: per-op name sets. Returns (live_in, live_out) as lists of
+    name sets — same contract as ControlFlowGraph.liveness — or None when
+    the native library is unavailable."""
+    lib = _lib()
+    if lib is None:
+        return None
+    n_ops = len(uses)
+    var_ids = _index_vars(uses, defs)
+    n_vars = len(var_ids)
+    words = max(1, (n_vars + 63) // 64)
+    use_off, use_ids = _csr(uses, var_ids)
+    def_off, def_ids = _csr(defs, var_ids)
+    live_in = np.zeros(max(1, n_ops) * words, np.uint64)
+    live_out = np.zeros(max(1, n_ops) * words, np.uint64)
+    rc = lib.paddle_tpu_liveness(
+        n_ops, n_vars, _as_i32p(use_off), _as_i32p(use_ids),
+        _as_i32p(def_off), _as_i32p(def_ids),
+        live_in.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        live_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    if rc < 0:
+        return None
+    names = [None] * n_vars
+    for n, i in var_ids.items():
+        names[i] = n
+    bits_in = np.unpackbits(
+        live_in.reshape(n_ops, words).view(np.uint8), axis=1,
+        bitorder="little") if n_ops else np.zeros((0, 0), np.uint8)
+    bits_out = np.unpackbits(
+        live_out.reshape(n_ops, words).view(np.uint8), axis=1,
+        bitorder="little") if n_ops else np.zeros((0, 0), np.uint8)
+
+    def decode(bits):
+        return [{names[v] for v in np.nonzero(row[:n_vars])[0]}
+                for row in bits]
+
+    return decode(bits_in), decode(bits_out)
+
+
+def topo_sort(uses, defs):
+    """Kahn order of the op DAG (producer->consumer edges); returns a list
+    of op indices, or None if unavailable or the graph has a cycle."""
+    lib = _lib()
+    if lib is None:
+        return None
+    n_ops = len(uses)
+    var_ids = _index_vars(uses, defs)
+    use_off, use_ids = _csr(uses, var_ids)
+    def_off, def_ids = _csr(defs, var_ids)
+    order = np.zeros(max(1, n_ops), np.int32)
+    emitted = lib.paddle_tpu_topo_sort(
+        n_ops, len(var_ids), _as_i32p(use_off), _as_i32p(use_ids),
+        _as_i32p(def_off), _as_i32p(def_ids), _as_i32p(order))
+    if emitted != n_ops:
+        return None
+    return order[:n_ops].tolist()

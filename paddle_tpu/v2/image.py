@@ -26,32 +26,43 @@ __all__ = [
 ]
 
 
+_DECODER = None  # probed once: failed imports re-scan sys.path every call
+
+
 def _decoder():
-    try:
-        import cv2
-        return ("cv2", cv2)
-    except ImportError:
-        pass
-    try:
-        from PIL import Image
-        return ("pil", Image)
-    except ImportError:
-        return (None, None)
+    global _DECODER
+    if _DECODER is None:
+        try:
+            import cv2
+            _DECODER = ("cv2", cv2)
+        except ImportError:
+            try:
+                from PIL import Image
+                _DECODER = ("pil", Image)
+            except ImportError:
+                _DECODER = (None, None)
+    return _DECODER
 
 
 def load_image_bytes(bytes_, is_color=True):
-    """Decode an encoded image buffer to an HWC (or HW gray) uint8 array."""
+    """Decode an encoded image buffer to an HWC (or HW gray) uint8 array.
+
+    Channel order is BGR — the cv2 convention the reference pipelines (and
+    their per-channel mean constants) were built on — REGARDLESS of which
+    decoder is installed, so models don't silently change behavior when
+    the environment swaps cv2 for PIL."""
     kind, mod = _decoder()
     if kind == "cv2":
         flag = mod.IMREAD_COLOR if is_color else mod.IMREAD_GRAYSCALE
         img = mod.imdecode(np.frombuffer(bytes_, dtype="uint8"), flag)
         if img is None:
             raise ValueError("could not decode image buffer")
-        return img
+        return img  # cv2 decodes BGR natively
     if kind == "pil":
         img = mod.open(_io.BytesIO(bytes_))
-        img = img.convert("RGB" if is_color else "L")
-        return np.asarray(img)
+        if is_color:
+            return np.asarray(img.convert("RGB"))[:, :, ::-1]  # -> BGR
+        return np.asarray(img.convert("L"))
     raise RuntimeError(
         "decoding image files needs cv2 or PIL; neither is importable. "
         "The array transforms (resize_short/crops/simple_transform) work "

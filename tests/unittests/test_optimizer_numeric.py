@@ -1,0 +1,161 @@
+"""Optimizer update rules vs numpy references.
+
+Parity: the reference's per-optimizer op tests
+(tests/unittests/test_{momentum,adam,adamax,adagrad,decayed_adagrad,
+adadelta,rmsprop,ftrl}_op.py). A single-parameter program (grad == the fed
+x) runs two executor steps per optimizer; the parameter trajectory must
+match a from-scratch numpy simulation of the published update rule —
+including accumulator bootstrapping and (for Adam) the beta-power series.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+LR = 0.1
+D = 4
+
+
+def _run_steps(make_opt, grads):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+        w = fluid.layers.create_parameter(
+            shape=[D], dtype="float32", name="w_opt",
+            default_initializer=fluid.initializer.Constant(1.0))
+        cost = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(x=w, y=x))
+        make_opt().minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    traj = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for g in grads:
+            exe.run(main, feed={"x": g.reshape(1, D)}, fetch_list=[cost])
+            traj.append(np.asarray(scope.get("w_opt")).copy())
+    return traj
+
+
+GRADS = [np.asarray([0.5, -1.0, 2.0, 0.1], "float32"),
+         np.asarray([-0.2, 0.7, 1.1, -0.4], "float32")]
+
+
+def _sim(update, state=None):
+    w = np.ones(D, "float64")
+    st = state or {}
+    traj = []
+    for t, g in enumerate(GRADS):
+        w = update(w, g.astype("float64"), st, t)
+        traj.append(w.copy())
+    return traj
+
+
+def _check(make_opt, update, state=None, rtol=1e-4):
+    got = _run_steps(make_opt, GRADS)
+    expect = _sim(update, state)
+    for a, b in zip(got, expect):
+        np.testing.assert_allclose(a, b, rtol=rtol, atol=1e-6)
+
+
+def test_sgd():
+    _check(lambda: fluid.optimizer.SGD(learning_rate=LR),
+           lambda w, g, st, t: w - LR * g)
+
+
+def test_momentum():
+    def upd(w, g, st, t):
+        v = st.get("v", 0.0)
+        v = 0.9 * v + g
+        st["v"] = v
+        return w - LR * v
+    _check(lambda: fluid.optimizer.Momentum(learning_rate=LR, momentum=0.9),
+           upd)
+
+
+def test_momentum_nesterov():
+    def upd(w, g, st, t):
+        v = 0.9 * st.get("v", 0.0) + g
+        st["v"] = v
+        return w - LR * (g + 0.9 * v)
+    _check(lambda: fluid.optimizer.Momentum(learning_rate=LR, momentum=0.9,
+                                            use_nesterov=True), upd)
+
+
+def test_adagrad():
+    def upd(w, g, st, t):
+        m = st.get("m", 0.0) + g * g
+        st["m"] = m
+        return w - LR * g / (np.sqrt(m) + 1e-6)
+    _check(lambda: fluid.optimizer.Adagrad(learning_rate=LR), upd)
+
+
+def test_adam():
+    b1, b2, eps = 0.9, 0.999, 1e-8
+
+    def upd(w, g, st, t):
+        m = b1 * st.get("m", 0.0) + (1 - b1) * g
+        v = b2 * st.get("v", 0.0) + (1 - b2) * g * g
+        st["m"], st["v"] = m, v
+        lr_t = LR * np.sqrt(1 - b2 ** (t + 1)) / (1 - b1 ** (t + 1))
+        return w - lr_t * m / (np.sqrt(v) + eps)
+    _check(lambda: fluid.optimizer.Adam(learning_rate=LR), upd)
+
+
+def test_adamax():
+    b1, b2, eps = 0.9, 0.999, 1e-8
+
+    def upd(w, g, st, t):
+        m = b1 * st.get("m", 0.0) + (1 - b1) * g
+        n = np.maximum(b2 * st.get("n", np.zeros(D)), np.abs(g) + eps)
+        st["m"], st["n"] = m, n
+        return w - (LR / (1 - b1 ** (t + 1))) * m / n
+    _check(lambda: fluid.optimizer.Adamax(learning_rate=LR), upd)
+
+
+def test_decayed_adagrad():
+    def upd(w, g, st, t):
+        m = 0.95 * st.get("m", 0.0) + 0.05 * g * g
+        st["m"] = m
+        return w - LR * g / (np.sqrt(m) + 1e-6)
+    _check(lambda: fluid.optimizer.DecayedAdagrad(learning_rate=LR), upd)
+
+
+def test_adadelta():
+    rho, eps = 0.95, 1e-6
+
+    def upd(w, g, st, t):
+        g2 = rho * st.get("g2", 0.0) + (1 - rho) * g * g
+        upd_v = -np.sqrt((st.get("u2", 0.0) + eps) / (g2 + eps)) * g
+        u2 = rho * st.get("u2", 0.0) + (1 - rho) * upd_v * upd_v
+        st["g2"], st["u2"] = g2, u2
+        return w + upd_v
+    _check(lambda: fluid.optimizer.Adadelta(learning_rate=LR), upd)
+
+
+def test_rmsprop():
+    rho, eps, mom = 0.95, 1e-6, 0.9
+
+    def upd(w, g, st, t):
+        ms = rho * st.get("ms", 0.0) + (1 - rho) * g * g
+        m = mom * st.get("m", 0.0) + LR * g / np.sqrt(ms + eps)
+        st["ms"], st["m"] = ms, m
+        return w - m
+    _check(lambda: fluid.optimizer.RMSProp(learning_rate=LR, rho=0.95,
+                                           epsilon=1e-6, momentum=0.9), upd)
+
+
+def test_ftrl():
+    l1, l2 = 0.1, 0.2
+
+    def upd(w, g, st, t):
+        sq = st.get("sq", np.zeros(D))
+        lin = st.get("lin", np.zeros(D))
+        new_sq = sq + g * g
+        sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / LR
+        new_lin = lin + g - sigma * w
+        denom = np.sqrt(new_sq) / LR + 2 * l2
+        st["sq"], st["lin"] = new_sq, new_lin
+        return (np.clip(new_lin, -l1, l1) - new_lin) / denom
+    _check(lambda: fluid.optimizer.Ftrl(learning_rate=LR, l1=l1, l2=l2),
+           upd)
