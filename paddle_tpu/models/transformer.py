@@ -241,6 +241,158 @@ def build_train(src_vocab_size, trg_vocab_size, max_length, d_model=64,
     return sum_cost, avg_cost, predict
 
 
+def build_decode(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
+                 n_head=4, d_key=16, d_value=16, d_model=64,
+                 d_inner_hid=128, beam_size=2, max_out_len=None,
+                 bos_id=1, eos_id=2):
+    """Autoregressive beam-search decode (the era's transformer infer
+    path: re-run the whole decoder on the growing prefix each step — no
+    KV cache in the reference either; dense [batch, beam] layout rides
+    one lax.while_loop like models/machine_translation.decoder_decode).
+
+    Build under a fresh unique_name.guard with the SAME call sequence as
+    `transformer`, so every parameter shares its training name and the
+    decode program runs in the training scope. Returns
+    (sentence_ids [B, K, C], sentence_scores [B, K]).
+    """
+    L = fluid.layers
+    K = beam_size
+    T = max_length
+    limit_steps = min(max_out_len or T - 1, T - 1)
+
+    src_word = L.data("src_word", [T], dtype="int64")
+    src_pos = L.data("src_pos", [T], dtype="int64")
+    src_slf = L.data("src_slf_attn_bias", [n_head, T, T])
+    trg_pos_full = L.data("trg_pos_full", [T], dtype="int64")
+    trg_slf = L.data("trg_slf_attn_bias", [n_head, T, T])
+    trg_src = L.data("trg_src_attn_bias", [n_head, T, T])
+    init_ids = L.data("init_ids", [K], dtype="int64")
+    init_scores = L.data("init_scores", [K])
+
+    # encoder: identical call order to `transformer` => identical param
+    # names (word emb, encoder fcs)
+    enc_input = prepare_encoder(
+        src_word, src_pos, src_vocab_size, d_model, T, 0.0,
+        pos_enc_param_name=POS_ENC_PARAM_NAMES[0])
+    enc_output = encoder(enc_input, src_slf, n_layer, n_head, d_key,
+                         d_value, d_model, d_inner_hid)
+
+    def beam_rep(x, tail_dims):
+        """[B, ...] -> [B*K, ...] (repeat each row per beam)."""
+        r = L.expand(L.unsqueeze(x, axes=[1]),
+                     [1, K] + [1] * len(tail_dims))
+        return L.reshape(r, shape=[-1] + list(tail_dims))
+
+    enc_rep = beam_rep(enc_output, [T, d_model])
+    trg_slf_rep = beam_rep(trg_slf, [n_head, T, T])
+    trg_src_rep = beam_rep(trg_src, [n_head, T, T])
+    trg_pos_rep = beam_rep(trg_pos_full, [T])
+
+    counter = L.zeros(shape=[1], dtype="int32")
+    counter.stop_gradient = True
+    limit = L.fill_constant(shape=[1], dtype="int32", value=limit_steps)
+
+    ids_array = L.create_array("int64", capacity=limit_steps + 1)
+    scores_array = L.create_array("float32", capacity=limit_steps + 1)
+    parent_array = L.create_array("int32", capacity=limit_steps + 1)
+    L.array_write(init_ids, counter, ids_array)
+    L.array_write(init_scores, counter, scores_array)
+    init_parent = L.fill_constant_batch_size_like(
+        input=init_ids, shape=[-1, K], dtype="int32", value=0)
+    L.array_write(init_parent, counter, parent_array)
+
+    # the decoded prefix, float-typed so one_hot matmul reordering works;
+    # cast to int64 for the embedding lookup each step
+    prefix = L.fill_constant_batch_size_like(
+        input=init_ids, shape=[-1, K, T], dtype="float32", value=0.0)
+
+    cond = L.less_than(x=counter, y=limit)
+    while_op = L.While(cond=cond)
+    with while_op.block():
+        pre_ids = L.array_read(ids_array, counter)        # [B, K] int64
+        pre_scores = L.array_read(scores_array, counter)  # [B, K]
+
+        # prefix[:, :, t] = pre_ids
+        t64 = L.cast(L.reshape(counter, shape=[1, 1]), "int64")
+        onehot_t = L.one_hot(t64, T)                      # [1, T]
+        keep = L.elementwise_sub(
+            x=L.fill_constant(shape=[1, T], dtype="float32", value=1.0),
+            y=onehot_t)
+        new_prefix = L.elementwise_add(
+            x=L.elementwise_mul(x=prefix, y=keep),
+            y=L.elementwise_mul(
+                x=L.expand(L.unsqueeze(L.cast(pre_ids, "float32"),
+                                       axes=[2]), [1, 1, T]),
+                y=onehot_t))
+        L.assign(new_prefix, prefix)
+
+        tokens = L.cast(L.reshape(prefix, shape=[-1, T]), "int64")
+        # trg embedding + pos enc: same prepare_encoder call as training
+        dec_input = prepare_encoder(
+            tokens, trg_pos_rep, trg_vocab_size, d_model, T, 0.0,
+            pos_enc_param_name=POS_ENC_PARAM_NAMES[1])
+        dec_output = decoder(dec_input, enc_rep, trg_slf_rep, trg_src_rep,
+                             n_layer, n_head, d_key, d_value, d_model,
+                             d_inner_hid)
+        logits = fluid.layers.fc(input=dec_output, size=trg_vocab_size,
+                                 bias_attr=False, num_flatten_dims=2)
+        # logits at position t: mask-and-reduce (no dynamic slicing op
+        # needed; XLA folds the one-hot contraction)
+        step_logits = L.reduce_sum(
+            L.elementwise_mul(
+                x=logits, y=L.reshape(onehot_t, shape=[1, T, 1])),
+            dim=1)                                        # [B*K, V]
+        logp = L.log(L.softmax(L.reshape(
+            step_logits, shape=[-1, K, trg_vocab_size])))  # [B, K, V]
+
+        selected_ids, selected_scores, parent = L.beam_search(
+            pre_ids=pre_ids, pre_scores=pre_scores, ids=None, scores=logp,
+            beam_size=K, end_id=eos_id, return_parent_idx=True)
+
+        # reorder prefixes to follow their selected parent beams
+        onehot_p = L.one_hot(parent, K)                   # [B, K, Ksrc]
+        L.assign(L.matmul(onehot_p, prefix), prefix)
+
+        L.increment(counter, 1, in_place=True)
+        L.array_write(selected_ids, counter, ids_array)
+        L.array_write(selected_scores, counter, scores_array)
+        L.array_write(parent, counter, parent_array)
+        L.less_than(x=counter, y=limit, cond=cond)
+
+    return L.beam_search_decode(ids_array, scores_array,
+                                parent_idx=parent_array, end_id=eos_id)
+
+
+def prepare_decode_batch(src_seqs, max_length, n_head, beam_size,
+                         bos_id=1, pad_id=0):
+    """Feed arrays for build_decode: encoder feeds + beam init."""
+    b = len(src_seqs)
+    neg = -1e9
+    src = np.full((b, max_length), pad_id, "int64")
+    src_pos = np.zeros((b, max_length), "int64")
+    src_bias = np.zeros((b, n_head, max_length, max_length), "float32")
+    cross_bias = np.zeros((b, n_head, max_length, max_length), "float32")
+    causal = np.triu(np.full((max_length, max_length), neg, "float32"), 1)
+    trg_bias = np.tile(causal[None, None], (b, n_head, 1, 1))
+    for i, s in enumerate(src_seqs):
+        s = list(s)[:max_length]
+        src[i, :len(s)] = s
+        src_pos[i, :len(s)] = np.arange(len(s))
+        src_bias[i, :, :, len(s):] = neg
+        cross_bias[i, :, :, len(s):] = neg
+    init_ids = np.full((b, beam_size), bos_id, "int64")
+    init_scores = np.zeros((b, beam_size), "float32")
+    init_scores[:, 1:] = neg  # break initial beam symmetry
+    return {
+        "src_word": src, "src_pos": src_pos, "src_slf_attn_bias": src_bias,
+        "trg_pos_full": np.tile(np.arange(max_length, dtype="int64")[None],
+                                (b, 1)),
+        "trg_slf_attn_bias": trg_bias.astype("float32"),
+        "trg_src_attn_bias": cross_bias,
+        "init_ids": init_ids, "init_scores": init_scores,
+    }
+
+
 def prepare_batch(src_seqs, trg_seqs, max_length, n_head, pad_id=0):
     """Pack python token lists into the 9 dense feed arrays."""
     b = len(src_seqs)

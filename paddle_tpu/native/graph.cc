@@ -67,18 +67,20 @@ int paddle_tpu_liveness(int n_ops, int n_vars,
   return sweeps;
 }
 
-// Kahn topological sort of the op DAG induced by var producer->consumer
-// edges. order_out: caller-allocated [n_ops]. Returns the number of ops
-// emitted (< n_ops means a cycle; the emitted prefix is valid).
+// Kahn topological sort of the op DAG induced by RAW (def->use) edges.
+// order_out: caller-allocated [n_ops]. Returns the number of ops emitted
+// (< n_ops means a cycle; the emitted prefix is valid).
 int paddle_tpu_topo_sort(int n_ops, int n_vars,
                          const int32_t* use_off, const int32_t* use_ids,
                          const int32_t* def_off, const int32_t* def_ids,
                          int32_t* order_out) {
   if (n_ops < 0 || n_vars < 0) return -1;
-  // producer[v] = last op defining v before first use (straight-line IR
-  // allows redefinition; each use depends on the latest prior def, which
-  // for a DAG check we approximate by every def of v before any use —
-  // matching the reference's ssa-graph edge construction)
+  // The IR is straight-line with redefinition (e.g. an sgd op reads AND
+  // rewrites its parameter), so a use at op i depends on the LATEST def
+  // strictly before i — treating every def as a producer of every use
+  // would manufacture cycles out of ordinary read-then-rewrite training
+  // programs. producers[v] is built in program order, so a binary search
+  // finds the governing def.
   std::vector<std::vector<int32_t>> producers(n_vars);
   for (int i = 0; i < n_ops; ++i)
     for (int32_t j = def_off[i]; j < def_off[i + 1]; ++j)
@@ -88,8 +90,18 @@ int paddle_tpu_topo_sort(int n_ops, int n_vars,
   std::vector<int32_t> indeg(n_ops, 0);
   for (int i = 0; i < n_ops; ++i) {
     for (int32_t j = use_off[i]; j < use_off[i + 1]; ++j) {
-      for (int32_t p : producers[use_ids[j]]) {
-        if (p == i) continue;
+      const std::vector<int32_t>& defs = producers[use_ids[j]];
+      // latest def with index < i
+      int32_t p = -1;
+      {
+        int lo = 0, hi = (int)defs.size() - 1;
+        while (lo <= hi) {
+          int mid = (lo + hi) / 2;
+          if (defs[mid] < i) { p = defs[mid]; lo = mid + 1; }
+          else hi = mid - 1;
+        }
+      }
+      if (p >= 0) {
         succ[p].push_back(i);
         ++indeg[i];
       }

@@ -95,7 +95,45 @@ def test_native_topo_sort():
     pos = {op: i for i, op in enumerate(order)}
     assert pos[0] < pos[1] and pos[0] < pos[2]
     assert pos[1] < pos[3] and pos[2] < pos[3]
-    # cycle -> None
-    uses_c = [{"b"}, {"a"}]
-    defs_c = [{"a"}, {"b"}]
-    assert ng.topo_sort(uses_c, defs_c) is None
+
+
+def test_topo_sort_handles_read_then_rewrite():
+    """In-place update ops (sgd reads AND rewrites its param) must not
+    manufacture cycles: a use depends on the latest def BEFORE it."""
+    # op0 defs w; op1 uses w (fwd); op2 uses fwd defs g; op3 uses w,g
+    # and REDEFINES w (the optimizer step)
+    uses = [set(), {"w"}, {"f"}, {"w", "g"}]
+    defs = [{"w"}, {"f"}, {"g"}, {"w"}]
+    order = ng.topo_sort(uses, defs)
+    assert order is not None, "read-then-rewrite produced a phantom cycle"
+    pos = {op: i for i, op in enumerate(order)}
+    assert pos[0] < pos[1] < pos[2] < pos[3]
+
+
+def test_topo_sort_on_real_training_program():
+    """A full fc->cost->sgd training block topo-sorts (no program-order
+    fallback) and the order respects RAW dependencies."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        p = fluid.layers.fc(input=x, size=1)
+        c = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(c)
+    ops = main.global_block().ops
+    uses = [{n for ns in op.inputs.values() for n in ns if n}
+            for op in ops]
+    defs = [{n for ns in op.outputs.values() for n in ns if n}
+            for op in ops]
+    order = ng.topo_sort(uses, defs)
+    assert order is not None, "training program hit the fallback"
+    pos = {op: i for i, op in enumerate(order)}
+    for i in range(len(ops)):
+        last_def = {}
+        for j in range(i):
+            for n in defs[j]:
+                last_def[n] = j
+        for n in uses[i]:
+            if n in last_def:
+                assert pos[last_def[n]] < pos[i], (i, n)
