@@ -258,7 +258,7 @@ def build_decode(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
     L = fluid.layers
     K = beam_size
     T = max_length
-    limit_steps = min(max_out_len or T - 1, T - 1)
+    limit_steps = T - 1 if max_out_len is None else min(max_out_len, T - 1)
 
     src_word = L.data("src_word", [T], dtype="int64")
     src_pos = L.data("src_pos", [T], dtype="int64")

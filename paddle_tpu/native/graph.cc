@@ -67,44 +67,41 @@ int paddle_tpu_liveness(int n_ops, int n_vars,
   return sweeps;
 }
 
-// Kahn topological sort of the op DAG induced by RAW (def->use) edges.
+// Kahn topological sort of the op DAG induced by RAW (latest-def -> use),
+// WAR (reader -> redefinition) and WAW (def -> redefinition) edges — the
+// full dependence set, so any emitted order is a legal execution schedule.
+// The IR is straight-line with redefinition (e.g. an sgd op reads AND
+// rewrites its parameter); building edges in program order keeps every
+// edge forward (lower -> higher index), so the graph is acyclic by
+// construction and all n_ops are always emitted for well-formed input.
 // order_out: caller-allocated [n_ops]. Returns the number of ops emitted
-// (< n_ops means a cycle; the emitted prefix is valid).
+// (< n_ops only for malformed input — kept as a defensive invariant).
 int paddle_tpu_topo_sort(int n_ops, int n_vars,
                          const int32_t* use_off, const int32_t* use_ids,
                          const int32_t* def_off, const int32_t* def_ids,
                          int32_t* order_out) {
   if (n_ops < 0 || n_vars < 0) return -1;
-  // The IR is straight-line with redefinition (e.g. an sgd op reads AND
-  // rewrites its parameter), so a use at op i depends on the LATEST def
-  // strictly before i — treating every def as a producer of every use
-  // would manufacture cycles out of ordinary read-then-rewrite training
-  // programs. producers[v] is built in program order, so a binary search
-  // finds the governing def.
-  std::vector<std::vector<int32_t>> producers(n_vars);
-  for (int i = 0; i < n_ops; ++i)
-    for (int32_t j = def_off[i]; j < def_off[i + 1]; ++j)
-      producers[def_ids[j]].push_back(i);
-
+  std::vector<int32_t> last_def(n_vars, -1);
+  std::vector<std::vector<int32_t>> readers(n_vars);  // since last def
   std::vector<std::vector<int32_t>> succ(n_ops);
   std::vector<int32_t> indeg(n_ops, 0);
+  auto add_edge = [&](int32_t from, int32_t to) {
+    if (from == to) return;
+    succ[from].push_back(to);
+    ++indeg[to];
+  };
   for (int i = 0; i < n_ops; ++i) {
     for (int32_t j = use_off[i]; j < use_off[i + 1]; ++j) {
-      const std::vector<int32_t>& defs = producers[use_ids[j]];
-      // latest def with index < i
-      int32_t p = -1;
-      {
-        int lo = 0, hi = (int)defs.size() - 1;
-        while (lo <= hi) {
-          int mid = (lo + hi) / 2;
-          if (defs[mid] < i) { p = defs[mid]; lo = mid + 1; }
-          else hi = mid - 1;
-        }
-      }
-      if (p >= 0) {
-        succ[p].push_back(i);
-        ++indeg[i];
-      }
+      int v = use_ids[j];
+      if (last_def[v] >= 0) add_edge(last_def[v], i);  // RAW
+      readers[v].push_back(i);
+    }
+    for (int32_t j = def_off[i]; j < def_off[i + 1]; ++j) {
+      int v = def_ids[j];
+      if (last_def[v] >= 0) add_edge(last_def[v], i);  // WAW
+      for (int32_t r : readers[v]) add_edge(r, i);     // WAR
+      readers[v].clear();
+      last_def[v] = i;
     }
   }
   std::vector<int32_t> queue;

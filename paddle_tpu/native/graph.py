@@ -97,8 +97,11 @@ def liveness(uses, defs):
 
 
 def topo_sort(uses, defs):
-    """Kahn order of the op DAG (producer->consumer edges); returns a list
-    of op indices, or None if unavailable or the graph has a cycle."""
+    """Kahn order of the op DAG under the full RAW/WAR/WAW dependence set
+    (any returned order is a legal execution schedule). Straight-line IR
+    with program-ordered edges is acyclic by construction, so this returns
+    None only when the native library is unavailable (or on a defensive
+    invariant violation)."""
     lib = _lib()
     if lib is None:
         return None

@@ -8,3 +8,4 @@ from .pipeline import pipeline_apply, pipeline_stages_spec, \
     stack_stage_params, sequential_reference
 from .distributed import init_distributed, shutdown_distributed, \
     global_mesh, is_initialized as distributed_is_initialized
+from .moe import moe_layer, init_moe_params, moe_param_specs

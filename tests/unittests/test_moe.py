@@ -1,0 +1,87 @@
+"""Expert-parallel MoE: capacity-bounded fast path vs dense reference,
+sharded dp×ep training on the virtual mesh."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import (make_mesh, moe_layer, init_moe_params,
+                                 moe_param_specs, NamedSharding, P)
+from paddle_tpu.parallel.moe import dense_reference
+
+
+def test_moe_matches_dense_reference_with_ample_capacity():
+    rng = np.random.RandomState(0)
+    params = init_moe_params(rng, d_model=8, d_hidden=16, num_experts=4)
+    x = rng.randn(32, 8).astype("float32")
+    y, aux = moe_layer(params, x, capacity_factor=4.0)  # no drops possible
+    ref = dense_reference(params, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    assert float(aux) >= 1.0 - 1e-5  # >= 1 by Cauchy-Schwarz, =1 uniform
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    rng = np.random.RandomState(1)
+    params = init_moe_params(rng, d_model=8, d_hidden=16, num_experts=4)
+    # force all tokens onto expert 0: zero gate -> uniform logits ->
+    # argmax ties resolve to expert 0 for every token
+    params["gate"] = jnp.zeros_like(params["gate"])
+    x = rng.randn(16, 8).astype("float32")
+    y, _ = moe_layer(params, x, capacity_factor=0.5)  # cap = 2 slots
+    nonzero_rows = int((np.abs(np.asarray(y)).max(axis=1) > 1e-9).sum())
+    assert nonzero_rows == 2  # only the first C tokens got expert output
+
+
+def test_moe_grads_flow_and_are_finite():
+    rng = np.random.RandomState(2)
+    params = init_moe_params(rng, d_model=8, d_hidden=16, num_experts=4)
+    x = rng.randn(24, 8).astype("float32")
+    tgt = rng.randn(24, 8).astype("float32")
+
+    def loss(p):
+        y, aux = moe_layer(p, x, capacity_factor=2.0)
+        return jnp.mean((y - tgt) ** 2) + 0.01 * aux
+
+    g = jax.grad(loss)(params)
+    for name, leaf in g.items():
+        a = np.asarray(leaf)
+        assert np.isfinite(a).all(), name
+    # expert weights receive gradient (at least the routed-to experts)
+    assert np.abs(np.asarray(g["w1"])).max() > 0
+    assert np.abs(np.asarray(g["gate"])).max() > 0
+
+
+def test_moe_dp_ep_sharded_training_step():
+    """dp×ep on one mesh: batch over dp, experts over ep; a jitted SGD
+    step executes with sharded expert weights and the loss decreases."""
+    rng = np.random.RandomState(3)
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    params = init_moe_params(rng, d_model=8, d_hidden=16, num_experts=4)
+    specs = moe_param_specs("ep")
+    params = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+              for k, v in params.items()}
+    x = rng.randn(64, 8).astype("float32")
+    w_true = (rng.randn(8, 8) * 0.5).astype("float32")
+    tgt = np.maximum(x @ w_true, 0)
+
+    def loss_fn(p, x, t):
+        y, aux = moe_layer(p, x, capacity_factor=2.0, mesh=mesh, axis="ep")
+        return jnp.mean((y - t) ** 2) + 0.01 * aux
+
+    @jax.jit
+    def step(p, x, t):
+        l, g = jax.value_and_grad(loss_fn)(p, x, t)
+        return l, {k: p[k] - 0.5 * g[k] for k in p}
+
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    ts = jax.device_put(tgt.astype("float32"), NamedSharding(mesh, P("dp")))
+    losses = []
+    for _ in range(40):
+        l, params = step(params, xs, ts)
+        losses.append(float(l))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    # expert weights stayed ep-sharded through the updates
+    assert "ep" in str(params["w1"].sharding.spec)

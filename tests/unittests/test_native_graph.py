@@ -97,6 +97,18 @@ def test_native_topo_sort():
     assert pos[1] < pos[3] and pos[2] < pos[3]
 
 
+def test_topo_sort_anti_dependencies():
+    """WAR/WAW edges: a redefinition must come after earlier readers and
+    the prior def, so the order is a legal schedule, not just RAW-valid."""
+    # op0 def w; op1 use w; op2 def w (no inputs) — op2 must stay after op1
+    uses = [set(), {"w"}, set()]
+    defs = [{"w"}, {"y"}, {"w"}]
+    order = ng.topo_sort(uses, defs)
+    assert order is not None
+    pos = {op: i for i, op in enumerate(order)}
+    assert pos[0] < pos[1] < pos[2], order
+
+
 def test_topo_sort_handles_read_then_rewrite():
     """In-place update ops (sgd reads AND rewrites its param) must not
     manufacture cycles: a use depends on the latest def BEFORE it."""
