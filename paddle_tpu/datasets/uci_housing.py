@@ -1,10 +1,14 @@
 """UCI housing regression dataset (506 samples, 13 features).
 
 Parity: python/paddle/v2/dataset/uci_housing.py — train()/test() yield
-(feature_vector[13] float32, [price] float32), features normalized. Synthetic
-fallback: a fixed random linear model + noise, so fit_a_line genuinely
-converges on it.
+(feature_vector[13] float32, [price] float32), features min-max normalized
+like the reference's feature_range scaling. Real `housing.data` under
+DATA_HOME/uci_housing is used when present (whitespace table, 14 columns,
+80/20 split like the reference); otherwise a fixed random linear model +
+noise, so fit_a_line genuinely converges on it.
 """
+import os
+
 import numpy as np
 
 from . import common
@@ -13,6 +17,21 @@ __all__ = ["train", "test", "feature_num", "convert"]
 
 feature_num = 13
 _TRAIN_N, _TEST_N = 404, 102  # the real 80/20 split of 506
+
+
+def _load_real():
+    """Parse housing.data (whitespace floats, 14 cols) and normalize each
+    feature as (x - mean) / (max - min) — reference uci_housing.py:67-71
+    (load_data loop); the label column stays raw."""
+    path = os.path.join(common.DATA_HOME, "uci_housing", "housing.data")
+    data = np.loadtxt(path, dtype=np.float32).reshape(-1, feature_num + 1)
+    feats = data[:, :feature_num]
+    lo, hi = feats.min(axis=0), feats.max(axis=0)
+    avg = feats.mean(axis=0)
+    span = np.where(hi > lo, hi - lo, 1.0)
+    data[:, :feature_num] = (feats - avg) / span
+    n_train = int(len(data) * 0.8)
+    return data[:n_train], data[n_train:]
 
 
 def _make(split_name, n):
@@ -27,6 +46,12 @@ def _make(split_name, n):
 
 def _reader_creator(split_name, n):
     def reader():
+        if common.have_real_data("uci_housing", "housing.data"):
+            tr, te = _load_real()
+            rows = tr if split_name == "train" else te
+            for row in rows:
+                yield row[:feature_num], row[feature_num:]
+            return
         xs, ys = _make(split_name, n)
         for x, y in zip(xs, ys):
             yield x, np.array([y], dtype=np.float32)

@@ -13,7 +13,7 @@ from paddle_tpu.models import language_model
 
 
 def _batches(word_dict, batch_size=16):
-    pairs = list(imikolov.train(word_dict, 2,
+    pairs = list(imikolov.train(word_dict, 0,
                                 data_type=imikolov.DataType.SEQ)())
     for i in range(0, len(pairs) - batch_size + 1, batch_size):
         chunk = pairs[i:i + batch_size]

@@ -141,9 +141,13 @@ def test_imikolov():
     w = datasets.imikolov.build_dict()
     gram = next(iter(datasets.imikolov.train(w, 5)()))
     assert len(gram) == 5
+    # SEQ: n bounds the src length (reference semantics); 0 = unbounded
     src, trg = next(iter(datasets.imikolov.train(
-        w, 5, datasets.imikolov.DataType.SEQ)()))
+        w, 0, datasets.imikolov.DataType.SEQ)()))
     assert src[1:] == trg[:-1]
+    bounded = list(datasets.imikolov.train(
+        w, 8, datasets.imikolov.DataType.SEQ)())
+    assert all(len(s) <= 8 for s, _ in bounded)
 
 
 def test_movielens():
