@@ -7,7 +7,7 @@ from .core.framework import default_main_program
 
 __all__ = ["ErrorClipByValue", "GradientClipByValue", "GradientClipByNorm",
            "GradientClipByGlobalNorm", "set_gradient_clip",
-           "append_gradient_clip_ops"]
+           "append_gradient_clip_ops", "error_clip_callback"]
 
 
 class BaseErrorClipAttr(object):
@@ -19,6 +19,31 @@ class ErrorClipByValue(BaseErrorClipAttr):
         max = float(max)
         self.max = max
         self.min = float(min) if min is not None else -max
+
+
+def error_clip_callback(block, context):
+    """Parity: reference clip.py:62 — called per appended grad op with the
+    grad_to_var map; clips @GRAD outputs whose forward var carries an
+    error_clip attr. core/backward.py applies the same policy inline for
+    the built-in append_backward; this callback is the hook for custom
+    backward builders."""
+    grad_to_var = context
+    if not block.ops:
+        return
+    op = block.ops[-1]
+    for grad_n in (n for ns in op.outputs.values() for n in ns
+                   if n in grad_to_var):
+        fwd_var = block.var_recursive(grad_to_var[grad_n])
+        error_clip = getattr(fwd_var, "error_clip", None)
+        if error_clip is None:
+            continue
+        if not isinstance(error_clip, BaseErrorClipAttr):
+            raise TypeError("Variable's error_clip should be an instance "
+                            "of BaseErrorClipAttr or None")
+        block.append_op(
+            type="clip", inputs={"X": [grad_n]}, outputs={"Out": [grad_n]},
+            attrs={"min": error_clip.min, "max": error_clip.max},
+            infer_shape=False)
 
 
 class BaseGradientClipAttr(object):

@@ -160,3 +160,34 @@ def _global_weight_initializer():
 
 def _global_bias_initializer():
     return ConstantInitializer(0.0)
+
+
+# ---------------------------------------------------------------------------
+# init_on_cpu (reference initializer.py:24-63): a context manager that forced
+# LR-schedule sub-graphs to initialize on the CPU. Under whole-program XLA
+# the placement is device-uniform, so the flag is tracked for API parity and
+# otherwise inert.
+# ---------------------------------------------------------------------------
+
+_force_init_on_cpu_ = False
+
+
+def force_init_on_cpu():
+    return _force_init_on_cpu_
+
+
+def init_on_cpu():
+    """with init_on_cpu(): ... (reference semantics: ops created inside are
+    placed on CPU at init time; a no-op placement hint on TPU)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _force_init_on_cpu_
+        prev = _force_init_on_cpu_
+        _force_init_on_cpu_ = True
+        try:
+            yield
+        finally:
+            _force_init_on_cpu_ = prev
+    return guard()

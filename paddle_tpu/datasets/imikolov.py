@@ -78,7 +78,10 @@ def _sentences(split_name, n, vocab):
 
 def _reader_creator(split_name, n, word_idx, ngram_n, data_type):
     vocab = len(word_idx)
-    real = common.have_real_data("imikolov", _FILES[split_name])
+    # real mode requires the TRAIN file (the vocabulary source): a stray
+    # valid-only DATA_HOME must not mix a synthetic vocab with real text
+    real = common.have_real_data("imikolov", _FILES["train"]) and \
+        common.have_real_data("imikolov", _FILES[split_name])
 
     def sentences():
         if real:

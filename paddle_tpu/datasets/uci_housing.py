@@ -45,13 +45,18 @@ def _make(split_name, n):
 
 
 def _reader_creator(split_name, n):
-    def reader():
-        if common.have_real_data("uci_housing", "housing.data"):
-            tr, te = _load_real()
-            rows = tr if split_name == "train" else te
+    # creator-time decision + parse (like the sibling loaders and the
+    # reference's load_data): epochs re-yield from memory, not the file
+    if common.have_real_data("uci_housing", "housing.data"):
+        tr, te = _load_real()
+        rows = tr if split_name == "train" else te
+
+        def real_reader():
             for row in rows:
                 yield row[:feature_num], row[feature_num:]
-            return
+        return real_reader
+
+    def reader():
         xs, ys = _make(split_name, n)
         for x, y in zip(xs, ys):
             yield x, np.array([y], dtype=np.float32)

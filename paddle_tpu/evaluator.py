@@ -175,16 +175,20 @@ class DetectionMAP(object):
     mAP. Computation in metrics.DetectionMAP."""
 
     def __init__(self, overlap_threshold=0.5, evaluate_difficult=True,
-                 ap_version="integral"):
+                 ap_version="integral", background_label=None):
         from .metrics import DetectionMAP as _Metric
         self._metric = _Metric(overlap_threshold=overlap_threshold,
-                               ap_version=ap_version)
+                               ap_version=ap_version,
+                               evaluate_difficult=evaluate_difficult,
+                               background_label=background_label)
 
     def reset(self, executor=None, reset_program=None):
         self._metric.reset()
 
-    def update(self, nmsed_out, nmsed_lens, gt_boxes, gt_labels):
-        self._metric.update(nmsed_out, nmsed_lens, gt_boxes, gt_labels)
+    def update(self, nmsed_out, nmsed_lens, gt_boxes, gt_labels,
+               gt_difficult=None):
+        self._metric.update(nmsed_out, nmsed_lens, gt_boxes, gt_labels,
+                            gt_difficult=gt_difficult)
 
     def eval(self, executor=None, eval_program=None):
         return np.array([self._metric.eval()], "float32")

@@ -17,6 +17,8 @@ __all__ = [
     "not_equal", "is_empty", "lod_rank_table", "max_sequence_len",
     "reorder_lod_tensor_by_rank", "shrink_memory", "lod_tensor_to_array",
     "array_to_lod_tensor", "split_lod_tensor", "merge_lod_tensor",
+    "Print", "ParallelDo", "get_places", "StaticRNNMemoryLink",
+    "BlockGuardWithCompletion",
 ]
 
 
@@ -726,3 +728,94 @@ class DynamicRNN(_RNNBase):
             raise ValueError(
                 "DynamicRNN.step_input needs a sequence (lod_level>0) input")
         return super(DynamicRNN, self).step_input(x, level)
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Parity: fluid.layers.Print (reference control_flow.py:150,
+    print_op.cc). Wraps the tensor so each execution prints `message` and
+    the value; lowered to jax.debug.print, which works inside jit and on
+    device. The op is identity, so gradients pass through unchanged
+    (print_phase is accepted; values print whenever the op executes,
+    including its recompute inside the backward's vjp). Returns the
+    identity output so the print stays live in the graph."""
+    helper = LayerHelper("print", name=None)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={"first_n": int(first_n), "message": message or "",
+               "summarize": int(summarize),
+               "print_tensor_name": bool(print_tensor_name),
+               "print_tensor_type": bool(print_tensor_type),
+               "print_tensor_shape": bool(print_tensor_shape),
+               "print_phase": str(print_phase),
+               "var_name": input.name})
+    if input.shape is not None:
+        out.shape = tuple(input.shape)
+    return out
+
+
+def get_places(device_count=None, device_type=None):
+    """Parity: fluid.layers.get_places — the reference returned a places
+    variable for ParallelDo. Device placement is mesh-declarative here, so
+    this returns the device list for inspection."""
+    import jax
+    devices = jax.devices()
+    if device_count is not None:
+        devices = devices[:device_count]
+    return devices
+
+
+class ParallelDo(object):
+    """Parity shim: reference control_flow.py ParallelDo replicated a
+    sub-block over GPUs with gradient all-reduce (parallel_do_op.cc). The
+    TPU-native equivalent is GSPMD data parallelism (ParallelExecutor), so
+    this shim runs the body INLINE on the full batch — numerically the
+    behavior ParallelDo produced, with the device distribution delegated to
+    the mesh. Kept so reference scripts run unchanged."""
+
+    def __init__(self, places, use_nccl=False, name=None):
+        self._outputs = []
+
+    def do(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            yield
+        return guard()
+
+    def read_input(self, var):
+        return var
+
+    def write_output(self, var):
+        self._outputs.append(var)
+
+    def __call__(self):
+        if not self._outputs:
+            raise ValueError("ParallelDo: no outputs written; call "
+                             "write_output inside the do() block")
+        return self._outputs[0] if len(self._outputs) == 1 \
+            else list(self._outputs)
+
+
+class StaticRNNMemoryLink(object):
+    """Parity: reference control_flow.py StaticRNNMemoryLink — the
+    (init, pre_mem, mem) record linking a memory across steps. The scan
+    lowering tracks this inside _RNNBase; the class is kept for scripts
+    that introspect it."""
+
+    def __init__(self, init, pre_mem, mem=None):
+        self.init = init
+        self.pre_mem = pre_mem
+        self.mem = mem
+
+
+class BlockGuardWithCompletion(_RNNGuard):
+    """Parity: reference control_flow.py BlockGuardWithCompletion — the
+    with-block helper that completes the RNN on exit. Functionally the same
+    guard rnn.block()/step() return (_RNNGuard: sets IN_RNN_BLOCK, opens
+    the step sub-block, emits the rnn_scan op on exit), kept under the
+    reference name for scripts that construct it directly."""

@@ -19,7 +19,46 @@ from . import tensor
 __all__ = [
     "prior_box", "iou_similarity", "box_coder", "bipartite_match",
     "target_assign", "ssd_loss", "detection_output", "multi_box_head",
+    "detection_map",
 ]
+
+
+def detection_map(detect_res, label, class_num=None, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral"):
+    """Batch mAP of detection_output results against ground truth.
+
+    Parity: reference detection_map_op.h (score-sorted greedy TP/FP at an
+    IoU threshold, 11point/integral AP) — a CPU-only op there; here the
+    same numpy routine (metrics.DetectionMAP) runs as a host callback
+    inside the jitted program, so the fetch is a plain scalar.
+
+    detect_res: [B, K, 6] (-1 padded) + lengths companion, as produced by
+    detection_output. label: lod_level-1 ground truth [B, G, 5] rows of
+    (class, x1, y1, x2, y2), or [B, G, 6] with a difficult flag after the
+    class — with evaluate_difficult=False, difficult boxes don't count as
+    positives and detections matching them are ignored (reference VOC
+    protocol). background_label (when not None) is excluded from the AP
+    mean; class_num is accepted for signature parity. Returns [1] float32
+    mAP."""
+    helper = LayerHelper("detection_map", **locals())
+    out = helper.create_variable_for_type_inference("float32")
+    out.stop_gradient = True
+    out.shape = (1,)
+    helper.append_op(
+        type="detection_map",
+        inputs={"DetectRes": [detect_res],
+                "DetectLen": [helper.block.var_recursive(
+                    detect_res.seq_len_var)],
+                "Label": [label],
+                "LabelLen": [helper.block.var_recursive(label.seq_len_var)]},
+        outputs={"Out": [out]},
+        attrs={"overlap_threshold": float(overlap_threshold),
+               "evaluate_difficult": bool(evaluate_difficult),
+               "background_label": background_label,
+               "ap_version": str(ap_version)},
+        infer_shape=False)
+    return out
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,

@@ -9,10 +9,11 @@ io pre-pass — see core/readers.py for the TPU-native design).
 from ..core import unique_name
 from ..core.framework import default_main_program, default_startup_program
 
-__all__ = ["data", "Send", "Recv", "open_recordio_file", "open_files",
-           "read_file", "create_shuffle_reader",
-           "create_double_buffer_reader", "create_multi_pass_reader",
-           "shuffle", "double_buffer", "multi_pass"]
+__all__ = ["data", "Send", "Recv", "ListenAndServ", "BlockGuardServ",
+           "open_recordio_file", "open_files", "read_file",
+           "create_shuffle_reader", "create_double_buffer_reader",
+           "create_multi_pass_reader", "shuffle", "double_buffer",
+           "multi_pass"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
@@ -39,6 +40,74 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
     if lod_level > 0:
         main.seq_len_var = name + "@SEQLEN"
     return main
+
+
+class BlockGuardServ(object):
+    """with server.do(): — collect the optimize block, then complete_op
+    (parity: reference layers/io.py:87)."""
+
+    def __init__(self, server):
+        if not isinstance(server, ListenAndServ):
+            raise TypeError("BlockGuardServ takes a ListenAndServ")
+        self.server = server
+        self.program = default_main_program()
+
+    def __enter__(self):
+        self.block = self.program.create_block()
+        return self.block
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.server.complete_op()
+        self.program.rollback()
+        return False
+
+
+class ListenAndServ(object):
+    """Parity: reference layers/io.py:108 — wraps the listen_and_serv op:
+    a server block receiving vars and running the optimize sub-block. On
+    TPU there is no RPC loop; the op is the same marker the
+    DistributeTranspiler's pserver programs carry, and the collected
+    optimize block executes directly (sharded-parameter semantics — see
+    transpiler/distribute_transpiler.py)."""
+
+    def __init__(self, endpoint, inputs=None, fan_in=1, optimizer_mode=True):
+        self.inputs = list(inputs or [])
+        self.endpoint = endpoint
+        self.fan_in = fan_in
+        self.optimizer_mode = optimizer_mode
+
+    def do(self):
+        return BlockGuardServ(self)
+
+    def get_params_and_grads(self):
+        prog = default_main_program()
+        block = prog.current_block()
+        params, grads = [], []
+        for op in block.ops:
+            if self.optimizer_mode:
+                if "Grad" in op.inputs and "Param" in op.inputs:
+                    params.append(op.inputs["Param"][0])
+                    grads.append(op.inputs["Grad"][0])
+            else:
+                for names in op.inputs.values():
+                    for n in names:
+                        params.append(n)
+                        grads.append(n)
+        return params, grads
+
+    def complete_op(self):
+        prog = default_main_program()
+        current = prog.current_block()
+        parent = prog.blocks[current.parent_idx]
+        params, grads = self.get_params_and_grads()
+        parent.append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": self.endpoint, "Fanin": self.fan_in,
+                   "ParamList": params, "GradList": grads,
+                   "sub_block": current.idx},
+            infer_shape=False)
 
 
 def Send(endpoints, send_vars, get_vars=None):

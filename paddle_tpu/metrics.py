@@ -143,18 +143,22 @@ class DetectionMAP(MetricBase):
     """
 
     def __init__(self, overlap_threshold=0.5, ap_version="integral",
+                 evaluate_difficult=True, background_label=None,
                  name=None):
         super(DetectionMAP, self).__init__(name)
         self.overlap_threshold = overlap_threshold
         self.ap_version = ap_version
+        self.evaluate_difficult = evaluate_difficult
+        self.background_label = background_label
         self.reset()
 
     def reset(self):
         self._dets = []   # (class, score, box, image_id)
-        self._gts = []    # (class, box, image_id)
+        self._gts = []    # (class, box, image_id, difficult)
         self._img = 0
 
-    def update(self, nmsed_out, nmsed_lens, gt_boxes, gt_labels):
+    def update(self, nmsed_out, nmsed_lens, gt_boxes, gt_labels,
+               gt_difficult=None):
         nmsed_out = np.asarray(nmsed_out)
         nmsed_lens = np.ravel(np.asarray(nmsed_lens))
         for i in range(nmsed_out.shape[0]):
@@ -165,8 +169,11 @@ class DetectionMAP(MetricBase):
                                    nmsed_out[i, j, 2:6].copy(), img))
             gb = np.asarray(gt_boxes[i]).reshape(-1, 4)
             gl = np.ravel(np.asarray(gt_labels[i]))
+            gd = np.ravel(np.asarray(gt_difficult[i])) \
+                if gt_difficult is not None else np.zeros(len(gl))
             for g in range(gb.shape[0]):
-                self._gts.append((int(gl[g]), gb[g].copy(), img))
+                self._gts.append((int(gl[g]), gb[g].copy(), img,
+                                  bool(gd[g])))
         self._img += nmsed_out.shape[0]
 
     @staticmethod
@@ -195,11 +202,14 @@ class DetectionMAP(MetricBase):
         return ap
 
     def eval(self):
-        classes = sorted({c for c, _, _ in self._gts})
+        classes = sorted({c for c, _, _, _ in self._gts
+                          if c != self.background_label})
         aps = []
         for cls in classes:
-            gts = [(b, i) for c, b, i in self._gts if c == cls]
-            npos = len(gts)
+            gts = [(b, i, d) for c, b, i, d in self._gts if c == cls]
+            # difficult gts don't count as positives when excluded
+            npos = sum(1 for _, _, d in gts
+                       if self.evaluate_difficult or not d)
             dets = sorted((d for d in self._dets if d[0] == cls),
                           key=lambda d: -d[1])
             used = set()
@@ -210,16 +220,20 @@ class DetectionMAP(MetricBase):
                 # ALL gts of the image; a detection whose best gt is already
                 # claimed counts FP (no re-matching to the second-best gt)
                 best, best_g = 0.0, -1
-                for gi, (gb, gimg) in enumerate(gts):
+                for gi, (gb, gimg, _) in enumerate(gts):
                     if gimg != img:
                         continue
                     ov = self._iou(box, gb)
                     if ov > best:
                         best, best_g = ov, gi
-                if (best >= self.overlap_threshold and best_g >= 0 and
-                        best_g not in used):
-                    tp[k] = 1
-                    used.add(best_g)
+                if best >= self.overlap_threshold and best_g >= 0:
+                    if not self.evaluate_difficult and gts[best_g][2]:
+                        continue  # matched a difficult gt: neither TP nor FP
+                    if best_g not in used:
+                        tp[k] = 1
+                        used.add(best_g)
+                    else:
+                        fp[k] = 1
                 else:
                     fp[k] = 1
             if npos == 0:

@@ -235,6 +235,38 @@ def _assign(ctx, ins, attrs):
     return _out(single(ins, "X"))
 
 
+@register("print")
+def _print(ctx, ins, attrs):
+    """Identity + debug callback print (reference print_op.cc). Works under
+    jit and inside lax control flow; the runtime prints when the step runs.
+    first_n is honored per compiled entry via a host-side counter in the
+    callback closure (a re-trace starts a fresh count)."""
+    x = single(ins, "In")
+    msg = attrs.get("message") or ""
+    parts = []
+    if attrs.get("print_tensor_name", True):
+        parts.append(attrs.get("var_name", ""))
+    if attrs.get("print_tensor_type", True):
+        parts.append(str(x.dtype))
+    if attrs.get("print_tensor_shape", True):
+        parts.append(str(tuple(x.shape)))
+    header = " ".join(p for p in [msg] + parts if p)
+    summarize = attrs.get("summarize", -1)
+    first_n = attrs.get("first_n", -1)
+    shown = x.reshape(-1)
+    if summarize and summarize > 0:
+        shown = shown[:summarize]
+    state = {"n": 0}
+
+    def _emit(v):
+        if first_n < 0 or state["n"] < first_n:
+            state["n"] += 1
+            print(header, np.asarray(v))
+
+    jax.debug.callback(_emit, shown)
+    return _out(x)
+
+
 @register("clip")
 def _clip(ctx, ins, attrs):
     return _out(jnp.clip(single(ins, "X"), attrs["min"], attrs["max"]))

@@ -419,3 +419,44 @@ def _ssd_loss(ctx, ins, attrs):
             lax.stop_gradient(jnp.sum(num_pos).astype(jnp.float32)), 1.0)
         loss = loss / normalizer
     return {"Loss": [loss]}
+
+
+@register("detection_map")
+def _detection_map(ctx, ins, attrs):
+    """Batch mAP via host callback to metrics.DetectionMAP (reference
+    detection_map_op.h ran on CPU inside the executor; jax.pure_callback
+    is the same host round-trip under whole-program jit)."""
+    det = single(ins, "DetectRes")        # [B, K, 6], -1 padded
+    det_len = single(ins, "DetectLen")    # [B]
+    label = single(ins, "Label")          # [B, G, 5|6]
+    label_len = single(ins, "LabelLen")   # [B]
+    thr = attrs.get("overlap_threshold", 0.5)
+    ap = attrs.get("ap_version", "integral")
+    eval_difficult = attrs.get("evaluate_difficult", True)
+    background = attrs.get("background_label", None)
+
+    def host_map(det, det_len, label, label_len):
+        from ..metrics import DetectionMAP
+        det = np.asarray(det)
+        det_len = np.ravel(np.asarray(det_len)).astype(np.int64)
+        label = np.asarray(label)
+        label_len = np.ravel(np.asarray(label_len)).astype(np.int64)
+        has_difficult = label.shape[-1] == 6
+        box_start = 2 if has_difficult else 1
+        m = DetectionMAP(overlap_threshold=thr, ap_version=ap,
+                         evaluate_difficult=eval_difficult,
+                         background_label=background)
+        gt_boxes, gt_labels, gt_diff = [], [], []
+        for i in range(label.shape[0]):
+            rows = label[i, :label_len[i]]
+            gt_labels.append(rows[:, 0])
+            gt_boxes.append(rows[:, box_start:box_start + 4])
+            gt_diff.append(rows[:, 1] if has_difficult
+                           else np.zeros(len(rows)))
+        m.update(det, det_len, gt_boxes, gt_labels, gt_difficult=gt_diff)
+        return np.asarray([m.eval()], np.float32)
+
+    out = jax.pure_callback(
+        host_map, jax.ShapeDtypeStruct((1,), jnp.float32),
+        det, det_len, label, label_len)
+    return {"Out": [out]}

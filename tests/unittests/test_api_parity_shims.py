@@ -1,0 +1,165 @@
+"""API-parity additions: Print, ParallelDo/get_places, ListenAndServ,
+init_on_cpu, error_clip_callback, detection_map."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_print_layer_passes_through_and_prints(capfd):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        y = fluid.layers.Print(x, message="dbg:", summarize=3)
+        out = fluid.layers.reduce_sum(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.asarray([[1.0, 2.0, 3.0]], "float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    assert abs(float(np.asarray(got).ravel()[0]) - 6.0) < 1e-5  # identity
+    captured = capfd.readouterr()
+    assert "dbg:" in captured.out or "dbg:" in captured.err
+
+
+def test_parallel_do_shim_runs_inline():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        places = fluid.layers.get_places()
+        pd = fluid.layers.ParallelDo(places)
+        with pd.do():
+            h = fluid.layers.fc(input=pd.read_input(x), size=2)
+            pd.write_output(h)
+        out = pd()
+        loss = fluid.layers.mean(fluid.layers.square(out))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": np.ones((4, 4), "f")},
+                       fetch_list=[loss])
+    assert np.isfinite(np.asarray(got)).all()
+    assert len(places) >= 1
+
+
+def test_listen_and_serv_collects_optimize_block():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        w = fluid.layers.create_parameter(shape=[4], dtype="float32",
+                                          name="las_w")
+        g = fluid.layers.data(name="g", shape=[4], dtype="float32")
+        lr = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                        value=0.1)
+        serv = fluid.layers.ListenAndServ("127.0.0.1:6174", fan_in=2)
+        with serv.do():
+            blk = main.current_block()
+            blk.append_op(type="sgd",
+                          inputs={"Param": [w.name], "Grad": [g.name],
+                                  "LearningRate": [lr.name]},
+                          outputs={"ParamOut": [w.name]},
+                          infer_shape=False)
+    ops = [op.type for op in main.global_block().ops]
+    assert "listen_and_serv" in ops
+    las = [op for op in main.global_block().ops
+           if op.type == "listen_and_serv"][0]
+    assert las.attrs["ParamList"] == ["las_w"]
+    assert las.attrs["Fanin"] == 2
+
+
+def test_init_on_cpu_context():
+    from paddle_tpu import initializer
+    assert not initializer.force_init_on_cpu()
+    with initializer.init_on_cpu():
+        assert initializer.force_init_on_cpu()
+    assert not initializer.force_init_on_cpu()
+
+
+def test_error_clip_callback():
+    from paddle_tpu import clip
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=2)
+        h.error_clip = clip.ErrorClipByValue(max=0.5)
+        g = main.global_block().create_var(name=h.name + "@GRAD",
+                                           shape=h.shape, dtype="float32")
+        main.global_block().append_op(
+            type="fill_constant", outputs={"Out": [g.name]},
+            attrs={"shape": [1, 2], "value": 3.0, "dtype": "float32"},
+            infer_shape=False)
+        n_before = len(main.global_block().ops)
+        clip.error_clip_callback(main.global_block(),
+                                 {g.name: h.name})
+        ops = main.global_block().ops
+        assert len(ops) == n_before + 1
+        assert ops[-1].type == "clip"
+        assert ops[-1].attrs["max"] == 0.5
+
+
+def test_detection_map_difficult_protocol():
+    """VOC protocol: with evaluate_difficult=False, difficult GTs are not
+    positives and detections matching them are ignored (not FPs)."""
+    from paddle_tpu.metrics import DetectionMAP
+    det = np.zeros((1, 2, 6), "float32")
+    det[0, 0] = [1, 0.9, 0.0, 0.0, 0.3, 0.3]   # matches difficult gt
+    det[0, 1] = [1, 0.8, 0.5, 0.5, 0.8, 0.8]   # matches easy gt
+    lens = np.asarray([2], "int32")
+    gt_boxes = [np.asarray([[0.0, 0.0, 0.3, 0.3],
+                            [0.5, 0.5, 0.8, 0.8]], "float32")]
+    gt_labels = [np.asarray([1, 1], "float32")]
+    difficult = [np.asarray([1, 0], "float32")]
+
+    m = DetectionMAP(evaluate_difficult=False)
+    m.update(det, lens, gt_boxes, gt_labels, gt_difficult=difficult)
+    # the difficult match is ignored; the easy gt is found -> perfect AP
+    np.testing.assert_allclose(m.eval(), 1.0, rtol=1e-6)
+
+    m2 = DetectionMAP(evaluate_difficult=True)
+    m2.update(det, lens, gt_boxes, gt_labels, gt_difficult=difficult)
+    np.testing.assert_allclose(m2.eval(), 1.0, rtol=1e-6)  # both matched
+
+    # background exclusion: class 0 gts don't contribute an AP term
+    m3 = DetectionMAP(background_label=1)
+    m3.update(det, lens, gt_boxes, gt_labels)
+    assert m3.eval() == 0.0  # only class 1 existed and it's excluded
+
+
+def test_detection_map_layer():
+    from paddle_tpu.metrics import DetectionMAP as HostMAP
+    B, K, G = 2, 4, 3
+    rng = np.random.RandomState(0)
+    det = np.full((B, K, 6), -1.0, "float32")
+    det_lens = np.asarray([3, 2], "int32")
+    gt = np.zeros((B, G, 5), "float32")
+    gt_lens = np.asarray([2, 1], "int32")
+    for b in range(B):
+        for j in range(det_lens[b]):
+            x1, y1 = rng.rand(2) * 0.5
+            det[b, j] = [rng.randint(0, 3), rng.rand(),
+                         x1, y1, x1 + 0.3, y1 + 0.3]
+        for g_ in range(gt_lens[b]):
+            x1, y1 = rng.rand(2) * 0.5
+            gt[b, g_] = [rng.randint(0, 3), x1, y1, x1 + 0.3, y1 + 0.3]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        d = fluid.layers.data(name="d", shape=[6], dtype="float32",
+                              lod_level=1)
+        l = fluid.layers.data(name="l", shape=[5], dtype="float32",
+                              lod_level=1)
+        m = fluid.layers.detection.detection_map(d, l)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got, = exe.run(
+            main,
+            feed={"d": fluid.LoDTensor.from_sequences(
+                      [det[b, :det_lens[b]] for b in range(B)]),
+                  "l": fluid.LoDTensor.from_sequences(
+                      [gt[b, :gt_lens[b]] for b in range(B)])},
+            fetch_list=[m])
+    ref = HostMAP(overlap_threshold=0.5)
+    ref.update(det, det_lens, [gt[b, :gt_lens[b], 1:5] for b in range(B)],
+               [gt[b, :gt_lens[b], 0] for b in range(B)])
+    np.testing.assert_allclose(np.asarray(got).ravel()[0], ref.eval(),
+                               rtol=1e-5)
