@@ -176,18 +176,17 @@ def force_init_on_cpu():
     return _force_init_on_cpu_
 
 
+import contextlib
+
+
+@contextlib.contextmanager
 def init_on_cpu():
     """with init_on_cpu(): ... (reference semantics: ops created inside are
     placed on CPU at init time; a no-op placement hint on TPU)."""
-    import contextlib
-
-    @contextlib.contextmanager
-    def guard():
-        global _force_init_on_cpu_
-        prev = _force_init_on_cpu_
-        _force_init_on_cpu_ = True
-        try:
-            yield
-        finally:
-            _force_init_on_cpu_ = prev
-    return guard()
+    global _force_init_on_cpu_
+    prev = _force_init_on_cpu_
+    _force_init_on_cpu_ = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu_ = prev

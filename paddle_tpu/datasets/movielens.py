@@ -3,9 +3,18 @@
 Parity: python/paddle/v2/dataset/movielens.py — train()/test() yield
 (user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
 [rating]); plus max_user_id/max_movie_id/max_job_id/age_table and the
-MovieInfo/UserInfo tables. Synthetic fallback: latent-factor ratings
-(user·movie affinity), so the recommender model genuinely learns.
+MovieInfo/UserInfo tables. The real `ml-1m.zip` under DATA_HOME/movielens
+is parsed when present ('::'-separated movies/users/ratings.dat, title
+year stripped, rating scaled x2-5, random.Random(0) 10% test split —
+reference movielens.py:101-160 exactly, with the title/category dicts
+built in sorted order for determinism). Synthetic fallback: latent-factor
+ratings (user·movie affinity), so the recommender model genuinely learns.
 """
+import os
+import random
+import re
+import zipfile
+
 import numpy as np
 
 from . import common
@@ -47,23 +56,97 @@ class UserInfo(object):
 
 
 def max_user_id():
+    if _have_real():
+        return max(_real_meta()[0])
     return _N_USERS - 1
 
 
 def max_movie_id():
+    if _have_real():
+        return max(_real_meta()[1])
     return _N_MOVIES - 1
 
 
 def max_job_id():
+    if _have_real():
+        return max(u.job_id for u in _real_meta()[0].values())
     return _N_JOBS - 1
 
 
 def movie_categories():
+    if _have_real():
+        return dict(_real_meta()[3])
     return {"cat%d" % i: i for i in range(_N_CATEGORIES)}
 
 
 def get_movie_title_dict():
+    if _have_real():
+        return dict(_real_meta()[2])
     return common.word_dict(_TITLE_VOCAB)
+
+
+_REAL_CACHE = None
+
+
+def _have_real():
+    return common.have_real_data("movielens", "ml-1m.zip")
+
+
+def _real_meta():
+    """Parse ml-1m.zip into (users, movies, title_dict, cat_dict) with
+    MovieInfo values pre-resolved to id lists."""
+    global _REAL_CACHE
+    if _REAL_CACHE is not None:
+        return _REAL_CACHE
+    path = os.path.join(common.DATA_HOME, "movielens", "ml-1m.zip")
+    pattern = re.compile(r"^(.*)\((\d+)\)$")
+    raw_movies = {}
+    title_words, cat_names = set(), set()
+    with zipfile.ZipFile(path) as z:
+        with z.open("ml-1m/movies.dat") as f:
+            for line in f:
+                mid, title, cats = \
+                    line.decode("latin-1").strip().split("::")
+                cats = cats.split("|")
+                cat_names.update(cats)
+                m = pattern.match(title)
+                title = m.group(1) if m else title
+                raw_movies[int(mid)] = (title, cats)
+                title_words.update(w.lower() for w in title.split())
+        users = {}
+        with z.open("ml-1m/users.dat") as f:
+            for line in f:
+                uid, gender, age, job = \
+                    line.decode("latin-1").strip().split("::")[:4]
+                users[int(uid)] = UserInfo(uid, gender, age, job)
+    title_dict = {w: i for i, w in enumerate(sorted(title_words))}
+    cat_dict = {c: i for i, c in enumerate(sorted(cat_names))}
+    movies = {}
+    for mid, (title, cats) in raw_movies.items():
+        movies[mid] = MovieInfo(
+            mid, [cat_dict[c] for c in cats],
+            [title_dict[w.lower()] for w in title.split()])
+    _REAL_CACHE = (users, movies, title_dict, cat_dict)
+    return _REAL_CACHE
+
+
+def _real_reader(is_test, rand_seed=0, test_ratio=0.1):
+    users, movies, _, _ = _real_meta()
+    path = os.path.join(common.DATA_HOME, "movielens", "ml-1m.zip")
+
+    def reader():
+        rand = random.Random(x=rand_seed)
+        with zipfile.ZipFile(path) as z:
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (rand.random() < test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ts = \
+                        line.decode("latin-1").strip().split("::")
+                    rating = float(rating) * 2 - 5.0  # reference scaling
+                    yield tuple(users[int(uid)].value() +
+                                movies[int(mid)].value() + [[rating]])
+    return reader
 
 
 _TABLES_CACHE = None
@@ -97,14 +180,16 @@ def _tables():
 
 
 def movie_info():
-    return _tables()[1]
+    return _real_meta()[1] if _have_real() else _tables()[1]
 
 
 def user_info():
-    return _tables()[0]
+    return _real_meta()[0] if _have_real() else _tables()[0]
 
 
 def _reader_creator(split_name, n):
+    if _have_real():
+        return _real_reader(is_test=(split_name == "test"))
     def reader():
         users, movies, uf, mf = _tables()
         rng = common.synthetic_rng("movielens", split_name)

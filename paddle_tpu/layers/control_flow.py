@@ -760,9 +760,11 @@ def Print(input, first_n=-1, message=None, summarize=-1,
 def get_places(device_count=None, device_type=None):
     """Parity: fluid.layers.get_places — the reference returned a places
     variable for ParallelDo. Device placement is mesh-declarative here, so
-    this returns the device list for inspection."""
+    this returns the device list for inspection (filtered to device_type
+    when given, e.g. 'CPU')."""
     import jax
-    devices = jax.devices()
+    devices = jax.devices(device_type.lower()) if device_type \
+        else jax.devices()
     if device_count is not None:
         devices = devices[:device_count]
     return devices

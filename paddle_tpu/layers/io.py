@@ -58,6 +58,7 @@ class BlockGuardServ(object):
 
     def __exit__(self, exc_type, exc_val, exc_tb):
         if exc_type is not None:
+            self.program.rollback()  # never leave the server block current
             return False
         self.server.complete_op()
         self.program.rollback()

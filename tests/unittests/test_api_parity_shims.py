@@ -125,21 +125,26 @@ def test_detection_map_difficult_protocol():
 
 
 def test_detection_map_layer():
+    """Non-vacuous parity: detections genuinely overlap GTs (mix of TPs
+    and FPs), so the callback's padding handling and AP math are exercised
+    and the result is strictly between 0 and 1."""
     from paddle_tpu.metrics import DetectionMAP as HostMAP
     B, K, G = 2, 4, 3
-    rng = np.random.RandomState(0)
     det = np.full((B, K, 6), -1.0, "float32")
-    det_lens = np.asarray([3, 2], "int32")
     gt = np.zeros((B, G, 5), "float32")
+    det_lens = np.asarray([3, 2], "int32")
     gt_lens = np.asarray([2, 1], "int32")
-    for b in range(B):
-        for j in range(det_lens[b]):
-            x1, y1 = rng.rand(2) * 0.5
-            det[b, j] = [rng.randint(0, 3), rng.rand(),
-                         x1, y1, x1 + 0.3, y1 + 0.3]
-        for g_ in range(gt_lens[b]):
-            x1, y1 = rng.rand(2) * 0.5
-            gt[b, g_] = [rng.randint(0, 3), x1, y1, x1 + 0.3, y1 + 0.3]
+    # image 0: gts cls1@(0,0) cls2@(.5,.5); dets: hit cls1, hit cls2,
+    # and a far-off cls1 FP
+    gt[0, 0] = [1, 0.0, 0.0, 0.3, 0.3]
+    gt[0, 1] = [2, 0.5, 0.5, 0.8, 0.8]
+    det[0, 0] = [1, 0.9, 0.02, 0.0, 0.32, 0.3]
+    det[0, 1] = [2, 0.8, 0.5, 0.52, 0.8, 0.82]
+    det[0, 2] = [1, 0.99, 0.6, 0.1, 0.9, 0.4]  # top-scored FP dents AP
+    # image 1: one cls1 gt; one hit + one miss
+    gt[1, 0] = [1, 0.2, 0.2, 0.5, 0.5]
+    det[1, 0] = [1, 0.95, 0.2, 0.22, 0.5, 0.52]
+    det[1, 1] = [1, 0.6, 0.7, 0.7, 0.95, 0.95]
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.unique_name.guard(), fluid.program_guard(main, startup):
@@ -147,7 +152,8 @@ def test_detection_map_layer():
                               lod_level=1)
         l = fluid.layers.data(name="l", shape=[5], dtype="float32",
                               lod_level=1)
-        m = fluid.layers.detection.detection_map(d, l)
+        m = fluid.layers.detection.detection_map(d, l,
+                                                 background_label=0)
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)
@@ -158,8 +164,10 @@ def test_detection_map_layer():
                   "l": fluid.LoDTensor.from_sequences(
                       [gt[b, :gt_lens[b]] for b in range(B)])},
             fetch_list=[m])
-    ref = HostMAP(overlap_threshold=0.5)
+    ref = HostMAP(overlap_threshold=0.5, background_label=0)
     ref.update(det, det_lens, [gt[b, :gt_lens[b], 1:5] for b in range(B)],
                [gt[b, :gt_lens[b], 0] for b in range(B)])
-    np.testing.assert_allclose(np.asarray(got).ravel()[0], ref.eval(),
+    expect = ref.eval()
+    assert 0.0 < expect < 1.0, expect  # non-vacuous: real TPs AND FPs
+    np.testing.assert_allclose(np.asarray(got).ravel()[0], expect,
                                rtol=1e-5)

@@ -116,6 +116,44 @@ def test_imdb_reads_aclimdb_tar(data_home):
     assert [r[1] for r in test_rows] == [0, 1]
 
 
+def test_movielens_reads_ml1m_zip(data_home, monkeypatch):
+    import zipfile
+    from paddle_tpu.datasets import movielens
+    monkeypatch.setattr(movielens, "_REAL_CACHE", None)
+    d = data_home / "movielens"
+    d.mkdir()
+    with zipfile.ZipFile(d / "ml-1m.zip", "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "7::Red Heat (1988)::Action\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::M::25::4::55455\n5::F::45::11::55117\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "\n".join("%d::%d::%d::978300760" % (u, m, r)
+                             for u, m, r in
+                             [(1, 1, 5), (1, 7, 3), (5, 1, 4), (5, 7, 1)]
+                             * 10))
+    assert movielens.max_user_id() == 5
+    assert movielens.max_movie_id() == 7
+    assert movielens.max_job_id() == 11
+    cats = movielens.movie_categories()
+    assert set(cats) == {"Animation", "Comedy", "Action"}
+    titles = movielens.get_movie_title_dict()
+    assert "toy" in titles and "story" in titles and "1995" not in titles
+    rows = list(movielens.train()()) + list(movielens.test()())
+    assert len(rows) == 40
+    uid, gender, age, job, mid, cat_ids, title_ids, rating = rows[0]
+    assert uid in (1, 5) and mid in (1, 7)
+    assert gender in (0, 1)
+    assert age == movielens.age_table.index(25) or \
+        age == movielens.age_table.index(45)
+    assert all(c in cats.values() for c in cat_ids)
+    assert -5.0 <= rating[0] <= 5.0  # reference x2-5 scaling
+    # deterministic split: train/test partition the data
+    assert 0 < len(list(movielens.test()())) < 40
+    monkeypatch.setattr(movielens, "_REAL_CACHE", None)
+
+
 def test_imikolov_reads_ptb_text(data_home):
     from paddle_tpu.datasets import imikolov
     d = data_home / "imikolov"
