@@ -1,0 +1,177 @@
+"""Multi-config conv2d and train-mode batch_norm numerics.
+
+Parity model: the reference's test_conv2d_op.py (stride/pad/dilation/groups
+sweeps vs a direct numpy convolution) and test_batch_norm_op.py (batch
+statistics, running-stat update `running = m*running + (1-m)*batch`, biased
+variance, NCHW vs NHWC vs rank-2 input) through the real executor path.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import check_grad_fd, run_op
+
+rng = np.random.RandomState(21)
+
+
+def np_conv2d(x, w, stride, pad, dil, groups):
+    """Direct numpy conv, NCHW x [N,C,H,W], w [O,C/g,kh,kw]."""
+    n, c, h, wd = x.shape
+    o, cg, kh, kw = w.shape
+    eh, ew = (kh - 1) * dil[0] + 1, (kw - 1) * dil[1] + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])))
+    oh = (h + 2 * pad[0] - eh) // stride[0] + 1
+    ow = (wd + 2 * pad[1] - ew) // stride[1] + 1
+    out = np.zeros((n, o, oh, ow), dtype=np.float64)
+    og = o // groups
+    for b in range(n):
+        for oc in range(o):
+            g = oc // og
+            for i in range(oh):
+                for j in range(ow):
+                    acc = 0.0
+                    for ic in range(cg):
+                        for ki in range(kh):
+                            for kj in range(kw):
+                                acc += (
+                                    xp[b, g * cg + ic,
+                                       i * stride[0] + ki * dil[0],
+                                       j * stride[1] + kj * dil[1]]
+                                    * w[oc, ic, ki, kj])
+                    out[b, oc, i, j] = acc
+    return out
+
+
+@pytest.mark.parametrize("stride,pad,dil,groups", [
+    ((1, 1), (0, 0), (1, 1), 1),
+    ((2, 2), (1, 1), (1, 1), 1),
+    ((1, 1), (1, 1), (2, 2), 1),   # dilated
+    ((1, 1), (1, 1), (1, 1), 2),   # grouped
+    ((2, 1), (0, 1), (1, 1), 1),   # asymmetric stride/pad
+    ((1, 1), (2, 2), (1, 1), 4),   # groups == channels (depthwise-like)
+])
+def test_conv2d_configs(stride, pad, dil, groups):
+    c, o = 4, 4
+    x = rng.randn(2, c, 7, 6).astype("float32")
+    w = rng.randn(o, c // groups, 3, 3).astype("float32")
+    got, = run_op("conv2d", {"Input": x, "Filter": w},
+                  attrs={"strides": list(stride), "paddings": list(pad),
+                         "dilations": list(dil), "groups": groups},
+                  out_slots=("Output",))
+    expect = np_conv2d(x.astype(np.float64), w.astype(np.float64),
+                       stride, pad, dil, groups)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_grouped_grads():
+    x = rng.randn(1, 4, 5, 5).astype("float32")
+    w = rng.randn(2, 2, 3, 3).astype("float32")
+    attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 2}
+    check_grad_fd("conv2d", {"Input": x, "Filter": w}, "Input", attrs=attrs,
+                  out_slots=("Output",))
+    check_grad_fd("conv2d", {"Input": x, "Filter": w}, "Filter", attrs=attrs,
+                  out_slots=("Output",))
+
+
+def test_conv2d_strided_grads():
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+    w = rng.randn(3, 2, 3, 3).astype("float32")
+    attrs = {"strides": [2, 2], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1}
+    check_grad_fd("conv2d", {"Input": x, "Filter": w}, "Input", attrs=attrs,
+                  out_slots=("Output",))
+
+
+def _bn_layer_run(x, scale, bias, is_test=False, momentum=0.9, eps=1e-5,
+                  layout="NCHW", n_runs=1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=list(x.shape[1:]),
+                               dtype="float32")
+        y = fluid.layers.batch_norm(
+            input=xv, is_test=is_test, momentum=momentum, epsilon=eps,
+            data_layout=layout,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(scale)),
+            bias_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(bias)),
+            moving_mean_name="bn_mean", moving_variance_name="bn_var")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(n_runs):
+            out, = exe.run(main, feed={"x": x}, fetch_list=[y])
+        mean = np.asarray(scope.get("bn_mean"))
+        var = np.asarray(scope.get("bn_var"))
+    return out, mean, var
+
+
+def test_batch_norm_train_numeric():
+    c = 3
+    x = rng.randn(4, c, 5, 5).astype("float32") * 2 + 1
+    scale = rng.rand(c).astype("float32") + 0.5
+    bias = rng.randn(c).astype("float32")
+    out, mean, var = _bn_layer_run(x, scale, bias, momentum=0.9)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))          # biased, like the reference
+    expect = ((x - bm.reshape(1, c, 1, 1))
+              / np.sqrt(bv.reshape(1, c, 1, 1) + 1e-5)
+              * scale.reshape(1, c, 1, 1) + bias.reshape(1, c, 1, 1))
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+    # moving stats after ONE step from (0, 1) init
+    np.testing.assert_allclose(mean, 0.9 * 0 + 0.1 * bm, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(var, 0.9 * 1 + 0.1 * bv, rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_running_stats_converge():
+    """Feeding the same batch k times: running mean -> batch mean."""
+    c = 2
+    x = (rng.randn(8, c, 3, 3) * 3 + 5).astype("float32")
+    scale = np.ones(c, dtype="float32")
+    bias = np.zeros(c, dtype="float32")
+    _, mean, var = _bn_layer_run(x, scale, bias, momentum=0.5, n_runs=6)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))
+    # after 6 steps with momentum .5 the residual of the init is 1/64
+    np.testing.assert_allclose(mean, bm * (1 - 0.5 ** 6), rtol=1e-3)
+    np.testing.assert_allclose(var, bv * (1 - 0.5 ** 6) + 0.5 ** 6,
+                               rtol=1e-3)
+
+
+def test_batch_norm_nhwc():
+    c = 3
+    x = rng.randn(2, 4, 4, c).astype("float32")
+    scale = np.ones(c, dtype="float32")
+    bias = np.zeros(c, dtype="float32")
+    out, _, _ = _bn_layer_run(x, scale, bias, layout="NHWC")
+    bm = x.mean(axis=(0, 1, 2))
+    bv = x.var(axis=(0, 1, 2))
+    expect = (x - bm) / np.sqrt(bv + 1e-5)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_batch_norm_rank2():
+    """fc output [N, C] normalizes over the batch axis only."""
+    c = 5
+    x = rng.randn(6, c).astype("float32")
+    scale = np.ones(c, dtype="float32")
+    bias = np.zeros(c, dtype="float32")
+    out, _, _ = _bn_layer_run(x, scale, bias)
+    expect = (x - x.mean(0)) / np.sqrt(x.var(0) + 1e-5)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_batch_norm_inference_uses_running_stats():
+    c = 2
+    x = rng.randn(3, c, 4, 4).astype("float32")
+    scale = (rng.rand(c) + 0.5).astype("float32")
+    bias = rng.randn(c).astype("float32")
+    out, mean, var = _bn_layer_run(x, scale, bias, is_test=True)
+    # untouched init stats: mean 0, var 1
+    np.testing.assert_allclose(mean, np.zeros(c), atol=0)
+    np.testing.assert_allclose(var, np.ones(c), atol=0)
+    expect = (x / np.sqrt(1 + 1e-5) * scale.reshape(1, c, 1, 1)
+              + bias.reshape(1, c, 1, 1))
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-4)
