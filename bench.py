@@ -26,11 +26,14 @@ def main():
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     dtype = os.environ.get("BENCH_DTYPE", "bf16")  # bf16 | fp32
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    # smoke-run knobs (defaults = the headline config)
+    hw = int(os.environ.get("BENCH_IMAGE_HW", "224"))
+    class_dim = int(os.environ.get("BENCH_CLASS_DIM", "1000"))
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.unique_name.guard(), fluid.program_guard(main_prog, startup):
         image, label, avg_cost, acc = build_train(
-            model="resnet50", class_dim=1000, image_shape=(3, 224, 224),
+            model="resnet50", class_dim=class_dim, image_shape=(3, hw, hw),
             learning_rate=0.1, momentum=0.9, use_bf16=(dtype == "bf16"))
     if remat:  # trade FLOPs for activation memory (enables larger batch)
         fluid.memory_optimization_transpiler.enable_rematerialization(
@@ -40,37 +43,69 @@ def main():
     exe = fluid.Executor(place)
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
-    # one-time host→device transfer; the timed loop feeds device-resident
-    # arrays (a real input pipeline would double-buffer the same way)
+    feed_mode = os.environ.get("BENCH_FEED", "device")  # device | host
     import jax.numpy as jnp
-    xs = jnp.asarray(rng.rand(batch, 3, 224, 224).astype("float32"))
-    ys = jnp.asarray(rng.randint(0, 1000, (batch, 1)).astype("int32"))
-    jax.block_until_ready((xs, ys))
+    if feed_mode == "host":
+        # realistic input pipeline: numpy batches staged host→device by the
+        # shipped DoubleBufferReader (core/readers.py) — the same code path
+        # layers.double_buffer uses — so the copy overlaps the running step
+        from itertools import count
+        from paddle_tpu.core.readers import (DoubleBufferReader,
+                                             IteratorReader)
+        host_batches = [
+            (rng.rand(batch, 3, hw, hw).astype("float32"),
+             rng.randint(0, class_dim, (batch, 1)).astype("int32"))
+            for _ in range(3)]
+        reader = DoubleBufferReader(IteratorReader(
+            lambda: (host_batches[i % len(host_batches)] for i in count())),
+            capacity=2, place=place)
+
+        def stage(_i):
+            img, lbl = reader.next()
+            return {"image": img, "label": lbl}
+
+        feeds = None  # per-step, via prefetcher below
+    else:
+        # one-time host→device transfer; the timed loop feeds
+        # device-resident arrays
+        xs = jnp.asarray(rng.rand(batch, 3, hw, hw).astype("float32"))
+        ys = jnp.asarray(rng.randint(0, class_dim, (batch, 1)).astype("int32"))
+        jax.block_until_ready((xs, ys))
+        feeds = {"image": xs, "label": ys}
 
     with fluid.scope_guard(scope):
         exe.run(startup)
         for _ in range(warmup):
-            loss, = exe.run(main_prog, feed={"image": xs, "label": ys},
-                            fetch_list=[avg_cost])
+            fd = stage(0) if feeds is None else feeds
+            loss, = exe.run(main_prog, feed=fd, fetch_list=[avg_cost])
         assert np.isfinite(loss).all(), "non-finite loss in warmup"
         t0 = time.perf_counter()
-        for _ in range(steps):
-            out = exe.run(main_prog, feed={"image": xs, "label": ys},
+        for i in range(steps):
+            fd = stage(i) if feeds is None else feeds
+            out = exe.run(main_prog, feed=fd,
                           fetch_list=[avg_cost], return_numpy=False)
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
 
     ips = batch * steps / dt
-    print(json.dumps({
+    headline = (hw == 224 and class_dim == 1000)
+    rec = {
         "metric": "resnet50_imagenet_train_throughput",
         "value": round(ips, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(ips / 300.0, 3),
+        # the 300 img/s V100 baseline is a 224x224/1000-class number; a
+        # shrunken smoke config must not masquerade as a baseline beat
+        "vs_baseline": round(ips / 300.0, 3) if headline else None,
         "batch": batch,
         "dtype": dtype,
+        "feed": feed_mode,
         "device": str(jax.devices()[0]),
         "loss": float(np.asarray(loss).reshape(-1)[0]),
-    }))
+    }
+    if not headline:
+        rec["image_hw"] = hw
+        rec["class_dim"] = class_dim
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

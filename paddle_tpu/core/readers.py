@@ -261,6 +261,7 @@ class DoubleBufferReader(ReaderBase):
         self._capacity = max(1, int(capacity))
         self._place = place
         self._gen = 0
+        _live_double_buffers.add(self)
         self._start()
 
     def _device(self):
@@ -330,6 +331,24 @@ class DoubleBufferReader(ReaderBase):
 class _ReaderError(object):
     def __init__(self, error):
         self.error = error
+
+
+# Interpreter-exit safety: a daemon worker parked inside jax.device_put /
+# q.put while CPython tears down aborts the process ("terminate called …"
+# from XLA). Drain and join every live double buffer first.
+import atexit
+import weakref
+
+_live_double_buffers = weakref.WeakSet()
+
+
+@atexit.register
+def _shutdown_double_buffers():
+    for r in list(_live_double_buffers):
+        try:
+            r._stop()
+        except Exception:
+            pass
 
 
 def run_host_io_op(op, scope):

@@ -86,13 +86,23 @@ def sequence_expand(x, y, name=None):
 
 
 def sequence_reshape(input, new_dim):
+    """Parity: fluid.layers.sequence_reshape (sequence_reshape_op.cc) —
+    repacks each sequence's row data to width new_dim; a length-L sequence
+    of dim D becomes length L*D/new_dim. The registered lowering reshapes
+    the padded data (valid data is a contiguous row prefix, so it stays
+    contiguous) and emits the integer-rescaled OutLen companion."""
     helper = LayerHelper("sequence_reshape", **locals())
     out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.block.create_var(
+        name=out.name + "@SEQLEN", shape=[-1], dtype="int32",
+        stop_gradient=True)
     helper.append_op(
-        type="reshape",
-        inputs={"X": [input]},
-        outputs={"Out": [out]},
-        attrs={"shape": [0, -1, new_dim]})
+        type="sequence_reshape",
+        inputs={"X": [input], "XLen": [_seq_len(helper, input)]},
+        outputs={"Out": [out], "OutLen": [out_len]},
+        attrs={"new_dim": new_dim})
+    out.lod_level = 1
+    out.seq_len_var = out_len.name
     return out
 
 

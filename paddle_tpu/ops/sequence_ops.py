@@ -116,6 +116,30 @@ def _sequence_conv(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+@register("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    """Repack row data to width new_dim (reference: sequence_reshape_op.cc).
+
+    Padded-dense: each row's valid data is a contiguous prefix of the
+    flattened [T*D] row, so reshaping to [T*D/new_dim, new_dim] keeps it a
+    contiguous prefix; only the lengths rescale (exact integer math). T is
+    zero-padded up when T*D doesn't divide new_dim (bucketed padding)."""
+    x = single(ins, "X")        # [B, T, D]
+    xlen = single(ins, "XLen")  # [B]
+    new_dim = int(attrs["new_dim"])
+    b, t, d = x.shape
+    # smallest pad with (t+pad)*d % new_dim == 0: t+pad ≡ 0 (mod nd/gcd)
+    import math
+    m = new_dim // math.gcd(d, new_dim)
+    pad_t = (-t) % m
+    if pad_t:
+        x = jnp.pad(x, ((0, 0), (0, pad_t), (0, 0)))
+        t += pad_t
+    out = x.reshape(b, (t * d) // new_dim, new_dim)
+    out_len = (xlen.astype(jnp.int32) * d) // new_dim
+    return {"Out": [out], "OutLen": [out_len]}
+
+
 @register("sequence_expand")
 def _sequence_expand(ctx, ins, attrs):
     """Expand each row of X to match Y's sequence lengths.

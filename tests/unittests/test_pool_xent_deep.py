@@ -1,0 +1,129 @@
+"""Multi-config pool2d and softmax_with_cross_entropy numerics.
+
+Parity model: reference test_pool2d_op.py (ksize/stride/pad sweeps for max +
+avg with exclusive padding handling, global pooling) and
+test_softmax_with_cross_entropy_op.py (hard/soft label, shift invariance)
+through the real executor path.
+"""
+import numpy as np
+import pytest
+
+from op_test import check_forward, check_grad_fd, run_op
+
+rng = np.random.RandomState(33)
+
+
+def np_pool2d(x, ksize, stride, pad, ptype, exclusive=True,
+              global_pool=False):
+    n, c, h, w = x.shape
+    if global_pool:
+        ksize, pad, stride = (h, w), (0, 0), (1, 1)
+    xp = np.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])),
+                constant_values=(-np.inf if ptype == "max" else 0.0))
+    oh = (h + 2 * pad[0] - ksize[0]) // stride[0] + 1
+    ow = (w + 2 * pad[1] - ksize[1]) // stride[1] + 1
+    out = np.zeros((n, c, oh, ow))
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * stride[0]:i * stride[0] + ksize[0],
+                     j * stride[1]:j * stride[1] + ksize[1]]
+            if ptype == "max":
+                out[:, :, i, j] = win.max(axis=(2, 3))
+            else:
+                s = win.sum(axis=(2, 3))
+                if exclusive:
+                    ones = np.pad(np.ones_like(x),
+                                  ((0, 0), (0, 0), (pad[0], pad[0]),
+                                   (pad[1], pad[1])))
+                    cnt = ones[:, :, i * stride[0]:i * stride[0] + ksize[0],
+                               j * stride[1]:j * stride[1] + ksize[1]
+                               ].sum(axis=(2, 3))
+                    out[:, :, i, j] = s / cnt
+                else:
+                    out[:, :, i, j] = s / (ksize[0] * ksize[1])
+    return out
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+@pytest.mark.parametrize("ksize,stride,pad", [
+    ((2, 2), (2, 2), (0, 0)),
+    ((3, 3), (1, 1), (1, 1)),
+    ((3, 2), (2, 1), (1, 0)),   # asymmetric
+])
+def test_pool2d_configs(ptype, ksize, stride, pad):
+    x = rng.randn(2, 3, 7, 6).astype("float32")
+    got, = run_op("pool2d", {"X": x},
+                  attrs={"pooling_type": ptype, "ksize": list(ksize),
+                         "strides": list(stride), "paddings": list(pad)})
+    expect = np_pool2d(x.astype(np.float64), ksize, stride, pad, ptype)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_pool2d_avg_inclusive():
+    """exclusive=False divides by the full window even at padded borders."""
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    attrs = {"pooling_type": "avg", "ksize": [3, 3], "strides": [1, 1],
+             "paddings": [1, 1], "exclusive": False}
+    got, = run_op("pool2d", {"X": x}, attrs=attrs)
+    expect = np_pool2d(x.astype(np.float64), (3, 3), (1, 1), (1, 1), "avg",
+                       exclusive=False)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_pool2d_global():
+    x = rng.randn(2, 4, 5, 5).astype("float32")
+    got, = run_op("pool2d", {"X": x},
+                  attrs={"pooling_type": "avg", "ksize": [1, 1],
+                         "global_pooling": True})
+    np.testing.assert_allclose(got, x.mean(axis=(2, 3), keepdims=True),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool2d_grads(ptype):
+    x = rng.randn(1, 2, 5, 5).astype("float32")
+    check_grad_fd("pool2d", {"X": x}, "X",
+                  attrs={"pooling_type": ptype, "ksize": [2, 2],
+                         "strides": [2, 2], "paddings": [0, 0]})
+
+
+def _np_softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def test_softmax_xent_shift_invariance():
+    """Adding a large constant to logits must not change the loss."""
+    logits = rng.randn(4, 7).astype("float32")
+    labels = rng.randint(0, 7, (4, 1)).astype("int64")
+    base = run_op("softmax_with_cross_entropy",
+                  {"Logits": logits, "Label": labels},
+                  out_slots=("Loss",), attrs={})[0]
+    shifted = run_op("softmax_with_cross_entropy",
+                     {"Logits": logits + 1000.0, "Label": labels},
+                     out_slots=("Loss",), attrs={})[0]
+    np.testing.assert_allclose(base, shifted, rtol=1e-4, atol=1e-4)
+    expect = -np.log(_np_softmax(logits.astype(np.float64))[
+        np.arange(4), labels.ravel()]).reshape(4, 1)
+    np.testing.assert_allclose(base, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_xent_soft_label():
+    logits = rng.randn(3, 5).astype("float32")
+    soft = rng.rand(3, 5).astype("float32")
+    soft /= soft.sum(-1, keepdims=True)
+    got = run_op("softmax_with_cross_entropy",
+                 {"Logits": logits, "Label": soft},
+                 out_slots=("Loss",), attrs={"soft_label": True})[0]
+    p = _np_softmax(logits.astype(np.float64))
+    expect = -(soft * np.log(p)).sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_xent_grad():
+    """d loss / d logits = softmax(logits) - onehot(label), check via FD."""
+    logits = rng.randn(3, 4).astype("float32")
+    labels = rng.randint(0, 4, (3, 1)).astype("int64")
+    check_grad_fd("softmax_with_cross_entropy",
+                  {"Logits": logits, "Label": labels}, "Logits",
+                  out_slots=("Loss",), attrs={})

@@ -1,0 +1,122 @@
+"""row_conv, sequence_conv, sequence_reshape numerics on ragged batches.
+
+Parity model: reference test_row_conv_op.py / test_seq_conv.py /
+test_sequence_reshape.py — per-sequence numpy references over the original
+variable-length data, run through the padded-dense layer path.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+rng = np.random.RandomState(55)
+
+
+def _run(build, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=list(fetch))
+
+
+def test_row_conv_vs_numpy():
+    d, fut = 3, 2
+    seqs = [rng.randn(L, d).astype("float32") for L in (5, 2, 4)]
+    lod = LoDTensor.from_sequences(seqs)
+    w = (rng.randn(fut + 1, d) * 0.4).astype("float32")
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[d], dtype="float32",
+                              lod_level=1)
+        out = fluid.layers.row_conv(
+            x, future_context_size=fut,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(w)))
+        return (out,)
+
+    got, = _run(build, {"x": lod})
+    for i, s in enumerate(seqs):
+        L = len(s)
+        expect = np.zeros((L, d))
+        for t in range(L):
+            for k in range(fut + 1):
+                if t + k < L:
+                    expect[t] += s[t + k] * w[k]
+        np.testing.assert_allclose(got[i, :L], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_conv_vs_numpy():
+    d, nf, fs = 4, 5, 3
+    seqs = [rng.randn(L, d).astype("float32") for L in (4, 6, 1)]
+    lod = LoDTensor.from_sequences(seqs)
+    w = (rng.randn(fs * d, nf) * 0.3).astype("float32")
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[d], dtype="float32",
+                              lod_level=1)
+        out = fluid.layers.sequence_conv(
+            input=x, num_filters=nf, filter_size=fs, bias_attr=False,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(w)))
+        return (out,)
+
+    got, = _run(build, {"x": lod})
+    start = -(fs // 2)
+    for i, s in enumerate(seqs):
+        L = len(s)
+        ctx = np.zeros((L, fs * d))
+        for t in range(L):
+            for k in range(fs):
+                src = t + start + k
+                if 0 <= src < L:
+                    ctx[t, k * d:(k + 1) * d] = s[src]
+        expect = ctx @ w
+        np.testing.assert_allclose(got[i, :L], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_reshape_data_and_lengths():
+    """dim 4 -> 2 doubles each sequence's length; downstream sequence ops
+    must see the scaled lengths (sequence_pool last picks element 2L-1)."""
+    d, nd = 4, 2
+    seqs = [rng.randn(L, d).astype("float32") for L in (3, 1, 2)]
+    lod = LoDTensor.from_sequences(seqs)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[d], dtype="float32",
+                              lod_level=1)
+        r = fluid.layers.sequence_reshape(x, nd)
+        last = fluid.layers.sequence_pool(input=r, pool_type="last")
+        total = fluid.layers.sequence_pool(input=r, pool_type="sum")
+        return (r, last, total)
+
+    r, last, total = _run(build, {"x": lod})
+    for i, s in enumerate(seqs):
+        flat = s.reshape(-1, nd)             # [2L, nd]
+        np.testing.assert_allclose(r[i, :len(flat)], flat, rtol=1e-6)
+        np.testing.assert_allclose(last[i], flat[-1], rtol=1e-6)
+        np.testing.assert_allclose(total[i], flat.sum(0), rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_sequence_reshape_widen():
+    """dim 2 -> 4 halves lengths."""
+    d, nd = 2, 4
+    seqs = [rng.randn(L, d).astype("float32") for L in (4, 2)]
+    lod = LoDTensor.from_sequences(seqs)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[d], dtype="float32",
+                              lod_level=1)
+        r = fluid.layers.sequence_reshape(x, nd)
+        first = fluid.layers.sequence_pool(input=r, pool_type="first")
+        return (r, first)
+
+    r, first = _run(build, {"x": lod})
+    for i, s in enumerate(seqs):
+        flat = s.reshape(-1, nd)
+        np.testing.assert_allclose(r[i, :len(flat)], flat, rtol=1e-6)
+        np.testing.assert_allclose(first[i], flat[0], rtol=1e-6)
