@@ -54,8 +54,10 @@ from .data_feeder import DataFeeder
 from . import backward
 from .parallel.parallel_executor import ParallelExecutor
 from . import transpiler
-from .transpiler import DistributeTranspiler
+from .transpiler import DistributeTranspiler, SimpleDistributeTranspiler
 from .transpiler import distributed_spliter
+from . import default_scope_funcs
+from . import net_drawer
 from . import reader
 from .reader import batch
 from . import datasets

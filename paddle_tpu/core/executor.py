@@ -22,12 +22,27 @@ from .utils import find_var as _find_feed_var
 
 
 class Scope(object):
-    """Name -> host/device array store (parity: framework::Scope)."""
+    """Name -> host/device array store (parity: framework::Scope, incl. the
+    kid-scope tree: new_scope()/parent lookup/drop_kids used by
+    default_scope_funcs and the reference's local-scope executor runs)."""
 
-    def __init__(self):
+    def __init__(self, parent=None):
         self._vars = {}
         self._lods = {}
         self._rng_counter = 0
+        self._parent = parent
+        self._kids = []
+
+    def new_scope(self):
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    def parent(self):
+        return self._parent
+
+    def drop_kids(self):
+        self._kids = []
 
     def set(self, name, value, lod=None):
         self._vars[name] = value
@@ -35,13 +50,23 @@ class Scope(object):
             self._lods[name] = lod
 
     def get(self, name):
-        return self._vars.get(name)
+        if name in self._vars:
+            return self._vars[name]
+        if self._parent is not None:
+            return self._parent.get(name)
+        return None
 
     def has(self, name):
-        return name in self._vars
+        return name in self._vars or (
+            self._parent is not None and self._parent.has(name))
 
     def find_var(self, name):
-        return _ScopeVar(self, name) if name in self._vars else None
+        """Search this scope then ancestors (parity: Scope::FindVar)."""
+        if name in self._vars:
+            return _ScopeVar(self, name)
+        if self._parent is not None:
+            return self._parent.find_var(name)
+        return None
 
     def var(self, name):
         if name not in self._vars:

@@ -82,6 +82,19 @@ class LowerCtx(object):
         # (pushed by control-flow lowerings) — folded into every key so
         # dropout/random ops inside loops vary per time step.
         self._loop_iters = []
+        # message -> traced bool flag: in-graph assertions raised host-side
+        # after the step (same channel as TensorArray overflow). Sticky OR
+        # per message.
+        self.op_errors = {}
+
+    def add_error(self, message, flag):
+        """Record an in-graph assertion (checkify-style). Only valid at the
+        top trace level — flags minted inside lax sub-block traces cannot
+        escape them, so callers inside loops are skipped."""
+        if self._loop_iters:
+            return
+        prev = self.op_errors.get(message)
+        self.op_errors[message] = flag if prev is None else (prev | flag)
 
     def begin_op(self, salt):
         self._op_salt = salt
@@ -316,6 +329,7 @@ def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
                        "sub-block overflowed its capacity inside traced "
                        "control flow; pass a larger capacity to "
                        "create_array()"] = sub_err
+            errors.update(ctx.op_errors)
             if errors:
                 # one combined scalar: the caller host-syncs only this in
                 # the common (no-error) case, per-message flags only after

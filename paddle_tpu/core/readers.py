@@ -304,11 +304,15 @@ class DoubleBufferReader(ReaderBase):
             raise item.error
         return item
 
-    def _stop(self):
+    def _stop(self, max_wait=None):
         """Stop the worker BEFORE touching the underlying reader: a worker
         blocked in q.put finishes its put once we drain, re-checks the
         generation and exits — so it can never steal a record from the
-        freshly reset underlying stream."""
+        freshly reset underlying stream. max_wait bounds the total wait (a
+        worker parked in a blocking source read can't be unblocked by
+        draining; the atexit path must not spin on it forever)."""
+        import time
+        deadline = None if max_wait is None else time.monotonic() + max_wait
         self._gen += 1
         while self._thread.is_alive():
             try:
@@ -317,6 +321,8 @@ class DoubleBufferReader(ReaderBase):
             except queue.Empty:
                 pass
             self._thread.join(timeout=0.05)
+            if deadline is not None and time.monotonic() > deadline:
+                return
 
     def _reset(self):
         self._stop()
@@ -346,7 +352,7 @@ _live_double_buffers = weakref.WeakSet()
 def _shutdown_double_buffers():
     for r in list(_live_double_buffers):
         try:
-            r._stop()
+            r._stop(max_wait=2.0)
         except Exception:
             pass
 

@@ -89,6 +89,9 @@ class AbstractCtx(object):
     def begin_op(self, salt):
         pass
 
+    def add_error(self, message, flag):
+        pass
+
 
 def _struct_for(var):
     import jax

@@ -1,0 +1,62 @@
+"""Thread-local scope stack (parity: python/paddle/fluid/default_scope_funcs.py).
+
+A thread-local stack of Scopes; the top is the current scope. `var`/`find_var`
+operate on the current scope (find_var searches ancestors, like
+framework::Scope::FindVar). `scoped_function` runs a callable inside a fresh
+kid scope that is dropped afterwards.
+"""
+import threading
+
+from .core.executor import Scope, global_scope
+
+__tl_scope__ = threading.local()
+
+__all__ = [
+    "get_cur_scope",
+    "enter_local_scope",
+    "leave_local_scope",
+    "var",
+    "find_var",
+    "scoped_function",
+]
+
+
+def get_cur_scope():
+    """Current scope (bottom of the stack = the process global scope)."""
+    stack = getattr(__tl_scope__, "cur_scope", None)
+    if stack is None:
+        stack = __tl_scope__.cur_scope = []
+    if not stack:
+        stack.append(global_scope())
+    return stack[-1]
+
+
+def enter_local_scope():
+    """Push a new kid of the current scope."""
+    cur = get_cur_scope()
+    __tl_scope__.cur_scope.append(cur.new_scope())
+
+
+def leave_local_scope():
+    """Pop the current scope and drop the parent's kids."""
+    __tl_scope__.cur_scope.pop()
+    get_cur_scope().drop_kids()
+
+
+def var(name):
+    """Create (or get) a variable in the current scope."""
+    return get_cur_scope().var(name)
+
+
+def find_var(name):
+    """Find a variable in the current scope or its ancestors."""
+    return get_cur_scope().find_var(name)
+
+
+def scoped_function(func):
+    """Invoke `func` inside a fresh local scope."""
+    enter_local_scope()
+    try:
+        func()
+    finally:
+        leave_local_scope()

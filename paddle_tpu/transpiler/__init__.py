@@ -2,3 +2,4 @@
 from . import distributed_spliter
 from .distribute_transpiler import DistributeTranspiler, VarBlock, \
     split_dense_variable, same_or_split_var
+from .distribute_transpiler_simple import SimpleDistributeTranspiler

@@ -1,0 +1,54 @@
+"""v2 DataFeeder (parity: python/paddle/v2/data_feeder.py).
+
+The reference converted reader minibatches into C++ `Arguments` via
+PyDataProvider2 scanners; the TPU-native equivalent converts them into the
+fluid feed dict consumed by the whole-program XLA executor. Constructed
+from `data_types` ([(name, paddle.v2.data_type.InputType)]) and an optional
+`feeding` map of name -> input-row column, exactly like the reference; the
+result of `feeder(minibatch)` is directly usable as `Executor.run(feed=...)`.
+"""
+import numpy as np
+
+from . import data_type as _data_type
+from ..core.lod import LoDTensor
+
+__all__ = ["DataFeeder"]
+
+
+def default_feeding_map(data_types):
+    return {name: i for i, (name, _) in enumerate(data_types)}
+
+
+class DataFeeder(object):
+    def __init__(self, data_types, feeding=None):
+        self.data_types = list(data_types)
+        if feeding is None:
+            feeding = default_feeding_map(self.data_types)
+        elif not isinstance(feeding, dict):
+            feeding = {name: i for i, name in enumerate(feeding)}
+        self.feeding = feeding
+
+    def __call__(self, dat, argument=None):
+        """Convert one minibatch (list of per-sample rows) into a feed dict.
+        Scalar/int types get a trailing [batch, 1] axis; seq_type>0 columns
+        become LoDTensors (padded dense + lengths downstream)."""
+        feed = {}
+        for name, tp in self.data_types:
+            col = self.feeding[name]
+            column = [row[col] for row in dat]
+            if isinstance(tp, _data_type.InputType) and tp.seq_type:
+                seqs = [np.asarray(s, dtype=tp.dtype) for s in column]
+                # integer sequences carry a feature dim of 1 downstream
+                if seqs and seqs[0].ndim == 1 and tp.dtype.startswith("int"):
+                    seqs = [s.reshape(-1, 1) for s in seqs]
+                feed[name] = LoDTensor.from_sequences(seqs)
+            else:
+                arr = np.asarray(column,
+                                 dtype=getattr(tp, "dtype", "float32"))
+                if arr.ndim == 1:
+                    arr = arr.reshape(-1, 1)
+                feed[name] = arr
+        return feed
+
+    # reference spelling: feeder.convert(minibatch)
+    convert = __call__

@@ -1,0 +1,23 @@
+"""v2 evaluator shim (parity: python/paddle/v2/evaluator.py).
+
+The reference auto-generated its names from trainer_config_helpers
+evaluators (classification_error, auc, ctc_error, ...) — a stack subsumed
+by fluid (SURVEY.md §2 "Legacy v2 API"). The evaluators with fluid-era
+equivalents are re-exported here from the fluid metrics/evaluator modules
+so v2-style code finds them under the old names; the rest of the legacy
+generator has no fluid counterpart and is out of scope.
+"""
+from ..evaluator import Accuracy, ChunkEvaluator, EditDistance  # noqa: F401
+
+__all__ = ["classification_error", "Accuracy", "ChunkEvaluator",
+           "EditDistance"]
+
+
+def classification_error(input, label, **kwargs):
+    """reference classification_error_evaluator ~ 1 - accuracy: returns the
+    fluid accuracy layer's complement."""
+    from .. import layers
+    acc = layers.accuracy(input=input, label=label,
+                          k=kwargs.get("top_k", 1))
+    one = layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+    return layers.elementwise_sub(one, acc)

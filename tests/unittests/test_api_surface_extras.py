@@ -1,0 +1,145 @@
+"""default_scope_funcs, net_drawer, SimpleDistributeTranspiler, v2
+DataFeeder/evaluator (the last small reference API-surface modules).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import default_scope_funcs as dsf
+
+
+def test_default_scope_funcs_stack():
+    base = dsf.get_cur_scope()
+    dsf.var("outer").set(np.float32(1.0))
+    dsf.enter_local_scope()
+    inner = dsf.get_cur_scope()
+    assert inner is not base
+    # parent lookup: outer visible from the kid scope
+    assert dsf.find_var("outer") is not None
+    dsf.var("inner_only").set(np.float32(2.0))
+    dsf.leave_local_scope()
+    assert dsf.get_cur_scope() is base
+    assert dsf.find_var("inner_only") is None       # kid dropped
+    assert float(dsf.find_var("outer").get_tensor()) == 1.0
+
+    seen = {}
+
+    def body():
+        seen["scope"] = dsf.get_cur_scope()
+        dsf.var("tmp")
+
+    dsf.scoped_function(body)
+    assert seen["scope"] is not base
+    assert dsf.get_cur_scope() is base
+    assert dsf.find_var("tmp") is None
+
+
+def test_scope_parent_lookup_isolated_from_set():
+    s = fluid.Scope()
+    s.set("a", np.float32(3.0))
+    kid = s.new_scope()
+    assert kid.has("a") and float(kid.get("a")) == 3.0
+    kid.set("a", np.float32(7.0))        # shadows, does not write parent
+    assert float(kid.get("a")) == 7.0
+    assert float(s.get("a")) == 3.0
+    s.drop_kids()
+
+
+def test_net_drawer_dot_output(tmp_path):
+    from paddle_tpu import net_drawer
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3, act="relu")
+    path = str(tmp_path / "net.dot")
+    g = net_drawer.draw_graph(startup, main, graphviz_file=path)
+    code = open(path).read()
+    assert code.startswith("digraph")
+    assert "mul" in code or "fc" in code
+    assert any("relu" in str(n) for n in g.nodes)
+
+
+def _build_fc_sgd():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        p = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        opt_ops, params_grads = fluid.optimizer.SGD(
+            learning_rate=0.1).minimize(loss)
+    return main, startup, opt_ops, params_grads
+
+
+def test_simple_distribute_transpiler_round_robin():
+    main, startup, opt_ops, params_grads = _build_fc_sgd()
+    t = fluid.SimpleDistributeTranspiler()
+    t.transpile(opt_ops, params_grads, program=main,
+                pservers="ps0:6174,ps1:6174", trainers=2)
+    # every trainable param placed whole on exactly one endpoint
+    placed = [p.name for slot in t.param_grad_map.values()
+              for p in slot["params"]]
+    assert sorted(placed) == sorted(p.name for p, g in params_grads)
+
+    trainer = t.get_trainer_program()
+    ttypes = [op.type for op in trainer.global_block().ops]
+    assert "send" in ttypes and "sgd" not in ttypes
+
+    total_updates = 0
+    for ep in ("ps0:6174", "ps1:6174"):
+        ps = t.get_pserver_program(ep, opt_ops)
+        ptypes = [op.type for op in ps.global_block().ops]
+        assert ptypes[0] == "recv"
+        total_updates += ptypes.count("sgd")
+    assert total_updates == len(params_grads)
+
+
+def test_simple_transpiler_hash_split_deterministic():
+    from paddle_tpu.transpiler.distribute_transpiler_simple import \
+        hash_name_to_server
+    main, startup, opt_ops, params_grads = _build_fc_sgd()
+    eps = ["a:1", "b:1", "c:1"]
+    m1 = hash_name_to_server(params_grads, eps)
+    m2 = hash_name_to_server(params_grads, eps)
+    flat = lambda m: sorted((ep, p.name) for ep, s in m.items()
+                            for p in s["params"])
+    assert flat(m1) == flat(m2)
+
+
+def test_v2_data_feeder_dense_and_sequence():
+    import paddle_tpu.v2 as paddle
+    data_types = [("image", paddle.data_type.dense_vector(4)),
+                  ("word", paddle.data_type.integer_value_sequence(100)),
+                  ("label", paddle.data_type.integer_value(10))]
+    feeder = paddle.data_feeder.DataFeeder(
+        data_types, feeding={"image": 0, "word": 1, "label": 2})
+    minibatch = [([0.1, 0.2, 0.3, 0.4], [3, 7, 9], 1),
+                 ([0.5, 0.6, 0.7, 0.8], [2], 4)]
+    feed = feeder(minibatch)
+    assert feed["image"].shape == (2, 4)
+    assert feed["image"].dtype == np.float32
+    assert feed["label"].shape == (2, 1)
+    assert feed["label"].dtype == np.int64
+    lod = feed["word"]
+    seqs = lod.to_sequences() if hasattr(lod, "to_sequences") else None
+    if seqs is not None:
+        assert [len(s) for s in seqs] == [3, 1]
+
+
+def test_v2_evaluator_classification_error():
+    import paddle_tpu.v2 as paddle
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        probs = fluid.layers.data(name="p", shape=[3], dtype="float32")
+        label = fluid.layers.data(name="l", shape=[1], dtype="int64")
+        err = paddle.evaluator.classification_error(probs, label)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        p = np.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1], [0.3, 0.3, 0.4]],
+                     dtype="float32")
+        l = np.array([[0], [1], [0]], dtype="int64")   # 2/3 correct
+        got, = exe.run(main, feed={"p": p, "l": l}, fetch_list=[err])
+    np.testing.assert_allclose(np.asarray(got).ravel(), [1 - 2.0 / 3],
+                               rtol=1e-5)

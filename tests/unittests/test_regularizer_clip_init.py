@@ -1,0 +1,158 @@
+"""Regularizers, gradient clipping, per-param LR, and initializer numerics.
+
+Parity model: reference test_regularizer.py, test_gradient_clip.py,
+test_initializer.py — exact one-step update algebra for decay/clip through
+the real executor, and statistical/exact checks of initializer output.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+rng = np.random.RandomState(44)
+
+
+def _one_sgd_step(lr=0.5, regularizer=None, grad_clip=None, param_lr=None,
+                  w0=None, x=None):
+    """fc (no bias) + mean(square) loss; returns (w_before, w_after, grad)
+    where grad is d loss / d w at w0 WITHOUT decay/clip."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        xv = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        attr = fluid.ParamAttr(
+            name="w",
+            initializer=fluid.initializer.NumpyArrayInitializer(w0),
+            regularizer=regularizer,
+            learning_rate=param_lr if param_lr is not None else 1.0)
+        p = fluid.layers.fc(input=xv, size=2, bias_attr=False,
+                            param_attr=attr)
+        loss = fluid.layers.mean(x=fluid.layers.reduce_sum(
+            fluid.layers.square(p), dim=1))
+        if grad_clip is not None:
+            fluid.clip.set_gradient_clip(grad_clip)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": x}, fetch_list=[loss])
+        w_after = np.asarray(scope.get("w"))
+    # analytic grad of mean_b sum_j (x_b @ w)_j^2 wrt w: 2/B * x^T (x w)
+    y = x @ w0
+    grad = 2.0 / x.shape[0] * x.T @ y
+    return w0, w_after, grad
+
+
+W0 = (rng.randn(3, 2) * 0.7).astype("float32")
+X = rng.randn(4, 3).astype("float32")
+
+
+def test_l2_decay_in_update():
+    coeff = 0.3
+    _, w_after, g = _one_sgd_step(regularizer=fluid.regularizer.L2Decay(
+        coeff), w0=W0, x=X)
+    expect = W0 - 0.5 * (g + coeff * W0)
+    np.testing.assert_allclose(w_after, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_l1_decay_in_update():
+    coeff = 0.2
+    _, w_after, g = _one_sgd_step(regularizer=fluid.regularizer.L1Decay(
+        coeff), w0=W0, x=X)
+    expect = W0 - 0.5 * (g + coeff * np.sign(W0))
+    np.testing.assert_allclose(w_after, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_clip_by_value():
+    clip = fluid.clip.GradientClipByValue(max=0.1, min=-0.1)
+    _, w_after, g = _one_sgd_step(grad_clip=clip, w0=W0, x=X)
+    expect = W0 - 0.5 * np.clip(g, -0.1, 0.1)
+    np.testing.assert_allclose(w_after, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_clip_by_norm():
+    clip_norm = 0.05
+    clip = fluid.clip.GradientClipByNorm(clip_norm)
+    _, w_after, g = _one_sgd_step(grad_clip=clip, w0=W0, x=X)
+    n = np.sqrt((g ** 2).sum())
+    gc = g * (clip_norm / n) if n > clip_norm else g
+    expect = W0 - 0.5 * gc
+    np.testing.assert_allclose(w_after, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_clip_by_global_norm():
+    clip_norm = 0.07
+    clip = fluid.clip.GradientClipByGlobalNorm(clip_norm)
+    _, w_after, g = _one_sgd_step(grad_clip=clip, w0=W0, x=X)
+    gn = np.sqrt((g ** 2).sum())        # single param: global norm == norm
+    scale = clip_norm / max(gn, clip_norm)
+    expect = W0 - 0.5 * g * scale
+    np.testing.assert_allclose(w_after, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_per_param_learning_rate():
+    """ParamAttr(learning_rate=k) scales the param's effective LR."""
+    _, w_base, g = _one_sgd_step(w0=W0, x=X)
+    _, w_scaled, _ = _one_sgd_step(param_lr=0.1, w0=W0, x=X)
+    np.testing.assert_allclose(w_base, W0 - 0.5 * g, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(w_scaled, W0 - 0.05 * g, rtol=1e-4,
+                               atol=1e-5)
+
+
+def _init_param(initializer, shape):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fluid.layers.create_parameter(
+            shape=list(shape), dtype="float32",
+            attr=fluid.ParamAttr(name="p", initializer=initializer))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return np.asarray(scope.get("p"))
+
+
+def test_xavier_uniform_bound():
+    """fan_in=fan_out=400: |v| <= sqrt(6/800), std ~ sqrt(2/800)."""
+    v = _init_param(fluid.initializer.Xavier(uniform=True), (400, 400))
+    bound = np.sqrt(6.0 / 800)
+    assert np.abs(v).max() <= bound + 1e-6
+    assert abs(v.std() - bound / np.sqrt(3)) < 0.05 * bound
+
+
+def test_msra_normal_std():
+    """fan_in=500: normal std = sqrt(2/500)."""
+    v = _init_param(fluid.initializer.MSRA(uniform=False), (500, 300))
+    expect = np.sqrt(2.0 / 500)
+    assert abs(v.std() - expect) < 0.05 * expect
+    assert abs(v.mean()) < 0.05 * expect
+
+
+def test_bilinear_kernel_exact():
+    """4x4 upsample kernel: the classic bilinear tent weights."""
+    v = _init_param(fluid.initializer.Bilinear(), (1, 1, 4, 4))
+    f = np.ceil(4 / 2.0)
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    expect = np.zeros((4, 4))
+    for i in range(4):
+        for j in range(4):
+            expect[i, j] = (1 - abs(i / f - c)) * (1 - abs(j / f - c))
+    np.testing.assert_allclose(v[0, 0], expect, rtol=1e-5, atol=1e-6)
+
+
+def test_constant_and_numpy_array_exact():
+    v = _init_param(fluid.initializer.Constant(2.5), (3, 3))
+    np.testing.assert_allclose(v, np.full((3, 3), 2.5), atol=0)
+    arr = rng.randn(2, 5).astype("float32")
+    v = _init_param(fluid.initializer.NumpyArrayInitializer(arr), (2, 5))
+    np.testing.assert_allclose(v, arr, atol=0)
+
+
+def test_uniform_normal_ranges():
+    v = _init_param(fluid.initializer.Uniform(low=-0.25, high=0.25),
+                    (300, 300))
+    assert v.min() >= -0.25 and v.max() <= 0.25
+    assert abs(v.mean()) < 0.01
+    v = _init_param(fluid.initializer.Normal(loc=1.0, scale=0.5), (300, 300))
+    assert abs(v.mean() - 1.0) < 0.02
+    assert abs(v.std() - 0.5) < 0.02

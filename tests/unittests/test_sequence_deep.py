@@ -120,3 +120,21 @@ def test_sequence_reshape_widen():
         flat = s.reshape(-1, nd)
         np.testing.assert_allclose(r[i, :len(flat)], flat, rtol=1e-6)
         np.testing.assert_allclose(first[i], flat[0], rtol=1e-6)
+
+
+def test_sequence_reshape_indivisible_raises():
+    """len*dim % new_dim != 0 must raise (reference PADDLE_ENFORCE), not
+    silently drop the sequence tail."""
+    import pytest
+    d, nd = 4, 8
+    seqs = [rng.randn(3, d).astype("float32")]   # 3*4=12, not /8
+    lod = LoDTensor.from_sequences(seqs)
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[d], dtype="float32",
+                              lod_level=1)
+        r = fluid.layers.sequence_reshape(x, nd)
+        return (r,)
+
+    with pytest.raises(RuntimeError, match="sequence_reshape"):
+        _run(build, {"x": lod})
