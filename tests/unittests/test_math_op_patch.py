@@ -1,0 +1,88 @@
+"""Python operators on Variables (math_op_patch).
+
+Parity model: reference test_math_op_patch.py — every patched dunder
+(+ - * / ** neg, scalar both sides, comparisons) against numpy through
+the executor.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+rng = np.random.RandomState(88)
+
+
+def _run(build, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=list(fetch))
+
+
+A = rng.rand(3, 4).astype("float32") + 0.5
+B = rng.rand(3, 4).astype("float32") + 0.5
+
+
+@pytest.mark.parametrize("expr,ref", [
+    (lambda x, y: x + y, lambda a, b: a + b),
+    (lambda x, y: x - y, lambda a, b: a - b),
+    (lambda x, y: x * y, lambda a, b: a * b),
+    (lambda x, y: x / y, lambda a, b: a / b),
+    (lambda x, y: x ** y, lambda a, b: a ** b),
+    (lambda x, y: x + 2.0, lambda a, b: a + 2.0),
+    (lambda x, y: 2.0 + x, lambda a, b: 2.0 + a),
+    (lambda x, y: x - 1.5, lambda a, b: a - 1.5),
+    (lambda x, y: 1.5 - x, lambda a, b: 1.5 - a),
+    (lambda x, y: 3.0 * x, lambda a, b: 3.0 * a),
+    (lambda x, y: x / 2.0, lambda a, b: a / 2.0),
+    (lambda x, y: 2.0 / x, lambda a, b: 2.0 / a),
+    (lambda x, y: x ** 2.0, lambda a, b: a ** 2.0),
+    (lambda x, y: 2.0 ** x, lambda a, b: 2.0 ** a),
+    (lambda x, y: -x, lambda a, b: -a),
+    (lambda x, y: (x + y) * (x - y) / 2.0,
+     lambda a, b: (a + b) * (a - b) / 2.0),
+])
+def test_arith_ops(expr, ref):
+    def build():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[4], dtype="float32")
+        return (expr(x, y),)
+
+    got, = _run(build, {"x": A, "y": B})
+    np.testing.assert_allclose(
+        got, ref(A.astype(np.float64), B.astype(np.float64)),
+        rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("expr,ref", [
+    (lambda x, y: x < y, lambda a, b: a < b),
+    (lambda x, y: x <= y, lambda a, b: a <= b),
+    (lambda x, y: x > y, lambda a, b: a > b),
+    (lambda x, y: x >= y, lambda a, b: a >= b),
+])
+def test_compare_ops(expr, ref):
+    def build():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[4], dtype="float32")
+        return (expr(x, y),)
+
+    got, = _run(build, {"x": A, "y": B})
+    np.testing.assert_array_equal(np.asarray(got).astype(bool), ref(A, B))
+
+
+def test_grad_through_operators():
+    def build():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        loss = fluid.layers.mean(x=fluid.layers.reduce_sum(
+            (x * x + 3.0 * x) / 2.0))
+        fluid.append_backward(loss)
+        return (loss, "x@GRAD")
+
+    _, gx = _run(build, {"x": A})
+    np.testing.assert_allclose(gx, (2 * A + 3) / 2 / 1.0, rtol=1e-4,
+                               atol=1e-5)

@@ -1,0 +1,136 @@
+"""nets.py composite numerics: glu, sequence_conv_pool,
+scaled_dot_product_attention, simple_img_conv_pool.
+
+Parity model: reference test_glu.py / test_multihead_attention.py — numpy
+references through the real executor.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+rng = np.random.RandomState(66)
+
+
+def _run(build, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=list(fetch))
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_glu_vs_numpy():
+    x = rng.randn(3, 8).astype("float32")
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        return (fluid.nets.glu(xv, dim=-1),)
+
+    got, = _run(build, {"x": x})
+    a, b = np.split(x.astype(np.float64), 2, axis=-1)
+    np.testing.assert_allclose(got, a * _sigmoid(b), rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_conv_pool_max():
+    d, nf, fs = 3, 4, 3
+    seqs = [rng.randn(L, d).astype("float32") for L in (4, 2)]
+    lod = LoDTensor.from_sequences(seqs)
+    w = (rng.randn(fs * d, nf) * 0.4).astype("float32")
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[d], dtype="float32",
+                              lod_level=1)
+        out = fluid.nets.sequence_conv_pool(
+            input=x, num_filters=nf, filter_size=fs, act="sigmoid",
+            pool_type="max",
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(w)))
+        return (out,)
+
+    got, = _run(build, {"x": lod})
+    start = -(fs // 2)
+    for i, s in enumerate(seqs):
+        L = len(s)
+        ctx = np.zeros((L, fs * d))
+        for t in range(L):
+            for k in range(fs):
+                src = t + start + k
+                if 0 <= src < L:
+                    ctx[t, k * d:(k + 1) * d] = s[src]
+        conv = _sigmoid(ctx @ w)        # bias initializes to 0
+        np.testing.assert_allclose(got[i], conv.max(0), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def _np_attention(q, k, v):
+    s = (q / np.sqrt(q.shape[-1])) @ np.swapaxes(k, -1, -2)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    w = e / e.sum(-1, keepdims=True)
+    return w @ v
+
+
+def test_scaled_dot_product_attention_single_head():
+    b, t, d = 2, 5, 4
+    q = rng.randn(b, t, d).astype("float32")
+    k = rng.randn(b, t, d).astype("float32")
+    v = rng.randn(b, t, d).astype("float32")
+
+    def build():
+        qv = fluid.layers.data(name="q", shape=[t, d], dtype="float32")
+        kv = fluid.layers.data(name="k", shape=[t, d], dtype="float32")
+        vv = fluid.layers.data(name="v", shape=[t, d], dtype="float32")
+        return (fluid.nets.scaled_dot_product_attention(qv, kv, vv),)
+
+    got, = _run(build, {"q": q, "k": k, "v": v})
+    expect = _np_attention(q.astype(np.float64), k.astype(np.float64),
+                           v.astype(np.float64))
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_scaled_dot_product_attention_multi_head():
+    b, t, d, heads = 2, 4, 8, 2
+    q = rng.randn(b, t, d).astype("float32")
+    k = rng.randn(b, t, d).astype("float32")
+    v = rng.randn(b, t, d).astype("float32")
+
+    def build():
+        qv = fluid.layers.data(name="q", shape=[t, d], dtype="float32")
+        kv = fluid.layers.data(name="k", shape=[t, d], dtype="float32")
+        vv = fluid.layers.data(name="v", shape=[t, d], dtype="float32")
+        return (fluid.nets.scaled_dot_product_attention(
+            qv, kv, vv, num_heads=heads),)
+
+    got, = _run(build, {"q": q, "k": k, "v": v})
+    hd = d // heads
+    qh = q.reshape(b, t, heads, hd).transpose(0, 2, 1, 3)
+    kh = k.reshape(b, t, heads, hd).transpose(0, 2, 1, 3)
+    vh = v.reshape(b, t, heads, hd).transpose(0, 2, 1, 3)
+    ctx = _np_attention(qh.astype(np.float64), kh.astype(np.float64),
+                        vh.astype(np.float64))
+    expect = ctx.transpose(0, 2, 1, 3).reshape(b, t, d)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_simple_img_conv_pool_shapes_and_grad():
+    x = rng.rand(2, 1, 8, 8).astype("float32")
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[1, 8, 8], dtype="float32")
+        out = fluid.nets.simple_img_conv_pool(
+            input=xv, num_filters=3, filter_size=3, pool_size=2,
+            pool_stride=2, act="relu")
+        loss = fluid.layers.mean(x=fluid.layers.reduce_sum(out))
+        fluid.append_backward(loss)
+        return (out, "conv2d_0.w_0@GRAD")
+
+    out, gw = _run(build, {"x": x})
+    assert out.shape == (2, 3, 3, 3)      # 8x8 -conv3(valid)-> 6x6 -pool2/2-> 3x3
+    assert np.abs(gw).sum() > 0
