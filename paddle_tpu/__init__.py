@@ -58,6 +58,9 @@ from .transpiler import DistributeTranspiler, SimpleDistributeTranspiler
 from .transpiler import distributed_spliter
 from . import default_scope_funcs
 from . import net_drawer
+from . import concurrency
+from .concurrency import (make_channel, channel_send, channel_recv,
+                          channel_close, Select)
 from . import reader
 from .reader import batch
 from . import datasets

@@ -1,0 +1,38 @@
+"""fluid.concurrency surface (parity: python/paddle/fluid/concurrency.py).
+
+EXPLICIT SCOPE CUT (SURVEY.md §2): the reference's Go-style CSP channels
+(make_channel/channel_send/channel_recv/channel_close/Select) block
+interpreter threads between ops — semantics that contradict whole-program
+XLA execution and that had no model, test, or benchmark user in the
+reference era. The TPU-native equivalents of their use cases are the async
+reader layers (fluid.layers.double_buffer) for producer/consumer input and
+collective-based parallelism (ParallelExecutor) for coordination. The names
+exist so reference scripts fail with a curated, actionable error instead of
+an AttributeError.
+"""
+from .layers.control_flow import Select  # noqa: F401
+
+__all__ = ["make_channel", "channel_send", "channel_recv", "channel_close",
+           "Select"]
+
+_MSG = ("fluid.concurrency is not rebuilt in paddle_tpu (explicit scope "
+        "cut, SURVEY.md §2): CSP channel ops block host threads between "
+        "ops, which contradicts whole-program XLA execution. Use the "
+        "reader layers (fluid.layers.double_buffer) for async input, or "
+        "ParallelExecutor collectives for parallel coordination.")
+
+
+def make_channel(dtype, capacity=0):
+    raise NotImplementedError(_MSG)
+
+
+def channel_send(channel, value, is_copy=False):
+    raise NotImplementedError(_MSG)
+
+
+def channel_recv(channel, return_value):
+    raise NotImplementedError(_MSG)
+
+
+def channel_close(channel):
+    raise NotImplementedError(_MSG)

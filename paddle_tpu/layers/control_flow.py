@@ -18,7 +18,8 @@ __all__ = [
     "reorder_lod_tensor_by_rank", "shrink_memory", "lod_tensor_to_array",
     "array_to_lod_tensor", "split_lod_tensor", "merge_lod_tensor",
     "Print", "ParallelDo", "get_places", "StaticRNNMemoryLink",
-    "BlockGuardWithCompletion",
+    "BlockGuardWithCompletion", "BlockGuard", "WhileGuard",
+    "ConditionalBlock", "Select",
 ]
 
 
@@ -821,3 +822,18 @@ class BlockGuardWithCompletion(_RNNGuard):
     guard rnn.block()/step() return (_RNNGuard: sets IN_RNN_BLOCK, opens
     the step sub-block, emits the rnn_scan op on exit), kept under the
     reference name for scripts that construct it directly."""
+
+
+class Select(object):
+    """Parity placeholder: fluid.Select (the CSP-style channel select from
+    fluid.concurrency). The concurrency surface is an explicit scope cut —
+    see SURVEY.md §2: its blocking-channel semantics contradict whole-
+    program XLA execution; the TPU-native equivalents are the async reader
+    layers (double_buffer) and collective-based parallelism."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "fluid.concurrency channels/Select are not rebuilt in "
+            "paddle_tpu (explicit scope cut, SURVEY.md §2); use the reader "
+            "layers (double_buffer) for async input or ParallelExecutor "
+            "collectives for parallelism")

@@ -23,6 +23,7 @@ __all__ = [
     "uniform_random", "uniform_random_batch_size_like", "gaussian_random",
     "gaussian_random_batch_size_like", "cumsum", "scatter", "sum", "gather",
     "fill_constant_batch_size_like", "squeeze", "unsqueeze",
+    "generate_layer_fn", "autodoc", "deprecated",
 ] + __activations__
 
 # op type -> (input slots [(slot, kw, required)], output slots, out dtype fn)
@@ -96,5 +97,36 @@ def generate_layer_fn(op_type):
     return layer_fn
 
 
-for _op in set(__all__):
+def autodoc(comment=""):
+    """Decorator stamping a generated docstring (parity:
+    layer_function_generator.autodoc)."""
+    def _decorator(func):
+        func.__doc__ = "%s\nlayer %s: inputs %s" % (
+            comment, func.__name__,
+            ", ".join(kw for _, kw, _r in
+                      _SPECS.get(func.__name__, ([], []))[0]))
+        return func
+    return _decorator
+
+
+def deprecated(since="", instead=""):
+    """Decorator warning on use (parity: the reference's @deprecated)."""
+    import functools
+    import warnings
+
+    def _decorator(func):
+        @functools.wraps(func)
+        def _wrapper(*args, **kwargs):
+            warnings.warn(
+                "%s is deprecated%s%s" % (
+                    func.__name__,
+                    (" since %s" % since) if since else "",
+                    ("; use %s instead" % instead) if instead else ""),
+                DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+        return _wrapper
+    return _decorator
+
+
+for _op in _SPECS:
     globals()[_op] = generate_layer_fn(_op)

@@ -1,0 +1,118 @@
+"""lod_rank_table / max_sequence_len / reorder_lod_tensor_by_rank /
+lod_tensor_to_array + array_to_lod_tensor round trips.
+
+Parity model: reference test_lod_rank_table.py, test_reorder_lod_tensor.py,
+test_lod_tensor_array_ops.py — the sorted-by-length machinery under the
+DynamicRNN/While decoder idiom, on the padded-dense layout.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+rng = np.random.RandomState(77)
+
+SEQS = [rng.randn(L, 3).astype("float32") for L in (2, 5, 1, 4)]
+LOD = LoDTensor.from_sequences(SEQS)
+DESC = np.argsort([-len(s) for s in SEQS], kind="stable")   # 1,3,0,2
+
+
+def _run(build, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=list(fetch))
+
+
+def test_max_sequence_len_from_table():
+    def build():
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        return (fluid.layers.max_sequence_len(table),)
+
+    got, = _run(build, {"x": LOD})
+    assert int(np.asarray(got).ravel()[0]) == 5
+
+
+def test_reorder_by_rank_descending_lengths():
+    def build():
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        y = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+        # downstream sequence op must see the PERMUTED lengths
+        first = fluid.layers.sequence_pool(input=y, pool_type="first")
+        last = fluid.layers.sequence_pool(input=y, pool_type="last")
+        return (y, first, last)
+
+    y, first, last = _run(build, {"x": LOD})
+    for row, src in enumerate(DESC):
+        s = SEQS[src]
+        np.testing.assert_allclose(y[row, :len(s)], s, rtol=1e-6)
+        np.testing.assert_allclose(first[row], s[0], rtol=1e-6)
+        np.testing.assert_allclose(last[row], s[-1], rtol=1e-6)
+
+
+def test_lod_tensor_array_round_trip_restores_order():
+    def build():
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        arr = fluid.layers.lod_tensor_to_array(x, table)
+        back = fluid.layers.array_to_lod_tensor(arr, table)
+        tot = fluid.layers.sequence_pool(input=back, pool_type="sum")
+        return (back, tot)
+
+    back, tot = _run(build, {"x": LOD})
+    for i, s in enumerate(SEQS):
+        np.testing.assert_allclose(back[i, :len(s)], s, rtol=1e-6)
+    # note: round-tripped lengths are the array capacity (max len) per row;
+    # data beyond each true length is zero so masked sums still match
+    for i, s in enumerate(SEQS):
+        np.testing.assert_allclose(tot[i], s.sum(0), rtol=1e-5, atol=1e-5)
+
+
+def test_array_read_time_steps_in_rank_order():
+    """array_read(t) gives step t of the rank-sorted batch — the While
+    decoder idiom."""
+    def build():
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        arr = fluid.layers.lod_tensor_to_array(x, table)
+        i0 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        step0 = fluid.layers.array_read(array=arr, i=i0)
+        i1 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=1)
+        step1 = fluid.layers.array_read(array=arr, i=i1)
+        return (step0, step1)
+
+    s0, s1 = _run(build, {"x": LOD})
+    expect0 = np.stack([SEQS[src][0] for src in DESC])
+    np.testing.assert_allclose(s0, expect0, rtol=1e-6)
+    # step 1: rows whose sequence is shorter than 2 carry padding zeros
+    for row, src in enumerate(DESC):
+        s = SEQS[src]
+        if len(s) > 1:
+            np.testing.assert_allclose(s1[row], s[1], rtol=1e-6)
+
+
+def test_shrink_memory_identity_contract():
+    """shrink_memory is identity in the padded-dense design (masking in
+    rnn_scan replaces batch shrinking); shape and values pass through."""
+    def build():
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=1)
+        mem = fluid.layers.fc(input=x, size=4, num_flatten_dims=2,
+                              bias_attr=False)
+        out = fluid.layers.shrink_memory(mem, i, table)
+        return (mem, out)
+
+    mem, out = _run(build, {"x": LOD})
+    np.testing.assert_allclose(out, mem, rtol=0, atol=0)
