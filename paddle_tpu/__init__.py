@@ -22,9 +22,11 @@ from .core.framework import (Program, Operator, Variable, Parameter,
                              switch_startup_program)
 from .core.executor import Executor, Scope, global_scope, scope_guard
 from .core.readers import EOFException
-from .core.backward import append_backward
+from .core.backward import append_backward, calc_gradient
+from .core.framework import Block, get_var
+from .core.executor import switch_scope, fetch_var
 from .core.lod import LoDTensor, create_lod_tensor
-from .core.param_attr import ParamAttr
+from .core.param_attr import ParamAttr, WeightNormParamAttr
 from .core import initializer
 from .core import unique_name
 from .places import CPUPlace, CUDAPlace, TPUPlace, is_compiled_with_cuda, \

@@ -13,11 +13,15 @@ from .framework import Variable, grad_var_name, GRAD_SUFFIX
 from . import registry
 
 
-def _op_path(block, loss_name, no_grad_set):
-    """Ops on a path from any differentiable input to the loss, plus the set
-    of vars that need gradients (parity: backward.py _find_op_path_)."""
+def _op_path(block, loss_name, no_grad_set, force_diff=()):
+    """Ops on a path from any differentiable input to the loss (or losses —
+    pass a set for multiple targets, parity: backward.py _find_op_path_),
+    plus the set of vars that need gradients. Names in `force_diff` are
+    treated as differentiable even if their var says stop_gradient (the
+    calc_gradient explicit-inputs contract)."""
     # backward sweep: vars needing grads
-    needed = {loss_name}
+    needed = set(loss_name) if isinstance(loss_name, (set, frozenset)) \
+        else {loss_name}
     path_flags = [False] * len(block.ops)
     for idx in range(len(block.ops) - 1, -1, -1):
         op = block.ops[idx]
@@ -25,6 +29,9 @@ def _op_path(block, loss_name, no_grad_set):
         if outs & needed:
             path_flags[idx] = True
             for name in op.all_input_vars():
+                if name in force_diff:
+                    needed.add(name)
+                    continue
                 if name in no_grad_set:
                     continue
                 v = block.vars.get(name)
@@ -60,8 +67,31 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                "dtype": loss.dtype},
         infer_shape=False)
 
-    # A var "has a grad" once some consumer's grad op has (started) writing it.
-    has_grad = {loss.name}
+    _backward_sweep(block, path_flags, needed, no_grad, {loss.name}, fwd_len)
+
+    # collect (param, grad) pairs
+    if parameter_list is not None:
+        params = [block.var_recursive(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = [p for p in block.program.all_parameters() if p.trainable]
+    pairs = []
+    for p in params:
+        g = block.vars.get(grad_var_name(p.name))
+        if g is not None and p.name in needed:
+            pairs.append((p, g))
+    return pairs
+
+
+
+def _backward_sweep(block, path_flags, needed, no_grad, seed_names,
+                    fwd_len):
+    """Emit grad_of ops in reverse topological order (shared by
+    append_backward and calc_gradient). seed_names are vars whose @GRAD
+    is already written (the seeded targets)."""
+    # A var "has a grad" once some consumer's grad op has (started)
+    # writing it.
+    has_grad = set(seed_names)
     for idx in range(fwd_len - 1, -1, -1):
         if not path_flags[idx]:
             continue
@@ -139,15 +169,58 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                 if g:
                     has_grad.add(g[:-len(GRAD_SUFFIX)])
 
-    # collect (param, grad) pairs
-    if parameter_list is not None:
-        params = [block.var_recursive(p) if isinstance(p, str) else p
-                  for p in parameter_list]
-    else:
-        params = [p for p in block.program.all_parameters() if p.trainable]
-    pairs = []
-    for p in params:
-        g = block.vars.get(grad_var_name(p.name))
-        if g is not None and p.name in needed:
-            pairs.append((p, g))
-    return pairs
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Backpropagate gradients of `targets` to `inputs` without an optimizer.
+
+    Parity: python/paddle/fluid/backward.py:555 calc_gradient. Appends
+    grad_of ops for the op path from `inputs` to `targets`; each target is
+    seeded with its matching entry of `target_gradients` (ones when None,
+    like the reference's filled loss grad). Returns the list of gradient
+    Variables for `inputs`, with None where a target is unreachable.
+    Unlike stop_gradient vars picked up implicitly, explicitly-passed
+    `inputs` are always treated as differentiable."""
+    targets = list(targets) if isinstance(targets, (list, tuple)) \
+        else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    tgs = list(target_gradients) if target_gradients is not None else \
+        [None] * len(targets)
+    if len(tgs) != len(targets):
+        raise ValueError("target_gradients must match targets (%d vs %d)"
+                         % (len(tgs), len(targets)))
+    block = targets[0].block
+    no_grad = set(no_grad_set or ())
+    for v in block.vars.values():
+        if v.stop_gradient:
+            no_grad.add(v.name)
+    force_diff = {i.name for i in inputs}
+    no_grad -= force_diff
+
+    path_flags, needed = _op_path(
+        block, {t.name for t in targets}, no_grad, force_diff=force_diff)
+    fwd_len = len(block.ops)
+
+    for t, tg in zip(targets, tgs):
+        gname = grad_var_name(t.name)
+        if gname not in block.vars:
+            block.create_var(name=gname, shape=t.shape, dtype=t.dtype)
+        if tg is None:
+            block.append_op(
+                type="fill_constant",
+                outputs={"Out": [block.vars[gname]]},
+                attrs={"shape": list(t.shape or (1,)), "value": 1.0,
+                       "dtype": t.dtype},
+                infer_shape=False)
+        else:
+            block.append_op(
+                type="assign", inputs={"X": [tg]},
+                outputs={"Out": [block.vars[gname]]}, infer_shape=False)
+
+    _backward_sweep(block, path_flags, needed, no_grad,
+                    {t.name for t in targets}, fwd_len)
+
+    grads = []
+    for i in inputs:
+        g = block.vars.get(grad_var_name(i.name))
+        grads.append(g if g is not None and i.name in needed else None)
+    return grads

@@ -109,13 +109,11 @@ import contextlib
 
 @contextlib.contextmanager
 def scope_guard(scope):
-    global _global_scope
-    old = _global_scope
-    _global_scope = scope
+    old = switch_scope(scope)
     try:
         yield
     finally:
-        _global_scope = old
+        switch_scope(old)
 
 
 def _feed_signature(feed):
@@ -341,3 +339,27 @@ def _to_array(value, var=None):
     if var is not None and var.dtype is not None:
         arr = arr.astype(convert_dtype(var.dtype), copy=False)
     return jnp.asarray(arr)
+
+
+def switch_scope(scope):
+    """Swap the process-global scope, returning the previous one
+    (parity: fluid.executor.switch_scope; scope_guard builds on it there)."""
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    return old
+
+
+def fetch_var(name, scope=None, return_numpy=True):
+    """Fetch a variable's value from `scope` (default: the global scope).
+    Parity: fluid.executor.fetch_var."""
+    if scope is None:
+        scope = _global_scope
+    v = scope.find_var(name)
+    if v is None:
+        raise RuntimeError(
+            "cannot find variable %r in the scope; only persistable vars "
+            "survive Executor.run (set persistable=True or fetch it in "
+            "fetch_list)" % name)
+    val = v.get_tensor()
+    return np.asarray(val) if return_numpy else val

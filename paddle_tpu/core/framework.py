@@ -515,3 +515,13 @@ def program_guard(main_program, startup_program=None):
         switch_main_program(old_main)
         if old_startup is not None:
             switch_startup_program(old_startup)
+
+
+def get_var(name, program=None):
+    """Get a variable by name from a program's global block
+    (parity: fluid.framework.get_var)."""
+    if program is None:
+        program = default_main_program()
+    if not isinstance(program, Program):
+        raise TypeError("get_var expects a Program, got %r" % (program,))
+    return program.global_block().var(name)

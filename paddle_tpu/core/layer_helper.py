@@ -104,6 +104,9 @@ class LayerHelper(object):
             attr.name = unique_name.generate(".".join([self.name, "w"]))
 
         shape = [int(s) for s in shape]
+        from .param_attr import WeightNormParamAttr
+        if isinstance(attr, WeightNormParamAttr):
+            return self._create_weight_normalized(attr, shape, dtype)
         main_block = self.main_program.global_block()
         if main_block.has_var(attr.name):
             # shared parameter (same ParamAttr name reused): one init op only,
@@ -123,6 +126,53 @@ class LayerHelper(object):
         # main program: the parameter itself
         return main_block.create_parameter(
             shape=shape, dtype=dtype, **attr.to_kwargs())
+
+    def _create_weight_normalized(self, attr, shape, dtype):
+        """w = g * v/||v|| (parity: reference layer_helper
+        _create_weight_normalize). v keeps the user's initializer; g is a
+        [shape[dim]] (dim=None: [1]) parameter initialized to ||v|| in the
+        startup program so the initial w equals v. The returned w is a
+        derived main-program variable — the trainable parameters are g/v."""
+        from .param_attr import ParamAttr, WeightNormParamAttr
+        main_block = self.main_program.global_block()
+        if main_block.has_var(attr.name):
+            existing = main_block.var(attr.name)   # shared re-use, like params
+            if existing.shape is not None and \
+                    tuple(existing.shape) != tuple(shape):
+                raise ValueError(
+                    "weight-norm parameter %r reused with shape %s but was "
+                    "created with shape %s"
+                    % (attr.name, shape, existing.shape))
+            return existing
+        dim = attr.dim
+        base_kwargs = dict(learning_rate=attr.learning_rate,
+                           regularizer=attr.regularizer,
+                           trainable=attr.trainable,
+                           gradient_clip=attr.gradient_clip)
+        v = self.create_parameter(
+            ParamAttr(name=attr.name + ".wn_v",
+                      initializer=attr.initializer, **base_kwargs),
+            shape=shape, dtype=dtype)
+        g_shape = [shape[dim]] if dim is not None else [1]
+        g = self.create_parameter(
+            ParamAttr(name=attr.name + ".wn_g",
+                      initializer=ConstantInitializer(1.0), **base_kwargs),
+            shape=g_shape, dtype=dtype)
+        # startup: overwrite g's constant init with ||v||
+        startup_block = self.startup_program.global_block()
+        startup_block.append_op(
+            type="wn_norm", inputs={"X": [v.name]},
+            outputs={"Out": [g.name]}, attrs={"dim": dim},
+            infer_shape=False)
+        # main: derived weight
+        w = self.main_program.global_block().create_var(
+            name=attr.name, dtype=dtype)
+        w.shape = tuple(shape)
+        self.main_program.global_block().append_op(
+            type="weight_norm", inputs={"G": [g], "V": [v]},
+            outputs={"Out": [w]}, attrs={"dim": dim})
+        WeightNormParamAttr.params_with_weight_norm.append(w)
+        return w
 
     def create_variable_for_type_inference(self, dtype=None, stop_gradient=False):
         return self.block.create_var(

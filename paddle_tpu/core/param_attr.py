@@ -58,3 +58,22 @@ class ParamAttr(object):
         if with_initializer:
             kwargs["initializer"] = self.initializer
         return kwargs
+
+
+class WeightNormParamAttr(ParamAttr):
+    """Weight normalization (parity: fluid.WeightNormParamAttr,
+    python/paddle/fluid/param_attr.py:90 + layer_helper.py
+    _create_weight_normalize): the parameter is reparameterized as
+    w = g * v / ||v||, with the l2 norm taken over every axis except
+    `dim` (dim=None: one scalar g over the whole tensor). g initializes
+    to ||v|| at startup so the initial w equals the initializer's v.
+    TPU-native: one registered `weight_norm` op instead of the
+    reference's 9-op norm graph; its vjp supplies the g/v gradients."""
+
+    # parameters reparameterized by weight normalization (reference keeps
+    # this list to identify the derived w vars at serialization time)
+    params_with_weight_norm = []
+
+    def __init__(self, dim=None, **kwargs):
+        super(WeightNormParamAttr, self).__init__(**kwargs)
+        self.dim = dim

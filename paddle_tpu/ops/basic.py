@@ -536,3 +536,33 @@ def _l2_normalize(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-12)
     norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
     return {"Out": [x / norm], "Norm": [norm]}
+
+
+def _wn_axes(x, dim):
+    return tuple(i for i in range(x.ndim) if i != dim) if dim is not None \
+        else tuple(range(x.ndim))
+
+
+@register("wn_norm")
+def _wn_norm(ctx, ins, attrs):
+    """||X|| over every axis except attr dim (weight-norm g init)."""
+    x = single(ins, "X")
+    dim = attrs.get("dim")
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=_wn_axes(x, dim)))
+    return _out(n.reshape(-1))
+
+
+@register("weight_norm")
+def _weight_norm(ctx, ins, attrs):
+    """W = G * V / ||V|| (parity: layer_helper.py __weight_normalize —
+    there a 9-op sub-graph; here one op whose jax.vjp yields the G and V
+    gradients)."""
+    g = single(ins, "G")
+    v = single(ins, "V")
+    dim = attrs.get("dim")
+    axes = _wn_axes(v, dim)
+    norm = jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+    scale = g.reshape([v.shape[dim] if (dim is not None and i == dim) else 1
+                       for i in range(v.ndim)]) if dim is not None \
+        else g.reshape((1,) * v.ndim)
+    return _out(v * (scale / jnp.maximum(norm, 1e-12)))

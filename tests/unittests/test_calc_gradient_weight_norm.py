@@ -1,0 +1,157 @@
+"""calc_gradient, WeightNormParamAttr, fetch_var/switch_scope/get_var.
+
+Parity model: reference test_calc_gradient.py, test_weight_normalization.py,
+test_fetch_var.py.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+rng = np.random.RandomState(99)
+
+
+def test_calc_gradient_param():
+    """Reference test_calc_gradient shape: grad of sum(x@w) wrt w."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.reduce_sum(y)
+        (gw,) = fluid.calc_gradient(loss, main.global_block().var("w"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xs = rng.rand(5, 4).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        g, = exe.run(main, feed={"x": xs}, fetch_list=[gw])
+    # d sum(x@w) / dw = x^T @ ones
+    expect = xs.T @ np.ones((5, 3))
+    np.testing.assert_allclose(g, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_calc_gradient_wrt_input_with_seed():
+    """target_gradients seeds the cotangent; grads flow to a data input."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        x.stop_gradient = False
+        y = fluid.layers.square(x)
+        seed = fluid.layers.data(name="s", shape=[3], dtype="float32")
+        (gx,) = fluid.calc_gradient(y, x, target_gradients=[seed])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xs = rng.rand(2, 3).astype("float32")
+    ss = rng.rand(2, 3).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        g, = exe.run(main, feed={"x": xs, "s": ss}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xs * ss, rtol=1e-5, atol=1e-6)
+
+
+def test_calc_gradient_explicit_input_overrides_stop_gradient():
+    """data vars default stop_gradient=True; passing one as `inputs` must
+    still produce its gradient (the documented contract)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        y = fluid.layers.reduce_sum(fluid.layers.square(x))
+        (gx,) = fluid.calc_gradient(y, x)
+    assert gx is not None
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xs = rng.rand(2, 3).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        g, = exe.run(main, feed={"x": xs}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xs, rtol=1e-5, atol=1e-6)
+
+
+def test_calc_gradient_unreachable_is_none():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        z = fluid.layers.data(name="z", shape=[3], dtype="float32")
+        z.stop_gradient = False
+        y = fluid.layers.reduce_sum(fluid.layers.square(x))
+        grads = fluid.calc_gradient(y, [z])
+    assert grads == [None]
+
+
+def test_weight_norm_param_attr():
+    """w = g*v/||v||: initial w equals the initializer's v; g/v are the
+    trainable params; training still converges."""
+    rng = np.random.RandomState(1234)   # own stream: convergence threshold
+    w0 = (rng.randn(4, 2) * 0.7).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        yv = fluid.layers.data(name="y", shape=[2], dtype="float32")
+        p = fluid.layers.fc(
+            input=x, size=2, bias_attr=False,
+            param_attr=fluid.WeightNormParamAttr(
+                dim=1, name="wn",
+                initializer=fluid.initializer.NumpyArrayInitializer(w0)))
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=yv))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # g initialized to per-column ||v||
+        g = np.asarray(scope.get("wn.wn_g"))
+        np.testing.assert_allclose(g, np.sqrt((w0 ** 2).sum(0)), rtol=1e-5)
+        # first forward uses w == w0
+        xs = rng.rand(8, 4).astype("f")
+        w_t = rng.randn(4, 2).astype("f") * 0.5
+        losses = []
+        for i in range(250):
+            l, = exe.run(main, feed={"x": xs, "y": xs @ w_t},
+                         fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+        params = {p.name for p in main.global_block().all_parameters()}
+    assert "wn.wn_g" in params and "wn.wn_v" in params and "wn" not in params
+    assert losses[-1] < 0.05 * losses[0]
+
+
+def test_weight_norm_scalar_dim():
+    w0 = (rng.randn(3, 3) * 0.5).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        p = fluid.layers.fc(
+            input=x, size=3, bias_attr=False,
+            param_attr=fluid.WeightNormParamAttr(
+                dim=None, name="wns",
+                initializer=fluid.initializer.NumpyArrayInitializer(w0)))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xs = rng.rand(2, 3).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": xs}, fetch_list=[p])
+        g = np.asarray(scope.get("wns.wn_g"))
+    np.testing.assert_allclose(g, [np.sqrt((w0 ** 2).sum())], rtol=1e-5)
+    np.testing.assert_allclose(out, xs @ w0, rtol=1e-4, atol=1e-5)
+
+
+def test_fetch_var_and_switch_scope():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        fluid.layers.create_parameter(
+            shape=[2, 2], dtype="float32", name="pv",
+            attr=fluid.ParamAttr(
+                initializer=fluid.initializer.Constant(1.5)))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    old = fluid.switch_scope(scope)
+    try:
+        exe.run(startup)
+        got = fluid.fetch_var("pv")
+        np.testing.assert_allclose(got, np.full((2, 2), 1.5), atol=0)
+    finally:
+        fluid.switch_scope(old)
+    # get_var finds the program variable
+    v = fluid.get_var("pv", main)
+    assert v.name == "pv" and tuple(v.shape) == (2, 2)
