@@ -16,7 +16,40 @@ import time
 import numpy as np
 
 
+def _await_devices(timeout_s):
+    """Device init probe with a watchdog: the axon tunnel can wedge with a
+    never-returning claim RPC; better one JSON error line than a silent
+    hang past the driver's patience."""
+    import threading
+    out = {}
+
+    def probe():
+        try:
+            import jax
+            out["devices"] = jax.devices()
+        except Exception as e:       # noqa: BLE001 - reported in JSON
+            out["error"] = repr(e)
+
+    def fail(msg):
+        print(json.dumps({
+            "metric": "resnet50_imagenet_train_throughput",
+            "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+            "error": msg}))
+        sys.exit(3)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        fail("device init did not return within %ds (TPU tunnel wedged?)"
+             % timeout_s)
+    if "devices" not in out:
+        fail(out.get("error", "device probe thread died without a result"))
+    return out["devices"]
+
+
 def main():
+    _await_devices(int(os.environ.get("BENCH_DEVICE_TIMEOUT", "600")))
     import jax
     import paddle_tpu as fluid
     from paddle_tpu.models.image_classification import build_train
