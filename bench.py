@@ -35,7 +35,9 @@ def _await_devices(timeout_s):
             "metric": "resnet50_imagenet_train_throughput",
             "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
             "error": msg}))
-        sys.exit(3)
+        sys.stdout.flush()
+        # skip atexit: jax teardown can block on the same wedged runtime
+        os._exit(3)
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
@@ -55,8 +57,8 @@ def main():
     from paddle_tpu.models.image_classification import build_train
 
     batch = int(os.environ.get("BENCH_BATCH", "256"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "20")))
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "5")))
     dtype = os.environ.get("BENCH_DTYPE", "bf16")  # bf16 | fp32
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
     # smoke-run knobs (defaults = the headline config)

@@ -1,0 +1,145 @@
+"""Gradient and mode coverage for ops whose grads had no dedicated test:
+elementwise min/max, matmul transposes, dropout eval mode, embedding
+padding_idx, one_hot boundary, cast dtype matrix, reduce keepdim grads.
+
+Parity model: the reference's per-op OpTest grad checks
+(test_elementwise_max_op.py etc.), via finite differences through the
+executor.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import check_grad_fd, run_op
+
+rng = np.random.RandomState(123)
+
+
+@pytest.mark.parametrize("op", ["elementwise_max", "elementwise_min"])
+def test_elementwise_minmax_grads(op):
+    # keep operands clear of ties so the subgradient is unambiguous
+    x = rng.rand(3, 4).astype("float32")
+    y = (x + ((rng.rand(3, 4) > 0.5) * 2 - 1) * 0.3).astype("float32")
+    check_grad_fd(op, {"X": x, "Y": y}, "X")
+    check_grad_fd(op, {"X": x, "Y": y}, "Y")
+
+
+@pytest.mark.parametrize("tx,ty", [(False, True), (True, False),
+                                   (True, True)])
+def test_matmul_transpose_grads(tx, ty):
+    a = rng.randn(*(4, 3) if tx else (3, 4)).astype("float32")
+    b = rng.randn(*(5, 4) if ty else (4, 5)).astype("float32")
+    attrs = {"transpose_X": tx, "transpose_Y": ty}
+    check_grad_fd("matmul", {"X": a, "Y": b}, "X", attrs=attrs)
+    check_grad_fd("matmul", {"X": a, "Y": b}, "Y", attrs=attrs)
+
+
+def test_dropout_eval_mode_downscales():
+    """Era semantics are downgrade_in_infer: test-time out = x*(1-p)
+    (reference dropout_op.h), NOT identity."""
+    x = rng.randn(4, 6).astype("float32")
+    got, = run_op("dropout", {"X": x},
+                  attrs={"dropout_prob": 0.7, "is_test": True},
+                  out_slots=("Out",))
+    np.testing.assert_allclose(got, x * 0.3, rtol=1e-6, atol=1e-7)
+
+
+def test_dropout_train_scales_survivors():
+    """The reference's downgrade-in-infer implementation keeps survivors
+    unscaled at train time (output == x where kept, 0 where dropped)."""
+    x = np.ones((200, 50), dtype="float32")
+    got, = run_op("dropout", {"X": x},
+                  attrs={"dropout_prob": 0.4, "is_test": False},
+                  out_slots=("Out",))
+    got = np.asarray(got)
+    vals = np.unique(np.round(got, 5))
+    assert set(vals.tolist()) <= {0.0, 1.0}
+    keep = (got != 0).mean()
+    assert abs(keep - 0.6) < 0.05
+
+
+def test_embedding_padding_idx_zero_row():
+    vocab, dim = 7, 4
+    table = rng.randn(vocab, dim).astype("float32")
+    ids = np.array([[1], [3], [0]], dtype="int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        iv = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            input=iv, size=[vocab, dim], padding_idx=3,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(table)))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, = exe.run(main, feed={"ids": ids}, fetch_list=[emb])
+    got = np.asarray(got).reshape(3, dim)
+    np.testing.assert_allclose(got[0], table[1], rtol=1e-6)
+    np.testing.assert_allclose(got[1], np.zeros(dim), atol=0)
+    np.testing.assert_allclose(got[2], table[0], rtol=1e-6)
+
+
+def test_embedding_negative_padding_idx():
+    vocab, dim = 5, 3
+    table = rng.randn(vocab, dim).astype("float32")
+    ids = np.array([[4]], dtype="int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        iv = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            input=iv, size=[vocab, dim], padding_idx=-1,   # == 4
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(table)))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, = exe.run(main, feed={"ids": ids}, fetch_list=[emb])
+    np.testing.assert_allclose(np.asarray(got).reshape(dim),
+                               np.zeros(dim), atol=0)
+
+
+def test_one_hot_boundary_indices():
+    ids = np.array([[0], [4], [2]], dtype="int64")
+    got, = run_op("one_hot", {"X": ids}, attrs={"depth": 5})
+    expect = np.zeros((3, 5), dtype="float32")
+    expect[[0, 1, 2], [0, 4, 2]] = 1
+    np.testing.assert_allclose(np.asarray(got).reshape(3, 5), expect,
+                               atol=0)
+
+
+@pytest.mark.parametrize("src,dst", [
+    ("float32", "int32"), ("int32", "float32"), ("float32", "bool"),
+    ("int64", "float32"), ("float32", "float64"), ("bool", "float32")])
+def test_cast_dtype_matrix(src, dst):
+    if src == "bool":
+        x = (rng.rand(3, 3) > 0.5)
+    else:
+        x = (rng.rand(3, 3) * 7).astype(src)
+    got, = run_op("cast", {"X": x.astype(src)},
+                  attrs={"in_dtype": src, "out_dtype": dst})
+    got = np.asarray(got)
+    assert str(got.dtype) == dst or (dst == "float64" and
+                                     str(got.dtype) == "float32")  # x64 off
+    np.testing.assert_allclose(got.astype("float64"),
+                               x.astype(dst).astype("float64"), rtol=1e-6)
+
+
+@pytest.mark.parametrize("keepdim", [False, True])
+def test_reduce_sum_grad_keepdim(keepdim):
+    x = rng.randn(3, 4).astype("float32")
+    check_grad_fd("reduce_sum", {"X": x}, "X",
+                  attrs={"dim": [1], "keep_dim": keepdim})
+
+
+def test_reduce_max_grad_routes_to_argmax():
+    x = np.array([[1.0, 5.0, 2.0], [7.0, 3.0, 4.0]], dtype="float32")
+    got = run_op("reduce_max", {"X": x}, attrs={"dim": [1]},
+                 fetch_grads=("X",))
+    gx = np.asarray(got[-1])
+    expect = np.zeros_like(x)
+    expect[0, 1] = 1
+    expect[1, 0] = 1
+    np.testing.assert_allclose(gx, expect, atol=1e-6)
