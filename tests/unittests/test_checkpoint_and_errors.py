@@ -1,0 +1,126 @@
+"""Checkpoint/resume equivalence and executor error paths.
+
+Parity model: reference fluid.io checkpoint utilities + reference
+test_exception.py-style negative checks through the real executor.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+rng = np.random.RandomState(31)
+
+
+def _build(seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="tanh")
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def test_checkpoint_resume_bit_equivalence(tmp_path):
+    """train 4 + checkpoint + train 4 more == resume-from-checkpoint +
+    train the same 4: identical params AND identical Adam state."""
+    r = np.random.RandomState(7)
+    w = r.randn(6, 1).astype("f")
+    data = [r.rand(16, 6).astype("f") for _ in range(8)]
+
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe.run(startup)
+        for xb in data[:4]:
+            exe.run(main, feed={"x": xb, "y": xb @ w}, fetch_list=[loss])
+        fluid.io.save_checkpoint(exe, str(tmp_path), main, step=4)
+        for xb in data[4:]:
+            exe.run(main, feed={"x": xb, "y": xb @ w}, fetch_list=[loss])
+        final_a = {n: np.asarray(scope_a.get(n)) for n in scope_a.names()}
+
+    # fresh process-equivalent: new scope, startup, then load
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe.run(startup)
+        step = fluid.io.load_checkpoint(exe, str(tmp_path), main)
+        assert step == 4
+        for xb in data[4:]:
+            exe.run(main, feed={"x": xb, "y": xb @ w}, fetch_list=[loss])
+        final_b = {n: np.asarray(scope_b.get(n)) for n in scope_b.names()}
+
+    for name, va in final_a.items():
+        if name.startswith("@"):   # internal counters may differ
+            continue
+        vb = final_b.get(name)
+        assert vb is not None, "missing %r after resume" % name
+        if va.dtype.kind == "f":
+            np.testing.assert_allclose(
+                va, vb, rtol=1e-6, atol=1e-7,
+                err_msg="state %r diverged after resume" % name)
+
+
+def test_load_checkpoint_empty_dir_returns_none(tmp_path):
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        assert fluid.io.load_checkpoint(exe, str(tmp_path), main) is None
+
+
+def test_run_main_before_startup_raises():
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xb = rng.rand(4, 6).astype("f")
+    with fluid.scope_guard(scope):
+        with pytest.raises(RuntimeError, match="startup"):
+            exe.run(main, feed={"x": xb, "y": xb[:, :1]},
+                    fetch_list=[loss])
+
+
+def test_fetch_unknown_var_raises():
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xb = rng.rand(4, 6).astype("f")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises((KeyError, RuntimeError)):
+            exe.run(main, feed={"x": xb, "y": xb[:, :1]},
+                    fetch_list=["no_such_var"])
+
+
+def test_feed_dtype_coercion_and_batch_change():
+    """float64 feeds coerce silently (by design); changing the batch size
+    between runs recompiles and still works."""
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for bs in (8, 16, 8):
+            xb = rng.rand(bs, 6).astype("float64")   # not float32
+            l, = exe.run(main, feed={"x": xb, "y": xb[:, :1]},
+                         fetch_list=[loss])
+            assert np.isfinite(np.asarray(l)).all()
+
+
+def test_wrong_feature_dim_raises():
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xb = rng.rand(4, 9).astype("f")   # feature dim 9 != 6
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(Exception):
+            exe.run(main, feed={"x": xb, "y": xb[:, :1]},
+                    fetch_list=[loss])

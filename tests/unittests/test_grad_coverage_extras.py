@@ -1,6 +1,6 @@
 """Gradient and mode coverage for ops whose grads had no dedicated test:
-elementwise min/max, matmul transposes, dropout eval mode, embedding
-padding_idx, one_hot boundary, cast dtype matrix, reduce keepdim grads.
+elementwise min/max, matmul transposes, embedding padding_idx, one_hot
+boundary, cast dtype matrix, reduce keepdim grads.
 
 Parity model: the reference's per-op OpTest grad checks
 (test_elementwise_max_op.py etc.), via finite differences through the
@@ -32,30 +32,6 @@ def test_matmul_transpose_grads(tx, ty):
     attrs = {"transpose_X": tx, "transpose_Y": ty}
     check_grad_fd("matmul", {"X": a, "Y": b}, "X", attrs=attrs)
     check_grad_fd("matmul", {"X": a, "Y": b}, "Y", attrs=attrs)
-
-
-def test_dropout_eval_mode_downscales():
-    """Era semantics are downgrade_in_infer: test-time out = x*(1-p)
-    (reference dropout_op.h), NOT identity."""
-    x = rng.randn(4, 6).astype("float32")
-    got, = run_op("dropout", {"X": x},
-                  attrs={"dropout_prob": 0.7, "is_test": True},
-                  out_slots=("Out",))
-    np.testing.assert_allclose(got, x * 0.3, rtol=1e-6, atol=1e-7)
-
-
-def test_dropout_train_scales_survivors():
-    """The reference's downgrade-in-infer implementation keeps survivors
-    unscaled at train time (output == x where kept, 0 where dropped)."""
-    x = np.ones((200, 50), dtype="float32")
-    got, = run_op("dropout", {"X": x},
-                  attrs={"dropout_prob": 0.4, "is_test": False},
-                  out_slots=("Out",))
-    got = np.asarray(got)
-    vals = np.unique(np.round(got, 5))
-    assert set(vals.tolist()) <= {0.0, 1.0}
-    keep = (got != 0).mean()
-    assert abs(keep - 0.6) < 0.05
 
 
 def test_embedding_padding_idx_zero_row():
