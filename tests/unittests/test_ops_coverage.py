@@ -399,8 +399,12 @@ def test_dropout_layer_statistics():
         f.append(fluid.layers.dropout(xv, dropout_prob=0.3))
 
     out, = _run_layers(build_train, feed={"x": x})
-    kept = (np.asarray(out) != 0).mean()
+    out = np.asarray(out)
+    kept = (out != 0).mean()
     assert abs(kept - 0.7) < 0.06, kept  # mask keeps ~70%
+    # downgrade_in_infer: train-time survivors stay UNSCALED (== x, not
+    # x/(1-p)); with x==1 every value must be exactly 0 or 1
+    assert set(np.unique(np.round(out, 5)).tolist()) <= {0.0, 1.0}
 
     def build_test(f):
         xv = fluid.layers.data(name="x", shape=[40], dtype="float32")
