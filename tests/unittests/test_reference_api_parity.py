@@ -1,0 +1,122 @@
+"""Executable API-parity contract: every public name the reference exports
+must resolve here.
+
+The name lists are frozen snapshots of the reference's __all__ lists
+(python/paddle/fluid/*.py + layers/*.py + v2/, PaddlePaddle ~v0.11). If a
+name is deliberately a scope-cut placeholder it must still resolve (with a
+curated error on use) so reference scripts fail actionably.
+"""
+import paddle_tpu as fluid
+import paddle_tpu.v2 as paddle_v2
+
+# python/paddle/fluid/layers/*.py __all__ union (reference snapshot)
+REFERENCE_LAYERS = """
+BlockGuard BlockGuardServ BlockGuardWithCompletion ConditionalBlock
+DynamicRNN IfElse ListenAndServ ParallelDo Print Select Send StaticRNN
+StaticRNNMemoryLink Switch While WhileGuard accuracy array_length
+array_read array_to_lod_tensor array_write assign autodoc
+autoincreased_step_counter batch_norm beam_search beam_search_decode
+bipartite_match cast chunk_eval clip clip_by_norm concat conv2d
+conv2d_transpose cos_sim create_array create_double_buffer_reader
+create_global_var create_multi_pass_reader create_parameter
+create_shuffle_reader create_tensor crf_decoding cross_entropy
+ctc_greedy_decoder cumsum data deprecated detection_map detection_output
+dropout dynamic_gru dynamic_lstm dynamic_lstmp edit_distance
+elementwise_add elementwise_div elementwise_max elementwise_min
+elementwise_mul elementwise_pow elementwise_sub embedding equal
+exponential_decay fc fill_constant fill_constant_batch_size_like
+gaussian_random gaussian_random_batch_size_like generate_layer_fn
+get_places gru_unit im2sequence increment inverse_time_decay l2_normalize
+layer_norm less_than linear_chain_crf lod_rank_table lod_reset
+lod_tensor_to_array logical_and logical_not logical_or logical_xor
+lstm_unit matmul max_sequence_len mean merge_lod_tensor
+monkey_patch_variable mul multi_box_head multiplex natural_exp_decay nce
+one_hot ones open_files open_recordio_file piecewise_decay
+polynomial_decay pool2d read_file reduce_max reduce_mean reduce_min
+reduce_prod reduce_sum reorder_lod_tensor_by_rank reshape row_conv scale
+scatter sequence_conv sequence_expand sequence_first_step
+sequence_last_step sequence_pool sequence_reshape sequence_softmax
+shrink_memory sigmoid_cross_entropy_with_logits smooth_l1 softmax
+softmax_with_cross_entropy split split_lod_tensor square_error_cost
+ssd_loss sum sums target_assign topk transpose uniform_random
+uniform_random_batch_size_like warpctc zeros
+""".split()
+
+# module-level __all__ snapshots
+REFERENCE_MODULES = {
+    "optimizer": ["SGD", "Momentum", "Adagrad", "Adam", "Adamax",
+                  "DecayedAdagrad", "Adadelta", "ModelAverage"],
+    "initializer": ["Constant", "Uniform", "Normal", "Xavier",
+                    "force_init_on_cpu", "init_on_cpu"],
+    "regularizer": ["append_regularization_ops", "L1Decay", "L2Decay"],
+    "clip": ["ErrorClipByValue", "GradientClipByValue",
+             "GradientClipByNorm", "GradientClipByGlobalNorm",
+             "append_gradient_clip_ops", "error_clip_callback"],
+    "io": ["save_vars", "save_params", "save_persistables", "load_vars",
+           "load_params", "load_persistables", "save_inference_model",
+           "load_inference_model", "get_inference_program"],
+    "evaluator": ["Accuracy", "ChunkEvaluator", "EditDistance",
+                  "DetectionMAP"],
+    "nets": ["simple_img_conv_pool", "sequence_conv_pool", "glu",
+             "scaled_dot_product_attention"],
+    "profiler": ["cuda_profiler", "reset_profiler", "profiler"],
+    "backward": ["append_backward", "calc_gradient"],
+    "default_scope_funcs": ["get_cur_scope", "enter_local_scope",
+                            "leave_local_scope", "var", "find_var",
+                            "scoped_function"],
+    "concurrency": ["make_channel", "channel_send", "channel_recv",
+                    "channel_close", "Select"],
+}
+
+REFERENCE_TOP_LEVEL = """
+Block Variable Program Operator default_startup_program
+default_main_program program_guard switch_startup_program
+switch_main_program get_var Executor global_scope scope_guard switch_scope
+fetch_var ParamAttr WeightNormParamAttr CPUPlace CUDAPlace DataFeeder
+DistributeTranspiler SimpleDistributeTranspiler ParallelExecutor
+LoDTensor create_lod_tensor memory_optimize release_memory
+append_backward calc_gradient Scope EOFException unique_name
+""".split()
+
+REFERENCE_V2 = ["dataset", "reader", "batch", "layer", "activation",
+                "attr", "data_type", "pooling", "networks", "optimizer",
+                "parameters", "trainer", "event", "inference", "infer",
+                "topology", "minibatch", "image", "data_feeder",
+                "evaluator"]
+
+
+def test_layers_names_resolve():
+    missing = [n for n in REFERENCE_LAYERS
+               if not hasattr(fluid.layers, n)]
+    assert not missing, "layers missing: %s" % missing
+
+
+def test_module_names_resolve():
+    missing = []
+    for mod, names in REFERENCE_MODULES.items():
+        m = getattr(fluid, mod)
+        missing += ["%s.%s" % (mod, n) for n in names if not hasattr(m, n)]
+    assert not missing, "module names missing: %s" % missing
+
+
+def test_top_level_names_resolve():
+    missing = [n for n in REFERENCE_TOP_LEVEL if not hasattr(fluid, n)]
+    assert not missing, "top-level missing: %s" % missing
+
+
+def test_v2_names_resolve():
+    missing = [n for n in REFERENCE_V2 if not hasattr(paddle_v2, n)]
+    assert not missing, "v2 missing: %s" % missing
+
+
+def test_reader_decorators_resolve():
+    for n in ["batch", "shuffle", "buffered", "compose", "chain",
+              "map_readers", "xmap_readers", "firstn"]:
+        assert hasattr(fluid.reader, n), "reader.%s missing" % n
+
+
+def test_datasets_resolve():
+    for n in ["uci_housing", "mnist", "cifar", "imdb", "imikolov",
+              "movielens", "conll05", "wmt14", "wmt16", "mq2007",
+              "sentiment", "flowers", "voc2012"]:
+        assert hasattr(fluid.datasets, n), "datasets.%s missing" % n

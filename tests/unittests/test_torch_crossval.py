@@ -166,3 +166,48 @@ def test_lstm_vs_torch():
         ref, _ = lstm(torch.from_numpy(xs))
     np.testing.assert_allclose(got[:, :T], ref.detach().numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_train_output_vs_torch():
+    """Train-mode normalized output (biased batch stats) matches
+    F.batch_norm(training=True). Running-stat update conventions differ
+    (torch blends unbiased var) and are asserted separately in
+    test_conv_bn_deep.py against the reference's own formula."""
+    c = 3
+    x = rng.randn(4, c, 5, 5).astype("float32") * 2 + 1
+    scale = (rng.rand(c) + 0.5).astype("float32")
+    bias = rng.randn(c).astype("float32")
+    got, = run_op(
+        "batch_norm",
+        {"X": x, "Scale": scale, "Bias": bias,
+         "Mean": np.zeros(c, "float32"), "Variance": np.ones(c, "float32")},
+        attrs={"epsilon": 1e-5, "momentum": 0.9, "is_test": False},
+        out_slots=("Y",))
+    ref = F.batch_norm(
+        torch.from_numpy(x), torch.zeros(c), torch.ones(c),
+        torch.from_numpy(scale), torch.from_numpy(bias),
+        training=True, eps=1e-5).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_smooth_l1_vs_torch():
+    """sigma=1: fluid smooth_l1 == rowwise-summed torch smooth_l1_loss."""
+    x = rng.randn(4, 6).astype("float32") * 2
+    y = rng.randn(4, 6).astype("float32")
+    got, = run_op("smooth_l1_loss", {"X": x, "Y": y}, attrs={"sigma": 1.0})
+    ref = F.smooth_l1_loss(torch.from_numpy(x), torch.from_numpy(y),
+                           reduction="none").numpy().sum(1, keepdims=True)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_nll_losses_vs_torch():
+    """softmax_with_cross_entropy == torch cross_entropy (per-sample)."""
+    logits = rng.randn(6, 9).astype("float32")
+    labels = rng.randint(0, 9, (6, 1)).astype("int64")
+    got = run_op("softmax_with_cross_entropy",
+                 {"Logits": logits, "Label": labels},
+                 out_slots=("Loss",), attrs={})[0]
+    ref = F.cross_entropy(torch.from_numpy(logits),
+                          torch.from_numpy(labels.ravel()),
+                          reduction="none").numpy().reshape(-1, 1)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
