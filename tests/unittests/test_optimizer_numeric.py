@@ -159,3 +159,42 @@ def test_ftrl():
         return (np.clip(new_lin, -l1, l1) - new_lin) / denom
     _check(lambda: fluid.optimizer.Ftrl(learning_rate=LR, l1=l1, l2=l2),
            upd)
+
+
+def test_model_average_apply_restore():
+    """ModelAverage: apply() swaps params for their running window average
+    (sum of post-update values / step count), restore puts them back.
+    Parity: fluid.optimizer.ModelAverage / average_accumulates_op."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        p = fluid.layers.fc(input=x, size=1, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(average_window_rate=0.5)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    r = np.random.RandomState(4)
+    w_true = r.randn(3, 1).astype("f")
+    snapshots = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(5):
+            xb = r.rand(8, 3).astype("f")
+            exe.run(main, feed={"x": xb, "y": xb @ w_true},
+                    fetch_list=[loss])
+            snapshots.append(np.asarray(scope.get("w")).copy())
+        w_now = np.asarray(scope.get("w")).copy()
+        with ma.apply(exe):
+            w_avg = np.asarray(scope.get("w")).copy()
+        w_back = np.asarray(scope.get("w"))
+    expect_avg = np.mean(snapshots, axis=0)
+    np.testing.assert_allclose(w_avg, expect_avg, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(w_back, w_now)   # restored
+    assert not np.allclose(w_avg, w_now)           # average != last
