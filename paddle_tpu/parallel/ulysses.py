@@ -1,0 +1,69 @@
+"""All-to-all (DeepSpeed-Ulysses-style) sequence/context parallelism.
+
+The complement of ring attention (SURVEY.md §2 long-context: "ring
+attention or all-to-all sequence/context parallelism"): instead of rotating
+K/V blocks around the `sp` ring, ONE all_to_all over ICI re-shards the
+activations from sequence-sharded [B, T/sp, H, D] to head-sharded
+[B, T, H/sp, D]; every chip then runs plain dense attention over the FULL
+sequence for its head group, and a final all_to_all restores the sequence
+sharding. Four all_to_all ops total per attention (q/k/v in, output back)
+in two communication phases (vs sp-1 ppermute hops for the ring) at the
+cost of requiring heads % sp == 0 — the standard trade: Ulysses when heads
+are plentiful, ring when sequence is extreme.
+
+The reference (March 2018) has no attention parallelism; this is TPU-first
+design, not parity.
+"""
+import functools
+
+from jax import lax
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from .mesh import P
+from .ring_attention import attention_reference, sequence_parallel_specs
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Per-shard body (use inside shard_map): q/k/v are the local
+    sequence shards [B, T/sp, H, D]; heads must divide by the axis size."""
+    sp = lax.axis_size(axis_name) if hasattr(lax, "axis_size") \
+        else lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % sp != 0:
+        raise ValueError(
+            "ulysses_attention needs heads %% sp == 0 (got %d heads over "
+            "sp=%d); use ring_attention for head-scarce long-context" %
+            (h, sp))
+
+    def seq_to_heads(x):
+        # [B, T/sp, H, D] -> [B, T, H/sp, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, causal=False, scale=None,
+                              batch_axis="dp", seq_axis="sp"):
+    """Global-view entry: full (or GSPMD-sharded) [B, T, H, D] arrays;
+    shard_map splits over (dp, sp) and runs the all-to-all attention."""
+    if batch_axis in mesh.axis_names:
+        spec = sequence_parallel_specs(batch_axis, seq_axis)
+    else:
+        spec = P(None, seq_axis, None, None)
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis_name=seq_axis,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
