@@ -9,7 +9,7 @@ fan-out (the reference's inserted sum_op after @RENAME@ bookkeeping) is
 handled by emitting grad ops in reverse topological order and accumulating
 into <var>@GRAD at lowering time.
 """
-from .framework import Variable, grad_var_name, GRAD_SUFFIX
+from .framework import grad_var_name, GRAD_SUFFIX
 from . import registry
 
 

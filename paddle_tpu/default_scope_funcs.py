@@ -7,7 +7,7 @@ kid scope that is dropped afterwards.
 """
 import threading
 
-from .core.executor import Scope, global_scope
+from .core.executor import global_scope
 
 __tl_scope__ = threading.local()
 

@@ -37,7 +37,17 @@ def device_count():
 def make_mesh(axes, devices=None):
     """axes: dict axis_name -> size (use -1 once for 'remaining devices')."""
     devices = devices if devices is not None else jax.devices()
-    sizes = dict(axes)
+    import numbers
+    try:
+        sizes = {k: int(v) for k, v in dict(axes).items()
+                 if isinstance(v, numbers.Integral)}
+        ok = len(sizes) == len(dict(axes))
+    except (TypeError, ValueError):
+        ok = False
+    if not ok:
+        raise TypeError(
+            "make_mesh expects {axis_name: size} (e.g. {'dp': -1} or "
+            "{'dp': 4, 'mp': 2}), got %r" % (axes,))
     if any(s < 1 and s != -1 for s in sizes.values()) \
             or list(sizes.values()).count(-1) > 1:
         raise ValueError("make_mesh: axis sizes must be positive, with at "

@@ -128,17 +128,22 @@ def sequence_parallel_specs(batch_axis="dp", seq_axis="sp"):
     return P(batch_axis, seq_axis, None, None)
 
 
+def sp_spec_for_mesh(mesh, batch_axis, seq_axis):
+    """The [B,T,H,D] PartitionSpec for an SP entry point on `mesh`: batch
+    over batch_axis when the mesh has one, sequence over seq_axis. Shared
+    by ring_attention_sharded and ulysses_attention_sharded."""
+    if batch_axis in mesh.axis_names:
+        return sequence_parallel_specs(batch_axis, seq_axis), \
+            (batch_axis, seq_axis)
+    return P(None, seq_axis, None, None), (seq_axis,)
+
+
 def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None,
                            batch_axis="dp", seq_axis="sp"):
     """Global-view ring attention: q,k,v are full [B,T,H,D] arrays (or GSPMD
     -sharded); shard_map splits them over (dp, sp) and runs the ring.
     """
-    if batch_axis in mesh.axis_names:
-        spec = sequence_parallel_specs(batch_axis, seq_axis)
-        vary_axes = (batch_axis, seq_axis)
-    else:
-        spec = P(None, seq_axis, None, None)
-        vary_axes = (seq_axis,)
+    spec, vary_axes = sp_spec_for_mesh(mesh, batch_axis, seq_axis)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
                           scale=scale, vary_axes=vary_axes),

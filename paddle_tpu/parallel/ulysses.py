@@ -22,8 +22,7 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-from .mesh import P
-from .ring_attention import attention_reference, sequence_parallel_specs
+from .ring_attention import attention_reference, sp_spec_for_mesh
 
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
@@ -58,10 +57,7 @@ def ulysses_attention_sharded(q, k, v, mesh, causal=False, scale=None,
                               batch_axis="dp", seq_axis="sp"):
     """Global-view entry: full (or GSPMD-sharded) [B, T, H, D] arrays;
     shard_map splits over (dp, sp) and runs the all-to-all attention."""
-    if batch_axis in mesh.axis_names:
-        spec = sequence_parallel_specs(batch_axis, seq_axis)
-    else:
-        spec = P(None, seq_axis, None, None)
+    spec, _ = sp_spec_for_mesh(mesh, batch_axis, seq_axis)
     fn = shard_map(
         functools.partial(ulysses_attention, axis_name=seq_axis,
                           causal=causal, scale=scale),

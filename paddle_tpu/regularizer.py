@@ -3,7 +3,7 @@
 Parity: python/paddle/fluid/regularizer.py — append_regularization_ops adds
 the decay term onto each parameter's gradient before the optimizer op.
 """
-from .core.framework import Parameter
+
 
 __all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer",
            "append_regularization_ops"]
