@@ -1,0 +1,105 @@
+"""Model-level long-context training on a dp×sp mesh: a small
+attention-block model whose attention runs through ring attention or
+Ulysses all-to-all, trained for real (loss decreases), with gradients
+matching the dense single-device model.
+
+This is the long-context story end-to-end: sequence sharded over `sp`,
+batch over `dp`, attention exact, training step jitted over the mesh.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import (make_mesh, ring_attention_sharded,
+                                 ulysses_attention_sharded,
+                                 attention_reference, NamedSharding, P)
+
+
+B, T, H, D = 4, 32, 4, 6
+
+
+def _init(seed=77):
+    r = np.random.RandomState(seed)
+    return {
+        "wqkv": (r.randn(H * D, 3 * H * D) * 0.08).astype("float32"),
+        "wo": (r.randn(H * D, H * D) * 0.08).astype("float32"),
+    }
+
+
+def _model(params, x, attend):
+    qkv = x @ params["wqkv"]
+    q, k, v = jnp.split(qkv.reshape(B, T, H, 3 * D), 3, axis=-1)
+    o = attend(q, k, v)
+    return o.reshape(B, T, H * D) @ params["wo"]
+
+
+def _loss(params, x, tgt, attend):
+    return jnp.mean((_model(params, x, attend) - tgt) ** 2)
+
+
+@pytest.mark.parametrize("flavor", ["ring", "ulysses"])
+def test_long_context_training_loss_decreases(flavor):
+    rng = np.random.RandomState(7)   # same data for both flavors
+    mesh = make_mesh({"dp": 2, "sp": 4}, jax.devices())
+    xh = rng.randn(B, T, H * D).astype("f") * 0.5
+    # teacher-student: targets from the same architecture with other params,
+    # so the student can actually fit them
+    teacher = {
+        "wqkv": (rng.randn(H * D, 3 * H * D) * 0.08).astype("float32"),
+        "wo": (rng.randn(H * D, H * D) * 0.08).astype("float32"),
+    }
+    tgt_h = np.asarray(_model(
+        teacher, jnp.asarray(xh),
+        lambda q, k, v: attention_reference(q, k, v, causal=True)))
+    x = jax.device_put(xh, NamedSharding(mesh, P("dp", "sp")))
+    tgt = jax.device_put(tgt_h, NamedSharding(mesh, P("dp", "sp")))
+    params = _init()
+
+    def attend(q, k, v):
+        fn = ring_attention_sharded if flavor == "ring" \
+            else ulysses_attention_sharded
+        return fn(q, k, v, mesh, causal=True)
+
+    vel = {k_: jnp.zeros_like(v) for k_, v in params.items()}
+
+    @jax.jit
+    def step(p, vel, x, tgt):
+        with mesh:
+            l, g = jax.value_and_grad(
+                lambda p: _loss(p, x, tgt, attend))(p)
+        vel = {k_: 0.9 * vel[k_] + g[k_] for k_ in p}
+        return l, {k_: p[k_] - 1.0 * vel[k_] for k_ in p}, vel
+
+    losses = []
+    for _ in range(120):
+        l, params, vel = step(params, vel, x, tgt)
+        losses.append(float(l))
+    assert losses[-1] < 0.25 * losses[0], losses[::30]
+
+
+@pytest.mark.parametrize("flavor", ["ring", "ulysses"])
+def test_long_context_grads_match_dense(flavor):
+    rng = np.random.RandomState(11)
+    mesh = make_mesh({"dp": 2, "sp": 4}, jax.devices())
+    x = rng.randn(B, T, H * D).astype("f") * 0.5
+    tgt = rng.randn(B, T, H * D).astype("f") * 0.2
+    params = _init()
+
+    def attend_sp(q, k, v):
+        fn = ring_attention_sharded if flavor == "ring" \
+            else ulysses_attention_sharded
+        return fn(q, k, v, mesh, causal=True)
+
+    def attend_dense(q, k, v):
+        return attention_reference(q, k, v, causal=True)
+
+    with mesh:
+        gs = jax.jit(jax.grad(
+            lambda p: _loss(p, x, tgt, attend_sp)))(params)
+    gd = jax.grad(lambda p: _loss(p, x, tgt, attend_dense))(params)
+    for k_ in params:
+        np.testing.assert_allclose(
+            np.asarray(gs[k_]), np.asarray(gd[k_]), rtol=5e-4, atol=5e-5,
+            err_msg="%s grad mismatch (%s)" % (k_, flavor))
