@@ -101,3 +101,44 @@ print("FRESH-OK")
         capture_output=True, text=True, timeout=300, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "FRESH-OK" in out.stdout
+
+
+def test_inference_model_with_while_subblock(tmp_path):
+    """Deploy path for control-flow programs: a While-loop program (the
+    seq2seq decode shape) must survive the versioned-desc round trip with
+    its sub-block and tensor arrays intact."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=3)
+        acc = fluid.layers.fc(input=x, size=4, bias_attr=False)
+        arr = fluid.layers.array_write(acc, i)
+        cond = fluid.layers.less_than(x=i, y=n)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            prev = fluid.layers.array_read(array=arr, i=i)
+            nxt = fluid.layers.elementwise_add(prev, prev)
+            fluid.layers.increment(x=i, value=1, in_place=True)
+            fluid.layers.array_write(nxt, i, array=arr)
+            fluid.layers.less_than(x=i, y=n, cond=cond)
+        out = fluid.layers.array_read(array=arr, i=n)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xs = np.random.RandomState(0).rand(2, 4).astype("f")
+        ref, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                      main_program=main)
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_inference_model(
+            str(tmp_path), exe)
+        got, = exe.run(prog, feed={"x": xs}, fetch_list=fetches)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
