@@ -141,3 +141,36 @@ def test_amp_lstm_converges_and_tracks_fp32():
     assert lbf[-1] < lbf[0] * 0.5, (lbf[0], lbf[-1])
     # f32-state discipline keeps the AMP trajectory close to full fp32
     np.testing.assert_allclose(lbf, l32, rtol=0.2, atol=0.08)
+
+
+def test_amp_transformer_trains():
+    """Program-level AMP on the transformer family: enable mixed precision
+    on the built program, train, loss finite and decreasing (bf16 MXU path
+    through attention/matmul/layer_norm)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    VOCAB, MAX_LEN, N_HEAD = 20, 8, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        sum_cost, avg_cost, predict = transformer.build_train(
+            src_vocab_size=VOCAB, trg_vocab_size=VOCAB, max_length=MAX_LEN,
+            n_layer=1, n_head=N_HEAD, d_key=16, d_value=16, d_model=32,
+            d_inner_hid=64, warmup_steps=20, learning_rate=2.0)
+        main.enable_mixed_precision()
+
+    rng = np.random.RandomState(3)
+    srcs = [rng.randint(2, VOCAB, rng.randint(3, MAX_LEN + 1)).tolist()
+            for _ in range(16)]
+    feed = transformer.prepare_batch(srcs, srcs, MAX_LEN, N_HEAD)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for i in range(40):
+            l, = exe.run(main, feed=feed, fetch_list=[avg_cost])
+            losses.append(float(np.ravel(l)[0]))
+    assert np.isfinite(losses).all(), losses[:5]
+    assert np.mean(losses[-5:]) < 0.8 * np.mean(losses[:5]), losses[::10]
