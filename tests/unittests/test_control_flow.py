@@ -238,3 +238,50 @@ def test_beam_search_step_and_decode():
     assert out_ids[0, 0] == 3 and out_ids[0, 1] == 4
     # finished beam (id 0) stays on end_id with unchanged score
     assert 0 in out_ids[1]
+
+
+def test_dynamic_rnn_static_input_and_memory_init():
+    """DynamicRNN with a per-sequence static input (visible unchanged at
+    every step, reference dynrnn_static_input) and an explicit memory
+    init: h_t = tanh(x_t W + s U + h_{t-1} V) vs numpy."""
+    from paddle_tpu.core.lod import LoDTensor
+
+    D, S, H = 3, 2, 4
+    rng_ = np.random.RandomState(21)
+    seqs = [rng_.randn(L, D).astype("f") * 0.5 for L in (4, 2)]
+    static = rng_.randn(2, S).astype("f")
+    h0 = rng_.randn(2, H).astype("f") * 0.3
+    Wx = (rng_.randn(D, H) * 0.4).astype("f")
+    Us = (rng_.randn(S, H) * 0.4).astype("f")
+    Vh = (rng_.randn(H, H) * 0.4).astype("f")
+
+    main, startup = fresh_programs()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[D], dtype="float32", lod_level=1)
+        sv = layers.data("s", shape=[S], dtype="float32")
+        h0v = layers.data("h0", shape=[H], dtype="float32")
+        rnn = layers.DynamicRNN()
+        with rnn.block():
+            xt = rnn.step_input(x)
+            st = rnn.static_input(sv)
+            h = rnn.memory(init=h0v)
+            proj = layers.elementwise_add(
+                layers.elementwise_add(
+                    layers.mul(x=xt, y=layers.assign(Wx)),
+                    layers.mul(x=st, y=layers.assign(Us))),
+                layers.mul(x=h, y=layers.assign(Vh)))
+            nh = layers.tanh(x=proj)
+            rnn.update_memory(h, nh)
+            rnn.output(nh)
+        out = rnn()
+        last = layers.sequence_pool(input=out, pool_type="last")
+
+    got, = run(main, startup,
+               {"x": LoDTensor.from_sequences(seqs), "s": static, "h0": h0},
+               [last])
+    for b, s in enumerate(seqs):
+        h = h0[b].astype(np.float64)
+        for t in range(len(s)):
+            h = np.tanh(s[t] @ Wx + static[b] @ Us + h @ Vh)
+        np.testing.assert_allclose(np.asarray(got)[b], h, rtol=1e-4,
+                                   atol=1e-5)

@@ -1,0 +1,103 @@
+"""Last uncovered registered ops: the ranking/robust loss family and misc
+tensor utilities, vs numpy references (+ FD grads for the losses).
+
+Parity model: reference test_hinge_loss_op / test_huber_loss_op /
+test_rank_loss_op / test_margin_rank_loss_op / test_minus_op /
+test_assign_value_op / test_fill_zeros_like_op / test_arg_max.
+"""
+import numpy as np
+import pytest
+
+from op_test import check_forward, check_grad_fd, run_op
+
+rng = np.random.RandomState(321)
+
+
+def test_hinge_loss_numeric_and_grad():
+    logits = rng.randn(5, 1).astype("float32")
+    labels = rng.randint(0, 2, (5, 1)).astype("float32")
+    expect = np.maximum(0.0, 1.0 - (2 * labels - 1) * logits)
+    check_forward("hinge_loss", {"Logits": logits, "Labels": labels},
+                  expect, out_slots=("Loss",))
+    check_grad_fd("hinge_loss", {"Logits": logits, "Labels": labels},
+                  "Logits", out_slots=("Loss",))
+
+
+@pytest.mark.parametrize("delta", [1.0, 0.5])
+def test_huber_loss_numeric_and_grad(delta):
+    x = rng.randn(6, 1).astype("float32")
+    y = (x + rng.randn(6, 1) * 1.5).astype("float32")
+    got = run_op("huber_loss", {"X": x, "Y": y}, attrs={"delta": delta},
+                 out_slots=("Out",))[0]
+    r = (y - x).astype(np.float64)
+    expect = np.where(np.abs(r) <= delta, 0.5 * r * r,
+                      delta * (np.abs(r) - 0.5 * delta))
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+    check_grad_fd("huber_loss", {"X": x, "Y": y}, "X",
+                  attrs={"delta": delta}, out_slots=("Out",))
+
+
+def test_rank_loss_numeric():
+    left = rng.randn(4, 1).astype("float32")
+    right = rng.randn(4, 1).astype("float32")
+    label = rng.randint(0, 2, (4, 1)).astype("float32")
+    got, = run_op("rank_loss",
+                  {"Label": label, "Left": left, "Right": right})
+    d = (left - right).astype(np.float64)
+    expect = np.log1p(np.exp(d)) - label * d
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("margin", [0.0, 0.3])
+def test_margin_rank_loss_numeric(margin):
+    x1 = rng.randn(5, 1).astype("float32")
+    x2 = rng.randn(5, 1).astype("float32")
+    label = (rng.randint(0, 2, (5, 1)) * 2 - 1).astype("float32")
+    got = run_op("margin_rank_loss", {"Label": label, "X1": x1, "X2": x2},
+                 attrs={"margin": margin}, out_slots=("Out",))[0]
+    expect = np.maximum(0.0, -label * (x1 - x2) + margin)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_minus_and_fill_zeros_like():
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    check_forward("minus", {"X": x, "Y": y}, x - y)
+    check_forward("fill_zeros_like", {"X": x}, np.zeros_like(x))
+
+
+def test_assign_value_op():
+    vals = rng.randn(6).astype("float32")
+    got, = run_op("assign_value", {},
+                  attrs={"values": vals.tolist(), "shape": [2, 3],
+                         "dtype": "float32"})
+    np.testing.assert_allclose(got, vals.reshape(2, 3), rtol=1e-6)
+
+
+def test_arg_max_axes():
+    x = rng.randn(3, 5).astype("float32")
+    got, = run_op("arg_max", {"X": x}, attrs={"axis": 1})
+    np.testing.assert_array_equal(np.asarray(got), x.argmax(1))
+    got, = run_op("arg_max", {"X": x}, attrs={"axis": 0})
+    np.testing.assert_array_equal(np.asarray(got), x.argmax(0))
+
+
+def test_reduce_sum_square():
+    x = rng.randn(4, 3).astype("float32")
+    got, = run_op("reduce_sum_square", {"X": x})
+    np.testing.assert_allclose(np.asarray(got).ravel(),
+                               [np.sum(x.astype(np.float64) ** 2)],
+                               rtol=1e-5)
+
+
+def test_truncated_gaussian_random_moments():
+    got, = run_op("truncated_gaussian_random", {},
+                  attrs={"shape": [400, 400], "mean": 1.0, "std": 0.5})
+    got = np.asarray(got)
+    assert got.shape == (400, 400)
+    # truncation at +-2 std around the mean
+    assert got.min() >= 1.0 - 2 * 0.5 - 1e-5
+    assert got.max() <= 1.0 + 2 * 0.5 + 1e-5
+    assert abs(got.mean() - 1.0) < 0.01
+    # std of a +-2-sigma truncated normal is ~0.880 * sigma
+    assert abs(got.std() - 0.5 * 0.880) < 0.02
