@@ -26,6 +26,13 @@ def _await_devices(timeout_s):
     def probe():
         try:
             import jax
+            # the axon sitecustomize forces jax_platforms="axon,cpu" in
+            # CONFIG regardless of the env var; honor an explicit env
+            # request (JAX_PLATFORMS=cpu smoke runs must not touch the
+            # tunnel at all)
+            want = os.environ.get("JAX_PLATFORMS")
+            if want:
+                jax.config.update("jax_platforms", want)
             out["devices"] = jax.devices()
         except Exception as e:       # noqa: BLE001 - reported in JSON
             out["error"] = repr(e)

@@ -1,0 +1,40 @@
+"""The judged bench.py must keep producing its one-JSON-line contract.
+
+One subprocess run of bench.py in the tiny smoke config on CPU (host-feed
+fp32 — exercises the DoubleBufferReader staging, the device-init watchdog's
+happy path, and the JSON record in a single fast compile; the bf16/AMP
+compile path is covered in-process by test_mixed_precision.py). Guards the
+driver-facing artifact against regressions the unit suite wouldn't see.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_bench_json_contract():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_BATCH": "2", "BENCH_STEPS": "1", "BENCH_WARMUP": "0",
+        "BENCH_IMAGE_HW": "32", "BENCH_CLASS_DIM": "10",
+        "BENCH_DTYPE": "fp32", "BENCH_FEED": "host",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "resnet50_imagenet_train_throughput"
+    assert rec["value"] > 0
+    assert rec["unit"] == "images/sec/chip"
+    assert rec["feed"] == "host" and rec["dtype"] == "fp32"
+    # smoke config must NOT claim a baseline comparison
+    assert rec["vs_baseline"] is None
+    assert rec["image_hw"] == 32 and rec["class_dim"] == 10
+    assert "loss" in rec and rec["loss"] == rec["loss"]  # finite

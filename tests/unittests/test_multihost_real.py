@@ -45,7 +45,6 @@ shutdown_distributed()
 """
 
 
-@pytest.mark.timeout(180)
 def test_two_process_rendezvous_and_global_psum(tmp_path):
     # free port for the coordinator
     s = socket.socket()
