@@ -142,3 +142,40 @@ def test_inference_model_with_while_subblock(tmp_path):
             str(tmp_path), exe)
         got, = exe.run(prog, feed={"x": xs}, fetch_list=fetches)
     np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_legacy_pickle_model_still_loads(tmp_path):
+    """Round-1 artifacts stored the Program as a pickle; the loader must
+    keep reading them (io.py sniffs the pickle magic) alongside the
+    versioned desc format."""
+    import json
+    import pickle
+
+    import numpy as np
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        y = fluid.layers.fc(input=x, size=2, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="wleg"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xs = np.random.RandomState(1).rand(2, 3).astype("f")
+        ref, = exe.run(main, feed={"x": xs}, fetch_list=[y])
+        # hand-write a legacy-format artifact: pickled program + params
+        infer = main.clone(for_test=True)
+        with open(str(tmp_path / "__model__"), "wb") as f:
+            pickle.dump(infer, f, protocol=2)
+        with open(str(tmp_path / "__model_meta__.json"), "w") as f:
+            json.dump({"feed": ["x"], "fetch": [y.name]}, f)
+        fluid.io.save_params(exe, str(tmp_path), infer)
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_inference_model(
+            str(tmp_path), exe)
+        got, = exe.run(prog, feed={"x": xs}, fetch_list=fetches)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
