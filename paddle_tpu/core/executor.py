@@ -129,6 +129,42 @@ def as_numpy(tensor):
     return np.asarray(tensor)
 
 
+def convert_feeds(program, feed, host=False):
+    """Feed dict -> arrays for the jitted program. LoDTensor feeds expand
+    to padded dense + the @SEQLEN lengths companion; plain arrays coerce
+    to the feed var's dtype. Shared by Executor and ParallelExecutor (the
+    reference's feed path lived once in executor.cc for both); host=True
+    keeps host values as numpy for a caller that places them itself."""
+    feed_arrays = {}
+    for name, value in feed.items():
+        var = _find_feed_var(program, name)
+        if isinstance(value, LoDTensor):
+            # sequence feed: expand to padded dense + lengths companion
+            padded, lengths = value.to_padded()
+            if var is not None and var.dtype is not None:
+                padded = padded.astype(convert_dtype(var.dtype),
+                                       copy=False)
+            feed_arrays[name] = padded if host else jnp.asarray(padded)
+            feed_arrays[name + "@SEQLEN"] = \
+                lengths if host else jnp.asarray(lengths)
+            continue
+        if var is not None and var.lod_level > 0:
+            try:  # ragged python lists make np.ndim itself raise
+                ndim = np.ndim(value)
+            except ValueError:
+                ndim = -1
+            if ndim != len(var.shape or ()) or \
+                    name + "@SEQLEN" not in feed:
+                raise TypeError(
+                    "variable %r is a sequence (lod_level=%d): feed a "
+                    "LoDTensor (fluid.create_lod_tensor / "
+                    "LoDTensor.from_sequences), or a padded [num_seqs, "
+                    "max_len, ...] array plus %r lengths" %
+                    (name, var.lod_level, name + "@SEQLEN"))
+        feed_arrays[name] = _to_array(value, var, host=host)
+    return feed_arrays
+
+
 def _array_safety_enabled():
     """In-graph TensorArray overflow checking (default ON). The check costs
     one scalar device->host sync per run for programs that contain tensor
@@ -208,33 +244,7 @@ class Executor(object):
         scope = scope or global_scope()
 
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
-        feed_arrays = {}
-        for name, value in feed.items():
-            var = _find_feed_var(program, name)
-            if isinstance(value, LoDTensor):
-                # sequence feed: expand to padded dense + lengths companion
-                padded, lengths = value.to_padded()
-                if var is not None and var.dtype is not None:
-                    padded = padded.astype(convert_dtype(var.dtype),
-                                           copy=False)
-                feed_arrays[name] = jnp.asarray(padded)
-                feed_arrays[name + "@SEQLEN"] = jnp.asarray(lengths)
-                continue
-            if var is not None and var.lod_level > 0:
-                try:  # ragged python lists make np.ndim itself raise
-                    ndim = np.ndim(value)
-                except ValueError:
-                    ndim = -1
-                if ndim != len(var.shape or ()) or \
-                        name + "@SEQLEN" not in feed:
-                    raise TypeError(
-                        "variable %r is a sequence (lod_level=%d): feed a "
-                        "LoDTensor (fluid.create_lod_tensor / "
-                        "LoDTensor.from_sequences), or a padded [num_seqs, "
-                        "max_len, ...] array plus %r lengths" %
-                        (name, var.lod_level, name + "@SEQLEN"))
-            arr = _to_array(value, var)
-            feed_arrays[name] = arr
+        feed_arrays = convert_feeds(program, feed)
 
         # io pre-pass: reader ops execute host-side (core/readers.py).
         # create_* ops build ReaderState objects in the scope; each `read`
@@ -326,7 +336,11 @@ class Executor(object):
 
 
 
-def _to_array(value, var=None):
+def _to_array(value, var=None, host=False):
+    """host=True keeps numpy values on the host (the ParallelExecutor path:
+    its single sharded device_put must be the only transfer — staging via
+    the default device first would double the volume and concentrate the
+    full batch on device 0)."""
     if isinstance(value, jax.Array):
         # already device-resident: never round-trip via host, but still
         # honor the declared dtype (device-side cast is a cheap XLA op)
@@ -338,7 +352,7 @@ def _to_array(value, var=None):
     arr = np.asarray(value)
     if var is not None and var.dtype is not None:
         arr = arr.astype(convert_dtype(var.dtype), copy=False)
-    return jnp.asarray(arr)
+    return arr if host else jnp.asarray(arr)
 
 
 def switch_scope(scope):

@@ -22,9 +22,10 @@ import jax.numpy as jnp
 
 from ..core import lowering
 from ..core.framework import default_main_program
-from ..core.executor import (global_scope, _to_array, _feed_signature,
+from ..core.executor import (global_scope, _feed_signature,
                              _nan_inf_enabled, _raise_program_errors,
-                             _array_safety_enabled, check_finite)
+                             _array_safety_enabled, check_finite,
+                             convert_feeds)
 from .mesh import data_parallel_mesh, replicated, batch_sharded, NamedSharding, P
 
 
@@ -103,14 +104,12 @@ class ParallelExecutor(object):
         scope = self._scope
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
 
-        feed_arrays = {}
-        for name, value in feed.items():
-            arr = np.asarray(value)
-            if arr.shape and arr.shape[0] % self.device_count != 0:
+        feed_arrays = convert_feeds(program, feed, host=True)
+        for name, arr in feed_arrays.items():
+            if np.shape(arr) and np.shape(arr)[0] % self.device_count != 0:
                 raise ValueError(
-                    "batch size %d must divide evenly across %d devices"
-                    % (arr.shape[0], self.device_count))
-            feed_arrays[name] = arr
+                    "batch size %d of feed %r must divide evenly across %d "
+                    "devices" % (np.shape(arr)[0], name, self.device_count))
         feed_names = sorted(feed_arrays)
 
         key = (program._uid, program._version,
@@ -126,7 +125,7 @@ class ParallelExecutor(object):
                 state_out, mesh=self.mesh, collect_errors=True)
             rep = replicated(self.mesh)
             in_shardings = (
-                [batch_sharded(self.mesh, np.asarray(feed_arrays[n]).ndim,
+                [batch_sharded(self.mesh, feed_arrays[n].ndim,
                                axis_name=self._batch_axis)
                  for n in feed_names],
                 [self._state_sharding(n) for n in state_rw],
@@ -159,7 +158,7 @@ class ParallelExecutor(object):
 
         feed_vals = [jax.device_put(
             feed_arrays[n],
-            batch_sharded(self.mesh, np.asarray(feed_arrays[n]).ndim,
+            batch_sharded(self.mesh, feed_arrays[n].ndim,
                           axis_name=self._batch_axis))
             for n in feed_names]
 
