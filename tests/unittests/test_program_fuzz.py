@@ -102,3 +102,29 @@ def test_random_program_grad_matches_fd(seed):
         np.testing.assert_allclose(
             gx[i, j], fd, rtol=5e-2, atol=5e-3,
             err_msg="seed %d grad[%d,%d] mismatch" % (seed, i, j))
+
+
+@pytest.mark.parametrize("seed", range(0, 30, 3))
+def test_random_program_amp_tracks_fp32(seed):
+    """The same random DAG under enable_mixed_precision: loss finite and
+    within bf16 tolerance of the fp32 run (integration of the AMP cast
+    discipline across arbitrary op compositions)."""
+    losses = {}
+    for amp in (False, True):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x, loss = _build_random(seed)
+            if amp:
+                main.enable_mixed_precision()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(1000 + seed)
+        xv = rng.rand(3, DIM).astype("float32") * 0.8 + 0.1
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            l, = exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        losses[amp] = float(np.ravel(np.asarray(l))[0])
+    assert np.isfinite(losses[True]), losses
+    np.testing.assert_allclose(
+        losses[True], losses[False], rtol=2e-2, atol=2e-2,
+        err_msg="seed %d: AMP loss diverged from fp32" % seed)
