@@ -128,3 +128,61 @@ def test_random_program_amp_tracks_fp32(seed):
     np.testing.assert_allclose(
         losses[True], losses[False], rtol=2e-2, atol=2e-2,
         err_msg="seed %d: AMP loss diverged from fp32" % seed)
+
+
+def _np_seq_reduce(kind, seqs):
+    if kind == "sum":
+        return np.stack([s.sum(0) for s in seqs])
+    if kind == "average":
+        return np.stack([s.mean(0) for s in seqs])
+    if kind == "max":
+        return np.stack([s.max(0) for s in seqs])
+    if kind == "first":
+        return np.stack([s[0] for s in seqs])
+    return np.stack([s[-1] for s in seqs])       # last
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_sequence_program(seed):
+    """Random ragged batch -> random elementwise chain (valid positions)
+    -> random sequence_pool: executor result matches the per-sequence
+    numpy evaluation. Exercises padding discipline across op chains."""
+    from paddle_tpu.core.lod import LoDTensor
+
+    rng = np.random.RandomState(500 + seed)
+    L_ = fluid.layers
+    n_seq = int(rng.randint(2, 5))
+    seqs = [rng.rand(int(rng.randint(1, 6)), DIM).astype("f") * 0.8 + 0.1
+            for _ in range(n_seq)]
+    chain = [str(rng.choice(["tanh", "sigmoid", "square", "softsign"]))
+             for _ in range(int(rng.randint(1, 4)))]
+    pool = str(rng.choice(["sum", "average", "max", "first", "last"]))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = L_.data(name="x", shape=[DIM], dtype="float32", lod_level=1)
+        v = x
+        for op in chain:
+            v = getattr(L_, op)(x=v)
+        out = L_.sequence_pool(input=v, pool_type=pool)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": LoDTensor.from_sequences(seqs)},
+                       fetch_list=[out])
+
+    ref_seqs = []
+    for s in seqs:
+        r = s.astype(np.float64)
+        for op in chain:
+            r = {"tanh": np.tanh,
+                 "sigmoid": lambda a: 1 / (1 + np.exp(-a)),
+                 "square": np.square,
+                 "softsign": lambda a: a / (1 + np.abs(a))}[op](r)
+        ref_seqs.append(r)
+    expect = _np_seq_reduce(pool, ref_seqs)
+    np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-4,
+                               atol=1e-5, err_msg="seed %d (%s|%s)"
+                               % (seed, "->".join(chain), pool))
