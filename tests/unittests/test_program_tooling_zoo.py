@@ -42,8 +42,26 @@ def _builders():
             word_dim=8, mark_dim=4, hidden_dim=16, depth=2, lr=0.03,
             mix_hidden_lr=1.0)
 
+    def ctr():
+        from paddle_tpu.models import ctr as m
+        m.build(sparse_feature_dim=1000, embedding_size=8)
+
+    def word2vec():
+        from paddle_tpu.models import word2vec as m
+        m.build(dict_size=100, embed_size=8, hidden_size=16)
+
+    def recommender():
+        from paddle_tpu.models import recommender_system as m
+        m.build_train(emb_dim=8, fc_dim=16)
+
+    def language_model():
+        from paddle_tpu.models import language_model as m
+        m.build(vocab_size=120, emb_size=8, hidden_size=8, num_layers=2)
+
     return {"mnist": mnist, "sentiment": sentiment, "seq2seq": seq2seq,
-            "transformer": transformer, "srl": srl}
+            "transformer": transformer, "srl": srl, "ctr": ctr,
+            "word2vec": word2vec, "recommender": recommender,
+            "language_model": language_model}
 
 
 @pytest.mark.parametrize("name", sorted(_builders()))
