@@ -99,3 +99,66 @@ def test_recognize_digits_verbatim():
     losses, accs = _run_script(RECOGNIZE_DIGITS_CONV)
     assert np.mean(accs[-5:]) > 0.9, accs[::10]
     assert losses[-1] < 0.5 * losses[0], losses[::10]
+
+
+WORD2VEC_NGRAM = """
+import numpy
+
+EMBED_SIZE = 8
+HIDDEN_SIZE = 32
+N = 5
+DICT_SIZE = 50
+
+def ngram_word(name):
+    return fluid.layers.data(name=name, shape=[1], dtype='int64')
+
+first_word = ngram_word('firstw')
+second_word = ngram_word('secondw')
+third_word = ngram_word('thirdw')
+forth_word = ngram_word('forthw')
+next_word = fluid.layers.data(name='nextw', shape=[1], dtype='int64')
+
+def embed(word):
+    return fluid.layers.embedding(
+        input=word, size=[DICT_SIZE, EMBED_SIZE],
+        dtype='float32', param_attr='shared_w')
+
+concat_embed = fluid.layers.concat(
+    input=[embed(first_word), embed(second_word),
+           embed(third_word), embed(forth_word)], axis=1)
+hidden1 = fluid.layers.fc(input=concat_embed, size=HIDDEN_SIZE,
+                          act='sigmoid')
+predict_word = fluid.layers.fc(input=hidden1, size=DICT_SIZE,
+                               act='softmax')
+cost = fluid.layers.cross_entropy(input=predict_word, label=next_word)
+avg_cost = fluid.layers.mean(x=cost)
+optimizer = fluid.optimizer.Adam(learning_rate=0.05)
+optimizer.minimize(avg_cost)
+
+place = fluid.CPUPlace()
+exe = fluid.Executor(place)
+exe.run(fluid.default_startup_program())
+
+rng = numpy.random.RandomState(7)
+# tiny fixed corpus, iterated (book-style smoke): memorize 32 5-grams
+ctxs = rng.randint(0, DICT_SIZE, (32, 4))
+nxt = (ctxs.sum(1) % DICT_SIZE).reshape(-1, 1)
+feeding = {'firstw': ctxs[:, 0:1].astype('int64'),
+           'secondw': ctxs[:, 1:2].astype('int64'),
+           'thirdw': ctxs[:, 2:3].astype('int64'),
+           'forthw': ctxs[:, 3:4].astype('int64'),
+           'nextw': nxt.astype('int64')}
+losses = []
+for step in range(300):
+    loss, = exe.run(fluid.default_main_program(), feed=feeding,
+                    fetch_list=[avg_cost])
+    losses.append(float(loss[0]))
+result = losses
+"""
+
+
+def test_word2vec_verbatim():
+    """Shared-embedding N-gram LM chapter: shared 'shared_w' ParamAttr
+    string across 4 embedding layers, trains."""
+    losses = _run_script(WORD2VEC_NGRAM)
+    assert losses[-1] < 0.3 * losses[0], losses[::50]
