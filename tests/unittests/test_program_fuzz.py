@@ -186,3 +186,53 @@ def test_random_sequence_program(seed):
     np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-4,
                                atol=1e-5, err_msg="seed %d (%s|%s)"
                                % (seed, "->".join(chain), pool))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_while_program(seed):
+    """Random While loop: n in [1,5] iterations applying a random smooth
+    elementwise update to a carried accumulator; result and loop-count
+    semantics match the per-iteration numpy evaluation."""
+    rng = np.random.RandomState(900 + seed)
+    L_ = fluid.layers
+    n_iter = int(rng.randint(1, 6))
+    ops = [str(rng.choice(["tanh", "sigmoid", "softsign"]))
+           for _ in range(int(rng.randint(1, 3)))]
+    scale = float(rng.rand() * 0.5 + 0.5)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = L_.data(name="x", shape=[DIM], dtype="float32")
+        i = L_.fill_constant(shape=[1], dtype="int64", value=0)
+        n = L_.fill_constant(shape=[1], dtype="int64", value=n_iter)
+        acc = L_.fill_constant(shape=[1, DIM], dtype="float32", value=0.0)
+        state = L_.elementwise_add(acc, x)     # start at x
+        cond = L_.less_than(x=i, y=n)
+        w = L_.While(cond=cond)
+        with w.block():
+            v = state
+            for op in ops:
+                v = getattr(L_, op)(x=v)
+            v = L_.scale(x=v, scale=scale)
+            L_.assign(v, state)
+            L_.increment(x=i, value=1, in_place=True)
+            L_.less_than(x=i, y=n, cond=cond)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xv = rng.rand(1, DIM).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, iters = exe.run(main, feed={"x": xv}, fetch_list=[state, i])
+
+    ref = xv.astype(np.float64)
+    fns = {"tanh": np.tanh, "sigmoid": lambda a: 1 / (1 + np.exp(-a)),
+           "softsign": lambda a: a / (1 + np.abs(a))}
+    for _ in range(n_iter):
+        for op in ops:
+            ref = fns[op](ref)
+        ref = ref * scale
+    assert int(np.ravel(iters)[0]) == n_iter
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-5,
+                               err_msg="seed %d n=%d ops=%s" %
+                               (seed, n_iter, ops))
