@@ -162,3 +162,62 @@ def test_word2vec_verbatim():
     string across 4 embedding layers, trains."""
     losses = _run_script(WORD2VEC_NGRAM)
     assert losses[-1] < 0.3 * losses[0], losses[::50]
+
+
+SENTIMENT_LSTM = """
+import numpy
+
+DICT_DIM = 60
+EMB_DIM = 16
+HID_DIM = 16
+
+data = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                         lod_level=1)
+label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+emb = fluid.layers.embedding(input=data, size=[DICT_DIM, EMB_DIM])
+fc1 = fluid.layers.fc(input=emb, size=HID_DIM * 4, num_flatten_dims=2)
+lstm1, cell1 = fluid.layers.dynamic_lstm(input=fc1, size=HID_DIM * 4)
+lstm_last = fluid.layers.sequence_pool(input=lstm1, pool_type='last')
+prediction = fluid.layers.fc(input=lstm_last, size=2, act='softmax')
+cost = fluid.layers.cross_entropy(input=prediction, label=label)
+avg_cost = fluid.layers.mean(x=cost)
+acc = fluid.layers.accuracy(input=prediction, label=label)
+adam = fluid.optimizer.Adam(learning_rate=0.02)
+adam.minimize(avg_cost)
+
+place = fluid.CPUPlace()
+exe = fluid.Executor(place)
+exe.run(fluid.default_startup_program())
+
+rng = numpy.random.RandomState(5)
+# synthetic sentiment: words < DICT_DIM//2 are "positive"
+def make_batch(n):
+    seqs, labels, lens = [], [], []
+    for _ in range(n):
+        k = rng.randint(2, 8)
+        pos = rng.randint(0, 2)
+        lo, hi = (0, DICT_DIM // 2) if pos else (DICT_DIM // 2, DICT_DIM)
+        s = rng.randint(lo, hi, k)
+        seqs.append(s.reshape(-1, 1).astype('int64'))
+        labels.append([pos])
+        lens.append(k)
+    flat = numpy.concatenate(seqs, axis=0)
+    tensor = fluid.create_lod_tensor(flat, [lens], place)
+    return tensor, numpy.asarray(labels, dtype='int64')
+
+accs = []
+for step in range(60):
+    words, labels = make_batch(16)
+    loss_v, acc_v = exe.run(fluid.default_main_program(),
+                            feed={'words': words, 'label': labels},
+                            fetch_list=[avg_cost, acc])
+    accs.append(float(acc_v[0]))
+result = accs
+"""
+
+
+def test_sentiment_lstm_verbatim():
+    """The LoD path verbatim: fluid.create_lod_tensor(flat, [lens], place)
+    feeding a dynamic_lstm chapter."""
+    accs = _run_script(SENTIMENT_LSTM)
+    assert np.mean(accs[-10:]) > 0.85, accs[::10]
