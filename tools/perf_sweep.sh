@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-start perf sweep on the REAL chip. Run FIRST THING in a round while
+# the axon tunnel is fresh (it can wedge permanently on concurrent clients
+# or giant remote compiles — see ARCHITECTURE.md / memory notes):
+#   bash tools/perf_sweep.sh
+# Probes layout, batch, remat, and feed-mode configs; one JSON line each in
+# /tmp/perf_sweep.log. Best known config (round 2): bf16 batch 256 device
+# feed = 2205 img/s (~14% MFU of a v5e's 197 bf16 TFLOPs). Targets worth
+# testing for >25% MFU: batch 512/1024 (+BENCH_REMAT=1), NHWC (see
+# layout_probe), XLA latency-hiding flags.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/perf_sweep.log
+: > $LOG
+probe() {  # never start a sweep against a wedged tunnel
+  timeout 120 python -c "import jax; print(jax.devices())" || {
+    echo "TUNNEL WEDGED - aborting sweep" | tee -a $LOG; exit 1; }
+}
+run() {
+  echo "=== $*" | tee -a $LOG
+  env "$@" BENCH_DEVICE_TIMEOUT=300 timeout 900 python bench.py 2>/dev/null \
+    | tail -1 | tee -a $LOG
+}
+probe
+timeout 600 python tools/layout_probe.py 2>/dev/null | tee -a $LOG
+run BENCH_BATCH=256 BENCH_DTYPE=bf16
+run BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3
+run BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3 BENCH_REMAT=1
+run BENCH_BATCH=1024 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3 BENCH_REMAT=1
+run BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_FEED=host BENCH_STEPS=10 BENCH_WARMUP=3
+echo "=== sweep done ===" | tee -a $LOG
