@@ -138,3 +138,22 @@ def test_sequence_reshape_indivisible_raises():
 
     with pytest.raises(RuntimeError, match="sequence_reshape"):
         _run(build, {"x": lod})
+
+
+def test_fetch_sequence_lengths_companion():
+    """The reference returned fetched sequences as LoDTensors with .lod();
+    here the idiom is fetching the @SEQLEN companion alongside
+    (fetch_list=[y, y.seq_len_var]) to un-pad."""
+    seqs = [rng.rand(3, 1).astype("f"), rng.rand(5, 1).astype("f")]
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                              lod_level=1)
+        y = fluid.layers.sequence_softmax(input=x)
+        return (y, y.seq_len_var)
+
+    out, lens = _run(build, {"x": LoDTensor.from_sequences(seqs)})
+    assert list(np.asarray(lens)) == [3, 5]
+    for i, s in enumerate(seqs):
+        row = np.asarray(out)[i, :int(np.asarray(lens)[i]), 0]
+        np.testing.assert_allclose(row.sum(), 1.0, rtol=1e-5)
