@@ -101,3 +101,15 @@ def test_truncated_gaussian_random_moments():
     assert abs(got.mean() - 1.0) < 0.01
     # std of a +-2-sigma truncated normal is ~0.880 * sigma
     assert abs(got.std() - 0.5 * 0.880) < 0.02
+
+
+def test_reshape_zero_and_infer_dims():
+    """fluid reshape attrs: 0 copies the input dim, -1 infers (reference
+    reshape_op.cc shape validation)."""
+    x = rng.randn(4, 6, 2).astype("float32")
+    got, = run_op("reshape", {"X": x}, attrs={"shape": [0, -1]})
+    np.testing.assert_allclose(got, x.reshape(4, 12), rtol=0)
+    got, = run_op("reshape", {"X": x}, attrs={"shape": [0, 3, -1]})
+    np.testing.assert_allclose(got, x.reshape(4, 3, 4), rtol=0)
+    got, = run_op("reshape", {"X": x}, attrs={"shape": [-1, 8]})
+    np.testing.assert_allclose(got, x.reshape(6, 8), rtol=0)
