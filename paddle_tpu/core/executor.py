@@ -165,6 +165,45 @@ def convert_feeds(program, feed, host=False):
     return feed_arrays
 
 
+def run_host_io_prepass(program, scope, feed_arrays, host=False,
+                        validate=None):
+    """io pre-pass: reader ops execute host-side (core/readers.py).
+    create_* ops build ReaderState objects in the scope; each `read` op
+    pops the next record and injects it as a feed of the jitted program
+    (EOFException propagates to the caller — check reader.eof() first).
+    Global block only: file IO inside traced control flow has no TPU
+    lowering. Shared by Executor and ParallelExecutor. host=True keeps
+    numpy records on the host for the caller's own sharded device_put;
+    records a DoubleBufferReader already staged stay device-resident
+    (device-to-device resharding beats forcing them back through the
+    host). `validate(record)` runs before the record is accepted; on
+    failure it is pushed back so the error doesn't consume it."""
+    for op in program.global_block().ops:
+        if op.type == "read":
+            state = scope.get(op.inputs["Reader"][0])
+            if state is None:
+                raise RuntimeError(
+                    "reader %r has no state; run the startup program "
+                    "first" % op.inputs["Reader"][0])
+            record = state.next()
+            out_names = op.outputs["Out"]
+            try:
+                if len(record) != len(out_names):
+                    raise ValueError(
+                        "reader yielded %d fields but read_file declared "
+                        "%d" % (len(record), len(out_names)))
+                if validate is not None:
+                    validate(record)
+            except Exception:
+                state.push_back(record)
+                raise
+            for out_name, val in zip(out_names, record):
+                feed_arrays[out_name] = _to_array(
+                    val, _find_feed_var(program, out_name), host=host)
+        elif readers.is_host_io_op(op.type):
+            readers.run_host_io_op(op, scope)
+
+
 def _array_safety_enabled():
     """In-graph TensorArray overflow checking (default ON). The check costs
     one scalar device->host sync per run for programs that contain tensor
@@ -246,30 +285,7 @@ class Executor(object):
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
         feed_arrays = convert_feeds(program, feed)
 
-        # io pre-pass: reader ops execute host-side (core/readers.py).
-        # create_* ops build ReaderState objects in the scope; each `read`
-        # op pops the next record and injects it as a feed of the jitted
-        # program (EOFException propagates to the caller — check
-        # reader.eof() first). Global block only: file IO inside traced
-        # control flow has no TPU lowering.
-        for op in program.global_block().ops:
-            if op.type == "read":
-                state = scope.get(op.inputs["Reader"][0])
-                if state is None:
-                    raise RuntimeError(
-                        "reader %r has no state; run the startup program "
-                        "first" % op.inputs["Reader"][0])
-                record = state.next()
-                out_names = op.outputs["Out"]
-                if len(record) != len(out_names):
-                    raise ValueError(
-                        "reader yielded %d fields but read_file declared %d"
-                        % (len(record), len(out_names)))
-                for out_name, val in zip(out_names, record):
-                    feed_arrays[out_name] = _to_array(
-                        val, _find_feed_var(program, out_name))
-            elif readers.is_host_io_op(op.type):
-                readers.run_host_io_op(op, scope)
+        run_host_io_prepass(program, scope, feed_arrays)
 
         feed_names = sorted(feed_arrays)
         key = (getattr(program, "_uid", None) or id(program),

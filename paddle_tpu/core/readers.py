@@ -56,6 +56,14 @@ class ReaderBase(object):
             return out
         return self._next()
 
+    def push_back(self, record):
+        """Return a just-popped record to the front of the stream (used by
+        the executor prepass when a record fails validation, so the error
+        doesn't consume it)."""
+        if self._peeked is not None:
+            raise RuntimeError("push_back with a peeked record pending")
+        self._peeked = record
+
     def eof(self):
         if self._peeked is not None:
             return False

@@ -25,7 +25,7 @@ from ..core.framework import default_main_program
 from ..core.executor import (global_scope, _feed_signature,
                              _nan_inf_enabled, _raise_program_errors,
                              _array_safety_enabled, check_finite,
-                             convert_feeds)
+                             convert_feeds, run_host_io_prepass)
 from .mesh import data_parallel_mesh, replicated, batch_sharded, NamedSharding, P
 
 
@@ -105,11 +105,22 @@ class ParallelExecutor(object):
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
 
         feed_arrays = convert_feeds(program, feed, host=True)
-        for name, arr in feed_arrays.items():
+
+        def _check_divisible(arr, what):
             if np.shape(arr) and np.shape(arr)[0] % self.device_count != 0:
                 raise ValueError(
-                    "batch size %d of feed %r must divide evenly across %d "
-                    "devices" % (np.shape(arr)[0], name, self.device_count))
+                    "batch size %d of %s must divide evenly across %d "
+                    "devices" % (np.shape(arr)[0], what, self.device_count))
+
+        for name, arr in feed_arrays.items():
+            _check_divisible(arr, "feed %r" % name)
+        # in-graph reader programs work data-parallel too: records pop
+        # host-side and shard over the mesh like any feed (validated before
+        # the record is consumed)
+        run_host_io_prepass(
+            program, scope, feed_arrays, host=True,
+            validate=lambda rec: [_check_divisible(f, "reader record field")
+                                  for f in rec])
         feed_names = sorted(feed_arrays)
 
         key = (program._uid, program._version,
