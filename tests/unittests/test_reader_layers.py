@@ -301,3 +301,25 @@ def test_parallel_reader_indivisible_batch_not_consumed(tmp_path):
         # record pushed back: the single-device executor drains BOTH batches
         vals = _drain(reader, s, main, exe)
     assert len(vals) == 2
+
+
+def test_double_buffer_reader_under_parallel_executor(tmp_path):
+    """double_buffer-staged records (device-resident) reshard over the
+    mesh under ParallelExecutor."""
+    path = _make_recordio(tmp_path, name="db.recordio")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        reader = fluid.layers.double_buffer(_open(path))
+        x, y = fluid.layers.read_file(reader)
+        s = fluid.layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pexe = fluid.ParallelExecutor(main_program=main)
+        vals = []
+        while not reader.eof():
+            v, = pexe.run(fetch_list=[s])
+            vals.append(float(np.ravel(np.asarray(v))[0]))
+    assert len(vals) == N_BATCHES
+    assert all(np.isfinite(vals))
