@@ -176,8 +176,10 @@ def run_host_io_prepass(program, scope, feed_arrays, host=False,
     numpy records on the host for the caller's own sharded device_put;
     records a DoubleBufferReader already staged stay device-resident
     (device-to-device resharding beats forcing them back through the
-    host). `validate(record)` runs before the record is accepted; on
-    failure it is pushed back so the error doesn't consume it."""
+    host). `validate(record, out_vars)` runs before the record is accepted
+    (out_vars are the declared read_file output Variables, for shape-aware
+    checks); on failure the record is pushed back so the error doesn't
+    consume it."""
     for op in program.global_block().ops:
         if op.type == "read":
             state = scope.get(op.inputs["Reader"][0])
@@ -193,7 +195,8 @@ def run_host_io_prepass(program, scope, feed_arrays, host=False,
                         "reader yielded %d fields but read_file declared "
                         "%d" % (len(record), len(out_names)))
                 if validate is not None:
-                    validate(record)
+                    validate(record,
+                             [_find_feed_var(program, n) for n in out_names])
             except Exception:
                 state.push_back(record)
                 raise
@@ -288,9 +291,10 @@ class Executor(object):
         run_host_io_prepass(program, scope, feed_arrays)
 
         feed_names = sorted(feed_arrays)
-        key = (getattr(program, "_uid", None) or id(program),
-               program._version, _feed_signature(feed_arrays),
-               tuple(fetch_names))
+        # program._uid is mandatory (as in ParallelExecutor): id() of a GC'd
+        # program can be recycled and silently serve a stale jitted fn
+        key = (program._uid, program._version,
+               _feed_signature(feed_arrays), tuple(fetch_names))
         compiled = False
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:

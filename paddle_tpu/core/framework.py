@@ -322,6 +322,11 @@ class Program(object):
         self.random_seed = 0
         self._op_uid_counter = 0
         self._amp = False  # bf16 mixed precision (enable_mixed_precision)
+        # exact accumulator-var -> param-name map recorded by
+        # Optimizer._add_accumulator; consumed by ParallelExecutor's
+        # sharded_weight_update so accumulator layouts never have to be
+        # guessed from name substrings
+        self._accumulator_owner = {}
         # process-unique identity for the Executor's compile cache: id() of
         # a GC'd program can be recycled by a new one, silently serving a
         # stale jitted fn; this never recycles

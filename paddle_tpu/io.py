@@ -135,6 +135,8 @@ def load_inference_model(dirname, executor, model_filename=None,
     if raw[:1] == b"\x80":  # pickle protocol >= 2: round-1 legacy artifact
         program = pickle.loads(raw)
         program._uid = next(Program._uid_counter)  # predates _uid; no id()
+        if not hasattr(program, "_accumulator_owner"):  # also predates it
+            program._accumulator_owner = {}
     else:
         program = _program_desc.program_from_bytes(raw)
     with open(os.path.join(dirname, "__model_meta__.json")) as f:

@@ -92,6 +92,19 @@ def sequence_reshape(input, new_dim):
     the padded data (valid data is a contiguous row prefix, so it stays
     contiguous) and emits the integer-rescaled OutLen companion."""
     helper = LayerHelper("sequence_reshape", **locals())
+    if helper.block.idx != 0:
+        # inside a While/RNN sub-block the lowering's per-sequence
+        # divisibility assertion cannot escape the lax trace
+        # (LowerCtx.add_error skips under _loop_iters) — the reference op
+        # would hard-error on a non-divisible tail, here it would be
+        # silently truncated. Surface that at build time.
+        import warnings
+        warnings.warn(
+            "sequence_reshape inside a control-flow sub-block: the "
+            "per-sequence len*dim % new_dim divisibility check is not "
+            "enforceable in-graph there; a non-divisible sequence tail "
+            "would be silently dropped. Verify shapes statically.",
+            stacklevel=2)
     out = helper.create_variable_for_type_inference(input.dtype)
     out_len = helper.block.create_var(
         name=out.name + "@SEQLEN", shape=[-1], dtype="int32",

@@ -83,6 +83,7 @@ class Optimizer(object):
         helper.set_variable_initializer(
             var, initializer=ConstantInitializer(value=float(fill_value)))
         self._accumulators[name][param.name] = var
+        var.block.program._accumulator_owner[var.name] = param.name
         return var
 
     def _get_accumulator(self, name, param):

