@@ -38,9 +38,13 @@ def _await_devices(timeout_s):
             out["error"] = repr(e)
 
     def fail(msg):
+        xf = os.environ.get("BENCH_MODEL", "resnet50") == "transformer"
         print(json.dumps({
-            "metric": "resnet50_imagenet_train_throughput",
-            "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+            "metric": "transformer_train_throughput" if xf
+            else "resnet50_imagenet_train_throughput",
+            "value": 0.0,
+            "unit": "tokens/sec/chip" if xf else "images/sec/chip",
+            "vs_baseline": None if xf else 0.0,
             "error": msg}))
         sys.stdout.flush()
         # skip atexit: jax teardown can block on the same wedged runtime
@@ -57,8 +61,74 @@ def _await_devices(timeout_s):
     return out["devices"]
 
 
+def bench_transformer():
+    """Transformer training throughput through the pallas flash-attention
+    path (BENCH_MODEL=transformer). Base-ish config (d_model 512, 8 heads,
+    6 layers, seq 256); prints one JSON tokens/sec line (no reference-era
+    baseline exists for this metric -> vs_baseline null)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "10")))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    seq = int(os.environ.get("BENCH_SEQ", "256"))
+    n_layer = int(os.environ.get("BENCH_LAYERS", "6"))
+    d_model = int(os.environ.get("BENCH_DMODEL", "512"))
+    n_head = int(os.environ.get("BENCH_HEADS", "8"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "30000"))
+    fused = os.environ.get("BENCH_FUSED_ATTN", "1") == "1"
+    dtype = os.environ.get("BENCH_DTYPE", "bf16")
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    if dtype == "bf16":
+        main_prog.enable_mixed_precision()
+    with fluid.unique_name.guard(), fluid.program_guard(main_prog, startup):
+        sum_cost, avg_cost, _ = transformer.build_train(
+            src_vocab_size=vocab, trg_vocab_size=vocab, max_length=seq,
+            n_layer=n_layer, n_head=n_head, d_key=d_model // n_head,
+            d_value=d_model // n_head, d_model=d_model,
+            d_inner_hid=d_model * 4, label_smooth_eps=0.1,
+            use_fused_attention=fused)
+
+    rng = np.random.RandomState(0)
+    srcs = [rng.randint(3, vocab, seq).tolist() for _ in range(batch)]
+    feed = transformer.prepare_batch(srcs, srcs, seq, n_head, fused=fused)
+    feed = {k: jnp.asarray(v) for k, v in feed.items()}
+    jax.block_until_ready(list(feed.values()))
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(warmup):
+            exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                          return_numpy=False)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        loss = np.asarray(out[0])
+        assert np.isfinite(loss).all(), "non-finite loss"
+
+    tps = batch * seq * steps / dt
+    print(json.dumps({
+        "metric": "transformer_train_throughput",
+        "value": round(tps, 1), "unit": "tokens/sec/chip",
+        "vs_baseline": None, "batch": batch, "seq": seq,
+        "layers": n_layer, "d_model": d_model, "dtype": dtype,
+        "fused_attention": fused, "device": str(jax.devices()[0]),
+        "loss": float(loss.reshape(-1)[0])}))
+
+
 def main():
     _await_devices(int(os.environ.get("BENCH_DEVICE_TIMEOUT", "600")))
+    if os.environ.get("BENCH_MODEL", "resnet50") == "transformer":
+        bench_transformer()
+        return
     import jax
     import paddle_tpu as fluid
     from paddle_tpu.models.image_classification import build_train

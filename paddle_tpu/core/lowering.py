@@ -42,7 +42,8 @@ def register_special(type):
 # mul/matmul request f32 via preferred_element_type; conv relies on the TPU
 # MXU's internal f32 accumulate (see ops/nn_ops.py).
 _AMP_BF16_OPS = frozenset({
-    "conv2d", "depthwise_conv2d", "conv2d_transpose", "mul", "matmul"})
+    "conv2d", "depthwise_conv2d", "conv2d_transpose", "mul", "matmul",
+    "fused_attention"})
 # Numerically sensitive ops: force their float inputs back up to f32 so the
 # loss/probability path never rounds through bf16.
 _AMP_F32_OPS = frozenset({

@@ -34,7 +34,13 @@ def _attention(enc_seq, dec_state):
     """Dot-product attention: enc_seq [B,Ts,H] x dec_state [B,H] -> ctx [B,H].
 
     Scores are masked past each row's true source length via
-    sequence_softmax (enc_seq carries its lengths companion)."""
+    sequence_softmax (enc_seq carries its lengths companion).
+
+    Deliberately NOT routed through layers.fused_attention: this runs one
+    single-query step inside a DynamicRNN trace, so there is no [T, T]
+    score matrix to keep out of HBM — the flash kernel's win — and a
+    pallas call per loop step would serialize against the lax.scan. The
+    multi-head [B, T, H, D] fused path lives in models/transformer.py."""
     scores = layers.matmul(enc_seq,
                            layers.unsqueeze(x=dec_state, axes=[2]))  # [B,Ts,1]
     scores = layers.squeeze(x=scores, axes=[2])                      # [B,Ts]

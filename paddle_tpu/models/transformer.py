@@ -31,8 +31,22 @@ def position_encoding_init(n_position, d_model):
 
 
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
-                         d_model, n_head=1, dropout_rate=0.0):
-    """q/k/v fc -> split heads -> scaled dot-product + bias -> combine."""
+                         d_model, n_head=1, dropout_rate=0.0,
+                         use_fused=False, causal=False, kv_len=None):
+    """q/k/v fc -> split heads -> scaled dot-product + bias -> combine.
+
+    use_fused routes the core through layers.fused_attention (the pallas
+    flash kernel, ops/pallas_kernels.py): the [T, T] score matrix never
+    hits HBM, padding is expressed as kv_len + causal instead of the dense
+    additive attn_bias (which the fused path ignores). Attention-weight
+    dropout can't be expressed inside the flash kernel, so
+    use_fused + dropout_rate>0 raises (a silent dense fallback would run
+    WITHOUT the causal/kv_len masks, leaking future positions)."""
+    if use_fused and dropout_rate:
+        raise ValueError(
+            "use_fused attention requires dropout_rate=0: attention-weight "
+            "dropout can't run inside the flash kernel, and the dense path "
+            "expresses masks as attn_bias, not causal/kv_len")
     keys = queries if keys is None else keys
     values = keys if values is None else values
 
@@ -42,6 +56,17 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                         bias_attr=False, num_flatten_dims=2)
     v = fluid.layers.fc(input=values, size=d_value * n_head,
                         bias_attr=False, num_flatten_dims=2)
+
+    if use_fused:
+        # [B, T, H*d] -> [B, T, H, d] (BTHD, the fused kernel's layout)
+        qf = fluid.layers.reshape(q, shape=[0, -1, n_head, d_key])
+        kf = fluid.layers.reshape(k, shape=[0, -1, n_head, d_key])
+        vf = fluid.layers.reshape(v, shape=[0, -1, n_head, d_value])
+        ctx = fluid.layers.fused_attention(qf, kf, vf, causal=causal,
+                                           kv_len=kv_len)
+        ctx = fluid.layers.reshape(ctx, shape=[0, -1, n_head * d_value])
+        return fluid.layers.fc(input=ctx, size=d_model, bias_attr=False,
+                               num_flatten_dims=2)
 
     def split_heads(x, d):
         # [B, T, H*d] -> [B, H, T, d]
@@ -110,10 +135,12 @@ def prepare_encoder(src_word, src_pos, src_vocab_size, src_emb_dim,
 
 
 def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
-                  d_inner_hid, dropout_rate=0.0):
+                  d_inner_hid, dropout_rate=0.0, use_fused=False,
+                  kv_len=None):
     attn_output = multi_head_attention(
         pre_post_process_layer(None, enc_input, "n"), None, None, attn_bias,
-        d_key, d_value, d_model, n_head, dropout_rate)
+        d_key, d_value, d_model, n_head, dropout_rate,
+        use_fused=use_fused, kv_len=kv_len)
     attn_output = pre_post_process_layer(enc_input, attn_output, "da",
                                          dropout_rate)
     ffd_output = positionwise_feed_forward(
@@ -124,16 +151,18 @@ def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
 
 def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
                   n_head, d_key, d_value, d_model, d_inner_hid,
-                  dropout_rate=0.0):
+                  dropout_rate=0.0, use_fused=False, src_len=None,
+                  trg_len=None):
     slf_attn_output = multi_head_attention(
         pre_post_process_layer(None, dec_input, "n"), None, None,
-        slf_attn_bias, d_key, d_value, d_model, n_head, dropout_rate)
+        slf_attn_bias, d_key, d_value, d_model, n_head, dropout_rate,
+        use_fused=use_fused, causal=True, kv_len=trg_len)
     slf_attn_output = pre_post_process_layer(dec_input, slf_attn_output,
                                              "da", dropout_rate)
     enc_attn_output = multi_head_attention(
         pre_post_process_layer(None, slf_attn_output, "n"), enc_output,
         enc_output, dec_enc_attn_bias, d_key, d_value, d_model, n_head,
-        dropout_rate)
+        dropout_rate, use_fused=use_fused, kv_len=src_len)
     enc_attn_output = pre_post_process_layer(slf_attn_output,
                                              enc_attn_output, "da",
                                              dropout_rate)
@@ -145,67 +174,100 @@ def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
 
 
 def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
-            d_inner_hid, dropout_rate=0.0):
+            d_inner_hid, dropout_rate=0.0, use_fused=False, kv_len=None):
     for _ in range(n_layer):
         enc_input = encoder_layer(enc_input, attn_bias, n_head, d_key,
                                   d_value, d_model, d_inner_hid,
-                                  dropout_rate)
+                                  dropout_rate, use_fused=use_fused,
+                                  kv_len=kv_len)
     return pre_post_process_layer(None, enc_input, "n")
 
 
 def decoder(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
             n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
-            dropout_rate=0.0):
+            dropout_rate=0.0, use_fused=False, src_len=None, trg_len=None):
     for _ in range(n_layer):
         dec_input = decoder_layer(dec_input, enc_output, slf_attn_bias,
                                   dec_enc_attn_bias, n_head, d_key, d_value,
-                                  d_model, d_inner_hid, dropout_rate)
+                                  d_model, d_inner_hid, dropout_rate,
+                                  use_fused=use_fused, src_len=src_len,
+                                  trg_len=trg_len)
     return pre_post_process_layer(None, dec_input, "n")
 
 
 FEED_NAMES = ["src_word", "src_pos", "trg_word", "trg_pos",
               "src_slf_attn_bias", "trg_slf_attn_bias", "trg_src_attn_bias",
               "lbl_word", "lbl_weight"]
+FUSED_FEED_NAMES = ["src_word", "src_pos", "trg_word", "trg_pos",
+                    "src_len", "trg_len", "lbl_word", "lbl_weight"]
 
 
-def make_inputs(max_length, n_head):
-    """Declare the 9 dense feeds (the classic transformer feed design)."""
+def make_inputs(max_length, n_head, fused=False):
+    """Declare the dense feeds. Classic design: 9 feeds with [H, T, T]
+    additive attention-bias tensors. fused=True (flash-attention path):
+    the three bias tensors are replaced by [B] int32 src_len/trg_len —
+    padding becomes kv_len block-skipping instead of O(T^2) -1e9 adds."""
     src_word = fluid.layers.data("src_word", [max_length], dtype="int64")
     src_pos = fluid.layers.data("src_pos", [max_length], dtype="int64")
     trg_word = fluid.layers.data("trg_word", [max_length], dtype="int64")
     trg_pos = fluid.layers.data("trg_pos", [max_length], dtype="int64")
-    src_slf = fluid.layers.data(
-        "src_slf_attn_bias", [n_head, max_length, max_length])
-    trg_slf = fluid.layers.data(
-        "trg_slf_attn_bias", [n_head, max_length, max_length])
-    trg_src = fluid.layers.data(
-        "trg_src_attn_bias", [n_head, max_length, max_length])
+    if fused:
+        src_len = fluid.layers.data("src_len", [1], dtype="int32")
+        trg_len = fluid.layers.data("trg_len", [1], dtype="int32")
+    else:
+        src_slf = fluid.layers.data(
+            "src_slf_attn_bias", [n_head, max_length, max_length])
+        trg_slf = fluid.layers.data(
+            "trg_slf_attn_bias", [n_head, max_length, max_length])
+        trg_src = fluid.layers.data(
+            "trg_src_attn_bias", [n_head, max_length, max_length])
     lbl_word = fluid.layers.data("lbl_word", [max_length, 1], dtype="int64")
     lbl_weight = fluid.layers.data("lbl_weight", [max_length, 1])
+    if fused:
+        return (src_word, src_pos, trg_word, trg_pos, src_len, trg_len,
+                lbl_word, lbl_weight)
     return (src_word, src_pos, trg_word, trg_pos, src_slf, trg_slf, trg_src,
             lbl_word, lbl_weight)
 
 
 def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
                 n_head=4, d_key=16, d_value=16, d_model=64, d_inner_hid=128,
-                dropout_rate=0.0, label_smooth_eps=0.0):
-    """Build the training graph; returns (sum_cost, avg_cost, predict)."""
-    (src_word, src_pos, trg_word, trg_pos, src_slf_attn_bias,
-     trg_slf_attn_bias, trg_src_attn_bias, lbl_word,
-     lbl_weight) = make_inputs(max_length, n_head)
+                dropout_rate=0.0, label_smooth_eps=0.0,
+                use_fused_attention=False):
+    """Build the training graph; returns (sum_cost, avg_cost, predict).
+
+    use_fused_attention: every attention core runs the pallas flash kernel
+    (padding via src_len/trg_len feeds, decoder causality via the kernel's
+    causal block-skipping). Requires dropout_rate == 0."""
+    if use_fused_attention:
+        if dropout_rate:
+            raise ValueError("use_fused_attention requires dropout_rate=0 "
+                             "(attention-weight dropout can't run inside "
+                             "the flash kernel)")
+        (src_word, src_pos, trg_word, trg_pos, src_len, trg_len,
+         lbl_word, lbl_weight) = make_inputs(max_length, n_head, fused=True)
+        src_slf_attn_bias = trg_slf_attn_bias = trg_src_attn_bias = None
+    else:
+        (src_word, src_pos, trg_word, trg_pos, src_slf_attn_bias,
+         trg_slf_attn_bias, trg_src_attn_bias, lbl_word,
+         lbl_weight) = make_inputs(max_length, n_head)
+        src_len = trg_len = None
 
     enc_input = prepare_encoder(
         src_word, src_pos, src_vocab_size, d_model, max_length,
         dropout_rate, pos_enc_param_name=POS_ENC_PARAM_NAMES[0])
     enc_output = encoder(enc_input, src_slf_attn_bias, n_layer, n_head,
-                         d_key, d_value, d_model, d_inner_hid, dropout_rate)
+                         d_key, d_value, d_model, d_inner_hid, dropout_rate,
+                         use_fused=use_fused_attention, kv_len=src_len)
 
     dec_input = prepare_encoder(
         trg_word, trg_pos, trg_vocab_size, d_model, max_length,
         dropout_rate, pos_enc_param_name=POS_ENC_PARAM_NAMES[1])
     dec_output = decoder(dec_input, enc_output, trg_slf_attn_bias,
                          trg_src_attn_bias, n_layer, n_head, d_key, d_value,
-                         d_model, d_inner_hid, dropout_rate)
+                         d_model, d_inner_hid, dropout_rate,
+                         use_fused=use_fused_attention, src_len=src_len,
+                         trg_len=trg_len)
 
     predict = fluid.layers.fc(input=dec_output, size=trg_vocab_size,
                               bias_attr=False, num_flatten_dims=2)
@@ -393,21 +455,28 @@ def prepare_decode_batch(src_seqs, max_length, n_head, beam_size,
     }
 
 
-def prepare_batch(src_seqs, trg_seqs, max_length, n_head, pad_id=0):
-    """Pack python token lists into the 9 dense feed arrays."""
+def prepare_batch(src_seqs, trg_seqs, max_length, n_head, pad_id=0,
+                  fused=False):
+    """Pack python token lists into the dense feed arrays (9 classic feeds,
+    or — fused=True, for a use_fused_attention program — src_len/trg_len
+    instead of the three [H, T, T] bias tensors)."""
     b = len(src_seqs)
-    feeds = {}
     src = np.full((b, max_length), pad_id, "int64")
     src_pos = np.zeros((b, max_length), "int64")
     trg = np.full((b, max_length), pad_id, "int64")
     trg_pos = np.zeros((b, max_length), "int64")
     lbl = np.full((b, max_length, 1), pad_id, "int64")
     lbl_w = np.zeros((b, max_length, 1), "float32")
+    src_len = np.zeros((b, 1), "int32")
+    trg_len = np.zeros((b, 1), "int32")
     neg = -1e9
-    src_bias = np.zeros((b, n_head, max_length, max_length), "float32")
-    trg_bias = np.zeros((b, n_head, max_length, max_length), "float32")
-    cross_bias = np.zeros((b, n_head, max_length, max_length), "float32")
-    causal = np.triu(np.full((max_length, max_length), neg, "float32"), 1)
+    if not fused:
+        src_bias = np.zeros((b, n_head, max_length, max_length), "float32")
+        trg_bias = np.zeros((b, n_head, max_length, max_length), "float32")
+        cross_bias = np.zeros((b, n_head, max_length, max_length),
+                              "float32")
+        causal = np.triu(np.full((max_length, max_length), neg, "float32"),
+                         1)
     for i, (s, t) in enumerate(zip(src_seqs, trg_seqs)):
         s = list(s)[:max_length]
         # teacher forcing: input <s>+t[:-1], label t
@@ -420,11 +489,20 @@ def prepare_batch(src_seqs, trg_seqs, max_length, n_head, pad_id=0):
         tl = min(len(t), max_length)
         lbl[i, :tl, 0] = list(t)[:tl]
         lbl_w[i, :tl, 0] = 1.0
-        src_bias[i, :, :, len(s):] = neg
-        trg_bias[i] = causal[None]
-        trg_bias[i, :, :, len(t_in):] = neg
-        cross_bias[i, :, :, len(s):] = neg
-    return {"src_word": src, "src_pos": src_pos, "trg_word": trg,
-            "trg_pos": trg_pos, "src_slf_attn_bias": src_bias,
-            "trg_slf_attn_bias": trg_bias, "trg_src_attn_bias": cross_bias,
-            "lbl_word": lbl, "lbl_weight": lbl_w}
+        src_len[i, 0] = len(s)
+        trg_len[i, 0] = len(t_in)
+        if not fused:
+            src_bias[i, :, :, len(s):] = neg
+            trg_bias[i] = causal[None]
+            trg_bias[i, :, :, len(t_in):] = neg
+            cross_bias[i, :, :, len(s):] = neg
+    feeds = {"src_word": src, "src_pos": src_pos, "trg_word": trg,
+             "trg_pos": trg_pos, "lbl_word": lbl, "lbl_weight": lbl_w}
+    if fused:
+        feeds["src_len"] = src_len
+        feeds["trg_len"] = trg_len
+    else:
+        feeds["src_slf_attn_bias"] = src_bias
+        feeds["trg_slf_attn_bias"] = trg_bias
+        feeds["trg_src_attn_bias"] = cross_bias
+    return feeds

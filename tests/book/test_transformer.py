@@ -121,3 +121,51 @@ def test_position_encoding_table():
     np.testing.assert_allclose(tab[0, 0::2], 0.0, atol=1e-7)  # sin(0)
     np.testing.assert_allclose(tab[0, 1::2], 1.0, atol=1e-7)  # cos(0)
     assert np.abs(tab).max() <= 1.0 + 1e-6
+
+
+def test_transformer_fused_attention_matches_dense():
+    """The flash-attention program (use_fused_attention=True: pallas kernel,
+    src_len/trg_len feeds) must produce the same forward loss as the dense
+    matmul+softmax+bias program on identical params, and train."""
+    def build(fused):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            sum_cost, avg_cost, predict = transformer.build_train(
+                src_vocab_size=VOCAB, trg_vocab_size=VOCAB,
+                max_length=MAX_LEN, n_layer=1, n_head=N_HEAD, d_key=16,
+                d_value=16, d_model=32, d_inner_hid=64, warmup_steps=20,
+                learning_rate=2.0, use_fused_attention=fused)
+        return main, startup, avg_cost
+
+    rng = np.random.RandomState(5)
+    srcs = [rng.randint(2, VOCAB, rng.randint(3, MAX_LEN + 1)).tolist()
+            for _ in range(8)]
+    feed_dense = transformer.prepare_batch(srcs, srcs, MAX_LEN, N_HEAD)
+    feed_fused = transformer.prepare_batch(srcs, srcs, MAX_LEN, N_HEAD,
+                                           fused=True)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    main_d, startup_d, cost_d = build(False)
+    scope_d = fluid.Scope()
+    with fluid.scope_guard(scope_d):
+        exe.run(startup_d)
+        init = {n: np.asarray(scope_d.get(n)) for n in scope_d.names()}
+        dense0 = float(np.ravel(exe.run(
+            main_d, feed=feed_dense, fetch_list=[cost_d])[0])[0])
+
+    main_f, startup_f, cost_f = build(True)
+    scope_f = fluid.Scope()
+    with fluid.scope_guard(scope_f):
+        exe.run(startup_f)
+        for n, v in init.items():
+            if scope_f.get(n) is not None:
+                scope_f.set(n, v)
+        fused_losses = []
+        for i in range(30):
+            loss, = exe.run(main_f, feed=feed_fused, fetch_list=[cost_f])
+            fused_losses.append(float(np.ravel(loss)[0]))
+    # same params -> same forward loss (flash is exact attention)
+    np.testing.assert_allclose(fused_losses[0], dense0, rtol=2e-4)
+    # and the fused program trains
+    assert fused_losses[-1] < 0.8 * fused_losses[0], fused_losses[::5]
