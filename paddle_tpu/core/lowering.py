@@ -83,6 +83,11 @@ class LowerCtx(object):
         # (pushed by control-flow lowerings) — folded into every key so
         # dropout/random ops inside loops vary per time step.
         self._loop_iters = []
+        # rng-only extra salts: folded into keys like _loop_iters but
+        # WITHOUT suppressing add_error — for re-lowering the same ops at
+        # top trace level (sequential pipeline stages), where assertions
+        # can still escape but randomness must differ per replay.
+        self._rng_extra = []
         # message -> traced bool flag: in-graph assertions raised host-side
         # after the step (same channel as TensorArray overflow). Sticky OR
         # per message.
@@ -116,6 +121,8 @@ class LowerCtx(object):
             base,
             (self._op_salt * 1000003 + self._op_calls * 97 + salt) & 0x7FFFFFFF)
         for it in self._loop_iters:
+            key = jax.random.fold_in(key, it)
+        for it in self._rng_extra:
             key = jax.random.fold_in(key, it)
         return key
 

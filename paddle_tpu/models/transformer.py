@@ -47,6 +47,10 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
             "use_fused attention requires dropout_rate=0: attention-weight "
             "dropout can't run inside the flash kernel, and the dense path "
             "expresses masks as attn_bias, not causal/kv_len")
+    if use_fused and attn_bias is not None:
+        raise ValueError(
+            "use_fused attention ignores dense attn_bias tensors — express "
+            "the mask as kv_len (key padding) and/or causal=True instead")
     keys = queries if keys is None else keys
     values = keys if values is None else values
 

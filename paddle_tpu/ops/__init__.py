@@ -7,3 +7,4 @@ from . import control_ops    # noqa: F401
 from . import crf_ops        # noqa: F401
 from . import ctc_ops        # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import parallel_ops   # noqa: F401

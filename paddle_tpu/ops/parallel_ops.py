@@ -1,0 +1,126 @@
+"""Program-level lowerings of the parallel subsystems: the `pipeline` op
+(GPipe looped pipeline, parallel/pipeline.py) and the `moe` op (top-1
+switch expert parallelism, parallel/moe.py).
+
+These make PP and EP reachable from the fluid Program path
+(layers.pipelined_stack / layers.switch_moe build the ops; Executor runs
+them sequentially / densely on one chip; ParallelExecutor with a mesh
+carrying a 'pp' / 'ep' axis runs the real collective schedules). The
+reference era had neither — its only model-partitioning story is the
+pserver parameter split (python/paddle/fluid/distribute_transpiler.py) —
+but SURVEY §2 commits to DP/TP/PP/SP/EP composable on one Mesh *for
+Programs*, which is exactly what these two ops close.
+
+Both lower through pure-jax library code, so `grad_of` (core/backward.py)
+differentiates them with jax.vjp like any other registered op: the
+backward pipeline falls out of lax.scan/ppermute transposition, the MoE
+backward out of the einsum transposes. No hand-written grad machinery.
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import registry
+from ..core.registry import single
+from ..core.lowering import Env, lower_block
+
+
+def _stage_runner(ctx, attrs):
+    """Build stage_fn(param_values, x) -> y that lowers the template
+    sub-block with the stage's parameter values bound to the template
+    names. `marker` (a python int or traced int32) is folded into the rng
+    stream so random ops vary per stage, and suppresses in-graph
+    assertion escapes while tracing inside shard_map/scan."""
+    sub = ctx.program.blocks[attrs["sub_block"]]
+    pnames = list(attrs["param_names"])
+    in_name = attrs["in_name"]
+    out_name = attrs["out_name"]
+
+    def stage_fn(plist, xin, marker, traced):
+        """traced=True while inside shard_map/scan (pp path): assertion
+        flags can't escape the trace, so add_error must be suppressed via
+        _loop_iters. The sequential path is at top trace level — only the
+        rng stream needs the per-stage fold, assertions still escape."""
+        benv = Env()
+        for n, v in zip(pnames, plist):
+            benv.write(n, v)
+        benv.write(in_name, xin)
+        stack = ctx._loop_iters if traced else ctx._rng_extra
+        stack.append(marker)
+        try:
+            lower_block(ctx, sub, benv)
+        finally:
+            stack.pop()
+        return benv.read(out_name)
+
+    return stage_fn
+
+
+def _pipeline_lower(ctx, ins, attrs):
+    x = single(ins, "X")
+    flat = list(ins.get("StageParams", []))
+    S = int(attrs["num_stages"])
+    Pn = int(attrs["params_per_stage"])
+    stage_fn = _stage_runner(ctx, attrs)
+
+    mesh = ctx.mesh
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1:
+        if pp != S:
+            raise ValueError(
+                "pipeline op has %d stages but the mesh 'pp' axis is %d — "
+                "stage count and pipeline ranks must match" % (S, pp))
+        from ..parallel.pipeline import pipeline_apply
+        # stack each template param across stages -> [S, ...] leaves; the
+        # shard_map in_spec P('pp') places stage s's slice on rank s
+        stacked = [jnp.stack([flat[s * Pn + j] for s in range(S)])
+                   for j in range(Pn)]
+        M = int(attrs.get("num_microbatches") or 0) or None
+        batch_axis = "dp" if mesh.shape.get("dp", 1) > 1 else None
+        out = pipeline_apply(
+            lambda plist, xin: stage_fn(plist, xin,
+                                        lax.axis_index("pp"), True),
+            stacked, x, mesh, num_microbatches=M, axis="pp",
+            batch_axis=batch_axis)
+    else:
+        # single-chip / no-pp-axis: run the stages sequentially (the exact
+        # math the pipeline schedule computes, minus the ring)
+        out = x
+        for s in range(S):
+            out = stage_fn(flat[s * Pn:(s + 1) * Pn], out, s, False)
+    return {"Out": [out]}
+
+
+def _pipeline_infer(block, op, out_vars):
+    xv = block.var_recursive(op.inputs["X"][0])
+    ov = block.var_recursive(op.outputs["Out"][0])
+    ov.shape, ov.dtype = xv.shape, xv.dtype
+
+
+registry.register("pipeline", _pipeline_lower, infer=_pipeline_infer)
+
+
+def _moe_lower(ctx, ins, attrs):
+    from ..parallel.moe import moe_layer
+    x = single(ins, "X")
+    params = {"gate": single(ins, "Gate"),
+              "w1": single(ins, "W1"), "b1": single(ins, "B1"),
+              "w2": single(ins, "W2"), "b2": single(ins, "B2")}
+    mesh = ctx.mesh
+    ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+    d = x.shape[-1]
+    x2 = x.reshape(-1, d)
+    y, aux = moe_layer(params, x2,
+                       capacity_factor=float(attrs["capacity_factor"]),
+                       mesh=mesh if ep > 1 else None, axis="ep")
+    return {"Out": [y.reshape(x.shape)], "AuxLoss": [aux.reshape(1)]}
+
+
+def _moe_infer(block, op, out_vars):
+    xv = block.var_recursive(op.inputs["X"][0])
+    ov = block.var_recursive(op.outputs["Out"][0])
+    ov.shape, ov.dtype = xv.shape, xv.dtype
+    av = block.var_recursive(op.outputs["AuxLoss"][0])
+    av.shape, av.dtype = (1,), "float32"
+
+
+registry.register("moe", _moe_lower, infer=_moe_infer)

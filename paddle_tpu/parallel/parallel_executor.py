@@ -77,6 +77,12 @@ class ParallelExecutor(object):
                 self._auto_weight_update_shardings(),
                 **self._param_shardings)
         self._cache = {}
+        # XLA:CPU collectives deadlock when several executions are in
+        # flight at once (each rendezvous needs one thread per virtual
+        # device; concurrent programs starve the pool and abort). Real TPU
+        # collectives don't have this failure mode — only serialize
+        # dispatch on the CPU (test/virtual-mesh) backend.
+        self._sync_dispatch = jax.default_backend() == "cpu"
         self._check_nan_inf = _nan_inf_enabled(check_nan_inf)
         self._array_safety = _array_safety_enabled()
         self._scope = global_scope()
@@ -217,10 +223,12 @@ class ParallelExecutor(object):
         t0 = _time.perf_counter() if profiling else 0.0
         fetches, new_state, errors = jitted(feed_vals, read_state(state_rw),
                                             read_state(state_ro), seed)
-        # state write-back precedes any raise: rw inputs were donated (see
-        # Executor.run)
+        # state write-back precedes any raise point (incl. the sync below):
+        # rw inputs were donated (see Executor.run)
         for n, v in zip(state_out, new_state):
             scope.set(n, v)
+        if self._sync_dispatch:
+            jax.block_until_ready((fetches, new_state))
         if profiling:
             jax.block_until_ready((fetches, new_state))
             tag = "pexe_program_%s(v%d)x%d fetch=%s" % (
