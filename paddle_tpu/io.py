@@ -22,7 +22,8 @@ from .core import program_desc as _program_desc
 __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
-    "load_inference_model", "get_inference_program",
+    "load_inference_model", "load_reference_model",
+    "get_inference_program",
     "save_checkpoint", "load_checkpoint",
     "get_parameter_value", "get_parameter_value_by_name",
 ]
@@ -144,6 +145,44 @@ def load_inference_model(dirname, executor, model_filename=None,
     load_params(executor, dirname)
     fetch_vars = [program.global_block().var(n) for n in meta["fetch"]]
     return program, meta["feed"], fetch_vars
+
+
+def load_reference_model(dirname, executor, model_filename=None):
+    """Load a model directory saved by REFERENCE-era code
+    (python/paddle/fluid/io.py:384 save_inference_model): a `__model__`
+    ProgramDesc protobuf plus one save_op LoDTensor file per persistable
+    var. Returns (program, feed_names, fetch_vars) like
+    load_inference_model; the program runs on the TPU Executor directly.
+
+    Parsing is a hand-rolled protobuf wire reader
+    (paddle_tpu/reference_format.py — framework.proto's schema), so no
+    protobuf runtime is needed. Combined single-file params
+    (params_filename/save_combine) are not supported — the era's default
+    was one file per variable.
+    """
+    from . import reference_format as rf
+
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "rb") as f:
+        raw = f.read()
+    blocks = rf._parse_blocks(raw)  # one wire decode for both consumers
+    program = rf.parse_program_desc(blocks)
+    feed_names, fetch_names = rf.strip_feed_fetch(blocks)
+
+    scope = global_scope()
+    for v in program.list_vars():
+        if not v.persistable:
+            continue
+        path = os.path.join(dirname, v.name)
+        if not os.path.exists(path):
+            raise RuntimeError(
+                "reference model param file missing: %r (combined "
+                "params_filename saves are not supported)" % path)
+        arr, _lod = rf.read_lod_tensor_file(path)
+        scope.set(v.name, arr)
+
+    fetch_vars = [program.global_block().var(n) for n in fetch_names]
+    return program, feed_names, fetch_vars
 
 
 def save_checkpoint(executor, checkpoint_dir, main_program=None,

@@ -26,28 +26,45 @@ def _freeze(v):
     return v
 
 
-def _block_sig(program, block):
-    """Structural signature of a stage sub-block: op types AND attrs
-    (recursing into nested sub-blocks, whose indices differ per stage even
-    when their contents match). Execution always uses stage 0's template,
-    so any attr divergence across stages (fc(act='relu') vs 'tanh') must
-    be a build error, not silent stage-0 math."""
+def _block_sig(program, block, canon=None):
+    """Structural signature of a stage sub-block: op types, attrs, AND
+    dataflow wiring (recursing into nested sub-blocks, whose indices
+    differ per stage even when their contents match). Execution always
+    uses stage 0's template, so ANY divergence across stages — attrs
+    (fc(act='relu') vs 'tanh') or topology (fc(fc(x)) vs fc(x)) — must be
+    a build error, not silent stage-0 math. Wiring is compared through
+    first-seen canonical ids, so the generated var names themselves may
+    legitimately differ per stage."""
+    canon = {} if canon is None else canon
+
+    def cid(n):
+        if n not in canon:
+            canon[n] = len(canon)
+        return canon[n]
+
     sig = []
     for op in block.ops:
         attrs = []
         for k in sorted(op.attrs):
             if k == "sub_block":
                 idx = op.attrs[k]
-                attrs.append((k, _block_sig(program, program.blocks[idx])))
+                attrs.append((k, _block_sig(program, program.blocks[idx],
+                                            canon)))
             elif k.endswith(("_name", "_names")):
                 # binding metadata holds per-stage generated var names
-                # (rnn_scan in_names, conditional out_names, ...); the
-                # structure they bind is compared via the recursion above,
-                # the names themselves legitimately differ per stage
-                continue
+                # (rnn_scan in_names, conditional out_names, ...); their
+                # wiring is canonicalized like op input/output names
+                v = op.attrs[k]
+                names = v if isinstance(v, (list, tuple)) else [v]
+                attrs.append((k, tuple(cid(x) for x in names
+                                       if isinstance(x, str))))
             else:
                 attrs.append((k, _freeze(op.attrs[k])))
-        sig.append((op.type, tuple(attrs)))
+        wiring = tuple(
+            (kind, slot, tuple(cid(n) for n in names if n))
+            for kind, slots in (("in", op.inputs), ("out", op.outputs))
+            for slot, names in sorted(slots.items()))
+        sig.append((op.type, tuple(attrs), wiring))
     return tuple(sig)
 
 

@@ -21,7 +21,7 @@ from jax import lax
 
 from ..core import registry
 from ..core.registry import single
-from ..core.lowering import Env, lower_block
+from ..core.lowering import Env, lower_block, PROGRAM_ERR
 
 
 def _stage_runner(ctx, attrs):
@@ -39,8 +39,13 @@ def _stage_runner(ctx, attrs):
         """traced=True while inside shard_map/scan (pp path): assertion
         flags can't escape the trace, so add_error must be suppressed via
         _loop_iters. The sequential path is at top trace level — only the
-        rng stream needs the per-stage fold, assertions still escape."""
+        rng stream needs the per-stage fold, assertions still escape.
+        Returns (out, err): err sweeps the stage env's PROGRAM_ERR and
+        TensorArray overflow flags (like control_ops' sub-blocks do) so
+        in-stage overflows reach the host on the sequential path."""
+        from .control_ops import _sweep_overflow
         benv = Env()
+        benv.write(PROGRAM_ERR, jnp.zeros((), bool))
         for n, v in zip(pnames, plist):
             benv.write(n, v)
         benv.write(in_name, xin)
@@ -50,7 +55,8 @@ def _stage_runner(ctx, attrs):
             lower_block(ctx, sub, benv)
         finally:
             stack.pop()
-        return benv.read(out_name)
+        return benv.read(out_name), _sweep_overflow(
+            benv, jnp.zeros((), bool))
 
     return stage_fn
 
@@ -77,17 +83,22 @@ def _pipeline_lower(ctx, ins, attrs):
         M = int(attrs.get("num_microbatches") or 0) or None
         batch_axis = "dp" if mesh.shape.get("dp", 1) > 1 else None
         out = pipeline_apply(
+            # error flags minted inside shard_map/scan can't escape the
+            # trace — dropped here, mirroring add_error's loop rule
             lambda plist, xin: stage_fn(plist, xin,
-                                        lax.axis_index("pp"), True),
+                                        lax.axis_index("pp"), True)[0],
             stacked, x, mesh, num_microbatches=M, axis="pp",
             batch_axis=batch_axis)
-    else:
-        # single-chip / no-pp-axis: run the stages sequentially (the exact
-        # math the pipeline schedule computes, minus the ring)
-        out = x
-        for s in range(S):
-            out = stage_fn(flat[s * Pn:(s + 1) * Pn], out, s, False)
-    return {"Out": [out]}
+        return {"Out": [out]}
+    # single-chip / no-pp-axis: run the stages sequentially (the exact
+    # math the pipeline schedule computes, minus the ring); stage error
+    # flags escape via the "__errors__" channel like rnn_scan's
+    out = x
+    err = jnp.zeros((), bool)
+    for s in range(S):
+        out, serr = stage_fn(flat[s * Pn:(s + 1) * Pn], out, s, False)
+        err = err | serr
+    return {"Out": [out], "__errors__": err}
 
 
 def _pipeline_infer(block, op, out_vars):

@@ -1,0 +1,349 @@
+"""Readers for reference-era on-disk artifacts (no protobuf runtime).
+
+The reference serializes programs as the `ProgramDesc` protobuf of
+paddle/fluid/framework/framework.proto (written by
+python/paddle/fluid/io.py:384 save_inference_model via
+`program.desc.serialize_to_string()`), and parameters as the LoDTensor
+stream of paddle/fluid/framework/lod_tensor.cc:243 SerializeToStream /
+tensor_util.cc:191 TensorToStream (written by operators/save_op.cc, one
+file per variable named after it).
+
+This module hand-rolls the protobuf wire format (proto2, only the field
+shapes framework.proto actually uses) so a model saved by reference-era
+code loads into a TPU-native Program — the one migration path source-level
+compatibility can't cover.
+"""
+import struct
+
+import numpy as np
+
+from .core.framework import Program
+
+__all__ = ["parse_program_desc", "read_lod_tensor_file",
+           "strip_feed_fetch"]
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire primitives (proto2)
+# ---------------------------------------------------------------------------
+
+def _varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("malformed varint")
+
+
+def _skip(buf, pos, wire):
+    if wire == 0:          # varint
+        _, pos = _varint(buf, pos)
+    elif wire == 1:        # fixed64
+        pos += 8
+    elif wire == 2:        # length-delimited
+        n, pos = _varint(buf, pos)
+        pos += n
+    elif wire == 5:        # fixed32
+        pos += 4
+    else:
+        raise ValueError("unsupported wire type %d" % wire)
+    return pos
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    value: int for varint/fixed, bytes for length-delimited."""
+    pos, end = 0, len(buf)
+    while pos < end:
+        key, pos = _varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _varint(buf, pos)
+        elif wire == 1:
+            v = struct.unpack("<q", buf[pos:pos + 8])[0]
+            pos += 8
+        elif wire == 2:
+            n, pos = _varint(buf, pos)
+            v = buf[pos:pos + n]
+            pos += n
+        elif wire == 5:
+            v = struct.unpack("<i", buf[pos:pos + 4])[0]
+            pos += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        yield field, wire, v
+
+
+def _sint32(v):
+    """proto int32 arrives as a 64-bit varint two's complement."""
+    v &= (1 << 64) - 1
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _repeated_varints(wire, v):
+    """A repeated varint field: packed (length-delimited) or one value."""
+    if wire == 2:
+        out, pos = [], 0
+        while pos < len(v):
+            x, pos = _varint(v, pos)
+            out.append(_sint32(x))
+        return out
+    return [_sint32(v)]
+
+
+def _f32(wire, v):
+    if wire == 5:
+        return struct.unpack("<f", struct.pack("<i", v))[0]
+    raise ValueError("expected fixed32 float, wire %d" % wire)
+
+
+# ---------------------------------------------------------------------------
+# framework.proto messages
+# ---------------------------------------------------------------------------
+
+_DTYPE = {0: "bool", 1: "int16", 2: "int32", 3: "int64",
+          4: "float16", 5: "float32", 6: "float64"}
+# VarType.Type values describing non-dense runtime objects
+_LOD_TENSOR, _READER = 7, 15
+_FEED_MINIBATCH, _FETCH_LIST = 9, 10
+
+
+def _parse_tensor_desc(buf):
+    dtype, dims = None, []
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            dtype = _DTYPE.get(v, "float32")
+        elif field == 2:
+            dims.extend(_repeated_varints(wire, v))
+    return dtype, dims
+
+
+def _parse_var_type(buf):
+    """VarType -> (type_enum, dtype, dims, lod_level)."""
+    t, dtype, dims, lod_level = None, None, None, 0
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            t = v
+        elif field == 3:  # LoDTensorDesc
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1:
+                    dtype, dims = _parse_tensor_desc(v2)
+                elif f2 == 2:
+                    lod_level = v2
+    return t, dtype, dims, lod_level
+
+
+def _parse_var_desc(buf):
+    name, vtype, persistable = None, None, False
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            name = v.decode("utf-8")
+        elif field == 2:
+            vtype = _parse_var_type(v)
+        elif field == 3:
+            persistable = bool(v)
+    return name, vtype, persistable
+
+
+def _parse_op_var(buf):
+    slot, args = None, []
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            slot = v.decode("utf-8")
+        elif field == 2:
+            args.append(v.decode("utf-8"))
+    return slot, args
+
+
+def _parse_attr(buf):
+    name = None
+    atype = None
+    vals = {}
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            name = v.decode("utf-8")
+        elif field == 2:
+            atype = v
+        elif field == 3:
+            vals["i"] = _sint32(v)
+        elif field == 4:
+            vals["f"] = _f32(wire, v)
+        elif field == 5:
+            vals["s"] = v.decode("utf-8")
+        elif field == 6:
+            vals.setdefault("ints", []).extend(_repeated_varints(wire, v))
+        elif field == 7:
+            if wire == 2:  # packed floats
+                vals.setdefault("floats", []).extend(
+                    struct.unpack("<%df" % (len(v) // 4), v))
+            else:
+                vals.setdefault("floats", []).append(_f32(wire, v))
+        elif field == 8:
+            vals.setdefault("strings", []).append(v.decode("utf-8"))
+        elif field == 10:
+            vals["b"] = bool(v)
+        elif field == 11:
+            vals.setdefault("bools", []).extend(
+                [bool(x) for x in _repeated_varints(wire, v)])
+        elif field == 12:
+            vals["block_idx"] = _sint32(v)
+        elif field == 13:
+            vals["l"] = _sint32(v)
+    # AttrType: INT FLOAT STRING INTS FLOATS STRINGS BOOLEAN BOOLEANS
+    #           BLOCK LONG
+    pick = {0: vals.get("i"), 1: vals.get("f"), 2: vals.get("s"),
+            3: vals.get("ints", []), 4: vals.get("floats", []),
+            5: vals.get("strings", []), 6: vals.get("b"),
+            7: vals.get("bools", []), 8: vals.get("block_idx"),
+            9: vals.get("l")}
+    if atype not in pick:
+        raise ValueError("unknown AttrType %r for attr %r" % (atype, name))
+    return name, pick[atype]
+
+
+def _parse_op_desc(buf):
+    inputs, outputs, attrs = {}, {}, {}
+    op_type = None
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            slot, args = _parse_op_var(v)
+            inputs[slot] = args
+        elif field == 2:
+            slot, args = _parse_op_var(v)
+            outputs[slot] = args
+        elif field == 3:
+            op_type = v.decode("utf-8")
+        elif field == 4:
+            name, value = _parse_attr(v)
+            attrs[name] = value
+    return op_type, inputs, outputs, attrs
+
+
+def _parse_block_desc(buf):
+    idx, parent, varz, ops = 0, -1, [], []
+    for field, wire, v in _fields(buf):
+        if field == 1:
+            idx = _sint32(v)
+        elif field == 2:
+            parent = _sint32(v)
+        elif field == 3:
+            varz.append(_parse_var_desc(v))
+        elif field == 4:
+            ops.append(_parse_op_desc(v))
+    return idx, parent, varz, ops
+
+
+def _parse_blocks(raw):
+    """ProgramDesc bytes -> [(idx, parent, vars, ops)] sorted by idx —
+    the single wire-decode both parse_program_desc and strip_feed_fetch
+    build on."""
+    blocks = []
+    for field, wire, v in _fields(raw):
+        if field == 1:
+            blocks.append(_parse_block_desc(v))
+    blocks.sort(key=lambda b: b[0])
+    return blocks
+
+
+def parse_program_desc(raw):
+    """ProgramDesc protobuf bytes -> Program (cites framework.proto;
+    the writer is python/paddle/fluid/framework.py Program.desc)."""
+    blocks = _parse_blocks(raw) if isinstance(raw, (bytes, bytearray)) \
+        else raw
+
+    program = Program()
+    # Program() starts with block 0; create the rest preserving parents
+    for idx, parent, _, _ in blocks[1:]:
+        program.create_block(parent_idx=max(parent, 0))
+    program.current_block_idx = 0
+
+    for idx, parent, varz, ops in blocks:
+        blk = program.blocks[idx]
+        for name, vtype, persistable in varz:
+            t, dtype, dims, lod_level = vtype if vtype else (
+                None, None, None, 0)
+            if t in (_FEED_MINIBATCH, _FETCH_LIST):
+                continue  # feed/fetch plumbing; the Executor feeds directly
+            blk.create_var(
+                name=name, shape=tuple(dims) if dims is not None else None,
+                dtype=dtype or "float32", lod_level=lod_level or 0,
+                persistable=persistable)
+        for op_type, ins, outs, attrs in ops:
+            if op_type in ("feed", "fetch"):
+                continue  # recovered separately by strip_feed_fetch
+            blk.append_op(type=op_type, inputs=ins, outputs=outs,
+                          attrs=attrs, infer_shape=False)
+    program.current_block_idx = 0
+    return program
+
+
+def strip_feed_fetch(blocks):
+    """Feed/fetch targets of a reference inference ProgramDesc: the names
+    wired through its prepended `feed` / appended `fetch` ops
+    (python/paddle/fluid/io.py get_feed_targets_names). Accepts the
+    _parse_blocks result (or raw bytes)."""
+    if isinstance(blocks, (bytes, bytearray)):
+        blocks = _parse_blocks(blocks)
+    feeds, fetches = [], []
+    if blocks:
+        _, _, _, ops = blocks[0]  # feed/fetch live in the global block
+        for op_type, ins, outs, attrs in ops:
+            if op_type == "feed":
+                feeds.insert(attrs.get("col", len(feeds)),
+                             outs["Out"][0])
+            elif op_type == "fetch":
+                fetches.append(ins["X"][0])
+    return feeds, fetches
+
+
+# ---------------------------------------------------------------------------
+# LoDTensor stream (save_op output, one file per variable)
+# ---------------------------------------------------------------------------
+
+def read_lod_tensor_file(path):
+    """Parse one reference save_op file -> (np.ndarray, lod levels list).
+
+    Layout (lod_tensor.cc SerializeToStream):
+      u32 version(0) | u64 lod_level | per level: u64 nbytes + size_t data
+      | u32 tensor version(0) | i32 desc_size | TensorDesc proto | raw data
+    """
+    with open(path, "rb") as f:
+        buf = f.read()
+    pos = 0
+
+    def u32():
+        nonlocal pos
+        v = struct.unpack_from("<I", buf, pos)[0]
+        pos += 4
+        return v
+
+    def u64():
+        nonlocal pos
+        v = struct.unpack_from("<Q", buf, pos)[0]
+        pos += 8
+        return v
+
+    version = u32()
+    if version != 0:
+        raise ValueError("unsupported LoDTensor version %d" % version)
+    lod = []
+    for _ in range(u64()):
+        nbytes = u64()
+        level = np.frombuffer(buf, "<u8", count=nbytes // 8, offset=pos)
+        pos += nbytes
+        lod.append(level.tolist())
+    tversion = u32()
+    if tversion != 0:
+        raise ValueError("unsupported Tensor version %d" % tversion)
+    desc_size = struct.unpack_from("<i", buf, pos)[0]
+    pos += 4
+    dtype, dims = _parse_tensor_desc(buf[pos:pos + desc_size])
+    pos += desc_size
+    arr = np.frombuffer(buf, np.dtype(dtype), offset=pos).reshape(dims)
+    return arr, lod

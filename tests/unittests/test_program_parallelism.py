@@ -176,3 +176,25 @@ def test_block_sig_ignores_generated_name_attrs():
 
     assert make(["stage0.in"], "relu") == make(["stage1.in"], "relu")
     assert make(["stage0.in"], "relu") != make(["stage0.in"], "tanh")
+
+
+def test_pipelined_stack_topology_divergence_rejected():
+    """Stages with identical op types/attrs/param shapes but different
+    WIRING (fc(fc(x)) vs fc(x)+fc(x)) must be rejected — the template
+    would silently impose stage 0's topology."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        n = iter([0, 1])
+
+        def stage(xin):
+            a = fluid.layers.fc(input=xin, size=16)
+            src = a if next(n) == 0 else xin  # stage 1 rewires to xin
+            b = fluid.layers.fc(input=src, size=16)
+            return b
+
+        try:
+            fluid.layers.pipelined_stack(x, 2, stage)
+            assert False, "expected ValueError"
+        except ValueError as e:
+            assert "homogeneous" in str(e)

@@ -1,0 +1,212 @@
+"""Loading reference-era artifacts: a __model__ ProgramDesc protobuf +
+save_op LoDTensor param files (round-3 verdict #4).
+
+The fixture is built by a minimal proto2 WRITER implemented here from the
+same framework.proto schema the reference serialized with
+(paddle/fluid/framework/framework.proto) — byte-for-byte the wire format
+`program.desc.serialize_to_string()` produced — plus save_op's LoDTensor
+stream layout (lod_tensor.cc SerializeToStream).
+"""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import reference_format as rf
+
+
+# --- proto2 wire writer (test-only) ----------------------------------------
+
+def _varint(v):
+    out = b""
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _ld(field, payload):  # length-delimited
+    if isinstance(payload, str):
+        payload = payload.encode("utf-8")
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _vi(field, v):
+    return _tag(field, 0) + _varint(v)
+
+
+def _f32(field, v):
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def tensor_desc(dtype_enum, dims):
+    return _vi(1, dtype_enum) + b"".join(_vi(2, d) for d in dims)
+
+
+def var_desc(name, dtype_enum, dims, persistable=False, var_type=7,
+             lod_level=0):
+    if var_type == 7:  # LOD_TENSOR
+        lodt = _ld(1, tensor_desc(dtype_enum, dims))
+        if lod_level:
+            lodt += _vi(2, lod_level)
+        vtype = _vi(1, 7) + _ld(3, lodt)
+    else:  # FEED_MINIBATCH / FETCH_LIST plumbing vars
+        vtype = _vi(1, var_type)
+    out = _ld(1, name) + _ld(2, vtype)
+    if persistable:
+        out += _vi(3, 1)
+    return out
+
+
+def op_var(slot, args):
+    return _ld(1, slot) + b"".join(_ld(2, a) for a in args)
+
+
+def attr(name, atype, value):
+    out = _ld(1, name) + _vi(2, atype)
+    if atype == 0:
+        out += _vi(3, value)
+    elif atype == 1:
+        out += _f32(4, value)
+    elif atype == 2:
+        out += _ld(5, value)
+    elif atype == 3:
+        out += b"".join(_vi(6, v) for v in value)
+    elif atype == 6:
+        out += _vi(10, 1 if value else 0)
+    else:
+        raise NotImplementedError(atype)
+    return out
+
+
+def op_desc(op_type, inputs, outputs, attrs=()):
+    out = _ld(3, op_type)
+    for slot, args in inputs:
+        out += _ld(1, op_var(slot, args))
+    for slot, args in outputs:
+        out += _ld(2, op_var(slot, args))
+    for a in attrs:
+        out += _ld(4, a)
+    return out
+
+
+def block_desc(idx, parent, varz, ops):
+    out = _vi(1, idx) + _tag(2, 0) + _varint(parent & ((1 << 64) - 1))
+    for v in varz:
+        out += _ld(3, v)
+    for o in ops:
+        out += _ld(4, o)
+    return out
+
+
+def lod_tensor_file(path, arr):
+    """save_op layout: u32 ver | u64 lod levels | u32 tensor ver |
+    i32 desc size | TensorDesc | raw data."""
+    dt = {np.dtype("float32"): 5, np.dtype("int64"): 3}[arr.dtype]
+    desc = tensor_desc(dt, arr.shape)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<I", 0))          # LoDTensor version
+        f.write(struct.pack("<Q", 0))          # no lod levels
+        f.write(struct.pack("<I", 0))          # Tensor version
+        f.write(struct.pack("<i", len(desc)))
+        f.write(desc)
+        f.write(arr.tobytes())
+
+
+@pytest.fixture
+def reference_model_dir(tmp_path):
+    """A reference-era save_inference_model directory: x -> relu(fc(x))
+    -> softmax, with prepended feed / appended fetch ops."""
+    rng = np.random.RandomState(5)
+    w = rng.randn(4, 3).astype("float32")
+    b = rng.randn(3).astype("float32")
+
+    varz = [
+        var_desc("feed", 0, [], var_type=9),
+        var_desc("fetch", 0, [], var_type=10),
+        var_desc("x", 5, [-1, 4]),
+        var_desc("fc_0.w_0", 5, [4, 3], persistable=True),
+        var_desc("fc_0.b_0", 5, [3], persistable=True),
+        var_desc("fc_0.tmp_0", 5, [-1, 3]),
+        var_desc("fc_0.tmp_1", 5, [-1, 3]),
+        var_desc("relu_0.tmp_0", 5, [-1, 3]),
+        var_desc("softmax_0.tmp_0", 5, [-1, 3]),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["x"])],
+                [attr("col", 0, 0)]),
+        op_desc("mul", [("X", ["x"]), ("Y", ["fc_0.w_0"])],
+                [("Out", ["fc_0.tmp_0"])],
+                [attr("x_num_col_dims", 0, 1), attr("y_num_col_dims", 0, 1)]),
+        op_desc("elementwise_add",
+                [("X", ["fc_0.tmp_0"]), ("Y", ["fc_0.b_0"])],
+                [("Out", ["fc_0.tmp_1"])], [attr("axis", 0, 1)]),
+        op_desc("relu", [("X", ["fc_0.tmp_1"])],
+                [("Out", ["relu_0.tmp_0"])]),
+        op_desc("softmax", [("X", ["relu_0.tmp_0"])],
+                [("Out", ["softmax_0.tmp_0"])]),
+        op_desc("fetch", [("X", ["softmax_0.tmp_0"])],
+                [("Out", ["fetch"])], [attr("col", 0, 0)]),
+    ]
+    program_bytes = _ld(1, block_desc(0, -1, varz, ops))
+
+    d = tmp_path / "ref_model"
+    d.mkdir()
+    (d / "__model__").write_bytes(program_bytes)
+    lod_tensor_file(str(d / "fc_0.w_0"), w)
+    lod_tensor_file(str(d / "fc_0.b_0"), b)
+    return str(d), w, b
+
+
+def test_load_reference_model_runs_inference(reference_model_dir):
+    dirname, w, b = reference_model_dir
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        program, feed_names, fetch_vars = fluid.io.load_reference_model(
+            dirname, exe)
+        assert feed_names == ["x"]
+        assert [v.name for v in fetch_vars] == ["softmax_0.tmp_0"]
+        # params landed in the scope with the file's exact values
+        np.testing.assert_array_equal(np.asarray(scope.get("fc_0.w_0")), w)
+
+        xs = np.random.RandomState(0).rand(6, 4).astype("float32")
+        out, = exe.run(program, feed={"x": xs}, fetch_list=fetch_vars)
+
+    h = np.maximum(xs @ w + b, 0)
+    e = np.exp(h - h.max(-1, keepdims=True))
+    ref = e / e.sum(-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_parse_program_desc_structure(reference_model_dir):
+    dirname, _, _ = reference_model_dir
+    raw = open(os.path.join(dirname, "__model__"), "rb").read()
+    program = rf.parse_program_desc(raw)
+    gb = program.global_block()
+    # feed/fetch plumbing stripped; compute ops kept in order
+    assert [op.type for op in gb.ops] == ["mul", "elementwise_add",
+                                          "relu", "softmax"]
+    assert gb.var("fc_0.w_0").persistable
+    assert tuple(gb.var("x").shape) == (-1, 4)
+    assert gb.ops[0].attrs["x_num_col_dims"] == 1
+    assert gb.ops[1].attrs["axis"] == 1
+
+
+def test_read_lod_tensor_file_roundtrip(tmp_path):
+    arr = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    p = str(tmp_path / "t")
+    lod_tensor_file(p, arr)
+    got, lod = rf.read_lod_tensor_file(p)
+    np.testing.assert_array_equal(got, arr)
+    assert lod == []
