@@ -41,21 +41,6 @@ def _varint(buf, pos):
             raise ValueError("malformed varint")
 
 
-def _skip(buf, pos, wire):
-    if wire == 0:          # varint
-        _, pos = _varint(buf, pos)
-    elif wire == 1:        # fixed64
-        pos += 8
-    elif wire == 2:        # length-delimited
-        n, pos = _varint(buf, pos)
-        pos += n
-    elif wire == 5:        # fixed32
-        pos += 4
-    else:
-        raise ValueError("unsupported wire type %d" % wire)
-    return pos
-
-
 def _fields(buf):
     """Yield (field_number, wire_type, value) over one message's bytes.
     value: int for varint/fixed, bytes for length-delimited."""
@@ -295,11 +280,14 @@ def strip_feed_fetch(blocks):
         _, _, _, ops = blocks[0]  # feed/fetch live in the global block
         for op_type, ins, outs, attrs in ops:
             if op_type == "feed":
-                feeds.insert(attrs.get("col", len(feeds)),
-                             outs["Out"][0])
+                feeds.append((attrs.get("col", len(feeds)),
+                              outs["Out"][0]))
             elif op_type == "fetch":
-                fetches.append(ins["X"][0])
-    return feeds, fetches
+                fetches.append((attrs.get("col", len(fetches)),
+                                ins["X"][0]))
+    # the era's prepend_feed_ops inserts at block index 0, so a real
+    # __model__ lists feed ops col n-1..0 — order by col, not block order
+    return [n for _, n in sorted(feeds)], [n for _, n in sorted(fetches)]
 
 
 # ---------------------------------------------------------------------------

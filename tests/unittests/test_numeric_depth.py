@@ -1,0 +1,263 @@
+"""Numeric depth for round-2's thin test spots (verdict #7): beam_search
+vs an independent host-side beam implementation, finite-difference grad
+checks for the differentiable detection ops, and Executor cache behavior.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+# ---------------------------------------------------------------------------
+# beam_search vs host reference
+# ---------------------------------------------------------------------------
+
+def _host_beam_step(pre_ids, pre_scores, logp, beam_size, end_id):
+    """Independent numpy implementation of the dense beam-search step
+    contract: finished beams (pre_id == end_id) may only extend with
+    end_id at zero added cost; top-k over beam*vocab."""
+    B, K, V = logp.shape
+    total = pre_scores[:, :, None] + logp
+    finished = pre_ids == end_id
+    for b in range(B):
+        for k in range(K):
+            if finished[b, k]:
+                total[b, k, :] = -1e9
+                total[b, k, end_id] = pre_scores[b, k]
+    flat = total.reshape(B, K * V)
+    # stable top-k by score desc (ties: lower flat index first, matching
+    # lax.top_k)
+    idx = np.argsort(-flat, axis=1, kind="stable")[:, :beam_size]
+    sel_scores = np.take_along_axis(flat, idx, axis=1)
+    parent = idx // V
+    token = idx % V
+    return token.astype(pre_ids.dtype), sel_scores.astype("float32"), \
+        parent.astype("int32")
+
+
+def _run_beam_step(pre_ids_np, pre_scores_np, logp_np, K, end_id):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        pre_ids = fluid.layers.data("pre_ids", [K], dtype="int64")
+        pre_scores = fluid.layers.data("pre_scores", [K])
+        scores = fluid.layers.data("scores", [K, logp_np.shape[2]])
+        ids, sc, par = fluid.layers.beam_search(
+            pre_ids=pre_ids, pre_scores=pre_scores, ids=None, scores=scores,
+            beam_size=K, end_id=end_id, return_parent_idx=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed={"pre_ids": pre_ids_np,
+                                   "pre_scores": pre_scores_np,
+                                   "scores": logp_np},
+                       fetch_list=[ids, sc, par])
+
+
+def test_beam_search_step_matches_host_reference():
+    rng = np.random.RandomState(11)
+    B, K, V, end_id = 3, 4, 11, 2
+    logp = np.log(rng.dirichlet(np.ones(V), size=(B, K))).astype("f")
+    pre_scores = (-rng.rand(B, K).cumsum(1)).astype("f")  # decreasing
+    pre_ids = rng.randint(3, V, (B, K)).astype("int64")
+    pre_ids[0, 1] = end_id  # one finished beam
+    pre_ids[2, 0] = end_id
+    got_ids, got_sc, got_par = _run_beam_step(
+        pre_ids, pre_scores, logp, K, end_id)
+    ref_ids, ref_sc, ref_par = _host_beam_step(
+        pre_ids, pre_scores, logp.astype("f8"), K, end_id)
+    np.testing.assert_allclose(np.asarray(got_sc), ref_sc,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_ids), ref_ids)
+    np.testing.assert_array_equal(np.asarray(got_par), ref_par)
+
+
+def test_beam_search_multistep_matches_host_reference():
+    """Chain T framework beam steps over a fixed transition 'LM' and
+    compare the surviving hypotheses with a pure-python list-based beam
+    search (independent bookkeeping: full hypothesis lists, no parent
+    backtrace)."""
+    rng = np.random.RandomState(5)
+    B, K, V, end_id, T = 2, 3, 9, 2, 6
+    trans = np.log(rng.dirichlet(np.ones(V), size=V)).astype("f8")  # [V,V]
+
+    # framework side
+    pre_ids = np.full((B, K), 1, "int64")  # bos
+    pre_scores = np.zeros((B, K), "f")
+    pre_scores[:, 1:] = -1e9               # break beam symmetry
+    hyps = [[[1] for _ in range(K)] for _ in range(B)]
+    for t in range(T):
+        logp = trans[pre_ids].astype("f")  # [B, K, V]
+        got_ids, got_sc, got_par = _run_beam_step(
+            pre_ids, pre_scores, logp, K, end_id)
+        got_ids, got_sc, got_par = (np.asarray(got_ids),
+                                    np.asarray(got_sc),
+                                    np.asarray(got_par))
+        hyps = [[hyps[b][got_par[b, k]] + [int(got_ids[b, k])]
+                 for k in range(K)] for b in range(B)]
+        pre_ids, pre_scores = got_ids, got_sc
+
+    # independent python beam search over the same LM
+    for b in range(B):
+        beams = [([1], 0.0)]
+        for t in range(T):
+            cand = []
+            for toks, s in beams:
+                if toks[-1] == end_id:
+                    cand.append((toks + [end_id], s))
+                    continue
+                for v in range(V):
+                    cand.append((toks + [v], s + trans[toks[-1], v]))
+            cand.sort(key=lambda c: -c[1])
+            beams = cand[:K]
+        for k in range(K):
+            assert beams[k][0] == hyps[b][k], (b, k, beams[k], hyps[b][k])
+            np.testing.assert_allclose(pre_scores[b, k], beams[k][1],
+                                       rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# detection op gradients vs finite differences
+# ---------------------------------------------------------------------------
+
+def _fd_check(build_out, x_np, rtol=2e-2, atol=2e-3, eps=1e-3):
+    """Analytic d(mean(out))/dx via calc_gradient vs central differences.
+    build_out(x_var) -> scalar-able Variable."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", list(x_np.shape[1:]), dtype="float32")
+        x.stop_gradient = False
+        loss = fluid.layers.mean(build_out(x))
+        grads = fluid.backward.calc_gradient(loss, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+
+        def f(arr):
+            out, = exe.run(main, feed={"x": arr.astype("f")},
+                           fetch_list=[loss])
+            return float(np.ravel(out)[0])
+
+        g, = exe.run(main, feed={"x": x_np}, fetch_list=grads)
+        g = np.asarray(g).reshape(x_np.shape)
+        num = np.zeros_like(x_np, dtype="f8")
+        it = np.nditer(x_np, flags=["multi_index"])
+        while not it.finished:
+            i = it.multi_index
+            up, dn = x_np.copy(), x_np.copy()
+            up[i] += eps
+            dn[i] -= eps
+            num[i] = (f(up) - f(dn)) / (2 * eps)
+            it.iternext()
+        np.testing.assert_allclose(g, num, rtol=rtol, atol=atol)
+
+
+def test_iou_similarity_grad_fd():
+    rng = np.random.RandomState(3)
+    # boxes [N, 4] (xmin, ymin, xmax, ymax), well-separated from FD kinks
+    x = np.array([[0.1, 0.1, 0.6, 0.7],
+                  [0.3, 0.2, 0.9, 0.8]], "f")
+    y = np.array([[0.2, 0.15, 0.7, 0.65],
+                  [0.05, 0.3, 0.55, 0.9],
+                  [0.4, 0.4, 0.95, 0.95]], "f")
+
+    def build(xv):
+        yv = fluid.layers.assign(y)
+        return fluid.layers.iou_similarity(xv, yv)
+
+    _fd_check(build, x)
+
+
+def test_box_coder_grad_fd():
+    prior = np.array([[0.1, 0.1, 0.5, 0.5],
+                      [0.3, 0.3, 0.8, 0.9]], "f")
+    pvar = np.array([[0.1, 0.1, 0.2, 0.2]] * 2, "f")
+    # well-conditioned target widths/heights (>= 0.3): the encode's log()
+    # curvature otherwise dominates the finite-difference truncation
+    target = np.array([[0.15, 0.2, 0.55, 0.6],
+                       [0.25, 0.1, 0.7, 0.75]], "f")
+
+    def build(tv):
+        pb = fluid.layers.assign(prior)
+        pbv = fluid.layers.assign(pvar)
+        return fluid.layers.box_coder(pb, pbv, tv,
+                                      code_type="encode_center_size")
+
+    _fd_check(build, target)
+
+
+def test_smooth_l1_ssd_regression_grad_fd():
+    """The differentiable core of the ssd_loss path: smooth_l1 over
+    predicted locations (matching/targets fixed)."""
+    rng = np.random.RandomState(6)
+    loc = (rng.rand(3, 8).astype("f") - 0.5)
+    gt = (rng.rand(3, 8).astype("f") - 0.5)
+
+    def build(lv):
+        gv = fluid.layers.assign(gt)
+        return fluid.layers.smooth_l1(x=lv, y=gv)
+
+    _fd_check(build, loc)
+
+
+# ---------------------------------------------------------------------------
+# Executor cache behavior
+# ---------------------------------------------------------------------------
+
+def _linreg():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_executor_cache_off_matches_cached():
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 4).astype("f")
+    ys = xs.sum(1, keepdims=True).astype("f")
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    results = []
+    for use_cache in (True, False):
+        main, startup, loss = _linreg()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            init = {n: np.asarray(scope.get(n)) for n in scope.names()}
+            for n, v in init.items():
+                scope.set(n, v)
+            scope._rng_counter = 0
+            vals = [float(np.ravel(exe.run(
+                main, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                use_program_cache=use_cache)[0])[0]) for _ in range(4)]
+            results.append(vals)
+    # same seeds, same math — caching must not change numerics
+    assert results[0] == results[1] or np.allclose(results[0], results[1],
+                                                   rtol=1e-6)
+
+
+def test_executor_requires_program_uid():
+    """The compile cache keys on program._uid — a Program-like object
+    without one is rejected instead of falling back to id() (round-1/2
+    aliasing hazard)."""
+    main, startup, loss = _linreg()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    class FakeProgram(object):
+        def __init__(self, real):
+            self.__dict__ = dict(real.__dict__)
+            del self.__dict__["_uid"]
+
+        def __getattr__(self, k):
+            raise AttributeError(k)
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        try:
+            exe.run(FakeProgram(main), feed={}, fetch_list=[loss])
+            assert False, "expected AttributeError for missing _uid"
+        except AttributeError:
+            pass

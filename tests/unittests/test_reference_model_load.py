@@ -210,3 +210,20 @@ def test_read_lod_tensor_file_roundtrip(tmp_path):
     got, lod = rf.read_lod_tensor_file(p)
     np.testing.assert_array_equal(got, arr)
     assert lod == []
+
+
+def test_strip_feed_fetch_descending_col_order():
+    """The reference's prepend_feed_ops inserts each feed op at block
+    index 0, so real __model__ files list feed ops col n-1..0 — feed
+    order must come from the col attr, not block order."""
+    varz = [var_desc("feed", 0, [], var_type=9),
+            var_desc("fetch", 0, [], var_type=10)] + [
+        var_desc("x%d" % i, 5, [-1, 2]) for i in range(3)]
+    ops = [op_desc("feed", [("X", ["feed"])], [("Out", ["x%d" % c])],
+                   [attr("col", 0, c)]) for c in (2, 1, 0)]
+    ops += [op_desc("fetch", [("X", ["x%d" % c])], [("Out", ["fetch"])],
+                    [attr("col", 0, c)]) for c in (1, 0)]
+    raw = _ld(1, block_desc(0, -1, varz, ops))
+    feeds, fetches = rf.strip_feed_fetch(raw)
+    assert feeds == ["x0", "x1", "x2"]
+    assert fetches == ["x0", "x1"]
