@@ -1,0 +1,79 @@
+"""Python half of the C inference API (native/inference_c.cc).
+
+The reference ships a C++ inference library + C API
+(paddle/fluid/inference/io.cc, paddle/capi) whose job is: load a saved
+inference model, feed C buffers, run, read C buffers back. TPU-native,
+the inference engine IS the XLA runtime, so the C entry embeds CPython
+and delegates here; this module keeps the C side to a dozen stable calls
+(create/run/destroy + buffer marshalling). Each predictor owns a private
+Scope; jit caching makes repeated run() calls compile-free.
+"""
+import os
+
+import numpy as np
+
+from .core.executor import Executor, scope_guard, Scope
+from . import io as _io
+from .places import CPUPlace, TPUPlace
+
+_predictors = {}
+_next_handle = [1]
+
+
+def _place():
+    """PTPU_PLACE=tpu serves on the accelerator; default CPU (the safe
+    choice for a C host process that may not own the TPU lease)."""
+    return TPUPlace() if os.environ.get("PTPU_PLACE", "cpu") == "tpu" \
+        else CPUPlace()
+
+
+def create(model_dir):
+    """Load a saved inference model (this framework's format when
+    __model_meta__.json is present, otherwise a reference-era
+    save_inference_model directory). Returns an int handle.
+
+    create() is NOT thread-safe (the io loaders write through the
+    process-global scope guard); initialize predictors before spawning
+    serving threads. run() is safe to call concurrently across handles.
+    """
+    exe = Executor(_place())
+    scope = Scope()
+    with scope_guard(scope):
+        if os.path.exists(os.path.join(model_dir, "__model_meta__.json")):
+            program, feeds, fetches = _io.load_inference_model(
+                model_dir, exe)
+        else:
+            program, feeds, fetches = _io.load_reference_model(
+                model_dir, exe)
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _predictors[h] = (exe, scope, program, list(feeds), fetches)
+    return h
+
+
+def feed_names(handle):
+    return list(_predictors[handle][3])
+
+
+def num_fetches(handle):
+    return len(_predictors[handle][4])
+
+
+def run(handle, names, buffers, shapes):
+    """names: feed names; buffers: per-feed bytes-like of float32 data;
+    shapes: per-feed int lists. Returns list of float32 C-contiguous
+    numpy arrays (one per fetch target)."""
+    exe, scope, program, _feeds, fetches = _predictors[handle]
+    feed = {}
+    for name, buf, shape in zip(names, buffers, shapes):
+        feed[name] = np.frombuffer(buf, dtype=np.float32).reshape(
+            [int(s) for s in shape])
+    # scope passed explicitly — scope_guard mutates a process global and
+    # would race when a multithreaded C host runs two predictors at once
+    outs = exe.run(program, feed=feed, fetch_list=fetches, scope=scope)
+    return [np.ascontiguousarray(np.asarray(o, dtype=np.float32))
+            for o in outs]
+
+
+def destroy(handle):
+    _predictors.pop(handle, None)

@@ -1,0 +1,214 @@
+// C inference API for paddle_tpu (parity: the reference's C++ inference
+// lib + C API — paddle/fluid/inference/io.cc LoadInferenceModel + run,
+// paddle/capi/. There the engine is hand-written CPU/CUDA kernels; here
+// the engine IS the XLA runtime, so this entry embeds CPython and
+// delegates model loading / jit / execution to paddle_tpu.capi_host,
+// keeping a stable C ABI a serving process can link against with no
+// Python in its own source.
+//
+// Build: make -C paddle_tpu/native libptpu_infer.so
+// Use:   ptpu_create(model_dir) -> handle (>0)
+//        ptpu_run(handle, names, bufs, shapes, ndims, nfeeds,
+//                 out, out_cap, out_shape, out_ndim_cap, &out_ndim)
+//        ptpu_destroy(handle); ptpu_last_error() for diagnostics.
+// float32 in/out; one fetch target (index 0) in v1 — the era's C API
+// served single-output predictors the same way.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+std::string g_err;
+
+void set_err_from_python() {
+  PyObject *type = nullptr, *val = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &val, &tb);
+  PyErr_NormalizeException(&type, &val, &tb);
+  g_err = "python error";
+  if (val) {
+    PyObject* s = PyObject_Str(val);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_err = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(val);
+  Py_XDECREF(tb);
+}
+
+PyObject* host_module() {
+  PyObject* m = PyImport_ImportModule("paddle_tpu.capi_host");
+  if (!m) set_err_from_python();
+  return m;
+}
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() : state(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state); }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns the last error message (thread-unsafe global, like errno).
+const char* ptpu_last_error() { return g_err.c_str(); }
+
+// Initialize the embedded interpreter (no-op when hosted inside an
+// existing Python process, e.g. loaded via ctypes).
+void ptpu_init() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL acquired by Py_Initialize so Gil{} can take it
+    PyEval_SaveThread();
+  }
+}
+
+// Load a saved inference model directory. Returns handle > 0, or 0 on
+// error (see ptpu_last_error).
+int64_t ptpu_create(const char* model_dir) {
+  ptpu_init();
+  Gil gil;
+  PyObject* m = host_module();
+  if (!m) return 0;
+  PyObject* r = PyObject_CallMethod(m, "create", "s", model_dir);
+  Py_DECREF(m);
+  if (!r) {
+    set_err_from_python();
+    return 0;
+  }
+  int64_t h = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return h;
+}
+
+// Number of feed targets; feed name by index (borrowed until next call).
+int ptpu_num_feeds(int64_t handle) {
+  ptpu_init();
+  Gil gil;
+  PyObject* m = host_module();
+  if (!m) return -1;
+  PyObject* r = PyObject_CallMethod(m, "feed_names", "L", handle);
+  Py_DECREF(m);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  int n = static_cast<int>(PyList_Size(r));
+  Py_DECREF(r);
+  return n;
+}
+
+int ptpu_feed_name(int64_t handle, int i, char* out, int cap) {
+  ptpu_init();
+  Gil gil;
+  PyObject* m = host_module();
+  if (!m) return -1;
+  PyObject* r = PyObject_CallMethod(m, "feed_names", "L", handle);
+  Py_DECREF(m);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  int rc = -1;
+  if (i >= 0 && i < PyList_Size(r)) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    if (s && static_cast<int>(strlen(s)) < cap) {
+      strcpy(out, s);
+      rc = 0;
+    } else {
+      g_err = "feed name buffer too small";
+    }
+  } else {
+    g_err = "feed index out of range";
+  }
+  Py_DECREF(r);
+  return rc;
+}
+
+// Run inference. float32 buffers; fetch target 0 is written to `out`
+// (capacity in elements); its shape to out_shape (out_ndim_cap entries).
+// Returns number of output elements, or -1 on error.
+int64_t ptpu_run(int64_t handle, const char** names, const float** bufs,
+                 const int64_t** shapes, const int* ndims, int nfeeds,
+                 float* out, int64_t out_cap, int64_t* out_shape,
+                 int out_ndim_cap, int* out_ndim) {
+  ptpu_init();
+  Gil gil;
+  PyObject* m = host_module();
+  if (!m) return -1;
+
+  PyObject* pnames = PyList_New(nfeeds);
+  PyObject* pbufs = PyList_New(nfeeds);
+  PyObject* pshapes = PyList_New(nfeeds);
+  for (int i = 0; i < nfeeds; ++i) {
+    int64_t n = 1;
+    for (int d = 0; d < ndims[i]; ++d) n *= shapes[i][d];
+    PyList_SetItem(pnames, i, PyUnicode_FromString(names[i]));
+    PyList_SetItem(
+        pbufs, i,
+        PyMemoryView_FromMemory(
+            reinterpret_cast<char*>(const_cast<float*>(bufs[i])),
+            n * static_cast<int64_t>(sizeof(float)), PyBUF_READ));
+    PyObject* sh = PyList_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; ++d)
+      PyList_SetItem(sh, d, PyLong_FromLongLong(shapes[i][d]));
+    PyList_SetItem(pshapes, i, sh);
+  }
+
+  PyObject* r = PyObject_CallMethod(m, "run", "LOOO", handle, pnames,
+                                    pbufs, pshapes);
+  Py_DECREF(pnames);
+  Py_DECREF(pbufs);
+  Py_DECREF(pshapes);
+  Py_DECREF(m);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+
+  int64_t copied = -1;
+  PyObject* arr = PyList_Size(r) > 0 ? PyList_GetItem(r, 0) : nullptr;
+  if (arr) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arr, &view, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT)
+        == 0) {
+      int64_t n = view.len / static_cast<int64_t>(sizeof(float));
+      if (view.ndim > out_ndim_cap) {
+        g_err = "output rank exceeds out_ndim_cap";
+      } else if (n > out_cap) {
+        g_err = "output larger than out_cap";
+      } else {
+        memcpy(out, view.buf, view.len);
+        for (int d = 0; d < view.ndim; ++d) out_shape[d] = view.shape[d];
+        *out_ndim = view.ndim;
+        copied = n;
+      }
+      PyBuffer_Release(&view);
+    } else {
+      set_err_from_python();
+    }
+  } else {
+    g_err = "predictor returned no outputs";
+  }
+  Py_DECREF(r);
+  return copied;
+}
+
+void ptpu_destroy(int64_t handle) {
+  ptpu_init();
+  Gil gil;
+  PyObject* m = host_module();
+  if (!m) return;
+  PyObject* r = PyObject_CallMethod(m, "destroy", "L", handle);
+  Py_XDECREF(r);
+  Py_DECREF(m);
+}
+
+}  // extern "C"
