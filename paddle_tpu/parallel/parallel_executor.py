@@ -141,11 +141,16 @@ class ParallelExecutor(object):
         def _batch_leading(name):
             return _var_batch_leading(_find_var(program, name))
 
+        # the batch dim shards over the batch axis only — a dp×sp/pp/ep
+        # mesh must not demand divisibility by the full device count
+        dp = self.mesh.shape.get(self._batch_axis, 1)
+
         def _check_divisible(arr, what):
-            if np.shape(arr) and np.shape(arr)[0] % self.device_count != 0:
+            if np.shape(arr) and np.shape(arr)[0] % dp != 0:
                 raise ValueError(
-                    "batch size %d of %s must divide evenly across %d "
-                    "devices" % (np.shape(arr)[0], what, self.device_count))
+                    "batch size %d of %s must divide evenly across the "
+                    "%d-way %r axis" % (np.shape(arr)[0], what, dp,
+                                        self._batch_axis))
 
         for name, arr in feed_arrays.items():
             if _batch_leading(name):

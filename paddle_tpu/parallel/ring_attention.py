@@ -48,22 +48,27 @@ def attention_reference(q, k, v, causal=False, scale=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block_attend(q, k, v, m, l, o, q_off, k_off, causal, scale):
+def _block_attend(q, k, v, m, l, o, q_off, k_off, causal, scale,
+                  kv_len=None):
     """One online-softmax accumulation step against a single K/V block.
 
     q: [B,Tq,H,D]  k,v: [B,Tk,H,D]  m,l: [B,H,Tq]  o: [B,Tq,H,D]
-    q_off/k_off: global position offsets of the blocks (for causal mask).
+    q_off/k_off: global position offsets of the blocks (for causal mask
+    and the kv_len key-padding mask; kv_len is [B] true key lengths).
     """
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B,H,Tq,Tk]
+    kpos = k_off + jnp.arange(k.shape[1])
     if causal:
         qpos = q_off + jnp.arange(q.shape[1])
-        kpos = k_off + jnp.arange(k.shape[1])
         mask = qpos[:, None] >= kpos[None, :]
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    if kv_len is not None:
+        kmask = kpos[None, :] < kv_len[:, None]           # [B, Tk]
+        logits = jnp.where(kmask[:, None, None, :], logits, _NEG_INF)
     m_blk = jnp.max(logits, axis=-1)                      # [B,H,Tq]
     m_new = jnp.maximum(m, m_blk)
     p = jnp.exp(logits - m_new[..., None])                # [B,H,Tq,Tk]
-    if causal:
+    if causal or kv_len is not None:
         # fully-masked rows would give exp(NEG_INF - NEG_INF) = 1 everywhere;
         # force masked entries to exact zero so l stays 0 and the final
         # clamp yields a zero output row
@@ -76,14 +81,14 @@ def _block_attend(q, k, v, m, l, o, q_off, k_off, causal, scale):
     return m_new, l_new, o_new
 
 
-def _ring_body(axis_name, n, causal, scale, t_q, t_k):
+def _ring_body(axis_name, n, causal, scale, t_q, t_k, kv_len=None):
     def body(step, carry):
         k, v, m, l, o, q, my_idx = carry
         # block currently held arrived from device (my_idx - step) mod n
         src = jnp.mod(my_idx - step, n)
         m, l, o = _block_attend(q, k, v, m, l, o,
                                 q_off=my_idx * t_q, k_off=src * t_k,
-                                causal=causal, scale=scale)
+                                causal=causal, scale=scale, kv_len=kv_len)
         # rotate K/V one hop around the ring (skip after the last block so
         # the loop does exactly n-1 permutes)
         perm = [(i, (i + 1) % n) for i in range(n)]
@@ -96,11 +101,14 @@ def _ring_body(axis_name, n, causal, scale, t_q, t_k):
 
 
 def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
-                   vary_axes=None):
+                   vary_axes=None, kv_len=None):
     """Per-shard ring attention; call inside shard_map over `axis_name`.
 
-    q,k,v: the LOCAL sequence blocks [B, T/sp, H, D]. Returns local output
-    block [B, T/sp, H, D]. Exact (not approximate): matches
+    q,k,v: the LOCAL sequence blocks [B, T/sp, H, D]. kv_len: optional
+    [B] int32 GLOBAL true key lengths (padded-batch masking — keys at
+    global position >= kv_len contribute nothing; same contract as
+    pallas flash_attention's kv_len). Returns local output block
+    [B, T/sp, H, D]. Exact (not approximate): matches
     attention_reference on the gathered result to fp32 tolerance.
     """
     if scale is None:
@@ -115,7 +123,9 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
     m0 = _vary(jnp.full((b, h, t_q), _NEG_INF, dtype=jnp.float32), axes)
     l0 = _vary(jnp.zeros((b, h, t_q), dtype=jnp.float32), axes)
     o0 = _vary(jnp.zeros(q.shape, dtype=jnp.float32), axes)
-    body = _ring_body(axis_name, n, causal, scale, t_q, t_k)
+    if kv_len is not None:
+        kv_len = jnp.asarray(kv_len, jnp.int32).reshape(b)
+    body = _ring_body(axis_name, n, causal, scale, t_q, t_k, kv_len=kv_len)
     _, _, m, l, o, _, _ = lax.fori_loop(
         0, n, body, (k, v, m0, l0, o0, q.astype(jnp.float32), my_idx))
     l = jnp.maximum(l, 1e-30)  # fully-masked rows (strict causal pad) → 0 out
@@ -139,13 +149,26 @@ def sp_spec_for_mesh(mesh, batch_axis, seq_axis):
 
 
 def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None,
-                           batch_axis="dp", seq_axis="sp"):
+                           batch_axis="dp", seq_axis="sp", kv_len=None):
     """Global-view ring attention: q,k,v are full [B,T,H,D] arrays (or GSPMD
     -sharded); shard_map splits them over (dp, sp) and runs the ring.
+    kv_len: optional [B] int32 global true key lengths (sharded over the
+    batch axis like q's batch dim).
     """
     spec, vary_axes = sp_spec_for_mesh(mesh, batch_axis, seq_axis)
-    fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
-                          scale=scale, vary_axes=vary_axes),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return fn(q, k, v)
+    if kv_len is None:
+        fn = shard_map(
+            functools.partial(ring_attention, axis_name=seq_axis,
+                              causal=causal, scale=scale,
+                              vary_axes=vary_axes),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        return fn(q, k, v)
+    len_spec = P(batch_axis) if batch_axis in mesh.axis_names else P()
+
+    def shard_fn(qs, ks, vs, lens):
+        return ring_attention(qs, ks, vs, axis_name=seq_axis, causal=causal,
+                              scale=scale, vary_axes=vary_axes, kv_len=lens)
+
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(spec, spec, spec, len_spec), out_specs=spec)
+    return fn(q, k, v, jnp.asarray(kv_len, jnp.int32).reshape(q.shape[0]))

@@ -258,3 +258,44 @@ def test_executor_requires_program_uid():
             assert False, "expected AttributeError for missing _uid"
         except AttributeError as e:
             assert "_uid" in str(e)
+
+
+def test_nhwc_conv_layout_matches_nchw(monkeypatch):
+    """FLAGS_conv_layout=NHWC (internal channels-last compute layout for
+    conv/pool) must be numerically identical to the default — same
+    fluid-facing NCHW contract, different MXU layout."""
+    rng = np.random.RandomState(2)
+    xs = rng.rand(4, 3, 16, 16).astype("f")
+    ys = rng.randint(0, 5, (4, 1)).astype("int64")
+
+    def run_once():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", [3, 16, 16], dtype="float32")
+            lbl = fluid.layers.data("lbl", [1], dtype="int64")
+            h = fluid.layers.conv2d(input=img, num_filters=8,
+                                    filter_size=3, padding=1, act="relu")
+            h = fluid.layers.pool2d(input=h, pool_size=2, pool_stride=2,
+                                    pool_type="avg")
+            h = fluid.layers.conv2d(input=h, num_filters=8, filter_size=3,
+                                    groups=2)
+            h = fluid.layers.pool2d(input=h, pool_size=2, pool_stride=2,
+                                    pool_type="max")
+            logits = fluid.layers.fc(input=h, size=5)
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(
+                input=fluid.layers.softmax(logits), label=lbl))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            scope._rng_counter = 0
+            vals = [float(np.ravel(exe.run(
+                main, feed={"img": xs, "lbl": ys},
+                fetch_list=[loss])[0])[0]) for _ in range(3)]
+        return vals
+
+    base = run_once()
+    monkeypatch.setenv("FLAGS_conv_layout", "NHWC")
+    nhwc = run_once()
+    np.testing.assert_allclose(base, nhwc, rtol=1e-5, atol=1e-6)

@@ -35,10 +35,13 @@ run() {
 probe
 timeout 600 python tools/layout_probe.py 2>/dev/null | tee -a $LOG
 run BENCH_BATCH=256 BENCH_DTYPE=bf16
+run BENCH_BATCH=256 BENCH_DTYPE=bf16 FLAGS_conv_layout=NHWC
 run BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3
 run BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3 BENCH_REMAT=1
 run BENCH_BATCH=1024 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3 BENCH_REMAT=1
 run BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_FEED=host BENCH_STEPS=10 BENCH_WARMUP=3
+run BENCH_BATCH=256 BENCH_DTYPE=bf16 \
+  XLA_FLAGS="${XLA_FLAGS:-} --xla_tpu_enable_latency_hiding_scheduler=true"
 run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
 run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256 BENCH_FUSED_ATTN=0
 echo "=== sweep done ===" | tee -a $LOG
