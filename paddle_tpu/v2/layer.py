@@ -9,8 +9,11 @@ from .activation import BaseActivation
 from . import data_type as _dt
 
 __all__ = ["data", "fc", "embedding", "classification_cost",
-           "cross_entropy_cost", "square_error_cost", "lstmemory",
-           "max_id", "concat", "pool", "dropout"]
+           "cross_entropy_cost", "square_error_cost", "mse_cost",
+           "lstmemory", "grumemory", "max_id", "concat", "pool", "dropout",
+           "img_conv", "img_pool", "batch_norm", "cos_sim", "first_seq",
+           "last_seq", "addto", "seq_reshape", "scaling", "trans",
+           "sum_cost", "huber_regression_cost", "crf", "crf_decoding"]
 
 
 def _act_name(act):
@@ -85,3 +88,105 @@ def pool(input, pooling_type=None):
 
 def dropout(input, dropout_rate):
     return fluid.layers.dropout(x=input, dropout_prob=dropout_rate)
+
+
+mse_cost = square_error_cost
+
+
+def grumemory(input, size=None, reverse=False, act=None, **kwargs):
+    hidden = size or input.shape[-1] // 3
+    return fluid.layers.dynamic_gru(
+        input=input, size=hidden, is_reverse=reverse,
+        candidate_activation=_act_name(act) or "tanh")
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
+             padding=0, groups=1, act=None, param_attr=None,
+             bias_attr=None, **kwargs):
+    return fluid.layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=stride, padding=padding, groups=groups,
+        act=_act_name(act), param_attr=param_attr, bias_attr=bias_attr)
+
+
+def img_pool(input, pool_size, pool_type=None, stride=1, padding=0,
+             **kwargs):
+    name = pool_type.name if pool_type is not None else "max"
+    if name == "average":
+        name = "avg"
+    return fluid.layers.pool2d(
+        input=input, pool_size=pool_size, pool_type=name,
+        pool_stride=stride, pool_padding=padding)
+
+
+def batch_norm(input, act=None, **kwargs):
+    return fluid.layers.batch_norm(input=input, act=_act_name(act))
+
+
+def cos_sim(a, b, scale=1, **kwargs):
+    out = fluid.layers.cos_sim(X=a, Y=b)
+    return out if scale == 1 else fluid.layers.scale(x=out,
+                                                     scale=float(scale))
+
+
+def first_seq(input, **kwargs):
+    return fluid.layers.sequence_first_step(input=input)
+
+
+def last_seq(input, **kwargs):
+    return fluid.layers.sequence_last_step(input=input)
+
+
+def addto(input, act=None, bias_attr=None, **kwargs):
+    vals = list(input) if isinstance(input, (list, tuple)) else [input]
+    out = vals[0]
+    for v in vals[1:]:
+        out = fluid.layers.elementwise_add(x=out, y=v)
+    if bias_attr not in (None, False):
+        bias = fluid.layers.create_parameter(
+            shape=[out.shape[-1]], dtype=out.dtype,
+            attr=None if bias_attr is True else bias_attr, is_bias=True)
+        out = fluid.layers.elementwise_add(x=out, y=bias,
+                                           axis=len(out.shape) - 1)
+    a = _act_name(act)
+    if a:
+        out = getattr(fluid.layers, a)(out)
+    return out
+
+
+def seq_reshape(input, reshape_size, **kwargs):
+    return fluid.layers.sequence_reshape(input=input,
+                                         new_dim=reshape_size)
+
+
+def scaling(input, weight, **kwargs):
+    return fluid.layers.elementwise_mul(x=input, y=weight, axis=0)
+
+
+def trans(input, **kwargs):
+    return fluid.layers.transpose(input, perm=[1, 0])
+
+
+def sum_cost(input, **kwargs):
+    return fluid.layers.reduce_sum(input)
+
+
+def huber_regression_cost(input, label, delta=1.0, **kwargs):
+    # Huber(delta) in terms of smooth_l1(sigma): with sigma = delta**-0.5
+    # the threshold is 1/sigma^2 = delta, and scaling the result by delta
+    # gives quadratic 0.5*d^2 and linear delta*(|d| - delta/2) exactly.
+    delta = float(delta)
+    return fluid.layers.scale(
+        fluid.layers.mean(
+            fluid.layers.smooth_l1(x=input, y=label,
+                                   sigma=delta ** -0.5)),
+        scale=delta)
+
+
+def crf(input, label, param_attr=None, **kwargs):
+    return fluid.layers.linear_chain_crf(input=input, label=label,
+                                         param_attr=param_attr)
+
+
+def crf_decoding(input, param_attr=None, **kwargs):
+    return fluid.layers.crf_decoding(input=input, param_attr=param_attr)

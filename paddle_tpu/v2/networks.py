@@ -3,7 +3,8 @@ import paddle_tpu as fluid
 from .layer import _act_name
 
 __all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
-           "simple_lstm"]
+           "simple_lstm", "simple_gru", "bidirectional_lstm",
+           "bidirectional_gru"]
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
@@ -34,3 +35,41 @@ def simple_lstm(input, size, **kwargs):
     fc = fluid.layers.fc(input=input, size=size * 4)
     h, c = fluid.layers.dynamic_lstm(input=fc, size=size * 4)
     return h
+
+
+def simple_gru(input, size, **kwargs):
+    """Parity: trainer_config_helpers/networks.py simple_gru (fc + gru)."""
+    fc = fluid.layers.fc(input=input, size=size * 3)
+    return fluid.layers.dynamic_gru(input=fc, size=size)
+
+
+def bidirectional_lstm(input, size, return_seq=False, **kwargs):
+    """Parity: networks.py bidirectional_lstm — fwd + bwd lstm, concat.
+    return_seq=False returns the concat of each direction's last step."""
+    fwd_in = fluid.layers.fc(input=input, size=size * 4)
+    fwd, _ = fluid.layers.dynamic_lstm(input=fwd_in, size=size * 4)
+    bwd_in = fluid.layers.fc(input=input, size=size * 4)
+    bwd, _ = fluid.layers.dynamic_lstm(input=bwd_in, size=size * 4,
+                                       is_reverse=True)
+    if return_seq:
+        return fluid.layers.concat(input=[fwd, bwd], axis=-1)
+    # the reverse scan's full-context state sits at the FIRST original
+    # position (it processed T-1..0), so the backward summary is first_seq
+    # — the reference networks.py does the same
+    return fluid.layers.concat(
+        input=[fluid.layers.sequence_last_step(input=fwd),
+               fluid.layers.sequence_first_step(input=bwd)], axis=-1)
+
+
+def bidirectional_gru(input, size, return_seq=False, **kwargs):
+    """Parity: networks.py bidirectional_gru."""
+    fwd_in = fluid.layers.fc(input=input, size=size * 3)
+    fwd = fluid.layers.dynamic_gru(input=fwd_in, size=size)
+    bwd_in = fluid.layers.fc(input=input, size=size * 3)
+    bwd = fluid.layers.dynamic_gru(input=bwd_in, size=size,
+                                   is_reverse=True)
+    if return_seq:
+        return fluid.layers.concat(input=[fwd, bwd], axis=-1)
+    return fluid.layers.concat(
+        input=[fluid.layers.sequence_last_step(input=fwd),
+               fluid.layers.sequence_first_step(input=bwd)], axis=-1)

@@ -1,0 +1,115 @@
+"""Widened v2 layer vocabulary (python/paddle/v2/layer.py + networks.py):
+conv/pool/batch_norm family, gru memories, sequence utilities, costs, and
+the bidirectional composites all build and train through the fluid
+executor under the hood.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.v2 as paddle
+from paddle_tpu.core.lod import LoDTensor
+
+
+def test_v2_conv_pool_batchnorm_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 12, 12], dtype="float32")
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        h = paddle.layer.img_conv(img, filter_size=3, num_filters=4,
+                                  padding=1,
+                                  act=paddle.activation.Relu)
+        h = paddle.layer.batch_norm(h, act=paddle.activation.Relu)
+        h = paddle.layer.img_pool(h, pool_size=2, stride=2,
+                                  pool_type=paddle.pooling.Max)
+        logits = paddle.layer.fc(h, size=3,
+                                 act=paddle.activation.Softmax)
+        cost = paddle.layer.classification_cost(logits, lbl)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(cost)
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(12, 1, 12, 12).astype("f")
+    ys = (xs.mean((1, 2, 3)) > xs.mean()).astype("int64")[:, None] * 2
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.ravel(exe.run(
+            main, feed={"img": xs, "lbl": ys}, fetch_list=[cost])[0])[0])
+            for _ in range(40)]
+    assert losses[-1] < 0.5 * losses[0], losses[::8]
+
+
+def test_v2_sequence_layers_and_bidirectional():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = paddle.layer.data(
+            "words", paddle.data_type.integer_value_sequence(30))
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        emb = paddle.layer.embedding(words, size=8)
+        bi = paddle.networks.bidirectional_gru(emb, size=6)
+        gru_seq = paddle.networks.simple_gru(emb, size=6)
+        feats = paddle.layer.concat([
+            bi,
+            paddle.layer.first_seq(gru_seq),
+            paddle.layer.last_seq(gru_seq),
+        ])
+        logits = paddle.layer.fc(feats, size=2,
+                                 act=paddle.activation.Softmax)
+        cost = paddle.layer.classification_cost(logits, lbl)
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(cost)
+
+    rng = np.random.RandomState(1)
+    seqs = [rng.randint(1, 30, rng.randint(2, 6)).tolist()
+            for _ in range(8)]
+    # label: does the sequence contain a token >= 15?
+    ys = np.array([[int(any(t >= 15 for t in s))] for s in seqs], "int64")
+    lod = LoDTensor.from_sequences(
+        [np.array(s, "int64")[:, None] for s in seqs])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(np.ravel(exe.run(
+            main, feed={"words": lod, "lbl": ys},
+            fetch_list=[cost])[0])[0]) for _ in range(60)]
+    assert losses[-1] < 0.6 * losses[0], losses[::10]
+
+
+def test_v2_misc_layers_numerics():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", [4], dtype="float32")
+        b = fluid.layers.data("b", [4], dtype="float32")
+        w = fluid.layers.data("w", [1], dtype="float32")
+        sim = paddle.layer.cos_sim(a, b, scale=2)
+        added = paddle.layer.addto([a, b], act=paddle.activation.Relu)
+        scaled = paddle.layer.scaling(a, w)
+        total = paddle.layer.sum_cost(a)
+        hub = paddle.layer.huber_regression_cost(a, b, delta=1.0)
+        hub2 = paddle.layer.huber_regression_cost(
+            fluid.layers.scale(a, scale=4.0), b, delta=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(2)
+    av = rng.rand(3, 4).astype("f")
+    bv = rng.rand(3, 4).astype("f")
+    wv = rng.rand(3, 1).astype("f")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        sims, adds, scs, tot, hb, hb2 = exe.run(
+            main, feed={"a": av, "b": bv, "w": wv},
+            fetch_list=[sim, added, scaled, total, hub, hub2])
+    cos = (av * bv).sum(1) / (np.linalg.norm(av, axis=1) *
+                              np.linalg.norm(bv, axis=1))
+    np.testing.assert_allclose(np.ravel(sims), 2 * cos, rtol=1e-5)
+    np.testing.assert_allclose(adds, np.maximum(av + bv, 0), rtol=1e-5)
+    np.testing.assert_allclose(scs, av * wv, rtol=1e-5)
+    np.testing.assert_allclose(float(np.ravel(tot)[0]), av.sum(),
+                               rtol=1e-5)
+    diff = np.abs(av - bv)
+    hub_ref = np.where(diff < 1.0, 0.5 * diff ** 2, diff - 0.5).sum(1)
+    np.testing.assert_allclose(float(np.ravel(hb)[0]), hub_ref.mean(),
+                               rtol=1e-4)
+    # delta != 1 exercises the sigma mapping: Huber(2) = 0.5 d^2 below 2,
+    # 2(|d| - 1) above
+    d2 = np.abs(4 * av - bv)
+    hub2_ref = np.where(d2 < 2.0, 0.5 * d2 ** 2, 2.0 * (d2 - 1.0)).sum(1)
+    np.testing.assert_allclose(float(np.ravel(hb2)[0]), hub2_ref.mean(),
+                               rtol=1e-4)

@@ -1,0 +1,105 @@
+"""Kernel-level microbench: pallas flash attention and fused softmax-xent
+vs their dense XLA counterparts, fwd+bwd, on whatever backend jax exposes
+(meant for the real chip; run via tools/perf_sweep.sh). One JSON line per
+comparison: {"kernel": ..., "dense_ms": ..., "fused_ms": ..., "speedup":
+..., "shape": ...}.
+
+Exclusive-tunnel rule applies: never run concurrently with another TPU
+process (see BENCH_LOG.md / memory notes).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _await():
+    import jax
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
+    return jax
+
+
+def _time(fn, *args, iters=20, warmup=3):
+    import jax
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def bench_attention(b=8, t=2048, h=8, d=64, causal=True, dtype="bfloat16"):
+    jax = _await()
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+    from paddle_tpu.parallel.ring_attention import attention_reference
+
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(b, t, h, d).astype("f") * 0.3,
+                           dtype=dtype) for _ in range(3))
+
+    def dense_loss(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal)
+                       .astype(jnp.float32))
+
+    def flash_loss(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, causal=causal)
+                       .astype(jnp.float32))
+
+    dense = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))
+    flash = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))
+    dms = _time(dense, q, k, v)
+    fms = _time(flash, q, k, v)
+    # flush per line: a timeout-kill (tunnel wedge) must not discard
+    # measurements already completed (BENCH_LOG persistence contract)
+    print(json.dumps({
+        "kernel": "flash_attention_fwd_bwd", "dense_ms": round(dms, 3),
+        "fused_ms": round(fms, 3), "speedup": round(dms / fms, 3),
+        "shape": [b, t, h, d], "causal": causal, "dtype": dtype,
+        "device": str(jax.devices()[0])}), flush=True)
+
+
+def bench_softmax_xent(n=8192, v=32000):
+    jax = _await()
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(n, v).astype("f"))
+    labels = jnp.asarray(rng.randint(0, v, n).astype("i4"))
+
+    def dense(logits, labels):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(jnp.take_along_axis(logp, labels[:, None], 1))
+
+    def fused(logits, labels):
+        return jnp.sum(pk.softmax_xent(logits, labels))
+
+    d = jax.jit(jax.grad(dense))
+    f = jax.jit(jax.grad(fused))
+    dms = _time(d, logits, labels)
+    fms = _time(f, logits, labels)
+    print(json.dumps({
+        "kernel": "softmax_xent_fwd_bwd", "dense_ms": round(dms, 3),
+        "fused_ms": round(fms, 3), "speedup": round(dms / fms, 3),
+        "shape": [n, v], "device": str(jax.devices()[0])}), flush=True)
+
+
+if __name__ == "__main__":
+    # MB_* knobs shrink the config for smoke runs (CPU interpret mode is
+    # orders of magnitude slower than the real kernel)
+    bench_attention(b=int(os.environ.get("MB_B", "8")),
+                    t=int(os.environ.get("MB_SEQ", "2048")),
+                    h=int(os.environ.get("MB_H", "8")))
+    bench_softmax_xent(n=int(os.environ.get("MB_N", "8192")),
+                       v=int(os.environ.get("MB_V", "32000")))

@@ -44,4 +44,11 @@ run BENCH_BATCH=256 BENCH_DTYPE=bf16 \
   XLA_FLAGS="${XLA_FLAGS:-} --xla_tpu_enable_latency_hiding_scheduler=true"
 run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
 run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256 BENCH_FUSED_ATTN=0
+echo "=== pallas microbench" | tee -a $LOG
+timeout 900 python tools/pallas_microbench.py 2>/dev/null | tee -a $LOG | \
+  while read -r line; do
+    printf -- '- %s microbench `%s`\n' "$(date -u +%FT%TZ)" "$line" >> BENCH_LOG.md
+  done
+[ "${PIPESTATUS[0]:-0}" = 0 ] || \
+  echo "- $(date -u +%FT%TZ) FAILED: pallas_microbench (rc)" >> BENCH_LOG.md
 echo "=== sweep done ===" | tee -a $LOG
