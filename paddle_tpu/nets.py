@@ -25,34 +25,34 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
                    conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
                    pool_stride=1, pool_type="max", use_cudnn=True,
                    use_mkldnn=False):
-    tmp = input
-    assert isinstance(conv_num_filter, (list, tuple))
+    if not isinstance(conv_num_filter, (list, tuple)):
+        raise TypeError("conv_num_filter must be a list/tuple (one entry "
+                        "per conv in the group)")
+    n = len(conv_num_filter)
 
-    def __extend_list__(obj):
-        if not hasattr(obj, "__len__"):
-            return [obj] * len(conv_num_filter)
-        return list(obj)
+    def per_conv(value):
+        """Broadcast a scalar argument to one value per conv."""
+        return list(value) if hasattr(value, "__len__") else [value] * n
 
-    conv_padding = __extend_list__(conv_padding)
-    conv_filter_size = __extend_list__(conv_filter_size)
-    param_attr = __extend_list__(param_attr)
-    conv_with_batchnorm = __extend_list__(conv_with_batchnorm)
-    conv_batchnorm_drop_rate = __extend_list__(conv_batchnorm_drop_rate)
+    stages = zip(conv_num_filter, per_conv(conv_filter_size),
+                 per_conv(conv_padding), per_conv(param_attr),
+                 per_conv(conv_with_batchnorm),
+                 per_conv(conv_batchnorm_drop_rate))
 
-    for i in range(len(conv_num_filter)):
-        local_conv_act = conv_act
-        if conv_with_batchnorm[i]:
-            local_conv_act = None
-        tmp = layers.conv2d(input=tmp, num_filters=conv_num_filter[i],
-                            filter_size=conv_filter_size[i],
-                            padding=conv_padding[i], param_attr=param_attr[i],
-                            act=local_conv_act, use_cudnn=use_cudnn)
-        if conv_with_batchnorm[i]:
-            tmp = layers.batch_norm(input=tmp, act=conv_act)
-            drop_rate = conv_batchnorm_drop_rate[i]
-            if abs(drop_rate) > 1e-5:
-                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
-    return layers.pool2d(input=tmp, pool_size=pool_size,
+    out = input
+    for filters, fsize, pad, pattr, with_bn, drop in stages:
+        # with batch_norm the activation moves after the norm (and the
+        # conv bias is redundant with bn's shift, but kept for parity)
+        out = layers.conv2d(input=out, num_filters=filters,
+                            filter_size=fsize, padding=pad,
+                            param_attr=pattr,
+                            act=None if with_bn else conv_act,
+                            use_cudnn=use_cudnn)
+        if with_bn:
+            out = layers.batch_norm(input=out, act=conv_act)
+            if abs(drop) > 1e-5:
+                out = layers.dropout(x=out, dropout_prob=drop)
+    return layers.pool2d(input=out, pool_size=pool_size,
                          pool_type=pool_type, pool_stride=pool_stride,
                          use_cudnn=use_cudnn)
 

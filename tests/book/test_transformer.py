@@ -266,3 +266,51 @@ def test_transformer_beam_decode_matches_host_reference():
     np.testing.assert_array_equal(dev_ids, host_ids)
     np.testing.assert_allclose(dev_scores, host_scores, rtol=2e-3,
                                atol=2e-3)
+
+
+def test_cached_decode_matches_full_decode():
+    """The KV-cache incremental decode (build_cached_decode: O(T) total
+    decoder work, caches as while_loop carries) must reproduce
+    build_decode's beams token-for-token on the same trained scope."""
+    K = 2
+    kwargs = dict(src_vocab_size=VOCAB, trg_vocab_size=VOCAB,
+                  max_length=MAX_LEN, n_layer=2, n_head=N_HEAD, d_key=16,
+                  d_value=16, d_model=32, d_inner_hid=64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        sum_cost, avg_cost, predict = transformer.build_train(
+            warmup_steps=20, learning_rate=2.0, **kwargs)
+
+    full_prog, s1 = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(full_prog, s1):
+        full_ids, full_scores = transformer.build_decode(
+            beam_size=K, **kwargs)
+    cached_prog, s2 = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(cached_prog, s2):
+        c_ids, c_scores = transformer.build_cached_decode(
+            beam_size=K, **kwargs)
+
+    rng = np.random.RandomState(17)
+    srcs = [rng.randint(3, VOCAB, 4).tolist(),
+            rng.randint(3, VOCAB, 6).tolist()]
+    dataset = [transformer.prepare_batch([s], [s], MAX_LEN, N_HEAD)
+               for s in srcs]
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(80):
+            exe.run(main, feed=dataset[i % 2], fetch_list=[avg_cost])
+
+        f_feed = transformer.prepare_decode_batch(srcs, MAX_LEN, N_HEAD, K)
+        f_ids, f_sc = exe.run(full_prog, feed=f_feed,
+                              fetch_list=[full_ids, full_scores])
+        c_feed = transformer.prepare_cached_decode_batch(
+            srcs, MAX_LEN, N_HEAD, K)
+        g_ids, g_sc = exe.run(cached_prog, feed=c_feed,
+                              fetch_list=[c_ids, c_scores])
+
+    np.testing.assert_array_equal(np.asarray(g_ids), np.asarray(f_ids))
+    np.testing.assert_allclose(np.asarray(g_sc), np.asarray(f_sc),
+                               rtol=2e-4, atol=2e-4)

@@ -44,6 +44,9 @@ run BENCH_BATCH=256 BENCH_DTYPE=bf16 \
   XLA_FLAGS="${XLA_FLAGS:-} --xla_tpu_enable_latency_hiding_scheduler=true"
 run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
 run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256 BENCH_FUSED_ATTN=0
+# long-context: the flash path's O(T) memory is the point — dense would
+# materialize [T,T] attention at 2k tokens
+run BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2
 echo "=== pallas microbench" | tee -a $LOG
 timeout 900 python tools/pallas_microbench.py 2>/dev/null | tee -a $LOG | \
   while read -r line; do
