@@ -7,6 +7,13 @@ the inference engine IS the XLA runtime, so the C entry embeds CPython
 and delegates here; this module keeps the C side to a dozen stable calls
 (create/run/destroy + buffer marshalling). Each predictor owns a private
 Scope; jit caching makes repeated run() calls compile-free.
+
+v2 (era-complete like paddle/capi paddle_matrix/paddle_ivector): feeds
+are reinterpreted with each feed var's DECLARED dtype (int64 ids for
+embedding models arrive as int64 buffers, not floats smuggled through a
+float32 contract), and ALL fetch targets are retained per run for
+multi-output predictors; the C side reads them back one at a time with
+their dtype and shape.
 """
 import os
 
@@ -15,6 +22,28 @@ import numpy as np
 from .core.executor import Executor, scope_guard, Scope
 from . import io as _io
 from .places import CPUPlace, TPUPlace
+
+
+class _Predictor(object):
+    __slots__ = ("exe", "scope", "program", "feeds", "fetches", "outputs",
+                 "dtypes")
+
+    def __init__(self, exe, scope, program, feeds, fetches):
+        self.exe = exe
+        self.scope = scope
+        self.program = program
+        self.feeds = list(feeds)
+        self.fetches = fetches
+        self.outputs = []  # last run's fetch arrays (native dtypes)
+        # feed name -> declared dtype, resolved once (run() is hot)
+        self.dtypes = {}
+        for n in self.feeds:
+            try:
+                v = program.global_block().var_recursive(n)
+                self.dtypes[n] = str(v.dtype)
+            except Exception:
+                self.dtypes[n] = "float32"
+
 
 _predictors = {}
 _next_handle = [1]
@@ -47,32 +76,83 @@ def create(model_dir):
                 model_dir, exe)
     h = _next_handle[0]
     _next_handle[0] += 1
-    _predictors[h] = (exe, scope, program, list(feeds), fetches)
+    _predictors[h] = _Predictor(exe, scope, program, feeds, fetches)
     return h
 
 
 def feed_names(handle):
-    return list(_predictors[handle][3])
+    return list(_predictors[handle].feeds)
+
+
+def _feed_dtype(p, name):
+    """Declared dtype of a feed var ('float32', 'int64', ...); float32 when
+    the name is unknown (defensive: reference models always declare)."""
+    return p.dtypes.get(name, "float32")
+
+
+def feed_dtypes(handle):
+    p = _predictors[handle]
+    return [_feed_dtype(p, n) for n in p.feeds]
+
+
+def feed_elem_sizes(handle, names):
+    """Per-name element byte widths, aligned with the PASSED names list —
+    one call resolves every feed's marshalling width for the C side."""
+    p = _predictors[handle]
+    return [int(np.dtype(_feed_dtype(p, n)).itemsize) for n in names]
 
 
 def num_fetches(handle):
-    return len(_predictors[handle][4])
+    return len(_predictors[handle].fetches)
 
 
 def run(handle, names, buffers, shapes):
-    """names: feed names; buffers: per-feed bytes-like of float32 data;
-    shapes: per-feed int lists. Returns list of float32 C-contiguous
-    numpy arrays (one per fetch target)."""
-    exe, scope, program, _feeds, fetches = _predictors[handle]
+    """names: feed names; buffers: per-feed bytes-like whose payload is in
+    each feed var's DECLARED dtype; shapes: per-feed int lists. Executes
+    and retains every fetch target (read back via output_*). Returns the
+    number of outputs."""
+    p = _predictors[handle]
     feed = {}
     for name, buf, shape in zip(names, buffers, shapes):
-        feed[name] = np.frombuffer(buf, dtype=np.float32).reshape(
+        dt = np.dtype(_feed_dtype(p, name))
+        feed[name] = np.frombuffer(buf, dtype=dt).reshape(
             [int(s) for s in shape])
     # scope passed explicitly — scope_guard mutates a process global and
     # would race when a multithreaded C host runs two predictors at once
-    outs = exe.run(program, feed=feed, fetch_list=fetches, scope=scope)
+    outs = p.exe.run(p.program, feed=feed, fetch_list=p.fetches,
+                     scope=p.scope)
+    p.outputs = [np.ascontiguousarray(np.asarray(o)) for o in outs]
+    return len(p.outputs)
+
+
+def run_legacy(handle, names, buffers, shapes):
+    """v1 contract: every buffer is float32 regardless of declared dtype
+    (ints were smuggled through floats); returns the float32-cast outputs
+    list. Kept so binaries linked against the v1 ptpu_run keep working."""
+    p = _predictors[handle]
+    p.outputs = []  # a later ptpu_output must not see a prior run2's arrays
+    feed = {}
+    for name, buf, shape in zip(names, buffers, shapes):
+        a = np.frombuffer(buf, dtype=np.float32).reshape(
+            [int(s) for s in shape])
+        dt = np.dtype(_feed_dtype(p, name))
+        feed[name] = a.astype(dt) if dt != np.float32 else a
+    outs = p.exe.run(p.program, feed=feed, fetch_list=p.fetches,
+                     scope=p.scope)
+    # v1 clients never call output_*; don't retain arrays on the handle
     return [np.ascontiguousarray(np.asarray(o, dtype=np.float32))
             for o in outs]
+
+
+def output_info(handle, i):
+    """(dtype_str, shape_list, nbytes) of retained output i."""
+    o = _predictors[handle].outputs[i]
+    return (str(o.dtype), [int(s) for s in o.shape], int(o.nbytes))
+
+
+def output_array(handle, i):
+    """The retained output array itself (C reads it via buffer protocol)."""
+    return _predictors[handle].outputs[i]
 
 
 def destroy(handle):

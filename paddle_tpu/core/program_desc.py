@@ -91,6 +91,12 @@ def program_to_bytes(program):
         "random_seed": program.random_seed,
         "amp": bool(getattr(program, "_amp", False)),
         "op_uid_counter": program._op_uid_counter,
+        # exact accumulator->param ownership recorded by
+        # Optimizer._add_accumulator; persisting it means deserialized
+        # programs never fall back to name-pattern accumulator matching in
+        # ParallelExecutor(sharded_weight_update=True)
+        "accumulator_owner": dict(
+            getattr(program, "_accumulator_owner", {})),
         "blocks": [{
             "idx": blk.idx,
             "parent_idx": blk.parent_idx,
@@ -145,5 +151,6 @@ def program_from_bytes(data):
             op.uid = od.get("uid", op.uid)
             blk.ops.append(op)
     p._op_uid_counter = desc.get("op_uid_counter", p._op_uid_counter)
+    p._accumulator_owner = dict(desc.get("accumulator_owner", {}))
     p._bump_version()
     return p

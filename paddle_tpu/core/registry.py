@@ -14,10 +14,14 @@ can override for ops whose output shape can't be derived that way
 """
 import numpy as np
 
-# sentinel substituted for the dynamic batch dim (-1) during abstract shape
-# inference; mapped back to -1 on outputs. A large prime no real layer dim
-# should collide with.
+# sentinels substituted for the dynamic batch dim (-1) during abstract shape
+# inference. Outputs are inferred under BOTH primes; any output dim that
+# DIFFERS between the two runs is batch-derived (even when folded into a
+# product by reshape/flatten, e.g. [-1, K] -> [-1*K]) and maps back to -1,
+# while dims that agree are genuinely static — so no literal feature size,
+# multiple of a sentinel or not, can be miscategorized.
 BATCH_SENTINEL = 1021
+BATCH_SENTINEL_B = 1031
 
 
 def int_dtype():
@@ -93,11 +97,20 @@ class AbstractCtx(object):
         pass
 
 
-def _struct_for(var):
+def _struct_for(var, idx=0):
+    """Abstract struct for inference pass `idx` (0 = BATCH_SENTINEL,
+    1 = BATCH_SENTINEL_B). Prefers the var's recorded abstract shapes —
+    which preserve folded batch products like B*H*T through reshapes that
+    a bare -1 re-substitution would lose — when they are still current
+    (i.e. nothing reassigned the public shape since they were recorded)."""
     import jax
+    rec = getattr(var, "_abstract_shapes", None)
+    if rec is not None and rec[2] == tuple(var.shape or ()):
+        return jax.ShapeDtypeStruct(rec[idx], np.dtype(var.dtype))
     if var.shape is None:
         return None
-    shape = tuple(BATCH_SENTINEL if d == -1 else d for d in var.shape)
+    sentinel = (BATCH_SENTINEL, BATCH_SENTINEL_B)[idx]
+    shape = tuple(sentinel if d == -1 else d for d in var.shape)
     return jax.ShapeDtypeStruct(shape, np.dtype(var.dtype))
 
 
@@ -118,21 +131,41 @@ def infer_and_set_shapes(block, op):
     import jax
     try:
         ins = {}
+        ins_b = {}
+        has_dynamic = False
         for slot, names in op.inputs.items():
-            structs = [_struct_for(block.var_recursive(n)) for n in names]
+            vars_ = [block.var_recursive(n) for n in names]
+            structs = [_struct_for(v) for v in vars_]
             if any(s is None for s in structs):
                 return  # un-inferable input; leave outputs as declared
+            has_dynamic = has_dynamic or any(
+                -1 in (v.shape or ()) for v in vars_)
             ins[slot] = structs
+            ins_b[slot] = [_struct_for(v, 1) for v in vars_]
         ctx = AbstractCtx()
         outs = jax.eval_shape(lambda i: od.lower(ctx, i, op.attrs), ins)
+        # second pass under a different sentinel: output dims that move with
+        # the sentinel are batch-derived (incl. folded products like
+        # [-1, K] -> [-1*K]); dims that agree are genuinely static
+        outs_b = jax.eval_shape(lambda i: od.lower(ctx, i, op.attrs),
+                                ins_b) if has_dynamic else outs
     except Exception:
         return  # inference is best-effort; executor lowering gives real errors
     for slot, structs in outs.items():
         if slot not in out_vars:
             continue
-        for var, st in zip(out_vars[slot], structs):
+        structs_b = outs_b.get(slot, structs) if has_dynamic else structs
+        for var, st, st_b in zip(out_vars[slot], structs, structs_b):
             if st is None:
                 continue
-            var.shape = tuple(-1 if d == BATCH_SENTINEL else int(d)
-                              for d in st.shape)
+            var.shape = tuple(
+                -1 if int(d) != int(db) else int(d)
+                for d, db in zip(st.shape, st_b.shape))
+            # keep the exact sentinel shapes for downstream inference (a -1
+            # re-substitution would lose folded batch products); the public
+            # snapshot invalidates the record if anything reassigns shape
+            var._abstract_shapes = (
+                tuple(int(d) for d in st.shape),
+                tuple(int(d) for d in st_b.shape),
+                var.shape)
             var.dtype = np.dtype(st.dtype).name

@@ -21,6 +21,8 @@ from . import detection
 from .detection import *   # noqa: F401,F403
 from . import parallel_layers
 from .parallel_layers import *  # noqa: F401,F403
+from . import extras
+from .extras import *      # noqa: F401,F403
 from .math_op_patch import monkey_patch_variable
 
 monkey_patch_variable()
@@ -28,4 +30,4 @@ monkey_patch_variable()
 __all__ = (nn.__all__ + ops.__all__ + tensor.__all__ + io.__all__ +
            sequence.__all__ + control_flow.__all__ +
            learning_rate_scheduler.__all__ + detection.__all__ +
-           parallel_layers.__all__)
+           parallel_layers.__all__ + extras.__all__)

@@ -1,0 +1,261 @@
+"""Python layer entries for the long-tail operator library (ops/tail_ops.py).
+
+The reference registered these ops in C++ (paddle/fluid/operators/
+{prelu,pad,crop,roi_pool,sequence_slice,sequence_concat,pool_with_index,
+unpool,spp,norm,l1_norm,squared_l2_norm,squared_l2_distance,
+modified_huber_loss,conv_shift,bilinear_tensor_product,precision_recall,
+positive_negative_pair}_op.cc) without exposing era Python wrappers; these
+thin layers make the ops reachable from the Program path and are NOT added
+to the frozen reference-__all__ parity surface.
+"""
+from ..core.layer_helper import LayerHelper
+from ..core.initializer import ConstantInitializer
+from .sequence import _seq_len
+
+__all__ = [
+    "prelu", "pad", "crop", "roi_pool", "sequence_slice", "sequence_concat",
+    "max_pool2d_with_index", "unpool", "spp", "norm", "l1_norm",
+    "squared_l2_norm", "squared_l2_distance", "modified_huber_loss",
+    "conv_shift", "bilinear_tensor_product", "precision_recall",
+    "positive_negative_pair",
+]
+
+
+def prelu(x, param_attr=None, name=None):
+    """Scalar-alpha PReLU (prelu_op.cc: Alpha has exactly one element)."""
+    helper = LayerHelper("prelu", **locals())
+    alpha = helper.create_parameter(
+        attr=helper.param_attr, shape=[1], dtype=x.dtype,
+        default_initializer=ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="pad", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"paddings": [int(p) for p in paddings],
+                            "pad_value": float(pad_value)})
+    return out
+
+
+def crop(x, shape, offsets=None, name=None):
+    helper = LayerHelper("crop", **locals())
+    if offsets is None:
+        offsets = [0] * len(shape)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="crop", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"shape": [int(s) for s in shape],
+                            "offsets": [int(o) for o in offsets]})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0):
+    helper = LayerHelper("roi_pool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference("int64")
+    argmax.stop_gradient = True
+    helper.append_op(
+        type="roi_pool", inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out], "Argmax": [argmax]},
+        attrs={"pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width),
+               "spatial_scale": float(spatial_scale)})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.block.create_var(
+        name=out.name + "@SEQLEN", shape=[-1], dtype="int32",
+        stop_gradient=True)
+    helper.append_op(
+        type="sequence_slice",
+        inputs={"X": [input], "Offset": [offset], "Length": [length]},
+        outputs={"Out": [out], "OutLen": [out_len]})
+    out.lod_level = max(input.lod_level, 1)
+    out.seq_len_var = out_len.name
+    return out
+
+
+def sequence_concat(input, axis=0, name=None):
+    """Concatenate a list of sequences (axis=0: along time per sequence)."""
+    helper = LayerHelper("sequence_concat", **locals())
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    out_len = helper.block.create_var(
+        name=out.name + "@SEQLEN", shape=[-1], dtype="int32",
+        stop_gradient=True)
+    helper.append_op(
+        type="sequence_concat",
+        inputs={"X": list(input),
+                "XLen": [_seq_len(helper, x) for x in input]},
+        outputs={"Out": [out], "OutLen": [out_len]},
+        attrs={"axis": int(axis)})
+    out.lod_level = max(input[0].lod_level, 1)
+    out.seq_len_var = out_len.name
+    return out
+
+
+def max_pool2d_with_index(input, pool_size, pool_stride=1, pool_padding=0,
+                          global_pooling=False, name=None):
+    from ..core.utils import pair as _pair
+    helper = LayerHelper("max_pool2d_with_index", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mask = helper.create_variable_for_type_inference("int32")
+    mask.stop_gradient = True
+    helper.append_op(
+        type="max_pool2d_with_index", inputs={"X": [input]},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={"ksize": list(_pair(pool_size)),
+               "strides": list(_pair(pool_stride)),
+               "paddings": list(_pair(pool_padding)),
+               "global_pooling": bool(global_pooling)})
+    return out, mask
+
+
+def unpool(input, indices, pool_size, pool_stride=1, pool_padding=0,
+           name=None):
+    from ..core.utils import pair as _pair
+    helper = LayerHelper("unpool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="unpool", inputs={"X": [input], "Indices": [indices]},
+        outputs={"Out": [out]},
+        attrs={"ksize": list(_pair(pool_size)),
+               "strides": list(_pair(pool_stride)),
+               "paddings": list(_pair(pool_padding)),
+               "unpooling_type": "max"})
+    return out
+
+
+def spp(input, pyramid_height, pool_type="max", name=None):
+    helper = LayerHelper("spp", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="spp", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pyramid_height": int(pyramid_height),
+                            "pooling_type": pool_type})
+    return out
+
+
+def norm(input, epsilon=1e-10, param_attr=None, name=None):
+    """Cross-channel L2 normalization with per-channel scale (norm_op.cc,
+    the SSD L2Norm layer)."""
+    helper = LayerHelper("norm", **locals())
+    scale = helper.create_parameter(
+        attr=helper.param_attr, shape=[input.shape[1], 1], dtype=input.dtype,
+        default_initializer=ConstantInitializer(1.0))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="norm", inputs={"X": [input], "Scale": [scale]},
+                     outputs={"Out": [out]},
+                     attrs={"epsilon": float(epsilon)})
+    return out
+
+
+def _unary_scalar(op_type, x, name=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type=op_type, inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def l1_norm(x, name=None):
+    return _unary_scalar("l1_norm", x, name)
+
+
+def squared_l2_norm(x, name=None):
+    return _unary_scalar("squared_l2_norm", x, name)
+
+
+def squared_l2_distance(x, y, name=None):
+    helper = LayerHelper("squared_l2_distance", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    sub = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="squared_l2_distance",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out], "sub_result": [sub]})
+    return out
+
+
+def modified_huber_loss(x, y, name=None):
+    helper = LayerHelper("modified_huber_loss", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inter = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="modified_huber_loss",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out], "IntermediateVal": [inter]})
+    return out
+
+
+def conv_shift(x, y, name=None):
+    helper = LayerHelper("conv_shift", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="conv_shift", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, param_attr=None, bias_attr=None,
+                            act=None, name=None):
+    helper = LayerHelper("bilinear_tensor_product", **locals())
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, x.shape[-1], y.shape[-1]],
+        dtype=x.dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        bias = helper.create_parameter(
+            attr=helper.bias_attr, shape=[1, size], dtype=x.dtype,
+            is_bias=True)
+        inputs["Bias"] = [bias]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def precision_recall(indices, labels, class_number, weights=None,
+                     states_info=None, name=None):
+    """Multiclass precision/recall/F1 (precision_recall_op.cc). Returns
+    (batch_metrics [6], accum_metrics [6], accum_states [C, 4])."""
+    helper = LayerHelper("precision_recall", **locals())
+    batch = helper.create_variable_for_type_inference("float32")
+    accum = helper.create_variable_for_type_inference("float32")
+    states = helper.create_variable_for_type_inference("float32")
+    inputs = {"Indices": [indices], "Labels": [labels]}
+    if weights is not None:
+        inputs["Weights"] = [weights]
+    if states_info is not None:
+        inputs["StatesInfo"] = [states_info]
+    helper.append_op(
+        type="precision_recall", inputs=inputs,
+        outputs={"BatchMetrics": [batch], "AccumMetrics": [accum],
+                 "AccumStatesInfo": [states]},
+        attrs={"class_number": int(class_number)})
+    return batch, accum, states
+
+
+def positive_negative_pair(score, label, query_id, weight=None,
+                           accum=None, column=-1, name=None):
+    """LTR correctly/incorrectly-ordered pair counts
+    (positive_negative_pair_op.cc). Returns (pos, neg, neu) [1] each."""
+    helper = LayerHelper("positive_negative_pair", **locals())
+    pos = helper.create_variable_for_type_inference("float32")
+    neg = helper.create_variable_for_type_inference("float32")
+    neu = helper.create_variable_for_type_inference("float32")
+    inputs = {"Score": [score], "Label": [label], "QueryID": [query_id]}
+    if weight is not None:
+        inputs["Weight"] = [weight]
+    if accum is not None:
+        inputs["AccumulatePositivePair"] = [accum[0]]
+        inputs["AccumulateNegativePair"] = [accum[1]]
+        inputs["AccumulateNeutralPair"] = [accum[2]]
+    helper.append_op(
+        type="positive_negative_pair", inputs=inputs,
+        outputs={"PositivePair": [pos], "NegativePair": [neg],
+                 "NeutralPair": [neu]},
+        attrs={"column": int(column)})
+    return pos, neg, neu

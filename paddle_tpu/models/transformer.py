@@ -549,7 +549,11 @@ def build_cached_decode(src_vocab_size, trg_vocab_size, max_length,
                 name=POS_ENC_PARAM_NAMES[1], trainable=False,
                 initializer=fluid.initializer.NumpyArrayInitializer(
                     position_encoding_init(T, d_model))))
-        x = word_emb + pos_enc                               # [BK, 1, D]
+        # embedding of [BK, 1] ids yields [BK, D] (reference lookup_table
+        # squeezes the id column); restore the explicit one-step time axis so
+        # every fc below sees [BK, 1, D] and creates [D, size] weights that
+        # share shapes (and names) with the training program's.
+        x = L.reshape(word_emb + pos_enc, shape=[-1, 1, d_model])
 
         # step masks: self-attn sees cache positions <= t; cross-attn sees
         # source positions < src_len

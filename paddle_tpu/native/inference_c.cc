@@ -11,8 +11,18 @@
 //        ptpu_run(handle, names, bufs, shapes, ndims, nfeeds,
 //                 out, out_cap, out_shape, out_ndim_cap, &out_ndim)
 //        ptpu_destroy(handle); ptpu_last_error() for diagnostics.
-// float32 in/out; one fetch target (index 0) in v1 — the era's C API
-// served single-output predictors the same way.
+//
+// v1 (ptpu_run): float32 in/out, one fetch target (index 0). Kept ABI-
+// stable for already-linked binaries.
+// v2 (era-complete like paddle/capi's paddle_matrix/paddle_ivector split):
+//        ptpu_feed_dtype(handle, i, buf, cap)     // "float32"/"int64"/...
+//        ptpu_run2(handle, names, (const void**)bufs, shapes, ndims, n)
+//            -> number of fetch outputs (retained on the handle), or -1
+//        ptpu_num_outputs(handle)
+//        ptpu_output(handle, i, out, out_cap_bytes, shape, ndim_cap,
+//                    &ndim, dtype_buf, dtype_cap) -> bytes copied
+// Feed buffers carry each feed var's DECLARED dtype (int64 ids feed
+// embedding/CTR models directly); outputs keep their native dtype.
 #include <Python.h>
 
 #include <cstdint>
@@ -132,6 +142,184 @@ int ptpu_feed_name(int64_t handle, int i, char* out, int cap) {
   return rc;
 }
 
+// Declared dtype string of feed i (e.g. "float32", "int64").
+int ptpu_feed_dtype(int64_t handle, int i, char* out, int cap) {
+  ptpu_init();
+  Gil gil;
+  PyObject* m = host_module();
+  if (!m) return -1;
+  PyObject* r = PyObject_CallMethod(m, "feed_dtypes", "L", handle);
+  Py_DECREF(m);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  int rc = -1;
+  if (i >= 0 && i < PyList_Size(r)) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    if (s && static_cast<int>(strlen(s)) < cap) {
+      strcpy(out, s);
+      rc = 0;
+    } else {
+      g_err = "dtype buffer too small";
+    }
+  } else {
+    g_err = "feed index out of range";
+  }
+  Py_DECREF(r);
+  return rc;
+}
+
+namespace {
+
+// Shared feed marshalling: raw byte buffers (size = product(shape) *
+// elem_size) handed to capi_host as memoryviews. elem_sizes[i] is the
+// byte width of feed i's declared dtype.
+PyObject* build_feed_args(const char** names, const void** bufs,
+                          const int64_t** shapes, const int* ndims,
+                          const int* elem_sizes, int nfeeds,
+                          PyObject** pnames, PyObject** pbufs,
+                          PyObject** pshapes) {
+  *pnames = PyList_New(nfeeds);
+  *pbufs = PyList_New(nfeeds);
+  *pshapes = PyList_New(nfeeds);
+  for (int i = 0; i < nfeeds; ++i) {
+    int64_t n = 1;
+    for (int d = 0; d < ndims[i]; ++d) n *= shapes[i][d];
+    PyList_SetItem(*pnames, i, PyUnicode_FromString(names[i]));
+    PyList_SetItem(
+        *pbufs, i,
+        PyMemoryView_FromMemory(
+            reinterpret_cast<char*>(const_cast<void*>(bufs[i])),
+            n * static_cast<int64_t>(elem_sizes[i]), PyBUF_READ));
+    PyObject* sh = PyList_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; ++d)
+      PyList_SetItem(sh, d, PyLong_FromLongLong(shapes[i][d]));
+    PyList_SetItem(*pshapes, i, sh);
+  }
+  return *pnames;
+}
+
+
+}  // namespace
+
+// v2 run: buffers already carry each feed's declared dtype; every fetch
+// output is retained on the handle for ptpu_output. Returns the number of
+// outputs, or -1.
+int64_t ptpu_run2(int64_t handle, const char** names, const void** bufs,
+                  const int64_t** shapes, const int* ndims, int nfeeds) {
+  ptpu_init();
+  Gil gil;
+  PyObject* m = host_module();
+  if (!m) return -1;
+
+  // per-feed element widths, resolved host-side in ONE call aligned with
+  // the names being passed (the host caches name->dtype per handle)
+  PyObject* plist = PyList_New(nfeeds);
+  for (int i = 0; i < nfeeds; ++i)
+    PyList_SetItem(plist, i, PyUnicode_FromString(names[i]));
+  PyObject* szs = PyObject_CallMethod(m, "feed_elem_sizes", "LO", handle,
+                                      plist);
+  Py_DECREF(plist);
+  if (!szs) {
+    set_err_from_python();
+    Py_DECREF(m);
+    return -1;
+  }
+  int* elem_sizes = new int[nfeeds];
+  for (int i = 0; i < nfeeds; ++i)
+    elem_sizes[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(szs, i)));
+  Py_DECREF(szs);
+
+  PyObject *pnames, *pbufs, *pshapes;
+  build_feed_args(names, bufs, shapes, ndims, elem_sizes, nfeeds, &pnames,
+                  &pbufs, &pshapes);
+  delete[] elem_sizes;
+  PyObject* r = PyObject_CallMethod(m, "run", "LOOO", handle, pnames,
+                                    pbufs, pshapes);
+  Py_DECREF(pnames);
+  Py_DECREF(pbufs);
+  Py_DECREF(pshapes);
+  Py_DECREF(m);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  int64_t n = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return n;
+}
+
+int ptpu_num_outputs(int64_t handle) {
+  ptpu_init();
+  Gil gil;
+  PyObject* m = host_module();
+  if (!m) return -1;
+  PyObject* r = PyObject_CallMethod(m, "num_fetches", "L", handle);
+  Py_DECREF(m);
+  if (!r) {
+    set_err_from_python();
+    return -1;
+  }
+  int n = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return n;
+}
+
+// Copy retained output i into `out` (capacity in BYTES). Writes its shape,
+// rank, and dtype string. Returns bytes copied, or -1.
+int64_t ptpu_output(int64_t handle, int i, void* out, int64_t out_cap_bytes,
+                    int64_t* out_shape, int out_ndim_cap, int* out_ndim,
+                    char* dtype_out, int dtype_cap) {
+  ptpu_init();
+  Gil gil;
+  PyObject* m = host_module();
+  if (!m) return -1;
+  PyObject* info = PyObject_CallMethod(m, "output_info", "Li", handle, i);
+  if (!info) {
+    set_err_from_python();
+    Py_DECREF(m);
+    return -1;
+  }
+  const char* dt = PyUnicode_AsUTF8(PyTuple_GetItem(info, 0));
+  if (dtype_out) {
+    if (!dt || static_cast<int>(strlen(dt)) >= dtype_cap) {
+      g_err = "dtype buffer too small";
+      Py_DECREF(info);
+      Py_DECREF(m);
+      return -1;
+    }
+    strcpy(dtype_out, dt);
+  }
+  PyObject* arr = PyObject_CallMethod(m, "output_array", "Li", handle, i);
+  Py_DECREF(info);
+  Py_DECREF(m);
+  if (!arr) {
+    set_err_from_python();
+    return -1;
+  }
+  int64_t copied = -1;
+  Py_buffer view;
+  if (PyObject_GetBuffer(arr, &view, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT)
+      == 0) {
+    if (view.ndim > out_ndim_cap) {
+      g_err = "output rank exceeds out_ndim_cap";
+    } else if (view.len > out_cap_bytes) {
+      g_err = "output larger than out_cap_bytes";
+    } else {
+      memcpy(out, view.buf, view.len);
+      for (int d = 0; d < view.ndim; ++d) out_shape[d] = view.shape[d];
+      *out_ndim = view.ndim;
+      copied = view.len;
+    }
+    PyBuffer_Release(&view);
+  } else {
+    set_err_from_python();
+  }
+  Py_DECREF(arr);
+  return copied;
+}
+
 // Run inference. float32 buffers; fetch target 0 is written to `out`
 // (capacity in elements); its shape to out_shape (out_ndim_cap entries).
 // Returns number of output elements, or -1 on error.
@@ -144,25 +332,15 @@ int64_t ptpu_run(int64_t handle, const char** names, const float** bufs,
   PyObject* m = host_module();
   if (!m) return -1;
 
-  PyObject* pnames = PyList_New(nfeeds);
-  PyObject* pbufs = PyList_New(nfeeds);
-  PyObject* pshapes = PyList_New(nfeeds);
-  for (int i = 0; i < nfeeds; ++i) {
-    int64_t n = 1;
-    for (int d = 0; d < ndims[i]; ++d) n *= shapes[i][d];
-    PyList_SetItem(pnames, i, PyUnicode_FromString(names[i]));
-    PyList_SetItem(
-        pbufs, i,
-        PyMemoryView_FromMemory(
-            reinterpret_cast<char*>(const_cast<float*>(bufs[i])),
-            n * static_cast<int64_t>(sizeof(float)), PyBUF_READ));
-    PyObject* sh = PyList_New(ndims[i]);
-    for (int d = 0; d < ndims[i]; ++d)
-      PyList_SetItem(sh, d, PyLong_FromLongLong(shapes[i][d]));
-    PyList_SetItem(pshapes, i, sh);
-  }
+  // v1 buffers are float32 by contract: marshal with a uniform width of 4
+  int* elem_sizes = new int[nfeeds];
+  for (int i = 0; i < nfeeds; ++i) elem_sizes[i] = sizeof(float);
+  PyObject *pnames, *pbufs, *pshapes;
+  build_feed_args(names, reinterpret_cast<const void**>(bufs), shapes,
+                  ndims, elem_sizes, nfeeds, &pnames, &pbufs, &pshapes);
+  delete[] elem_sizes;
 
-  PyObject* r = PyObject_CallMethod(m, "run", "LOOO", handle, pnames,
+  PyObject* r = PyObject_CallMethod(m, "run_legacy", "LOOO", handle, pnames,
                                     pbufs, pshapes);
   Py_DECREF(pnames);
   Py_DECREF(pbufs);

@@ -1,0 +1,494 @@
+"""The long-tail operator library: ops the reference registers as C++
+CPU+CUDA kernel pairs but that never made the era's Python ``__all__``.
+
+Parity: paddle/fluid/operators/{prelu_op,pad_op,crop_op,roi_pool_op,
+sequence_slice_op,sequence_concat_op,pool_with_index_op,unpool_op,spp_op,
+norm_op,l1_norm_op,squared_l2_norm_op,squared_l2_distance_op,
+modified_huber_loss_op,conv_shift_op,bilinear_tensor_product_op,
+precision_recall_op,positive_negative_pair_op,proximal_gd_op,
+proximal_adagrad_op}.{cc,cu,h}.
+
+TPU-native design notes: every op is a single pure-JAX function with static
+output shapes (the reference's per-element loops become masked/vectorized
+XLA computations), so backward comes free via jax.vjp and XLA fuses the
+masks into neighbouring ops. Data-dependent *regions* (roi_pool bins,
+sequence_slice windows) are expressed as value-dependent masks/gathers over
+statically-shaped tensors — never as dynamic shapes, which would break MXU
+tiling and the jit cache.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register, single
+
+
+def _out(x):
+    return {"Out": [x]}
+
+
+# ---------------------------------------------------------------------------
+# elementwise / loss tail
+# ---------------------------------------------------------------------------
+
+@register("prelu")
+def _prelu(ctx, ins, attrs):
+    """prelu_op.cc: f(x) = x if x >= 0 else alpha * x, scalar alpha."""
+    x = single(ins, "X")
+    alpha = single(ins, "Alpha").reshape(())
+    return _out(jnp.where(x >= 0, x, alpha * x))
+
+
+@register("pad")
+def _pad(ctx, ins, attrs):
+    """pad_op.cc: constant-pad; paddings = [lo0, hi0, lo1, hi1, ...]."""
+    x = single(ins, "X")
+    p = attrs["paddings"]
+    widths = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(x.ndim)]
+    return _out(jnp.pad(x, widths, mode="constant",
+                        constant_values=attrs.get("pad_value", 0.0)))
+
+
+@register("crop")
+def _crop(ctx, ins, attrs):
+    """crop_op.cc: static-offset slice of `shape` starting at `offsets`."""
+    x = single(ins, "X")
+    offsets = [int(o) for o in attrs["offsets"]]
+    shape = [int(s) for s in attrs["shape"]]
+    return _out(jax.lax.slice(
+        x, offsets, [o + s for o, s in zip(offsets, shape)]))
+
+
+@register("modified_huber_loss")
+def _modified_huber_loss(ctx, ins, attrs):
+    """modified_huber_loss_op.h: inter = x*(2y-1);
+    loss = -4*inter if inter < -1; (1-inter)^2 if inter < 1; else 0."""
+    x = single(ins, "X").reshape(-1)
+    y = single(ins, "Y").reshape(-1)
+    inter = x * (2.0 * y - 1.0)
+    loss = jnp.where(inter < -1.0, -4.0 * inter,
+                     jnp.where(inter < 1.0, jnp.square(1.0 - inter), 0.0))
+    n = single(ins, "X").shape[0]
+    return {"IntermediateVal": [inter.reshape(n, -1)],
+            "Out": [loss.reshape(n, 1)]}
+
+
+@register("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    """squared_l2_distance_op.h: row-wise ||x - y||^2 (y row-broadcast)."""
+    x = single(ins, "X")
+    y = single(ins, "Y")
+    x2 = x.reshape(x.shape[0], -1)
+    y2 = y.reshape(y.shape[0], -1)
+    sub = x2 - y2  # broadcasts when y has one row
+    return {"sub_result": [sub],
+            "Out": [jnp.sum(jnp.square(sub), axis=1, keepdims=True)]}
+
+
+@register("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    """l1_norm_op.h: Out = sum |x| (scalar, shape [1])."""
+    return _out(jnp.sum(jnp.abs(single(ins, "X"))).reshape(1))
+
+
+@register("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    """squared_l2_norm_op.h: Out = sum x^2 (scalar, shape [1])."""
+    return _out(jnp.sum(jnp.square(single(ins, "X"))).reshape(1))
+
+
+@register("norm")
+def _norm(ctx, ins, attrs):
+    """norm_op.h (the SSD cross-channel L2Norm): per spatial position,
+    out[n,c,h,w] = x[n,c,h,w] / sqrt(sum_c x^2 + eps) * scale[c]."""
+    x = single(ins, "X")                      # [N, C, H, W]
+    scale = single(ins, "Scale").reshape(-1)  # [C]
+    eps = attrs.get("epsilon", 1e-10)
+    denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+    return _out(x / denom * scale.reshape(1, -1, 1, 1))
+
+
+@register("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """conv_shift_op.cc: NTM circular convolution.
+    out[b,i] = sum_j x[b, (i + j - (N-1)/2) mod M] * y[b, j]."""
+    x = single(ins, "X")  # [B, M]
+    y = single(ins, "Y")  # [B, N], N odd
+    m, n = x.shape[1], y.shape[1]
+    half = (n - 1) // 2
+    # index matrix [M, N]: gathered x columns per (i, j)
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    idx = (i + j - half) % m
+    return _out(jnp.einsum("bmn,bn->bm", x[:, idx], y))
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """bilinear_tensor_product_op.h: out[b,i] = x[b]^T W_i y[b] (+ bias)."""
+    x = single(ins, "X")       # [B, Dx]
+    y = single(ins, "Y")       # [B, Dy]
+    w = single(ins, "Weight")  # [size, Dx, Dy]
+    out = jnp.einsum("bj,ijk,bk->bi", x, w, y)
+    bias = single(ins, "Bias")
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return _out(out)
+
+
+# ---------------------------------------------------------------------------
+# pooling tail
+# ---------------------------------------------------------------------------
+
+def _pool_windows(x, ksize, strides, paddings):
+    """Gather explicit pooling windows: x [N,C,H,W] ->
+    (vals [N,C,Ho,Wo,kh,kw], hidx [Ho,kh], widx [Wo,kw], valid masks).
+    Out-of-bounds taps are masked, not materialized (no host padding)."""
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    h, w = x.shape[2], x.shape[3]
+    ho = (h - kh + 2 * ph) // sh + 1
+    wo = (w - kw + 2 * pw) // sw + 1
+    hidx = (jnp.arange(ho) * sh - ph)[:, None] + jnp.arange(kh)[None, :]
+    widx = (jnp.arange(wo) * sw - pw)[:, None] + jnp.arange(kw)[None, :]
+    hvalid = (hidx >= 0) & (hidx < h)
+    wvalid = (widx >= 0) & (widx < w)
+    rows = jnp.take(x, jnp.clip(hidx, 0, h - 1).reshape(-1), axis=2)
+    rows = rows.reshape(x.shape[:2] + (ho, kh, w))
+    vals = jnp.take(rows, jnp.clip(widx, 0, w - 1).reshape(-1), axis=4)
+    vals = vals.reshape(x.shape[:2] + (ho, kh, wo, kw))
+    vals = jnp.moveaxis(vals, 3, 4)  # [N,C,Ho,Wo,kh,kw]
+    return vals, hidx, widx, hvalid, wvalid, ho, wo
+
+
+@register("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """pool_with_index_op.cc: max pool + per-window argmax Mask holding the
+    in-plane flat index (h * W + w) of each max."""
+    x = single(ins, "X")
+    ksize = [int(k) for k in attrs["ksize"]]
+    if attrs.get("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3]]
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    vals, hidx, widx, hvalid, wvalid, ho, wo = _pool_windows(
+        x, ksize, strides, paddings)
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+    valid = hvalid[:, None, :, None] & wvalid[None, :, None, :]  # Ho,Wo,kh,kw
+    masked = jnp.where(valid[None, None], vals, neg)
+    flat = masked.reshape(masked.shape[:4] + (-1,))
+    amax = jnp.argmax(flat, axis=-1)                     # [N,C,Ho,Wo]
+    out = jnp.max(flat, axis=-1)
+    # window-local argmax -> in-plane flat index
+    kh, kw = ksize
+    local_h = amax // kw
+    local_w = amax % kw
+    gh = jnp.take_along_axis(  # [Ho,kh] rows indexed per output position
+        hidx[None, None, :, None, :].astype(jnp.int32),
+        local_h[..., None].astype(jnp.int32), axis=-1).squeeze(-1)
+    gw = jnp.take_along_axis(
+        widx[None, None, None, :, :].astype(jnp.int32),
+        local_w[..., None].astype(jnp.int32), axis=-1).squeeze(-1)
+    mask = (gh * x.shape[3] + gw).astype(jnp.int32)
+    return {"Out": [out], "Mask": [mask]}
+
+
+@register("unpool")
+def _unpool(ctx, ins, attrs):
+    """unpool_op.h: scatter x back to the in-plane positions recorded by
+    max_pool2d_with_index; everything else zero."""
+    x = single(ins, "X")              # [N, C, h, w]
+    indices = single(ins, "Indices")  # [N, C, h, w] in-plane flat indices
+    ksize = [int(k) for k in attrs["ksize"]]
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    n, c, h, w = x.shape
+    ho = (h - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    wo = (w - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    flat = jnp.zeros((n * c, ho * wo), x.dtype)
+    rows = jnp.arange(n * c)[:, None]
+    out = flat.at[rows, indices.reshape(n * c, -1)].set(
+        x.reshape(n * c, -1), mode="drop")
+    return _out(out.reshape(n, c, ho, wo))
+
+
+@register("spp")
+def _spp(ctx, ins, attrs):
+    """spp_op.h: spatial pyramid pooling — per level p, pool to 2^p x 2^p
+    bins (kernel=ceil(dim/bins), stride=kernel, symmetric pad), flatten,
+    concat -> [N, C * (4^height - 1) / 3]."""
+    x = single(ins, "X")
+    height = int(attrs["pyramid_height"])
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    pieces = []
+    for p in range(height):
+        bins = 2 ** p
+        kh = -(-h // bins)
+        kw = -(-w // bins)
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        vals, _, _, hvalid, wvalid, ho, wo = _pool_windows(
+            x, [kh, kw], [kh, kw], [ph, pw])
+        valid = hvalid[:, None, :, None] & wvalid[None, :, None, :]
+        if ptype == "max":
+            neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+            lvl = jnp.max(jnp.where(valid[None, None], vals, neg),
+                          axis=(-2, -1))
+        else:
+            # reference AvgPool divides by the full window size incl. padding
+            lvl = jnp.sum(jnp.where(valid[None, None], vals, 0.0),
+                          axis=(-2, -1)) / float(kh * kw)
+        pieces.append(lvl.reshape(n, -1))
+    return _out(jnp.concatenate(pieces, axis=1))
+
+
+@register("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    """roi_pool_op.h: Fast-RCNN ROI max pooling. ROIs [R, 5] rows are
+    (batch_id, x1, y1, x2, y2) in input scale; each ROI is divided into
+    pooled_h x pooled_w bins, empty bins produce 0 with Argmax -1.
+
+    TPU-native: bin membership is a value-dependent mask over the static
+    [H, W] plane (the reference's per-bin scalar loops), so shapes stay
+    static and backward is jax.vjp of a masked max."""
+    x = single(ins, "X")        # [N, C, H, W]
+    rois = single(ins, "ROIs")  # [R, 5]
+    phh = int(attrs["pooled_height"])
+    pww = int(attrs["pooled_width"])
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    rf = rois.astype(jnp.float32)
+    batch_id = rois[:, 0].astype(jnp.int32)
+    x1 = jnp.round(rf[:, 1] * scale).astype(jnp.int32)
+    y1 = jnp.round(rf[:, 2] * scale).astype(jnp.int32)
+    x2 = jnp.round(rf[:, 3] * scale).astype(jnp.int32)
+    y2 = jnp.round(rf[:, 4] * scale).astype(jnp.int32)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+    roi_w = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+    bin_h = roi_h / phh  # [R]
+    bin_w = roi_w / pww
+
+    def bounds(start, bin_sz, pooled, limit):
+        ip = jnp.arange(pooled, dtype=jnp.float32)
+        lo = jnp.floor(ip[None, :] * bin_sz[:, None]).astype(jnp.int32)
+        hi = jnp.ceil((ip[None, :] + 1) * bin_sz[:, None]).astype(jnp.int32)
+        lo = jnp.clip(lo + start[:, None], 0, limit)
+        hi = jnp.clip(hi + start[:, None], 0, limit)
+        return lo, hi  # [R, pooled]
+
+    hlo, hhi = bounds(y1, bin_h, phh, h)
+    wlo, whi = bounds(x1, bin_w, pww, w)
+    hs = jnp.arange(h)
+    ws = jnp.arange(w)
+    hmask = (hs[None, None, :] >= hlo[:, :, None]) & \
+            (hs[None, None, :] < hhi[:, :, None])      # [R, PH, H]
+    wmask = (ws[None, None, :] >= wlo[:, :, None]) & \
+            (ws[None, None, :] < whi[:, :, None])      # [R, PW, W]
+    feat = x[jnp.clip(batch_id, 0, n - 1)]             # [R, C, H, W]
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+    # separable two-stage masked max: reduce rows under hmask, then columns
+    # under wmask — peak memory O(R*C*PH*H*W), never the PH*PW x H*W cross
+    # product a joint-mask formulation would materialize
+    vals_h = jnp.where(hmask[:, None, :, :, None],
+                       feat[:, :, None, :, :], neg)    # [R, C, PH, H, W]
+    rowmax = jnp.max(vals_h, axis=3)                   # [R, C, PH, W]
+    rowargh = jnp.argmax(vals_h, axis=3)               # h of each column max
+    vals_w = jnp.where(wmask[:, None, None, :, :],
+                       rowmax[:, :, :, None, :], neg)  # [R, C, PH, PW, W]
+    out = jnp.max(vals_w, axis=-1)
+    argw = jnp.argmax(vals_w, axis=-1)                 # [R, C, PH, PW]
+    argh = jnp.take_along_axis(
+        rowargh[:, :, :, None, :], argw[..., None], axis=-1).squeeze(-1)
+    empty = ~(jnp.any(hmask, 2)[:, :, None] &
+              jnp.any(wmask, 2)[:, None, :])           # [R, PH, PW]
+    out = jnp.where(empty[:, None], 0.0, out)
+    argmax = jnp.where(empty[:, None], -1,
+                       argh * w + argw).astype(jnp.int64
+                       if jax.config.jax_enable_x64 else jnp.int32)
+    return {"Out": [out.astype(x.dtype)], "Argmax": [argmax]}
+
+
+# ---------------------------------------------------------------------------
+# sequence tail (padded-dense layout: X [B, T, ...] + XLen [B])
+# ---------------------------------------------------------------------------
+
+@register("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    """sequence_slice_op.cc: per-sequence crop [offset, offset+length) in
+    the padded layout — a per-row dynamic gather with masking; output keeps
+    the static T and carries new lengths in OutLen."""
+    x = single(ins, "X")            # [B, T, ...]
+    offset = single(ins, "Offset").reshape(-1).astype(jnp.int32)  # [B]
+    length = single(ins, "Length").reshape(-1).astype(jnp.int32)  # [B]
+    t = x.shape[1]
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]        # [1, T]
+    src = jnp.clip(pos + offset[:, None], 0, t - 1)      # [B, T]
+    gathered = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    keep = (pos < length[:, None]).reshape(
+        x.shape[:2] + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(keep, gathered, 0)],
+            "OutLen": [length]}
+
+
+@register("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    """sequence_concat_op.cc: axis=0 concatenates along time per sequence
+    (out seq b = x0[b][:len0] ++ x1[b][:len1] ++ ...); other axes are a
+    plain feature concat. Gather formulation: for each output step t, find
+    the source input via the per-row cumulative-length table."""
+    xs = ins["X"]                   # list of [B, Ti, F]
+    lens = ins["XLen"]              # list of [B]
+    axis = attrs.get("axis", 0)
+    if axis != 0:
+        return {"Out": [jnp.concatenate(xs, axis=axis)],
+                "OutLen": [lens[0].astype(jnp.int32)]}
+    b = xs[0].shape[0]
+    tmax = max(x.shape[1] for x in xs)
+    feat = xs[0].shape[2:]
+    stack = jnp.stack(
+        [jnp.pad(x, [(0, 0), (0, tmax - x.shape[1])] +
+                 [(0, 0)] * (x.ndim - 2)) for x in xs], 0)  # [N,B,Tmax,F]
+    ln = jnp.stack([l.reshape(-1).astype(jnp.int32) for l in lens], 0)  # [N,B]
+    cum = jnp.concatenate(
+        [jnp.zeros((1, b), jnp.int32), jnp.cumsum(ln, axis=0)], 0)  # [N+1,B]
+    ttot = sum(x.shape[1] for x in xs)
+    t = jnp.arange(ttot, dtype=jnp.int32)                    # [Ttot]
+    # seg[b_, t] = index of the input owning output step t for row b_
+    seg = (t[None, :, None] >= cum.T[:, None, 1:]).sum(-1)   # [B, Ttot]
+    seg = jnp.clip(seg, 0, len(xs) - 1)
+    start = jnp.take_along_axis(cum.T, seg, axis=1)          # [B, Ttot]
+    local = jnp.clip(t[None, :] - start, 0, tmax - 1)
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    flat_idx = (seg * b + rows) * tmax + local               # [B, Ttot]
+    flat = stack.reshape((len(xs) * b * tmax,) + feat)
+    out = jnp.take(flat, flat_idx.reshape(-1), axis=0).reshape(
+        (b, ttot) + feat)
+    total = cum[-1]                                          # [B]
+    keep = (t[None, :] < total[:, None]).reshape(
+        (b, ttot) + (1,) * len(feat))
+    return {"Out": [jnp.where(keep, out, 0)],
+            "OutLen": [total]}
+
+
+# ---------------------------------------------------------------------------
+# ranking / multiclass metrics tail
+# ---------------------------------------------------------------------------
+
+@register("precision_recall")
+def _precision_recall(ctx, ins, attrs):
+    """precision_recall_op.h: multiclass TP/FP/TN/FN statistics + macro and
+    micro precision/recall/F1. Metrics layout (6): [macro-P, macro-R,
+    macro-F1, micro-P, micro-R, micro-F1]. Empty-denominator convention
+    follows the reference: precision/recall default to 1, F1 to 0."""
+    idx = single(ins, "Indices").reshape(-1).astype(jnp.int32)
+    label = single(ins, "Labels").reshape(-1).astype(jnp.int32)
+    weights = single(ins, "Weights")
+    states = single(ins, "StatesInfo")
+    cls = int(attrs["class_number"])
+    w = (weights.reshape(-1).astype(jnp.float32)
+         if weights is not None else jnp.ones(idx.shape[0], jnp.float32))
+    oh_pred = jax.nn.one_hot(idx, cls, dtype=jnp.float32)
+    oh_label = jax.nn.one_hot(label, cls, dtype=jnp.float32)
+    correct = (idx == label).astype(jnp.float32)
+    tp = jnp.sum(w[:, None] * oh_pred * oh_label, 0)
+    fp = jnp.sum(w[:, None] * oh_pred * (1 - oh_label), 0)
+    fn = jnp.sum(w[:, None] * (1 - oh_pred) * oh_label, 0)
+    # TN[c] += w except for pred (always) and label (when wrong)
+    tn = jnp.sum(w) - jnp.sum(w[:, None] * oh_pred, 0) \
+        - jnp.sum((w * (1 - correct))[:, None] * oh_label, 0)
+    batch = jnp.stack([tp, fp, tn, fn], axis=1)  # [C, 4]
+
+    def metrics(st):
+        tp_, fp_, fn_ = st[:, 0], st[:, 1], st[:, 3]
+        def ratio(a, b):
+            return jnp.where(a + b > 0, a / jnp.maximum(a + b, 1e-30), 1.0)
+        def f1(p, r):
+            return jnp.where(p + r > 0,
+                             2 * p * r / jnp.maximum(p + r, 1e-30), 0.0)
+        # macro F1 is the F1 OF the macro-averaged P/R (reference
+        # ComputeMetrics), not the mean of per-class F1s
+        map_ = jnp.mean(ratio(tp_, fp_))
+        mar = jnp.mean(ratio(tp_, fn_))
+        mip = ratio(jnp.sum(tp_), jnp.sum(fp_))
+        mir = ratio(jnp.sum(tp_), jnp.sum(fn_))
+        return jnp.stack([map_, mar, f1(map_, mar), mip, mir, f1(mip, mir)])
+
+    accum = batch + (states if states is not None else 0.0)
+    return {"BatchMetrics": [metrics(batch)],
+            "AccumMetrics": [metrics(accum)],
+            "AccumStatesInfo": [accum]}
+
+
+@register("positive_negative_pair")
+def _positive_negative_pair(ctx, ins, attrs):
+    """positive_negative_pair_op.h: LTR pair counting. For every unordered
+    same-query pair with different labels, weight (w_i + w_j)/2 is added to
+    PositivePair when score and label order agree, else to NegativePair;
+    equal scores ALSO add to NeutralPair (faithful to the reference kernel,
+    where the neutral branch falls through into the negative one)."""
+    score = single(ins, "Score")
+    label = single(ins, "Label").reshape(-1)
+    qid = single(ins, "QueryID").reshape(-1)
+    weight = single(ins, "Weight")
+    col = attrs.get("column", -1)
+    s = score[:, col].reshape(-1)
+    n = s.shape[0]
+    w = (weight.reshape(-1) if weight is not None
+         else jnp.ones(n, jnp.float32))
+    i = jnp.arange(n)
+    pair_mask = ((qid[:, None] == qid[None, :]) & (i[:, None] < i[None, :]) &
+                 (label[:, None] != label[None, :])).astype(jnp.float32)
+    pw = 0.5 * (w[:, None] + w[None, :]) * pair_mask
+    ds = s[:, None] - s[None, :]
+    dl = label[:, None] - label[None, :]
+    pos = jnp.sum(jnp.where(ds * dl > 0, pw, 0.0)).reshape(1)
+    neg = jnp.sum(jnp.where(ds * dl <= 0, pw, 0.0)).reshape(1)
+    neu = jnp.sum(jnp.where(ds == 0, pw, 0.0)).reshape(1)
+    acc_p = single(ins, "AccumulatePositivePair")
+    acc_n = single(ins, "AccumulateNegativePair")
+    acc_u = single(ins, "AccumulateNeutralPair")
+    if acc_p is not None:
+        pos = pos + acc_p.reshape(1)
+        neg = neg + acc_n.reshape(1)
+        neu = neu + acc_u.reshape(1)
+    return {"PositivePair": [pos], "NegativePair": [neg],
+            "NeutralPair": [neu]}
+
+
+# ---------------------------------------------------------------------------
+# proximal optimizers (proximal_gd_op.cc / proximal_adagrad_op.cc)
+# ---------------------------------------------------------------------------
+
+def _proximal_step(lr, l1, l2, prox):
+    return (jnp.sign(prox) / (1.0 + lr * l2) *
+            jnp.maximum(jnp.abs(prox) - lr * l1, 0.0))
+
+
+@register("proximal_gd")
+def _proximal_gd(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    lr = single(ins, "LearningRate").reshape(())
+    prox = p - lr * g
+    out = _proximal_step(lr, attrs.get("l1", 0.0), attrs.get("l2", 0.0),
+                         prox)
+    return {"ParamOut": [out.astype(p.dtype)]}
+
+
+@register("proximal_adagrad")
+def _proximal_adagrad(ctx, ins, attrs):
+    p = single(ins, "Param")
+    g = single(ins, "Grad")
+    m = single(ins, "Moment")
+    lr = single(ins, "LearningRate").reshape(())
+    gf = g.astype(jnp.float32)
+    m_out = m + jnp.square(gf)
+    prox = p - lr * gf / jnp.sqrt(m_out)
+    out = _proximal_step(lr, attrs.get("l1", 0.0), attrs.get("l2", 0.0),
+                         prox)
+    return {"ParamOut": [out.astype(p.dtype)], "MomentOut": [m_out]}

@@ -22,6 +22,8 @@ __all__ = [
     "AdagradOptimizer", "AdamOptimizer", "AdamaxOptimizer",
     "DecayedAdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
     "FtrlOptimizer", "ModelAverage", "Optimizer",
+    "ProximalGD", "ProximalAdagrad", "ProximalGDOptimizer",
+    "ProximalAdagradOptimizer",
 ]
 
 
@@ -410,6 +412,57 @@ class FtrlOptimizer(Optimizer):
             infer_shape=False)
 
 
+class ProximalGDOptimizer(Optimizer):
+    """Parity: proximal_gd_op.cc (FOBOS; the reference registers the op
+    without an era Python class): prox = param - lr * grad;
+    param = sign(prox) / (1 + lr*l2) * max(|prox| - lr*l1, 0)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kwargs):
+        super(ProximalGDOptimizer, self).__init__(learning_rate, **kwargs)
+        self._l1 = l1
+        self._l2 = l2
+
+    def _append_optimize_op(self, block, param_and_grad):
+        return block.append_op(
+            type="proximal_gd",
+            inputs={"Param": [param_and_grad[0]],
+                    "Grad": [param_and_grad[1]],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]]},
+            attrs={"l1": self._l1, "l2": self._l2},
+            infer_shape=False)
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """Parity: proximal_adagrad_op.cc — adagrad-scaled proximal step."""
+
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kwargs):
+        super(ProximalAdagradOptimizer, self).__init__(learning_rate,
+                                                       **kwargs)
+        self._l1 = l1
+        self._l2 = l2
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator(self._moment_acc_str,
+                                       param_and_grad[0])
+        return block.append_op(
+            type="proximal_adagrad",
+            inputs={"Param": [param_and_grad[0]],
+                    "Grad": [param_and_grad[1]],
+                    "Moment": [moment],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]],
+                     "MomentOut": [moment]},
+            attrs={"l1": self._l1, "l2": self._l2},
+            infer_shape=False)
+
+
 class ModelAverage(Optimizer):
     """Parity: fluid.optimizer.ModelAverage (average_accumulates_op).
 
@@ -486,3 +539,5 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer

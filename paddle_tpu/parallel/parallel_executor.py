@@ -108,7 +108,11 @@ class ParallelExecutor(object):
                     and int(np.prod(shape)) >= dp:
                 specs[name] = P(self._batch_axis)
         acc_owner = getattr(self._program, "_accumulator_owner", {})
-        by_len = sorted(specs, key=len, reverse=True)
+        # fallback matching runs against ALL program parameters longest-first
+        # (not just the sharded ones) so a suffix-named param present in
+        # specs can never claim an accumulator whose true owner was merely
+        # excluded from sharding (leading dim not divisible by dp)
+        by_len = sorted(params, key=len, reverse=True)
         for v in self._program.global_block().vars.values():
             if v.name in specs or not getattr(v, "persistable", False):
                 continue

@@ -149,3 +149,145 @@ def test_c_api_standalone_binary(tmp_path):
     assert "n=6 ndim=2 rows=2" in r.stdout
     total = float(r.stdout.strip().split("total=")[1])
     assert abs(total - 2.0) < 1e-4  # two softmax rows
+
+
+def _save_embedding_model(dirname):
+    """CTR-style model: int64 id feed -> embedding -> fc; TWO fetch
+    targets (probabilities + pre-softmax logits) to exercise multi-fetch."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [4], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[50, 8])
+        pooled = fluid.layers.reduce_sum(emb, dim=1)
+        logits = fluid.layers.fc(input=pooled, size=3)
+        prob = fluid.layers.softmax(logits)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(dirname, ["ids"], [prob, logits], exe,
+                                      main_program=main)
+        ids_np = np.random.RandomState(5).randint(
+            0, 50, size=(3, 4)).astype("int64")
+        refs = exe.run(main, feed={"ids": ids_np},
+                       fetch_list=[prob, logits])
+    return ids_np, [np.asarray(r) for r in refs]
+
+
+def test_c_api_v2_int64_feeds_multi_fetch(tmp_path):
+    """v2 ABI: int64 id buffers feed an embedding model directly (no
+    float smuggling), and BOTH fetch targets read back with dtype+shape
+    (round-3 verdict #8 / ADVICE #2)."""
+    model_dir = str(tmp_path / "m")
+    ids_np, refs = _save_embedding_model(model_dir)
+    lib = _load_lib()
+    lib.ptpu_run2.restype = ctypes.c_int64
+    lib.ptpu_output.restype = ctypes.c_int64
+
+    h = lib.ptpu_create(model_dir.encode())
+    assert h > 0, lib.ptpu_last_error().decode()
+
+    dt = ctypes.create_string_buffer(16)
+    assert lib.ptpu_feed_dtype(ctypes.c_int64(h), 0, dt, 16) == 0
+    assert dt.value == b"int64"
+
+    data = np.ascontiguousarray(ids_np)
+    names = (ctypes.c_char_p * 1)(b"ids")
+    bufs = (ctypes.c_void_p * 1)(data.ctypes.data_as(ctypes.c_void_p))
+    shape = (ctypes.c_int64 * 2)(*data.shape)
+    shapes = (ctypes.POINTER(ctypes.c_int64) * 1)(shape)
+    ndims = (ctypes.c_int * 1)(2)
+    n_out = lib.ptpu_run2(ctypes.c_int64(h), names, bufs, shapes, ndims, 1)
+    assert n_out == 2, lib.ptpu_last_error().decode()
+    assert lib.ptpu_num_outputs(ctypes.c_int64(h)) == 2
+
+    for i, ref in enumerate(refs):
+        out = np.zeros(256, "f")
+        out_shape = (ctypes.c_int64 * 8)()
+        out_ndim = ctypes.c_int(0)
+        odt = ctypes.create_string_buffer(16)
+        nbytes = lib.ptpu_output(
+            ctypes.c_int64(h), i,
+            out.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(out.nbytes), out_shape, 8,
+            ctypes.byref(out_ndim), odt, 16)
+        assert nbytes == ref.nbytes, lib.ptpu_last_error().decode()
+        assert odt.value == b"float32"
+        assert out_ndim.value == ref.ndim
+        assert tuple(out_shape[:ref.ndim]) == ref.shape
+        got = out[:ref.size].reshape(ref.shape)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    lib.ptpu_destroy(ctypes.c_int64(h))
+
+
+C_MAIN_V2 = r"""
+#include <stdio.h>
+#include <stdint.h>
+#include <string.h>
+
+extern const char* ptpu_last_error();
+extern int64_t ptpu_create(const char* model_dir);
+extern int ptpu_feed_dtype(int64_t, int, char*, int);
+extern int64_t ptpu_run2(int64_t, const char**, const void**,
+                         const int64_t**, const int*, int);
+extern int ptpu_num_outputs(int64_t);
+extern int64_t ptpu_output(int64_t, int, void*, int64_t, int64_t*, int,
+                           int*, char*, int);
+extern void ptpu_destroy(int64_t);
+
+int main(int argc, char** argv) {
+  int64_t h = ptpu_create(argv[1]);
+  if (h <= 0) { fprintf(stderr, "create: %s\n", ptpu_last_error()); return 1; }
+  char dt[16];
+  if (ptpu_feed_dtype(h, 0, dt, 16) != 0 || strcmp(dt, "int64") != 0) {
+    fprintf(stderr, "dtype: %s (%s)\n", dt, ptpu_last_error());
+    return 2;
+  }
+  int64_t ids[2 * 4] = {1, 5, 9, 13, 2, 6, 10, 14};
+  const char* names[1] = {"ids"};
+  const void* bufs[1] = {ids};
+  int64_t shape[2] = {2, 4};
+  const int64_t* shapes[1] = {shape};
+  int ndims[1] = {2};
+  int64_t n_out = ptpu_run2(h, names, bufs, shapes, ndims, 1);
+  if (n_out < 0) { fprintf(stderr, "run2: %s\n", ptpu_last_error()); return 3; }
+  float out[64];
+  int64_t out_shape[8];
+  int out_ndim = 0;
+  char odt[16];
+  int64_t nb = ptpu_output(h, 0, out, sizeof(out), out_shape, 8, &out_ndim,
+                           odt, 16);
+  if (nb < 0) { fprintf(stderr, "output: %s\n", ptpu_last_error()); return 4; }
+  double s = 0;
+  for (int64_t i = 0; i < (int64_t)(nb / sizeof(float)); ++i) s += out[i];
+  printf("nout=%lld rows=%lld dtype=%s sum=%.4f\n", (long long)n_out,
+         (long long)out_shape[0], odt, s);
+  ptpu_destroy(h);
+  return 0;
+}
+"""
+
+
+def test_c_api_v2_standalone_binary(tmp_path):
+    model_dir = str(tmp_path / "m")
+    _save_embedding_model(model_dir)
+    _load_lib()
+
+    csrc = tmp_path / "main_v2.c"
+    csrc.write_text(C_MAIN_V2)
+    exe_path = str(tmp_path / "infer_v2")
+    ldflags = subprocess.run(
+        ["python3-config", "--ldflags", "--embed"],
+        capture_output=True, text=True, check=True).stdout.split()
+    subprocess.run(
+        ["gcc", str(csrc), "-o", exe_path, "-L" + NATIVE, "-lptpu_infer",
+         "-Wl,-rpath," + NATIVE] + ldflags,
+        check=True, capture_output=True, timeout=120)
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run([exe_path, model_dir], capture_output=True,
+                       text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "nout=2 rows=2 dtype=float32" in r.stdout
+    s = float(r.stdout.strip().split("sum=")[1])
+    assert abs(s - 2.0) < 1e-4  # two softmax rows sum to 1 each

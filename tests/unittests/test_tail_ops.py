@@ -1,0 +1,442 @@
+"""Numeric tests for the long-tail operator library (ops/tail_ops.py).
+
+Every op: forward vs an independent numpy implementation; differentiable
+ops also get central-finite-difference gradient checks through the real
+executor path. Parity: the corresponding reference
+paddle/fluid/operators/*_op.cc unit tests
+(python/paddle/fluid/tests/unittests/test_{prelu,pad,crop,roi_pool,...}_op.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+from op_test import run_op, check_forward, check_grad_fd
+
+
+rng = np.random.RandomState(7)
+
+
+# ---------------------------------------------------------------------------
+# elementwise / loss tail
+# ---------------------------------------------------------------------------
+
+def test_prelu():
+    x = rng.randn(4, 5).astype("float32")
+    x = np.where(np.abs(x) < 0.1, 0.3, x)  # keep FD probes off the kink
+    alpha = np.array([0.3], "float32")
+    exp = np.where(x >= 0, x, 0.3 * x)
+    check_forward("prelu", {"X": x, "Alpha": alpha}, exp)
+    check_grad_fd("prelu", {"X": x, "Alpha": alpha}, "X")
+    check_grad_fd("prelu", {"X": x, "Alpha": alpha}, "Alpha")
+
+
+def test_pad():
+    x = rng.randn(2, 3).astype("float32")
+    exp = np.pad(x, [(1, 2), (0, 1)], constant_values=0.5)
+    check_forward("pad", {"X": x},
+                  exp, attrs={"paddings": [1, 2, 0, 1], "pad_value": 0.5})
+    check_grad_fd("pad", {"X": x}, "X",
+                  attrs={"paddings": [1, 2, 0, 1], "pad_value": 0.5})
+
+
+def test_crop():
+    x = rng.randn(4, 6).astype("float32")
+    exp = x[1:3, 2:6]
+    check_forward("crop", {"X": x}, exp,
+                  attrs={"offsets": [1, 2], "shape": [2, 4]})
+    check_grad_fd("crop", {"X": x}, "X",
+                  attrs={"offsets": [1, 2], "shape": [2, 4]})
+
+
+def test_modified_huber_loss():
+    x = np.array([[-2.0], [-0.5], [0.2], [3.0]], "float32")
+    y = np.array([[0.0], [1.0], [1.0], [1.0]], "float32")
+    inter = (x * (2 * y - 1)).ravel()
+    exp = np.where(inter < -1, -4 * inter,
+                   np.where(inter < 1, (1 - inter) ** 2, 0.0))
+    check_forward("modified_huber_loss", {"X": x, "Y": y},
+                  exp.reshape(-1, 1))
+    check_grad_fd("modified_huber_loss", {"X": x, "Y": y}, "X")
+
+
+def test_squared_l2_distance():
+    x = rng.randn(4, 3).astype("float32")
+    y = rng.randn(4, 3).astype("float32")
+    exp = ((x - y) ** 2).sum(1, keepdims=True)
+    check_forward("squared_l2_distance", {"X": x, "Y": y}, exp)
+    check_grad_fd("squared_l2_distance", {"X": x, "Y": y}, "X")
+    # y row-broadcast form
+    y1 = rng.randn(1, 3).astype("float32")
+    exp1 = ((x - y1) ** 2).sum(1, keepdims=True)
+    check_forward("squared_l2_distance", {"X": x, "Y": y1}, exp1)
+
+
+def test_l1_and_squared_l2_norm():
+    x = rng.randn(3, 4).astype("float32")
+    check_forward("l1_norm", {"X": x}, np.abs(x).sum().reshape(1))
+    check_forward("squared_l2_norm", {"X": x}, (x ** 2).sum().reshape(1))
+    check_grad_fd("l1_norm", {"X": x + 0.5}, "X")  # keep away from |0| kink
+    check_grad_fd("squared_l2_norm", {"X": x}, "X")
+
+
+def test_cross_channel_norm():
+    x = rng.rand(2, 3, 4, 5).astype("float32") + 0.1
+    scale = rng.rand(3, 1).astype("float32")
+    denom = np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+    exp = x / denom * scale.reshape(1, 3, 1, 1)
+    check_forward("norm", {"X": x, "Scale": scale}, exp,
+                  attrs={"epsilon": 1e-10}, rtol=1e-4)
+    check_grad_fd("norm", {"X": x, "Scale": scale}, "X",
+                  attrs={"epsilon": 1e-10})
+
+
+def test_conv_shift():
+    b, m, n = 3, 7, 3
+    x = rng.randn(b, m).astype("float32")
+    y = rng.randn(b, n).astype("float32")
+    half = (n - 1) // 2
+    exp = np.zeros((b, m), "float32")
+    for k in range(b):
+        for i in range(m):
+            for j in range(n):
+                exp[k, i] += x[k, (i + j - half) % m] * y[k, j]
+    check_forward("conv_shift", {"X": x, "Y": y}, exp, rtol=1e-4)
+    check_grad_fd("conv_shift", {"X": x, "Y": y}, "X")
+    check_grad_fd("conv_shift", {"X": x, "Y": y}, "Y")
+
+
+def test_bilinear_tensor_product():
+    b, dx, dy, size = 3, 4, 5, 2
+    x = rng.randn(b, dx).astype("float32")
+    y = rng.randn(b, dy).astype("float32")
+    w = rng.randn(size, dx, dy).astype("float32")
+    bias = rng.randn(1, size).astype("float32")
+    exp = np.einsum("bj,ijk,bk->bi", x, w, y) + bias
+    check_forward("bilinear_tensor_product",
+                  {"X": x, "Y": y, "Weight": w, "Bias": bias}, exp,
+                  rtol=1e-4)
+    check_grad_fd("bilinear_tensor_product",
+                  {"X": x, "Y": y, "Weight": w, "Bias": bias}, "X")
+    check_grad_fd("bilinear_tensor_product",
+                  {"X": x, "Y": y, "Weight": w, "Bias": bias}, "Weight")
+
+
+# ---------------------------------------------------------------------------
+# pooling tail
+# ---------------------------------------------------------------------------
+
+def _np_max_pool_with_index(x, ksize, strides, paddings):
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    ho = (h - kh + 2 * ph) // sh + 1
+    wo = (w - kw + 2 * pw) // sw + 1
+    out = np.zeros((n, c, ho, wo), x.dtype)
+    mask = np.zeros((n, c, ho, wo), "int32")
+    for b in range(n):
+        for ch in range(c):
+            for i in range(ho):
+                for j in range(wo):
+                    best, bidx = -np.inf, -1
+                    for di in range(kh):
+                        for dj in range(kw):
+                            hh, ww = i * sh - ph + di, j * sw - pw + dj
+                            if 0 <= hh < h and 0 <= ww < w \
+                                    and x[b, ch, hh, ww] > best:
+                                best = x[b, ch, hh, ww]
+                                bidx = hh * w + ww
+                    out[b, ch, i, j] = best
+                    mask[b, ch, i, j] = bidx
+    return out, mask
+
+
+def test_max_pool2d_with_index():
+    x = rng.randn(2, 3, 6, 7).astype("float32")
+    for ksize, strides, paddings in [([2, 2], [2, 2], [0, 0]),
+                                     ([3, 2], [2, 1], [1, 0])]:
+        exp, expmask = _np_max_pool_with_index(x, ksize, strides, paddings)
+        got = run_op("max_pool2d_with_index", {"X": x},
+                     {"ksize": ksize, "strides": strides,
+                      "paddings": paddings}, out_slots=("Out", "Mask"))
+        np.testing.assert_allclose(got[0], exp, rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(got[1]), expmask)
+    check_grad_fd("max_pool2d_with_index", {"X": x}, "X",
+                  attrs={"ksize": [2, 2], "strides": [2, 2],
+                         "paddings": [0, 0]})
+
+
+def test_unpool_roundtrip():
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    pooled, mask = _np_max_pool_with_index(x, [2, 2], [2, 2], [0, 0])
+    got = run_op("unpool", {"X": pooled, "Indices": mask},
+                 {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]})
+    exp = np.zeros_like(x).reshape(2 * 3, 64)
+    for bc in range(6):
+        exp[bc, mask.reshape(6, -1)[bc]] = pooled.reshape(6, -1)[bc]
+    np.testing.assert_allclose(np.asarray(got[0]).reshape(6, 64), exp,
+                               rtol=1e-5)
+    check_grad_fd("unpool", {"X": pooled, "Indices": mask}, "X",
+                  attrs={"ksize": [2, 2], "strides": [2, 2],
+                         "paddings": [0, 0]})
+
+
+def test_spp():
+    x = rng.randn(2, 3, 5, 7).astype("float32")
+    height = 2
+    pieces = []
+    for p in range(height):
+        bins = 2 ** p
+        kh, kw = -(-5 // bins), -(-7 // bins)
+        ph, pw = (kh * bins - 5 + 1) // 2, (kw * bins - 7 + 1) // 2
+        lvl = np.full((2, 3, bins, bins), -np.inf, "float32")
+        for b in range(2):
+            for c in range(3):
+                for i in range(bins):
+                    for j in range(bins):
+                        hs, ws = i * kh - ph, j * kw - pw
+                        reg = x[b, c,
+                                max(hs, 0):min(hs + kh, 5),
+                                max(ws, 0):min(ws + kw, 7)]
+                        lvl[b, c, i, j] = reg.max()
+        pieces.append(lvl.reshape(2, -1))
+    exp = np.concatenate(pieces, axis=1)
+    check_forward("spp", {"X": x}, exp,
+                  attrs={"pyramid_height": height, "pooling_type": "max"})
+    check_grad_fd("spp", {"X": x}, "X",
+                  attrs={"pyramid_height": 2, "pooling_type": "max"})
+
+
+def test_roi_pool():
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    rois = np.array([[0, 1, 1, 5, 5],
+                     [1, 0, 0, 7, 7],
+                     [0, 4, 4, 6, 6]], "int64")
+    ph = pw = 2
+    scale = 1.0
+    r = rois.shape[0]
+    exp = np.zeros((r, 3, ph, pw), "float32")
+    exparg = np.full((r, 3, ph, pw), -1, "int64")
+    for ri in range(r):
+        bid, x1, y1, x2, y2 = [int(v) for v in rois[ri]]
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        bh, bw = rh / ph, rw / pw
+        for c in range(3):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = min(max(int(np.floor(i * bh)) + y1, 0), 8)
+                    he = min(max(int(np.ceil((i + 1) * bh)) + y1, 0), 8)
+                    ws = min(max(int(np.floor(j * bw)) + x1, 0), 8)
+                    we = min(max(int(np.ceil((j + 1) * bw)) + x1, 0), 8)
+                    if he <= hs or we <= ws:
+                        continue
+                    reg = x[bid, c, hs:he, ws:we]
+                    exp[ri, c, i, j] = reg.max()
+                    am = np.unravel_index(reg.argmax(), reg.shape)
+                    exparg[ri, c, i, j] = (hs + am[0]) * 8 + (ws + am[1])
+    got = run_op("roi_pool", {"X": x, "ROIs": rois},
+                 {"pooled_height": ph, "pooled_width": pw,
+                  "spatial_scale": scale}, out_slots=("Out", "Argmax"))
+    np.testing.assert_allclose(got[0], exp, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got[1], "int64"), exparg)
+    check_grad_fd("roi_pool", {"X": x, "ROIs": rois}, "X",
+                  attrs={"pooled_height": ph, "pooled_width": pw,
+                         "spatial_scale": scale})
+
+
+# ---------------------------------------------------------------------------
+# sequence tail
+# ---------------------------------------------------------------------------
+
+def test_sequence_slice():
+    x = rng.randn(3, 6, 2).astype("float32")
+    xlen = np.array([6, 4, 5], "int32")
+    offset = np.array([[0], [1], [2]], "int64")
+    length = np.array([[2], [1], [3]], "int64")
+    exp = np.zeros_like(x)
+    for b in range(3):
+        o, l = int(offset[b, 0]), int(length[b, 0])
+        exp[b, :l] = x[b, o:o + l]
+    got = run_op("sequence_slice",
+                 {"X": x, "Offset": offset, "Length": length, "XLen": xlen},
+                 out_slots=("Out", "OutLen"))
+    np.testing.assert_allclose(got[0], exp, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got[1]), length.ravel())
+    check_grad_fd("sequence_slice",
+                  {"X": x, "Offset": offset, "Length": length, "XLen": xlen},
+                  "X")
+
+
+def test_sequence_concat_time_axis():
+    x0 = rng.randn(2, 4, 3).astype("float32")
+    x1 = rng.randn(2, 5, 3).astype("float32")
+    l0 = np.array([3, 4], "int32")
+    l1 = np.array([5, 2], "int32")
+    ttot = 9
+    exp = np.zeros((2, ttot, 3), "float32")
+    for b in range(2):
+        seq = np.concatenate([x0[b, :l0[b]], x1[b, :l1[b]]], 0)
+        exp[b, :seq.shape[0]] = seq
+    got = run_op("sequence_concat",
+                 {"X": [x0, x1], "XLen": [l0, l1]},
+                 {"axis": 0}, out_slots=("Out", "OutLen"))
+    np.testing.assert_allclose(got[0], exp, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got[1]), l0 + l1)
+
+
+def test_sequence_concat_layer_and_grad():
+    # through the layer API with real data vars, including backward
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[3], lod_level=1)
+        b = fluid.layers.data("b", shape=[3], lod_level=1)
+        out = fluid.layers.sequence_concat([a, b])
+        pooled = fluid.layers.sequence_pool(out, "sum")
+        loss = fluid.layers.mean(x=fluid.layers.reduce_sum(pooled))
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {
+        "a": np.ones((2, 4, 3), "float32"),
+        "a@SEQLEN": np.array([2, 4], "int32"),
+        "b": np.ones((2, 4, 3), "float32") * 2,
+        "b@SEQLEN": np.array([1, 3], "int32"),
+    }
+    out_v, = exe.run(main, feed=feed, fetch_list=[loss.name])
+    # total over both sequences: b0: 2*3*1 + 1*3*2 = 12; b1: 4*3 + 3*3*2 = 30
+    np.testing.assert_allclose(out_v, 42.0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# metrics tail
+# ---------------------------------------------------------------------------
+
+def _np_precision_recall(idx, label, w, cls, states=None):
+    st = np.zeros((cls, 4), "float64")  # TP FP TN FN
+    for i in range(len(idx)):
+        p, l, wi = int(idx[i]), int(label[i]), float(w[i])
+        if p == l:
+            st[p, 0] += wi
+            st[:, 2] += wi
+            st[p, 2] -= wi
+        else:
+            st[l, 3] += wi
+            st[p, 1] += wi
+            st[:, 2] += wi
+            st[p, 2] -= wi
+            st[l, 2] -= wi
+    def prec(tp, fp):
+        return tp / (tp + fp) if (tp > 0 or fp > 0) else 1.0
+    def f1(p, r):
+        return 2 * p * r / (p + r) if (p > 0 or r > 0) else 0.0
+    def metrics(st):
+        ps = [prec(st[c, 0], st[c, 1]) for c in range(cls)]
+        rs = [prec(st[c, 0], st[c, 3]) for c in range(cls)]
+        mp, mr = np.mean(ps), np.mean(rs)
+        ip = prec(st[:, 0].sum(), st[:, 1].sum())
+        ir = prec(st[:, 0].sum(), st[:, 3].sum())
+        return np.array([mp, mr, f1(mp, mr), ip, ir, f1(ip, ir)])
+    accum = st + (states if states is not None else 0)
+    return metrics(st), metrics(accum), accum
+
+
+def test_precision_recall():
+    cls = 3
+    idx = np.array([[0], [1], [2], [1], [0], [2], [1]], "int32")
+    label = np.array([[0], [2], [2], [1], [1], [0], [1]], "int32")
+    w = np.full((7, 1), 0.5, "float32")
+    states = rng.rand(cls, 4).astype("float32") * 2
+    eb, ea, es = _np_precision_recall(idx.ravel(), label.ravel(),
+                                      w.ravel(), cls, states)
+    got = run_op("precision_recall",
+                 {"Indices": idx, "Labels": label, "Weights": w,
+                  "StatesInfo": states},
+                 {"class_number": cls},
+                 out_slots=("BatchMetrics", "AccumMetrics",
+                            "AccumStatesInfo"))
+    np.testing.assert_allclose(got[0], eb, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got[1], ea, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got[2], es, rtol=1e-5, atol=1e-5)
+
+
+def test_positive_negative_pair():
+    score = np.array([[0.8], [0.2], [0.5], [0.5], [0.9]], "float32")
+    label = np.array([[1.0], [0.0], [1.0], [0.0], [2.0]], "float32")
+    qid = np.array([[1], [1], [1], [1], [2]], "int64")
+    pos = neg = neu = 0.0
+    n = 5
+    for i in range(n):
+        for j in range(i + 1, n):
+            if qid[i, 0] != qid[j, 0] or label[i, 0] == label[j, 0]:
+                continue
+            w = 1.0
+            ds = score[i, 0] - score[j, 0]
+            dl = label[i, 0] - label[j, 0]
+            if ds == 0:
+                neu += w
+            if ds * dl > 0:
+                pos += w
+            else:
+                neg += w
+    got = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": qid},
+                 {"column": -1},
+                 out_slots=("PositivePair", "NegativePair", "NeutralPair"))
+    np.testing.assert_allclose(got[0], [pos], rtol=1e-6)
+    np.testing.assert_allclose(got[1], [neg], rtol=1e-6)
+    np.testing.assert_allclose(got[2], [neu], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# proximal optimizers
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opt_cls,has_moment", [
+    (fluid.optimizer.ProximalGDOptimizer, False),
+    (fluid.optimizer.ProximalAdagradOptimizer, True),
+])
+def test_proximal_optimizers(opt_cls, has_moment):
+    lr, l1, l2 = 0.1, 0.05, 0.02
+    x_np = rng.randn(4, 3).astype("float32")
+    w_init = rng.randn(3, 1).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        y = fluid.layers.fc(
+            x, 1, bias_attr=False,
+            param_attr=fluid.ParamAttr(
+                name="w",
+                initializer=fluid.initializer.NumpyArrayInitializer(w_init)))
+        loss = fluid.layers.mean(x=fluid.layers.reduce_sum(y, dim=1))
+        opt = opt_cls(learning_rate=lr, l1=l1, l2=l2)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": x_np}, fetch_list=[loss.name])
+        w_new = np.array(scope.find_var("w").get_tensor())
+    grad = np.tile(x_np.mean(0, keepdims=True).T, (1, 1))
+    if has_moment:
+        moment = grad ** 2
+        prox = w_init - lr * grad / np.sqrt(moment)
+    else:
+        prox = w_init - lr * grad
+    exp = np.sign(prox) / (1 + lr * l2) * np.maximum(
+        np.abs(prox) - lr * l1, 0)
+    np.testing.assert_allclose(w_new, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_prelu_layer_in_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.prelu(fluid.layers.fc(x, 8))
+        loss = fluid.layers.mean(x=fluid.layers.reduce_sum(h, dim=1))
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out, = exe.run(main, feed={"x": rng.randn(5, 4).astype("float32")},
+                   fetch_list=[loss.name])
+    assert np.isfinite(out).all()
