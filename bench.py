@@ -61,6 +61,14 @@ def _await_devices(timeout_s):
     return out["devices"]
 
 
+def _mfu(flops_per_sec):
+    """Model FLOPs utilization against the chip's peak (BENCH_PEAK_TFLOPS,
+    default 197 = TPU v5e bf16), so every bench line self-describes how far
+    it sits from the >=25% north star (SURVEY.md section 5)."""
+    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+    return round(flops_per_sec / peak, 4)
+
+
 def bench_transformer():
     """Transformer training throughput through the pallas flash-attention
     path (BENCH_MODEL=transformer). Base-ish config (d_model 512, 8 heads,
@@ -115,12 +123,17 @@ def bench_transformer():
         assert np.isfinite(loss).all(), "non-finite loss"
 
     tps = batch * seq * steps / dt
+    # training FLOPs/token ~ 6 * params (72*L*d^2 with d_inner=4d) plus
+    # the attention matmuls (~12*L*seq*d fwd+bwd)
+    flops_per_token = 72.0 * n_layer * d_model ** 2 \
+        + 12.0 * n_layer * seq * d_model
     print(json.dumps({
         "metric": "transformer_train_throughput",
         "value": round(tps, 1), "unit": "tokens/sec/chip",
         "vs_baseline": None, "batch": batch, "seq": seq,
         "layers": n_layer, "d_model": d_model, "dtype": dtype,
         "fused_attention": fused, "device": str(jax.devices()[0]),
+        "mfu": _mfu(tps * flops_per_token),
         "loss": float(loss.reshape(-1)[0])}))
 
 
@@ -203,6 +216,9 @@ def main():
 
     ips = batch * steps / dt
     headline = (hw == 224 and class_dim == 1000)
+    # ResNet-50 fwd ~ 4.1 GFLOPs @ 224^2; training ~ 3x fwd (mfu is only
+    # reported for the headline 224 config, so no resolution scaling)
+    flops_per_image = 3 * 4.1e9
     rec = {
         "metric": "resnet50_imagenet_train_throughput",
         "value": round(ips, 2),
@@ -214,6 +230,7 @@ def main():
         "dtype": dtype,
         "feed": feed_mode,
         "device": str(jax.devices()[0]),
+        "mfu": _mfu(ips * flops_per_image) if headline else None,
         "loss": float(np.asarray(loss).reshape(-1)[0]),
     }
     if not headline:

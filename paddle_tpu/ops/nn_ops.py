@@ -117,22 +117,34 @@ def _pool2d(ctx, ins, attrs):
         ksize = (x.shape[2], x.shape[3])
         pads = (0, 0)
         strides = (1, 1)
+    # ceil_mode rounds the output size UP; realized as extra trailing
+    # padding so reduce_window emits ceil((H - k + 2p)/s) + 1 positions
+    # (pool_op.cc ceil_mode attr; the extra rows never enter an avg count)
+    extra = [0, 0]
+    if attrs.get("ceil_mode", False):
+        for d, hw in enumerate((x.shape[2], x.shape[3])):
+            span = hw - ksize[d] + 2 * pads[d]
+            out_ceil = -(-span // strides[d]) + 1
+            extra[d] = max(0, (out_ceil - 1) * strides[d] - span)
     nhwc = _conv_layout() == "NHWC"
     if nhwc:  # channels-last compute layout, same knob as conv2d
         x = jnp.transpose(x, (0, 2, 3, 1))
         window = (1,) + ksize + (1,)
         strides4 = (1,) + strides + (1,)
-        padding = ((0, 0), (pads[0], pads[0]), (pads[1], pads[1]), (0, 0))
+        padding = ((0, 0), (pads[0], pads[0] + extra[0]),
+                   (pads[1], pads[1] + extra[1]), (0, 0))
     else:
         window = (1, 1) + ksize
         strides4 = (1, 1) + strides
-        padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+        padding = ((0, 0), (0, 0), (pads[0], pads[0] + extra[0]),
+                   (pads[1], pads[1] + extra[1]))
     if ptype == "max":
         init = -jnp.inf
         out = lax.reduce_window(x, init, lax.max, window, strides4, padding)
     else:
         s = lax.reduce_window(x, 0.0, lax.add, window, strides4, padding)
-        if attrs.get("exclusive", True) and (pads[0] or pads[1]):
+        if attrs.get("exclusive", True) and (pads[0] or pads[1] or
+                                             extra[0] or extra[1]):
             ones = jnp.ones_like(x)
             cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides4, padding)
             out = s / cnt

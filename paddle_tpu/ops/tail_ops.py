@@ -238,9 +238,12 @@ def _spp(ctx, ins, attrs):
             lvl = jnp.max(jnp.where(valid[None, None], vals, neg),
                           axis=(-2, -1))
         else:
-            # reference AvgPool divides by the full window size incl. padding
+            # reference AvgPool (math/pooling.cc) divides by the CLIPPED
+            # window — only in-bounds taps count, padding excluded
+            cnt = jnp.maximum(
+                jnp.sum(valid, axis=(-2, -1)).astype(x.dtype), 1.0)
             lvl = jnp.sum(jnp.where(valid[None, None], vals, 0.0),
-                          axis=(-2, -1)) / float(kh * kw)
+                          axis=(-2, -1)) / cnt[None, None]
         pieces.append(lvl.reshape(n, -1))
     return _out(jnp.concatenate(pieces, axis=1))
 
@@ -296,19 +299,28 @@ def _roi_pool(ctx, ins, attrs):
     vals_h = jnp.where(hmask[:, None, :, :, None],
                        feat[:, :, None, :, :], neg)    # [R, C, PH, H, W]
     rowmax = jnp.max(vals_h, axis=3)                   # [R, C, PH, W]
-    rowargh = jnp.argmax(vals_h, axis=3)               # h of each column max
     vals_w = jnp.where(wmask[:, None, None, :, :],
                        rowmax[:, :, :, None, :], neg)  # [R, C, PH, PW, W]
-    out = jnp.max(vals_w, axis=-1)
-    argw = jnp.argmax(vals_w, axis=-1)                 # [R, C, PH, PW]
-    argh = jnp.take_along_axis(
-        rowargh[:, :, :, None, :], argw[..., None], axis=-1).squeeze(-1)
+    rawmax = jnp.max(vals_w, axis=-1)                  # [R, C, PH, PW]
     empty = ~(jnp.any(hmask, 2)[:, :, None] &
               jnp.any(wmask, 2)[:, None, :])           # [R, PH, PW]
-    out = jnp.where(empty[:, None], 0.0, out)
-    argmax = jnp.where(empty[:, None], -1,
-                       argh * w + argw).astype(jnp.int64
-                       if jax.config.jax_enable_x64 else jnp.int32)
+    out = jnp.where(empty[:, None], 0.0, rawmax)
+    # Argmax must match the reference's ROW-MAJOR first-max scan even when
+    # the bin max is duplicated: per pooled column, take the SMALLEST
+    # in-plane index h*W+w whose value equals the bin max. One [R,C,PH,H,W]
+    # mask per pw (python loop over the small static PW) — never the joint
+    # PH*PW x H*W product.
+    flatpos = (hs[:, None] * w + ws[None, :]).astype(jnp.int32)  # [H, W]
+    args = []
+    for pw in range(pww):
+        eq = (vals_h == rawmax[:, :, :, pw, None, None]) & \
+            hmask[:, None, :, :, None] & \
+            wmask[:, pw][:, None, None, None, :]
+        pos = jnp.where(eq, flatpos[None, None, None], h * w)
+        args.append(jnp.min(pos, axis=(3, 4)))         # [R, C, PH]
+    argmax = jnp.stack(args, axis=-1)                  # [R, C, PH, PW]
+    argmax = jnp.where(empty[:, None], -1, argmax).astype(
+        jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
     return {"Out": [out.astype(x.dtype)], "Argmax": [argmax]}
 
 

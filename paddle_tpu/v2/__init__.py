@@ -28,6 +28,8 @@ from . import minibatch                     # noqa: F401
 from . import image                         # noqa: F401
 from . import data_feeder                   # noqa: F401
 from . import evaluator                     # noqa: F401
+from . import plot                          # noqa: F401
+from . import op                            # noqa: F401
 
 __all__ = ["init", "dataset", "reader", "batch", "layer", "activation",
            "data_type", "attr", "pooling", "networks", "optimizer",

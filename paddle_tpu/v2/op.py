@@ -1,0 +1,34 @@
+"""paddle.v2.op — unary math functions + arithmetic operators on layers.
+
+Parity: python/paddle/v2/op.py. There each op builds a mixed/projection
+sub-network through trainer_config_helpers; here a v2 "layer" IS a fluid
+Variable (see v2/layer.py), so the math ops delegate straight to the
+fluid op set and the +,-,* operator sugar is already provided by fluid's
+math_op_patch on every Variable — only the named functions need shims.
+"""
+import paddle_tpu as fluid
+
+__all__ = ["exp", "log", "abs", "sigmoid", "tanh", "square", "relu",
+           "sqrt", "reciprocal", "softmax"]
+
+
+def _unary(op_name):
+    def op(input, name=None):
+        return getattr(fluid.layers, op_name)(input)
+    op.__name__ = op_name
+    return op
+
+
+exp = _unary("exp")
+log = _unary("log")
+abs = _unary("abs")
+sigmoid = _unary("sigmoid")
+tanh = _unary("tanh")
+square = _unary("square")
+relu = _unary("relu")
+sqrt = _unary("sqrt")
+reciprocal = _unary("reciprocal")
+
+
+def softmax(input, name=None):
+    return fluid.layers.softmax(input)

@@ -1,0 +1,11 @@
+"""paddle.v2.plot — notebook training-curve plotting.
+
+Parity: python/paddle/v2/plot/{__init__.py,plot.py} (Ploter/PlotData).
+Same contract: append (title, step, value) points, .plot() refreshes a
+matplotlib figure inside IPython, and DISABLE_PLOT=True (or a headless
+environment without matplotlib/IPython — the normal case on a TPU pod
+worker) degrades to pure data collection so training scripts keep running.
+"""
+from .plot import Ploter, PlotData
+
+__all__ = ["Ploter", "PlotData"]

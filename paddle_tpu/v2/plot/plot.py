@@ -1,0 +1,68 @@
+"""Training-curve plotter (parity: python/paddle/v2/plot/plot.py).
+
+The reference imports matplotlib + IPython eagerly unless
+DISABLE_PLOT=True; here the imports are lazy AND optional, so the shim is
+usable on headless TPU workers: data is always collected, drawing happens
+only when a display stack exists.
+"""
+import os
+
+
+class PlotData(object):
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter(object):
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = {title: PlotData() for title in args}
+        self.__disable_plot__ = os.environ.get("DISABLE_PLOT")
+        self.plt = None
+        self.display = None
+        if not self.__plot_is_disabled__():
+            try:
+                import matplotlib.pyplot as plt
+                from IPython import display
+                self.plt = plt
+                self.display = display
+            except Exception:
+                pass  # headless: collect data, skip drawing
+
+    def __plot_is_disabled__(self):
+        return self.__disable_plot__ == "True"
+
+    def append(self, title, step, value):
+        assert isinstance(title, str)
+        assert title in self.__plot_data__
+        self.__plot_data__[title].append(step, value)
+
+    def plot(self, path=None):
+        if self.__plot_is_disabled__() or self.plt is None:
+            return
+        titles = []
+        for title in self.__args__:
+            data = self.__plot_data__[title]
+            if len(data.step) > 0:
+                self.plt.plot(data.step, data.value)
+                titles.append(title)
+        self.plt.legend(titles, loc="upper left")
+        if path is None:
+            self.display.clear_output(wait=True)
+            self.display.display(self.plt.gcf())
+        else:
+            self.plt.savefig(path)
+        self.plt.gcf().clear()
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()

@@ -171,3 +171,38 @@ def test_detection_map_layer():
     assert 0.0 < expect < 1.0, expect  # non-vacuous: real TPs AND FPs
     np.testing.assert_allclose(np.asarray(got).ravel()[0], expect,
                                rtol=1e-5)
+
+
+def test_v2_plot_shim():
+    """paddle.v2.plot Ploter collects data headlessly (DISABLE_PLOT or no
+    matplotlib) without crashing — reference plot.py import parity."""
+    import os
+    import paddle_tpu.v2 as paddle
+    os.environ["DISABLE_PLOT"] = "True"
+    try:
+        p = paddle.plot.Ploter("train", "test")
+        p.append("train", 0, 1.0)
+        p.append("train", 1, 0.5)
+        p.plot()  # no-op headless
+        assert p.__plot_data__["train"].value == [1.0, 0.5]
+        p.reset()
+        assert p.__plot_data__["train"].value == []
+    finally:
+        os.environ.pop("DISABLE_PLOT", None)
+
+
+def test_v2_op_shim():
+    """paddle.v2.op named math fns build fluid ops over v2 layers."""
+    import numpy as np
+    import paddle_tpu as fluid
+    import paddle_tpu.v2 as paddle
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+        y = paddle.op.tanh(paddle.op.exp(x))
+        z = x * 2.0 + y  # math_op_patch operator sugar on Variables
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(3, 4).astype("f")
+    out, = exe.run(main, feed={"x": xv}, fetch_list=[z])
+    np.testing.assert_allclose(out, xv * 2 + np.tanh(np.exp(xv)), rtol=1e-5)

@@ -182,30 +182,54 @@ def test_unpool_roundtrip():
                          "paddings": [0, 0]})
 
 
-def test_spp():
-    x = rng.randn(2, 3, 5, 7).astype("float32")
-    height = 2
+def _np_spp(x, height, ptype):
     pieces = []
+    hh, ww = x.shape[2], x.shape[3]
     for p in range(height):
         bins = 2 ** p
-        kh, kw = -(-5 // bins), -(-7 // bins)
-        ph, pw = (kh * bins - 5 + 1) // 2, (kw * bins - 7 + 1) // 2
-        lvl = np.full((2, 3, bins, bins), -np.inf, "float32")
-        for b in range(2):
-            for c in range(3):
+        kh, kw = -(-hh // bins), -(-ww // bins)
+        ph, pw = (kh * bins - hh + 1) // 2, (kw * bins - ww + 1) // 2
+        lvl = np.zeros(x.shape[:2] + (bins, bins), "float32")
+        for b in range(x.shape[0]):
+            for c in range(x.shape[1]):
                 for i in range(bins):
                     for j in range(bins):
                         hs, ws = i * kh - ph, j * kw - pw
                         reg = x[b, c,
-                                max(hs, 0):min(hs + kh, 5),
-                                max(ws, 0):min(ws + kw, 7)]
-                        lvl[b, c, i, j] = reg.max()
-        pieces.append(lvl.reshape(2, -1))
-    exp = np.concatenate(pieces, axis=1)
+                                max(hs, 0):min(hs + kh, hh),
+                                max(ws, 0):min(ws + kw, ww)]
+                        # avg divides by the CLIPPED window (pooling.cc)
+                        lvl[b, c, i, j] = reg.max() if ptype == "max" \
+                            else reg.mean()
+        pieces.append(lvl.reshape(x.shape[0], -1))
+    return np.concatenate(pieces, axis=1)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_spp(ptype):
+    x = rng.randn(2, 3, 5, 7).astype("float32")
+    exp = _np_spp(x, 2, ptype)
     check_forward("spp", {"X": x}, exp,
-                  attrs={"pyramid_height": height, "pooling_type": "max"})
+                  attrs={"pyramid_height": 2, "pooling_type": ptype},
+                  rtol=1e-5, atol=1e-6)
     check_grad_fd("spp", {"X": x}, "X",
-                  attrs={"pyramid_height": 2, "pooling_type": "max"})
+                  attrs={"pyramid_height": 2, "pooling_type": ptype})
+
+
+def test_roi_pool_argmax_tie_row_major():
+    """Duplicated bin maxima must resolve to the reference's row-major
+    first occurrence (roi_pool_op.h strictly-greater scan)."""
+    x = np.zeros((1, 1, 6, 6), "float32")
+    # one bin covers rows 0..2, cols 0..2; put the max at (0,2) and (2,0):
+    # row-major first is (0,2) -> index 0*6+2 = 2
+    x[0, 0, 0, 2] = 5.0
+    x[0, 0, 2, 0] = 5.0
+    rois = np.array([[0, 0, 0, 5, 5]], "int64")
+    got = run_op("roi_pool", {"X": x, "ROIs": rois},
+                 {"pooled_height": 2, "pooled_width": 2,
+                  "spatial_scale": 1.0}, out_slots=("Out", "Argmax"))
+    assert np.asarray(got[0])[0, 0, 0, 0] == 5.0
+    assert int(np.asarray(got[1])[0, 0, 0, 0]) == 2
 
 
 def test_roi_pool():
