@@ -11,8 +11,9 @@ per family (the full FD loop per config would be executor-run quadratic).
 """
 import numpy as np
 import pytest
-import torch
-import torch.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
 
 from op_test import run_op, check_grad_fd
 
@@ -98,8 +99,11 @@ POOL_GRID = [
     ([2, 3, 5, 5], [3, 3], [1, 1], [0, 0], True, False, "max"),   # Case3
     ([2, 3, 7, 7], [3, 3], [1, 1], [0, 0], False, False, "max"),  # Case4
     ([2, 3, 7, 7], [3, 3], [1, 1], [1, 1], False, False, "max"),  # Case5
-    ([2, 3, 7, 7], [3, 3], [2, 2], [0, 0], False, True, "max"),   # ceil
-    ([2, 3, 7, 7], [3, 3], [2, 2], [1, 1], False, True, "avg"),   # ceil avg
+    # ceil cases where span % stride != 0, so the extra-padding path in
+    # _pool2d actually fires (6-3=3, stride 2 -> one extra trailing row)
+    ([2, 3, 6, 6], [3, 3], [2, 2], [0, 0], False, True, "max"),   # ceil
+    ([2, 3, 6, 6], [3, 3], [2, 2], [0, 0], False, True, "avg"),   # ceil avg
+    ([2, 3, 7, 7], [3, 3], [2, 2], [1, 1], False, True, "avg"),   # ceil+pad
 ]
 
 

@@ -1,0 +1,207 @@
+"""Executable audit: every operator the reference builds as C++
+(paddle/fluid/operators/*_op.cc, ~v0.11 snapshot, .cu/_test files and
+per-device kernel re-registrations excluded) must map to a registered
+TPU lowering, a special (graph-level) lowering, a documented runtime
+subsumption, or a documented scope cut (round-3 verdict #3 done-gate).
+
+The file list is a frozen snapshot (like the frozen-__all__ API parity
+test) so the audit runs without the reference checkout present.
+"""
+import pytest
+
+import paddle_tpu  # noqa: F401  (registers all lowerings)
+from paddle_tpu.core import registry
+from paddle_tpu.core.lowering import _SPECIAL
+
+# Reference *_op.cc files that lower 1:1 to a registered op named by
+# stripping the `_op` suffix.
+DIRECT = """
+accuracy adadelta adagrad adam adamax assign assign_value auc batch_norm
+bilinear_tensor_product bipartite_match box_coder cast chunk_eval
+clip_by_norm clip concat conv_shift cos_sim crf_decoding crop
+cross_entropy ctc_align cumsum decayed_adagrad detection_map dropout
+edit_distance elementwise_add elementwise_div elementwise_max
+elementwise_min elementwise_mul elementwise_pow elementwise_sub expand
+fill_constant_batch_size_like fill_constant fill_zeros_like ftrl gather
+gaussian_random_batch_size_like gaussian_random gru gru_unit hinge_loss
+huber_loss im2sequence increment iou_similarity is_empty l1_norm
+label_smooth layer_norm linear_chain_crf listen_and_serv lod_reset
+log_loss lookup_table lrn lstm lstm_unit margin_rank_loss matmul maxout
+mean merge_lod_tensor mine_hard_examples minus modified_huber_loss
+momentum mul multiclass_nms multiplex nce norm one_hot pad
+positive_negative_pair precision_recall prelu print prior_box
+proximal_adagrad proximal_gd rank_loss reshape rmsprop roi_pool row_conv
+scale scatter send sequence_concat sequence_conv sequence_erase
+sequence_expand sequence_pool sequence_reshape sequence_slice
+sequence_softmax sgd sigmoid_cross_entropy_with_logits sign
+softmax_with_cross_entropy softmax split_lod_tensor split
+squared_l2_distance squared_l2_norm sum target_assign transpose
+uniform_random_batch_size_like uniform_random unpool warpctc spp
+""".split()
+
+# Files registering several ops / ops under a different name.
+MULTI = {
+    "activation_op": ["sigmoid", "logsigmoid", "exp", "relu", "tanh",
+                      "tanh_shrink", "sqrt", "abs", "ceil", "floor", "cos",
+                      "sin", "round", "reciprocal", "log", "square",
+                      "softplus", "softsign", "brelu", "leaky_relu",
+                      "soft_relu", "elu", "relu6", "pow", "stanh",
+                      "hard_shrink", "thresholded_relu", "hard_sigmoid",
+                      "swish", "softshrink"],
+    "compare_op": ["less_than", "less_equal", "greater_than",
+                   "greater_equal", "equal", "not_equal"],
+    "logical_op": ["logical_and", "logical_or", "logical_xor",
+                   "logical_not"],
+    "reduce_op": ["reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+                  "reduce_prod"],
+    "conv_op": ["conv2d", "depthwise_conv2d"],
+    "conv_transpose_op": ["conv2d_transpose"],
+    "pool_op": ["pool2d"],
+    "pool_with_index_op": ["max_pool2d_with_index"],
+    "top_k_op": ["topk"],
+    "smooth_l1_loss_op": ["smooth_l1_loss"],
+    "lstmp_op": ["lstm"],  # projection variant of the same scan lowering
+}
+
+# Graph-level lowerings (core/lowering.py _SPECIAL / ops/control_ops.py):
+# sub-block and LoD-structure ops that can't be a single jnp rule.
+SPECIAL = {
+    "while_op": "while",
+    "conditional_block_op": "conditional_block",
+    "cond_op": "conditional_block",  # IfElse lowers to conditional_block
+    "beam_search_op": "beam_search",
+    "beam_search_decode_op": "beam_search_decode",
+    "array_to_lod_tensor_op": "array_to_lod_tensor",
+    "lod_tensor_to_array_op": "lod_tensor_to_array",
+    "lod_array_length_op": "lod_array_length",
+    "lod_rank_table_op": "lod_rank_table",
+    "max_sequence_len_op": "max_sequence_len",
+    "reorder_lod_tensor_by_rank_op": "reorder_lod_tensor_by_rank",
+    "shrink_rnn_memory_op": "shrink_rnn_memory",
+    "tensor_array_read_write_op": "write_to_array",  # + read_from_array
+}
+
+# Runtime subsumptions: the op's JOB exists, done by a different mechanism
+# (documented in SURVEY.md / the named module), so no graph op is needed.
+SUBSUMED = {
+    "feed_op": "Executor feeds arrays directly (core/executor.py)",
+    "fetch_op": "Executor fetch_list returns arrays directly",
+    "load_op": "fluid.io.load_vars writes scope arrays (io.py)",
+    "save_op": "fluid.io.save_vars reads scope arrays (io.py)",
+    "load_combine_op": "fluid.io.load_params single-file path (io.py)",
+    "save_combine_op": "fluid.io.save_params single-file path (io.py)",
+    "delete_var_op": "XLA buffer liveness; scope GC (core/executor.py)",
+    "net_op": "op composition IS the Program (core/framework.py)",
+    "rnn_memory_helper_op": "autodiff carries rnn state via jax.vjp "
+                            "(core/lowering.py grad_of)",
+    "recurrent_op": "Dynamic/StaticRNN lower to the registered rnn_scan "
+                    "(ops/control_ops.py)",
+    "parallel_do_op": "layers.ParallelDo maps to GSPMD data parallel "
+                      "(layers/control_flow.py)",
+    "get_places_op": "layers.get_places returns mesh device list",
+    "fill_op": "assign_value covers fill's set-from-attr-buffer job",
+    "average_accumulates_op": "ModelAverage optimizer (average.py)",
+    "split_selected_rows_op": "pserver param split in distribute_transpiler "
+                              "(dense rows representation)",
+    "recv_op": "distribute_transpiler pserver programs execute via "
+               "listen_and_serv lowering (transpiler/)",
+    "nccl_op": "XLA collectives over the mesh (psum/all_gather) replace "
+               "NCCL kernels (SURVEY §6.5)",
+    "conv_mkldnn_op": "device-specific kernel of conv_op; XLA:TPU "
+                      "specializes the single conv2d lowering",
+    "pool_mkldnn_op": "device-specific kernel of pool_op",
+    "softmax_mkldnn_op": "device-specific kernel of softmax_op",
+    "lrn_mkldnn_op": "device-specific kernel of lrn_op",
+}
+
+# Documented scope cuts (SURVEY.md): fluid.concurrency CSP surface.
+CUT = {
+    "channel_close_op": "fluid.concurrency cut (SURVEY §2)",
+    "channel_create_op": "fluid.concurrency cut (SURVEY §2)",
+    "channel_recv_op": "fluid.concurrency cut (SURVEY §2)",
+    "channel_send_op": "fluid.concurrency cut (SURVEY §2)",
+    "go_op": "fluid.concurrency cut (SURVEY §2)",
+    "select_op": "fluid.concurrency cut (SURVEY §2)",
+}
+
+# The frozen snapshot of ls paddle/fluid/operators/*_op.cc (no .cu.cc, no
+# *_test.cc) at the reference commit.
+REFERENCE_OP_FILES = """
+accuracy_op activation_op adadelta_op adagrad_op adam_op adamax_op
+array_to_lod_tensor_op assign_op assign_value_op auc_op
+average_accumulates_op batch_norm_op beam_search_decode_op beam_search_op
+bilinear_tensor_product_op bipartite_match_op box_coder_op cast_op
+channel_close_op channel_create_op channel_recv_op channel_send_op
+chunk_eval_op clip_by_norm_op clip_op compare_op concat_op cond_op
+conditional_block_op conv_mkldnn_op conv_op conv_shift_op
+conv_transpose_op cos_sim_op crf_decoding_op crop_op cross_entropy_op
+ctc_align_op cumsum_op decayed_adagrad_op delete_var_op detection_map_op
+dropout_op edit_distance_op elementwise_add_op elementwise_div_op
+elementwise_max_op elementwise_min_op elementwise_mul_op
+elementwise_pow_op elementwise_sub_op expand_op feed_op fetch_op
+fill_constant_batch_size_like_op fill_constant_op fill_op
+fill_zeros_like_op ftrl_op gather_op gaussian_random_batch_size_like_op
+gaussian_random_op get_places_op go_op gru_op gru_unit_op hinge_loss_op
+huber_loss_op im2sequence_op increment_op iou_similarity_op is_empty_op
+l1_norm_op label_smooth_op layer_norm_op linear_chain_crf_op
+listen_and_serv_op load_combine_op load_op lod_array_length_op
+lod_rank_table_op lod_reset_op lod_tensor_to_array_op log_loss_op
+logical_op lookup_table_op lrn_mkldnn_op lrn_op lstm_op lstm_unit_op
+lstmp_op margin_rank_loss_op matmul_op max_sequence_len_op maxout_op
+mean_op merge_lod_tensor_op mine_hard_examples_op minus_op
+modified_huber_loss_op momentum_op mul_op multiclass_nms_op multiplex_op
+nccl_op nce_op net_op norm_op one_hot_op pad_op parallel_do_op
+pool_mkldnn_op pool_op pool_with_index_op positive_negative_pair_op
+precision_recall_op prelu_op print_op prior_box_op proximal_adagrad_op
+proximal_gd_op rank_loss_op read_op recurrent_op recv_op reduce_op
+reorder_lod_tensor_by_rank_op reshape_op rmsprop_op rnn_memory_helper_op
+roi_pool_op row_conv_op save_combine_op save_op scale_op scatter_op
+select_op send_op sequence_concat_op sequence_conv_op sequence_erase_op
+sequence_expand_op sequence_pool_op sequence_reshape_op sequence_slice_op
+sequence_softmax_op sgd_op shrink_rnn_memory_op
+sigmoid_cross_entropy_with_logits_op sign_op smooth_l1_loss_op
+softmax_mkldnn_op softmax_op softmax_with_cross_entropy_op
+split_lod_tensor_op split_op split_selected_rows_op spp_op
+squared_l2_distance_op squared_l2_norm_op sum_op target_assign_op
+tensor_array_read_write_op top_k_op transpose_op
+uniform_random_batch_size_like_op uniform_random_op unpool_op warpctc_op
+while_op read_op
+""".split()
+
+
+def test_every_reference_op_file_is_accounted_for():
+    unaccounted = []
+    for f in sorted(set(REFERENCE_OP_FILES)):
+        base = f[:-3] if f.endswith("_op") else f
+        if base in DIRECT:
+            continue
+        if f in MULTI or f in SPECIAL or f in SUBSUMED or f in CUT:
+            continue
+        if f == "read_op":  # in-graph reader: layers/io.py read_file +
+            continue        # host-io pre-pass (core/executor.py)
+        unaccounted.append(f)
+    assert not unaccounted, (
+        "reference op files with no lowering/subsumption/cut mapping: %s"
+        % unaccounted)
+
+
+def test_direct_and_multi_map_to_registered_lowerings():
+    for base in DIRECT:
+        assert registry.is_registered(base), base
+    for f, ops in MULTI.items():
+        for op in ops:
+            assert registry.is_registered(op), (f, op)
+
+
+def test_special_map_to_graph_level_lowerings():
+    for f, op in SPECIAL.items():
+        assert op in _SPECIAL, (f, op)
+    assert "read_from_array" in _SPECIAL
+
+
+def test_no_category_overlap():
+    cats = [set(DIRECT)] + [set(d) for d in (MULTI, SPECIAL, SUBSUMED, CUT)]
+    names = [n for f in (MULTI, SPECIAL, SUBSUMED, CUT) for n in f]
+    direct_files = {d + "_op" for d in DIRECT}
+    for n in names:
+        assert n not in direct_files, n

@@ -3,22 +3,34 @@
 # the axon tunnel is fresh (it can wedge permanently on concurrent clients
 # or giant remote compiles — see ARCHITECTURE.md / memory notes):
 #   bash tools/perf_sweep.sh
-# Probes layout, batch, remat, and feed-mode configs; one JSON line each in
-# /tmp/perf_sweep.log. Best known config (round 2): bf16 batch 256 device
-# feed = 2205 img/s (~14% MFU of a v5e's 197 bf16 TFLOPs). Targets worth
-# testing for >25% MFU: batch 512/1024 (+BENCH_REMAT=1), NHWC (see
-# layout_probe), XLA latency-hiding flags.
+# STRICT CHEAPEST-FIRST ORDER (r3 verdict weak #4): the safe headline config
+# (bf16 batch 256 device feed) runs first and is git-committed the moment it
+# succeeds; escalating configs (batch 512/1024, layout probe's multi-compile,
+# 2k-seq transformer) only run after the bank is safe, each gated on a fresh
+# tunnel probe so one wedge can't take later cheap configs down with it.
+# Best known config (round 2): bf16 batch 256 device feed = 2205 img/s
+# (~14% MFU of a v5e's 197 bf16 TFLOPs). Targets worth testing for >25% MFU:
+# batch 512/1024 (+BENCH_REMAT=1), NHWC, XLA latency-hiding flags.
 set -u
 cd "$(dirname "$0")/.."
 LOG=/tmp/perf_sweep.log
 : > $LOG
-probe() {  # never start a sweep against a wedged tunnel
+WEDGED=0
+probe() {  # never start a compile against a wedged tunnel
+  [ "$WEDGED" = 1 ] && return 1
   timeout 120 python -c "import jax; print(jax.devices())" || {
-    echo "TUNNEL WEDGED - aborting sweep" | tee -a $LOG
-    echo "- $(date -u +%FT%TZ) tunnel probe FAILED (sweep aborted)" >> BENCH_LOG.md
-    exit 1; }
+    echo "TUNNEL WEDGED - skipping remaining configs" | tee -a $LOG
+    echo "- $(date -u +%FT%TZ) tunnel probe FAILED mid-sweep" >> BENCH_LOG.md
+    WEDGED=1
+    return 1; }
+}
+bank() {  # commit the log so a later wedge cannot erase banked numbers
+  # pathspec-limited: never sweeps unrelated staged work into the bank
+  git commit -q -m "perf sweep: bank measured bench lines" \
+    -- BENCH_LOG.md 2>/dev/null || true
 }
 run() {
+  [ "$WEDGED" = 1 ] && { echo "skip (wedged): $*" | tee -a $LOG; return; }
   echo "=== $*" | tee -a $LOG
   local line
   line=$(env "$@" BENCH_DEVICE_TIMEOUT=300 timeout 900 python bench.py \
@@ -27,31 +39,44 @@ run() {
   # persist every successful measurement the moment it exists (r2 verdict
   # weak #1: a later wedge must not erase the round's perf story)
   case "$line" in
-    *'"error"'*|"") echo "- $(date -u +%FT%TZ) FAILED: $*" >> BENCH_LOG.md ;;
+    *'"error"'*|"")
+      echo "- $(date -u +%FT%TZ) FAILED: $*" >> BENCH_LOG.md
+      # a device-init timeout OR a timeout-killed bench (empty output —
+      # wedged mid-compile) means the tunnel is gone: stop compiling
+      case "$line" in *"device init"*|"") WEDGED=1 ;; esac ;;
     *) printf -- '- %s `%s`\n  `%s`\n' "$(date -u +%FT%TZ)" "$*" "$line" \
-         >> BENCH_LOG.md ;;
+         >> BENCH_LOG.md
+       bank ;;
   esac
 }
-probe
-timeout 600 python tools/layout_probe.py 2>/dev/null | tee -a $LOG
+probe || exit 1
+# ---- tier 1: the safe headline config, banked immediately --------------
 run BENCH_BATCH=256 BENCH_DTYPE=bf16
-run BENCH_BATCH=256 BENCH_DTYPE=bf16 FLAGS_conv_layout=NHWC
-run BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3
-run BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3 BENCH_REMAT=1
-run BENCH_BATCH=1024 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3 BENCH_REMAT=1
-run BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_FEED=host BENCH_STEPS=10 BENCH_WARMUP=3
-run BENCH_BATCH=256 BENCH_DTYPE=bf16 \
+probe && run BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_FEED=host BENCH_STEPS=10 BENCH_WARMUP=3
+# ---- tier 2: cheap single-compile variants -----------------------------
+probe && run BENCH_BATCH=256 BENCH_DTYPE=bf16 FLAGS_conv_layout=NHWC
+probe && run BENCH_BATCH=256 BENCH_DTYPE=bf16 \
   XLA_FLAGS="${XLA_FLAGS:-} --xla_tpu_enable_latency_hiding_scheduler=true"
-run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
-run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256 BENCH_FUSED_ATTN=0
+probe && run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
+probe && run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256 BENCH_FUSED_ATTN=0
+# ---- tier 3: multi-compile probe + pallas microbench -------------------
+if probe; then
+  timeout 600 python tools/layout_probe.py 2>/dev/null | tee -a $LOG
+  echo "=== pallas microbench" | tee -a $LOG
+  timeout 900 python tools/pallas_microbench.py 2>/dev/null | tee -a $LOG | \
+    while read -r line; do
+      printf -- '- %s microbench `%s`\n' "$(date -u +%FT%TZ)" "$line" >> BENCH_LOG.md
+    done
+  [ "${PIPESTATUS[0]:-0}" = 0 ] || \
+    echo "- $(date -u +%FT%TZ) FAILED: pallas_microbench (rc)" >> BENCH_LOG.md
+  bank
+fi
+# ---- tier 4: big compiles LAST (the r2 wedge was a batch-512 compile) --
+probe && run BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3
+probe && run BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3 BENCH_REMAT=1
+probe && run BENCH_BATCH=1024 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3 BENCH_REMAT=1
 # long-context: the flash path's O(T) memory is the point — dense would
 # materialize [T,T] attention at 2k tokens
-run BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2
-echo "=== pallas microbench" | tee -a $LOG
-timeout 900 python tools/pallas_microbench.py 2>/dev/null | tee -a $LOG | \
-  while read -r line; do
-    printf -- '- %s microbench `%s`\n' "$(date -u +%FT%TZ)" "$line" >> BENCH_LOG.md
-  done
-[ "${PIPESTATUS[0]:-0}" = 0 ] || \
-  echo "- $(date -u +%FT%TZ) FAILED: pallas_microbench (rc)" >> BENCH_LOG.md
-echo "=== sweep done ===" | tee -a $LOG
+probe && run BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2
+bank
+echo "=== sweep done (wedged=$WEDGED) ===" | tee -a $LOG
