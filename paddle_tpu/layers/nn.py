@@ -580,14 +580,20 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
 
 
 def fused_attention(q, k, v, causal=False, scale=None, kv_len=None,
-                    block_q=128, block_k=128, name=None):
+                    block_q=128, block_k=128, sp_impl="ring", name=None):
     """Flash attention over [B, T, H, D] q/k/v (TPU-native addition — the
     reference era built attention from matmul+softmax ops; this is the
     fused pallas path, see ops/pallas_kernels.py). kv_len: optional [B]
     int32 Variable of true key lengths (padded-batch masking + block
     skipping); defaults to k's sequence-lengths companion when k is a
-    lod_level>0 sequence. For multi-chip sequence parallelism use
-    parallel.ring_attention instead."""
+    lod_level>0 sequence. Under a ParallelExecutor mesh with an 'sp'
+    axis the op runs sequence-parallel; sp_impl chooses the algorithm:
+    "ring" (K/V rotation over ICI, any head count) or "ulysses"
+    (all-to-all head sharding, needs heads % sp == 0)."""
+    if sp_impl not in ("ring", "ulysses"):
+        raise ValueError(
+            "fused_attention sp_impl must be 'ring' or 'ulysses', got %r"
+            % (sp_impl,))
     helper = LayerHelper("fused_attention", **locals())
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
@@ -600,7 +606,8 @@ def fused_attention(q, k, v, causal=False, scale=None, kv_len=None,
         outputs={"Out": [out]},
         attrs={"causal": bool(causal),
                "scale": None if scale is None else float(scale),
-               "block_q": int(block_q), "block_k": int(block_k)})
+               "block_q": int(block_q), "block_k": int(block_k),
+               "sp_impl": str(sp_impl)})
     if q.shape is not None:
         out.shape = tuple(q.shape)
     return out

@@ -365,6 +365,14 @@ def _fused_attention(ctx, ins, attrs):
     scale = attrs.get("scale", None)
     mesh = ctx.mesh
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        # sp_impl picks the sequence-parallel algorithm: "ring" (default;
+        # K/V blocks rotate over ICI, O(T/sp) memory, any head count) or
+        # "ulysses" (all-to-all head sharding — one collective round
+        # instead of sp-1 ppermute hops when heads % sp == 0)
+        if attrs.get("sp_impl", "ring") == "ulysses":
+            from ..parallel.ulysses import ulysses_attention_sharded
+            return _out(ulysses_attention_sharded(
+                q, k, v, mesh, causal=causal, scale=scale, kv_len=kv_len))
         from ..parallel.ring_attention import ring_attention_sharded
         return _out(ring_attention_sharded(
             q, k, v, mesh, causal=causal, scale=scale, kv_len=kv_len))

@@ -34,9 +34,10 @@ __all__ = ["ring_attention", "attention_reference", "ring_attention_sharded",
 _NEG_INF = -1e30
 
 
-def attention_reference(q, k, v, causal=False, scale=None):
+def attention_reference(q, k, v, causal=False, scale=None, kv_len=None):
     """Dense single-device attention, [B,T,H,D]. The numerical reference the
-    ring path must match; also the fallback when no `sp` axis exists."""
+    ring path must match; also the fallback when no `sp` axis exists.
+    kv_len: optional [B] true key lengths (key-padding mask)."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -44,6 +45,10 @@ def attention_reference(q, k, v, causal=False, scale=None):
         tq, tk = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), dtype=bool))
         logits = jnp.where(mask, logits, _NEG_INF)
+    if kv_len is not None:
+        kpos = jnp.arange(k.shape[1])
+        kmask = kpos[None, :] < kv_len[:, None]           # [B, Tk]
+        logits = jnp.where(kmask[:, None, None, :], logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -148,6 +153,23 @@ def sp_spec_for_mesh(mesh, batch_axis, seq_axis):
     return P(None, seq_axis, None, None), (seq_axis,)
 
 
+def sp_shard_call(body, q, k, v, mesh, batch_axis, seq_axis, kv_len):
+    """Shared SP entry plumbing for ring and ulysses: shard q/k/v over
+    (batch_axis, seq_axis), kv_len (if any) over the batch axis, and run
+    `body(qs, ks, vs, lens)` per shard. The single place that owns the
+    kv_len sharding contract ([B] int32, batch-sharded)."""
+    spec, _ = sp_spec_for_mesh(mesh, batch_axis, seq_axis)
+    if kv_len is None:
+        fn = shard_map(lambda qs, ks, vs: body(qs, ks, vs, None),
+                       mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+        return fn(q, k, v)
+    len_spec = P(batch_axis) if batch_axis in mesh.axis_names else P()
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(spec, spec, spec, len_spec), out_specs=spec)
+    return fn(q, k, v, jnp.asarray(kv_len, jnp.int32).reshape(q.shape[0]))
+
+
 def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None,
                            batch_axis="dp", seq_axis="sp", kv_len=None):
     """Global-view ring attention: q,k,v are full [B,T,H,D] arrays (or GSPMD
@@ -155,20 +177,10 @@ def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None,
     kv_len: optional [B] int32 global true key lengths (sharded over the
     batch axis like q's batch dim).
     """
-    spec, vary_axes = sp_spec_for_mesh(mesh, batch_axis, seq_axis)
-    if kv_len is None:
-        fn = shard_map(
-            functools.partial(ring_attention, axis_name=seq_axis,
-                              causal=causal, scale=scale,
-                              vary_axes=vary_axes),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-        return fn(q, k, v)
-    len_spec = P(batch_axis) if batch_axis in mesh.axis_names else P()
+    _, vary_axes = sp_spec_for_mesh(mesh, batch_axis, seq_axis)
 
-    def shard_fn(qs, ks, vs, lens):
+    def body(qs, ks, vs, lens):
         return ring_attention(qs, ks, vs, axis_name=seq_axis, causal=causal,
                               scale=scale, vary_axes=vary_axes, kv_len=lens)
 
-    fn = shard_map(shard_fn, mesh=mesh,
-                   in_specs=(spec, spec, spec, len_spec), out_specs=spec)
-    return fn(q, k, v, jnp.asarray(kv_len, jnp.int32).reshape(q.shape[0]))
+    return sp_shard_call(body, q, k, v, mesh, batch_axis, seq_axis, kv_len)

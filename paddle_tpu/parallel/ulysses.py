@@ -14,22 +14,20 @@ are plentiful, ring when sequence is extreme.
 The reference (March 2018) has no attention parallelism; this is TPU-first
 design, not parity.
 """
-import functools
-
 from jax import lax
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
 
-from .ring_attention import attention_reference, sp_spec_for_mesh
+from .ring_attention import attention_reference, sp_shard_call
 
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
 
-def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
+                      kv_len=None):
     """Per-shard body (use inside shard_map): q/k/v are the local
-    sequence shards [B, T/sp, H, D]; heads must divide by the axis size."""
+    sequence shards [B, T/sp, H, D]; heads must divide by the axis size.
+    kv_len: optional [B] true key lengths — after the all-to-all each
+    shard holds the FULL sequence for its head slice, so key-padding is
+    the plain dense mask."""
     sp = lax.axis_size(axis_name) if hasattr(lax, "axis_size") \
         else lax.psum(1, axis_name)
     h = q.shape[2]
@@ -49,17 +47,19 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    out = attention_reference(qh, kh, vh, causal=causal, scale=scale,
+                              kv_len=kv_len)
     return heads_to_seq(out)
 
 
 def ulysses_attention_sharded(q, k, v, mesh, causal=False, scale=None,
-                              batch_axis="dp", seq_axis="sp"):
+                              batch_axis="dp", seq_axis="sp", kv_len=None):
     """Global-view entry: full (or GSPMD-sharded) [B, T, H, D] arrays;
-    shard_map splits over (dp, sp) and runs the all-to-all attention."""
-    spec, _ = sp_spec_for_mesh(mesh, batch_axis, seq_axis)
-    fn = shard_map(
-        functools.partial(ulysses_attention, axis_name=seq_axis,
-                          causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return fn(q, k, v)
+    shard_map splits over (dp, sp) and runs the all-to-all attention.
+    kv_len: optional [B] int32 global true key lengths (sharded over the
+    batch axis like q's batch dim)."""
+    def body(qs, ks, vs, lens):
+        return ulysses_attention(qs, ks, vs, axis_name=seq_axis,
+                                 causal=causal, scale=scale, kv_len=lens)
+
+    return sp_shard_call(body, q, k, v, mesh, batch_axis, seq_axis, kv_len)

@@ -107,6 +107,8 @@ SUBSUMED = {
                "listen_and_serv lowering (transpiler/)",
     "nccl_op": "XLA collectives over the mesh (psum/all_gather) replace "
                "NCCL kernels (SURVEY §6.5)",
+    "read_op": "in-graph readers: layers/io.py read_file + the host-io "
+               "pre-pass (core/executor.py)",
     "conv_mkldnn_op": "device-specific kernel of conv_op; XLA:TPU "
                       "specializes the single conv2d lowering",
     "pool_mkldnn_op": "device-specific kernel of pool_op",
@@ -165,7 +167,7 @@ split_lod_tensor_op split_op split_selected_rows_op spp_op
 squared_l2_distance_op squared_l2_norm_op sum_op target_assign_op
 tensor_array_read_write_op top_k_op transpose_op
 uniform_random_batch_size_like_op uniform_random_op unpool_op warpctc_op
-while_op read_op
+while_op
 """.split()
 
 
@@ -177,8 +179,6 @@ def test_every_reference_op_file_is_accounted_for():
             continue
         if f in MULTI or f in SPECIAL or f in SUBSUMED or f in CUT:
             continue
-        if f == "read_op":  # in-graph reader: layers/io.py read_file +
-            continue        # host-io pre-pass (core/executor.py)
         unaccounted.append(f)
     assert not unaccounted, (
         "reference op files with no lowering/subsumption/cut mapping: %s"
@@ -200,8 +200,12 @@ def test_special_map_to_graph_level_lowerings():
 
 
 def test_no_category_overlap():
-    cats = [set(DIRECT)] + [set(d) for d in (MULTI, SPECIAL, SUBSUMED, CUT)]
-    names = [n for f in (MULTI, SPECIAL, SUBSUMED, CUT) for n in f]
-    direct_files = {d + "_op" for d in DIRECT}
-    for n in names:
-        assert n not in direct_files, n
+    """Each reference op file must have exactly ONE disposition."""
+    cats = {"DIRECT": {d + "_op" for d in DIRECT}, "MULTI": set(MULTI),
+            "SPECIAL": set(SPECIAL), "SUBSUMED": set(SUBSUMED),
+            "CUT": set(CUT)}
+    names = sorted(cats)
+    for i, a in enumerate(names):
+        for b in names[i + 1:]:
+            overlap = cats[a] & cats[b]
+            assert not overlap, (a, b, overlap)

@@ -136,3 +136,77 @@ def test_fused_attention_program_path_sp():
             fetch_list=[loss2], feed=feed)[0])[0]) for _ in range(3)]
 
     np.testing.assert_allclose(single, par, rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("axes", [{"sp": 4}, {"dp": 2, "sp": 4}])
+def test_ulysses_matches_dense(causal, axes):
+    """All-to-all (Ulysses) SP must equal dense attention exactly, like
+    ring — it is a head-layout change, not an approximation."""
+    from paddle_tpu.parallel.ulysses import ulysses_attention_sharded
+    mesh = make_mesh(dict(axes))
+    q, k, v = _qkv()  # h=4 divides sp=4
+    want = attention_reference(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=causal)
+    with mesh:
+        got = jax.jit(lambda q, k, v: ulysses_attention_sharded(
+            q, k, v, mesh, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ulysses_kv_len_matches_dense():
+    from paddle_tpu.parallel.ulysses import ulysses_attention_sharded
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    q, k, v = _qkv()
+    kv_len = np.array([20, 32], "int32")
+    want = attention_reference(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=True,
+                               kv_len=jnp.asarray(kv_len))
+    with mesh:
+        got = jax.jit(lambda q, k, v, l: ulysses_attention_sharded(
+            q, k, v, mesh, causal=True, kv_len=l))(q, k, v, kv_len)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_fused_attention_program_path_sp_ulysses():
+    """sp_impl='ulysses' from the fluid Program path: the same
+    fused_attention program matches single-device numerics on a dp x sp
+    mesh (all-to-all head sharding instead of the K/V ring)."""
+    import paddle_tpu as fluid
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            q = fluid.layers.data("q", [32, 4, 8], dtype="float32")
+            k = fluid.layers.data("k", [32, 4, 8], dtype="float32")
+            v = fluid.layers.data("v", [32, 4, 8], dtype="float32")
+            lens = fluid.layers.data("lens", [1], dtype="int32")
+            out = fluid.layers.fused_attention(
+                q, k, v, causal=True, sp_impl="ulysses",
+                kv_len=fluid.layers.reshape(lens, shape=[-1]))
+            loss = fluid.layers.mean(x=fluid.layers.reduce_sum(out))
+        return main, startup, loss
+
+    rng = np.random.RandomState(5)
+    feed = {"q": rng.randn(2, 32, 4, 8).astype("f") * 0.3,
+            "k": rng.randn(2, 32, 4, 8).astype("f") * 0.3,
+            "v": rng.randn(2, 32, 4, 8).astype("f") * 0.3,
+            "lens": np.array([[20], [32]], "int32")}
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    main1, startup1, loss1 = build()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup1)
+        single = float(np.ravel(exe.run(main1, feed=feed,
+                                        fetch_list=[loss1])[0])[0])
+
+    main2, startup2, loss2 = build()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        pexe = fluid.ParallelExecutor(
+            main_program=main2, mesh=make_mesh({"dp": 2, "sp": 4}))
+        par = float(np.ravel(pexe.run(fetch_list=[loss2],
+                                      feed=feed)[0])[0])
+    np.testing.assert_allclose(single, par, rtol=2e-4, atol=1e-5)

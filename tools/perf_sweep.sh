@@ -16,9 +16,12 @@ cd "$(dirname "$0")/.."
 LOG=/tmp/perf_sweep.log
 : > $LOG
 WEDGED=0
+tunnel_ok() {  # raw 120s device probe, no WEDGED short-circuit
+  timeout 120 python -c "import jax; print(jax.devices())"
+}
 probe() {  # never start a compile against a wedged tunnel
   [ "$WEDGED" = 1 ] && return 1
-  timeout 120 python -c "import jax; print(jax.devices())" || {
+  tunnel_ok || {
     echo "TUNNEL WEDGED - skipping remaining configs" | tee -a $LOG
     echo "- $(date -u +%FT%TZ) tunnel probe FAILED mid-sweep" >> BENCH_LOG.md
     WEDGED=1
@@ -41,9 +44,14 @@ run() {
   case "$line" in
     *'"error"'*|"")
       echo "- $(date -u +%FT%TZ) FAILED: $*" >> BENCH_LOG.md
-      # a device-init timeout OR a timeout-killed bench (empty output —
-      # wedged mid-compile) means the tunnel is gone: stop compiling
-      case "$line" in *"device init"*|"") WEDGED=1 ;; esac ;;
+      # a device-init timeout means the tunnel is gone; an EMPTY line is
+      # ambiguous (timeout-killed mid-compile OR an ordinary crash with
+      # stderr discarded) — re-probe to tell the two apart before
+      # writing off the rest of the sweep
+      case "$line" in
+        *"device init"*) WEDGED=1 ;;
+        "") tunnel_ok || WEDGED=1 ;;
+      esac ;;
     *) printf -- '- %s `%s`\n  `%s`\n' "$(date -u +%FT%TZ)" "$*" "$line" \
          >> BENCH_LOG.md
        bank ;;
