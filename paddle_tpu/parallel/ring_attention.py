@@ -14,7 +14,6 @@ on (batch, seq). Works under jit inside a Mesh context; differentiable
 (jax.grad flows through shard_map + ppermute, giving the ring backward pass
 with reverse-direction permutes automatically).
 """
-import functools
 
 import numpy as np
 

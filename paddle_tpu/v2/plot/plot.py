@@ -1,14 +1,20 @@
-"""Training-curve plotter (parity: python/paddle/v2/plot/plot.py).
+"""Training-curve plotter (API parity: python/paddle/v2/plot/plot.py).
 
-The reference imports matplotlib + IPython eagerly unless
-DISABLE_PLOT=True; here the imports are lazy AND optional, so the shim is
-usable on headless TPU workers: data is always collected, drawing happens
-only when a display stack exists.
+Same public contract — ``Ploter(*titles)``, ``append(title, step, value)``,
+``plot(path=None)``, ``reset()``, honoring ``DISABLE_PLOT=True`` — but
+built headless-first for TPU workers: points are kept as (step, value)
+pairs regardless of environment, and the matplotlib/IPython display stack
+is a lazy optional import instead of a hard dependency, so the same
+training script runs in a notebook (live-refreshing figure) and on a pod
+worker (data collection only) without edits.
 """
 import os
 
 
 class PlotData(object):
+    """One curve. Exposes mutable .step / .value lists (reference
+    contract: user code may append to or reassign them directly)."""
+
     def __init__(self):
         self.step = []
         self.value = []
@@ -21,48 +27,57 @@ class PlotData(object):
         self.step = []
         self.value = []
 
+    def __len__(self):
+        return len(self.step)
+
+
+def _display_stack():
+    """(pyplot, display) when a drawing environment exists, else None."""
+    if os.environ.get("DISABLE_PLOT") == "True":
+        return None
+    try:
+        import matplotlib.pyplot as plt
+        from IPython import display
+    except Exception:
+        return None
+    return plt, display
+
 
 class Ploter(object):
     def __init__(self, *args):
         self.__args__ = args
-        self.__plot_data__ = {title: PlotData() for title in args}
+        self.__plot_data__ = {}
+        for title in args:
+            self.__plot_data__[title] = PlotData()
         self.__disable_plot__ = os.environ.get("DISABLE_PLOT")
-        self.plt = None
-        self.display = None
-        if not self.__plot_is_disabled__():
-            try:
-                import matplotlib.pyplot as plt
-                from IPython import display
-                self.plt = plt
-                self.display = display
-            except Exception:
-                pass  # headless: collect data, skip drawing
+        stack = _display_stack()
+        self.plt = stack[0] if stack else None
+        self.display = stack[1] if stack else None
 
     def __plot_is_disabled__(self):
         return self.__disable_plot__ == "True"
 
     def append(self, title, step, value):
-        assert isinstance(title, str)
-        assert title in self.__plot_data__
+        if title not in self.__plot_data__:
+            raise AssertionError("unknown curve title %r (have %s)"
+                                 % (title, list(self.__plot_data__)))
         self.__plot_data__[title].append(step, value)
 
     def plot(self, path=None):
-        if self.__plot_is_disabled__() or self.plt is None:
-            return
-        titles = []
-        for title in self.__args__:
-            data = self.__plot_data__[title]
-            if len(data.step) > 0:
-                self.plt.plot(data.step, data.value)
-                titles.append(title)
-        self.plt.legend(titles, loc="upper left")
-        if path is None:
+        if self.plt is None:
+            return  # headless / disabled: keep collecting, draw nothing
+        drawn = [t for t in self.__args__ if len(self.__plot_data__[t])]
+        for title in drawn:
+            curve = self.__plot_data__[title]
+            self.plt.plot(curve.step, curve.value)
+        self.plt.legend(drawn, loc="upper left")
+        if path is not None:
+            self.plt.savefig(path)
+        else:
             self.display.clear_output(wait=True)
             self.display.display(self.plt.gcf())
-        else:
-            self.plt.savefig(path)
         self.plt.gcf().clear()
 
     def reset(self):
-        for data in self.__plot_data__.values():
-            data.reset()
+        for curve in self.__plot_data__.values():
+            curve.reset()

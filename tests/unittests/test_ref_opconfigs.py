@@ -436,3 +436,62 @@ def test_cross_entropy_soft_ref_config():
     got, = run_op("cross_entropy", {"X": p, "Label": soft},
                   {"soft_label": True}, out_slots=("Y",))
     np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# split — test_split_op.py: uneven sections along a middle axis
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape,axis,sections", [
+    ((4, 5, 6), 1, [2, 1, 2]),
+    ((4, 6, 6), 1, [3, 3]),
+    ((8, 3), 0, [2, 2, 4]),
+])
+def test_split_ref_config(shape, axis, sections):
+    x = rng.rand(*shape).astype("float32")
+    outs = run_op("split", {"X": x},
+                  {"axis": axis, "sections": sections},
+                  out_slots=("Out",), n_outputs={"Out": len(sections)})
+    exp = np.split(x, np.cumsum(sections)[:-1], axis)
+    for g, e in zip(outs, exp):
+        np.testing.assert_allclose(g, e, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# dropout — test_dropout_op.py: prob 0 (identity), prob 1 (zeros),
+# is_test (era downscale-at-inference x*(1-p)), plus mask statistics
+# ---------------------------------------------------------------------------
+
+def test_dropout_ref_configs():
+    x = rng.rand(32, 64).astype("float32") + 0.1
+    got, = run_op("dropout", {"X": x}, {"dropout_prob": 0.0})
+    np.testing.assert_allclose(got, x, rtol=1e-6)           # p=0 identity
+    got, = run_op("dropout", {"X": x}, {"dropout_prob": 1.0})
+    np.testing.assert_allclose(got, np.zeros_like(x))       # p=1 all-drop
+    got, = run_op("dropout", {"X": x},
+                  {"dropout_prob": 0.35, "is_test": True})
+    np.testing.assert_allclose(got, x * 0.65, rtol=1e-6)    # era inference
+    got, = run_op("dropout", {"X": x}, {"dropout_prob": 0.5})
+    kept = np.asarray(got) != 0
+    assert 0.3 < kept.mean() < 0.7                          # ~half kept
+    np.testing.assert_allclose(np.asarray(got)[kept], x[kept], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# sequence_expand — test_sequence_expand.py LoD cases in the padded layout
+# ---------------------------------------------------------------------------
+
+def test_sequence_expand_ref_config():
+    # x: one row per sequence; y's ref-level lengths repeat x's rows
+    x = np.arange(1, 9, dtype="float32").reshape(4, 1, 2)   # 4 seqs, 1 step
+    y = np.zeros((4, 3, 2), "float32")                      # lens 1..3
+    ylen = np.array([1, 3, 2, 3], "int32")
+    got = run_op("sequence_expand",
+                 {"X": x, "Y": y, "YLen": ylen},
+                 out_slots=("Out",))[0]
+    got = np.asarray(got)
+    # each x row i repeats ylen[i] times along time
+    for i, n in enumerate(ylen):
+        for t in range(n):
+            np.testing.assert_allclose(got[i, t], x[i, 0], rtol=1e-6)
+        assert np.all(got[i, n:] == 0)

@@ -36,7 +36,7 @@ run() {
   [ "$WEDGED" = 1 ] && { echo "skip (wedged): $*" | tee -a $LOG; return; }
   echo "=== $*" | tee -a $LOG
   local line
-  line=$(env "$@" BENCH_DEVICE_TIMEOUT=300 timeout 900 python bench.py \
+  line=$(env "$@" BENCH_DEVICE_TIMEOUT=300 timeout -k 10 900 python bench.py \
          2>/dev/null | tail -1)
   echo "$line" | tee -a $LOG
   # persist every successful measurement the moment it exists (r2 verdict
