@@ -45,8 +45,10 @@ class LoDTensor(object):
         feat = self.data.shape[1:]
         out = np.zeros((len(lengths), max_len) + tuple(feat),
                        dtype=self.data.dtype)
-        for i in range(len(lengths)):
-            out[i, :lengths[i]] = self.data[offs[i]:offs[i + 1]]
+        from ..native import lodpack
+        if not lodpack.pack_into(self.data, offs, out):
+            for i in range(len(lengths)):  # no native lib: numpy fallback
+                out[i, :lengths[i]] = self.data[offs[i]:offs[i + 1]]
         return out, lengths
 
     @staticmethod

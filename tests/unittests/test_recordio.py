@@ -69,3 +69,55 @@ def test_reader_conversion_roundtrip(tmp_path):
     for s, b in zip(samples, back):
         for x, y in zip(s, b):
             np.testing.assert_array_equal(np.asarray(x), y)
+
+
+def test_native_lod_pack_matches_numpy():
+    """liblodpack pack/unpack vs the pure-numpy padded conversion."""
+    import numpy as np
+    from paddle_tpu.core.lod import LoDTensor
+    from paddle_tpu.native import lodpack
+
+    rng = np.random.RandomState(3)
+    seqs = [rng.randn(n, 5).astype("float32") for n in (3, 1, 7, 4)]
+    t = LoDTensor.from_sequences(seqs)
+    padded, lengths = t.to_padded(bucket=4)
+    # independent numpy reference
+    exp = np.zeros_like(padded)
+    for i, s in enumerate(seqs):
+        exp[i, :len(s)] = s
+    np.testing.assert_array_equal(padded, exp)
+    np.testing.assert_array_equal(lengths, [3, 1, 7, 4])
+
+    if lodpack.available():
+        flat = lodpack.unpack(padded, lengths)
+        np.testing.assert_array_equal(flat, np.concatenate(seqs, 0))
+        # int64 ids path (CTR/NLP feeds)
+        ids = [rng.randint(0, 99, (n, 1)).astype("int64") for n in (2, 5)]
+        ti = LoDTensor.from_sequences(ids)
+        p2, l2 = ti.to_padded(bucket=8)
+        assert p2.dtype == np.int64 and p2.shape == (2, 8, 1)
+        np.testing.assert_array_equal(p2[1, :5], ids[1])
+        assert p2[0, 2:].sum() == 0
+
+
+def test_native_lod_pack_rejects_malformed():
+    """Malformed offsets / over-long sequences must never be silently
+    packed: the native path reports failure and the caller's numpy
+    fallback raises — same outcome with or without the toolchain."""
+    import numpy as np
+    import pytest as _pytest
+    from paddle_tpu.core.lod import LoDTensor, create_lod_tensor
+    from paddle_tpu.native import lodpack
+
+    if lodpack.available():
+        data = np.zeros((3, 2), "float32")
+        out = np.zeros((1, 8, 2), "float32")
+        # offsets past the data end -> native refuses (no OOB read)
+        assert not lodpack.pack_into(data, [0, 5], out)
+        # sequence longer than max_len -> native refuses (no truncation)
+        assert not lodpack.pack_into(np.zeros((7, 2), "f"), [0, 7],
+                                     np.zeros((1, 4, 2), "f"))
+    # whole-path check: bad offsets raise from to_padded either way
+    t = create_lod_tensor(np.zeros((3, 2), "float32"), [[5]])
+    with _pytest.raises(Exception):
+        t.to_padded()
