@@ -42,6 +42,15 @@ class LoDTensor(object):
         if max_len is None:
             m = int(lengths.max()) if len(lengths) else 1
             max_len = max(bucket, ((m + bucket - 1) // bucket) * bucket)
+        # validate up front so native and numpy paths agree on EVERY
+        # malformed input (a numpy slice past the data end can silently
+        # broadcast a short row instead of raising)
+        if len(lengths) and (lengths.min() < 0 or offs[0] < 0
+                             or offs[-1] > len(self.data)
+                             or int(lengths.max()) > max_len):
+            raise ValueError(
+                "malformed LoD: offsets %r over %d data rows (max_len %d)"
+                % (offs, len(self.data), max_len))
         feat = self.data.shape[1:]
         out = np.zeros((len(lengths), max_len) + tuple(feat),
                        dtype=self.data.dtype)

@@ -1,10 +1,11 @@
 """ctypes binding for liblodpack.so — the padded-dense LoD layout
 conversion (per-step host hot path for every sequence feed).
 
-Callers: core/lod.py LoDTensor.to_padded (pack) and beam/decode helpers
-that flatten padded results (unpack). Each caller keeps a numpy fallback;
-these functions return False/None when the native library is unavailable
-or the arrays aren't contiguous.
+Caller: core/lod.py LoDTensor.to_padded (pack). unpack() is the reverse
+conversion for host-side consumers of padded results (currently exercised
+by tests; kept next to pack so the two contracts evolve together). Both
+return False/None when the native library is unavailable or the arrays
+aren't native-packable, and the caller falls back to numpy.
 """
 import ctypes
 
@@ -52,6 +53,8 @@ def pack_into(data, offs, out):
     n_seqs, max_len = out.shape[0], out.shape[1]
     row_bytes = int(np.prod(out.shape[2:], dtype=np.int64)) * out.itemsize
     offs_arr = np.ascontiguousarray(np.asarray(offs, dtype=np.int64))
+    if offs_arr.shape != (n_seqs + 1,):
+        return False  # C loop indexes offs[0..n_seqs]; never read past it
     rc = lib.ptpu_lod_pack(
         data.ctypes.data_as(ctypes.c_char_p),
         offs_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -72,6 +75,8 @@ def unpack(padded, lengths):
         return None
     lengths = np.ascontiguousarray(np.asarray(lengths, dtype=np.int32))
     n_seqs, max_len = padded.shape[0], padded.shape[1]
+    if lengths.shape != (n_seqs,):
+        return None  # C writes one block per seq; out is sized from lengths
     feat = padded.shape[2:]
     row_bytes = int(np.prod(feat, dtype=np.int64)) * padded.itemsize
     total = int(lengths.sum())

@@ -121,3 +121,22 @@ def test_native_lod_pack_rejects_malformed():
     t = create_lod_tensor(np.zeros((3, 2), "float32"), [[5]])
     with _pytest.raises(Exception):
         t.to_padded()
+
+
+def test_lod_pack_binding_arity_guards():
+    """Binding-level guards: wrong-arity offsets/lengths are refused before
+    any native call can read past their buffers."""
+    import numpy as np
+    from paddle_tpu.core.lod import create_lod_tensor
+    from paddle_tpu.native import lodpack
+    import pytest as _pytest
+
+    if lodpack.available():
+        data = np.zeros((4, 2), "float32")
+        out = np.zeros((3, 4, 2), "float32")
+        assert not lodpack.pack_into(data, [0, 2], out)   # needs 4 offsets
+        assert lodpack.unpack(np.zeros((3, 4, 2), "f"), [2, 2]) is None
+    # under-run offsets that numpy would silently broadcast must raise
+    t = create_lod_tensor(np.zeros((1, 2), "float32"), [[4]])
+    with _pytest.raises(ValueError):
+        t.to_padded()

@@ -495,3 +495,129 @@ def test_sequence_expand_ref_config():
         for t in range(n):
             np.testing.assert_allclose(got[i, t], x[i, 0], rtol=1e-6)
         assert np.all(got[i, n:] == 0)
+
+
+# ---------------------------------------------------------------------------
+# matmul — test_matmul_op.py transpose_X x transpose_Y x rank matrix
+# ---------------------------------------------------------------------------
+
+MATMUL_GRID = []
+for tx in (False, True):
+    for ty in (False, True):
+        MATMUL_GRID.append((2, 2, tx, ty))   # [M,K]x[K,N] with transposes
+        MATMUL_GRID.append((3, 3, tx, ty))   # batched
+MATMUL_GRID.append((2, 1, False, False))     # matrix x vector
+MATMUL_GRID.append((1, 1, False, False))     # vector dot
+
+
+@pytest.mark.parametrize("dx,dy,tx,ty", MATMUL_GRID)
+def test_matmul_ref_config(dx, dy, tx, ty):
+    m, k, n, b = 4, 5, 6, 3
+    if dx == 1:
+        xs = [k]
+    else:
+        xs = ([m, k] if not tx else [k, m])
+        if dx == 3:
+            xs = [b] + xs
+    if dy == 1:
+        ys = [k]
+    else:
+        ys = ([k, n] if not ty else [n, k])
+        if dy == 3:
+            ys = [b] + ys
+    x = rng.rand(*xs).astype("float32")
+    y = rng.rand(*ys).astype("float32")
+    xm = np.swapaxes(x, -1, -2) if (tx and x.ndim > 1) else x
+    ym = np.swapaxes(y, -1, -2) if (ty and y.ndim > 1) else y
+    exp = np.matmul(xm, ym)
+    got, = run_op("matmul", {"X": x, "Y": y},
+                  {"transpose_X": tx, "transpose_Y": ty})
+    np.testing.assert_allclose(np.asarray(got).reshape(exp.shape), exp,
+                               rtol=2e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# lookup_table — test_lookup_table_op.py: plain and padding_idx variants
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("padding_idx", [-1, 0, 7])
+def test_lookup_table_ref_config(padding_idx):
+    w = rng.rand(17, 31).astype("float32")
+    ids = rng.randint(0, 17, (9, 1)).astype("int64")
+    ids[3, 0] = 7  # ensure the padding idx occurs
+    exp = w[ids.ravel()]
+    if padding_idx >= 0:
+        exp = exp.copy()
+        exp[ids.ravel() == padding_idx] = 0.0
+    got, = run_op("lookup_table", {"W": w, "Ids": ids},
+                  {"padding_idx": padding_idx})
+    np.testing.assert_allclose(np.asarray(got).reshape(9, 31), exp,
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sequence_pool — test_seq_pool.py: all six pooltypes on ragged batches
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("ptype", ["sum", "average", "sqrt", "max",
+                                   "last", "first"])
+def test_sequence_pool_ref_config(ptype):
+    x = rng.randn(3, 6, 4).astype("float32")
+    xlen = np.array([6, 2, 5], "int32")
+    got, = run_op("sequence_pool", {"X": x, "XLen": xlen},
+                  {"pooltype": ptype.upper()})
+    exp = np.zeros((3, 4), "float32")
+    for b in range(3):
+        seq = x[b, :xlen[b]]
+        exp[b] = {"sum": seq.sum(0), "average": seq.mean(0),
+                  "sqrt": seq.sum(0) / np.sqrt(len(seq)),
+                  "max": seq.max(0), "last": seq[-1],
+                  "first": seq[0]}[ptype]
+    np.testing.assert_allclose(got, exp, rtol=2e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# edit_distance — test_edit_distance_op.py: normalized and raw
+# ---------------------------------------------------------------------------
+
+def _levenshtein(a, b):
+    m, n = len(a), len(b)
+    dp = np.zeros((m + 1, n + 1), "int32")
+    dp[:, 0] = np.arange(m + 1)
+    dp[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                           dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[m, n]
+
+
+@pytest.mark.parametrize("normalized", [False, True])
+def test_edit_distance_ref_config(normalized):
+    import paddle_tpu as fluid
+    hyp_seqs = [[1, 2, 3], [5, 6, 7, 8]]
+    ref_seqs = [[1, 3, 3, 4], [5, 7, 8]]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        hyp = fluid.layers.data("hyp", shape=[1], dtype="int64",
+                                lod_level=1)
+        ref = fluid.layers.data("ref", shape=[1], dtype="int64",
+                                lod_level=1)
+        dist, seq_num = fluid.layers.edit_distance(
+            hyp, ref, normalized=normalized)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {
+        "hyp": fluid.LoDTensor.from_sequences(
+            [np.array(s, "int64").reshape(-1, 1) for s in hyp_seqs]),
+        "ref": fluid.LoDTensor.from_sequences(
+            [np.array(s, "int64").reshape(-1, 1) for s in ref_seqs]),
+    }
+    d, n = exe.run(main, feed=feed, fetch_list=[dist, seq_num])
+    exp = np.array([[_levenshtein(h, r)] for h, r in
+                    zip(hyp_seqs, ref_seqs)], "float32")
+    if normalized:
+        exp = exp / np.array([[len(r)] for r in ref_seqs], "float32")
+    np.testing.assert_allclose(np.asarray(d).reshape(-1, 1), exp,
+                               rtol=1e-5)
+    assert int(np.asarray(n).ravel()[0]) == 2
