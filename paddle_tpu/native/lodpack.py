@@ -77,6 +77,10 @@ def unpack(padded, lengths):
     n_seqs, max_len = padded.shape[0], padded.shape[1]
     if lengths.shape != (n_seqs,):
         return None  # C writes one block per seq; out is sized from lengths
+    if len(lengths) and (lengths.min() < 0 or int(lengths.max()) > max_len):
+        return None  # a bad length must never reach memcpy: out is sized
+                     # from sum(lengths), so one oversized/negative entry
+                     # would overflow it before the C-side check fires
     feat = padded.shape[2:]
     row_bytes = int(np.prod(feat, dtype=np.int64)) * padded.itemsize
     total = int(lengths.sum())

@@ -52,12 +52,15 @@ def _pad(ctx, ins, attrs):
 
 @register("crop")
 def _crop(ctx, ins, attrs):
-    """crop_op.cc: static-offset slice of `shape` starting at `offsets`."""
+    """crop_op.cc: static-offset slice of `shape` starting at `offsets`.
+    A -1 dim takes the full remaining extent (offset..end) — needed for
+    cropping feature dims of dynamic-batch tensors."""
     x = single(ins, "X")
     offsets = [int(o) for o in attrs["offsets"]]
     shape = [int(s) for s in attrs["shape"]]
-    return _out(jax.lax.slice(
-        x, offsets, [o + s for o, s in zip(offsets, shape)]))
+    limits = [x.shape[d] if s == -1 else o + s
+              for d, (o, s) in enumerate(zip(offsets, shape))]
+    return _out(jax.lax.slice(x, offsets, limits))
 
 
 @register("modified_huber_loss")

@@ -46,7 +46,7 @@ def _build_random(seed):
     x.stop_gradient = False
     nodes = [x]
     for step in range(int(rng.randint(3, 7))):
-        kind = rng.choice(["unary", "binary", "fc"])
+        kind = rng.choice(["unary", "binary", "fc", "tail"])
         if kind == "unary" or len(nodes) < 2:
             src = nodes[int(rng.randint(len(nodes)))]
             nodes.append(_apply_unary(_unary_ops(rng), src))
@@ -54,6 +54,23 @@ def _build_random(seed):
             a = nodes[int(rng.randint(len(nodes)))]
             b = nodes[int(rng.randint(len(nodes)))]
             nodes.append(_apply_binary(rng, a, b))
+        elif kind == "tail":
+            # round-4 long-tail ops in the DAG (shape-preserving picks)
+            src = nodes[int(rng.randint(len(nodes)))]
+            which = rng.choice(["prelu", "pad_crop", "conv_shift"])
+            if which == "prelu":
+                nodes.append(L.prelu(src))
+            elif which == "pad_crop":
+                padded = L.pad(src, [0, 0, 1, 2], pad_value=0.5)
+                nodes.append(L.crop(padded, shape=[-1, DIM],
+                                    offsets=[0, 1]))
+            else:
+                ker = L.fc(input=src, size=3, bias_attr=False,
+                           param_attr=fluid.ParamAttr(
+                               initializer=fluid.initializer.
+                               NumpyArrayInitializer(
+                                   (rng.randn(DIM, 3) * 0.3).astype("f"))))
+                nodes.append(L.conv_shift(src, ker))
         else:
             src = nodes[int(rng.randint(len(nodes)))]
             nodes.append(L.fc(
@@ -236,3 +253,57 @@ def test_random_while_program(seed):
     np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-5,
                                err_msg="seed %d n=%d ops=%s" %
                                (seed, n_iter, ops))
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_random_while_reshape_fc_program(seed):
+    """Regression fuzz for the round-3 cached-decode bug class: reshape
+    with 0/-1 dims INSIDE a While sub-block feeding an fc — shape
+    inference must keep concrete feature dims so fc creates the right
+    weight, for a random mix of reshape specs and elementwise noise."""
+    rng = np.random.RandomState(7000 + seed)
+    L_ = fluid.layers
+    n_iter = int(rng.randint(1, 4))
+    h = int(rng.choice([2, 4]))      # heads-ish split factor of DIM
+    assert DIM % h == 0
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = L_.data(name="x", shape=[DIM], dtype="float32")
+        i = L_.fill_constant(shape=[1], dtype="int64", value=0)
+        n = L_.fill_constant(shape=[1], dtype="int64", value=n_iter)
+        acc = L_.fill_constant_batch_size_like(
+            input=x, shape=[-1, DIM], dtype="float32", value=0.0)
+        state = L_.elementwise_add(acc, x)
+        cond = L_.less_than(x=i, y=n)
+        w = L_.While(cond=cond)
+        with w.block():
+            # reshape through a 0/-1-dim spec chain, then transpose and
+            # back — the folded batch products must survive inference
+            v = L_.reshape(state, shape=[0, h, DIM // h])
+            v = L_.transpose(v, perm=[0, 2, 1])
+            v = L_.reshape(v, shape=[-1, DIM])
+            # fc requires a concrete trailing dim here (the r3 crash site)
+            v = L_.fc(input=v, size=DIM, bias_attr=False,
+                      param_attr=fluid.ParamAttr(
+                          name="loop_w_%d" % seed,
+                          initializer=fluid.initializer.
+                          NumpyArrayInitializer(
+                              np.eye(DIM, dtype="f"))))
+            L_.assign(v, state)
+            L_.increment(x=i, value=1, in_place=True)
+            L_.less_than(x=i, y=n, cond=cond)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xv = rng.rand(3, DIM).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[state])
+
+    # identity fc + reshape/transpose/reshape: v = interleave permutation
+    ref = xv
+    for _ in range(n_iter):
+        ref = ref.reshape(3, h, DIM // h).transpose(0, 2, 1).reshape(3, DIM)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5,
+                               err_msg="seed %d h=%d" % (seed, h))

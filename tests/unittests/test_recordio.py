@@ -140,3 +140,13 @@ def test_lod_pack_binding_arity_guards():
     t = create_lod_tensor(np.zeros((1, 2), "float32"), [[4]])
     with _pytest.raises(ValueError):
         t.to_padded()
+
+
+def test_lod_unpack_rejects_bad_lengths():
+    import numpy as np
+    from paddle_tpu.native import lodpack
+    if not lodpack.available():
+        return
+    padded = np.zeros((2, 5, 2), "float32")
+    assert lodpack.unpack(padded, [5, -3]) is None   # negative length
+    assert lodpack.unpack(padded, [6, 1]) is None    # > max_len

@@ -227,3 +227,84 @@ def test_strip_feed_fetch_descending_col_order():
     feeds, fetches = rf.strip_feed_fetch(raw)
     assert feeds == ["x0", "x1", "x2"]
     assert fetches == ["x0", "x1"]
+
+
+@pytest.fixture
+def reference_conv_model_dir(tmp_path):
+    """A reference-era conv model: image -> conv2d(strides/paddings ints
+    attrs) -> pool2d max -> flatten mul -> softmax. Exercises the wire
+    reader's repeated-int attrs and 4-D persistable tensors."""
+    rng = np.random.RandomState(9)
+    filt = (rng.randn(2, 1, 3, 3) * 0.5).astype("float32")
+    w = (rng.randn(2 * 3 * 3, 4) * 0.5).astype("float32")
+
+    varz = [
+        var_desc("feed", 0, [], var_type=9),
+        var_desc("fetch", 0, [], var_type=10),
+        var_desc("image", 5, [-1, 1, 6, 6]),
+        var_desc("conv2d_0.w_0", 5, [2, 1, 3, 3], persistable=True),
+        var_desc("conv2d_0.tmp_0", 5, [-1, 2, 6, 6]),
+        var_desc("pool2d_0.tmp_0", 5, [-1, 2, 3, 3]),
+        var_desc("reshape_0.tmp_0", 5, [-1, 18]),
+        var_desc("fc_0.w_0", 5, [18, 4], persistable=True),
+        var_desc("fc_0.tmp_0", 5, [-1, 4]),
+        var_desc("softmax_0.tmp_0", 5, [-1, 4]),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["image"])],
+                [attr("col", 0, 0)]),
+        op_desc("conv2d",
+                [("Input", ["image"]), ("Filter", ["conv2d_0.w_0"])],
+                [("Output", ["conv2d_0.tmp_0"])],
+                [attr("strides", 3, [1, 1]), attr("paddings", 3, [1, 1]),
+                 attr("dilations", 3, [1, 1]), attr("groups", 0, 1)]),
+        op_desc("pool2d", [("X", ["conv2d_0.tmp_0"])],
+                [("Out", ["pool2d_0.tmp_0"])],
+                [attr("pooling_type", 2, b"max"),
+                 attr("ksize", 3, [2, 2]), attr("strides", 3, [2, 2]),
+                 attr("paddings", 3, [0, 0])]),
+        op_desc("reshape", [("X", ["pool2d_0.tmp_0"])],
+                [("Out", ["reshape_0.tmp_0"])],
+                [attr("shape", 3, [-1, 18])]),
+        op_desc("mul", [("X", ["reshape_0.tmp_0"]), ("Y", ["fc_0.w_0"])],
+                [("Out", ["fc_0.tmp_0"])],
+                [attr("x_num_col_dims", 0, 1),
+                 attr("y_num_col_dims", 0, 1)]),
+        op_desc("softmax", [("X", ["fc_0.tmp_0"])],
+                [("Out", ["softmax_0.tmp_0"])]),
+        op_desc("fetch", [("X", ["softmax_0.tmp_0"])],
+                [("Out", ["fetch"])], [attr("col", 0, 0)]),
+    ]
+    program_bytes = _ld(1, block_desc(0, -1, varz, ops))
+    d = tmp_path / "ref_conv_model"
+    d.mkdir()
+    (d / "__model__").write_bytes(program_bytes)
+    lod_tensor_file(str(d / "conv2d_0.w_0"), filt)
+    lod_tensor_file(str(d / "fc_0.w_0"), w)
+    return str(d), filt, w
+
+
+def test_load_reference_conv_model(reference_conv_model_dir):
+    """The wire-format conv model must produce the same output as the
+    identical program built through the native layer API."""
+    dirname, filt, w = reference_conv_model_dir
+    rng = np.random.RandomState(4)
+    img = rng.rand(3, 1, 6, 6).astype("float32")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        program, feeds, fetches = fluid.io.load_reference_model(
+            dirname, exe)
+        assert feeds == ["image"]
+        out, = exe.run(program, feed={"image": img}, fetch_list=fetches)
+
+    # independent torch reference for the same math
+    import torch
+    import torch.nn.functional as F
+    t = F.conv2d(torch.from_numpy(img), torch.from_numpy(filt), padding=1)
+    t = F.max_pool2d(t, 2, stride=2)
+    logits = t.reshape(3, 18).numpy() @ w
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    exp = e / e.sum(1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(out), exp, rtol=2e-4, atol=1e-5)

@@ -47,6 +47,9 @@ def test_crop():
                   attrs={"offsets": [1, 2], "shape": [2, 4]})
     check_grad_fd("crop", {"X": x}, "X",
                   attrs={"offsets": [1, 2], "shape": [2, 4]})
+    # -1 dim = full remaining extent (dynamic-batch crops)
+    check_forward("crop", {"X": x}, x[:, 1:5],
+                  attrs={"offsets": [0, 1], "shape": [-1, 4]})
 
 
 def test_modified_huber_loss():
