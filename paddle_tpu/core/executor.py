@@ -7,6 +7,8 @@ fetch list) is lowered ONCE to a jitted XLA computation and cached —
 subsequent runs are a single device dispatch, vs. the reference's per-op
 kernel launches every run.
 """
+import collections
+import os
 import time
 
 import numpy as np
@@ -269,11 +271,31 @@ def check_finite(named_arrays, context=""):
                  a.size))
 
 
+def _jit_cache_capacity():
+    """Max live compiled programs per executor (LRU beyond this). Bucketed
+    padding keeps the shape-signature space small in normal training, but
+    unbounded feed-shape variety must not accumulate XLA executables
+    forever. PADDLE_TPU_JIT_CACHE_SIZE overrides (0 = unbounded)."""
+    try:
+        return int(os.environ.get("PADDLE_TPU_JIT_CACHE_SIZE", "64"))
+    except ValueError:
+        return 64
+
+
+def _cache_put_lru(cache, key, entry, capacity):
+    """Insert into an OrderedDict LRU, evicting least-recently-used."""
+    cache[key] = entry
+    cache.move_to_end(key)
+    if capacity > 0:
+        while len(cache) > capacity:
+            cache.popitem(last=False)
+
+
 class Executor(object):
     def __init__(self, place=None, check_nan_inf=None):
         from ..places import CPUPlace
         self.place = place if place is not None else CPUPlace()
-        self._cache = {}
+        self._cache = collections.OrderedDict()
         self._check_nan_inf = _nan_inf_enabled(check_nan_inf)
         self._array_safety = _array_safety_enabled()
 
@@ -302,7 +324,9 @@ class Executor(object):
                _conv_layout())
         compiled = False
         entry = self._cache.get(key) if use_program_cache else None
-        if entry is None:
+        if entry is not None:
+            self._cache.move_to_end(key)  # LRU touch
+        else:
             compiled = True
             state_rw, state_ro, state_out = lowering.analyze_state(
                 program, feed_names, fetch_names)
@@ -312,7 +336,8 @@ class Executor(object):
             jitted = jax.jit(fn, donate_argnums=(1,))
             entry = (jitted, state_rw, state_ro, state_out)
             if use_program_cache:
-                self._cache[key] = entry
+                _cache_put_lru(self._cache, key, entry,
+                               _jit_cache_capacity())
         jitted, state_rw, state_ro, state_out = entry
 
         def read_state(names):

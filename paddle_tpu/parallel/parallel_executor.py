@@ -13,6 +13,7 @@ the backward pass (what the reference's allow_op_delay tried to approximate
 by hand). The scheduling knobs are accepted and ignored — XLA owns the
 schedule.
 """
+import collections
 import re
 import time as _time
 
@@ -26,7 +27,8 @@ from ..core.framework import default_main_program
 from ..core.executor import (global_scope, _feed_signature,
                              _nan_inf_enabled, _raise_program_errors,
                              _array_safety_enabled, check_finite,
-                             convert_feeds, run_host_io_prepass)
+                             convert_feeds, run_host_io_prepass,
+                             _cache_put_lru, _jit_cache_capacity)
 from ..core.utils import find_var as _find_var
 from .mesh import data_parallel_mesh, replicated, batch_sharded, NamedSharding, P
 
@@ -76,7 +78,7 @@ class ParallelExecutor(object):
             self._param_shardings = dict(
                 self._auto_weight_update_shardings(),
                 **self._param_shardings)
-        self._cache = {}
+        self._cache = collections.OrderedDict()
         # XLA:CPU collectives deadlock when several executions are in
         # flight at once (each rendezvous needs one thread per virtual
         # device; concurrent programs starve the pool and abort). Real TPU
@@ -187,7 +189,9 @@ class ParallelExecutor(object):
                _conv_layout())
         compiled = False
         entry = self._cache.get(key)
-        if entry is None:
+        if entry is not None:
+            self._cache.move_to_end(key)  # LRU touch
+        else:
             compiled = True
             state_rw, state_ro, state_out = lowering.analyze_state(
                 program, feed_names, fetch_names)
@@ -209,7 +213,7 @@ class ParallelExecutor(object):
                              out_shardings=out_shardings,
                              donate_argnums=(1,))
             entry = (jitted, state_rw, state_ro, state_out)
-            self._cache[key] = entry
+            _cache_put_lru(self._cache, key, entry, _jit_cache_capacity())
         jitted, state_rw, state_ro, state_out = entry
 
         def read_state(names):

@@ -125,3 +125,28 @@ def test_wrong_feature_dim_raises():
         with pytest.raises(Exception):
             exe.run(main, feed={"x": xb, "y": xb[:, :1]},
                     fetch_list=[loss])
+
+
+def test_jit_cache_lru_eviction(monkeypatch):
+    """Varying feed shapes must not grow the executor's compiled-program
+    cache without bound: beyond PADDLE_TPU_JIT_CACHE_SIZE the least-
+    recently-used executable is evicted; re-running an evicted shape
+    recompiles and still computes correctly."""
+    import numpy as np
+    monkeypatch.setenv("PADDLE_TPU_JIT_CACHE_SIZE", "3")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        out = fluid.layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for b in (1, 2, 3, 4, 5):  # five distinct shape signatures
+            v, = exe.run(main, feed={"x": np.ones((b, 4), "f")},
+                         fetch_list=[out])
+            assert float(np.ravel(v)[0]) == 4.0 * b
+        assert len(exe._cache) == 3
+        # evicted shape recompiles and still works
+        v, = exe.run(main, feed={"x": np.ones((1, 4), "f")},
+                     fetch_list=[out])
+        assert float(np.ravel(v)[0]) == 4.0
