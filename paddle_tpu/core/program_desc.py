@@ -72,6 +72,7 @@ def _var_desc(v):
         "seq_len_var": v.seq_len_var,
         "type": v.type,
         "capacity": v.capacity,
+        "mesh_axes": list(getattr(v, "mesh_axes", None) or []) or None,
     }
 
 
@@ -139,6 +140,9 @@ def program_from_bytes(data):
             else:
                 v = Variable(blk, **cls_kwargs)
             v.seq_len_var = vd.get("seq_len_var")
+            if vd.get("mesh_axes"):
+                v.mesh_axes = tuple(a if a is None else str(a)
+                                    for a in vd["mesh_axes"])
             blk.vars[v.name] = v
         for od in bd["ops"]:
             op = Operator(blk, od["type"], None, None,

@@ -78,6 +78,29 @@ class ParallelExecutor(object):
             self._param_shardings = dict(
                 self._auto_weight_update_shardings(),
                 **self._param_shardings)
+        # ParamAttr(mesh_axes=...) annotations: Program-reachable tensor
+        # parallelism. Precedence: explicit param_shardings > mesh_axes >
+        # auto ZeRO (an annotated param keeps its TP layout even under
+        # sharded_weight_update — its optimizer accumulators follow it so
+        # param and moments never sit in conflicting layouts). An
+        # annotation whose axes are ALL absent from this mesh is a no-op
+        # (the same model definition reused on a dp-only mesh keeps its
+        # ZeRO sharding instead of degrading to full replication).
+        explicit = dict(param_shardings or {})
+        acc_owner = getattr(self._program, "_accumulator_owner", {})
+        for p_ in self._program.global_block().all_parameters():
+            axes = getattr(p_, "mesh_axes", None)
+            if not axes or p_.name in explicit:
+                continue
+            resolved = [a if a in self.mesh.axis_names else None
+                        for a in axes]
+            if all(a is None for a in resolved):
+                continue
+            spec = P(*resolved)
+            self._param_shardings[p_.name] = spec
+            for acc, owner in acc_owner.items():
+                if owner == p_.name and acc not in explicit:
+                    self._param_shardings[acc] = spec
         self._cache = collections.OrderedDict()
         # XLA:CPU collectives deadlock when several executions are in
         # flight at once (each rendezvous needs one thread per virtual

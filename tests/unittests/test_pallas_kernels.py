@@ -301,3 +301,53 @@ def test_softmax_xent_grad_matches_dense():
     g2 = jax.grad(loss_dense)(logits)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("t,bq,bk", [
+    (100, 32, 64), (100, 64, 32), (33, 32, 32), (7, 8, 8),
+    (129, 64, 64), (65, 128, 128),
+])
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_flash_attention_block_grid(t, bq, bk, causal):
+    """Block-size x ragged-T matrix: every (block_q, block_k) index-math
+    combination must match dense, incl. T smaller than one block, T one
+    past a block boundary, and asymmetric q/k tiles both ways."""
+    rng = np.random.RandomState(t * 7 + bq)
+    q, k, v = _qkv(rng, t=t, h=2, d=8)
+    out = pk.flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("t,bq,bk", [(50, 16, 32), (33, 32, 16)])
+def test_flash_attention_grads_block_grid(t, bq, bk):
+    """Flash backward across uneven block tilings vs jax.grad of dense."""
+    rng = np.random.RandomState(t + bq)
+    q, k, v = _qkv(rng, t=t, h=2, d=8)
+
+    def loss_flash(q, k, v):
+        o = pk.flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+        return jnp.sum(o ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4)
+
+
+def test_flash_attention_kv_len_block_boundaries():
+    """kv_len landing exactly on, one before, and one after a block
+    boundary — the block-skip fast path must not drop a partial block."""
+    rng = np.random.RandomState(11)
+    q, k, v = _qkv(rng, b=4, t=64, h=2, d=8)
+    lens = np.array([32, 31, 33, 64], "int32")  # on/under/over boundary
+    out = pk.flash_attention(q, k, v, kv_len=jnp.asarray(lens),
+                             block_q=32, block_k=32)
+    ref = attention_reference(q, k, v, kv_len=jnp.asarray(lens))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)

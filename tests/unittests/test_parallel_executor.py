@@ -334,3 +334,124 @@ def test_accumulator_fallback_skips_unsharded_owner():
     # my_fc.w's velocity must NOT appear in specs via the fc.w pattern
     for name in specs:
         assert "my_fc.w" not in name or name == "my_fc.w", name
+
+
+def test_param_attr_mesh_axes_tensor_parallel():
+    """TP from the Program path: ParamAttr(mesh_axes=(None, 'mp')) shards
+    an fc weight's output dim over 'mp'; the dp x mp run matches
+    single-device numerics, the annotation survives a desc round-trip,
+    and explicit param_shardings still win over the annotation."""
+    from paddle_tpu.core.program_desc import (program_to_bytes,
+                                              program_from_bytes)
+    from paddle_tpu.parallel.mesh import make_mesh, P
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(
+                input=x, size=32, act="relu",
+                param_attr=fluid.ParamAttr(name="tp.w",
+                                           mesh_axes=(None, "mp")))
+            pred = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                x=fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(3)
+    xs = rng.rand(8, 16).astype("float32")
+    ys = xs.sum(1, keepdims=True).astype("float32") * 0.05
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    main1, startup1, loss1 = build()
+    scope1 = fluid.Scope()
+    with fluid.scope_guard(scope1):
+        exe.run(startup1)
+        init = {n: np.asarray(scope1.get(n)) for n in scope1.names()}
+        single = [float(np.ravel(exe.run(
+            main1, feed={"x": xs, "y": ys}, fetch_list=[loss1])[0])[0])
+            for _ in range(3)]
+
+    main2, startup2, loss2 = build()
+    # the annotation must survive serialization
+    main2 = program_from_bytes(program_to_bytes(main2))
+    assert main2.global_block().var("tp.w").mesh_axes == (None, "mp")
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup2)
+        for n, v in init.items():
+            scope2.set(n, v)
+        pexe = fluid.ParallelExecutor(
+            main_program=main2, loss_name=loss2.name,
+            mesh=make_mesh({"dp": 2, "mp": 4}))
+        assert pexe._param_shardings["tp.w"] == P(None, "mp")
+        par = [float(np.ravel(pexe.run(
+            fetch_list=[loss2], feed={"x": xs, "y": ys})[0])[0])
+            for _ in range(3)]
+    np.testing.assert_allclose(single, par, rtol=2e-4, atol=1e-6)
+
+    # explicit param_shardings beat the annotation
+    main3, startup3, loss3 = build()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup3)
+        pexe3 = fluid.ParallelExecutor(
+            main_program=main3, loss_name=loss3.name,
+            mesh=make_mesh({"dp": 2, "mp": 4}),
+            param_shardings={"tp.w": P()})
+        assert pexe3._param_shardings["tp.w"] == P()
+
+
+def test_mesh_axes_zero_interplay():
+    """mesh_axes + sharded_weight_update: an annotated param's
+    accumulators FOLLOW the TP layout (no conflicting param/moment
+    shardings), and an annotation with no axis on the current mesh is a
+    no-op that keeps the ZeRO P(dp) sharding."""
+    from paddle_tpu.parallel.mesh import make_mesh, P
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(
+                input=x, size=16, act="relu",
+                param_attr=fluid.ParamAttr(name="tp.w",
+                                           mesh_axes=(None, "mp")))
+            pred = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                x=fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.Momentum(learning_rate=0.05,
+                                     momentum=0.9).minimize(loss)
+        return main, loss
+
+    main, loss = build()
+    pexe = fluid.ParallelExecutor(
+        main_program=main, loss_name=loss.name,
+        mesh=make_mesh({"dp": 2, "mp": 4}), sharded_weight_update=True)
+    specs = pexe._param_shardings
+    assert specs["tp.w"] == P(None, "mp")
+    vel = [a for a, p in main._accumulator_owner.items()
+           if p == "tp.w" and "velocity" in a]
+    assert vel and all(specs.get(a) == P(None, "mp") for a in vel)
+
+    # dp-only mesh: the 'mp' annotation filters away entirely -> ZeRO
+    # keeps the P(dp) sharding for the param and its accumulators
+    main2, loss2 = build()
+    pexe2 = fluid.ParallelExecutor(
+        main_program=main2, loss_name=loss2.name,
+        mesh=make_mesh({"dp": 8}), sharded_weight_update=True)
+    assert pexe2._param_shardings["tp.w"] == P("dp")
+
+
+def test_mesh_axes_weight_norm_rejected():
+    import pytest as _pytest
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        with _pytest.raises(NotImplementedError):
+            fluid.layers.fc(
+                input=x, size=4,
+                param_attr=fluid.WeightNormParamAttr(
+                    name="wn.w", mesh_axes=(None, "mp")))
