@@ -342,6 +342,16 @@ def _fill_zeros_like(ctx, ins, attrs):
     return _out(jnp.zeros_like(single(ins, "X")))
 
 
+@register("fill")
+def _fill(ctx, ins, attrs):
+    """fill_op.cc: fill Out with the row-major `value` float list, reshaped
+    to `shape`, cast to `dtype` (force_cpu is a placement no-op here)."""
+    arr = np.asarray(attrs["value"], dtype=np.float32)
+    arr = arr.reshape(attrs["shape"]).astype(
+        np.dtype(attrs.get("dtype", "float32")))
+    return _out(jnp.asarray(arr))
+
+
 @register("assign_value")
 def _assign_value(ctx, ins, attrs):
     arr = np.asarray(attrs["values"], dtype=np.dtype(attrs.get("dtype", "float32")))

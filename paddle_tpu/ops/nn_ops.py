@@ -147,7 +147,9 @@ def _pool2d(ctx, ins, attrs):
                                              extra[0] or extra[1]):
             ones = jnp.ones_like(x)
             cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides4, padding)
-            out = s / cnt
+            # a ceil-mode window can sit fully inside padding (count 0);
+            # emit 0 there, not 0/0
+            out = s / jnp.maximum(cnt, 1.0)
         else:
             out = s / float(ksize[0] * ksize[1])
     if nhwc:

@@ -292,6 +292,85 @@ def _lstm(ctx, ins, attrs):
             "BatchGate": [x], "BatchCellPreAct": [cell]}
 
 
+@register("lstmp")
+def _lstmp(ctx, ins, attrs):
+    """lstmp_op.cc — LSTM with recurrent projection: the [B, P] PROJECTED
+    state (not the [B, D] hidden) feeds the next step's gate matmul
+    (lstmp_op.h:161-167), so Weight is [P, 4D] and ProjWeight [D, P];
+    r_t = proj_act(h_t @ ProjWeight). H0 [B, D] enters through the same
+    projection (lstmp_op.h:174-187). Divergence kept deliberately: the
+    reference gates on proj_act but then applies cell_act to the
+    projection (lstmp_op.h:201-203, an evident typo since both default to
+    tanh); we apply proj_act itself.
+    """
+    x = single(ins, "Input")            # [B, T, 4D]
+    w = single(ins, "Weight")           # [P, 4D]
+    w_proj = single(ins, "ProjWeight")  # [D, P]
+    bias = single(ins, "Bias")          # [1, 4D(+3D)]
+    h0 = single(ins, "H0")
+    c0 = single(ins, "C0")
+    xlen = single(ins, "XLen")
+    d = w_proj.shape[0]
+    p = w_proj.shape[1]
+    b, t, _ = x.shape
+    use_peep = attrs.get("use_peepholes", False)
+    gact = _lstm_act(attrs.get("gate_activation", "sigmoid"))
+    cact = _lstm_act(attrs.get("cell_activation", "tanh"))
+    hact = _lstm_act(attrs.get("candidate_activation", "tanh"))
+    pact = _lstm_act(attrs.get("proj_activation", "tanh"))
+    is_rev = attrs.get("is_reverse", False)
+
+    state_dt, rmat2 = _amp_recurrence(ctx, x.dtype)
+
+    bias = bias.reshape(-1).astype(state_dt)
+    gate_bias = bias[:4 * d]
+    if use_peep:
+        w_ic, w_fc, w_oc = (bias[4 * d:5 * d], bias[5 * d:6 * d],
+                            bias[6 * d:7 * d])
+    c_prev = c0.astype(state_dt) if c0 is not None \
+        else jnp.zeros((b, d), state_dt)
+    if h0 is not None:
+        r_prev = pact(rmat2(h0.astype(state_dt), w_proj))
+    else:
+        r_prev = jnp.zeros((b, p), state_dt)
+
+    m = _mask(xlen, t, state_dt)
+    xs = jnp.swapaxes(x, 0, 1).astype(state_dt)     # [T, B, 4D]
+    ms = m.T[:, :, None]
+    if is_rev:
+        xs = xs[::-1]
+        ms = ms[::-1]
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        xt, mt = inp
+        gates = xt + rmat2(r_prev, w) + gate_bias    # [B, 4D]
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if use_peep:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i = gact(gi)
+        f = gact(gf)
+        c_new = f * c_prev + i * cact(gc)
+        if use_peep:
+            go = go + c_new * w_oc
+        o = gact(go)
+        h_new = o * hact(c_new)
+        r_new = pact(rmat2(h_new, w_proj))           # [B, P]
+        r = mt * r_new + (1 - mt) * r_prev
+        c = mt * c_new + (1 - mt) * c_prev
+        return (r, c), (r, c)
+
+    _, (rs, cs) = lax.scan(step, (r_prev, c_prev), (xs, ms))
+    if is_rev:
+        rs, cs = rs[::-1], cs[::-1]
+    proj = jnp.swapaxes(rs, 0, 1).astype(x.dtype)   # [B, T, P]
+    cell = jnp.swapaxes(cs, 0, 1).astype(x.dtype)
+    return {"Projection": [proj], "Cell": [cell],
+            "BatchGate": [x], "BatchCellPreAct": [cell],
+            "BatchHidden": [cell], "OrderedP0": [r_prev]}
+
+
 @register("gru")
 def _gru(ctx, ins, attrs):
     """dynamic_gru: input [B, T, 3D] pre-projected, weight packed

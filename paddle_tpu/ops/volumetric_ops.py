@@ -1,0 +1,166 @@
+"""Volumetric (3-D) conv/pool lowerings.
+
+Parity: the reference registers these from the SAME .cc files as the 2-D
+family — conv_op.cc:340 (conv3d), conv_transpose_op.cc (conv3d_transpose),
+pool_op.cc (pool3d), pool_with_index_op.cc (max_pool3d_with_index) — which
+is why the file-level op audit alone missed them (a name-level audit now
+exists in tests/unittests/test_reference_op_files_audit.py).
+
+TPU notes: 3-D convs lower to one lax.conv_general_dilated over NCDHW —
+XLA tiles the contraction onto the MXU exactly as for 2-D (the extra
+spatial dim just joins the window). Pooling is lax.reduce_window over a
+5-D operand. The with-index variant gathers explicit windows (indices are
+a data output, which reduce_window cannot produce).
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register, single
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return (int(v[0]),) * 3
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _out(x):
+    return {"Out": [x]}
+
+
+@register("conv3d")
+def _conv3d(ctx, ins, attrs):
+    x = single(ins, "Input")    # NCDHW
+    w = single(ins, "Filter")   # OIDHW (I = C/groups)
+    strides = _triple(attrs.get("strides", [1, 1, 1]))
+    pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    dil = _triple(attrs.get("dilations", [1, 1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    x = single(ins, "Input")    # NCDHW
+    w = single(ins, "Filter")   # fluid layout [C_in, C_out, kd, kh, kw]
+    strides = _triple(attrs.get("strides", [1, 1, 1]))
+    pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    dil = _triple(attrs.get("dilations", [1, 1, 1]))
+    # Same contract as the 2-D lowering (ops/nn_ops.py _conv2d_transpose):
+    # fluid's filter is the OIDHW filter of the forward conv this op is the
+    # input-gradient of; transpose_kernel swaps I/O and flips taps, and the
+    # gradient conv pads (effective_k - 1 - pad) per side so the output is
+    # (D-1)*stride + k - 2*pad.
+    eff = [(w.shape[2 + i] - 1) * dil[i] + 1 for i in range(3)]
+    out = lax.conv_transpose(
+        x, w,
+        strides=strides,
+        padding=[(eff[i] - 1 - pads[i], eff[i] - 1 - pads[i])
+                 for i in range(3)],
+        rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        transpose_kernel=True)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register("pool3d")
+def _pool3d(ctx, ins, attrs):
+    x = single(ins, "X")  # NCDHW
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _triple(attrs.get("ksize", [2, 2, 2]))
+    strides = _triple(attrs.get("strides", [1, 1, 1]))
+    pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    if attrs.get("global_pooling"):
+        ksize = x.shape[2:5]
+        pads = (0, 0, 0)
+        strides = (1, 1, 1)
+    # ceil_mode as trailing padding, mirroring pool2d (pool_op.cc attr)
+    extra = [0, 0, 0]
+    if attrs.get("ceil_mode", False):
+        for d in range(3):
+            span = x.shape[2 + d] - ksize[d] + 2 * pads[d]
+            out_ceil = -(-span // strides[d]) + 1
+            extra[d] = max(0, (out_ceil - 1) * strides[d] - span)
+    window = (1, 1) + tuple(ksize)
+    strides5 = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple(
+        (pads[d], pads[d] + extra[d]) for d in range(3))
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides5,
+                                padding)
+    else:
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides5, padding)
+        if attrs.get("exclusive", True) and any(
+                pads[d] or extra[d] for d in range(3)):
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                    strides5, padding)
+            # a ceil-mode window can sit fully inside padding (count 0);
+            # emit 0 there, not 0/0
+            out = s / jnp.maximum(cnt, 1.0)
+        else:
+            out = s / float(ksize[0] * ksize[1] * ksize[2])
+    return _out(out.astype(x.dtype))
+
+
+@register("max_pool3d_with_index")
+def _max_pool3d_with_index(ctx, ins, attrs):
+    """pool_with_index_op.cc (3-D registration): max pool + Mask of the
+    in-volume flat index d*(H*W) + h*W + w of each window max."""
+    x = single(ins, "X")  # [N, C, D, H, W]
+    ksize = [int(k) for k in attrs["ksize"]]
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:5])
+    strides = [int(s) for s in attrs.get("strides", [1, 1, 1])]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0, 0])]
+    n, c = x.shape[:2]
+    dims = x.shape[2:5]
+    outdims = [(dims[i] - ksize[i] + 2 * pads[i]) // strides[i] + 1
+               for i in range(3)]
+    # per-axis tap index tables [Oi, ki] + validity, as in _pool_windows
+    idx, valid = [], []
+    for i in range(3):
+        t = (jnp.arange(outdims[i]) * strides[i] - pads[i])[:, None] \
+            + jnp.arange(ksize[i])[None, :]
+        idx.append(t)
+        valid.append((t >= 0) & (t < dims[i]))
+    # gather windows axis by axis: -> [N, C, Od, kd, Oh, kh, Ow, kw]
+    v = x
+    for i in range(3):
+        axis = 2 + 2 * i
+        v = jnp.take(v, jnp.clip(idx[i], 0, dims[i] - 1).reshape(-1),
+                     axis=axis)
+        v = v.reshape(v.shape[:axis] + (outdims[i], ksize[i])
+                      + v.shape[axis + 1:])
+    v = v.transpose(0, 1, 2, 4, 6, 3, 5, 7)  # [N,C,Od,Oh,Ow,kd,kh,kw]
+    ok = (valid[0][:, None, None, :, None, None]
+          & valid[1][None, :, None, None, :, None]
+          & valid[2][None, None, :, None, None, :])  # [Od,Oh,Ow,kd,kh,kw]
+    neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+    masked = jnp.where(ok[None, None], v, neg)
+    flat = masked.reshape((n, c) + tuple(outdims) + (-1,))
+    amax = flat.argmax(axis=-1)
+    out = flat.max(axis=-1)
+    kd, kh, kw = ksize
+    ld = amax // (kh * kw)
+    lh = (amax // kw) % kh
+    lw = amax % kw
+    def pick(table, local, bcast):
+        # table [Oi, ki] -> value at each output position's local argmax
+        t = table.astype(jnp.int32).reshape(bcast)
+        return jnp.take_along_axis(
+            jnp.broadcast_to(t, local.shape + (t.shape[-1],)),
+            local[..., None].astype(jnp.int32), axis=-1).squeeze(-1)
+    gd = pick(idx[0], ld, (1, 1, outdims[0], 1, 1, kd))
+    gh = pick(idx[1], lh, (1, 1, 1, outdims[1], 1, kh))
+    gw = pick(idx[2], lw, (1, 1, 1, 1, outdims[2], kw))
+    mask = (gd * (dims[1] * dims[2]) + gh * dims[2] + gw).astype(jnp.int32)
+    return {"Out": [out], "Mask": [mask]}

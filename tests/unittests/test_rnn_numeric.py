@@ -185,11 +185,13 @@ def test_dynamic_gru_h0():
 
 
 def test_dynamic_lstmp_projection():
-    """lstmp = lstm + projection fc: proj rows = hidden rows @ proj_w."""
+    """lstmp (reference lstmp_op): the PROJECTED state r_t = tanh(h_t @
+    proj_w) feeds the next step's gates, so Weight is [proj_size, 4d]."""
     d, p = 2, 3
     seqs = [rng.randn(3, 4 * d).astype("float32") * 0.5]
     lod = LoDTensor.from_sequences(seqs)
-    w = (rng.randn(d, 4 * d) * 0.3).astype("float32")
+    w = (rng.randn(p, 4 * d) * 0.3).astype("float32")
+    proj_w = (rng.randn(d, p) * 0.3).astype("float32")
     b = np.zeros(4 * d, dtype="float32")
 
     def build():
@@ -197,16 +199,30 @@ def test_dynamic_lstmp_projection():
                               lod_level=1)
         proj, cell = fluid.layers.dynamic_lstmp(
             input=x, size=4 * d, proj_size=p, use_peepholes=False,
-            param_attr=fluid.ParamAttr(
-                initializer=fluid.initializer.NumpyArrayInitializer(w)),
+            param_attr=[
+                fluid.ParamAttr(
+                    initializer=fluid.initializer.NumpyArrayInitializer(w)),
+                fluid.ParamAttr(
+                    initializer=fluid.initializer.NumpyArrayInitializer(
+                        proj_w))],
             bias_attr=fluid.ParamAttr(
                 initializer=fluid.initializer.NumpyArrayInitializer(
                     b.reshape(1, -1))))
         return (proj,)
 
     proj, = _run(build, {"x": lod})
+    # step-by-step numpy recurrence with the projection inside the loop
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    r = np.zeros(p)
+    c = np.zeros(d)
+    x64 = seqs[0].astype(np.float64)
+    for t in range(3):
+        gi, gf, gc, go = np.split(x64[t] + r @ w.astype(np.float64), 4)
+        c = sig(gf) * c + sig(gi) * np.tanh(gc)
+        h = sig(go) * np.tanh(c)
+        r = np.tanh(h @ proj_w.astype(np.float64))
+        np.testing.assert_allclose(proj[0, t], r, rtol=1e-4, atol=1e-5)
     assert proj.shape[-1] == p
-    assert np.isfinite(proj).all()
 
 
 def test_lstm_gradients_flow():

@@ -1,0 +1,324 @@
+"""3-D conv/pool family + fill + lstmp — ops the reference registers from
+shared .cc files (conv_op.cc:340, pool_op.cc, pool_with_index_op.cc,
+fill_op.cc, lstmp_op.cc) that a file-level audit alone would miss.
+
+conv/pool forwards cross-check torch; lstmp checks a step-by-step numpy
+recurrence with the projection INSIDE the loop (the defining property the
+old lstm+fc subsumption got wrong).
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+from op_test import run_op, check_grad_fd
+
+rng = np.random.RandomState(7)
+
+
+# ---------------------------------------------------------------------------
+# conv3d
+# ---------------------------------------------------------------------------
+
+CONV3D_GRID = [
+    # (input NCDHW, filter OIDHW, pad, stride, dilation, groups)
+    ([2, 3, 4, 4, 4], [6, 3, 3, 3, 3], [0, 0, 0], [1, 1, 1], [1, 1, 1], 1),
+    ([2, 3, 5, 5, 5], [6, 3, 3, 3, 3], [1, 1, 1], [2, 2, 2], [1, 1, 1], 1),
+    ([2, 4, 4, 4, 4], [4, 2, 3, 3, 3], [1, 1, 1], [1, 1, 1], [1, 1, 1], 2),
+    ([1, 2, 6, 6, 6], [4, 2, 2, 2, 2], [0, 0, 0], [1, 1, 1], [2, 2, 2], 1),
+]
+
+
+@pytest.mark.parametrize("ishape,fshape,pad,stride,dil,groups", CONV3D_GRID)
+def test_conv3d_vs_torch(ishape, fshape, pad, stride, dil, groups):
+    x = rng.rand(*ishape).astype("float32")
+    w = rng.rand(*fshape).astype("float32") - 0.5
+    exp = F.conv3d(torch.from_numpy(x), torch.from_numpy(w), stride=stride,
+                   padding=pad, dilation=dil, groups=groups).numpy()
+    got, = run_op("conv3d", {"Input": x, "Filter": w},
+                  {"strides": stride, "paddings": pad, "dilations": dil,
+                   "groups": groups}, out_slots=("Output",))
+    np.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-4)
+
+
+def test_conv3d_grad_fd():
+    x = rng.rand(1, 2, 3, 3, 3).astype("float32")
+    w = rng.rand(2, 2, 2, 2, 2).astype("float32") - 0.5
+    check_grad_fd("conv3d", {"Input": x, "Filter": w}, "Filter",
+                  {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                   "dilations": [1, 1, 1], "groups": 1},
+                  out_slots=("Output",))
+
+
+# ---------------------------------------------------------------------------
+# conv3d_transpose
+# ---------------------------------------------------------------------------
+
+CONV3DT_GRID = [
+    # (input NCDHW, filter [Cin, Cout, kd, kh, kw], pad, stride, dilation)
+    ([2, 3, 3, 3, 3], [3, 4, 3, 3, 3], [0, 0, 0], [1, 1, 1], [1, 1, 1]),
+    ([2, 3, 3, 3, 3], [3, 4, 3, 3, 3], [1, 1, 1], [2, 2, 2], [1, 1, 1]),
+    ([1, 2, 4, 4, 4], [2, 3, 2, 2, 2], [0, 0, 0], [2, 2, 2], [1, 1, 1]),
+]
+
+
+@pytest.mark.parametrize("ishape,fshape,pad,stride,dil", CONV3DT_GRID)
+def test_conv3d_transpose_vs_torch(ishape, fshape, pad, stride, dil):
+    x = rng.rand(*ishape).astype("float32")
+    w = rng.rand(*fshape).astype("float32") - 0.5
+    exp = F.conv_transpose3d(torch.from_numpy(x), torch.from_numpy(w),
+                             stride=stride, padding=pad, dilation=dil).numpy()
+    got, = run_op("conv3d_transpose", {"Input": x, "Filter": w},
+                  {"strides": stride, "paddings": pad, "dilations": dil},
+                  out_slots=("Output",))
+    np.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# pool3d
+# ---------------------------------------------------------------------------
+
+POOL3D_GRID = [
+    # (shape, ksize, stride, pad, ptype, global, ceil, exclusive)
+    ([2, 3, 4, 4, 4], [2, 2, 2], [2, 2, 2], [0, 0, 0], "max", False, False, True),
+    ([2, 3, 5, 5, 5], [2, 2, 2], [2, 2, 2], [0, 0, 0], "max", False, True, True),
+    ([2, 3, 4, 4, 4], [3, 3, 3], [1, 1, 1], [1, 1, 1], "max", False, False, True),
+    ([2, 3, 4, 4, 4], [2, 2, 2], [2, 2, 2], [0, 0, 0], "avg", False, False, True),
+    ([2, 3, 4, 4, 4], [3, 3, 3], [1, 1, 1], [1, 1, 1], "avg", False, False, True),
+    ([2, 3, 4, 4, 4], [3, 3, 3], [1, 1, 1], [1, 1, 1], "avg", False, False, False),
+    ([2, 3, 4, 5, 6], [2, 2, 2], [1, 1, 1], [0, 0, 0], "avg", True, False, True),
+    ([2, 3, 5, 5, 5], [2, 2, 2], [2, 2, 2], [1, 1, 1], "avg", False, True, True),
+]
+
+
+@pytest.mark.parametrize(
+    "shape,ksize,stride,pad,ptype,gpool,ceil,excl", POOL3D_GRID)
+def test_pool3d_vs_torch(shape, ksize, stride, pad, ptype, gpool, ceil, excl):
+    x = rng.rand(*shape).astype("float32")
+    t = torch.from_numpy(x)
+    if gpool:
+        exp = (t.amax((2, 3, 4), keepdim=True) if ptype == "max"
+               else t.mean((2, 3, 4), keepdim=True)).numpy()
+    elif ptype == "max":
+        exp = F.max_pool3d(t, ksize, stride, pad, ceil_mode=ceil).numpy()
+    else:
+        exp = F.avg_pool3d(t, ksize, stride, pad, ceil_mode=ceil,
+                           count_include_pad=not excl).numpy()
+    got, = run_op("pool3d", {"X": x},
+                  {"pooling_type": ptype, "ksize": ksize, "strides": stride,
+                   "paddings": pad, "global_pooling": gpool,
+                   "ceil_mode": ceil, "exclusive": excl})
+    if ceil:
+        # reference ceil formula (pool_op.cc PoolOutputSize) keeps windows
+        # torch clips when they start entirely in the trailing padding;
+        # compare the shared prefix and require 0 at reference-only tails
+        sl = tuple(slice(None, e) for e in exp.shape)
+        np.testing.assert_allclose(got[sl], exp, rtol=1e-5, atol=1e-5)
+        for d in range(3):
+            if got.shape[2 + d] > exp.shape[2 + d]:
+                tail = np.take(got, range(exp.shape[2 + d], got.shape[2 + d]),
+                               axis=2 + d)
+                np.testing.assert_allclose(tail, 0.0, atol=1e-6)
+    else:
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-5)
+
+
+def test_pool3d_grad_fd():
+    x = rng.rand(1, 2, 3, 3, 3).astype("float32")
+    check_grad_fd("pool3d", {"X": x},
+                  "X", {"pooling_type": "avg", "ksize": [2, 2, 2],
+                        "strides": [1, 1, 1], "paddings": [0, 0, 0]})
+
+
+# ---------------------------------------------------------------------------
+# max_pool3d_with_index
+# ---------------------------------------------------------------------------
+
+MP3I_GRID = [
+    ([2, 3, 4, 4, 4], [2, 2, 2], [2, 2, 2], [0, 0, 0], False),
+    ([1, 2, 5, 4, 6], [3, 2, 2], [2, 2, 2], [1, 0, 1], False),
+    ([2, 2, 3, 3, 3], [2, 2, 2], [1, 1, 1], [0, 0, 0], False),
+    ([1, 2, 4, 4, 4], [9, 9, 9], [1, 1, 1], [0, 0, 0], True),
+]
+
+
+@pytest.mark.parametrize("shape,ksize,stride,pad,gpool", MP3I_GRID)
+def test_max_pool3d_with_index_vs_torch(shape, ksize, stride, pad, gpool):
+    x = rng.rand(*shape).astype("float32")
+    t = torch.from_numpy(x)
+    if gpool:
+        ksize, stride, pad = list(shape[2:]), [1, 1, 1], [0, 0, 0]
+    exp, exp_idx = F.max_pool3d(t, ksize, stride, pad, return_indices=True)
+    out, mask = run_op("max_pool3d_with_index", {"X": x},
+                       {"ksize": ksize, "strides": stride, "paddings": pad,
+                        "global_pooling": gpool},
+                       out_slots=("Out", "Mask"))
+    np.testing.assert_allclose(out, exp.numpy(), rtol=1e-6, atol=1e-6)
+    # torch's indices flatten over the input volume D*H*W, same contract
+    np.testing.assert_array_equal(mask, exp_idx.numpy())
+
+
+# ---------------------------------------------------------------------------
+# fill
+# ---------------------------------------------------------------------------
+
+def test_fill_op():
+    vals = np.arange(6.0).astype("float32")
+    got, = run_op("fill", {}, {"value": vals.tolist(), "shape": [2, 3],
+                               "dtype": "float32"})
+    np.testing.assert_array_equal(got, vals.reshape(2, 3))
+    got, = run_op("fill", {}, {"value": [1.0, 2.0], "shape": [2],
+                               "dtype": "int64"})
+    assert got.dtype.kind == "i"  # int64 narrows to int32 under jax x32
+    np.testing.assert_array_equal(got, [1, 2])
+
+
+# ---------------------------------------------------------------------------
+# lstmp: projection inside the recurrence
+# ---------------------------------------------------------------------------
+
+def _sig(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def np_lstmp(x, w, w_proj, bias, lens, use_peep, is_rev):
+    """Step-by-step reference with masked carry, mirroring lstmp_op.h:
+    gates = x_t + r_{t-1} @ W; r_t = tanh(h_t @ W_proj)."""
+    b, t, d4 = x.shape
+    d = d4 // 4
+    p = w_proj.shape[1]
+    gb = bias.reshape(-1)[:4 * d]
+    if use_peep:
+        w_ic, w_fc, w_oc = (bias.reshape(-1)[4 * d:5 * d],
+                            bias.reshape(-1)[5 * d:6 * d],
+                            bias.reshape(-1)[6 * d:7 * d])
+    r = np.zeros((b, p))
+    c = np.zeros((b, d))
+    order = range(t - 1, -1, -1) if is_rev else range(t)
+    rs = np.zeros((b, t, p))
+    cs = np.zeros((b, t, d))
+    for step in order:
+        mt = (step < lens).astype(np.float64)[:, None]
+        gates = x[:, step] + r @ w + gb
+        gi, gf, gc, go = np.split(gates, 4, axis=-1)
+        if use_peep:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i, f = _sig(gi), _sig(gf)
+        c_new = f * c + i * np.tanh(gc)
+        if use_peep:
+            go = go + c_new * w_oc
+        o = _sig(go)
+        r_new = np.tanh(np.tanh(c_new) * o @ w_proj)
+        r = mt * r_new + (1 - mt) * r
+        c = mt * c_new + (1 - mt) * c
+        rs[:, step] = r
+        cs[:, step] = c
+    return rs, cs
+
+
+@pytest.mark.parametrize("use_peep,is_rev", [(False, False), (True, False),
+                                             (False, True), (True, True)])
+def test_lstmp_op_vs_numpy(use_peep, is_rev):
+    b, t, d, p = 3, 5, 4, 2
+    x = (rng.rand(b, t, 4 * d) - 0.5).astype("float64")
+    w = (rng.rand(p, 4 * d) - 0.5).astype("float64")
+    w_proj = (rng.rand(d, p) - 0.5).astype("float64")
+    bias = (rng.rand(1, 7 * d if use_peep else 4 * d) - 0.5).astype("float64")
+    lens = np.array([5, 3, 1], dtype=np.int32)
+    exp_r, exp_c = np_lstmp(x, w, w_proj, bias, lens, use_peep, is_rev)
+    proj, cell = run_op(
+        "lstmp", {"Input": x, "Weight": w, "ProjWeight": w_proj,
+                  "Bias": bias, "XLen": lens},
+        {"use_peepholes": use_peep, "is_reverse": is_rev},
+        out_slots=("Projection", "Cell"))
+    m = (np.arange(t)[None, :] < lens[:, None]).astype(np.float64)
+    np.testing.assert_allclose(proj * m[:, :, None], exp_r * m[:, :, None],
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(cell * m[:, :, None], exp_c * m[:, :, None],
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_lstmp_projection_feeds_back():
+    """The defining lstmp property: output differs from lstm + post-hoc
+    projection (which the old subsumption computed)."""
+    b, t, d, p = 2, 4, 3, 2
+    # large weights: tanh must be in its nonlinear range, else the post-hoc
+    # projection is numerically indistinguishable (tanh(v) ~ v)
+    x = (3.0 * (rng.rand(b, t, 4 * d) - 0.5)).astype("float64")
+    w = (3.0 * (rng.rand(p, 4 * d) - 0.5)).astype("float64")
+    w_proj = (3.0 * (rng.rand(d, p) - 0.5)).astype("float64")
+    bias = (rng.rand(1, 4 * d) - 0.5).astype("float64")
+    lens = np.array([4, 4], dtype=np.int32)
+    proj, _ = run_op("lstmp",
+                     {"Input": x, "Weight": w, "ProjWeight": w_proj,
+                      "Bias": bias, "XLen": lens}, {"use_peepholes": False},
+                     out_slots=("Projection", "Cell"))
+    # lstm with zero-padded [d,4d] recurrent weight cannot reproduce it:
+    # the projected-state recurrence mixes through w_proj every step
+    w_lstm = (w_proj @ w).astype("float64")  # equivalent ONLY if tanh were
+    hid, _ = run_op("lstm", {"Input": x, "Weight": w_lstm, "Bias": bias,
+                             "XLen": lens}, {"use_peepholes": False},
+                    out_slots=("Hidden", "Cell"))
+    post = np.tanh(hid @ w_proj)
+    assert not np.allclose(proj, post, atol=1e-4)
+
+
+def test_dynamic_lstmp_h0_c0_wired():
+    """h_0/c_0 reach the lstmp kernel: a nonzero h_0 changes step-0 output
+    through the H0->projection path (lstmp_op.h:174-187)."""
+    import paddle_tpu as fluid
+    L = fluid.layers
+    d, p = 2, 3
+    x_np = (rng.rand(2, 4 * d) - 0.5).astype("float32")
+    outs = {}
+    for tag, h0val in (("zero", np.zeros((1, d), "float32")),
+                       ("warm", np.full((1, d), 2.0, "float32"))):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = L.data(name="x", shape=[4 * d], dtype="float32", lod_level=1)
+            const = fluid.initializer.Constant(0.3)
+            proj, _ = L.dynamic_lstmp(
+                input=x, size=4 * d, proj_size=p, use_peepholes=False,
+                param_attr=[fluid.ParamAttr(initializer=const),
+                            fluid.ParamAttr(initializer=const)],
+                bias_attr=fluid.ParamAttr(initializer=const),
+                h_0=L.assign(h0val), c_0=L.assign(np.zeros((1, d), "f")))
+            last = L.sequence_pool(input=proj, pool_type="first")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            lod = fluid.create_lod_tensor(x_np, [[2]], fluid.CPUPlace())
+            outs[tag], = exe.run(main, feed={"x": lod},
+                                 fetch_list=[last.name])
+    assert not np.allclose(outs["zero"], outs["warm"], atol=1e-6)
+
+
+def test_dynamic_lstmp_layer_end_to_end():
+    """dynamic_lstmp trains: projection output [B, T, P], loss decreases."""
+    import paddle_tpu as fluid
+    L = fluid.layers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[5], dtype="float32", lod_level=1)
+        fc = L.fc(input=x, size=16, bias_attr=False)
+        proj, cell = L.dynamic_lstmp(input=fc, size=16, proj_size=3)
+        pooled = L.sequence_pool(input=proj, pool_type="last")
+        y = L.data(name="y", shape=[1], dtype="float32")
+        loss = L.mean(x=L.square_error_cost(input=L.fc(pooled, size=1),
+                                            label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    seqs = [np.asarray(rng.rand(n, 5), dtype="float32")
+            for n in (3, 5, 2)]
+    lod = fluid.create_lod_tensor(np.concatenate(seqs),
+                                  [[3, 5, 2]], fluid.CPUPlace())
+    yv = rng.rand(3, 1).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [exe.run(main, feed={"x": lod, "y": yv},
+                          fetch_list=[loss])[0][0] for _ in range(12)]
+    assert losses[-1] < losses[0]
