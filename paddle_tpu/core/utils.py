@@ -14,3 +14,17 @@ def find_var(program, name):
         if name in block.vars:
             return block.vars[name]
     return None
+
+
+def device_fetch_barrier(out):
+    """REAL device barrier for timing loops: reduce the first leaf to a
+    scalar on device and fetch it to host. Over the axon TPU tunnel,
+    jax.block_until_ready can return once work is ENQUEUED remotely
+    (round 4: microbenches reported impossible sub-HBM-latency timings);
+    a device->host fetch cannot complete before the computation has.
+    The single home for this workaround — bench.py and tools/* call it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jnp.sum(leaf.astype(jnp.float32)))

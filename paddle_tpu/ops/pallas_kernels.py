@@ -65,7 +65,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref, *,
     q = q_ref[0].astype(jnp.float32) * scale                 # [bq, d]
     bq, d = q.shape
     qpos = qb * block_q + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-    kv_len = len_ref[0, 0]                                   # this row's T
+    # whole [BH, 1] array lives in SMEM (a (1,1)-blocked spec violates
+    # Mosaic's (8,128) block rule — caught on first real-TPU run, round 4)
+    kv_len = len_ref[pl.program_id(0), 0]                    # this row's T
 
     nk = t_pad // block_k
     if causal:
@@ -106,7 +108,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, lse_ref, *,
 
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+    lse_ref[0] = m + jnp.log(l_safe)                         # [bq, 1]
 
 
 def _flash_fwd(q, k, v, kv_len, scale, causal, block_q, block_k, interpret):
@@ -124,6 +126,9 @@ def _flash_fwd(q, k, v, kv_len, scale, causal, block_q, block_k, interpret):
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, t_pad=t_pad)
+    # lens: whole array in SMEM (no blocking); lse: [BH, T, 1] so the
+    # block's trailing dims are (block_q, 1) — Mosaic requires last-two
+    # block dims divisible by (8, 128) or equal to the array's
     smem = {} if pltpu is None else {"memory_space": pltpu.SMEM}
     out, lse = pl.pallas_call(
         kernel,
@@ -132,19 +137,19 @@ def _flash_fwd(q, k, v, kv_len, scale, causal, block_q, block_k, interpret):
             _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
             _vmem_spec((1, t_pad, d), lambda b, i: (b, 0, 0)),
             _vmem_spec((1, t_pad, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1), lambda b, i: (b, 0), **smem),
+            pl.BlockSpec(**smem),
         ],
         out_specs=[
             _vmem_spec((1, block_q, d), lambda b, i: (b, i, 0)),
-            _vmem_spec((1, block_q), lambda b, i: (b, i)),
+            _vmem_spec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t_pad), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t_pad, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, lens)
-    return out[:, :t], lse[:, :t]
+    return out[:, :t], lse[:, :t, 0]
 
 
 def _flash_bwd(scale, causal, block_k, res, g):

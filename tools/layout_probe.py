@@ -33,14 +33,20 @@ def bench_layout(layout, batch=256, c=256, hw=14, k=3, depth=8, steps=20):
                         dtype=jnp.bfloat16)
         ws = [jnp.asarray(rng.randn(k, k, c, c).astype(np.float32) * 0.05,
                           dtype=jnp.bfloat16) for _ in range(depth)]
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.core.utils import device_fetch_barrier
+
     apply = conv_stack(layout)
     grad = jax.jit(jax.grad(apply))
     g = grad(ws, x)
-    jax.block_until_ready(g)
+    device_fetch_barrier(g)
     t0 = time.perf_counter()
     for _ in range(steps):
         g = grad(ws, x)
-    jax.block_until_ready(g)
+    device_fetch_barrier(g)
     dt = (time.perf_counter() - t0) / steps
     flops = 2 * 3 * depth * batch * hw * hw * c * c * k * k  # fwd+bwd(2x)
     print("%s: %.2f ms/step, %.1f TFLOP/s" % (layout, dt * 1e3,

@@ -27,14 +27,14 @@ def _await():
 
 
 def _time(fn, *args, iters=20, warmup=3):
-    import jax
+    from paddle_tpu.core.utils import device_fetch_barrier
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    device_fetch_barrier(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    device_fetch_barrier(out)
     return (time.perf_counter() - t0) / iters * 1e3
 
 

@@ -16,16 +16,25 @@ cd "$(dirname "$0")/.."
 LOG=/tmp/perf_sweep.log
 : > $LOG
 WEDGED=0
+LOCK="tools/tpu_lock.sh"  # exclusive-tunnel flock (round-4 re-wedge); we
+                          # cd'd to the repo root above
 tunnel_ok() {  # raw 120s device probe, no WEDGED short-circuit
-  timeout 120 python -c "import jax; print(jax.devices())"
+  bash "$LOCK" timeout 120 python -c "import jax; print(jax.devices())"
 }
 probe() {  # never start a compile against a wedged tunnel
   [ "$WEDGED" = 1 ] && return 1
-  tunnel_ok || {
+  tunnel_ok
+  local rc=$?
+  [ $rc -eq 0 ] && return 0
+  if [ $rc -eq 75 ]; then  # tpu_lock timeout: busy, NOT a wedge diagnosis
+    echo "TPU LOCK BUSY - skipping remaining configs (not a wedge)" | tee -a $LOG
+    echo "- $(date -u +%FT%TZ) sweep stopped: tpu_lock busy (rc=75)" >> BENCH_LOG.md
+  else
     echo "TUNNEL WEDGED - skipping remaining configs" | tee -a $LOG
     echo "- $(date -u +%FT%TZ) tunnel probe FAILED mid-sweep" >> BENCH_LOG.md
-    WEDGED=1
-    return 1; }
+  fi
+  WEDGED=1
+  return 1
 }
 bank() {  # commit the log so a later wedge cannot erase banked numbers
   # pathspec-limited: never sweeps unrelated staged work into the bank
@@ -36,8 +45,8 @@ run() {
   [ "$WEDGED" = 1 ] && { echo "skip (wedged): $*" | tee -a $LOG; return; }
   echo "=== $*" | tee -a $LOG
   local line
-  line=$(env "$@" BENCH_DEVICE_TIMEOUT=300 timeout -k 10 900 python bench.py \
-         2>/dev/null | tail -1)
+  line=$(bash "$LOCK" env "$@" BENCH_DEVICE_TIMEOUT=300 timeout -k 10 900 \
+         python bench.py 2>/dev/null | tail -1)
   echo "$line" | tee -a $LOG
   # persist every successful measurement the moment it exists (r2 verdict
   # weak #1: a later wedge must not erase the round's perf story)
@@ -69,9 +78,9 @@ probe && run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
 probe && run BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256 BENCH_FUSED_ATTN=0
 # ---- tier 3: multi-compile probe + pallas microbench -------------------
 if probe; then
-  timeout 600 python tools/layout_probe.py 2>/dev/null | tee -a $LOG
+  bash "$LOCK" timeout 600 python tools/layout_probe.py 2>/dev/null | tee -a $LOG
   echo "=== pallas microbench" | tee -a $LOG
-  timeout 900 python tools/pallas_microbench.py 2>/dev/null | tee -a $LOG | \
+  bash "$LOCK" timeout 900 python tools/pallas_microbench.py 2>/dev/null | tee -a $LOG | \
     while read -r line; do
       printf -- '- %s microbench `%s`\n' "$(date -u +%FT%TZ)" "$line" >> BENCH_LOG.md
     done
