@@ -77,6 +77,7 @@ def bench_transformer():
     import jax
     import jax.numpy as jnp
     import paddle_tpu as fluid
+    from paddle_tpu.core.utils import device_fetch_barrier
     from paddle_tpu.models import transformer
 
     batch = int(os.environ.get("BENCH_BATCH", "32"))
@@ -117,16 +118,19 @@ def bench_transformer():
         for _ in range(steps):
             out = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
                           return_numpy=False)
-        # D2H loss fetch = real barrier (core/utils.device_fetch_barrier)
-        loss = np.asarray(out[0])
+        device_fetch_barrier(out)
         dt = time.perf_counter() - t0
+        loss = np.asarray(out[0])
         assert np.isfinite(loss).all(), "non-finite loss"
 
     tps = batch * seq * steps / dt
     # training FLOPs/token ~ 6 * params (72*L*d^2 with d_inner=4d) plus
-    # the attention matmuls (~12*L*seq*d fwd+bwd)
+    # the attention matmuls (~12*L*seq*d fwd+bwd) plus the vocab
+    # projection (6*d*V — at base config it rivals the whole body:
+    # 92M vs 113M FLOPs/token; omitting it undercounted MFU pre-round-4)
     flops_per_token = 72.0 * n_layer * d_model ** 2 \
-        + 12.0 * n_layer * seq * d_model
+        + 12.0 * n_layer * seq * d_model \
+        + 6.0 * d_model * vocab
     print(json.dumps({
         "metric": "transformer_train_throughput",
         "value": round(tps, 1), "unit": "tokens/sec/chip",
@@ -144,6 +148,7 @@ def main():
         return
     import jax
     import paddle_tpu as fluid
+    from paddle_tpu.core.utils import device_fetch_barrier
     from paddle_tpu.models.image_classification import build_train
 
     batch = int(os.environ.get("BENCH_BATCH", "256"))
@@ -209,18 +214,20 @@ def main():
             fd = stage(i) if feeds is None else feeds
             out = exe.run(main_prog, feed=fd,
                           fetch_list=[avg_cost], return_numpy=False)
-        # D2H loss fetch as the barrier (see core/utils.py
-        # device_fetch_barrier: block_until_ready can return at
-        # remote-enqueue time over the axon tunnel)
-        loss = np.asarray(out[0])
+        device_fetch_barrier(out)
         dt = time.perf_counter() - t0
+        loss = np.asarray(out[0])
         assert np.isfinite(loss).all(), "non-finite loss"
 
     ips = batch * steps / dt
     headline = (hw == 224 and class_dim == 1000)
-    # ResNet-50 fwd ~ 4.1 GFLOPs @ 224^2; training ~ 3x fwd (mfu is only
-    # reported for the headline 224 config, so no resolution scaling)
-    flops_per_image = 3 * 4.1e9
+    # ResNet-50 fwd = 4.09 GMACs = 8.18e9 FLOPs @ 224^2 (the commonly
+    # quoted "4.1 GFLOPs" is MACs); training ~ 3x fwd. Audited round 4:
+    # per-conv program shapes sum to 8.178e9 and XLA cost_analysis counts
+    # 8.14e9 fwd / 26.9e9 train — so 3*8.2e9 is the conservative
+    # conv+fc-only floor. (The pre-round-4 constant 3*4.1e9 undercounted
+    # MFU by 2x.)
+    flops_per_image = 3 * 8.2e9
     rec = {
         "metric": "resnet50_imagenet_train_throughput",
         "value": round(ips, 2),

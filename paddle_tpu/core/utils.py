@@ -22,7 +22,8 @@ def device_fetch_barrier(out):
     jax.block_until_ready can return once work is ENQUEUED remotely
     (round 4: microbenches reported impossible sub-HBM-latency timings);
     a device->host fetch cannot complete before the computation has.
-    The single home for this workaround — bench.py and tools/* call it."""
+    The single home for this workaround — bench.py and tools/* call it
+    at the end of their timing loops."""
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -1,10 +1,16 @@
 """Probe: NCHW vs NHWC conv layout cost on the real TPU for a ResNet-50-ish
-stack of convs, fwd+bwd. Run standalone: python /tmp/layout_probe.py"""
+stack of convs, fwd+bwd. Run standalone: python tools/layout_probe.py"""
+import os
+import sys
 import time
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from paddle_tpu.core.utils import device_fetch_barrier  # noqa: E402
 
 
 def conv_stack(layout):
@@ -33,12 +39,6 @@ def bench_layout(layout, batch=256, c=256, hw=14, k=3, depth=8, steps=20):
                         dtype=jnp.bfloat16)
         ws = [jnp.asarray(rng.randn(k, k, c, c).astype(np.float32) * 0.05,
                           dtype=jnp.bfloat16) for _ in range(depth)]
-    import os
-    import sys
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    from paddle_tpu.core.utils import device_fetch_barrier
-
     apply = conv_stack(layout)
     grad = jax.jit(jax.grad(apply))
     g = grad(ws, x)

@@ -44,9 +44,17 @@ bank() {  # commit the log so a later wedge cannot erase banked numbers
 run() {
   [ "$WEDGED" = 1 ] && { echo "skip (wedged): $*" | tee -a $LOG; return; }
   echo "=== $*" | tee -a $LOG
-  local line
-  line=$(bash "$LOCK" env "$@" BENCH_DEVICE_TIMEOUT=300 timeout -k 10 900 \
-         python bench.py 2>/dev/null | tail -1)
+  local line rc
+  bash "$LOCK" env "$@" BENCH_DEVICE_TIMEOUT=300 timeout -k 10 900 \
+    python bench.py >/tmp/bench_run.out 2>/dev/null
+  rc=$?
+  line=$(tail -1 /tmp/bench_run.out)
+  if [ $rc -eq 75 ]; then  # lock busy: not a bench failure, not a wedge
+    echo "TPU LOCK BUSY - stopping sweep (not a wedge)" | tee -a $LOG
+    echo "- $(date -u +%FT%TZ) sweep stopped mid-run: tpu_lock busy (rc=75)" >> BENCH_LOG.md
+    WEDGED=1
+    return
+  fi
   echo "$line" | tee -a $LOG
   # persist every successful measurement the moment it exists (r2 verdict
   # weak #1: a later wedge must not erase the round's perf story)

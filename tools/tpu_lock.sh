@@ -5,20 +5,12 @@
 # never overlap (the axon tunnel wedges its lease on concurrent clients —
 # it cost rounds 2-3 their perf story and re-wedged round 4 at 01:52Z).
 #
-# A lock timeout is NOT a tunnel wedge: it exits rc=75 (EX_TEMPFAIL) with
-# a loud stderr line so callers (perf_sweep.sh probe()) can tell "another
-# client is still running" apart from "the tunnel is gone".
+# A lock timeout is NOT a tunnel wedge: flock exits rc=75 (EX_TEMPFAIL,
+# via -E) so callers (perf_sweep.sh) can tell "another client is still
+# running" apart from "the tunnel is gone". The wrapped command's own rc
+# passes through untouched.
 LOCKFILE=/tmp/tpu_client.lock
 if ! flock -n "$LOCKFILE" true 2>/dev/null; then
   echo "tpu_lock: lock busy (another TPU client is running); waiting up to 20 min..." >&2
 fi
-flock -w 1200 "$LOCKFILE" "$@"
-rc=$?
-# flock's own acquisition failure returns 1 with nothing executed; re-check
-# the lock to map it to a distinct, loud code (a wrapped command's real
-# rc=1 passes through because the lock is free again by then)
-if [ $rc -eq 1 ] && ! flock -n "$LOCKFILE" true 2>/dev/null; then
-  echo "tpu_lock: TIMED OUT waiting for $LOCKFILE (rc=75, NOT a tunnel wedge)" >&2
-  exit 75
-fi
-exit $rc
+exec flock -w 1200 -E 75 "$LOCKFILE" "$@"
