@@ -120,13 +120,51 @@ def sequence_reshape(input, new_dim):
 
 
 def lod_reset(x, y=None, target_lod=None):
+    """Re-segment x's flat data stream (reference lod_reset_op.cc: new LoD
+    from Y's own LoD, Y.data offsets, or attr target_lod [0, n1, n2...];
+    plain per-sequence lengths are also accepted for target_lod — a list
+    whose first element is 0 is ALWAYS read as offsets, per the reference,
+    so an empty-first-sequence lengths list must be given as offsets)."""
+    if y is None and not target_lod:
+        raise ValueError(
+            "lod_reset: either y or a non-empty target_lod must be "
+            "provided (reference lod_reset_op enforces the same)")
     helper = LayerHelper("lod_reset", **locals())
+    if helper.block.idx != 0:
+        # inside a While/RNN sub-block the lowering's length-sum assertion
+        # cannot escape the lax trace (LowerCtx.add_error skips under
+        # _loop_iters) — a mismatched target would silently clip or drop
+        # rows. Surface that at build time, like sequence_reshape above.
+        import warnings
+        warnings.warn(
+            "lod_reset inside a control-flow sub-block: the target-"
+            "segmentation length-sum check is not enforceable in-graph "
+            "there; a mismatched target_lod would silently clip or drop "
+            "rows. Verify lengths statically.", stacklevel=2)
     out = helper.create_variable_for_type_inference(x.dtype)
-    helper.append_op(type="lod_reset", inputs={"X": [x]},
-                    outputs={"Out": [out]})
+    out_len = helper.block.create_var(
+        name=out.name + "@SEQLEN", shape=[-1], dtype="int32",
+        stop_gradient=True)
+    inputs = {"X": [x]}
+    attrs = {}
+    if getattr(x, "lod_level", 0):
+        inputs["XLen"] = [_seq_len(helper, x)]
     if y is not None:
-        out.lod_level = y.lod_level
-        out.seq_len_var = y.seq_len_var
+        if getattr(y, "lod_level", 0):
+            inputs["Y"] = [y]
+            inputs["YLen"] = [_seq_len(helper, y)]
+        else:
+            inputs["YData"] = [y]
+    elif target_lod is not None:
+        tl = [int(v) for v in target_lod]
+        attrs["target_lens"] = (
+            [b - a for a, b in zip(tl, tl[1:])]
+            if tl and tl[0] == 0 and len(tl) > 1 else tl)
+    helper.append_op(type="lod_reset", inputs=inputs,
+                     outputs={"Out": [out], "OutLen": [out_len]},
+                     attrs=attrs)
+    out.lod_level = 1
+    out.seq_len_var = out_len.name
     return out
 
 

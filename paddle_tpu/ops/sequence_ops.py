@@ -168,8 +168,69 @@ def _sequence_expand(ctx, ins, attrs):
 
 @register("lod_reset")
 def _lod_reset(ctx, ins, attrs):
+    """lod_reset_op.cc: keep the flat data stream, replace the segmentation.
+
+    The reference's row-major [total, D] layout makes this metadata-only;
+    the padded-dense layout has to repack rows — flatten X's valid rows to
+    a contiguous stream (scatter by old cumulative lengths), then re-split
+    per the new lengths (gather by new cumulative lengths). New lengths
+    come from attr target_lens (static), YLen (Y's own LoD), or YData
+    (Y.data holding offsets, reference doc "attr(target_lod): [0, 4, 6]").
+    """
     x = single(ins, "X")
-    return {"Out": [x]}
+    xlen = single(ins, "XLen")
+    ylen = single(ins, "YLen")
+    ydata = single(ins, "YData")
+    y = single(ins, "Y")
+    t_lens = attrs.get("target_lens") or []
+    if ylen is None and ydata is None and not t_lens:
+        # no target: pass through unchanged (the reference op enforces a
+        # target; tolerated here for metadata-only program clones)
+        return {"Out": [x]} if xlen is None else \
+            {"Out": [x], "OutLen": [xlen]}
+    # 1. flatten valid rows into one contiguous stream
+    if xlen is not None:
+        b, t = x.shape[:2]
+        feat = x.shape[2:]
+        cap = b * t
+        xl = xlen.astype(jnp.int32)
+        cum = jnp.cumsum(xl) - xl                       # exclusive prefix
+        pos = cum[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        valid = jnp.arange(t, dtype=jnp.int32)[None, :] < xl[:, None]
+        pos = jnp.where(valid, pos, cap)                # park padding rows
+        flat = jnp.zeros((cap + 1,) + feat, x.dtype).at[
+            pos.reshape(-1)].set(x.reshape((cap,) + feat))[:cap]
+    else:                                               # dense X: rows ARE the stream
+        feat = x.shape[1:]
+        flat = x
+        cap = x.shape[0]
+    # 2. new segmentation
+    if ylen is not None:
+        newlen = ylen.astype(jnp.int32)
+        b2 = y.shape[0] if y is not None else newlen.shape[0]
+        t2 = y.shape[1] if y is not None and len(y.shape) > 1 else cap
+    elif ydata is not None:
+        off = ydata.reshape(-1).astype(jnp.int32)
+        newlen = off[1:] - off[:-1]
+        b2, t2 = newlen.shape[0], cap
+    else:
+        lens = [int(v) for v in t_lens]
+        newlen = jnp.asarray(lens, jnp.int32)
+        b2, t2 = len(lens), max(lens)
+    # reference lod_reset_op.cc enforces the last offset == data length;
+    # a mismatch here would silently duplicate (clip) or drop rows
+    total = jnp.sum(xl) if xlen is not None else cap
+    ctx.add_error(
+        "lod_reset: target segmentation length sum != data stream length",
+        jnp.sum(newlen) != total)
+    cum2 = jnp.cumsum(newlen) - newlen
+    idx = cum2[:, None] + jnp.arange(t2, dtype=jnp.int32)[None, :]
+    valid2 = jnp.arange(t2, dtype=jnp.int32)[None, :] < newlen[:, None]
+    out = flat[jnp.clip(idx, 0, cap - 1).reshape(-1)].reshape(
+        (b2, t2) + feat)
+    out = jnp.where(valid2.reshape((b2, t2) + (1,) * len(feat)), out,
+                    jnp.zeros((), x.dtype))
+    return {"Out": [out], "OutLen": [newlen]}
 
 
 @register("row_conv")
@@ -239,8 +300,11 @@ def _lstm(ctx, ins, attrs):
     b, t, _ = x.shape
     use_peep = attrs.get("use_peepholes", False)
     gact = _lstm_act(attrs.get("gate_activation", "sigmoid"))
-    cact = _lstm_act(attrs.get("cell_activation", "tanh"))
-    hact = _lstm_act(attrs.get("candidate_activation", "tanh"))
+    # lstm_op.h: act_cand maps the candidate gate, act_cell maps the cell
+    # state on its way into the hidden output (h = o * act_cell(c)) —
+    # indistinguishable at the tanh/tanh default, distinct otherwise
+    cell_act = _lstm_act(attrs.get("cell_activation", "tanh"))
+    cand_act = _lstm_act(attrs.get("candidate_activation", "tanh"))
     is_rev = attrs.get("is_reverse", False)
 
     state_dt, rmat2 = _amp_recurrence(ctx, x.dtype)
@@ -273,11 +337,11 @@ def _lstm(ctx, ins, attrs):
             gf = gf + c_prev * w_fc
         i = gact(gi)
         f = gact(gf)
-        c_new = f * c_prev + i * cact(gc)
+        c_new = f * c_prev + i * cand_act(gc)
         if use_peep:
             go = go + c_new * w_oc
         o = gact(go)
-        h_new = o * hact(c_new)
+        h_new = o * cell_act(c_new)
         # masked carry: padding steps keep previous state
         h = mt * h_new + (1 - mt) * h_prev
         c = mt * c_new + (1 - mt) * c_prev
@@ -315,8 +379,8 @@ def _lstmp(ctx, ins, attrs):
     b, t, _ = x.shape
     use_peep = attrs.get("use_peepholes", False)
     gact = _lstm_act(attrs.get("gate_activation", "sigmoid"))
-    cact = _lstm_act(attrs.get("cell_activation", "tanh"))
-    hact = _lstm_act(attrs.get("candidate_activation", "tanh"))
+    cell_act = _lstm_act(attrs.get("cell_activation", "tanh"))
+    cand_act = _lstm_act(attrs.get("candidate_activation", "tanh"))
     pact = _lstm_act(attrs.get("proj_activation", "tanh"))
     is_rev = attrs.get("is_reverse", False)
 
@@ -351,11 +415,11 @@ def _lstmp(ctx, ins, attrs):
             gf = gf + c_prev * w_fc
         i = gact(gi)
         f = gact(gf)
-        c_new = f * c_prev + i * cact(gc)
+        c_new = f * c_prev + i * cand_act(gc)
         if use_peep:
             go = go + c_new * w_oc
         o = gact(go)
-        h_new = o * hact(c_new)
+        h_new = o * cell_act(c_new)
         r_new = pact(rmat2(h_new, w_proj))           # [B, P]
         r = mt * r_new + (1 - mt) * r_prev
         c = mt * c_new + (1 - mt) * c_prev

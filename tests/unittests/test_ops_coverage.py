@@ -535,11 +535,14 @@ def test_sequence_mask_and_lod_reset_layers():
         xv = fluid.layers.data(name="x", shape=[4], dtype="float32")
         f.append(fluid.layers.lod_reset(xv, target_lod=[2, 2]))
 
+    x = _x(4, 4)
     mask, reset = _run_layers(
-        build, feed={"lens": lens, "x": _x(4, 4)})
+        build, feed={"lens": lens, "x": x})
     expect = (np.arange(5)[None] < lens[:, None]).astype("float32")
     np.testing.assert_allclose(mask, expect)
-    assert reset.shape[0] == 4  # data passes through unchanged
+    # 4 dense rows re-segmented into 2 sequences of 2 (padded [2, 2, 4])
+    assert reset.shape[:2] == (2, 2)
+    np.testing.assert_allclose(np.asarray(reset).reshape(4, 4), x, rtol=1e-6)
 
 
 def test_im2sequence_layer_numeric():
