@@ -127,6 +127,16 @@ class LowerCtx(object):
         return key
 
 
+class _Lazy(object):
+    """Deferred env value: resolving it triggers a segment recompute
+    (rematerialization). See _lower_block_remat."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
 class Env(object):
     """Name -> traced value mapping for one lowering pass."""
 
@@ -137,16 +147,24 @@ class Env(object):
         if name not in self.values:
             raise KeyError("variable %r read before it was written; "
                            "is it fed / initialized?" % name)
-        return self.values[name]
+        v = self.values[name]
+        if isinstance(v, _Lazy):
+            v = v.fn()
+            self.values[name] = v
+        return v
 
     def read_opt(self, name):
-        return self.values.get(name)
+        v = self.values.get(name)
+        if isinstance(v, _Lazy):
+            v = v.fn()
+            self.values[name] = v
+        return v
 
     def write(self, name, value):
         self.values[name] = value
 
     def accumulate(self, name, value):
-        cur = self.values.get(name)
+        cur = self.read_opt(name)
         self.values[name] = value if cur is None else cur + value
 
     def __contains__(self, name):
@@ -155,10 +173,154 @@ class Env(object):
 
 def lower_block(ctx, block, env):
     from .readers import is_host_io_op
-    for op in block.ops:
-        if is_host_io_op(op.type):
-            continue  # executed host-side by the Executor's io pre-pass
+    ops = [op for op in block.ops if not is_host_io_op(op.type)]
+    # host io ops are executed host-side by the Executor's io pre-pass
+    if getattr(ctx.program, "_rematerialize", False) and block.idx == 0 \
+            and not ctx.is_startup and _lower_block_remat(ctx, ops, env):
+        return
+    for op in ops:
         lower_op(ctx, op, env)
+
+
+def _is_traced_array(v):
+    return isinstance(v, jax.Array) or isinstance(v, jax.core.Tracer)
+
+
+def _lower_block_remat(ctx, ops, env):
+    """Segment-level rematerialization (enable_rematerialization).
+
+    TPU-native activation checkpointing over the explicit fluid backward:
+    the forward region (ops before the first gradient op) is split into
+    ~sqrt(n)-op segments. After lowering a segment, every value it
+    produced whose remaining consumers are exclusively in the backward
+    region is swapped for a deferred recompute: when the backward reads
+    it, the whole segment re-lowers from its boundary inputs behind a
+    lax.optimization_barrier (so XLA cannot CSE the replay with the
+    forward and silently resurrect the saved residual). Only segment
+    boundaries stay live across the forward→backward gap — peak
+    activation memory drops from O(n) to O(n/s + s), the classic
+    checkpointing tradeoff the reference has no counterpart for.
+
+    RNG discipline: recompute replays lower_op with the same op uids, so
+    counter-derived keys (dropout masks etc.) are bit-identical to the
+    forward's. Returns False when the program has no backward region to
+    rematerialize (caller falls back to plain lowering).
+    """
+    first_bwd = None
+    for i, op in enumerate(ops):
+        if op.type == "grad_of" or any(
+                n.endswith(GRAD_SUFFIX) for n in op.all_output_vars() if n):
+            first_bwd = i
+            break
+    if first_bwd is None or first_bwd < 8:
+        return False
+    fwd_ops, bwd_ops = ops[:first_bwd], ops[first_bwd:]
+
+    def resolve_lazies():
+        # special-lowered ops (while/conditional_block/beam_search...) read
+        # enclosing-scope values via wholesale env copies that op.inputs
+        # does not list, and resolve them INSIDE lax sub-traces — a _Lazy
+        # reaching one would replay its segment at inner trace level and
+        # poison the shared recompute cache with escaped tracers. Force
+        # every deferred value concrete (top-level trace) first.
+        for nm, v in list(env.values.items()):
+            if isinstance(v, _Lazy):
+                env.values[nm] = v.fn()
+
+    fwd_write_counts = {}
+    for op in fwd_ops:
+        for nm in op.all_output_vars():
+            if nm:
+                fwd_write_counts[nm] = fwd_write_counts.get(nm, 0) + 1
+    read_by_bwd = set()
+    for op in bwd_ops:
+        for nm in op.all_input_vars():
+            read_by_bwd.add(nm)
+    keep = set(getattr(ctx, "remat_keep", ()))
+
+    import math
+    seg_len = max(4, int(math.ceil(math.sqrt(len(fwd_ops)))))
+    segments = [fwd_ops[i:i + seg_len]
+                for i in range(0, len(fwd_ops), seg_len)]
+    seg_reads = []
+    for seg in segments:
+        seg_reads.append({nm for op in seg
+                          for nm in op.all_input_vars() if nm})
+    # names read by any LATER forward segment (those stay live anyway —
+    # they are the checkpoints; rematerializing them would cascade)
+    suffix_after = [set() for _ in segments]
+    acc = set()
+    for k in range(len(segments) - 1, -1, -1):
+        suffix_after[k] = set(acc)
+        acc |= seg_reads[k]
+
+    for k, seg in enumerate(segments):
+        has_special = any(op.type in _SPECIAL for op in seg)
+        before = dict(env.values)
+        for op in seg:
+            if op.type in _SPECIAL:
+                resolve_lazies()
+            lower_op(ctx, op, env)
+        if has_special:
+            # a segment with a sub-block op cannot be replayed faithfully
+            # (its implicit enclosing-scope reads are not in op.inputs) —
+            # keep its products as plain checkpoints
+            continue
+        interior = sorted({
+            nm for op in seg for nm in op.all_output_vars()
+            if nm and nm in read_by_bwd
+            and nm not in suffix_after[k]
+            and nm not in keep
+            and fwd_write_counts.get(nm) == 1       # SSA-safe only
+            and _is_traced_array(env.values.get(nm))})
+        if not interior:
+            continue
+        boundary = {nm: before[nm] for nm in seg_reads[k]
+                    if nm in before and not isinstance(before[nm], _Lazy)}
+
+        def make_recompute(seg=seg, boundary=boundary,
+                           interior=tuple(interior)):
+            cache = {}
+
+            def recompute():
+                if cache:
+                    return cache
+                names = sorted(boundary)
+                arrs = [boundary[nm] for nm in names]
+                arr_idx = [i for i, a in enumerate(arrs)
+                           if _is_traced_array(a)]
+                if arr_idx:
+                    barred = jax.lax.optimization_barrier(
+                        [arrs[i] for i in arr_idx])
+                    for i, b in zip(arr_idx, barred):
+                        arrs[i] = b
+                sub = Env()
+                sub.values.update(zip(names, arrs))
+                for op in seg:
+                    lower_op(ctx, op, sub)
+                for nm in interior:
+                    cache[nm] = sub.values[nm]
+                return cache
+
+            return recompute
+
+        rec = make_recompute()
+        for nm in interior:
+            env.values[nm] = _Lazy(lambda nm=nm, rec=rec: rec()[nm])
+
+    for op in bwd_ops:
+        if op.type in _SPECIAL:
+            # nested sub-block grads are NOT segment-handled: leave
+            # _segment_handled unset so they keep the per-op fallback
+            resolve_lazies()
+            lower_op(ctx, op, env)
+            continue
+        ctx._segment_handled = True
+        try:
+            lower_op(ctx, op, env)
+        finally:
+            ctx._segment_handled = False
+    return True
 
 
 # Reserved env name carrying the OR of sub-block-confined TensorArray
@@ -259,10 +421,17 @@ def _lower_grad_of(ctx, op, env):
             flat.append(outs[slot][i])
         return flat
 
-    if getattr(ctx.program, "_rematerialize", False):
-        # memory_optimization_transpiler.enable_rematerialization: recompute
-        # this op's forward in the backward pass instead of keeping residuals
-        # (jax.checkpoint blocks XLA from CSE-ing it with the forward pass).
+    # Rematerialization: when the segment-level pass handles this grad op
+    # (top-level backward of a >=8-op forward), it hands the replay
+    # recomputed barrier-guarded primals — per-op jax.checkpoint must NOT
+    # stack on top: for boundary/checkpoint inputs the replay SHOULD CSE
+    # with the forward (the residual is live anyway; blocking that was
+    # measured at +15G HBM on ResNet-50@512). Everywhere the segment pass
+    # cannot reach (grad ops inside control-flow sub-blocks, programs
+    # below the segment gate) the per-op checkpoint is still the only
+    # remat lever, so it stays as the fallback.
+    if getattr(ctx.program, "_rematerialize", False) \
+            and not getattr(ctx, "_segment_handled", False):
         f = jax.checkpoint(f)
     primals, vjp_fn = jax.vjp(f, diff_primal)
 
@@ -312,6 +481,10 @@ def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
         base_key = jax.random.fold_in(
             jax.random.key(program.random_seed), seed)
         ctx = LowerCtx(program, base_key=base_key, mesh=mesh)
+        # names the remat pass must never defer: externally observed values
+        # (fetches, persistable state) and everything fed from outside
+        ctx.remat_keep = (set(fetch_names) | set(state_out) | set(state_rw)
+                         | set(state_ro) | set(feed_names))
         env = Env()
         for n, v in zip(feed_names, feed_vals):
             env.write(n, v)

@@ -217,12 +217,14 @@ def _lod_reset(ctx, ins, attrs):
         lens = [int(v) for v in t_lens]
         newlen = jnp.asarray(lens, jnp.int32)
         b2, t2 = len(lens), max(lens)
-    # reference lod_reset_op.cc enforces the last offset == data length;
-    # a mismatch here would silently duplicate (clip) or drop rows
+    # reference lod_reset_op.cc enforces an ascending LoD whose last offset
+    # equals the data length; a mismatch here would silently duplicate
+    # (clip) or drop rows. Non-monotone offsets telescope to a valid sum,
+    # so negative lengths must be rejected separately.
     total = jnp.sum(xl) if xlen is not None else cap
     ctx.add_error(
         "lod_reset: target segmentation length sum != data stream length",
-        jnp.sum(newlen) != total)
+        (jnp.sum(newlen) != total) | (newlen < 0).any())
     cum2 = jnp.cumsum(newlen) - newlen
     idx = cum2[:, None] + jnp.arange(t2, dtype=jnp.int32)[None, :]
     valid2 = jnp.arange(t2, dtype=jnp.int32)[None, :] < newlen[:, None]
@@ -472,7 +474,9 @@ def _gru(ctx, ins, attrs):
         xu = xt[:, :2 * d] + rmat(h_prev, w_g) + bias[:2 * d]
         u, r = jnp.split(gact(xu), 2, axis=-1)
         c = cact(xt[:, 2 * d:] + rmat(r * h_prev, w_c) + bias[2 * d:])
-        h_new = u * h_prev + (1 - u) * c
+        # reference gru convention (gru_kernel.h / test_gru_op.py:71):
+        # the update gate weights the CANDIDATE, not the carried state
+        h_new = u * c + (1 - u) * h_prev
         h = mt * h_new + (1 - mt) * h_prev
         return h, h
 
@@ -506,7 +510,7 @@ def _gru_unit(ctx, ins, attrs):
     xu = x[:, :2 * d] + h_prev @ w[:, :2 * d]
     u, r = jnp.split(gact(xu), 2, axis=-1)
     c = cact(x[:, 2 * d:] + (r * h_prev) @ w[:, 2 * d:])
-    h = u * h_prev + (1 - u) * c
+    h = u * c + (1 - u) * h_prev   # gru_unit_op: u weights the candidate
     return {"Hidden": [h], "Gate": [xu], "ResetHiddenPrev": [r * h_prev]}
 
 
@@ -516,12 +520,13 @@ def _lstm_unit(ctx, ins, attrs):
     x = single(ins, "X")
     c_prev = single(ins, "C_prev")
     forget_bias = attrs.get("forget_bias", 0.0)
-    d = x.shape[-1] // 4
-    gi, gf, gc, go = jnp.split(x, 4, axis=-1)
+    # reference lstm_unit_op.h packs gates i, f, o, j — candidate LAST
+    # (unlike lstm_op's i, f, c, o) — order matters for loaded weights
+    gi, gf, go, gj = jnp.split(x, 4, axis=-1)
     i = jax.nn.sigmoid(gi)
     f = jax.nn.sigmoid(gf + forget_bias)
     o = jax.nn.sigmoid(go)
-    c = f * c_prev + i * jnp.tanh(gc)
+    c = f * c_prev + i * jnp.tanh(gj)
     h = o * jnp.tanh(c)
     return {"C": [c], "H": [h]}
 

@@ -126,7 +126,7 @@ def _np_gru_act(seq, w, b, d, gate, cand, reverse, h0=None):
         ur = ACT[gate](np.concatenate([xu, xr]) + h @ w_ur)
         u, r = np.split(ur, 2)
         c = ACT[cand](xc + (r * h) @ w_c)
-        h = u * h + (1.0 - u) * c
+        h = u * c + (1.0 - u) * h   # reference: u weights the candidate
         hs[t] = h
     return hs
 
@@ -478,3 +478,20 @@ def test_sequence_softmax_ref_config(lens):
         e = np.exp(s.ravel() - s.max())
         np.testing.assert_allclose(got[i, :len(s)].ravel(), e / e.sum(),
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_lod_reset_rejects_nonmonotone_offsets():
+    """offsets [0,4,2,6] telescope to the right sum — the negative-length
+    term must still trip the in-graph assertion (reference hard-errors on
+    a non-ascending LoD)."""
+    import pytest as _pytest
+    seqs = [rng.randn(2, 2).astype("f"), rng.randn(4, 2).astype("f")]
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        r = fluid.layers.lod_reset(x, target_lod=[0, 4, 2, 6])
+        return (fluid.layers.sequence_last_step(r),)
+
+    with _pytest.raises(RuntimeError, match="lod_reset"):
+        _run(build, {"x": LoDTensor.from_sequences(seqs)})

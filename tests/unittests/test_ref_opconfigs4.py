@@ -1,0 +1,275 @@
+"""Reference OpTest parameter grids, tranche 4.
+
+Families ported from /root/reference/python/paddle/fluid/tests/unittests/:
+- gru_unit (test_gru_unit_op.py — including the reference's
+  h = u*c + (1-u)*h_prev update-gate convention and with/without bias)
+- lstm_unit (test_lstm_unit_op.py — i,f,o,j gate packing, forget_bias)
+- the full compare-op matrix (test_compare_op.py: 6 ops x int32/int64/
+  float32 x broadcast)
+- the logical-op matrix (test_logical_op.py)
+- expand expand_times grids (test_expand_op.py), pad rank-4
+  (test_pad_op.py), top_k k-grid (test_top_k_op.py), scale
+  bias/bias_after_scale, clip_by_norm under/over threshold
+- optimizer attr grids vs hand-stepped numpy: adam epsilon/betas,
+  rmsprop decay/epsilon, ftrl l1/l2/lr_power
+  (test_adam_op.py, test_rmsprop_op.py, test_ftrl_op.py)
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+from op_test import run_op, check_forward, check_grad_fd
+
+rng = np.random.RandomState(41)
+
+
+def sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+# ---------------------------------------------------------------------------
+# gru_unit — test_gru_unit_op.py
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_gru_unit_ref_config(with_bias):
+    b, d = 5, 4
+    x = rng.uniform(-0.1, 0.1, (b, 3 * d)).astype("float32")
+    hp = rng.uniform(-0.1, 0.1, (b, d)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (d, 3 * d)).astype("float32")
+    bias = rng.uniform(-0.1, 0.1, (1, 3 * d)).astype("float32")
+
+    g = x + (bias if with_bias else 0.0)
+    u_r = sigmoid(hp @ w[:, :2 * d] + g[:, :2 * d])
+    u, r = u_r[:, :d], u_r[:, d:]
+    c = np.tanh((r * hp) @ w[:, 2 * d:] + g[:, 2 * d:])
+    exp_h = u * c + (1 - u) * hp       # reference update-gate convention
+
+    ins = {"Input": x, "HiddenPrev": hp, "Weight": w}
+    if with_bias:
+        ins["Bias"] = bias
+    got = run_op("gru_unit", ins, out_slots=("Hidden",))
+    np.testing.assert_allclose(got[0], exp_h, rtol=1e-4, atol=1e-5)
+    check_grad_fd("gru_unit", ins, "Input", out_slots=("Hidden",))
+
+
+# ---------------------------------------------------------------------------
+# lstm_unit — test_lstm_unit_op.py (i, f, o, j packing)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("forget_bias", [0.0, 1.0])
+def test_lstm_unit_ref_config(forget_bias):
+    b, d = 5, 4
+    x = rng.randn(b, 4 * d).astype("float32")
+    cp = rng.randn(b, d).astype("float32")
+    i, f, o, j = np.split(x, 4, axis=1)
+    exp_c = cp * sigmoid(f + forget_bias) + sigmoid(i) * np.tanh(j)
+    exp_h = np.tanh(exp_c) * sigmoid(o)
+    got = run_op("lstm_unit", {"X": x, "C_prev": cp},
+                 {"forget_bias": forget_bias}, out_slots=("C", "H"))
+    np.testing.assert_allclose(got[0], exp_c, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got[1], exp_h, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# compare matrix — test_compare_op.py
+# ---------------------------------------------------------------------------
+
+CMP = {
+    "less_than": lambda a, b: a < b,
+    "less_equal": lambda a, b: a <= b,
+    "greater_than": lambda a, b: a > b,
+    "greater_equal": lambda a, b: a >= b,
+    "equal": lambda a, b: a == b,
+    "not_equal": lambda a, b: a != b,
+}
+
+
+@pytest.mark.parametrize("op", sorted(CMP))
+@pytest.mark.parametrize("dt", ["int32", "int64", "float32"])
+def test_compare_matrix(op, dt):
+    if dt.startswith("int"):
+        a = rng.randint(-3, 3, (4, 5)).astype(dt)
+        b = rng.randint(-3, 3, (4, 5)).astype(dt)
+    else:
+        a = rng.randn(4, 5).astype(dt)
+        b = np.where(rng.rand(4, 5) < 0.3, a, rng.randn(4, 5)).astype(dt)
+    got = run_op(op, {"X": a, "Y": b})[0]
+    exp = CMP[op](a, b)
+    assert np.asarray(got).dtype == np.dtype(bool)
+    np.testing.assert_array_equal(np.asarray(got), exp)
+
+
+LOGICAL = {
+    "logical_and": lambda a, b: a & b,
+    "logical_or": lambda a, b: a | b,
+    "logical_xor": lambda a, b: a ^ b,
+}
+
+
+@pytest.mark.parametrize("op", sorted(LOGICAL))
+def test_logical_matrix(op):
+    a = rng.rand(6, 3) < 0.5
+    b = rng.rand(6, 3) < 0.5
+    got = run_op(op, {"X": a, "Y": b})[0]
+    np.testing.assert_array_equal(np.asarray(got), LOGICAL[op](a, b))
+
+
+def test_logical_not():
+    a = rng.rand(6, 3) < 0.5
+    np.testing.assert_array_equal(
+        np.asarray(run_op("logical_not", {"X": a})[0]), ~a)
+
+
+# ---------------------------------------------------------------------------
+# expand / pad / top_k / scale / clip_by_norm grids
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape,times", [
+    ((2, 3), [2, 2]), ((1, 4), [3, 1]), ((2, 1, 3), [1, 4, 2])])
+def test_expand_times_grid(shape, times):
+    x = rng.randn(*shape).astype("float32")
+    exp = np.tile(x, times)
+    check_forward("expand", {"X": x}, exp, {"expand_times": list(times)})
+
+
+@pytest.mark.parametrize("paddings", [
+    [0, 1, 2, 3], [1, 0, 0, 2]])
+def test_pad_rank2_grid(paddings):
+    x = rng.randn(3, 4).astype("float32")
+    pw = [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    exp = np.pad(x, pw, constant_values=0.5)
+    check_forward("pad", {"X": x}, exp,
+                  {"paddings": paddings, "pad_value": 0.5})
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_top_k_grid(k):
+    x = rng.randn(4, 8).astype("float32")
+    vals, idx = run_op("topk", {"X": x}, {"k": k},
+                       out_slots=("Out", "Indices"))
+    exp_idx = np.argsort(-x, axis=1)[:, :k]
+    exp_vals = np.take_along_axis(x, exp_idx, axis=1)
+    np.testing.assert_allclose(vals, exp_vals, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx), exp_idx)
+
+
+@pytest.mark.parametrize("scale,bias,after", [
+    (2.0, 0.0, True), (0.5, 1.0, True), (0.5, 1.0, False)])
+def test_scale_bias_grid(scale, bias, after):
+    x = rng.randn(3, 4).astype("float32")
+    exp = x * scale + bias if after else (x + bias) * scale
+    check_forward("scale", {"X": x}, exp,
+                  {"scale": scale, "bias": bias,
+                   "bias_after_scale": after})
+
+
+@pytest.mark.parametrize("max_norm", [0.5, 100.0])
+def test_clip_by_norm_grid(max_norm):
+    x = rng.randn(4, 4).astype("float32")
+    norm = np.linalg.norm(x)
+    exp = x * (max_norm / norm) if norm > max_norm else x
+    check_forward("clip_by_norm", {"X": x}, exp, {"max_norm": max_norm},
+                  rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# optimizer attr grids vs hand-stepped numpy (adam/rmsprop/ftrl)
+# ---------------------------------------------------------------------------
+
+def _sgd_fixture():
+    """One fc param trained on a fixed quadratic; returns (run_fn, grads)
+    where run_fn(opt) -> param values after 3 steps."""
+    xs = rng.rand(6, 4).astype("f")
+    ys = rng.rand(6, 1).astype("f")
+
+    def run_with(opt_factory, steps=3):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(
+                input=x, size=1, bias_attr=False,
+                param_attr=fluid.ParamAttr(
+                    name="w_opt",
+                    initializer=fluid.initializer.Constant(0.25)))
+            loss = fluid.layers.mean(
+                x=fluid.layers.square_error_cost(input=pred, label=y))
+            opt_factory().minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(steps):
+                exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            return np.array(scope.find_var("w_opt").get_tensor())
+
+    def grad_at(w):
+        pred = xs @ w
+        return 2.0 * xs.T @ (pred - ys) / len(xs)
+
+    return run_with, grad_at
+
+
+@pytest.mark.parametrize("eps,b1,b2", [(1e-8, 0.9, 0.999),
+                                       (1e-4, 0.7, 0.8)])
+def test_adam_attr_grid(eps, b1, b2):
+    run_with, grad_at = _sgd_fixture()
+    got = run_with(lambda: fluid.optimizer.Adam(
+        learning_rate=0.1, beta1=b1, beta2=b2, epsilon=eps))
+    w = np.full((4, 1), 0.25, np.float64)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t in range(1, 4):
+        g = grad_at(w.astype("f")).astype(np.float64)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = 0.1 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(got, w, rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.parametrize("decay,eps,mom", [(0.9, 1e-6, 0.0),
+                                           (0.8, 1e-4, 0.5)])
+def test_rmsprop_attr_grid(decay, eps, mom):
+    run_with, grad_at = _sgd_fixture()
+    got = run_with(lambda: fluid.optimizer.RMSProp(
+        learning_rate=0.05, rho=decay, epsilon=eps, momentum=mom))
+    w = np.full((4, 1), 0.25, np.float64)
+    ms = np.zeros_like(w)
+    mo = np.zeros_like(w)
+    for _ in range(3):
+        g = grad_at(w.astype("f")).astype(np.float64)
+        ms = decay * ms + (1 - decay) * g * g
+        mo = mom * mo + 0.05 * g / np.sqrt(ms + eps)
+        w = w - mo
+    np.testing.assert_allclose(got, w, rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.parametrize("l1,l2,power", [(0.0, 0.0, -0.5),
+                                         (0.1, 0.2, -0.5)])
+def test_ftrl_attr_grid(l1, l2, power):
+    run_with, grad_at = _sgd_fixture()
+    got = run_with(lambda: fluid.optimizer.Ftrl(
+        learning_rate=0.1, l1=l1, l2=l2, lr_power=power))
+    lr = 0.1
+    w = np.full((4, 1), 0.25, np.float64)
+    sq = np.zeros_like(w)
+    lin = np.zeros_like(w)
+    for _ in range(3):
+        g = grad_at(w.astype("f")).astype(np.float64)
+        new_sq = sq + g * g
+        if power == -0.5:
+            sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / lr
+        else:
+            sigma = (new_sq ** -power - sq ** -power) / lr
+        lin = lin + g - sigma * w
+        sq = new_sq
+        if power == -0.5:
+            denom = np.sqrt(sq) / lr + 2 * l2
+        else:
+            denom = sq ** -power / lr + 2 * l2
+        pre = np.clip(lin, -l1, l1) - lin
+        w = np.where(np.abs(lin) > l1, pre / denom, np.zeros_like(w))
+    np.testing.assert_allclose(got, w, rtol=2e-3, atol=2e-4)

@@ -126,7 +126,7 @@ def _np_gru(seq, w, b, d, reverse, h0=None):
         xu = seq[t][:2 * d] + h @ w[:, :2 * d] + b[:2 * d]
         u, r = np.split(sigmoid(xu), 2)
         c = np.tanh(seq[t][2 * d:] + (r * h) @ w[:, 2 * d:] + b[2 * d:])
-        h = u * h + (1 - u) * c
+        h = u * c + (1 - u) * h   # reference: u weights the candidate
         hs[t] = h
     return hs
 
