@@ -285,3 +285,86 @@ def test_dynamic_rnn_static_input_and_memory_init():
             h = np.tanh(s[t] @ Wx + static[b] @ Us + h @ Vh)
         np.testing.assert_allclose(np.asarray(got)[b], h, rtol=1e-4,
                                    atol=1e-5)
+
+
+def test_dynamic_rnn_gradient_check_fd():
+    """Full numeric gradient verification through DynamicRNN (parity:
+    test_dynrnn_gradient_check.py) — analytic param/input grads vs central
+    finite differences of the scalar loss, on a ragged batch."""
+    B, D, H = 3, 3, 2
+    lengths = [3, 1, 2]
+    rng = np.random.RandomState(9)
+    seqs = [rng.randn(n, D).astype("float64") * 0.5 for n in lengths]
+
+    def build():
+        main, startup = fresh_programs()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[D], lod_level=1)
+            x.stop_gradient = False    # data vars default to no-grad
+            rnn = layers.DynamicRNN()
+            with rnn.block():
+                xt = rnn.step_input(x)
+                h = rnn.memory(shape=[H], value=0.0)
+                cat = layers.concat([xt, h], axis=1)
+                nh = layers.fc(input=cat, size=H, act="tanh",
+                               bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="drnn_w"))
+                rnn.update_memory(h, nh)
+                rnn.output(nh)
+            out = rnn()
+            final = layers.sequence_last_step(out)
+            loss = layers.mean(layers.reduce_sum(final, dim=[1]))
+            fluid.append_backward(loss)
+        return main, startup, loss
+
+    main, startup, loss = build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": fluid.LoDTensor.from_sequences(
+            [s.astype("float32") for s in seqs])}
+        w0 = np.asarray(scope.get("drnn_w")).copy()
+
+        def loss_at(w):
+            scope.set("drnn_w", w.astype("float32"))
+            l, = exe.run(main, feed=feed, fetch_list=[loss])
+            return float(np.ravel(l)[0])
+
+        _, grad, xgrad = exe.run(main, feed=feed,
+                                 fetch_list=[loss, "drnn_w@GRAD",
+                                             "x@GRAD"])
+        eps = 1e-3
+        fd = np.zeros_like(w0, dtype=np.float64)
+        it = np.nditer(w0, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            for sgn in (+1, -1):
+                w = w0.astype(np.float64).copy()
+                w[idx] += sgn * eps
+                fd[idx] += sgn * loss_at(w)
+            fd[idx] /= 2 * eps
+            it.iternext()
+        scope.set("drnn_w", w0)
+        np.testing.assert_allclose(np.asarray(grad), fd, rtol=3e-2,
+                                   atol=3e-3)
+
+        # input gradient: perturb one timestep of one sequence at a time
+        def loss_at_x(new_seqs):
+            l, = exe.run(main, feed={"x": fluid.LoDTensor.from_sequences(
+                [s.astype("float32") for s in new_seqs])},
+                fetch_list=[loss])
+            return float(np.ravel(l)[0])
+
+        xg = np.asarray(xgrad)
+        for b in (0, 2):
+            for t_i in range(lengths[b]):
+                for d_i in range(D):
+                    acc = 0.0
+                    for sgn in (+1, -1):
+                        pert = [s.copy() for s in seqs]
+                        pert[b][t_i, d_i] += sgn * eps
+                        acc += sgn * loss_at_x(pert)
+                    np.testing.assert_allclose(
+                        xg[b, t_i, d_i], acc / (2 * eps), rtol=3e-2,
+                        atol=3e-3)

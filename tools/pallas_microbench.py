@@ -38,7 +38,7 @@ def _time(fn, *args, iters=20, warmup=3):
     return (time.perf_counter() - t0) / iters * 1e3
 
 
-def _attention_setup(jax, b, t, h, d, causal, dtype):
+def _attention_setup(b, t, h, d, causal, dtype):
     """Shared q/k/v construction + dense baseline so bench_attention and
     tune_attention_blocks stay comparable by construction."""
     import jax.numpy as jnp
@@ -62,8 +62,7 @@ def bench_attention(b=8, t=2048, h=8, d=64, causal=True, dtype="bfloat16"):
     import jax.numpy as jnp
     from paddle_tpu.ops import pallas_kernels as pk
 
-    q, k, v, _, dense_loss = _attention_setup(jax, b, t, h, d, causal,
-                                              dtype)
+    q, k, v, _, dense_loss = _attention_setup(b, t, h, d, causal, dtype)
 
     def flash_loss(q, k, v):
         return jnp.sum(pk.flash_attention(q, k, v, causal=causal)
@@ -118,8 +117,8 @@ def tune_attention_blocks(b=8, t=2048, h=8, d=64, causal=True,
     import jax.numpy as jnp
     from paddle_tpu.ops import pallas_kernels as pk
 
-    q, k, v, dense_fwd, dense_loss = _attention_setup(jax, b, t, h, d,
-                                                      causal, dtype)
+    q, k, v, dense_fwd, dense_loss = _attention_setup(b, t, h, d, causal,
+                                                      dtype)
     dense_f = jax.jit(dense_fwd)
     dense_g = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))
     dfms = _time(dense_f, q, k, v)
