@@ -168,6 +168,9 @@ def load_reference_model(dirname, executor, model_filename=None):
     blocks = rf._parse_blocks(raw)  # one wire decode for both consumers
     program = rf.parse_program_desc(blocks)
     feed_names, fetch_names = rf.strip_feed_fetch(blocks)
+    # flat-LoD-rows -> padded-dense rewiring (sequence models: lstm/gru/
+    # sequence_* ops gain @SEQLEN companions, mul/elementwise gain a rank)
+    rf.adapt_sequence_layout(program, feed_names)
 
     scope = global_scope()
     for v in program.list_vars():

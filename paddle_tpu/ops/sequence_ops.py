@@ -290,7 +290,8 @@ def _lstm(ctx, ins, attrs):
     """dynamic_lstm: input [B, T, 4D] (pre-projected by an fc), weight
     [D, 4D] recurrent, bias [1, 4D] (+[1, 3D] peepholes if use_peepholes).
 
-    Gate order (reference lstm_op): input, forget, cell(candidate), output.
+    Gate order (reference lstm_op.cc:125 {W_ch, W_ih, W_fh, W_oh}):
+    candidate, input, forget, output.
     """
     x = single(ins, "Input")       # [B, T, 4D]
     w = single(ins, "Weight")      # [D, 4D]
@@ -333,7 +334,9 @@ def _lstm(ctx, ins, attrs):
         h_prev, c_prev = carry
         xt, mt = inp
         gates = xt + rmat(h_prev) + gate_bias       # [B, 4D]
-        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        # reference weight layout lstm_op.cc:125 "{W_ch, W_ih, W_fh,
+        # W_oh}" — CANDIDATE block first (kernel order in, ig, fg, og)
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
         if use_peep:
             gi = gi + c_prev * w_ic
             gf = gf + c_prev * w_fc
@@ -411,7 +414,9 @@ def _lstmp(ctx, ins, attrs):
         r_prev, c_prev = carry
         xt, mt = inp
         gates = xt + rmat2(r_prev, w) + gate_bias    # [B, 4D]
-        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        # reference weight layout lstm_op.cc:125 "{W_ch, W_ih, W_fh,
+        # W_oh}" — CANDIDATE block first (kernel order in, ig, fg, og)
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
         if use_peep:
             gi = gi + c_prev * w_ic
             gf = gf + c_prev * w_fc
@@ -521,7 +526,8 @@ def _lstm_unit(ctx, ins, attrs):
     c_prev = single(ins, "C_prev")
     forget_bias = attrs.get("forget_bias", 0.0)
     # reference lstm_unit_op.h packs gates i, f, o, j — candidate LAST
-    # (unlike lstm_op's i, f, c, o) — order matters for loaded weights
+    # (unlike lstm_op's candidate-FIRST {W_ch, W_ih, W_fh, W_oh}) —
+    # order matters for loaded weights
     gi, gf, go, gj = jnp.split(x, 4, axis=-1)
     i = jax.nn.sigmoid(gi)
     f = jax.nn.sigmoid(gf + forget_bias)

@@ -17,9 +17,10 @@ import struct
 
 import numpy as np
 
-from .core.framework import Program
+from .core.framework import Block, Program
 
 __all__ = ["parse_program_desc", "read_lod_tensor_file",
+           "adapt_sequence_layout",
            "strip_feed_fetch"]
 
 
@@ -335,3 +336,111 @@ def read_lod_tensor_file(path):
     pos += desc_size
     arr = np.frombuffer(buf, np.dtype(dtype), offset=pos).reshape(dims)
     return arr, lod
+
+
+# ---------------------------------------------------------------------------
+# layout adaptation: flat LoD rows -> padded-dense + @SEQLEN companions
+# ---------------------------------------------------------------------------
+
+# recurrences: attach XLen to Input; sequence-shaped outputs keep the
+# segmentation via the generic propagation rule below
+_RECURRENT = frozenset(("lstm", "lstmp", "gru"))
+
+
+def adapt_sequence_layout(program, feed_names):
+    """Rewire a loaded reference program from the flat-LoD-rows layout to
+    the padded-dense layout (SURVEY §6.3), in place.
+
+    The reference addresses a lod_level-1 tensor as [total_rows, D] and
+    carries the segmentation out of band (LoD offsets in the runtime
+    tensor). Here the same variable is [num_seqs, max_len, D] plus an
+    int32 ``name@SEQLEN`` lengths companion that the Executor feeds
+    automatically for LoDTensor feeds. Three rewrites follow from that:
+
+    - row-semantics ops gain a rank: ``mul`` x_num_col_dims += 1, and the
+      broadcast/concat axis of ``elementwise_*``/``concat`` += 1 when the
+      data is sequence-shaped (a program built through our own layers
+      encodes the same thing as fc(num_flatten_dims=2) — layers/nn.py);
+    - sequence/recurrence ops (lstm/lstmp/gru/sequence_*) get their
+      ``XLen``/``YLen`` input wired to the segmentation companion;
+    - segmentation PROPAGATES by the same generic rule Block.append_op
+      applies to layer-built programs: every op except the
+      ``_LOD_CLEARING_OPS`` (sequence_pool & co) hands its first
+      sequence-input's lengths to its outputs — one shared invariant,
+      not a second allowlist.
+
+    Cites: lod_tensor.md design + lstm_op.cc (the era's in-op LoD walk
+    this replaces). Known limit: ``concat`` with axis=0 on sequence data
+    (time-axis concat, i.e. sequence_concat semantics) is not rewritten.
+    """
+    block = program.global_block()
+    seqlen = {}
+
+    def ensure_len_var(name):
+        ln = name + "@SEQLEN"
+        if ln not in block.vars:
+            v = block.create_var(name=ln, shape=(-1,), dtype="int32")
+            v.stop_gradient = True
+        return ln
+
+    for name in feed_names:
+        v = block.vars.get(name)
+        if v is not None and getattr(v, "lod_level", 0):
+            seqlen[name] = ensure_len_var(name)
+
+    def first(slot_map, slot):
+        names = slot_map.get(slot) or []
+        return names[0] if names else None
+
+    for op in block.ops:
+        t = op.type
+        ins_names = [n for ns in op.inputs.values() for n in ns if n]
+        # --- op-specific rank/wiring rewrites --------------------------
+        if t == "mul" and first(op.inputs, "X") in seqlen:
+            op.attrs["x_num_col_dims"] = \
+                op.attrs.get("x_num_col_dims", 1) + 1
+        elif t.startswith("elementwise_"):
+            x, y = first(op.inputs, "X"), first(op.inputs, "Y")
+            if x in seqlen and y not in seqlen:
+                ax = op.attrs.get("axis", -1)
+                if ax >= 1:
+                    op.attrs["axis"] = ax + 1
+        elif t == "concat":
+            if any(n in seqlen for n in op.inputs.get("X", ()) or ()):
+                ax = op.attrs.get("axis", 0)
+                if ax >= 1:
+                    op.attrs["axis"] = ax + 1
+        elif t in _RECURRENT:
+            inp = first(op.inputs, "Input")
+            if inp in seqlen:
+                op.inputs["XLen"] = [seqlen[inp]]
+        elif t in ("sequence_pool", "sequence_last_step",
+                   "sequence_first_step", "sequence_softmax",
+                   "sequence_conv"):
+            x = first(op.inputs, "X")
+            if x in seqlen:
+                op.inputs["XLen"] = [seqlen[x]]
+        elif t == "sequence_expand":
+            y = first(op.inputs, "Y")
+            if y in seqlen:
+                op.inputs["YLen"] = [seqlen[y]]
+                for o in op.outputs.get("Out", ()) or ():
+                    if o:   # expand follows Y's lengths, not X's
+                        seqlen[o] = seqlen[y]
+        # --- generic segmentation propagation (Block.append_op's rule:
+        #     first sequence input wins, clearing ops consume) ----------
+        if t not in Block._LOD_CLEARING_OPS:
+            src = next((n for n in ins_names if n in seqlen), None)
+            if src is not None:
+                for ns in op.outputs.values():
+                    for o in ns:
+                        if o and o not in seqlen:
+                            seqlen[o] = seqlen[src]
+
+    for name, ln in seqlen.items():
+        v = block.vars.get(name)
+        if v is not None:
+            if not getattr(v, "lod_level", 0):
+                v.lod_level = 1
+            v.seq_len_var = ln
+    return program

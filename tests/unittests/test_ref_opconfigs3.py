@@ -65,7 +65,7 @@ def _np_lstm_act(seq, w, b, d, gate, cell, cand, reverse):
     steps = range(len(seq) - 1, -1, -1) if reverse else range(len(seq))
     for t in steps:
         g = seq[t] + h @ w + b
-        gi, gf, gc, go = np.split(g, 4)
+        gc, gi, gf, go = np.split(g, 4)
         i, f = ACT[gate](gi), ACT[gate](gf)
         c = f * c + i * ACT[cand](gc)
         h = ACT[gate](go) * ACT[cell](c)

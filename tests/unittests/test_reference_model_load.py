@@ -308,3 +308,232 @@ def test_load_reference_conv_model(reference_conv_model_dir):
     e = np.exp(logits - logits.max(1, keepdims=True))
     exp = e / e.sum(1, keepdims=True)
     np.testing.assert_allclose(np.asarray(out), exp, rtol=2e-4, atol=1e-5)
+
+
+# --- reference-era SEQUENCE model: lookup_table -> fc -> lstm -> pool ------
+
+@pytest.fixture
+def reference_lstm_model_dir(tmp_path):
+    """A reference-era sentiment-style inference model: int64 word ids
+    (lod) -> lookup_table -> fc (mul x_num_col_dims=1 + bias axis=1,
+    the FLAT-rows convention) -> lstm ({W_ch, W_ih, W_fh, W_oh} packed
+    weights, lstm_op.cc:125) -> sequence_pool MAX -> fc -> softmax.
+
+    Exercises adapt_sequence_layout end to end: the loaded program must
+    gain @SEQLEN wiring, rank-shifted mul/elementwise attrs, and produce
+    the numpy reference computed with the reference's own conventions."""
+    V, E, H, C = 10, 4, 3, 3
+    rng = np.random.RandomState(13)
+    emb = (rng.randn(V, E) * 0.5).astype("float32")
+    fcw = (rng.randn(E, 4 * H) * 0.4).astype("float32")
+    fcb = (rng.randn(4 * H) * 0.2).astype("float32")
+    lw = (rng.randn(H, 4 * H) * 0.4).astype("float32")
+    lb = (rng.randn(1, 4 * H) * 0.2).astype("float32")
+    f2w = (rng.randn(H, C) * 0.5).astype("float32")
+    f2b = (rng.randn(C) * 0.2).astype("float32")
+
+    varz = [
+        var_desc("feed", 0, [], var_type=9),
+        var_desc("fetch", 0, [], var_type=10),
+        var_desc("words", 3, [-1, 1], lod_level=1),
+        var_desc("emb.w", 5, [V, E], persistable=True),
+        var_desc("emb.tmp", 5, [-1, E], lod_level=1),
+        var_desc("fc.w", 5, [E, 4 * H], persistable=True),
+        var_desc("fc.b", 5, [4 * H], persistable=True),
+        var_desc("fc.tmp0", 5, [-1, 4 * H], lod_level=1),
+        var_desc("fc.tmp1", 5, [-1, 4 * H], lod_level=1),
+        var_desc("lstm.w", 5, [H, 4 * H], persistable=True),
+        var_desc("lstm.b", 5, [1, 4 * H], persistable=True),
+        var_desc("lstm.h", 5, [-1, H], lod_level=1),
+        var_desc("lstm.c", 5, [-1, H], lod_level=1),
+        var_desc("pool.tmp", 5, [-1, H]),
+        var_desc("fc2.tmp0", 5, [-1, C]),
+        var_desc("fc2.tmp1", 5, [-1, C]),
+        var_desc("prob", 5, [-1, C]),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["words"])],
+                [attr("col", 0, 0)]),
+        op_desc("lookup_table", [("W", ["emb.w"]), ("Ids", ["words"])],
+                [("Out", ["emb.tmp"])]),
+        op_desc("mul", [("X", ["emb.tmp"]), ("Y", ["fc.w"])],
+                [("Out", ["fc.tmp0"])],
+                [attr("x_num_col_dims", 0, 1),
+                 attr("y_num_col_dims", 0, 1)]),
+        op_desc("elementwise_add",
+                [("X", ["fc.tmp0"]), ("Y", ["fc.b"])],
+                [("Out", ["fc.tmp1"])], [attr("axis", 0, 1)]),
+        op_desc("lstm",
+                [("Input", ["fc.tmp1"]), ("Weight", ["lstm.w"]),
+                 ("Bias", ["lstm.b"])],
+                [("Hidden", ["lstm.h"]), ("Cell", ["lstm.c"])],
+                [attr("use_peepholes", 6, False),
+                 attr("is_reverse", 6, False),
+                 attr("gate_activation", 2, "sigmoid"),
+                 attr("cell_activation", 2, "tanh"),
+                 attr("candidate_activation", 2, "tanh")]),
+        op_desc("sequence_pool", [("X", ["lstm.h"])],
+                [("Out", ["pool.tmp"])], [attr("pooltype", 2, "MAX")]),
+        op_desc("mul", [("X", ["pool.tmp"]), ("Y", ["fc2.w"])],
+                [("Out", ["fc2.tmp0"])],
+                [attr("x_num_col_dims", 0, 1),
+                 attr("y_num_col_dims", 0, 1)]),
+        op_desc("elementwise_add",
+                [("X", ["fc2.tmp0"]), ("Y", ["fc2.b"])],
+                [("Out", ["fc2.tmp1"])], [attr("axis", 0, 1)]),
+        op_desc("softmax", [("X", ["fc2.tmp1"])], [("Out", ["prob"])]),
+        op_desc("fetch", [("X", ["prob"])], [("Out", ["fetch"])],
+                [attr("col", 0, 0)]),
+    ]
+    varz.insert(10, var_desc("fc2.w", 5, [H, C], persistable=True))
+    varz.insert(11, var_desc("fc2.b", 5, [C], persistable=True))
+    program_bytes = _ld(1, block_desc(0, -1, varz, ops))
+
+    d = tmp_path / "ref_lstm_model"
+    d.mkdir()
+    (d / "__model__").write_bytes(program_bytes)
+    for name, arr in [("emb.w", emb), ("fc.w", fcw), ("fc.b", fcb),
+                      ("lstm.w", lw), ("lstm.b", lb), ("fc2.w", f2w),
+                      ("fc2.b", f2b)]:
+        lod_tensor_file(str(d / name), arr)
+    return str(d), (emb, fcw, fcb, lw, lb, f2w, f2b)
+
+
+def _np_reference_lstm_model(seq_ids, params):
+    emb, fcw, fcb, lw, lb, f2w, f2b = params
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    H = lw.shape[0]
+    x = emb[seq_ids] @ fcw + fcb                  # [L, 4H]
+    h = np.zeros(H)
+    c = np.zeros(H)
+    hs = []
+    for t in range(len(seq_ids)):
+        g = x[t] + h @ lw + lb.ravel()
+        gc, gi, gf, go = np.split(g, 4)           # candidate FIRST
+        c = sig(gf) * c + sig(gi) * np.tanh(gc)
+        h = sig(go) * np.tanh(c)
+        hs.append(h)
+    pooled = np.max(np.stack(hs), axis=0)
+    logits = pooled @ f2w + f2b
+    e = np.exp(logits - logits.max())
+    return e / e.sum()
+
+
+def test_load_reference_lstm_model(reference_lstm_model_dir):
+    from paddle_tpu.core.lod import LoDTensor
+
+    dirname, params = reference_lstm_model_dir
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        program, feeds, fetches = fluid.io.load_reference_model(
+            dirname, exe)
+        assert feeds == ["words"]
+        rng = np.random.RandomState(3)
+        lens = [4, 2, 5]
+        seqs = [rng.randint(0, 10, (n, 1)).astype("int64") for n in lens]
+        out, = exe.run(program,
+                       feed={"words": LoDTensor.from_sequences(seqs)},
+                       fetch_list=fetches)
+        out = np.asarray(out)
+        assert out.shape == (3, 3)
+        for i, s in enumerate(seqs):
+            exp = _np_reference_lstm_model(s.ravel(), params)
+            np.testing.assert_allclose(out[i], exp, rtol=1e-4, atol=1e-5)
+
+
+def test_load_reference_bidirectional_lstm_concat(tmp_path):
+    """Era-typical bidirectional stack: forward lstm + is_reverse lstm ->
+    concat(axis=1, the FLAT-rows feature axis) -> sequence_pool LAST.
+    Exercises the generic segmentation propagation through concat and the
+    concat-axis rank shift (review r4 finding)."""
+    from paddle_tpu.core.lod import LoDTensor
+
+    E, H = 3, 2
+    rng = np.random.RandomState(21)
+    lw_f = (rng.randn(H, 4 * H) * 0.4).astype("float32")
+    lw_b = (rng.randn(H, 4 * H) * 0.4).astype("float32")
+    zb = np.zeros((1, 4 * H), dtype="float32")
+
+    varz = [
+        var_desc("feed", 0, [], var_type=9),
+        var_desc("fetch", 0, [], var_type=10),
+        var_desc("x", 5, [-1, 4 * H], lod_level=1),
+        var_desc("lstm_f.w", 5, [H, 4 * H], persistable=True),
+        var_desc("lstm_f.b", 5, [1, 4 * H], persistable=True),
+        var_desc("lstm_b.w", 5, [H, 4 * H], persistable=True),
+        var_desc("lstm_b.b", 5, [1, 4 * H], persistable=True),
+        var_desc("h_f", 5, [-1, H], lod_level=1),
+        var_desc("c_f", 5, [-1, H], lod_level=1),
+        var_desc("h_b", 5, [-1, H], lod_level=1),
+        var_desc("c_b", 5, [-1, H], lod_level=1),
+        var_desc("cat", 5, [-1, 2 * H], lod_level=1),
+        var_desc("last", 5, [-1, 2 * H]),
+    ]
+
+    def lstm_op(name, win, bin_, hout, cout, reverse):
+        return op_desc(
+            "lstm", [("Input", ["x"]), ("Weight", [win]), ("Bias", [bin_])],
+            [("Hidden", [hout]), ("Cell", [cout])],
+            [attr("use_peepholes", 6, False),
+             attr("is_reverse", 6, reverse),
+             attr("gate_activation", 2, "sigmoid"),
+             attr("cell_activation", 2, "tanh"),
+             attr("candidate_activation", 2, "tanh")])
+
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["x"])],
+                [attr("col", 0, 0)]),
+        lstm_op("f", "lstm_f.w", "lstm_f.b", "h_f", "c_f", False),
+        lstm_op("b", "lstm_b.w", "lstm_b.b", "h_b", "c_b", True),
+        op_desc("concat", [("X", ["h_f", "h_b"])], [("Out", ["cat"])],
+                [attr("axis", 0, 1)]),
+        op_desc("sequence_pool", [("X", ["cat"])], [("Out", ["last"])],
+                [attr("pooltype", 2, "LAST")]),
+        op_desc("fetch", [("X", ["last"])], [("Out", ["fetch"])],
+                [attr("col", 0, 0)]),
+    ]
+    d = tmp_path / "ref_bilstm"
+    d.mkdir()
+    (d / "__model__").write_bytes(_ld(1, block_desc(0, -1, varz, ops)))
+    for name, arr in [("lstm_f.w", lw_f), ("lstm_f.b", zb),
+                      ("lstm_b.w", lw_b), ("lstm_b.b", zb)]:
+        lod_tensor_file(str(d / name), arr)
+
+    def np_lstm(seq, w, reverse):
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+        h = np.zeros(H)
+        c = np.zeros(H)
+        hs = np.zeros((len(seq), H))
+        order = range(len(seq) - 1, -1, -1) if reverse else range(len(seq))
+        for t in order:
+            g = seq[t] + h @ w
+            gc, gi, gf, go = np.split(g, 4)
+            c = sig(gf) * c + sig(gi) * np.tanh(gc)
+            h = sig(go) * np.tanh(c)
+            hs[t] = h
+        return hs
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        program, feeds, fetches = fluid.io.load_reference_model(str(d), exe)
+        lens = [3, 5]
+        seqs = [rng.randn(n, 4 * H).astype("float32") * 0.5 for n in lens]
+        out, = exe.run(program,
+                       feed={"x": LoDTensor.from_sequences(seqs)},
+                       fetch_list=fetches)
+        out = np.asarray(out)
+        assert out.shape == (2, 2 * H)
+        for i, s in enumerate(seqs):
+            hf = np_lstm(s.astype(np.float64), lw_f.astype(np.float64),
+                         False)
+            hb = np_lstm(s.astype(np.float64), lw_b.astype(np.float64),
+                         True)
+            exp_last = np.concatenate([hf[-1], hb[-1]])
+            np.testing.assert_allclose(out[i], exp_last, rtol=1e-4,
+                                       atol=1e-5)

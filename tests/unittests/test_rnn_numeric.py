@@ -5,7 +5,7 @@ Parity model: the reference's test_lstm_op.py / test_gru_op.py
 (python/paddle/fluid/tests/unittests/) recompute the recurrence in numpy per
 LoD sequence and compare; we do the same through the real layer + executor
 path on a ragged batch, covering peepholes, is_reverse, h0/c0 and both gate
-orders of the packed weights (lstm_op: i,f,c,o; gru_op: [update|reset|cand]).
+orders of the packed weights (lstm_op: c,i,f,o per {W_ch, W_ih, W_fh, W_oh}; gru_op: [update|reset|cand]).
 """
 import numpy as np
 import pytest
@@ -42,7 +42,7 @@ def _np_lstm(seq, w, b, d, use_peep, reverse, h0=None, c0=None):
     hs, cs = np.zeros((len(seq), d)), np.zeros((len(seq), d))
     for t in steps:
         g = seq[t] + h @ w + gate_b
-        gi, gf, gc, go = np.split(g, 4)
+        gc, gi, gf, go = np.split(g, 4)
         if use_peep:
             gi = gi + c * w_ic
             gf = gf + c * w_fc
@@ -217,7 +217,7 @@ def test_dynamic_lstmp_projection():
     c = np.zeros(d)
     x64 = seqs[0].astype(np.float64)
     for t in range(3):
-        gi, gf, gc, go = np.split(x64[t] + r @ w.astype(np.float64), 4)
+        gc, gi, gf, go = np.split(x64[t] + r @ w.astype(np.float64), 4)
         c = sig(gf) * c + sig(gi) * np.tanh(gc)
         h = sig(go) * np.tanh(c)
         r = np.tanh(h @ proj_w.astype(np.float64))

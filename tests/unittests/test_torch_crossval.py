@@ -115,8 +115,9 @@ def test_warpctc_vs_torch_ctc_loss():
 
 def test_lstm_vs_torch():
     """dynamic_lstm (no peepholes) vs torch.nn.LSTM on one full-length
-    batch. Gate-order mapping: fluid packs [i,f,c,o]; torch packs
-    [i,f,g,o] as rows of weight_ih/hh — same order, different layout
+    batch. Gate-order mapping: fluid packs [c,i,f,o] (lstm_op.cc:125
+    {W_ch, W_ih, W_fh, W_oh}); torch packs [i,f,g,o] as rows of
+    weight_ih/hh — torch gate r reads fluid slice order[r] below
     (fluid: x pre-projected, recurrent w [D,4D] column-blocks; torch:
     weight_hh [4D, D] row-blocks)."""
     d = 4
@@ -151,17 +152,18 @@ def test_lstm_vs_torch():
     # torch LSTM with identity input projection (input = pre-projected x)
     lstm = torch.nn.LSTM(input_size=4 * d, hidden_size=d, batch_first=True)
     with torch.no_grad():
-        # fluid gates [i,f,c,o] on columns of [D,4D]; torch rows of [4D,*]
-        # in order i,f,g,o — both use g=tanh candidate, same equations
+        # fluid gates [c,i,f,o] on columns of [D,4D]; torch rows of [4D,*]
+        # in order i,f,g,o — torch gate r maps to fluid slice order[r]
+        order = [1, 2, 0, 3]          # i<-1, f<-2, g(cand)<-0, o<-3
         wi = np.zeros((4 * d, 4 * d), dtype="float32")
-        for k in range(4):   # identity for each gate's slice
-            wi[k * d:(k + 1) * d, k * d:(k + 1) * d] = np.eye(d)
+        for r, k in enumerate(order):
+            wi[r * d:(r + 1) * d, k * d:(k + 1) * d] = np.eye(d)
         lstm.weight_ih_l0.copy_(torch.from_numpy(wi))
         lstm.weight_hh_l0.copy_(torch.from_numpy(
-            np.concatenate([w[:, k * d:(k + 1) * d].T for k in range(4)],
+            np.concatenate([w[:, k * d:(k + 1) * d].T for k in order],
                            axis=0)))
         lstm.bias_ih_l0.copy_(torch.from_numpy(
-            np.concatenate([b[k * d:(k + 1) * d] for k in range(4)])))
+            np.concatenate([b[k * d:(k + 1) * d] for k in order])))
         lstm.bias_hh_l0.zero_()
         ref, _ = lstm(torch.from_numpy(xs))
     np.testing.assert_allclose(got[:, :T], ref.detach().numpy(),

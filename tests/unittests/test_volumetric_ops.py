@@ -201,7 +201,7 @@ def np_lstmp(x, w, w_proj, bias, lens, use_peep, is_rev):
     for step in order:
         mt = (step < lens).astype(np.float64)[:, None]
         gates = x[:, step] + r @ w + gb
-        gi, gf, gc, go = np.split(gates, 4, axis=-1)
+        gc, gi, gf, go = np.split(gates, 4, axis=-1)
         if use_peep:
             gi = gi + c * w_ic
             gf = gf + c * w_fc
