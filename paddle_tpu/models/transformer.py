@@ -237,12 +237,17 @@ def make_inputs(max_length, n_head, fused=False):
 def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
                 n_head=4, d_key=16, d_value=16, d_model=64, d_inner_hid=128,
                 dropout_rate=0.0, label_smooth_eps=0.0,
-                use_fused_attention=False):
+                use_fused_attention=False, use_fused_label_smooth=True):
     """Build the training graph; returns (sum_cost, avg_cost, predict).
 
     use_fused_attention: every attention core runs the pallas flash kernel
     (padding via src_len/trg_len feeds, decoder causality via the kernel's
-    causal block-skipping). Requires dropout_rate == 0."""
+    causal block-skipping). Requires dropout_rate == 0.
+
+    use_fused_label_smooth: compute uniform label smoothing by exact
+    decomposition ((1-eps)*nll + eps*(lse - mean logits)) instead of the
+    dense [N, vocab] smoothed-label + soft-softmax path — numerically
+    identical, HBM-free at 30k vocab."""
     if use_fused_attention:
         if dropout_rate:
             raise ValueError("use_fused_attention requires dropout_rate=0 "
@@ -277,7 +282,24 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
                               bias_attr=False, num_flatten_dims=2)
     predict_2d = fluid.layers.reshape(predict, shape=[-1, trg_vocab_size])
     lbl_flat = fluid.layers.reshape(lbl_word, shape=[-1, 1])
-    if label_smooth_eps:
+    if label_smooth_eps and use_fused_label_smooth:
+        # exact decomposition of uniform label smoothing: with
+        # lse = logit_label + nll,
+        #   -(sum smoothed*logp) = (1-eps)*nll + eps*(lse - sum(logits)/V)
+        #                        = nll + eps*(logit_label - sum(logits)/V).
+        # Avoids BOTH [N, V] dense materializations of the naive path —
+        # the smoothed label matrix and the soft-label softmax — and keeps
+        # the hard-label fused pallas xent kernel engaged. Gradient
+        # (1-eps)*(p - onehot) + eps*(p - 1/V) falls out of the vjp.
+        nll = fluid.layers.softmax_with_cross_entropy(
+            logits=predict_2d, label=lbl_flat)
+        logit_lbl = fluid.layers.reduce_sum(
+            fluid.layers.one_hot(lbl_flat, depth=trg_vocab_size)
+            * predict_2d, dim=1, keep_dim=True)
+        cost = nll + label_smooth_eps * (
+            logit_lbl - fluid.layers.reduce_sum(
+                predict_2d, dim=1, keep_dim=True) / float(trg_vocab_size))
+    elif label_smooth_eps:
         smoothed = fluid.layers.label_smooth(
             fluid.layers.one_hot(lbl_flat, depth=trg_vocab_size),
             epsilon=label_smooth_eps)

@@ -314,3 +314,40 @@ def test_cached_decode_matches_full_decode():
     np.testing.assert_array_equal(np.asarray(g_ids), np.asarray(f_ids))
     np.testing.assert_allclose(np.asarray(g_sc), np.asarray(f_sc),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_fused_label_smooth_matches_dense_path():
+    """The decomposed uniform label smoothing ((1-eps)*nll + eps*(lse -
+    mean logits)) must equal the dense smoothed-label soft-xent path
+    bit-for-tolerance, including through training (gradients)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    def run(fused_ls):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), \
+                fluid.program_guard(main, startup):
+            sum_cost, avg_cost, _ = transformer.build_train(
+                src_vocab_size=37, trg_vocab_size=37, max_length=12,
+                n_layer=1, n_head=2, d_key=8, d_value=8, d_model=16,
+                d_inner_hid=32, label_smooth_eps=0.1,
+                use_fused_label_smooth=fused_ls)
+        rng = np.random.RandomState(4)
+        srcs = [rng.randint(3, 37, rng.randint(4, 10)).tolist()
+                for _ in range(6)]
+        feed = transformer.prepare_batch(srcs, srcs, 12, 2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        out = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(3):
+                s, a = exe.run(main, feed=feed,
+                               fetch_list=[sum_cost, avg_cost])
+                out.append((float(np.ravel(s)[0]), float(np.ravel(a)[0])))
+        return out
+
+    dense = run(False)
+    fused = run(True)
+    np.testing.assert_allclose(dense, fused, rtol=2e-5, atol=1e-6)

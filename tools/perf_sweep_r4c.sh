@@ -1,6 +1,8 @@
 #!/bin/bash
 # Round-4 third-window sweep: everything still unmeasured after the 03:15Z
-# window. Cheapest-first; ONE client at a time via tools/tpu_lock.sh;
+# window. SUPERSEDES perf_sweep.sh / perf_sweep_r4b.sh (historical records
+# of earlier windows — do not re-run them; this copy carries the harness
+# fixes: rc-gated banking, probe-before-recovery-log). Cheapest-first; ONE client at a time via tools/tpu_lock.sh;
 # stderr kept per run. New since the last window: pallas flash BACKWARD
 # kernels (dK/dV + dQ, causal skipping) and segment-level remat replaced
 # the per-op jax.checkpoint that OOM'd at 29G.
@@ -47,21 +49,26 @@ run() {  # run <timeout_s> ENV=V...
   fi
   line=$(tail -1 /tmp/bench_run.out)
   echo "$line" | tee -a $LOG
+  # rc gates banking: a timeout-killed run's last stdout line must never
+  # be banked as a measurement (r4c review finding)
+  if [ $rc -ne 0 ]; then
+    line='{"error": "rc='$rc'"}'"$line"
+  fi
   case "$line" in
     *'"error"'*|"")
       echo "- $(date -u +%FT%TZ) FAILED(rc=$rc, err=/tmp/bench_err_c$N.log): $*" >> BENCH_LOG.md
       tail -3 /tmp/bench_err_c$N.log >> $LOG
       case "$line" in
         *"device init"*) WEDGED=1 ;;
-        "") tunnel_ok || WEDGED=1 ;;
+        *) tunnel_ok || WEDGED=1 ;;
       esac ;;
     *) printf -- '- %s `%s`\n  `%s`\n' "$(date -u +%FT%TZ)" "$*" "$line" \
          >> BENCH_LOG.md
        bank ;;
   esac
 }
-echo "- $(date -u +%FT%TZ) TUNNEL RECOVERED; r4c sweep starts" >> BENCH_LOG.md
 probe || exit 1
+echo "- $(date -u +%FT%TZ) TUNNEL RECOVERED; r4c sweep starts" >> BENCH_LOG.md
 # tier 1: cheap re-measures through the NEW flash backward kernels
 run 900 BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
 probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2
