@@ -111,12 +111,37 @@ def run(handle, names, buffers, shapes):
     each feed var's DECLARED dtype; shapes: per-feed int lists. Executes
     and retains every fetch target (read back via output_*). Returns the
     number of outputs."""
+    return run_lod(handle, names, buffers, shapes, [()] * len(names))
+
+
+def run_lod(handle, names, buffers, shapes, lods):
+    """Like run(), plus per-feed sequence lengths (era paddle_arguments'
+    sequence_start_positions, as lengths): a feed with a non-empty lods
+    entry carries FLAT rows ([total, D], the reference serving layout) and
+    is re-segmented into a LoDTensor; an empty entry is a dense feed."""
+    from .core.lod import LoDTensor
+
     p = _predictors[handle]
     feed = {}
-    for name, buf, shape in zip(names, buffers, shapes):
+    for name, buf, shape, lens in zip(names, buffers, shapes, lods):
         dt = np.dtype(_feed_dtype(p, name))
-        feed[name] = np.frombuffer(buf, dtype=dt).reshape(
-            [int(s) for s in shape])
+        a = np.frombuffer(buf, dtype=dt).reshape([int(s) for s in shape])
+        if lens:
+            lens = [int(v) for v in lens]
+            if min(lens) < 0:
+                raise ValueError(
+                    "feed %r: negative sequence length in %r"
+                    % (name, lens))
+            offs = np.cumsum([0] + lens)
+            if int(offs[-1]) != a.shape[0]:
+                raise ValueError(
+                    "feed %r: sequence lengths sum to %d but the flat "
+                    "buffer has %d rows" % (name, int(offs[-1]),
+                                            a.shape[0]))
+            feed[name] = LoDTensor.from_sequences(
+                [a[offs[i]:offs[i + 1]] for i in range(len(lens))])
+        else:
+            feed[name] = a
     # scope passed explicitly — scope_guard mutates a process global and
     # would race when a multithreaded C host runs two predictors at once
     outs = p.exe.run(p.program, feed=feed, fetch_list=p.fetches,

@@ -247,7 +247,8 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
     use_fused_label_smooth: compute uniform label smoothing by exact
     decomposition ((1-eps)*nll + eps*(lse - mean logits)) instead of the
     dense [N, vocab] smoothed-label + soft-softmax path — numerically
-    identical, HBM-free at 30k vocab."""
+    identical; the remaining [N, vocab] intermediates are fusion-friendly
+    (one_hot compare + reduce) rather than stored labels."""
     if use_fused_attention:
         if dropout_rate:
             raise ValueError("use_fused_attention requires dropout_rate=0 "
@@ -287,9 +288,11 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
         # lse = logit_label + nll,
         #   -(sum smoothed*logp) = (1-eps)*nll + eps*(lse - sum(logits)/V)
         #                        = nll + eps*(logit_label - sum(logits)/V).
-        # Avoids BOTH [N, V] dense materializations of the naive path —
-        # the smoothed label matrix and the soft-label softmax — and keeps
-        # the hard-label fused pallas xent kernel engaged. Gradient
+        # Replaces the naive path's [N, V] smoothed-label matrix and
+        # soft-label softmax with the hard-label fused pallas xent kernel
+        # plus per-row reductions; logit_label still goes through a
+        # one_hot*logits reduce whose fusion (no materialized [N, V]
+        # buffer) is up to XLA — no gather-by-label layer exists. Gradient
         # (1-eps)*(p - onehot) + eps*(p - 1/V) falls out of the vjp.
         nll = fluid.layers.softmax_with_cross_entropy(
             logits=predict_2d, label=lbl_flat)

@@ -23,6 +23,14 @@
 //                    &ndim, dtype_buf, dtype_cap) -> bytes copied
 // Feed buffers carry each feed var's DECLARED dtype (int64 ids feed
 // embedding/CTR models directly); outputs keep their native dtype.
+//        ptpu_run2_lod(handle, names, bufs, shapes, ndims,
+//                      lods, lod_lens, n)
+//            like ptpu_run2 plus per-feed sequence lengths (the era
+//            paddle_arguments sequence_start_positions, passed as
+//            LENGTHS): lods[i] points at lod_lens[i] int64 sequence
+//            lengths and the buffer carries FLAT [total, D] rows;
+//            lod_lens[i] == 0 marks a dense feed. Serves the era's
+//            sequence models (sentiment/MT) from C.
 #include <Python.h>
 
 #include <cstdint>
@@ -203,18 +211,21 @@ PyObject* build_feed_args(const char** names, const void** bufs,
 
 }  // namespace
 
-// v2 run: buffers already carry each feed's declared dtype; every fetch
-// output is retained on the handle for ptpu_output. Returns the number of
-// outputs, or -1.
-int64_t ptpu_run2(int64_t handle, const char** names, const void** bufs,
-                  const int64_t** shapes, const int* ndims, int nfeeds) {
+namespace {
+
+// shared v2 feed marshalling + host call: resolves per-feed element
+// widths, builds the (names, bufs, shapes) lists, and invokes
+// capi_host.run (lods == nullptr) or capi_host.run_lod. Returns the
+// number of retained outputs, or -1.
+int64_t run_v2_common(int64_t handle, const char** names, const void** bufs,
+                      const int64_t** shapes, const int* ndims,
+                      const int64_t** lods, const int* lod_lens,
+                      int nfeeds) {
   ptpu_init();
   Gil gil;
   PyObject* m = host_module();
   if (!m) return -1;
 
-  // per-feed element widths, resolved host-side in ONE call aligned with
-  // the names being passed (the host caches name->dtype per handle)
   PyObject* plist = PyList_New(nfeeds);
   for (int i = 0; i < nfeeds; ++i)
     PyList_SetItem(plist, i, PyUnicode_FromString(names[i]));
@@ -235,8 +246,23 @@ int64_t ptpu_run2(int64_t handle, const char** names, const void** bufs,
   build_feed_args(names, bufs, shapes, ndims, elem_sizes, nfeeds, &pnames,
                   &pbufs, &pshapes);
   delete[] elem_sizes;
-  PyObject* r = PyObject_CallMethod(m, "run", "LOOO", handle, pnames,
-                                    pbufs, pshapes);
+  PyObject* r;
+  if (lods == nullptr) {
+    r = PyObject_CallMethod(m, "run", "LOOO", handle, pnames, pbufs,
+                            pshapes);
+  } else {
+    PyObject* plods = PyList_New(nfeeds);
+    for (int i = 0; i < nfeeds; ++i) {
+      int n = lod_lens ? lod_lens[i] : 0;
+      PyObject* ls = PyList_New(n);
+      for (int j = 0; j < n; ++j)
+        PyList_SetItem(ls, j, PyLong_FromLongLong(lods[i][j]));
+      PyList_SetItem(plods, i, ls);
+    }
+    r = PyObject_CallMethod(m, "run_lod", "LOOOO", handle, pnames, pbufs,
+                            pshapes, plods);
+    Py_DECREF(plods);
+  }
   Py_DECREF(pnames);
   Py_DECREF(pbufs);
   Py_DECREF(pshapes);
@@ -248,6 +274,30 @@ int64_t ptpu_run2(int64_t handle, const char** names, const void** bufs,
   int64_t n = PyLong_AsLongLong(r);
   Py_DECREF(r);
   return n;
+}
+
+}  // namespace
+
+// v2 run: buffers already carry each feed's declared dtype; every fetch
+// output is retained on the handle for ptpu_output. Returns the number of
+// outputs, or -1.
+int64_t ptpu_run2(int64_t handle, const char** names, const void** bufs,
+                  const int64_t** shapes, const int* ndims, int nfeeds) {
+  return run_v2_common(handle, names, bufs, shapes, ndims, nullptr,
+                       nullptr, nfeeds);
+}
+
+// v2 + LoD: per-feed sequence lengths re-segment flat-row buffers into
+// LoDTensors host-side (capi_host.run_lod). lods[i]/lod_lens[i] may be
+// null/0 for dense feeds.
+int64_t ptpu_run2_lod(int64_t handle, const char** names, const void** bufs,
+                      const int64_t** shapes, const int* ndims,
+                      const int64_t** lods, const int* lod_lens,
+                      int nfeeds) {
+  static const int64_t* kNoLods[1] = {nullptr};
+  (void)kNoLods;
+  return run_v2_common(handle, names, bufs, shapes, ndims,
+                       lods ? lods : kNoLods, lod_lens, nfeeds);
 }
 
 int ptpu_num_outputs(int64_t handle) {

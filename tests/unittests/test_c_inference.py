@@ -291,3 +291,83 @@ def test_c_api_v2_standalone_binary(tmp_path):
     assert "nout=2 rows=2 dtype=float32" in r.stdout
     s = float(r.stdout.strip().split("sum=")[1])
     assert abs(s - 2.0) < 1e-4  # two softmax rows sum to 1 each
+
+
+def _save_lstm_model(dirname):
+    """Sentiment-style lod model: ids -> embedding -> fc -> lstm -> max
+    pool -> fc softmax, saved via save_inference_model. Returns flat-row
+    ids, sequence lengths, and the direct-executor reference output."""
+    from paddle_tpu.core.lod import LoDTensor
+
+    V, E, H = 20, 4, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data("words", [1], dtype="int64", lod_level=1)
+        emb = fluid.layers.embedding(input=words, size=[V, E])
+        proj = fluid.layers.fc(input=emb, size=4 * H, num_flatten_dims=2)
+        hidden, _ = fluid.layers.dynamic_lstm(input=proj, size=4 * H,
+                                              use_peepholes=False)
+        pooled = fluid.layers.sequence_pool(input=hidden, pool_type="max")
+        out = fluid.layers.fc(input=pooled, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(7)
+    lens = [3, 5, 2]
+    seqs = [rng.randint(0, V, (n, 1)).astype("int64") for n in lens]
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(dirname, ["words"], [out], exe,
+                                      main_program=main)
+        ref, = exe.run(main,
+                       feed={"words": LoDTensor.from_sequences(seqs)},
+                       fetch_list=[out])
+    flat = np.concatenate(seqs, axis=0)
+    return flat, lens, np.asarray(ref)
+
+
+def test_c_api_v2_lod_sequence_feeds(tmp_path):
+    """ptpu_run2_lod: flat [total, 1] int64 rows + per-sequence lengths
+    drive a saved LSTM model from C — the era paddle_arguments
+    sequence_start_positions serving path."""
+    model_dir = str(tmp_path / "mseq")
+    flat, lens, ref = _save_lstm_model(model_dir)
+    lib = _load_lib()
+    lib.ptpu_run2_lod.restype = ctypes.c_int64
+    lib.ptpu_output.restype = ctypes.c_int64
+
+    h = lib.ptpu_create(model_dir.encode())
+    assert h > 0, lib.ptpu_last_error().decode()
+
+    data = np.ascontiguousarray(flat)
+    names = (ctypes.c_char_p * 1)(b"words")
+    bufs = (ctypes.c_void_p * 1)(data.ctypes.data_as(ctypes.c_void_p))
+    shape = (ctypes.c_int64 * 2)(*data.shape)
+    shapes = (ctypes.POINTER(ctypes.c_int64) * 1)(shape)
+    ndims = (ctypes.c_int * 1)(2)
+    lod = (ctypes.c_int64 * len(lens))(*lens)
+    lods = (ctypes.POINTER(ctypes.c_int64) * 1)(lod)
+    lod_lens = (ctypes.c_int * 1)(len(lens))
+    n_out = lib.ptpu_run2_lod(ctypes.c_int64(h), names, bufs, shapes,
+                              ndims, lods, lod_lens, 1)
+    assert n_out == 1, lib.ptpu_last_error().decode()
+
+    out = np.zeros(64, "f")
+    out_shape = (ctypes.c_int64 * 8)()
+    out_ndim = ctypes.c_int(0)
+    odt = ctypes.create_string_buffer(16)
+    nbytes = lib.ptpu_output(
+        ctypes.c_int64(h), 0, out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(out.nbytes), out_shape, 8, ctypes.byref(out_ndim),
+        odt, 16)
+    assert nbytes == ref.nbytes, lib.ptpu_last_error().decode()
+    got = out[:ref.size].reshape(ref.shape)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    # mismatched lengths must error, not corrupt
+    bad = (ctypes.c_int64 * len(lens))(*[n + 1 for n in lens])
+    bads = (ctypes.POINTER(ctypes.c_int64) * 1)(bad)
+    r = lib.ptpu_run2_lod(ctypes.c_int64(h), names, bufs, shapes, ndims,
+                          bads, lod_lens, 1)
+    assert r == -1
+    assert b"lengths sum" in lib.ptpu_last_error()
+    lib.ptpu_destroy(ctypes.c_int64(h))
