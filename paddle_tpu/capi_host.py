@@ -119,7 +119,7 @@ def run_lod(handle, names, buffers, shapes, lods):
     sequence_start_positions, as lengths): a feed with a non-empty lods
     entry carries FLAT rows ([total, D], the reference serving layout) and
     is re-segmented into a LoDTensor; an empty entry is a dense feed."""
-    from .core.lod import LoDTensor
+    from .core.lod import create_lod_tensor
 
     p = _predictors[handle]
     feed = {}
@@ -132,14 +132,13 @@ def run_lod(handle, names, buffers, shapes, lods):
                 raise ValueError(
                     "feed %r: negative sequence length in %r"
                     % (name, lens))
-            offs = np.cumsum([0] + lens)
-            if int(offs[-1]) != a.shape[0]:
+            total = sum(lens)
+            if total != a.shape[0]:
                 raise ValueError(
                     "feed %r: sequence lengths sum to %d but the flat "
-                    "buffer has %d rows" % (name, int(offs[-1]),
-                                            a.shape[0]))
-            feed[name] = LoDTensor.from_sequences(
-                [a[offs[i]:offs[i + 1]] for i in range(len(lens))])
+                    "buffer has %d rows" % (name, total, a.shape[0]))
+            # zero-copy: the buffer is already the flat row stream
+            feed[name] = create_lod_tensor(a, [lens])
         else:
             feed[name] = a
     # scope passed explicitly — scope_guard mutates a process global and

@@ -294,10 +294,10 @@ int64_t ptpu_run2_lod(int64_t handle, const char** names, const void** bufs,
                       const int64_t** shapes, const int* ndims,
                       const int64_t** lods, const int* lod_lens,
                       int nfeeds) {
-  static const int64_t* kNoLods[1] = {nullptr};
-  (void)kNoLods;
-  return run_v2_common(handle, names, bufs, shapes, ndims,
-                       lods ? lods : kNoLods, lod_lens, nfeeds);
+  // lods == NULL degrades to the all-dense run path (run_v2_common
+  // routes on the pointer), avoiding any placeholder-array indexing
+  return run_v2_common(handle, names, bufs, shapes, ndims, lods,
+                       lod_lens, nfeeds);
 }
 
 int ptpu_num_outputs(int64_t handle) {

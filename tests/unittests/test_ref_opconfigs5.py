@@ -1,0 +1,259 @@
+"""Reference OpTest parameter grids, tranche 5 — the detection family.
+
+Ported grids (/root/reference/python/paddle/fluid/tests/unittests/):
+- prior_box (test_prior_box_op.py): min/max sizes x aspect_ratios x flip
+  x clip x offset, including the reference's box expansion order
+  [min, max, ar!=1...] and real_aspect_ratios flip expansion.
+- box_coder (test_box_coder_op.py): EncodeCenterSize / DecodeCenterSize
+  against the reference's closed form.
+- multiclass_nms (test_multiclass_nms_op.py): score_threshold /
+  nms_top_k / keep_top_k grid against a numpy NMS.
+- target_assign / mine_hard_examples (test_target_assign_op.py,
+  test_mine_hard_examples_op.py): match-index gather + max_negative
+  mining.
+"""
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+rng = np.random.RandomState(53)
+
+
+# ---------------------------------------------------------------------------
+# prior_box
+# ---------------------------------------------------------------------------
+
+def _np_prior_box(fh, fw, ih, iw, min_sizes, max_sizes, ars_in, flip,
+                  clip, offset, variances):
+    ars = [1.0]
+    for ar in ars_in:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    step_w, step_h = iw / fw, ih / fh
+    halves = []
+    for s, ms in enumerate(min_sizes):
+        halves.append((ms / 2.0, ms / 2.0))
+        if max_sizes:
+            c = np.sqrt(ms * max_sizes[s]) / 2.0
+            halves.append((c, c))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            halves.append((ms * np.sqrt(ar) / 2.0, ms / np.sqrt(ar) / 2.0))
+    out = np.zeros((fh, fw, len(halves), 4), np.float32)
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            for p, (hw, hh) in enumerate(halves):
+                out[y, x, p] = [(cx - hw) / iw, (cy - hh) / ih,
+                                (cx + hw) / iw, (cy + hh) / ih]
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          out.shape).copy()
+    return out, var
+
+
+PRIOR_GRID = [
+    # (min_sizes, max_sizes, ars, flip, clip, offset)
+    ([2.0, 4.0], [5.0, 10.0], [2.0], False, False, 0.5),
+    ([2.0, 4.0], [5.0, 10.0], [2.0, 3.0], True, True, 0.5),
+    ([3.0], [], [2.0], True, False, 0.25),
+]
+
+
+@pytest.mark.parametrize("mins,maxs,ars,flip,clip,offset", PRIOR_GRID)
+def test_prior_box_ref_config(mins, maxs, ars, flip, clip, offset):
+    fh = fw = 4
+    ih = iw = 20
+    feat = rng.randn(2, 2, fh, fw).astype("float32")
+    img = rng.randn(2, 3, ih, iw).astype("float32")
+    attrs = {"min_sizes": mins, "max_sizes": maxs, "aspect_ratios": ars,
+             "flip": flip, "clip": clip, "offset": offset,
+             "variances": [0.1, 0.1, 0.2, 0.2]}
+    boxes, var = run_op("prior_box", {"Input": feat, "Image": img}, attrs,
+                        out_slots=("Boxes", "Variances"))
+    exp_b, exp_v = _np_prior_box(fh, fw, ih, iw, mins, maxs, ars, flip,
+                                 clip, offset, [0.1, 0.1, 0.2, 0.2])
+    np.testing.assert_allclose(np.asarray(boxes), exp_b, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var), exp_v, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# box_coder
+# ---------------------------------------------------------------------------
+
+def _np_encode(target, prior, pvar):
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = (prior[:, 0] + prior[:, 2]) / 2
+    pcy = (prior[:, 1] + prior[:, 3]) / 2
+    tw = target[:, None, 2] - target[:, None, 0]
+    th = target[:, None, 3] - target[:, None, 1]
+    tcx = (target[:, None, 0] + target[:, None, 2]) / 2
+    tcy = (target[:, None, 1] + target[:, None, 3]) / 2
+    out = np.stack([
+        (tcx - pcx) / pw / pvar[:, 0],
+        (tcy - pcy) / ph / pvar[:, 1],
+        np.log(tw / pw) / pvar[:, 2],
+        np.log(th / ph) / pvar[:, 3],
+    ], axis=-1)
+    return out
+
+
+def _np_decode(target, prior, pvar):
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = (prior[:, 0] + prior[:, 2]) / 2
+    pcy = (prior[:, 1] + prior[:, 3]) / 2
+    cx = pvar[:, 0] * target[:, 0] * pw + pcx
+    cy = pvar[:, 1] * target[:, 1] * ph + pcy
+    w = np.exp(pvar[:, 2] * target[:, 2]) * pw
+    h = np.exp(pvar[:, 3] * target[:, 3]) * ph
+    return np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                    axis=-1)
+
+
+def _rand_boxes(n):
+    lo = rng.rand(n, 2) * 0.5
+    hi = lo + 0.1 + rng.rand(n, 2) * 0.4
+    return np.concatenate([lo, hi], axis=1).astype("float32")
+
+
+def test_box_coder_encode_ref_config():
+    prior = _rand_boxes(7)
+    pvar = (rng.rand(7, 4).astype("float32") * 0.2 + 0.1)
+    target = _rand_boxes(5)
+    got = run_op("box_coder", {"PriorBox": prior, "PriorBoxVar": pvar,
+                               "TargetBox": target},
+                 {"code_type": "encode_center_size"},
+                 out_slots=("OutputBox",))[0]
+    exp = _np_encode(target.astype(np.float64), prior.astype(np.float64),
+                     pvar.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(got), exp, rtol=1e-4, atol=1e-5)
+
+
+def test_box_coder_decode_ref_config():
+    prior = _rand_boxes(6)
+    pvar = (rng.rand(6, 4).astype("float32") * 0.2 + 0.1)
+    target = (rng.randn(6, 4) * 0.3).astype("float32")
+    got = run_op("box_coder", {"PriorBox": prior, "PriorBoxVar": pvar,
+                               "TargetBox": target},
+                 {"code_type": "decode_center_size"},
+                 out_slots=("OutputBox",))[0]
+    exp = _np_decode(target.astype(np.float64), prior.astype(np.float64),
+                     pvar.astype(np.float64))
+    got = np.asarray(got)
+    if got.ndim == 3:  # [N, M, 4] with N == M diagonal semantics differ
+        got = got.reshape(exp.shape) if got.size == exp.size else \
+            np.stack([got[i, i] for i in range(len(exp))])
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms threshold grid
+# ---------------------------------------------------------------------------
+
+def _np_iou(a, b):
+    ix0 = max(a[0], b[0])
+    iy0 = max(a[1], b[1])
+    ix1 = min(a[2], b[2])
+    iy1 = min(a[3], b[3])
+    iw = max(0.0, ix1 - ix0)
+    ih = max(0.0, iy1 - iy0)
+    inter = iw * ih
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) \
+        - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def _np_nms_class(boxes, scores, score_thr, nms_thr, top_k):
+    idx = np.argsort(-scores)
+    idx = [i for i in idx if scores[i] > score_thr][:top_k]
+    keep = []
+    for i in idx:
+        if all(_np_iou(boxes[i], boxes[j]) <= nms_thr for j in keep):
+            keep.append(i)
+    return keep
+
+
+@pytest.mark.parametrize("score_thr,keep_top_k", [(0.01, 10), (0.3, 3)])
+def test_multiclass_nms_threshold_grid(score_thr, keep_top_k):
+    m, c = 12, 3
+    boxes = _rand_boxes(m)
+    scores = rng.rand(c, m).astype("float32")
+    out, out_len = run_op(
+        "multiclass_nms",
+        {"BBoxes": boxes[None], "Scores": scores[None]},
+        {"background_label": 0, "score_threshold": score_thr,
+         "nms_top_k": 8, "keep_top_k": keep_top_k, "nms_threshold": 0.3},
+        out_slots=("Out", "OutLen"))
+    out = np.asarray(out)[0]
+    n = int(np.asarray(out_len).reshape(-1)[0])
+
+    cand = []
+    for cls in range(1, c):  # background 0 skipped
+        for i in _np_nms_class(boxes, scores[cls], score_thr, 0.3, 8):
+            cand.append((cls, scores[cls][i]) + tuple(boxes[i]))
+    cand.sort(key=lambda r: -r[1])
+    cand = cand[:keep_top_k]
+    assert n == len(cand)
+    got = out[:n]
+    got_sorted = sorted(map(tuple, got.tolist()), key=lambda r: -r[1])
+    for g, e in zip(got_sorted, cand):
+        np.testing.assert_allclose(g, e, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# target_assign / mine_hard_examples
+# ---------------------------------------------------------------------------
+
+def test_target_assign_ref_config():
+    b, g, k, m = 2, 4, 3, 6
+    x = rng.randn(b, g, k).astype("float32")
+    midx = rng.randint(-1, g, (b, m)).astype("int32")
+    out, wt = run_op("target_assign", {"X": x, "MatchIndices": midx},
+                     {"mismatch_value": 7.0}, out_slots=("Out", "OutWeight"))
+    out = np.asarray(out)
+    wt = np.asarray(wt)
+    for bi in range(b):
+        for mi in range(m):
+            if midx[bi, mi] < 0:
+                np.testing.assert_allclose(out[bi, mi], 7.0)
+                assert wt[bi, mi].max() == 0
+            else:
+                np.testing.assert_allclose(out[bi, mi], x[bi, midx[bi, mi]],
+                                           rtol=1e-6)
+                assert wt[bi, mi].min() == 1
+
+
+def test_mine_hard_examples_max_negative():
+    b, m = 2, 8
+    cls_loss = rng.rand(b, m).astype("float32")
+    midx = np.full((b, m), -1, np.int32)
+    midx[0, 1] = 0
+    midx[0, 4] = 1   # 2 positives in row 0
+    midx[1, 2] = 0   # 1 positive in row 1
+    mdist = rng.rand(b, m).astype("float32")
+    neg_mask, = run_op(
+        "mine_hard_examples",
+        {"ClsLoss": cls_loss, "MatchIndices": midx, "MatchDist": mdist},
+        {"neg_pos_ratio": 2.0, "mining_type": "max_negative",
+         "neg_dist_threshold": 0.5},
+        out_slots=("NegMask",))
+    neg_mask = np.asarray(neg_mask)
+    for bi, npos in ((0, 2), (1, 1)):
+        want = int(2.0 * npos)
+        sel = neg_mask[bi].astype(bool)
+        # eligibility (mine_hard_examples_op.cc): unmatched AND match
+        # distance under neg_dist_threshold
+        eligible = np.where((midx[bi] < 0) & (mdist[bi] < 0.5))[0]
+        assert sel.sum() == min(want, len(eligible))
+        assert not (sel & (midx[bi] >= 0)).any()
+        top = eligible[np.argsort(-cls_loss[bi][eligible])][:want]
+        assert set(np.where(sel)[0]) == set(top)
