@@ -144,6 +144,62 @@ def bench_transformer():
         "loss": float(loss.reshape(-1)[0])}))
 
 
+def bench_transformer_decode():
+    """KV-cache incremental beam decode throughput (BENCH_MODEL=transformer
+    BENCH_DECODE=1): tokens generated per second through
+    build_cached_decode's while_loop (caches as carries, O(T) decoder
+    work). The reference era re-ran the decoder on the growing prefix per
+    step; this metric is the TPU-native serving headline."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.core.utils import device_fetch_barrier
+    from paddle_tpu.models import transformer
+
+    batch = int(os.environ.get("BENCH_BATCH", "16"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "5")))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    n_layer = int(os.environ.get("BENCH_LAYERS", "6"))
+    d_model = int(os.environ.get("BENCH_DMODEL", "512"))
+    n_head = int(os.environ.get("BENCH_HEADS", "8"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "30000"))
+    beam = int(os.environ.get("BENCH_BEAM", "4"))
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(prog, startup):
+        ids, scores = transformer.build_cached_decode(
+            src_vocab_size=vocab, trg_vocab_size=vocab, max_length=seq,
+            n_layer=n_layer, n_head=n_head, d_key=d_model // n_head,
+            d_value=d_model // n_head, d_model=d_model,
+            d_inner_hid=d_model * 4, beam_size=beam)
+
+    rng = np.random.RandomState(0)
+    srcs = [rng.randint(3, vocab, seq - 2).tolist() for _ in range(batch)]
+    feed = transformer.prepare_cached_decode_batch(srcs, seq, n_head, beam)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(warmup):
+            exe.run(prog, feed=feed, fetch_list=[ids])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = exe.run(prog, feed=feed, fetch_list=[ids],
+                          return_numpy=False)
+        device_fetch_barrier(out)
+        dt = time.perf_counter() - t0
+
+    # each run decodes up to seq-1 positions for batch*beam hypotheses
+    tps = batch * beam * (seq - 1) * steps / dt
+    print(json.dumps({
+        "metric": "transformer_cached_decode_throughput",
+        "value": round(tps, 1), "unit": "tokens/sec/chip",
+        "vs_baseline": None, "batch": batch, "beam": beam, "seq": seq,
+        "layers": n_layer, "d_model": d_model,
+        "device": str(jax.devices()[0])}))
+
+
 def bench_stacked_lstm():
     """Stacked dynamic-LSTM sentiment training (the reference benchmark
     suite's stacked_dynamic_lstm.py workload): embedding -> 3x (fc+lstm)
@@ -225,7 +281,10 @@ def main():
     _await_devices(int(os.environ.get("BENCH_DEVICE_TIMEOUT", "600")))
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "transformer":
-        bench_transformer()
+        if os.environ.get("BENCH_DECODE") == "1":
+            bench_transformer_decode()
+        else:
+            bench_transformer()
         return
     if model == "stacked_lstm":
         bench_stacked_lstm()
