@@ -92,6 +92,7 @@ def bench_transformer():
     n_head = int(os.environ.get("BENCH_HEADS", "8"))
     vocab = int(os.environ.get("BENCH_VOCAB", "30000"))
     fused = os.environ.get("BENCH_FUSED_ATTN", "1") == "1"
+    fused_qkv = os.environ.get("BENCH_FUSED_QKV", "0") == "1"
     dtype = os.environ.get("BENCH_DTYPE", "bf16")
 
     main_prog, startup = fluid.Program(), fluid.Program()
@@ -103,7 +104,7 @@ def bench_transformer():
             n_layer=n_layer, n_head=n_head, d_key=d_model // n_head,
             d_value=d_model // n_head, d_model=d_model,
             d_inner_hid=d_model * 4, label_smooth_eps=0.1,
-            use_fused_attention=fused)
+            use_fused_attention=fused, use_qkv_fusion=fused_qkv)
 
     rng = np.random.RandomState(0)
     srcs = [rng.randint(3, vocab, seq).tolist() for _ in range(batch)]
@@ -139,7 +140,8 @@ def bench_transformer():
         "value": round(tps, 1), "unit": "tokens/sec/chip",
         "vs_baseline": None, "batch": batch, "seq": seq,
         "layers": n_layer, "d_model": d_model, "dtype": dtype,
-        "fused_attention": fused, "device": str(jax.devices()[0]),
+        "fused_attention": fused, "fused_qkv": fused_qkv,
+        "device": str(jax.devices()[0]),
         "mfu": _mfu(tps * flops_per_token),
         "loss": float(loss.reshape(-1)[0])}))
 

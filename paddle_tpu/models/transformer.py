@@ -32,7 +32,8 @@ def position_encoding_init(n_position, d_model):
 
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head=1, dropout_rate=0.0,
-                         use_fused=False, causal=False, kv_len=None):
+                         use_fused=False, causal=False, kv_len=None,
+                         fuse_qkv=False):
     """q/k/v fc -> split heads -> scaled dot-product + bias -> combine.
 
     use_fused routes the core through layers.fused_attention (the pallas
@@ -41,7 +42,15 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
     additive attn_bias (which the fused path ignores). Attention-weight
     dropout can't be expressed inside the flash kernel, so
     use_fused + dropout_rate>0 raises (a silent dense fallback would run
-    WITHOUT the causal/kv_len masks, leaking future positions)."""
+    WITHOUT the causal/kv_len masks, leaking future positions).
+
+    fuse_qkv (self-attention only): one [D, (2*d_key+d_value)*H] matmul
+    instead of three — a larger MXU tile and one pass over the
+    activations. The combined weight is the COLUMN concatenation
+    [W_q | W_k | W_v] of the unfused weights (tested equivalent).
+    NOTE: the decode builders (build_decode/build_cached_decode) create
+    the unfused three-weight layout; a scope trained with fuse_qkv=True
+    cannot be decoded by them (they raise if asked)."""
     if use_fused and dropout_rate:
         raise ValueError(
             "use_fused attention requires dropout_rate=0: attention-weight "
@@ -51,15 +60,33 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
         raise ValueError(
             "use_fused attention ignores dense attn_bias tensors — express "
             "the mask as kv_len (key padding) and/or causal=True instead")
+    if fuse_qkv and keys is not None:
+        raise ValueError("fuse_qkv requires self-attention (keys=None): "
+                         "cross-attention projects different inputs")
     keys = queries if keys is None else keys
     values = keys if values is None else values
 
-    q = fluid.layers.fc(input=queries, size=d_key * n_head,
-                        bias_attr=False, num_flatten_dims=2)
-    k = fluid.layers.fc(input=keys, size=d_key * n_head,
-                        bias_attr=False, num_flatten_dims=2)
-    v = fluid.layers.fc(input=values, size=d_value * n_head,
-                        bias_attr=False, num_flatten_dims=2)
+    if fuse_qkv:
+        # per-slice Xavier scale: the fused weight's natural fan_out is 3x
+        # a single projection's, which would shrink init std vs the
+        # unfused path — pin fan_out to one projection so the flag stays a
+        # pure perf toggle at default init
+        qkv = fluid.layers.fc(
+            input=queries, size=(2 * d_key + d_value) * n_head,
+            bias_attr=False, num_flatten_dims=2,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.XavierInitializer(
+                    fan_out=d_key * n_head)))
+        q, k, v = fluid.layers.split(
+            qkv, num_or_sections=[d_key * n_head, d_key * n_head,
+                                  d_value * n_head], dim=-1)
+    else:
+        q = fluid.layers.fc(input=queries, size=d_key * n_head,
+                            bias_attr=False, num_flatten_dims=2)
+        k = fluid.layers.fc(input=keys, size=d_key * n_head,
+                            bias_attr=False, num_flatten_dims=2)
+        v = fluid.layers.fc(input=values, size=d_value * n_head,
+                            bias_attr=False, num_flatten_dims=2)
 
     if use_fused:
         # [B, T, H*d] -> [B, T, H, d] (BTHD, the fused kernel's layout)
@@ -140,11 +167,11 @@ def prepare_encoder(src_word, src_pos, src_vocab_size, src_emb_dim,
 
 def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
                   d_inner_hid, dropout_rate=0.0, use_fused=False,
-                  kv_len=None):
+                  kv_len=None, fuse_qkv=False):
     attn_output = multi_head_attention(
         pre_post_process_layer(None, enc_input, "n"), None, None, attn_bias,
         d_key, d_value, d_model, n_head, dropout_rate,
-        use_fused=use_fused, kv_len=kv_len)
+        use_fused=use_fused, kv_len=kv_len, fuse_qkv=fuse_qkv)
     attn_output = pre_post_process_layer(enc_input, attn_output, "da",
                                          dropout_rate)
     ffd_output = positionwise_feed_forward(
@@ -156,11 +183,12 @@ def encoder_layer(enc_input, attn_bias, n_head, d_key, d_value, d_model,
 def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
                   n_head, d_key, d_value, d_model, d_inner_hid,
                   dropout_rate=0.0, use_fused=False, src_len=None,
-                  trg_len=None):
+                  trg_len=None, fuse_qkv=False):
     slf_attn_output = multi_head_attention(
         pre_post_process_layer(None, dec_input, "n"), None, None,
         slf_attn_bias, d_key, d_value, d_model, n_head, dropout_rate,
-        use_fused=use_fused, causal=True, kv_len=trg_len)
+        use_fused=use_fused, causal=True, kv_len=trg_len,
+        fuse_qkv=fuse_qkv)
     slf_attn_output = pre_post_process_layer(dec_input, slf_attn_output,
                                              "da", dropout_rate)
     enc_attn_output = multi_head_attention(
@@ -178,23 +206,23 @@ def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
 
 
 def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
-            d_inner_hid, dropout_rate=0.0, use_fused=False, kv_len=None):
+            d_inner_hid, dropout_rate=0.0, use_fused=False, fuse_qkv=False, kv_len=None):
     for _ in range(n_layer):
         enc_input = encoder_layer(enc_input, attn_bias, n_head, d_key,
                                   d_value, d_model, d_inner_hid,
-                                  dropout_rate, use_fused=use_fused,
+                                  dropout_rate, use_fused=use_fused, fuse_qkv=fuse_qkv,
                                   kv_len=kv_len)
     return pre_post_process_layer(None, enc_input, "n")
 
 
 def decoder(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
             n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
-            dropout_rate=0.0, use_fused=False, src_len=None, trg_len=None):
+            dropout_rate=0.0, use_fused=False, fuse_qkv=False, src_len=None, trg_len=None):
     for _ in range(n_layer):
         dec_input = decoder_layer(dec_input, enc_output, slf_attn_bias,
                                   dec_enc_attn_bias, n_head, d_key, d_value,
                                   d_model, d_inner_hid, dropout_rate,
-                                  use_fused=use_fused, src_len=src_len,
+                                  use_fused=use_fused, fuse_qkv=fuse_qkv, src_len=src_len,
                                   trg_len=trg_len)
     return pre_post_process_layer(None, dec_input, "n")
 
@@ -237,7 +265,8 @@ def make_inputs(max_length, n_head, fused=False):
 def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
                 n_head=4, d_key=16, d_value=16, d_model=64, d_inner_hid=128,
                 dropout_rate=0.0, label_smooth_eps=0.0,
-                use_fused_attention=False, use_fused_label_smooth=True):
+                use_fused_attention=False, use_fused_label_smooth=True,
+                use_qkv_fusion=False):
     """Build the training graph; returns (sum_cost, avg_cost, predict).
 
     use_fused_attention: every attention core runs the pallas flash kernel
@@ -268,7 +297,8 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
         dropout_rate, pos_enc_param_name=POS_ENC_PARAM_NAMES[0])
     enc_output = encoder(enc_input, src_slf_attn_bias, n_layer, n_head,
                          d_key, d_value, d_model, d_inner_hid, dropout_rate,
-                         use_fused=use_fused_attention, kv_len=src_len)
+                         use_fused=use_fused_attention, kv_len=src_len,
+                         fuse_qkv=use_qkv_fusion)
 
     dec_input = prepare_encoder(
         trg_word, trg_pos, trg_vocab_size, d_model, max_length,
@@ -277,7 +307,7 @@ def transformer(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
                          trg_src_attn_bias, n_layer, n_head, d_key, d_value,
                          d_model, d_inner_hid, dropout_rate,
                          use_fused=use_fused_attention, src_len=src_len,
-                         trg_len=trg_len)
+                         trg_len=trg_len, fuse_qkv=use_qkv_fusion)
 
     predict = fluid.layers.fc(input=dec_output, size=trg_vocab_size,
                               bias_attr=False, num_flatten_dims=2)
@@ -335,7 +365,7 @@ def build_train(src_vocab_size, trg_vocab_size, max_length, d_model=64,
 def build_decode(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
                  n_head=4, d_key=16, d_value=16, d_model=64,
                  d_inner_hid=128, beam_size=2, max_out_len=None,
-                 bos_id=1, eos_id=2):
+                 bos_id=1, eos_id=2, fuse_qkv=False):
     """Autoregressive beam-search decode (the era's transformer infer
     path: re-run the whole decoder on the growing prefix each step — no
     KV cache in the reference either; dense [batch, beam] layout rides
@@ -346,6 +376,12 @@ def build_decode(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
     decode program runs in the training scope. Returns
     (sentence_ids [B, K, C], sentence_scores [B, K]).
     """
+    if fuse_qkv:
+        raise NotImplementedError(
+            "the decode builders create the unfused q/k/v weight layout; "
+            "decode a fuse_qkv-trained scope is not supported — train "
+            "with use_qkv_fusion=False for decode interop")
+
     L = fluid.layers
     K = beam_size
     T = max_length
@@ -457,7 +493,7 @@ def build_decode(src_vocab_size, trg_vocab_size, max_length, n_layer=2,
 def build_cached_decode(src_vocab_size, trg_vocab_size, max_length,
                         n_layer=2, n_head=4, d_key=16, d_value=16,
                         d_model=64, d_inner_hid=128, beam_size=2,
-                        max_out_len=None, bos_id=1, eos_id=2):
+                        max_out_len=None, bos_id=1, eos_id=2, fuse_qkv=False):
     """Incremental beam decode with per-layer self-attention KV caches —
     the TPU-native upgrade over build_decode (and over the reference era,
     which re-ran the whole decoder on the growing prefix each step,
@@ -474,6 +510,12 @@ def build_cached_decode(src_vocab_size, trg_vocab_size, max_length,
     padding), init_ids, init_scores. Returns
     (sentence_ids [B,K,C], sentence_scores [B,K]) — must match
     build_decode token-for-token (tested)."""
+    if fuse_qkv:
+        raise NotImplementedError(
+            "the decode builders create the unfused q/k/v weight layout; "
+            "decode a fuse_qkv-trained scope is not supported — train "
+            "with use_qkv_fusion=False for decode interop")
+
     L = fluid.layers
     K = beam_size
     T = max_length

@@ -351,3 +351,42 @@ def test_fused_label_smooth_matches_dense_path():
     dense = run(False)
     fused = run(True)
     np.testing.assert_allclose(dense, fused, rtol=2e-5, atol=1e-6)
+
+
+def test_fused_qkv_projection_equivalent():
+    """fuse_qkv's combined weight is the column concat [W_q|W_k|W_v]:
+    with weights wired that way, the attention output must match the
+    three-matmul path exactly."""
+    from paddle_tpu.models.transformer import multi_head_attention
+
+    B, T, D, H, dk = 2, 5, 8, 2, 4
+    rng = np.random.RandomState(6)
+    x = rng.randn(B, T, D).astype("float32") * 0.5
+    wq, wk, wv = (rng.randn(D, dk * H).astype("float32") * 0.3
+                  for _ in range(3))
+    wo = (rng.randn(dk * H, D) * 0.3).astype("float32")
+
+    def run(fuse):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            xv = fluid.layers.data("x", [T, D], dtype="float32")
+            out = multi_head_attention(xv, None, None, None, dk, dk, D,
+                                       n_head=H, fuse_qkv=fuse)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            params = sorted(v.name for v in
+                            main.global_block().all_parameters())
+            if fuse:
+                scope.set(params[0], np.concatenate([wq, wk, wv], axis=1))
+                scope.set(params[1], wo)
+            else:
+                scope.set(params[0], wq)
+                scope.set(params[1], wk)
+                scope.set(params[2], wv)
+                scope.set(params[3], wo)
+            got, = exe.run(main, feed={"x": x}, fetch_list=[out])
+        return np.asarray(got)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)

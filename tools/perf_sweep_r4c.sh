@@ -72,6 +72,7 @@ echo "- $(date -u +%FT%TZ) TUNNEL RECOVERED; r4c sweep starts" >> BENCH_LOG.md
 # tier 1: cheap re-measures through the NEW flash backward kernels
 run 900 BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
 probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2
+probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256 BENCH_FUSED_QKV=1
 probe && run 900 BENCH_MODEL=transformer BENCH_DECODE=1 BENCH_BATCH=16 BENCH_SEQ=128
 # tier 2: new bench models
 probe && run 900 BENCH_MODEL=stacked_lstm BENCH_BATCH=128 BENCH_SEQ=64
