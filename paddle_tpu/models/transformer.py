@@ -63,6 +63,10 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
     if fuse_qkv and keys is not None:
         raise ValueError("fuse_qkv requires self-attention (keys=None): "
                          "cross-attention projects different inputs")
+    if fuse_qkv and d_value != d_key:
+        raise ValueError(
+            "fuse_qkv requires d_value == d_key: a single Xavier init "
+            "cannot match both per-slice scales otherwise")
     keys = queries if keys is None else keys
     values = keys if values is None else values
 
@@ -71,10 +75,14 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
         # a single projection's, which would shrink init std vs the
         # unfused path — pin fan_out to one projection so the flag stays a
         # pure perf toggle at default init
+        # the distinct param name makes a layout-mismatched decode build
+        # fail fast on a missing parameter instead of silently reading a
+        # shape-coincident fc weight from the trained scope
         qkv = fluid.layers.fc(
             input=queries, size=(2 * d_key + d_value) * n_head,
             bias_attr=False, num_flatten_dims=2,
             param_attr=fluid.ParamAttr(
+                name=fluid.unique_name.generate("fused_qkv.w"),
                 initializer=fluid.initializer.XavierInitializer(
                     fan_out=d_key * n_head)))
         q, k, v = fluid.layers.split(
@@ -206,7 +214,8 @@ def decoder_layer(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
 
 
 def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
-            d_inner_hid, dropout_rate=0.0, use_fused=False, fuse_qkv=False, kv_len=None):
+            d_inner_hid, dropout_rate=0.0, use_fused=False, kv_len=None,
+            fuse_qkv=False):
     for _ in range(n_layer):
         enc_input = encoder_layer(enc_input, attn_bias, n_head, d_key,
                                   d_value, d_model, d_inner_hid,
@@ -217,7 +226,8 @@ def encoder(enc_input, attn_bias, n_layer, n_head, d_key, d_value, d_model,
 
 def decoder(dec_input, enc_output, slf_attn_bias, dec_enc_attn_bias,
             n_layer, n_head, d_key, d_value, d_model, d_inner_hid,
-            dropout_rate=0.0, use_fused=False, fuse_qkv=False, src_len=None, trg_len=None):
+            dropout_rate=0.0, use_fused=False, src_len=None, trg_len=None,
+            fuse_qkv=False):
     for _ in range(n_layer):
         dec_input = decoder_layer(dec_input, enc_output, slf_attn_bias,
                                   dec_enc_attn_bias, n_head, d_key, d_value,

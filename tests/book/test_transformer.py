@@ -379,8 +379,10 @@ def test_fused_qkv_projection_equivalent():
             params = sorted(v.name for v in
                             main.global_block().all_parameters())
             if fuse:
-                scope.set(params[0], np.concatenate([wq, wk, wv], axis=1))
-                scope.set(params[1], wo)
+                qkv_name = next(p for p in params if "fused_qkv" in p)
+                out_name = next(p for p in params if "fused_qkv" not in p)
+                scope.set(qkv_name, np.concatenate([wq, wk, wv], axis=1))
+                scope.set(out_name, wo)
             else:
                 scope.set(params[0], wq)
                 scope.set(params[1], wk)
