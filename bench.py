@@ -39,7 +39,9 @@ def _await_devices(timeout_s):
 
     def fail(msg):
         model = os.environ.get("BENCH_MODEL", "resnet50")
-        token_metric = {"transformer": "transformer_train_throughput",
+        token_metric = {"transformer": "transformer_cached_decode_throughput"
+                        if os.environ.get("BENCH_DECODE") == "1"
+                        else "transformer_train_throughput",
                         "stacked_lstm": "stacked_lstm_train_throughput"}
         tok = model in token_metric
         print(json.dumps({
