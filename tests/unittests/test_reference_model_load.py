@@ -537,3 +537,82 @@ def test_load_reference_bidirectional_lstm_concat(tmp_path):
             exp_last = np.concatenate([hf[-1], hb[-1]])
             np.testing.assert_allclose(out[i], exp_last, rtol=1e-4,
                                        atol=1e-5)
+
+
+def test_load_reference_gru_model(tmp_path):
+    """Era GRU inference model through the layout adapter: ids ->
+    lookup_table -> fc (flat-rows mul) -> gru -> last-step pool.
+    GRU weight packing [update|reset|candidate] and the reference's
+    h = u*c + (1-u)*h_prev convention, verified against numpy."""
+    from paddle_tpu.core.lod import LoDTensor
+
+    V, E, H = 12, 3, 2
+    rng = np.random.RandomState(29)
+    emb = (rng.randn(V, E) * 0.5).astype("float32")
+    fcw = (rng.randn(E, 3 * H) * 0.4).astype("float32")
+    gw = (rng.randn(H, 3 * H) * 0.4).astype("float32")
+
+    varz = [
+        var_desc("feed", 0, [], var_type=9),
+        var_desc("fetch", 0, [], var_type=10),
+        var_desc("ids", 3, [-1, 1], lod_level=1),
+        var_desc("emb.w", 5, [V, E], persistable=True),
+        var_desc("emb.t", 5, [-1, E], lod_level=1),
+        var_desc("fc.w", 5, [E, 3 * H], persistable=True),
+        var_desc("fc.t", 5, [-1, 3 * H], lod_level=1),
+        var_desc("gru.w", 5, [H, 3 * H], persistable=True),
+        var_desc("gru.h", 5, [-1, H], lod_level=1),
+        var_desc("last", 5, [-1, H]),
+    ]
+    ops = [
+        op_desc("feed", [("X", ["feed"])], [("Out", ["ids"])],
+                [attr("col", 0, 0)]),
+        op_desc("lookup_table", [("W", ["emb.w"]), ("Ids", ["ids"])],
+                [("Out", ["emb.t"])]),
+        op_desc("mul", [("X", ["emb.t"]), ("Y", ["fc.w"])],
+                [("Out", ["fc.t"])],
+                [attr("x_num_col_dims", 0, 1),
+                 attr("y_num_col_dims", 0, 1)]),
+        op_desc("gru", [("Input", ["fc.t"]), ("Weight", ["gru.w"])],
+                [("Hidden", ["gru.h"])],
+                [attr("gate_activation", 2, "sigmoid"),
+                 attr("activation", 2, "tanh"),
+                 attr("is_reverse", 6, False)]),
+        op_desc("sequence_pool", [("X", ["gru.h"])],
+                [("Out", ["last"])], [attr("pooltype", 2, "LAST")]),
+        op_desc("fetch", [("X", ["last"])], [("Out", ["fetch"])],
+                [attr("col", 0, 0)]),
+    ]
+    d = tmp_path / "ref_gru"
+    d.mkdir()
+    (d / "__model__").write_bytes(_ld(1, block_desc(0, -1, varz, ops)))
+    lod_tensor_file(str(d / "emb.w"), emb)
+    lod_tensor_file(str(d / "fc.w"), fcw)
+    lod_tensor_file(str(d / "gru.w"), gw)
+
+    def np_gru_last(seq_ids):
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+        x = emb[seq_ids] @ fcw                       # [L, 3H]
+        h = np.zeros(H)
+        for t in range(len(seq_ids)):
+            xu, xr, xc = np.split(x[t], 3)
+            u = sig(xu + h @ gw[:, :H])
+            r = sig(xr + h @ gw[:, H:2 * H])
+            c = np.tanh(xc + (r * h) @ gw[:, 2 * H:])
+            h = u * c + (1 - u) * h                  # reference convention
+        return h
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        program, feeds, fetches = fluid.io.load_reference_model(str(d), exe)
+        lens = [4, 2]
+        seqs = [rng.randint(0, V, (n, 1)).astype("int64") for n in lens]
+        out, = exe.run(program,
+                       feed={"ids": LoDTensor.from_sequences(seqs)},
+                       fetch_list=fetches)
+        out = np.asarray(out)
+        for i, s in enumerate(seqs):
+            np.testing.assert_allclose(out[i], np_gru_last(s.ravel()),
+                                       rtol=1e-4, atol=1e-5)
