@@ -69,8 +69,11 @@ run() {  # run <timeout_s> ENV=V...
 }
 probe || exit 1
 echo "- $(date -u +%FT%TZ) TUNNEL RECOVERED; r4c sweep starts" >> BENCH_LOG.md
-# tier 1: cheap re-measures through the NEW flash backward kernels
-run 900 BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
+# tier 1: headline re-confirmation (the round-4 Env/lowering changes sit
+# on every trace path) then cheap re-measures through the NEW flash
+# backward kernels
+run 900 BENCH_BATCH=256 BENCH_DTYPE=bf16
+probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
 probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2
 probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256 BENCH_FUSED_QKV=1
 probe && run 900 BENCH_MODEL=transformer BENCH_DECODE=1 BENCH_BATCH=16 BENCH_SEQ=128
