@@ -80,6 +80,12 @@ def _depthwise_conv2d(ctx, ins, attrs):
 def _conv2d_transpose(ctx, ins, attrs):
     x = single(ins, "Input")    # NCHW
     w = single(ins, "Filter")   # IOHW in fluid transpose conv
+    if int(attrs.get("groups", 1) or 1) != 1:
+        # era parity: conv_transpose_op.cc:101 "We enforce groups number
+        # == 1" — silently ignoring the attr would compute wrong results
+        raise ValueError(
+            "conv2d_transpose: groups != 1 is not supported (the "
+            "reference enforces groups == 1 for transposed convolution)")
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dil = _pair(attrs.get("dilations", [1, 1]))
