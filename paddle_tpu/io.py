@@ -158,7 +158,11 @@ def load_reference_model(dirname, executor, model_filename=None):
     (paddle_tpu/reference_format.py — framework.proto's schema), so no
     protobuf runtime is needed. Combined single-file params
     (params_filename/save_combine) are not supported — the era's default
-    was one file per variable.
+    was one file per variable. Sequence models load through the
+    flat-LoD->padded layout adapter (adapt_sequence_layout). Control-flow
+    ops in a LOADED desc (While/conditional_block sub-blocks) are not
+    supported: the reference desc carries no loop-carry metadata and the
+    era served beam decode from host-side python loops, not saved graphs.
     """
     from . import reference_format as rf
 
