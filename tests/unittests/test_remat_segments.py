@@ -213,3 +213,37 @@ def test_remat_under_parallel_executor_matches_single():
                                        feed={"img": xs, "lab": ys})[0])[0])
                for _ in range(3)]
     np.testing.assert_allclose(single, par, rtol=1e-5, atol=1e-6)
+
+
+def test_remat_with_mixed_precision_matches_base():
+    """The bench remat configs run bf16 AMP — segment replays must apply
+    the same AMP casts as the original forward. Unlike fp32 (bit-exact,
+    test above), bf16 trajectories are only CLOSE: the replayed segment
+    may fuse differently under XLA, so bf16 intermediate rounding can
+    differ (the same property jax.checkpoint has in low precision).
+    Step 1 must still match closely and the drift stay bf16-sized."""
+
+    def train(remat):
+        main, startup, loss = _conv_net()
+        main.enable_mixed_precision()
+        if remat:
+            fluid.memory_optimization_transpiler \
+                .enable_rematerialization(main)
+        r = np.random.RandomState(12)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        out = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(3):
+                xs = r.rand(8, 1, 12, 12).astype("f")
+                ys = r.randint(0, 5, (8, 1)).astype("int64")
+                l, = exe.run(main, feed={"img": xs, "lab": ys},
+                             fetch_list=[loss])
+                out.append(float(np.ravel(l)[0]))
+        return out
+
+    base = train(False)
+    remat = train(True)
+    np.testing.assert_allclose(base, remat, rtol=5e-3, atol=1e-3)
+    assert np.isfinite(base).all()
