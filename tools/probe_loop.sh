@@ -1,0 +1,17 @@
+#!/bin/bash
+# Probe the tunnel every ~20 min; on the FIRST healthy probe, run the r4c
+# sweep (which banks+commits each measured line) and exit. Single
+# instance via its own lock.
+exec 9>/tmp/probe_loop.lock
+flock -n 9 || { echo "probe_loop already running"; exit 0; }
+cd /root/repo
+for i in $(seq 1 14); do
+  if bash tools/tpu_lock.sh timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) RECOVERED on probe $i — starting r4c sweep" >> /tmp/probe_loop.log
+    bash tools/perf_sweep_r4c.sh >> /tmp/probe_loop.log 2>&1
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) probe $i rc=124" >> /tmp/probe_loop.log
+  sleep 1200
+done
+echo "$(date -u +%FT%TZ) probe loop exhausted (14 probes)" >> /tmp/probe_loop.log
