@@ -16,6 +16,24 @@ import time
 import numpy as np
 
 
+def _error_line(msg):
+    """The one-JSON-line error payload, with the SAME metric/unit mapping
+    as the success paths so downstream aggregators keyed on metric names
+    bucket error lines correctly."""
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    token_metric = {"transformer": "transformer_cached_decode_throughput"
+                    if os.environ.get("BENCH_DECODE") == "1"
+                    else "transformer_train_throughput",
+                    "stacked_lstm": "stacked_lstm_train_throughput"}
+    tok = model in token_metric
+    return {"metric": token_metric.get(
+                model, "%s_imagenet_train_throughput" % model),
+            "value": 0.0,
+            "unit": "tokens/sec/chip" if tok else "images/sec/chip",
+            "vs_baseline": 0.0 if model == "resnet50" else None,
+            "error": msg}
+
+
 def _await_devices(timeout_s):
     """Device init probe with a watchdog: the axon tunnel can wedge with a
     never-returning claim RPC; better one JSON error line than a silent
@@ -38,19 +56,7 @@ def _await_devices(timeout_s):
             out["error"] = repr(e)
 
     def fail(msg):
-        model = os.environ.get("BENCH_MODEL", "resnet50")
-        token_metric = {"transformer": "transformer_cached_decode_throughput"
-                        if os.environ.get("BENCH_DECODE") == "1"
-                        else "transformer_train_throughput",
-                        "stacked_lstm": "stacked_lstm_train_throughput"}
-        tok = model in token_metric
-        print(json.dumps({
-            "metric": token_metric.get(
-                model, "%s_imagenet_train_throughput" % model),
-            "value": 0.0,
-            "unit": "tokens/sec/chip" if tok else "images/sec/chip",
-            "vs_baseline": 0.0 if model == "resnet50" else None,
-            "error": msg}))
+        print(json.dumps(_error_line(msg)))
         sys.stdout.flush()
         # skip atexit: jax teardown can block on the same wedged runtime
         os._exit(3)
@@ -286,7 +292,28 @@ _IMAGE_MODELS = {
 
 
 def main():
+    # Exclusive-client lock FIRST, synchronously, with a generous timeout:
+    # a wait here means another TPU client (e.g. the 2-min probe loop) is
+    # finishing — that is NOT a tunnel wedge and must not eat into the
+    # device-init watchdog below.  tpu_guard also hooks jax backend init,
+    # so the lock is held either way; this call just fronts the wait.
+    from paddle_tpu import tpu_guard
+    if not tpu_guard.cpu_only_env():
+        try:
+            tpu_guard.acquire_tpu_lock(timeout=float(
+                os.environ.get("PTPU_LOCK_TIMEOUT", "3600")))
+        except tpu_guard.TPULockTimeout as e:
+            print(json.dumps(_error_line(str(e))))
+            sys.stdout.flush()
+            os._exit(4)
     _await_devices(int(os.environ.get("BENCH_DEVICE_TIMEOUT", "600")))
+    # Loud-failure rule: never emit CPU numbers dressed up as TPU data
+    # (axon init failure falls back to CPU silently otherwise).
+    if tpu_guard.accelerator_missing():
+        print(json.dumps(_error_line(
+            "accelerator expected but only CPU devices initialized")))
+        sys.stdout.flush()
+        os._exit(3)
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "transformer":
         if os.environ.get("BENCH_DECODE") == "1":

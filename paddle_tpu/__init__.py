@@ -15,6 +15,8 @@ Execution is whole-program XLA compilation (core/lowering.py), autodiff is
 jax.vjp over op lowering rules (core/backward.py), and multi-device runs ride
 jax.sharding Meshes (parallel/).
 """
+from . import tpu_guard  # MUST be first: installs the exclusive TPU-client
+                         # lock on jax backend init (see tpu_guard.py)
 from .core import framework
 from .core.framework import (Program, Operator, Variable, Parameter,
                              default_main_program, default_startup_program,

@@ -1,0 +1,167 @@
+"""Mandatory exclusive-client guard for the TPU (axon tunnel) backend.
+
+The axon tunnel wedges its lease when two JAX clients overlap — this cost
+rounds 2-4 multi-hour outages, twice in round 4 alone even though
+``tools/tpu_lock.sh`` existed, because the lock was advisory (prose rules
+don't stop ad-hoc scripts).  This module makes the lock MANDATORY in code:
+importing ``paddle_tpu`` wraps ``jax._src.xla_bridge._init_backend`` so
+that initializing any non-CPU platform first acquires the same flock
+``tools/tpu_lock.sh`` uses (``/tmp/tpu_client.lock``).
+
+Semantics (chosen so the bench driver, which runs ``python bench.py`` with
+no wrapper, can never be locked out by a background probe):
+
+- CPU-only runs (``JAX_PLATFORMS=cpu`` — the test suite, the multichip
+  dryrun) never touch the lock.
+- If the lock is free: take it and hold it for the life of the process
+  (released by the OS at exit, crash included).
+- If an ancestor already holds it (``tools/tpu_lock.sh`` sets
+  ``PTPU_LOCK_HELD=1`` and the flock fd is inherited): proceed.
+- Otherwise BLOCK up to ``PTPU_LOCK_TIMEOUT`` seconds (default 1200 —
+  matches tpu_lock.sh) waiting for the other client to finish, then raise
+  ``TPULockTimeout``.  A stray second client therefore gets a Python
+  exception, not a wedged tunnel lease.
+
+Escape hatch: ``PTPU_LOCK_DISABLE=1`` (single-tenant environments).
+
+Parity note: the reference serializes GPU access per-process through the
+CUDA context + nccl communicator setup (paddle/fluid/platform/device_context.cc);
+a remote-tunnel TPU needs the serialization at the *host* level instead,
+which is what this flock provides.
+"""
+import fcntl
+import os
+import time
+
+LOCKFILE = "/tmp/tpu_client.lock"
+
+_lock_fd = None          # held for process lifetime once acquired
+_installed = False
+
+
+class TPULockTimeout(BaseException):
+    """Deliberately NOT an Exception: jax's multi-platform fallback wraps
+    backend init in ``except Exception`` and would otherwise fall back to
+    CPU — turning "second TPU client" into silently-wrong CPU benchmark
+    numbers.  A lock timeout must abort the process, not downgrade it."""
+
+
+def cpu_only_env():
+    """True when JAX_PLATFORMS explicitly restricts this process to CPU
+    (test suite / smoke runs) — such a process never needs the lock."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    parts = [p.strip() for p in want.split(",") if p.strip()]
+    return bool(parts) and all(p == "cpu" for p in parts)
+
+
+def acquire_tpu_lock(timeout=None):
+    """Idempotently acquire the exclusive TPU-client flock.
+
+    Returns immediately if already held by this process or an ancestor
+    (PTPU_LOCK_HELD, set by tools/tpu_lock.sh).  Blocks up to ``timeout``
+    seconds (default $PTPU_LOCK_TIMEOUT or 1200) otherwise.
+    """
+    global _lock_fd
+    if _lock_fd is not None:
+        return
+    if os.environ.get("PTPU_LOCK_DISABLE") == "1":
+        return
+    if os.environ.get("PTPU_LOCK_HELD") == "1":
+        # Ancestor (tools/tpu_lock.sh) claims to hold it via an inherited
+        # flock fd.  Verify rather than trust: if the lock is actually
+        # FREE the claim is stale (e.g. a backgrounded child outlived the
+        # flock wrapper) — take it ourselves.  If it is held we cannot
+        # distinguish ancestor from stranger, so honor the claim.
+        fd = os.open(LOCKFILE, os.O_CREAT | os.O_RDWR, 0o666)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            _lock_fd = fd  # stale claim; now genuinely held
+        except OSError:
+            os.close(fd)   # held (presumably by the ancestor): proceed
+        return
+    if timeout is None:
+        timeout = float(os.environ.get("PTPU_LOCK_TIMEOUT", "1200"))
+    fd = os.open(LOCKFILE, os.O_CREAT | os.O_RDWR, 0o666)
+    deadline = time.monotonic() + timeout
+    notified = False
+    while True:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            _lock_fd = fd  # hold until process exit
+            return
+        except OSError:
+            if time.monotonic() >= deadline:
+                os.close(fd)
+                raise TPULockTimeout(
+                    "another TPU client holds %s (waited %.0fs). The axon "
+                    "tunnel wedges on concurrent clients; run under "
+                    "tools/tpu_lock.sh or wait for the other client."
+                    % (LOCKFILE, timeout))
+            if not notified:
+                import sys
+                print("tpu_guard: %s busy; waiting up to %.0fs for the "
+                      "other TPU client..." % (LOCKFILE, timeout),
+                      file=sys.stderr)
+                notified = True
+            time.sleep(2.0)
+
+
+def accelerator_missing():
+    """True when this process was meant for the accelerator but jax
+    initialized only CPU devices (tunnel down / backend init error →
+    jax's silent CPU fallback).  False under JAX_PLATFORMS=cpu."""
+    if cpu_only_env():
+        return False
+    import jax
+    return all(d.platform == "cpu" for d in jax.devices())
+
+
+def require_accelerator(tool_name):
+    """Loud-failure rule for benchmark emitters: abort instead of emitting
+    CPU timings dressed up as TPU data.  No-op under JAX_PLATFORMS=cpu."""
+    if accelerator_missing():
+        import sys
+        sys.exit("%s: accelerator expected but only CPU devices "
+                 "initialized; refusing to emit CPU numbers" % tool_name)
+
+
+def install():
+    """Wrap jax's backend initialization so any non-CPU platform init
+    first acquires the exclusive client lock.  Idempotent."""
+    global _installed
+    if _installed:
+        return
+    try:
+        from jax._src import xla_bridge as xb
+        orig = xb._init_backend
+    except Exception:
+        # Private jax API moved: degrade to best-effort (explicit
+        # acquire_tpu_lock() calls in bench/tools still protect the
+        # tunnel) rather than making the whole package unimportable.
+        import warnings
+        warnings.warn("tpu_guard: jax backend-init hook unavailable; "
+                      "TPU-client lock is explicit-only in this process")
+        return
+
+    def _guarded_init_backend(platform, *a, **kw):
+        global _lock_fd
+        if platform in ("cpu",):
+            return orig(platform, *a, **kw)
+        had_lock = _lock_fd is not None
+        acquire_tpu_lock()
+        try:
+            return orig(platform, *a, **kw)
+        except BaseException:
+            # Init failed (tunnel down, plugin error): a process that is
+            # about to fall back to CPU must not keep the exclusive TPU
+            # lock for its whole life.
+            if not had_lock and _lock_fd is not None:
+                os.close(_lock_fd)
+                _lock_fd = None
+            raise
+
+    xb._init_backend = _guarded_init_backend
+    _installed = True
+
+
+install()

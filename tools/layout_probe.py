@@ -10,7 +10,17 @@ from jax import lax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+from paddle_tpu import tpu_guard  # noqa: E402,F401 - mandatory lock guard
 from paddle_tpu.core.utils import device_fetch_barrier  # noqa: E402
+
+# The image's sitecustomize pins jax config to "axon,cpu" regardless of the
+# env var; honor an explicit JAX_PLATFORMS request (cpu smoke runs must not
+# dial the tunnel), same as bench.py/_await().
+_want = os.environ.get("JAX_PLATFORMS")
+if _want:
+    jax.config.update("jax_platforms", _want)
+# Loud-failure rule: refuse to emit CPU timings dressed up as TPU data.
+tpu_guard.require_accelerator("layout_probe")
 
 
 def conv_stack(layout):

@@ -18,11 +18,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+from paddle_tpu import tpu_guard  # noqa: E402 - mandatory exclusive
+                                  # TPU-client lock (installs on import)
+
+
 def _await():
     import jax
     want = os.environ.get("JAX_PLATFORMS")
     if want:
         jax.config.update("jax_platforms", want)
+    tpu_guard.require_accelerator("pallas_microbench")
     return jax
 
 

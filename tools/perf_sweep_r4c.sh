@@ -99,3 +99,6 @@ probe && run 1200 BENCH_BATCH=1024 BENCH_DTYPE=bf16 BENCH_STEPS=5 BENCH_WARMUP=2
 probe && run 2400 BENCH_BATCH=1024 BENCH_DTYPE=bf16 BENCH_STEPS=5 BENCH_WARMUP=2 BENCH_REMAT=1
 bank
 echo "=== r4c sweep done (wedged=$WEDGED) ===" | tee -a $LOG
+# propagate wedge status so the probe loop can leave the sweep queued
+# (a wedged run refires on the next healthy window)
+exit $WEDGED

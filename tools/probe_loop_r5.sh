@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-5 probe loop. Probes the tunnel every ~20 min under the exclusive
+# client lock; on the FIRST healthy probe, runs the sweep script named in
+# tools/NEXT_SWEEP (re-read at fire time so the queued sweep can be
+# upgraded mid-round without restarting the loop), then RESUMES probing —
+# NEXT_SWEEP may be updated again after a window closes. Single instance
+# via its own lock. Log: /tmp/probe_loop_r5.log
+exec 9>/tmp/probe_loop_r5.lock
+flock -n 9 || { echo "probe_loop_r5 already running"; exit 0; }
+cd /root/repo
+LOG=/tmp/probe_loop_r5.log
+# Health = a NON-CPU device actually initialized; jax's silent CPU
+# fallback (tunnel down but fast-failing) must read as DOWN, not healthy.
+PROBE='import jax,sys; sys.exit(0 if any(d.platform!="cpu" for d in jax.devices()) else 1)'
+for i in $(seq 1 32); do
+  if bash tools/tpu_lock.sh timeout 120 python -c "$PROBE" >/dev/null 2>&1; then
+    SWEEP=$(head -1 tools/NEXT_SWEEP 2>/dev/null)
+    if [ -n "$SWEEP" ] && [ -f "$SWEEP" ]; then
+      echo "$(date -u +%FT%TZ) RECOVERED on probe $i — firing $SWEEP" >> $LOG
+      if bash "$SWEEP" >> $LOG 2>&1; then
+        # consume only after a successful run; a sweep that aborted
+        # (lock contention, tunnel died mid-run) stays queued and
+        # refires on the next healthy probe
+        : > tools/NEXT_SWEEP
+        echo "$(date -u +%FT%TZ) sweep $SWEEP finished; consumed" >> $LOG
+      else
+        echo "$(date -u +%FT%TZ) sweep $SWEEP failed (rc=$?); left queued" >> $LOG
+        sleep 1200
+      fi
+    else
+      echo "$(date -u +%FT%TZ) probe $i healthy; no sweep queued" >> $LOG
+      sleep 1200
+    fi
+  else
+    echo "$(date -u +%FT%TZ) probe $i rc!=0 (tunnel down or lock busy)" >> $LOG
+    sleep 1200
+  fi
+done
+echo "$(date -u +%FT%TZ) probe loop exhausted (32 probes)" >> $LOG

@@ -10,6 +10,10 @@
 # running" apart from "the tunnel is gone". The wrapped command's own rc
 # passes through untouched.
 LOCKFILE=/tmp/tpu_client.lock
+# Tell the in-process guard (paddle_tpu/tpu_guard.py) the flock is already
+# held by this wrapper (the locked fd is inherited through flock's exec),
+# so the wrapped python process must not try to re-acquire it.
+export PTPU_LOCK_HELD=1
 if ! flock -n "$LOCKFILE" true 2>/dev/null; then
   echo "tpu_lock: lock busy (another TPU client is running); waiting up to 20 min..." >&2
 fi
