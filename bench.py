@@ -21,15 +21,21 @@ def _error_line(msg):
     as the success paths so downstream aggregators keyed on metric names
     bucket error lines correctly."""
     model = os.environ.get("BENCH_MODEL", "resnet50")
+    decode = os.environ.get("BENCH_DECODE") == "1"
     token_metric = {"transformer": "transformer_cached_decode_throughput"
-                    if os.environ.get("BENCH_DECODE") == "1"
-                    else "transformer_train_throughput",
+                    if decode else "transformer_train_throughput",
                     "stacked_lstm": "stacked_lstm_train_throughput"}
     tok = model in token_metric
+    if model == "transformer" and decode:
+        unit = "emitted tokens/sec/chip"   # matches the success path
+    elif tok:
+        unit = "tokens/sec/chip"
+    else:
+        unit = "images/sec/chip"
     return {"metric": token_metric.get(
                 model, "%s_imagenet_train_throughput" % model),
             "value": 0.0,
-            "unit": "tokens/sec/chip" if tok else "images/sec/chip",
+            "unit": unit,
             "vs_baseline": 0.0 if model == "resnet50" else None,
             "error": msg}
 
@@ -200,11 +206,15 @@ def bench_transformer_decode():
         device_fetch_barrier(out)
         dt = time.perf_counter() - t0
 
-    # each run decodes up to seq-1 positions for batch*beam hypotheses
-    tps = batch * beam * (seq - 1) * steps / dt
+    # Throughput of EMITTED tokens (the returned hypotheses): each run
+    # decodes seq-1 positions per batch element. The decoder also scores
+    # beam-1 discarded hypotheses per step — that work is real but its
+    # tokens are not output, so counting them would inflate tokens/sec
+    # (ADVICE r4 #4); beam is in the JSON for FLOP reconstruction.
+    tps = batch * (seq - 1) * steps / dt
     print(json.dumps({
         "metric": "transformer_cached_decode_throughput",
-        "value": round(tps, 1), "unit": "tokens/sec/chip",
+        "value": round(tps, 1), "unit": "emitted tokens/sec/chip",
         "vs_baseline": None, "batch": batch, "beam": beam, "seq": seq,
         "layers": n_layer, "d_model": d_model,
         "device": str(jax.devices()[0])}))

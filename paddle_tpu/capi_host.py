@@ -121,6 +121,14 @@ def run_lod(handle, names, buffers, shapes, lods):
     is re-segmented into a LoDTensor; an empty entry is a dense feed."""
     from .core.lod import create_lod_tensor
 
+    # zip() would silently drop trailing feeds on a short list (the C
+    # entry point always builds nfeeds-length arrays, but direct Python
+    # callers can get it wrong) — validate up front (ADVICE r4 #1).
+    if not (len(names) == len(buffers) == len(shapes) == len(lods)):
+        raise ValueError(
+            "run_lod: mismatched feed lists: %d names, %d buffers, "
+            "%d shapes, %d lods" % (len(names), len(buffers),
+                                    len(shapes), len(lods)))
     p = _predictors[handle]
     feed = {}
     for name, buf, shape, lens in zip(names, buffers, shapes, lods):

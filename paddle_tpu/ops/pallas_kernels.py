@@ -242,7 +242,15 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     a dK/dV kernel gridded over k-blocks and a dQ kernel gridded over
     q-blocks, both with causal block skipping. Replaces the r4 plain-lax
     scan, which the microbench measured at 0.75x XLA's dense backward
-    (no causal skip, no VMEM residency control)."""
+    (no causal skip, no VMEM residency control).
+
+    VMEM budget (ADVICE r4 #3): each kernel pins one full [t_pad, d]
+    operand pair in VMEM per grid step (q+g for dK/dV, k+v for dQ) —
+    2*t_pad*d*2B bf16 ≈ 0.5 MB at t=2048, d=64, comfortably inside the
+    ~16 MB/core budget up to t≈32k. Streaming that pair through a second
+    grid axis (double-buffered) is the follow-up if longer single-core
+    sequences are ever benched; ring/Ulysses SP is the intended path for
+    those lengths (parallel/ring_attention.py)."""
     q, k, v, kv_len, out, lse = res
     bh, t, d = q.shape
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),

@@ -346,6 +346,15 @@ def read_lod_tensor_file(path):
 # segmentation via the generic propagation rule below
 _RECURRENT = frozenset(("lstm", "lstmp", "gru"))
 
+# Sequence-RESTRUCTURING ops this adapter does not rewrite: each changes
+# the segmentation itself (not just per-step values), so the generic
+# "propagate X's lengths to Out" rule below would be silently WRONG for
+# them.  Reject at load time instead (ADVICE r4 #2).
+_UNHANDLED_SEQ_RESTRUCTURING = frozenset((
+    "lod_reset", "sequence_concat", "sequence_slice", "sequence_erase",
+    "sequence_reshape", "sequence_pad", "sequence_unpad",
+))
+
 
 def adapt_sequence_layout(program, feed_names):
     """Rewire a loaded reference program from the flat-LoD-rows layout to
@@ -395,6 +404,23 @@ def adapt_sequence_layout(program, feed_names):
     for op in block.ops:
         t = op.type
         ins_names = [n for ns in op.inputs.values() for n in ns if n]
+        # --- reject segmentation-restructuring ops we cannot rewrite ---
+        if any(n in seqlen for n in ins_names):
+            if t in _UNHANDLED_SEQ_RESTRUCTURING:
+                raise ValueError(
+                    "adapt_sequence_layout: op %r restructures sequence "
+                    "segmentation and is not supported by the layout "
+                    "adapter; rebuild this program with the native "
+                    "paddle_tpu layers instead of loading the reference "
+                    "desc" % t)
+            # flat sequence vars are rank-2 [total_rows, D]: axis 0 and
+            # its negative alias -2 both denote the time axis
+            if t == "concat" and op.attrs.get("axis", 0) in (0, -2):
+                raise ValueError(
+                    "adapt_sequence_layout: concat with axis=0 on "
+                    "sequence data is time-axis concatenation "
+                    "(sequence_concat semantics) and is not supported "
+                    "by the layout adapter")
         # --- op-specific rank/wiring rewrites --------------------------
         if t == "mul" and first(op.inputs, "X") in seqlen:
             op.attrs["x_num_col_dims"] = \

@@ -371,3 +371,22 @@ def test_c_api_v2_lod_sequence_feeds(tmp_path):
     assert r == -1
     assert b"lengths sum" in lib.ptpu_last_error()
     lib.ptpu_destroy(ctypes.c_int64(h))
+
+
+def test_run_lod_rejects_mismatched_feed_lists(tmp_path):
+    """Direct Python callers of capi_host.run_lod with a short lods (or
+    buffers/shapes) list must get a ValueError, not silently dropped
+    trailing feeds (ADVICE r4 #1; the C entry point always builds
+    nfeeds-length arrays, so only Python callers are exposed)."""
+    from paddle_tpu import capi_host
+    model_dir = str(tmp_path / "m")
+    xs, _ = _save_model(model_dir)
+    h = capi_host.create(model_dir)
+    try:
+        buf = np.ascontiguousarray(xs).tobytes()
+        with pytest.raises(ValueError, match="mismatched feed lists"):
+            capi_host.run_lod(h, ["x"], [buf], [list(xs.shape)], [])
+        with pytest.raises(ValueError, match="mismatched feed lists"):
+            capi_host.run_lod(h, ["x"], [], [list(xs.shape)], [()])
+    finally:
+        capi_host.destroy(h)

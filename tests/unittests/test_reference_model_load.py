@@ -616,3 +616,38 @@ def test_load_reference_gru_model(tmp_path):
         for i, s in enumerate(seqs):
             np.testing.assert_allclose(out[i], np_gru_last(s.ravel()),
                                        rtol=1e-4, atol=1e-5)
+
+
+def test_adapt_rejects_unhandled_sequence_restructuring_ops():
+    """A loaded desc whose sequence data flows into a segmentation-
+    RESTRUCTURING op the adapter cannot rewrite (lod_reset,
+    sequence_concat, ..., or time-axis concat) must fail loudly at load
+    time — generic propagation would silently hand X's old lengths to
+    Out (ADVICE r4 #2)."""
+    def seq_program(mid_op):
+        varz = [
+            var_desc("words", 5, [-1, 4], lod_level=1),
+            var_desc("out", 5, [-1, 4], lod_level=1),
+        ]
+        raw = _ld(1, block_desc(0, -1, varz, [mid_op]))
+        return rf.parse_program_desc(raw)
+
+    for t in ("lod_reset", "sequence_concat", "sequence_pad"):
+        prog = seq_program(op_desc(t, [("X", ["words"])],
+                                   [("Out", ["out"])]))
+        with pytest.raises(ValueError, match="restructures sequence"):
+            rf.adapt_sequence_layout(prog, ["words"])
+
+    # time-axis concat (axis=0, or its rank-2 negative alias -2) on
+    # sequence data == sequence_concat
+    for ax in (0, -2):
+        prog = seq_program(op_desc("concat", [("X", ["words"])],
+                                   [("Out", ["out"])],
+                                   [attr("axis", 0, ax)]))
+        with pytest.raises(ValueError, match="time-axis"):
+            rf.adapt_sequence_layout(prog, ["words"])
+
+    # feature-axis concat (axis=1) stays supported
+    prog = seq_program(op_desc("concat", [("X", ["words"])],
+                               [("Out", ["out"])], [attr("axis", 0, 1)]))
+    rf.adapt_sequence_layout(prog, ["words"])  # must not raise
