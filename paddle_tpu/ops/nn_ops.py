@@ -34,7 +34,12 @@ def _conv_layout():
     missing #4). Read at trace time: set it before the first run of a
     program (the jit cache keys on the program, not the flag)."""
     import os
-    return os.environ.get("FLAGS_conv_layout", "NCHW").upper()
+    layout = os.environ.get("FLAGS_conv_layout", "NCHW").upper()
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError(
+            "FLAGS_conv_layout=%r: expected NCHW or NHWC (a typo here "
+            "would otherwise silently run the NCHW path)" % layout)
+    return layout
 
 
 # ---------------------------------------------------------------------------

@@ -10,3 +10,4 @@ from .distributed import init_distributed, shutdown_distributed, \
     global_mesh, is_initialized as distributed_is_initialized
 from .moe import moe_layer, init_moe_params, moe_param_specs
 from .ulysses import ulysses_attention, ulysses_attention_sharded
+from . import tp

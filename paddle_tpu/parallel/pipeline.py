@@ -97,7 +97,7 @@ def _pipeline_shard(params, xs, stage_fn, axis_name, vary_axes):
 
 
 def pipeline_apply(stage_fn, stacked_params, x, mesh, num_microbatches=None,
-                   axis="pp", batch_axis=None):
+                   axis="pp", batch_axis=None, param_specs=None):
     """Run x through S pipeline stages sharded over mesh axis `axis`.
 
     stage_fn(params, x_mb) -> y_mb must be shape-preserving (homogeneous
@@ -107,6 +107,13 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, num_microbatches=None,
     that keeps every stage busy; more microbatches shrink the bubble
     fraction (S-1)/(M+S-1)). batch_axis: optional mesh axis ('dp') to
     additionally shard the microbatch dim — dp×pp composition on one mesh.
+
+    param_specs: optional PartitionSpec pytree (same structure as
+    stacked_params) overriding the default P(axis)-on-the-stage-dim
+    placement — the dp×mp×pp composition hook: shard stage weights over
+    BOTH 'pp' and a tensor-parallel axis (e.g. tp.mlp_block_specs(
+    tp_axis='mp', pp_axis='pp')) and have stage_fn do its own mp
+    collectives (tp.mlp_block_apply(..., tp_axis='mp')).
 
     Differentiable end to end; jit-compatible (call under the mesh).
     """
@@ -129,7 +136,8 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, num_microbatches=None,
         functools.partial(_pipeline_shard, stage_fn=stage_fn,
                           axis_name=axis, vary_axes=vary_axes),
         mesh=mesh,
-        in_specs=(pipeline_stages_spec(stacked_params, axis), x_spec),
+        in_specs=(param_specs if param_specs is not None
+                  else pipeline_stages_spec(stacked_params, axis), x_spec),
         out_specs=x_spec)
     out = fn(stacked_params, xs)
     return out.reshape((B,) + out.shape[2:])

@@ -1,0 +1,73 @@
+"""Tensor (model) parallelism: Megatron-style column/row-parallel blocks
+over a mesh ``mp`` axis, composable with the pipeline (``pp``) and data
+(``dp``) axes on ONE Mesh (SURVEY.md §2 "DP/TP/PP/SP composable").
+
+TPU-first design: the reference era's only model-partitioning story is the
+pserver parameter split (python/paddle/fluid/distribute_transpiler.py),
+which shards the *storage* of parameters, not the *math*. Here the math is
+sharded: the first matmul is column-parallel (weight split on its output
+dim, activations stay local), the second is row-parallel (weight split on
+its input dim) followed by one ``psum`` over ``mp`` — the classic
+two-matmul block with a single collective, riding ICI.
+
+Two execution modes, same params + specs:
+
+- GSPMD mode (no shard_map): apply with ``tp_axis=None``; place the
+  params with ``mlp_block_specs()`` and let XLA insert the collectives.
+- Manual mode (inside ``shard_map`` — e.g. a pipeline stage, where the
+  ``pp`` schedule is already manual): apply with ``tp_axis="mp"``; the
+  block psums explicitly. This is what makes dp×mp×pp composition work:
+  ``pipeline_apply(param_specs=...)`` shards the stacked stage weights
+  over BOTH 'pp' (stage dim) and 'mp' (hidden dim), and each stage runs
+  this block with its local weight shards.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import P
+
+__all__ = ["mlp_block_init", "mlp_block_apply", "mlp_block_specs"]
+
+
+def mlp_block_init(rng, d, d_hidden, scale=0.1):
+    """Params for one tanh MLP block: [d -> d_hidden -> d] (shape-
+    preserving, so it can serve as a homogeneous pipeline stage)."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(rng) if isinstance(rng, int)
+                              else rng)
+    return {
+        "w1": jax.random.normal(k1, (d, d_hidden), jnp.float32) * scale,
+        "b1": jnp.zeros((d_hidden,), jnp.float32),
+        "w2": jax.random.normal(k2, (d_hidden, d), jnp.float32) * scale,
+        "b2": jnp.zeros((d,), jnp.float32),
+    }
+
+
+def mlp_block_specs(tp_axis="mp", pp_axis=None):
+    """PartitionSpecs for (optionally stage-stacked) mlp_block params.
+
+    Column-parallel w1/b1 split the hidden dim over ``tp_axis``; the
+    row-parallel w2 splits its input (hidden) dim; b2 is replicated over
+    mp (added after the psum). With ``pp_axis`` set, a leading stacked
+    stage dim is sharded over it (pipeline composition)."""
+    def pp(*rest):
+        return P(pp_axis, *rest) if pp_axis else P(*rest)
+    return {
+        "w1": pp(None, tp_axis),
+        "b1": pp(tp_axis),
+        "w2": pp(tp_axis, None),
+        "b2": pp(None),
+    }
+
+
+def mlp_block_apply(params, x, tp_axis=None):
+    """y = w2ᵀ·tanh(w1ᵀx + b1) + b2, with the hidden dim sharded over
+    ``tp_axis`` when running manually inside shard_map (one psum — the
+    Megatron pattern). With tp_axis=None this is the dense math (use
+    under GSPMD with mlp_block_specs placements, or as the single-chip
+    reference)."""
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    z = h @ params["w2"]
+    if tp_axis is not None:
+        z = lax.psum(z, tp_axis)
+    return z + params["b2"]

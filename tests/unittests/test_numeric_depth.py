@@ -3,6 +3,7 @@ vs an independent host-side beam implementation, finite-difference grad
 checks for the differentiable detection ops, and Executor cache behavior.
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 
@@ -299,3 +300,18 @@ def test_nhwc_conv_layout_matches_nchw(monkeypatch):
     monkeypatch.setenv("FLAGS_conv_layout", "NHWC")
     nhwc = run_once()
     np.testing.assert_allclose(base, nhwc, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_layout_default_is_nchw(monkeypatch):
+    """The committed layout decision (ARCHITECTURE.md §12b, measured on
+    the real v5e: NCHW 2210.5 vs NHWC 2208.7 img/s — a tie, so the fluid
+    contract wins): NCHW is the default; NHWC is opt-in via
+    FLAGS_conv_layout and invalid values fail loudly."""
+    from paddle_tpu.ops import nn_ops
+    monkeypatch.delenv("FLAGS_conv_layout", raising=False)
+    assert nn_ops._conv_layout() == "NCHW"
+    monkeypatch.setenv("FLAGS_conv_layout", "nhwc")
+    assert nn_ops._conv_layout() == "NHWC"
+    monkeypatch.setenv("FLAGS_conv_layout", "NWHC")  # typo
+    with pytest.raises(ValueError, match="NCHW or NHWC"):
+        nn_ops._conv_layout()
