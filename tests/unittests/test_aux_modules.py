@@ -155,3 +155,48 @@ def test_fetch_param_from_startup_program():
     with fluid.scope_guard(scope):
         w, = exe.run(startup, fetch_list=[w_name])
     assert np.asarray(w).shape == (4, 2)
+
+
+def test_unique_name_generate_switch_guard():
+    """Parity with the reference's test_unique_name.py: generate()
+    produces distinct monotonically-suffixed names per key, switch()
+    swaps the generator state, and guard() restores it."""
+    from paddle_tpu import unique_name
+    with unique_name.guard():
+        a0 = unique_name.generate("fc")
+        a1 = unique_name.generate("fc")
+        b0 = unique_name.generate("conv")
+        assert a0 != a1 and a0.startswith("fc") and b0.startswith("conv")
+        old = unique_name.switch()          # fresh generator
+        f0 = unique_name.generate("fc")
+        assert f0 == a0                     # counters restarted
+        unique_name.switch(old)             # back to the first generator
+        a2 = unique_name.generate("fc")
+        assert a2 not in (a0, a1)
+    with unique_name.guard():
+        assert unique_name.generate("fc") == a0  # guard isolates state
+
+
+def test_default_scope_funcs_stack_and_lookup():
+    """Parity with the reference's test_default_scope_funcs.py: the
+    thread-local scope stack, ancestor lookup, and scoped_function."""
+    from paddle_tpu import default_scope_funcs as dsf
+    base = dsf.get_cur_scope()
+    dsf.var("outer_v")
+    dsf.enter_local_scope()
+    try:
+        assert dsf.get_cur_scope() is not base
+        assert dsf.find_var("outer_v") is not None   # ancestor lookup
+        dsf.var("inner_v")
+        assert dsf.find_var("inner_v") is not None
+    finally:
+        dsf.leave_local_scope()
+    assert dsf.get_cur_scope() is base
+    assert dsf.find_var("outer_v") is not None
+
+    seen = {}
+    def body():
+        dsf.var("scoped_v")
+        seen["inside"] = dsf.find_var("scoped_v") is not None
+    dsf.scoped_function(body)
+    assert seen["inside"]

@@ -1,0 +1,417 @@
+"""Executable audit: every file in the reference's unittest suite
+(python/paddle/fluid/tests/unittests/, ~v0.11 snapshot, 199 entries incl. dotfiles) must
+map to a ported OpTest-config tranche, an equivalent repo test file, or a
+documented skip with a reason (round-4 verdict missing #3 done-gate — the
+mirror of test_reference_op_files_audit.py for *tests* instead of *ops*).
+
+The file list is a frozen snapshot so the audit runs without the reference
+checkout present; when the checkout IS present the snapshot is re-verified
+against the live tree (same contract as the op-file audit).
+"""
+import os
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TESTS_ROOT = os.path.dirname(HERE)
+REFERENCE_DIR = "/root/reference/python/paddle/fluid/tests/unittests"
+
+# Frozen `ls -a` (minus . ..) of the reference unittest directory
+# (199 entries including .gitignore).
+REFERENCE_FILES = """
+.gitignore CMakeLists.txt __init__.py decorators.py op_test.py
+test_accuracy_op.py test_activation_op.py test_adadelta_op.py
+test_adagrad_op.py test_adam_op.py test_adamax_op.py
+test_array_read_write_op.py test_assign_op.py test_assign_value_op.py
+test_auc_op.py test_batch_norm_op.py test_beam_search_decode_op.py
+test_beam_search_op.py test_bilinear_tensor_product_op.py
+test_bipartite_match_op.py test_box_coder_op.py test_calc_gradient.py
+test_cast_op.py test_chunk_eval_op.py test_clip_by_norm_op.py
+test_clip_op.py test_compare_op.py test_concat_op.py test_cond_op.py
+test_conditional_block.py test_const_value.py test_conv2d_op.py
+test_conv2d_transpose_op.py test_conv3d_op.py
+test_conv3d_transpose_op.py test_conv_shift_op.py test_cos_sim_op.py
+test_create_op_doc_string.py test_crf_decoding_op.py test_crop_op.py
+test_cross_entropy_op.py test_ctc_align.py test_cumsum_op.py
+test_debugger.py test_decayed_adagrad_op.py test_default_scope_funcs.py
+test_detection_map_op.py test_dropout_op.py test_dyn_rnn.py
+test_dynrnn_gradient_check.py test_dynrnn_static_input.py
+test_edit_distance_op.py test_elementwise_add_op.py
+test_elementwise_div_op.py test_elementwise_max_op.py
+test_elementwise_min_op.py test_elementwise_mul_op.py
+test_elementwise_pow_op.py test_elementwise_sub_op.py test_exception.py
+test_executor_and_mul.py test_expand_op.py test_feed_fetch_method.py
+test_fetch_var.py test_fill_constant_batch_size_like_op.py
+test_fill_constant_op.py test_fill_op.py test_fill_zeros_like_op.py
+test_framework_debug_str.py test_ftrl_op.py test_gather_op.py
+test_gaussian_random_batch_size_like_op.py test_gaussian_random_op.py
+test_get_places_op.py test_gru_op.py test_gru_unit_op.py
+test_hinge_loss_op.py test_huber_loss_op.py test_im2sequence_op.py
+test_image_classification_layer.py test_infer_shape.py
+test_inference_model_io.py test_initializer.py test_iou_similarity_op.py
+test_is_empty_op.py test_l1_norm_op.py test_label_smooth_op.py
+test_layer_norm_op.py test_layers.py test_learning_rate_scheduler.py
+test_linear_chain_crf_op.py test_lod_array_length_op.py
+test_lod_rank_table.py test_lod_reset_op.py test_lod_tensor_array.py
+test_lod_tensor_array_ops.py test_log_loss_op.py test_logical_op.py
+test_lookup_table_op.py test_lrn_op.py test_lstm_op.py
+test_lstm_unit_op.py test_lstmp_op.py test_margin_rank_loss_op.py
+test_math_op_patch.py test_matmul_op.py test_maxout_op.py
+test_mean_op.py test_memory_optimization_transpiler.py
+test_mine_hard_examples_op.py test_minus_op.py
+test_modified_huber_loss_op.py test_momentum_op.py test_mul_op.py
+test_multi_pass_reader.py test_multiclass_nms_op.py
+test_multihead_attention.py test_multiple_reader.py
+test_multiplex_op.py test_nce.py test_net.py test_norm_op.py
+test_normalization_wrapper.py test_nvprof.py test_one_hot_op.py
+test_op_support_gpu.py test_operator.py test_operator_desc.py
+test_optimizer.py test_pad_op.py test_parallel_op.py test_parameter.py
+test_pool2d_op.py test_pool3d_op.py test_pool_max_op.py
+test_positive_negative_pair_op.py test_precision_recall_op.py
+test_prelu_op.py test_print_op.py test_prior_box_op.py
+test_profiler.py test_program.py test_protobuf.py
+test_protobuf_descs.py test_proximal_adagrad_op.py
+test_proximal_gd_op.py test_rank_loss_op.py test_recordio_reader.py
+test_recurrent_op.py test_recv_op.py test_reduce_op.py
+test_registry.py test_regularizer.py test_reorder_lod_tensor.py
+test_reshape_op.py test_rmsprop_op.py test_rnn_memory_helper_op.py
+test_roi_pool_op.py test_row_conv_op.py test_scale_op.py
+test_scatter_op.py test_scope.py test_selected_rows.py
+test_seq_concat_op.py test_seq_conv.py test_seq_pool.py
+test_sequence_erase_op.py test_sequence_expand.py
+test_sequence_reshape.py test_sequence_slice_op.py
+test_sequence_softmax_op.py test_sgd_op.py test_shrink_rnn_memory.py
+test_sigmoid_cross_entropy_with_logits_op.py test_sign_op.py
+test_smooth_l1_loss_op.py test_softmax_op.py
+test_softmax_with_cross_entropy_op.py
+test_split_and_merge_lod_tensor_op.py test_split_op.py
+test_split_selected_rows_op.py test_split_var.py test_spp_op.py
+test_squared_l2_distance_op.py test_squared_l2_norm_op.py
+test_sum_op.py test_switch.py test_target_assign_op.py test_tensor.py
+test_top_k_op.py test_transpose_op.py
+test_uniform_random_batch_size_like_op.py test_uniform_random_op.py
+test_unique_name.py test_unpool_op.py test_variable.py
+test_warpctc_op.py test_weight_normalization.py test_while_op.py
+""".split()
+
+# --- disposition 1: ported as reference-OpTest-config tranches -------------
+# (tests/unittests/test_ref_opconfigs*.py re-run the reference tests'
+# attr/shape grids through the real executor path vs numpy references)
+T1 = "unittests/test_ref_opconfigs.py"
+T2 = "unittests/test_ref_opconfigs2.py"
+T3 = "unittests/test_ref_opconfigs3.py"
+T4 = "unittests/test_ref_opconfigs4.py"
+T5 = "unittests/test_ref_opconfigs5.py"
+
+TRANCHE = {
+    "test_activation_op.py": T1,
+    "test_adam_op.py": T4,
+    "test_batch_norm_op.py": T2,
+    "test_box_coder_op.py": T5,
+    "test_cast_op.py": T3,
+    "test_clip_by_norm_op.py": T4,
+    "test_clip_op.py": T1,
+    "test_compare_op.py": T4,
+    "test_concat_op.py": T1,
+    "test_conv2d_op.py": T1,
+    "test_conv2d_transpose_op.py": T1,
+    "test_cos_sim_op.py": T3,
+    "test_crop_op.py": T2,
+    "test_cross_entropy_op.py": T1,
+    "test_cumsum_op.py": T1,
+    "test_dropout_op.py": T1,
+    "test_edit_distance_op.py": T1,
+    "test_elementwise_add_op.py": T1,
+    "test_elementwise_div_op.py": T1,
+    "test_elementwise_max_op.py": T1,
+    "test_elementwise_min_op.py": T1,
+    "test_elementwise_mul_op.py": T1,
+    "test_elementwise_pow_op.py": T1,
+    "test_elementwise_sub_op.py": T1,
+    "test_expand_op.py": T2,
+    "test_ftrl_op.py": T4,
+    "test_gather_op.py": T1,
+    "test_gaussian_random_batch_size_like_op.py": T3,
+    "test_gaussian_random_op.py": T3,
+    "test_gru_op.py": T3,
+    "test_gru_unit_op.py": T4,
+    "test_hinge_loss_op.py": T3,
+    "test_huber_loss_op.py": T3,
+    "test_im2sequence_op.py": T2,
+    "test_is_empty_op.py": T3,
+    "test_label_smooth_op.py": T3,
+    "test_layer_norm_op.py": T2,
+    "test_lod_reset_op.py": T3,
+    "test_log_loss_op.py": T3,
+    "test_logical_op.py": T4,
+    "test_lookup_table_op.py": T1,
+    "test_lrn_op.py": T1,
+    "test_lstm_op.py": T3,
+    "test_lstm_unit_op.py": T4,
+    "test_margin_rank_loss_op.py": T3,
+    "test_matmul_op.py": T1,
+    "test_maxout_op.py": T1,
+    "test_mine_hard_examples_op.py": T5,
+    "test_mul_op.py": T1,
+    "test_multiclass_nms_op.py": T5,
+    "test_multiplex_op.py": T3,
+    "test_one_hot_op.py": T1,
+    "test_pad_op.py": T2,
+    "test_pool2d_op.py": T1,
+    "test_prelu_op.py": T2,
+    "test_prior_box_op.py": T5,
+    "test_rank_loss_op.py": T3,
+    "test_reduce_op.py": T1,
+    "test_rmsprop_op.py": T4,
+    "test_row_conv_op.py": T2,
+    "test_scale_op.py": T4,
+    "test_scatter_op.py": T1,
+    "test_seq_concat_op.py": T3,
+    "test_seq_pool.py": T1,
+    "test_sequence_expand.py": T1,
+    "test_sequence_slice_op.py": T3,
+    "test_sequence_softmax_op.py": T3,
+    "test_sign_op.py": T3,
+    "test_smooth_l1_loss_op.py": T2,
+    "test_softmax_op.py": T1,
+    "test_softmax_with_cross_entropy_op.py": T3,
+    "test_split_op.py": T1,
+    "test_sum_op.py": T1,
+    "test_target_assign_op.py": T5,
+    "test_top_k_op.py": T4,
+    "test_transpose_op.py": T1,
+    "test_uniform_random_batch_size_like_op.py": T3,
+    "test_uniform_random_op.py": T3,
+}
+
+# --- disposition 2: equivalent repo test file(s) ---------------------------
+# Paths relative to tests/; each named file must exist (asserted below).
+U = "unittests/"
+B = "book/"
+EQUIV = {
+    "op_test.py": [U + "op_test.py"],
+    "test_accuracy_op.py": [U + "test_aux_modules.py",
+                            U + "test_ops_coverage.py"],
+    "test_adadelta_op.py": [U + "test_optimizer_numeric.py"],
+    "test_adagrad_op.py": [U + "test_optimizer_numeric.py"],
+    "test_adamax_op.py": [U + "test_optimizer_numeric.py"],
+    "test_array_read_write_op.py": [U + "test_control_flow.py"],
+    "test_assign_op.py": [U + "test_ops_coverage.py"],
+    "test_assign_value_op.py": [U + "test_loss_misc_ops.py"],
+    "test_auc_op.py": [U + "test_metrics_auc.py"],
+    "test_beam_search_decode_op.py": [U + "test_control_flow.py",
+                                      B + "test_machine_translation.py"],
+    "test_beam_search_op.py": [U + "test_control_flow.py",
+                               B + "test_machine_translation.py"],
+    "test_bilinear_tensor_product_op.py": [U + "test_tail_ops.py"],
+    "test_bipartite_match_op.py": [U + "test_detection_ops.py"],
+    "test_calc_gradient.py": [U + "test_calc_gradient_weight_norm.py"],
+    "test_chunk_eval_op.py": [U + "test_crf_ops.py"],
+    "test_cond_op.py": [U + "test_control_flow.py"],
+    "test_conditional_block.py": [U + "test_control_flow.py"],
+    "test_conv3d_op.py": [U + "test_volumetric_ops.py"],
+    "test_conv3d_transpose_op.py": [U + "test_volumetric_ops.py"],
+    "test_conv_shift_op.py": [U + "test_program_fuzz.py",
+                              U + "test_tail_ops.py"],
+    "test_crf_decoding_op.py": [U + "test_crf_ops.py"],
+    "test_ctc_align.py": [U + "test_ctc_ops.py"],
+    "test_debugger.py": [U + "test_aux_modules.py"],
+    "test_decayed_adagrad_op.py": [U + "test_optimizer_numeric.py"],
+    "test_default_scope_funcs.py": [U + "test_aux_modules.py"],
+    "test_detection_map_op.py": [U + "test_aux_modules.py",
+                                 U + "test_tail_ops.py"],
+    "test_dyn_rnn.py": [U + "test_control_flow.py",
+                        U + "test_rnn_numeric.py"],
+    "test_dynrnn_gradient_check.py": [U + "test_control_flow.py"],
+    "test_dynrnn_static_input.py": [U + "test_control_flow.py"],
+    "test_exception.py": [U + "test_checkpoint_and_errors.py"],
+    "test_executor_and_mul.py": [U + "test_ops_numeric.py",
+                                 U + "test_fit_a_line.py"],
+    "test_feed_fetch_method.py": [U + "test_program_tooling_zoo.py"],
+    "test_fetch_var.py": [U + "test_aux_modules.py"],
+    "test_fill_constant_batch_size_like_op.py": [
+        U + "test_program_prune.py", U + "test_ops_coverage.py"],
+    "test_fill_constant_op.py": [U + "test_program_prune.py",
+                                 U + "test_ops_coverage.py"],
+    "test_fill_op.py": [U + "test_ops_coverage.py"],
+    "test_fill_zeros_like_op.py": [U + "test_loss_misc_ops.py"],
+    "test_framework_debug_str.py": [U + "test_aux_modules.py"],
+    "test_image_classification_layer.py": [U + "test_image_models.py"],
+    "test_infer_shape.py": [U + "test_program_fuzz.py"],
+    "test_inference_model_io.py": [U + "test_inference_model.py"],
+    "test_initializer.py": [U + "test_regularizer_clip_init.py"],
+    "test_iou_similarity_op.py": [U + "test_detection_ops.py"],
+    "test_l1_norm_op.py": [U + "test_tail_ops.py"],
+    "test_layers.py": [U + "test_reference_api_parity.py"],
+    "test_learning_rate_scheduler.py": [U + "test_lr_scheduler.py"],
+    "test_linear_chain_crf_op.py": [U + "test_crf_ops.py"],
+    "test_lod_array_length_op.py": [U + "test_control_flow.py"],
+    "test_lod_rank_table.py": [U + "test_rank_table_ops.py"],
+    "test_lod_tensor_array.py": [U + "test_tensor_array_capacity.py"],
+    "test_lod_tensor_array_ops.py": [U + "test_control_flow.py",
+                                     U + "test_rank_table_ops.py"],
+    "test_lstmp_op.py": [U + "test_rnn_numeric.py"],
+    "test_math_op_patch.py": [U + "test_math_op_patch.py"],
+    "test_mean_op.py": [U + "test_ops_coverage.py"],
+    "test_memory_optimization_transpiler.py": [U + "test_aux_modules.py",
+                                               U + "test_remat_segments.py"],
+    "test_minus_op.py": [U + "test_loss_misc_ops.py"],
+    "test_modified_huber_loss_op.py": [U + "test_tail_ops.py"],
+    "test_momentum_op.py": [U + "test_optimizer_numeric.py"],
+    "test_multi_pass_reader.py": [U + "test_reader_layers.py"],
+    "test_multihead_attention.py": [B + "test_transformer.py",
+                                    U + "test_long_context_training.py"],
+    "test_multiple_reader.py": [U + "test_reader_layers.py"],
+    "test_nce.py": [U + "test_ctc_ops.py"],
+    "test_net.py": [U + "test_nets_composites.py"],
+    "test_norm_op.py": [U + "test_ref_opconfigs2.py"],
+    "test_normalization_wrapper.py": [
+        U + "test_calc_gradient_weight_norm.py",
+        U + "test_ops_coverage.py"],
+    "test_operator.py": [U + "test_program_tooling_zoo.py"],
+    "test_operator_desc.py": [U + "test_program_tooling_zoo.py"],
+    "test_optimizer.py": [U + "test_optimizer_numeric.py"],
+    "test_parallel_op.py": [U + "test_control_flow.py",
+                            U + "test_program_parallelism.py"],
+    "test_parameter.py": [U + "test_regularizer_clip_init.py",
+                          U + "test_program_tooling_zoo.py"],
+    "test_pool3d_op.py": [U + "test_volumetric_ops.py"],
+    "test_pool_max_op.py": [U + "test_tail_ops.py"],
+    "test_positive_negative_pair_op.py": [U + "test_tail_ops.py"],
+    "test_precision_recall_op.py": [U + "test_tail_ops.py"],
+    "test_print_op.py": [U + "test_api_parity_shims.py"],
+    "test_profiler.py": [U + "test_profiler_and_io_data.py"],
+    "test_program.py": [U + "test_program_prune.py",
+                        U + "test_program_tooling_zoo.py"],
+    "test_protobuf_descs.py": [U + "test_program_tooling_zoo.py"],
+    "test_proximal_adagrad_op.py": [U + "test_optimizer_numeric.py"],
+    "test_proximal_gd_op.py": [U + "test_optimizer_numeric.py"],
+    "test_recordio_reader.py": [U + "test_recordio.py"],
+    "test_recurrent_op.py": [U + "test_control_flow.py"],
+    "test_recv_op.py": [U + "test_distribute_transpiler.py"],
+    "test_registry.py": [U + "test_ops_coverage.py"],
+    "test_regularizer.py": [U + "test_regularizer_clip_init.py"],
+    "test_reorder_lod_tensor.py": [U + "test_rank_table_ops.py"],
+    "test_reshape_op.py": [U + "test_ops_coverage.py",
+                           U + "test_mixed_precision.py"],
+    "test_roi_pool_op.py": [U + "test_detection_ops.py"],
+    "test_scope.py": [U + "test_checkpoint_and_errors.py",
+                      U + "test_aux_modules.py"],
+    "test_seq_conv.py": [U + "test_sequence_ops.py",
+                         U + "test_sequence_deep.py"],
+    "test_sequence_erase_op.py": [U + "test_ctc_ops.py"],
+    "test_sequence_reshape.py": [U + "test_sequence_deep.py"],
+    "test_sgd_op.py": [U + "test_optimizer_numeric.py"],
+    "test_shrink_rnn_memory.py": [U + "test_rank_table_ops.py"],
+    "test_sigmoid_cross_entropy_with_logits_op.py": [
+        U + "test_loss_misc_ops.py"],
+    "test_split_and_merge_lod_tensor_op.py": [U + "test_control_flow.py"],
+    "test_split_var.py": [U + "test_distribute_transpiler.py"],
+    "test_spp_op.py": [U + "test_tail_ops.py"],
+    "test_squared_l2_distance_op.py": [U + "test_tail_ops.py"],
+    "test_squared_l2_norm_op.py": [U + "test_tail_ops.py"],
+    "test_switch.py": [U + "test_control_flow.py"],
+    "test_tensor.py": [U + "test_sequence_deep.py"],
+    "test_unique_name.py": [U + "test_aux_modules.py"],
+    "test_unpool_op.py": [U + "test_tail_ops.py"],
+    "test_variable.py": [U + "test_program_tooling_zoo.py"],
+    "test_warpctc_op.py": [U + "test_ctc_ops.py"],
+    "test_weight_normalization.py": [
+        U + "test_calc_gradient_weight_norm.py"],
+    "test_while_op.py": [U + "test_control_flow.py"],
+}
+
+# --- disposition 3: documented skips ---------------------------------------
+SKIP = {
+    ".gitignore": "VCS metadata, not a test",
+    "CMakeLists.txt": "build-system file, not a test",
+    "__init__.py": "package marker, not a test",
+    "decorators.py": "reference test-harness helper (@prog_scope); the "
+                     "repo uses pytest fixtures + program_guard instead",
+    "test_const_value.py": "asserts C++ core string constants "
+                           "(kEmptyVarName etc.) exist; the TPU design "
+                           "has no C++ scope-name constants — the "
+                           "framework surface is audited by "
+                           "test_reference_api_parity.py",
+    "test_create_op_doc_string.py": "asserts the C++ OpProto doc-string "
+                                    "machinery; lowering rules are "
+                                    "Python (docstrings native), no "
+                                    "OpProto exists by design",
+    "test_nvprof.py": "CUDA nvprof integration; CUDA-only by "
+                      "definition. The profiler bridge equivalent is "
+                      "tested in test_profiler_and_io_data.py",
+    "test_op_support_gpu.py": "queries the C++ registry for GPU "
+                              "kernels; no GPU in the design — "
+                              "places.is_compiled_with_cuda() is "
+                              "False-by-contract (places.py)",
+    "test_protobuf.py": "smoke-tests the protobuf *runtime* the "
+                        "reference links against; this framework has "
+                        "no protobuf dependency (reference_format.py "
+                        "parses the wire format directly, covered by "
+                        "test_reference_model_load.py)",
+    "test_rnn_memory_helper_op.py": "rnn_memory_helper is the "
+                                    "reference's manual RNN-state "
+                                    "plumbing; lax.scan carries state "
+                                    "natively (subsumed — see the op "
+                                    "audit NAME_SUBSUMED)",
+    "test_selected_rows.py": "SelectedRows is the reference's sparse "
+                             "gradient carrier; gradients are dense "
+                             "by design on TPU (SURVEY §6: pserver "
+                             "sparse updates become dense sharded "
+                             "updates), lookup_table grads verified "
+                             "dense in test_ref_opconfigs.py",
+    "test_split_selected_rows_op.py": "SelectedRows splitting for the "
+                                      "pserver path; see "
+                                      "test_selected_rows.py skip — "
+                                      "the split *policy* equivalents "
+                                      "are tested in "
+                                      "test_distribute_transpiler.py",
+    "test_get_places_op.py": "get_places is a CPU/GPU device-count op "
+                             "feeding ParallelDo; device enumeration "
+                             "is jax.devices() (ParallelDo itself is "
+                             "tested in test_control_flow.py)",
+}
+
+
+ALL_DISPOSED = set(TRANCHE) | set(EQUIV) | set(SKIP)
+
+
+def test_every_reference_test_file_is_accounted_for():
+    missing = sorted(set(REFERENCE_FILES) - ALL_DISPOSED)
+    assert not missing, (
+        "reference unittest files with no port/equivalent/skip: %s"
+        % missing)
+
+
+def test_no_unknown_or_double_disposition():
+    unknown = sorted(ALL_DISPOSED - set(REFERENCE_FILES))
+    assert not unknown, "dispositions for nonexistent files: %s" % unknown
+    for a, b in (("TRANCHE", "EQUIV"), ("TRANCHE", "SKIP"),
+                 ("EQUIV", "SKIP")):
+        overlap = set(globals()[a]) & set(globals()[b])
+        assert not overlap, (a, b, sorted(overlap))
+
+
+def test_mapped_repo_files_exist():
+    missing = []
+    for targets in list(EQUIV.values()) + [[t] for t in TRANCHE.values()]:
+        for rel in targets:
+            if not os.path.exists(os.path.join(TESTS_ROOT, rel)):
+                missing.append(rel)
+    assert not missing, "mapped repo test files missing: %s" % sorted(
+        set(missing))
+
+
+def test_frozen_snapshot_matches_reference_tree():
+    """Re-verify the frozen list against the live reference checkout when
+    present (the audit itself must not rot)."""
+    if not os.path.isdir(REFERENCE_DIR):
+        pytest.skip("reference checkout not present")
+    # ignore derived/editor artifacts (__pycache__, *.pyc, swap files)
+    # so transient junk in the read-only checkout can't fail the audit
+    live = sorted(
+        n for n in os.listdir(REFERENCE_DIR)
+        if n != "__pycache__" and not n.endswith((".pyc", ".swp", "~")))
+    assert live == sorted(REFERENCE_FILES), {
+        "only_in_live": sorted(set(live) - set(REFERENCE_FILES)),
+        "only_in_frozen": sorted(set(REFERENCE_FILES) - set(live))}
