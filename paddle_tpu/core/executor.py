@@ -315,13 +315,13 @@ class Executor(object):
         feed_names = sorted(feed_arrays)
         # program._uid is mandatory (as in ParallelExecutor): id() of a GC'd
         # program can be recycled and silently serve a stale jitted fn.
-        # FLAGS_conv_layout is read at trace time, so the resolved layout is
-        # part of the key — flipping the env var between runs must re-trace,
-        # not silently serve the other layout's compiled fn
-        from ..ops.nn_ops import _conv_layout
+        # trace_env_key() carries every trace-time env flag (conv layout,
+        # flash dispatch, remat tuning) — flipping one between runs must
+        # re-trace, not silently serve the other configuration's fn
+        from .lowering import trace_env_key
         key = (program._uid, program._version,
                _feed_signature(feed_arrays), tuple(fetch_names),
-               _conv_layout())
+               trace_env_key())
         compiled = False
         entry = self._cache.get(key) if use_program_cache else None
         if entry is not None:

@@ -27,6 +27,35 @@ from .utils import find_var as _find_var
 _SPECIAL = {}
 
 
+def remat_segment_len_flag():
+    """FLAGS_remat_segment_len: explicit ops-per-segment for segment
+    remat (None = the sqrt(n) default). Single owner of the flag read:
+    both _lower_block_remat and trace_env_key() call this."""
+    import os
+    try:
+        v = os.environ.get("FLAGS_remat_segment_len", "")
+        return int(v) if v else None
+    except ValueError:
+        return None
+
+
+def trace_env_key():
+    """Values of every env flag that is read at TRACE time (they shape
+    the lowered computation): any jit-program cache over lowered fns must
+    include this tuple in its key, or flipping a flag between runs would
+    silently serve the other configuration's compiled fn.
+
+    Current flags: FLAGS_conv_layout (conv/pool compute layout),
+    FLAGS_flash_min_seq (flash-vs-dense attention dispatch crossover),
+    FLAGS_remat_segment_len (segment-remat tuning knob), and the
+    PADDLE_TPU_PALLAS gate (resolved through _pallas_enabled, which also
+    folds in the backend default). When adding a trace-time flag, add its
+    resolved value HERE."""
+    from ..ops.nn_ops import _conv_layout, _flash_min_seq, _pallas_enabled
+    return (_conv_layout(), _flash_min_seq(), remat_segment_len_flag(),
+            _pallas_enabled())
+
+
 def register_special(type):
     def deco(fn):
         _SPECIAL[type] = fn
@@ -239,7 +268,15 @@ def _lower_block_remat(ctx, ops, env):
     keep = set(getattr(ctx, "remat_keep", ()))
 
     import math
-    seg_len = max(4, int(math.ceil(math.sqrt(len(fwd_ops)))))
+    seg_len_flag = remat_segment_len_flag()
+    if seg_len_flag is not None:
+        # tuning knob (round-4 verdict weak #3): sqrt(n) segments means
+        # sqrt(n) optimization barriers; compile time is sensitive to
+        # the barrier count, so the sweep can probe longer segments
+        # (fewer barriers, more recompute per barrier)
+        seg_len = max(4, seg_len_flag)
+    else:
+        seg_len = max(4, int(math.ceil(math.sqrt(len(fwd_ops)))))
     segments = [fwd_ops[i:i + seg_len]
                 for i in range(0, len(fwd_ops), seg_len)]
     seg_reads = []

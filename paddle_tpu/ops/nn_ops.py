@@ -337,6 +337,18 @@ def _pallas_enabled():
     return jax.default_backend() == "tpu"
 
 
+def _flash_min_seq():
+    """Flash-vs-dense attention dispatch crossover (FLAGS_flash_min_seq;
+    default 1024 from the round-4 v5e measurements — dense wins at 256,
+    flash at 2048). 0 forces flash always. Single owner of the flag read:
+    both the dispatch and trace_env_key() call this."""
+    import os
+    try:
+        return int(os.environ.get("FLAGS_flash_min_seq", "") or 1024)
+    except ValueError:
+        return 1024
+
+
 @register("softmax_with_cross_entropy")
 def _softmax_xent(ctx, ins, attrs):
     logits = single(ins, "Logits")
@@ -389,6 +401,19 @@ def _fused_attention(ctx, ins, attrs):
         from ..parallel.ring_attention import ring_attention_sharded
         return _out(ring_attention_sharded(
             q, k, v, mesh, causal=causal, scale=scale, kv_len=kv_len))
+    # Per-shape dispatch (round-4 measurements, real v5e: dense XLA
+    # attention beat the flash kernel at T=256 — 130.0k vs 102.0k tok/s —
+    # while flash was 12.1x dense at T=2048): short sequences take the
+    # dense einsum path, long ones the pallas kernel. Crossover default
+    # 1024; override with FLAGS_flash_min_seq (0 forces flash always —
+    # used by kernel-coverage tests and the block-tune sweep).
+    min_seq = _flash_min_seq()
+    t = q.shape[1]
+    if t is not None and t < min_seq:
+        from ..parallel.ring_attention import attention_reference
+        return _out(attention_reference(
+            q, k, v, causal=causal, scale=scale,
+            kv_len=kv_len).astype(q.dtype))
     from . import pallas_kernels as pk
     out = pk.flash_attention(
         q, k, v, causal=causal, scale=scale, kv_len=kv_len,

@@ -204,12 +204,13 @@ class ParallelExecutor(object):
                                      axis_name=self._batch_axis)
             return replicated(self.mesh)
 
-        # the resolved conv layout is traced into the fn — key on it so an
-        # env-var flip re-traces instead of serving the other layout
-        from ..ops.nn_ops import _conv_layout
+        # every trace-time env flag (conv layout, flash dispatch, remat
+        # tuning) is traced into the fn — key on them so an env-var flip
+        # re-traces instead of serving the other configuration
+        from ..core.lowering import trace_env_key
         key = (program._uid, program._version,
                _feed_signature(feed_arrays), tuple(fetch_names),
-               _conv_layout())
+               trace_env_key())
         compiled = False
         entry = self._cache.get(key)
         if entry is not None:

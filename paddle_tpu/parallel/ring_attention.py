@@ -45,6 +45,10 @@ def attention_reference(q, k, v, causal=False, scale=None, kv_len=None):
         mask = jnp.tril(jnp.ones((tq, tk), dtype=bool))
         logits = jnp.where(mask, logits, _NEG_INF)
     if kv_len is not None:
+        # accept [B] or the fluid-convention [B, 1] (the flash kernel
+        # normalizes the same way; a [B, 1] here would silently
+        # broadcast the mask to rank 5)
+        kv_len = jnp.asarray(kv_len).reshape(k.shape[0])
         kpos = jnp.arange(k.shape[1])
         kmask = kpos[None, :] < kv_len[:, None]           # [B, Tk]
         logits = jnp.where(kmask[:, None, None, :], logits, _NEG_INF)

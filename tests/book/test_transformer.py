@@ -3,6 +3,7 @@
 Parity: fluid benchmark transformer (training program shape and feeds).
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.models import transformer
@@ -123,10 +124,14 @@ def test_position_encoding_table():
     assert np.abs(tab).max() <= 1.0 + 1e-6
 
 
-def test_transformer_fused_attention_matches_dense():
+def test_transformer_fused_attention_matches_dense(monkeypatch):
     """The flash-attention program (use_fused_attention=True: pallas kernel,
     src_len/trg_len feeds) must produce the same forward loss as the dense
     matmul+softmax+bias program on identical params, and train."""
+    # force the pallas kernel even at this tiny T (the per-shape dispatch
+    # would otherwise route short sequences to the dense path and this
+    # test would compare dense with dense)
+    monkeypatch.setenv("FLAGS_flash_min_seq", "0")
     def build(fused):
         main, startup = fluid.Program(), fluid.Program()
         with fluid.unique_name.guard(), fluid.program_guard(main, startup):
@@ -392,3 +397,81 @@ def test_fused_qkv_projection_equivalent():
         return np.asarray(got)
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_attention_short_seq_dispatches_dense(monkeypatch):
+    """Per-shape dispatch (round-4 v5e measurements: dense wins at T=256,
+    flash at T=2048): below FLAGS_flash_min_seq the fused_attention op
+    must route to the dense einsum path — asserted by making the pallas
+    kernel unreachable."""
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    def boom(*a, **k):
+        raise AssertionError("pallas kernel must not run at short T")
+
+    monkeypatch.setattr(pk, "flash_attention", boom)
+    monkeypatch.delenv("FLAGS_flash_min_seq", raising=False)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        q = fluid.layers.data(name="q", shape=[8, 2, 16], dtype="float32")
+        k = fluid.layers.data(name="k", shape=[8, 2, 16], dtype="float32")
+        v = fluid.layers.data(name="v", shape=[8, 2, 16], dtype="float32")
+        out = fluid.layers.fused_attention(q, k, v, causal=True)
+    rng = np.random.RandomState(0)
+    qs, ks, vs = (rng.randn(2, 8, 2, 16).astype("float32") * 0.5
+                  for _ in range(3))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, = exe.run(main, feed={"q": qs, "k": ks, "v": vs},
+                       fetch_list=[out])
+    from paddle_tpu.parallel.ring_attention import attention_reference
+    ref = attention_reference(qs, ks, vs, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # the fluid-convention [B, 1] kv_len feed must work on the dense
+    # path too (regression: the rank-2 mask silently broadcast logits
+    # to rank 5 before attention_reference normalized kv_len)
+    main_l, startup_l = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main_l, startup_l):
+        ql = fluid.layers.data(name="q", shape=[8, 2, 16],
+                               dtype="float32")
+        kl = fluid.layers.data(name="k", shape=[8, 2, 16],
+                               dtype="float32")
+        vl = fluid.layers.data(name="v", shape=[8, 2, 16],
+                               dtype="float32")
+        ln = fluid.layers.data(name="len", shape=[1], dtype="int32")
+        out_l = fluid.layers.fused_attention(ql, kl, vl, causal=True,
+                                             kv_len=ln)
+    lens = np.asarray([[5], [8]], "int32")
+    scope_l = fluid.Scope()
+    with fluid.scope_guard(scope_l):
+        exe.run(startup_l)
+        got_l, = exe.run(main_l, feed={"q": qs, "k": ks, "v": vs,
+                                       "len": lens},
+                         fetch_list=[out_l])
+    ref_l = attention_reference(qs, ks, vs, causal=True,
+                                kv_len=lens.reshape(-1))
+    np.testing.assert_allclose(np.asarray(got_l), np.asarray(ref_l),
+                               rtol=1e-5, atol=1e-5)
+
+    # above the threshold the kernel IS reached (the boom patch fires)
+    monkeypatch.setenv("FLAGS_flash_min_seq", "4")
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main2, startup2):
+        q2 = fluid.layers.data(name="q", shape=[8, 2, 16],
+                               dtype="float32")
+        k2 = fluid.layers.data(name="k", shape=[8, 2, 16],
+                               dtype="float32")
+        v2 = fluid.layers.data(name="v", shape=[8, 2, 16],
+                               dtype="float32")
+        out2 = fluid.layers.fused_attention(q2, k2, v2, causal=True)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup2)
+        with pytest.raises(Exception, match="pallas kernel must not"):
+            exe.run(main2, feed={"q": qs, "k": ks, "v": vs},
+                    fetch_list=[out2])

@@ -150,3 +150,40 @@ def test_jit_cache_lru_eviction(monkeypatch):
         v, = exe.run(main, feed={"x": np.ones((1, 4), "f")},
                      fetch_list=[out])
         assert float(np.ravel(v)[0]) == 4.0
+
+
+def test_trace_time_env_flags_key_the_program_cache(monkeypatch):
+    """Flipping a trace-time flag (here FLAGS_flash_min_seq) between runs
+    of the SAME program must re-trace, not serve the stale compiled fn —
+    asserted by making the pallas kernel observable-by-raising."""
+    import numpy as np
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        q = fluid.layers.data(name="q", shape=[8, 2, 8], dtype="float32")
+        out = fluid.layers.fused_attention(q, q, q, causal=True)
+    rng = np.random.RandomState(0)
+    qs = rng.randn(2, 8, 2, 8).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        monkeypatch.delenv("FLAGS_flash_min_seq", raising=False)
+        exe.run(main, feed={"q": qs}, fetch_list=[out])  # dense, cached
+
+        calls = {"n": 0}
+        real = pk.flash_attention
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(pk, "flash_attention", counting)
+        # same flag -> cache hit, kernel still not traced
+        exe.run(main, feed={"q": qs}, fetch_list=[out])
+        assert calls["n"] == 0
+        # flag flip -> re-trace through the kernel path
+        monkeypatch.setenv("FLAGS_flash_min_seq", "0")
+        exe.run(main, feed={"q": qs}, fetch_list=[out])
+        assert calls["n"] == 1

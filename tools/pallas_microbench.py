@@ -177,6 +177,13 @@ if __name__ == "__main__":
         tune_attention_blocks(b=int(os.environ.get("MB_B", "8")),
                               t=int(os.environ.get("MB_SEQ", "2048")),
                               h=int(os.environ.get("MB_H", "8")))
+    elif os.environ.get("MB_SHAPES"):
+        # MB_SHAPES=BxTxHxD[,BxTxHxD...]: attention fwd+bwd comparison
+        # at each shape (one line per shape, cheapest-first ordering is
+        # the caller's job)
+        for spec in os.environ["MB_SHAPES"].split(","):
+            b, t, h, d = (int(x) for x in spec.strip().split("x"))
+            bench_attention(b=b, t=t, h=h, d=d)
     else:
         bench_attention(b=int(os.environ.get("MB_B", "8")),
                         t=int(os.environ.get("MB_SEQ", "2048")),
