@@ -347,12 +347,19 @@ def main():
     # smoke-run knobs (defaults = the headline config)
     hw = int(os.environ.get("BENCH_IMAGE_HW", "224"))
     class_dim = int(os.environ.get("BENCH_CLASS_DIM", "1000"))
+    # feed modes: device (one-time transfer, chip-throughput headline) |
+    # host (float32 batches through DoubleBufferReader — measures the
+    # full pipeline incl. link bandwidth) | host_u8 (uint8 batches,
+    # normalize on device: 4x less traffic — the feeder machinery
+    # decoupled from link bandwidth, round-4 weak #5)
+    feed_mode = os.environ.get("BENCH_FEED", "device")
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.unique_name.guard(), fluid.program_guard(main_prog, startup):
         image, label, avg_cost, acc = build_train(
             model=model, class_dim=class_dim, image_shape=(3, hw, hw),
-            learning_rate=0.1, momentum=0.9, use_bf16=(dtype == "bf16"))
+            learning_rate=0.1, momentum=0.9, use_bf16=(dtype == "bf16"),
+            uint8_input=(feed_mode == "host_u8"))
     if remat:  # trade FLOPs for activation memory (enables larger batch)
         fluid.memory_optimization_transpiler.enable_rematerialization(
             main_prog)
@@ -361,17 +368,21 @@ def main():
     exe = fluid.Executor(place)
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
-    feed_mode = os.environ.get("BENCH_FEED", "device")  # device | host
     import jax.numpy as jnp
-    if feed_mode == "host":
+    if feed_mode in ("host", "host_u8"):
         # realistic input pipeline: numpy batches staged host→device by the
         # shipped DoubleBufferReader (core/readers.py) — the same code path
         # layers.double_buffer uses — so the copy overlaps the running step
         from itertools import count
         from paddle_tpu.core.readers import (DoubleBufferReader,
                                              IteratorReader)
+        def make_image():
+            if feed_mode == "host_u8":
+                return (rng.rand(batch, 3, hw, hw) * 255).astype("uint8")
+            return rng.rand(batch, 3, hw, hw).astype("float32")
+
         host_batches = [
-            (rng.rand(batch, 3, hw, hw).astype("float32"),
+            (make_image(),
              rng.randint(0, class_dim, (batch, 1)).astype("int32"))
             for _ in range(3)]
         reader = DoubleBufferReader(IteratorReader(

@@ -29,14 +29,23 @@ _SPECIAL = {}
 
 def remat_segment_len_flag():
     """FLAGS_remat_segment_len: explicit ops-per-segment for segment
-    remat (None = the sqrt(n) default). Single owner of the flag read:
-    both _lower_block_remat and trace_env_key() call this."""
+    remat (unset/empty = the sqrt(n) default -> None). Single owner of
+    the flag read: _lower_block_remat, trace_env_key() and the compile
+    probe all call this. Non-numeric values raise LOUDLY (like
+    FLAGS_conv_layout): a typo silently measured as the sqrt default
+    would mislabel banked compile-time numbers. Values < 4 are clamped
+    to 4 by the lowering; the resolved value is what this returns."""
     import os
-    try:
-        v = os.environ.get("FLAGS_remat_segment_len", "")
-        return int(v) if v else None
-    except ValueError:
+    v = os.environ.get("FLAGS_remat_segment_len", "")
+    if not v:
         return None
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(
+            "FLAGS_remat_segment_len=%r: expected an integer (ops per "
+            "remat segment) or unset" % v)
+    return max(4, n)
 
 
 def trace_env_key():
@@ -47,13 +56,17 @@ def trace_env_key():
 
     Current flags: FLAGS_conv_layout (conv/pool compute layout),
     FLAGS_flash_min_seq (flash-vs-dense attention dispatch crossover),
-    FLAGS_remat_segment_len (segment-remat tuning knob), and the
-    PADDLE_TPU_PALLAS gate (resolved through _pallas_enabled, which also
-    folds in the backend default). When adding a trace-time flag, add its
-    resolved value HERE."""
-    from ..ops.nn_ops import _conv_layout, _flash_min_seq, _pallas_enabled
+    FLAGS_remat_segment_len (segment-remat tuning knob), and the raw
+    PADDLE_TPU_PALLAS env string — the RAW string, not
+    _pallas_enabled(): that helper consults jax.default_backend(),
+    whose init can dial the TPU tunnel (and take the exclusive client
+    lock) from a pure-CPU run; the backend cannot flip mid-process, so
+    the env string alone captures everything that can change between
+    runs. When adding a trace-time flag, add its resolved value HERE."""
+    import os
+    from ..ops.nn_ops import _conv_layout, _flash_min_seq
     return (_conv_layout(), _flash_min_seq(), remat_segment_len_flag(),
-            _pallas_enabled())
+            os.environ.get("PADDLE_TPU_PALLAS", ""))
 
 
 def register_special(type):
@@ -274,7 +287,7 @@ def _lower_block_remat(ctx, ops, env):
         # sqrt(n) optimization barriers; compile time is sensitive to
         # the barrier count, so the sweep can probe longer segments
         # (fewer barriers, more recompute per barrier)
-        seg_len = max(4, seg_len_flag)
+        seg_len = seg_len_flag
     else:
         seg_len = max(4, int(math.ceil(math.sqrt(len(fwd_ops)))))
     segments = [fwd_ops[i:i + seg_len]

@@ -103,19 +103,32 @@ def vgg16(input, class_dim=1000, is_test=False):
 
 def build_train(model="resnet50", class_dim=1000, image_shape=(3, 224, 224),
                 learning_rate=0.01, momentum=0.9, is_test=False,
-                use_softmax_xent_fusion=True, use_bf16=False):
+                use_softmax_xent_fusion=True, use_bf16=False,
+                uint8_input=False):
     """Build the full training graph (reference: benchmark/fluid style).
 
     use_bf16 turns on the TPU mixed-precision path for the enclosing main
     program (Program.enable_mixed_precision): bf16 MXU compute, f32 master
     params — SURVEY §7 M5.
 
+    uint8_input: the image feed is raw uint8 pixels, normalized to
+    [0, 1) ON DEVICE (cast + scale fuse into the first conv). The
+    standard TPU input-pipeline layout: 4x less host->device traffic
+    than float32 feeds — the feeder measurement decoupled from link
+    bandwidth (round-4 weak #5).
+
     Returns (image, label, avg_cost, acc_top1).
     """
     if use_bf16:
         fluid.default_main_program().enable_mixed_precision()
-    image = fluid.layers.data(name="image", shape=list(image_shape),
-                              dtype="float32")
+    if uint8_input:
+        raw = fluid.layers.data(name="image", shape=list(image_shape),
+                                dtype="uint8")
+        image = fluid.layers.scale(
+            fluid.layers.cast(raw, dtype="float32"), scale=1.0 / 255.0)
+    else:
+        image = fluid.layers.data(name="image", shape=list(image_shape),
+                                  dtype="float32")
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")
     if model.startswith("resnet"):
         depth = int(model[len("resnet"):] or 50)

@@ -127,10 +127,14 @@ def test_fused_attention_layer_through_executor():
                                rtol=2e-3, atol=2e-4)
 
 
-def test_fused_attention_kv_len_through_executor():
+def test_fused_attention_kv_len_through_executor(monkeypatch):
     """Layer-level KVLen plumbing: kv_len auto-resolved from a sequence
-    feed's lengths companion, through Executor + append_backward."""
+    feed's lengths companion, through Executor + append_backward —
+    through the PALLAS KERNEL (min_seq=0 forces it; the per-shape
+    dispatch would otherwise route this tiny T to the dense path and
+    the test would stop covering the kernel's KVLen/custom_vjp)."""
     import paddle_tpu as fluid
+    monkeypatch.setenv("FLAGS_flash_min_seq", "0")
     rng = np.random.RandomState(12)
     H, D = 2, 8
     seqs = [rng.randn(n, H * D).astype("float32") * 0.5 for n in (9, 5, 2)]

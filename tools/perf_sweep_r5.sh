@@ -77,6 +77,11 @@ mb() {  # mb <timeout_s> <label> ENV=V... -- run pallas_microbench with env
   bash "$LOCK" env "$@" timeout -k 10 "$to" python tools/pallas_microbench.py \
     >/tmp/mb_run.out 2>/tmp/mb_err_$label.log
   local rc=$?
+  if [ $rc -eq 75 ]; then
+    echo "- $(date -u +%FT%TZ) r5 sweep stopped mid-mb: tpu_lock busy" >> BENCH_LOG.md
+    WEDGED=1
+    return
+  fi
   if [ $rc -eq 0 ]; then
     while read -r line; do
       printf -- '- %s microbench(%s) `%s`\n' "$(date -u +%FT%TZ)" "$label" "$line" >> BENCH_LOG.md
@@ -104,6 +109,12 @@ probe && mb 1200 bwd MB_SHAPES="8x1024x8x64,8x2048x8x64,4x4096x8x64"
 probe && run 900 BENCH_MODEL=transformer BENCH_DECODE=1 BENCH_BATCH=16 BENCH_SEQ=128
 probe && run 900 BENCH_MODEL=stacked_lstm BENCH_BATCH=128 BENCH_SEQ=64
 probe && run 900 BENCH_MODEL=vgg16 BENCH_BATCH=128
+# host-feed pair: float32 (link-bandwidth-bound on the tunnel: 40.4 img/s
+# = ~24MB/s in r4) vs uint8-normalize-on-device (4x less traffic). If
+# host_u8 lands ~4x host, the feeder machinery is proven and the ceiling
+# is the link, closing r4 weak #5's open question.
+probe && run 900 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_FEED=host BENCH_STEPS=5 BENCH_WARMUP=2
+probe && run 900 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_FEED=host_u8 BENCH_STEPS=5 BENCH_WARMUP=2
 # --- tier 4: flash block-size tune (one process, many small compiles) ------
 if probe; then
   echo "=== flash tune" | tee -a $LOG

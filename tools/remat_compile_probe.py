@@ -74,7 +74,9 @@ def probe(batch, remat, hw, classes):
     del compiled
     print(json.dumps({
         "probe": "remat_compile", "batch": batch, "remat": bool(remat),
-        "segment_len": os.environ.get("FLAGS_remat_segment_len"),
+        # RESOLVED value (clamped/validated), not the raw env string —
+        # banked numbers must be labeled with the config that actually ran
+        "segment_len": lowering.remat_segment_len_flag(),
         "hw": hw, "classes": classes,
         "trace_s": round(t_trace, 2), "compile_s": round(t_compile, 2),
         "hlo_barriers": n_barrier, "hlo_lines": n_lines,
