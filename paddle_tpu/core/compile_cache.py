@@ -1,0 +1,59 @@
+"""Persistent XLA compilation cache (opt-in).
+
+TPU compiles are expensive (20-40 s for a ResNet-50 train step; tens of
+minutes for remat graphs at large batch). jax ships a persistent
+executable cache keyed on the HLO + compile options; enabling it makes
+every repeat bench config / restarted sweep load its executable from
+disk instead of recompiling — directly attacking the round-4 failure
+mode where a 20-min remat compile burned the tunnel window twice.
+
+Enable with FLAGS_compile_cache_dir=<dir> (bench.py defaults it to
+/tmp/ptpu_compile_cache; the test suite leaves it off — CPU compiles are
+cheap and test isolation matters more). The reference era had no
+counterpart (its op-by-op executor had nothing to cache); this is a
+TPU-native runtime feature.
+"""
+import os
+
+_enabled_dir = None
+
+
+def default_cache_dir():
+    """Per-user cache path: a world-shared /tmp dir would let another
+    user pre-plant entries that jax deserializes as compiled executables
+    (and makedirs(exist_ok=True) on a foreign-owned dir hides permission
+    failures)."""
+    import tempfile
+    return os.path.join(tempfile.gettempdir(),
+                        "ptpu_compile_cache_%d" % os.getuid())
+
+
+def maybe_enable_persistent_cache(default_dir=None):
+    """Idempotently point jax's persistent compilation cache at
+    FLAGS_compile_cache_dir (or ``default_dir`` when the flag is UNSET).
+    An explicitly-set EMPTY flag disables the cache even when the caller
+    passes a default — the supported off switch for compile-inclusive
+    timing runs. Returns the directory in effect, or None when off."""
+    global _enabled_dir
+    if "FLAGS_compile_cache_dir" in os.environ:
+        path = os.environ["FLAGS_compile_cache_dir"]  # '' = explicit off
+    else:
+        path = default_dir
+    if not path:
+        return None
+    if _enabled_dir is not None:
+        return _enabled_dir
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        _enabled_dir = path  # the cache IS active from this point
+    except Exception:   # cache is an optimization, never a failure source
+        return None
+    try:
+        # cache even fast compiles: sweep configs repeat across processes
+        # (best-effort: older jax may lack the option — cache stays on)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
+    return _enabled_dir

@@ -27,6 +27,9 @@ def _await():
     want = os.environ.get("JAX_PLATFORMS")
     if want:
         jax.config.update("jax_platforms", want)
+    from paddle_tpu.core.compile_cache import (default_cache_dir,
+                                               maybe_enable_persistent_cache)
+    maybe_enable_persistent_cache(default_cache_dir())
     tpu_guard.require_accelerator("pallas_microbench")
     return jax
 

@@ -35,6 +35,9 @@ def probe(batch, remat, hw, classes):
     want = os.environ.get("JAX_PLATFORMS")
     if want:
         jax.config.update("jax_platforms", want)
+    # compile-time probe: do NOT enable the persistent cache here — a
+    # cache hit would report near-zero compile_s and invalidate the
+    # measurement this tool exists for
     import paddle_tpu as fluid
     from paddle_tpu.core import lowering
 
