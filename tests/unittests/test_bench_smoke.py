@@ -38,3 +38,37 @@ def test_bench_json_contract():
     assert rec["vs_baseline"] is None
     assert rec["image_hw"] == 32 and rec["class_dim"] == 10
     assert "loss" in rec and rec["loss"] == rec["loss"]  # finite
+
+
+def test_tool_shell_scripts_parse():
+    """bash -n every tools/*.sh: a syntax error in a sweep script would
+    consume the round's only healthy tunnel window (the probe loop
+    fires them unattended)."""
+    import glob
+    scripts = sorted(glob.glob(os.path.join(REPO, "tools", "*.sh")))
+    assert scripts, "no tools/*.sh found"
+    for s in scripts:
+        r = subprocess.run(["bash", "-n", s], capture_output=True,
+                           text=True)
+        assert r.returncode == 0, (s, r.stderr)
+
+
+def test_sweeps_only_set_knobs_bench_reads():
+    """Every perf sweep script may only set BENCH_* vars that bench.py
+    actually reads — a misspelled knob in an unattended sweep line would
+    silently run the DEFAULT config and bank it under the wrong label.
+    Globbed over all rounds' sweeps so a future sweep can't dodge it."""
+    import glob
+    import re
+    with open(os.path.join(REPO, "bench.py")) as f:
+        known = set(re.findall(r'environ\.get\("(BENCH_[A-Z0-9_]+)"',
+                               f.read()))
+    assert "BENCH_BATCH" in known and "BENCH_FEED" in known
+    for path in sorted(glob.glob(os.path.join(REPO, "tools",
+                                              "perf_sweep*.sh"))):
+        with open(path) as f:
+            used = set(re.findall(r"(BENCH_[A-Z0-9_]+)=", f.read()))
+        unknown = used - known
+        assert not unknown, (
+            "%s sets BENCH_ vars bench.py never reads: %s"
+            % (os.path.basename(path), sorted(unknown)))
