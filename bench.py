@@ -296,8 +296,20 @@ def bench_stacked_lstm():
 # "15.5G" is MACs, so fwd = 31e9); models build_train supports but this
 # table lacks still bench (mfu reported null)
 _IMAGE_MODELS = {
+    # fwd FLOPs/image at 224^2/1000 classes (train ~ 3x fwd), each
+    # MEASURED with XLA cost_analysis on the network AS IMPLEMENTED in
+    # models/image_classification.py (is_test forward, 2026-07-31 —
+    # same methodology as the r4 resnet50 audit, which also matches
+    # per-conv shape sums): resnet50 8.14e9, resnet101 1.541e10,
+    # resnet152 2.307e10, vgg16 3.011e10, alexnet (legacy 96-filter
+    # unpadded-conv1 ungrouped variant) 1.852e9, googlenet v1 (aux
+    # heads off) 2.734e9.
     "resnet50": (3 * 8.2e9, "resnet50_imagenet_train_throughput"),
-    "vgg16": (3 * 31.0e9, "vgg16_imagenet_train_throughput"),
+    "resnet101": (3 * 15.4e9, "resnet101_imagenet_train_throughput"),
+    "resnet152": (3 * 23.1e9, "resnet152_imagenet_train_throughput"),
+    "vgg16": (3 * 30.1e9, "vgg16_imagenet_train_throughput"),
+    "alexnet": (3 * 1.85e9, "alexnet_imagenet_train_throughput"),
+    "googlenet": (3 * 2.73e9, "googlenet_imagenet_train_throughput"),
 }
 
 

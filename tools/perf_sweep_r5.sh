@@ -102,6 +102,9 @@ probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
 probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=8 BENCH_SEQ=1024 BENCH_STEPS=5 BENCH_WARMUP=2
 probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2
 probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2 BENCH_FUSED_QKV=1
+# MFU scales with model width — the big config (d_model 1024, 16 heads)
+# is the fairer MXU-utilization number at long T
+probe && run 1200 BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_DMODEL=1024 BENCH_HEADS=16 BENCH_STEPS=5 BENCH_WARMUP=2
 # kernel-level: flash fwd+bwd vs XLA dense at the long lengths (the r4
 # lax bwd measured 0.75x dense; the pallas bwd must beat 1x to stay)
 probe && mb 1200 bwd MB_SHAPES="8x1024x8x64,8x2048x8x64,4x4096x8x64"
@@ -109,6 +112,7 @@ probe && mb 1200 bwd MB_SHAPES="8x1024x8x64,8x2048x8x64,4x4096x8x64"
 probe && run 900 BENCH_MODEL=transformer BENCH_DECODE=1 BENCH_BATCH=16 BENCH_SEQ=128
 probe && run 900 BENCH_MODEL=stacked_lstm BENCH_BATCH=128 BENCH_SEQ=64
 probe && run 900 BENCH_MODEL=vgg16 BENCH_BATCH=128
+probe && run 900 BENCH_MODEL=resnet101 BENCH_BATCH=128 BENCH_DTYPE=bf16
 # host-feed pair: float32 (link-bandwidth-bound on the tunnel: 40.4 img/s
 # = ~24MB/s in r4) vs uint8-normalize-on-device (4x less traffic). If
 # host_u8 lands ~4x host, the feeder machinery is proven and the ceiling
