@@ -352,9 +352,36 @@ def _fill(ctx, ins, attrs):
     return _out(jnp.asarray(arr))
 
 
+def _attr_np_dtype(attrs, default="float32"):
+    """Resolve a "dtype" attr that may be a numpy-style string (our
+    layers) OR the era framework.proto VarType enum int (era descs and
+    reference OpTest configs encode dtype as e.g. 5=FP32, 2=INT32)."""
+    v = attrs.get("dtype", default)
+    if isinstance(v, (int, np.integer)):
+        table = {0: "bool", 1: "int16", 2: "int32", 3: "int64",
+                 4: "float16", 5: "float32", 6: "float64"}
+        v = table.get(int(v), default)
+    return np.dtype(v)
+
+
 @register("assign_value")
 def _assign_value(ctx, ins, attrs):
-    arr = np.asarray(attrs["values"], dtype=np.dtype(attrs.get("dtype", "float32")))
+    """assign_value_op.cc:55 stores the payload in a dtype-SUFFIXED attr
+    (fp32_values / int32_values, selected in assign_value_op.h:34) —
+    accept those wire names (era descs / OpTest configs, where dtype is
+    the VarType enum int) alongside the layer's own "values"."""
+    dtype = _attr_np_dtype(attrs)
+    if "values" in attrs:
+        vals = attrs["values"]
+    elif dtype == np.int32 and "int32_values" in attrs:
+        vals = attrs["int32_values"]
+    elif "fp32_values" in attrs:
+        vals = attrs["fp32_values"]
+    else:
+        raise KeyError(
+            "assign_value: none of values/fp32_values/int32_values in "
+            "attrs %r" % sorted(attrs))
+    arr = np.asarray(vals, dtype=dtype)
     return _out(jnp.asarray(arr.reshape(attrs["shape"])))
 
 

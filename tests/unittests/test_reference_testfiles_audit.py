@@ -102,6 +102,7 @@ T2 = "unittests/test_ref_opconfigs2.py"
 T3 = "unittests/test_ref_opconfigs3.py"
 T4 = "unittests/test_ref_opconfigs4.py"
 T5 = "unittests/test_ref_opconfigs5.py"
+T6 = "unittests/test_ref_opconfigs6.py"
 
 TRANCHE = {
     "test_activation_op.py": T1,
@@ -182,6 +183,15 @@ TRANCHE = {
     "test_transpose_op.py": T1,
     "test_uniform_random_batch_size_like_op.py": T3,
     "test_uniform_random_op.py": T3,
+    "test_accuracy_op.py": T6,
+    "test_assign_value_op.py": T6,
+    "test_fill_constant_batch_size_like_op.py": T6,
+    "test_mean_op.py": T6,
+    "test_minus_op.py": T6,
+    "test_norm_op.py": T6,
+    "test_reshape_op.py": T6,
+    "test_sequence_erase_op.py": T6,
+    "test_squared_l2_distance_op.py": T6,
 }
 
 # --- disposition 2: equivalent repo test file(s) ---------------------------
@@ -190,14 +200,11 @@ U = "unittests/"
 B = "book/"
 EQUIV = {
     "op_test.py": [U + "op_test.py"],
-    "test_accuracy_op.py": [U + "test_aux_modules.py",
-                            U + "test_ops_coverage.py"],
     "test_adadelta_op.py": [U + "test_optimizer_numeric.py"],
     "test_adagrad_op.py": [U + "test_optimizer_numeric.py"],
     "test_adamax_op.py": [U + "test_optimizer_numeric.py"],
     "test_array_read_write_op.py": [U + "test_control_flow.py"],
     "test_assign_op.py": [U + "test_ops_coverage.py"],
-    "test_assign_value_op.py": [U + "test_loss_misc_ops.py"],
     "test_auc_op.py": [U + "test_metrics_auc.py"],
     "test_beam_search_decode_op.py": [U + "test_control_flow.py",
                                       B + "test_machine_translation.py"],
@@ -229,8 +236,6 @@ EQUIV = {
                                  U + "test_fit_a_line.py"],
     "test_feed_fetch_method.py": [U + "test_program_tooling_zoo.py"],
     "test_fetch_var.py": [U + "test_aux_modules.py"],
-    "test_fill_constant_batch_size_like_op.py": [
-        U + "test_program_prune.py", U + "test_ops_coverage.py"],
     "test_fill_constant_op.py": [U + "test_program_prune.py",
                                  U + "test_ops_coverage.py"],
     "test_fill_op.py": [U + "test_ops_coverage.py"],
@@ -252,10 +257,8 @@ EQUIV = {
                                      U + "test_rank_table_ops.py"],
     "test_lstmp_op.py": [U + "test_rnn_numeric.py"],
     "test_math_op_patch.py": [U + "test_math_op_patch.py"],
-    "test_mean_op.py": [U + "test_ops_coverage.py"],
     "test_memory_optimization_transpiler.py": [U + "test_aux_modules.py",
                                                U + "test_remat_segments.py"],
-    "test_minus_op.py": [U + "test_loss_misc_ops.py"],
     "test_modified_huber_loss_op.py": [U + "test_tail_ops.py"],
     "test_momentum_op.py": [U + "test_optimizer_numeric.py"],
     "test_multi_pass_reader.py": [U + "test_reader_layers.py"],
@@ -264,7 +267,6 @@ EQUIV = {
     "test_multiple_reader.py": [U + "test_reader_layers.py"],
     "test_nce.py": [U + "test_ctc_ops.py"],
     "test_net.py": [U + "test_nets_composites.py"],
-    "test_norm_op.py": [U + "test_ref_opconfigs2.py"],
     "test_normalization_wrapper.py": [
         U + "test_calc_gradient_weight_norm.py",
         U + "test_ops_coverage.py"],
@@ -292,14 +294,11 @@ EQUIV = {
     "test_registry.py": [U + "test_ops_coverage.py"],
     "test_regularizer.py": [U + "test_regularizer_clip_init.py"],
     "test_reorder_lod_tensor.py": [U + "test_rank_table_ops.py"],
-    "test_reshape_op.py": [U + "test_ops_coverage.py",
-                           U + "test_mixed_precision.py"],
     "test_roi_pool_op.py": [U + "test_detection_ops.py"],
     "test_scope.py": [U + "test_checkpoint_and_errors.py",
                       U + "test_aux_modules.py"],
     "test_seq_conv.py": [U + "test_sequence_ops.py",
                          U + "test_sequence_deep.py"],
-    "test_sequence_erase_op.py": [U + "test_ctc_ops.py"],
     "test_sequence_reshape.py": [U + "test_sequence_deep.py"],
     "test_sgd_op.py": [U + "test_optimizer_numeric.py"],
     "test_shrink_rnn_memory.py": [U + "test_rank_table_ops.py"],
@@ -308,7 +307,6 @@ EQUIV = {
     "test_split_and_merge_lod_tensor_op.py": [U + "test_control_flow.py"],
     "test_split_var.py": [U + "test_distribute_transpiler.py"],
     "test_spp_op.py": [U + "test_tail_ops.py"],
-    "test_squared_l2_distance_op.py": [U + "test_tail_ops.py"],
     "test_squared_l2_norm_op.py": [U + "test_tail_ops.py"],
     "test_switch.py": [U + "test_control_flow.py"],
     "test_tensor.py": [U + "test_sequence_deep.py"],
