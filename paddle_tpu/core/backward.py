@@ -96,9 +96,24 @@ def _backward_sweep(block, path_flags, needed, no_grad, seed_names,
         if not path_flags[idx]:
             continue
         op = block.ops[idx]
-        if not registry.is_registered(op.type):
-            raise NotImplementedError(
-                "no lowering registered for op %r; cannot differentiate" % op.type)
+        from .lowering import SPECIAL_GRADS
+        diff_slots = None   # None = every slot (generic registered path)
+        if op.type in SPECIAL_GRADS:
+            # same gate _lower_grad_of dispatches on — membership here
+            # wins over registration so the diff_slots contract and the
+            # grad implementation can never disagree
+            diff_slots = SPECIAL_GRADS[op.type]["diff_slots"]
+        elif not registry.is_registered(op.type):
+            # structure-only specials (lod_rank_table, max_sequence_len,
+            # ...) produce no float outputs: if no output carries a
+            # grad, there is nothing to differentiate — same skip the
+            # generic path applies via its `produces` check below
+            if any(n in has_grad for ns in op.outputs.values()
+                   for n in ns if n):
+                raise NotImplementedError(
+                    "no lowering registered for op %r; cannot "
+                    "differentiate" % op.type)
+            continue
         out_grads = {}
         produces = False
         for slot, names in op.outputs.items():
@@ -131,7 +146,8 @@ def _backward_sweep(block, path_flags, needed, no_grad, seed_names,
             grad_in_names.extend(names)
             outs = []
             for n in names:
-                if n in no_grad or n not in needed:
+                if n in no_grad or n not in needed or (
+                        diff_slots is not None and slot not in diff_slots):
                     outs.append("")
                 else:
                     outs.append(grad_var_name(n))

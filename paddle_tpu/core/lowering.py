@@ -423,6 +423,36 @@ def _is_float(x):
     return jnp.issubdtype(jnp.result_type(x), jnp.floating)
 
 
+def _grad_reorder_by_rank(ctx, op, env):
+    """Gradient of reorder_lod_tensor_by_rank: the backward of a row
+    permutation is the inverse permutation (reference:
+    reorder_lod_tensor_op.cc grad kernel reorders with the inverted rank
+    table). Structure-only companions (XLen) carry no grad."""
+    fwd_inputs = op.attrs["fwd_inputs"]
+    fwd_outputs = op.attrs["fwd_outputs"]
+    rt = env.read(fwd_inputs["RankTable"][0])
+    og = env.read_opt(fwd_outputs["Out"][0] + GRAD_SUFFIX)
+    if og is None:
+        return
+    xname = fwd_inputs["X"][0]
+    if xname in op.attrs.get("no_grad_names", ()):
+        return
+    inv = jnp.argsort(rt.index)
+    env.accumulate(xname + GRAD_SUFFIX, jnp.take(og, inv, axis=0))
+
+
+# Special (graph-level) forward lowerings that cannot ride the generic
+# jax.vjp-of-the-rule path but ARE differentiable: hand-written grad
+# emitters keyed by forward op type, plus the input slots that actually
+# receive grads (backward.py must not declare @GRAD vars for
+# structure-only slots like RankTable — a declared grad marks its
+# producer differentiable and would poison the upstream sweep).
+SPECIAL_GRADS = {
+    "reorder_lod_tensor_by_rank": {"fn": _grad_reorder_by_rank,
+                                   "diff_slots": ("X",)},
+}
+
+
 def _lower_grad_of(ctx, op, env):
     """Lower a generic gradient op via jax.vjp of the forward rule.
 
@@ -433,6 +463,9 @@ def _lower_grad_of(ctx, op, env):
     because backward.py emits grad ops in reverse topological order.
     """
     fwd_type = op.attrs["fwd_type"]
+    if fwd_type in SPECIAL_GRADS:
+        SPECIAL_GRADS[fwd_type]["fn"](ctx, op, env)
+        return
     fwd_attrs = op.attrs["fwd_attrs"]
     fwd_inputs = op.attrs["fwd_inputs"]    # slot -> [names]
     fwd_outputs = op.attrs["fwd_outputs"]  # slot -> [names]

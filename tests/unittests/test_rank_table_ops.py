@@ -116,3 +116,33 @@ def test_shrink_memory_identity_contract():
 
     mem, out = _run(build, {"x": LOD})
     np.testing.assert_allclose(out, mem, rtol=0, atol=0)
+
+
+def test_reorder_by_rank_gradient_is_inverse_permutation():
+    """Reference test_reorder_lod_tensor.py checks x@GRAD through the
+    reorder: the backward of a row permutation is the inverse
+    permutation. A position-DEPENDENT loss (rows weighted by their
+    post-reorder position) makes a wrong permutation detectable — a
+    plain sum would be permutation-invariant and pass vacuously."""
+    def build():
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              lod_level=1)
+        x.stop_gradient = False
+        table = fluid.layers.lod_rank_table(x)
+        y = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+        w = fluid.layers.assign(
+            np.arange(1, 5, dtype="float32").reshape(4, 1, 1))
+        loss = fluid.layers.reduce_sum(y * w)
+        fluid.append_backward(loss)
+        return (loss, "x@GRAD")
+
+    _, grad = _run(build, {"x": LOD})
+    grad = np.asarray(grad)
+    # row src of x sits at post-reorder position row -> weight row+1 on
+    # every VALID timestep (the reference layout is flat rows — padding
+    # grads are an artifact of the padded-dense design, not part of the
+    # permutation contract this test pins)
+    for row, src in enumerate(DESC):
+        L = len(SEQS[src])
+        np.testing.assert_allclose(grad[src, :L], float(row + 1),
+                                   rtol=1e-6)
