@@ -91,12 +91,12 @@ def _backward_sweep(block, path_flags, needed, no_grad, seed_names,
     is already written (the seeded targets)."""
     # A var "has a grad" once some consumer's grad op has (started)
     # writing it.
+    from .lowering import SPECIAL_GRADS  # function-level: avoids cycle
     has_grad = set(seed_names)
     for idx in range(fwd_len - 1, -1, -1):
         if not path_flags[idx]:
             continue
         op = block.ops[idx]
-        from .lowering import SPECIAL_GRADS
         diff_slots = None   # None = every slot (generic registered path)
         if op.type in SPECIAL_GRADS:
             # same gate _lower_grad_of dispatches on — membership here

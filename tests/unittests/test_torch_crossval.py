@@ -213,3 +213,46 @@ def test_nll_losses_vs_torch():
                           torch.from_numpy(labels.ravel()),
                           reduction="none").numpy().reshape(-1, 1)
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+# --- 3-D family: conv3d / conv3d_transpose / pool3d vs torch ---------------
+
+@pytest.mark.parametrize("stride,pad,dil", [
+    ((1, 1, 1), (1, 1, 1), (1, 1, 1)),
+    ((2, 1, 2), (0, 1, 1), (1, 1, 1)),
+])
+def test_conv3d_vs_torch(stride, pad, dil):
+    x = rng.randn(2, 3, 5, 6, 7).astype("float32")
+    w = rng.randn(4, 3, 3, 3, 3).astype("float32")
+    got, = run_op("conv3d", {"Input": x, "Filter": w},
+                  attrs={"strides": list(stride), "paddings": list(pad),
+                         "dilations": list(dil), "groups": 1},
+                  out_slots=("Output",))
+    ref = F.conv3d(torch.from_numpy(x), torch.from_numpy(w),
+                   stride=stride, padding=pad, dilation=dil).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv3d_transpose_vs_torch():
+    x = rng.randn(2, 4, 4, 5, 5).astype("float32")
+    w = rng.randn(4, 3, 3, 3, 3).astype("float32")  # [Cin, Cout, k, k, k]
+    got, = run_op("conv3d_transpose", {"Input": x, "Filter": w},
+                  attrs={"strides": [2, 2, 2], "paddings": [1, 1, 1],
+                         "dilations": [1, 1, 1], "groups": 1},
+                  out_slots=("Output",))
+    ref = F.conv_transpose3d(torch.from_numpy(x), torch.from_numpy(w),
+                             stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool3d_vs_torch(ptype):
+    x = rng.randn(2, 3, 6, 7, 8).astype("float32")
+    got, = run_op("pool3d", {"X": x},
+                  attrs={"pooling_type": ptype, "ksize": [2, 2, 2],
+                         "strides": [2, 2, 2], "paddings": [0, 0, 0],
+                         "global_pooling": False})
+    t = torch.from_numpy(x)
+    ref = (F.max_pool3d(t, 2, stride=2) if ptype == "max"
+           else F.avg_pool3d(t, 2, stride=2)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
