@@ -71,3 +71,96 @@ def test_fetch_weights_and_grad():
         expect_gb = 2 * pred_np.mean(0)
         np.testing.assert_allclose(gw, expect_gw, rtol=1e-4)
         np.testing.assert_allclose(gb, expect_gb, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_fit_a_line_real_regression_gate():
+    """Real-data regression gate (round 5): the fit_a_line program
+    trained on sklearn's bundled diabetes set (442 real patient records
+    — the era chapter used the UCI housing set, not shipped in this
+    zero-egress image) must reach R^2 >= 0.28 on a held-out split.
+    Calibration: sklearn's exact OLS solution scores R^2 = 0.330 on this
+    same split, so the gate asks for ~85%% of the closed-form optimum —
+    passing means the model genuinely fits real structure (the trivial
+    mean predictor scores 0)."""
+    from sklearn.datasets import load_diabetes
+    d = load_diabetes()
+    xs = d.data.astype("float32")
+    ys = d.target.astype("float32").reshape(-1, 1)
+    xs = (xs - xs.mean(0)) / (xs.std(0) + 1e-7)
+    y_mean, y_std = ys.mean(), ys.std()
+    ys_n = (ys - y_mean) / y_std
+    rng = np.random.RandomState(0)
+    perm = rng.permutation(len(xs))
+    xs, ys_n = xs[perm], ys_n[perm]
+    n_te = 88
+    xtr, ytr, xte, yte = xs[n_te:], ys_n[n_te:], xs[:n_te], ys_n[:n_te]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[10], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1, act=None)
+        avg = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(avg)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for epoch in range(60):
+            p = rng.permutation(len(xtr))
+            for i in range(0, len(xtr) - 31, 32):
+                b = p[i:i + 32]
+                exe.run(main, feed={"x": xtr[b], "y": ytr[b]},
+                        fetch_list=[])
+        mse, = exe.run(test_prog, feed={"x": xte, "y": yte},
+                       fetch_list=[avg])
+    r2 = 1.0 - float(np.ravel(mse)[0]) / float(np.var(yte))
+    assert r2 >= 0.28, "held-out R^2 only %.3f (OLS optimum 0.330)" % r2
+
+
+@pytest.mark.slow
+def test_logistic_real_classification_gate():
+    """Real-data binary-classification gate: fc+softmax trained on
+    sklearn's bundled breast-cancer set (569 real records) must reach
+    >=93% held-out accuracy — the CTR/logistic book path proven on real
+    structure."""
+    from sklearn.datasets import load_breast_cancer
+    d = load_breast_cancer()
+    xs = d.data.astype("float32")
+    xs = (xs - xs.mean(0)) / (xs.std(0) + 1e-7)
+    ys = d.target.astype("int64").reshape(-1, 1)
+    rng = np.random.RandomState(1)
+    perm = rng.permutation(len(xs))
+    xs, ys = xs[perm], ys[perm]
+    n_te = 114
+    xtr, ytr, xte, yte = xs[n_te:], ys[n_te:], xs[:n_te], ys[:n_te]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[30], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        prob = fluid.layers.fc(input=x, size=2, act="softmax")
+        avg = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=prob, label=y))
+        acc = fluid.layers.accuracy(input=prob, label=y)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for epoch in range(30):
+            p = rng.permutation(len(xtr))
+            for i in range(0, len(xtr) - 31, 32):
+                b = p[i:i + 32]
+                exe.run(main, feed={"x": xtr[b], "y": ytr[b]},
+                        fetch_list=[])
+        a, = exe.run(test_prog, feed={"x": xte, "y": yte},
+                     fetch_list=[acc])
+    assert float(np.ravel(a)[0]) >= 0.93, \
+        "held-out accuracy only %.3f" % float(np.ravel(a)[0])
