@@ -386,6 +386,21 @@ class Program(object):
             p._set_test_mode()
         return p
 
+    def append_backward(self, target, no_grad_set=None):
+        """Era method form (reference framework.py:1058 — test_layers.py
+        calls program.append_backward(avg_cost)); delegates to the
+        module-level backward builder. Returns [(Parameter, grad
+        Variable)] like fluid.append_backward."""
+        from .backward import append_backward as _ab
+        if not isinstance(target, Variable):
+            raise TypeError("append_backward target must be a Variable, "
+                            "got %r" % type(target).__name__)
+        if target.block.program is not self:
+            raise ValueError(
+                "append_backward target %r belongs to a different "
+                "Program" % target.name)
+        return _ab(target, no_grad_set=no_grad_set)
+
     def _set_test_mode(self):
         for blk in self.blocks:
             for op in blk.ops:

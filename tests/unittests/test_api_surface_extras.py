@@ -2,6 +2,7 @@
 DataFeeder/evaluator (the last small reference API-surface modules).
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import default_scope_funcs as dsf
@@ -143,3 +144,30 @@ def test_v2_evaluator_classification_error():
         got, = exe.run(main, feed={"p": p, "l": l}, fetch_list=[err])
     np.testing.assert_allclose(np.asarray(got).ravel(), [1 - 2.0 / 3],
                                rtol=1e-5)
+
+
+def test_program_append_backward_method():
+    """Era method form (reference framework.py:1058; test_layers.py uses
+    program.append_backward(avg_cost)): same result as the module-level
+    fluid.append_backward, and a foreign-program target is rejected."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(x=pred)
+        pairs = main.append_backward(loss)
+    names = {p.name for p, g in pairs}
+    assert any(n.endswith(".w_0") or "w" in n for n in names), names
+    assert all(g.name.endswith("@GRAD") for _, g in pairs)
+    # grads actually flow: run one fetch of a param grad
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        g, = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                     fetch_list=[pairs[0][1].name])
+    assert np.isfinite(np.asarray(g)).all()
+
+    other = fluid.Program()
+    with pytest.raises(ValueError, match="different"):
+        other.append_backward(loss)

@@ -247,7 +247,9 @@ EQUIV = {
     "test_initializer.py": [U + "test_regularizer_clip_init.py"],
     "test_iou_similarity_op.py": [U + "test_detection_ops.py"],
     "test_l1_norm_op.py": [U + "test_tail_ops.py"],
-    "test_layers.py": [U + "test_reference_api_parity.py"],
+    "test_layers.py": [U + "test_reference_api_parity.py",
+                       U + "test_fit_a_line.py",
+                       U + "test_api_surface_extras.py"],
     "test_learning_rate_scheduler.py": [U + "test_lr_scheduler.py"],
     "test_linear_chain_crf_op.py": [U + "test_crf_ops.py"],
     "test_lod_array_length_op.py": [U + "test_control_flow.py"],
