@@ -91,6 +91,14 @@ class Variable(object):
         from ..layers import tensor as _tensor
         return _tensor.cast(self, dtype)
 
+    def set_error_clip(self, error_clip):
+        """Era setter form (reference framework.py Variable
+        .set_error_clip); same field append_backward consults."""
+        self.error_clip = error_clip
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        return repr(self)
+
     def __repr__(self):
         return "Variable(%s, shape=%s, dtype=%s, lod=%d%s)" % (
             self.name, self.shape, self.dtype, self.lod_level,
@@ -172,6 +180,48 @@ class Operator(object):
     def attr(self, name):
         return self.attrs[name]
 
+    # ---- era surface (reference framework.py Operator) ---------------
+    @property
+    def attr_names(self):
+        return list(self.attrs)
+
+    def attr_type(self, name):
+        """Python type of the attr (the era returned the proto AttrType
+        enum; callers branch on kind, which the type answers)."""
+        return type(self.attrs[name])
+
+    @property
+    def input_arg_names(self):
+        return self.all_input_vars()
+
+    @property
+    def output_arg_names(self):
+        return self.all_output_vars()
+
+    def rename_input(self, old_name, new_name):
+        """Era contract (op_desc.cc RenameInput): raises when old_name
+        is not referenced — a silent no-op would surface later as a
+        confusing missing-var error at execution."""
+        if not any(old_name in names for names in self.inputs.values()):
+            raise ValueError(
+                "rename_input: op %r has no input named %r"
+                % (self.type, old_name))
+        for slot, names in self.inputs.items():
+            self.inputs[slot] = [new_name if n == old_name else n
+                                 for n in names]
+
+    def rename_output(self, old_name, new_name):
+        if not any(old_name in names for names in self.outputs.values()):
+            raise ValueError(
+                "rename_output: op %r has no output named %r"
+                % (self.type, old_name))
+        for slot, names in self.outputs.items():
+            self.outputs[slot] = [new_name if n == old_name else n
+                                  for n in names]
+
+    def to_string(self, throw_on_error=False):
+        return repr(self)
+
     def __repr__(self):
         ins = ", ".join("%s=%s" % (k, v) for k, v in self.inputs.items())
         outs = ", ".join("%s=%s" % (k, v) for k, v in self.outputs.items())
@@ -248,6 +298,113 @@ class Block(object):
 
     def all_parameters(self):
         return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # ---- era surface (reference framework.py Block) -------------------
+    def iter_parameters(self):
+        return iter(self.all_parameters())
+
+    def clone_variable(self, var):
+        """Clone a variable (from any block) into this block as a
+        persistable var — the era transpiler idiom for materializing a
+        remote var locally (reference framework.py:921)."""
+        return self.create_var(
+            name=var.name, shape=var.shape, dtype=var.dtype,
+            lod_level=var.lod_level, persistable=True, type=var.type)
+
+    def copy_param_info_from(self, other):
+        """Copy Parameter metadata (trainable/optimize/regularizer/
+        gradient clip/ERROR clip — everything backward.py consults)
+        from same-named parameters of another block. A source param
+        missing here raises (era contract: copy_param_info_from
+        enforced the match rather than silently skipping)."""
+        for p in other.all_parameters():
+            mine = self.vars.get(p.name)
+            if mine is None:
+                raise ValueError(
+                    "copy_param_info_from: no var named %r in this "
+                    "block" % p.name)
+            if isinstance(mine, Parameter):
+                mine.trainable = p.trainable
+                mine.optimize_attr = dict(p.optimize_attr)
+                mine.regularizer = p.regularizer
+                mine.gradient_clip_attr = p.gradient_clip_attr
+                mine.do_model_average = p.do_model_average
+                mine.stop_gradient = p.stop_gradient
+            mine.error_clip = p.error_clip
+
+    def delete_ops(self, ops):
+        """Remove the given ops from this block (era transpilers slice
+        optimize ops out before shipping a sub-program)."""
+        doomed = set(id(op) for op in ops)
+        self.ops = [op for op in self.ops if id(op) not in doomed]
+        self.program._bump_version()
+
+    def slice_ops(self, start, end):
+        return self.ops[start:end]
+
+    def rename_var(self, name, new_name):
+        """Rename a var and every reference to it in this block's ops
+        (the era pserver-transpiler primitive). Sequence-length
+        companions riding on the var are renamed with it."""
+        if name not in self.vars:
+            raise ValueError("rename_var: no var named %r here" % name)
+        if new_name in self.vars:
+            raise ValueError("rename_var: %r already exists" % new_name)
+        v = self.vars.pop(name)
+        v.name = new_name
+        self.vars[new_name] = v
+        # a var and its @GRAD companion rename together: grad ops write
+        # <name>@GRAD derived from the forward name, and error-clip ops
+        # reference the grad name directly
+        renames = {name: new_name,
+                   grad_var_name(name): grad_var_name(new_name)}
+
+        def _sub(n):
+            return renames.get(n, n)
+
+        def _rewrite_attrs(attrs):
+            # names also live in ATTRS: grad_of snapshots the forward
+            # op's input/output maps, and control-flow lowerings bind
+            # sub-block placeholders via *_name/_names attrs — a rename
+            # that missed them would fail at lowering with a
+            # read-before-write on the stale name
+            for k, v in list(attrs.items()):
+                if k in ("fwd_inputs", "fwd_outputs"):
+                    attrs[k] = {s: [_sub(n) for n in ns]
+                                for s, ns in v.items()}
+                elif k.endswith("_name") and v in renames:
+                    attrs[k] = renames[v]
+                elif k.endswith("_names") and isinstance(v, (list, tuple)):
+                    attrs[k] = type(v)(_sub(n) for n in v)
+
+        for op in self.ops:
+            # op-level rename raises on absent names (era contract);
+            # this block-wide sweep rewrites only where referenced
+            for old in renames:
+                if old in op.all_input_vars():
+                    op.rename_input(old, renames[old])
+                if old in op.all_output_vars():
+                    op.rename_output(old, renames[old])
+            _rewrite_attrs(op.attrs)
+        gname = grad_var_name(name)
+        if gname in self.vars:
+            gv = self.vars.pop(gname)
+            gv.name = grad_var_name(new_name)
+            self.vars[gv.name] = gv
+        for other in self.vars.values():
+            if getattr(other, "seq_len_var", None) == name:
+                other.seq_len_var = new_name
+        self.program._bump_version()
+        return v
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        lines = ["block_%d {" % self.idx]
+        for vname in sorted(self.vars):
+            lines.append("  var " + repr(self.vars[vname]))
+        for op in self.ops:
+            lines.append("  op " + repr(op))
+        lines.append("}")
+        return "\n".join(lines)
 
     # ops whose outputs are per-sequence (not per-timestep): do not inherit lod
     _LOD_CLEARING_OPS = frozenset([
@@ -364,6 +521,28 @@ class Program(object):
         for blk in self.blocks:
             for v in blk.vars.values():
                 yield v
+
+    # ---- era surface (reference framework.py Program) ------------------
+    def block(self, index):
+        return self.blocks[index]
+
+    def copy_param_info_from(self, other):
+        self.global_block().copy_param_info_from(other.global_block())
+
+    def inference_optimize(self):
+        """Era standalone form of clone(for_test=True): a copy with
+        is_test flipped everywhere (reference prune.cc:187 — it never
+        pruned ops, only flipped the attr)."""
+        return self.clone(for_test=True)
+
+    @staticmethod
+    def parse_from_string(binary_str):
+        """Deserialize a program serialized by this build
+        (program_to_bytes); the era parsed its protobuf here — for
+        REFERENCE-era protobuf descs use
+        reference_format.parse_program_desc / io.load_reference_model."""
+        from .program_desc import program_from_bytes
+        return program_from_bytes(binary_str)
 
     def enable_mixed_precision(self, enable=True):
         """TPU bf16 training path (SURVEY §7 M5; no 2018-fluid counterpart).
