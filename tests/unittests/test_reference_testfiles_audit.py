@@ -267,7 +267,7 @@ EQUIV = {
     "test_multihead_attention.py": [B + "test_transformer.py",
                                     U + "test_long_context_training.py"],
     "test_multiple_reader.py": [U + "test_reader_layers.py"],
-    "test_nce.py": [U + "test_ctc_ops.py"],
+    "test_nce.py": [U + "test_ops_coverage.py"],
     "test_net.py": [U + "test_nets_composites.py"],
     "test_normalization_wrapper.py": [
         U + "test_calc_gradient_weight_norm.py",
@@ -296,7 +296,7 @@ EQUIV = {
     "test_registry.py": [U + "test_ops_coverage.py"],
     "test_regularizer.py": [U + "test_regularizer_clip_init.py"],
     "test_reorder_lod_tensor.py": [U + "test_rank_table_ops.py"],
-    "test_roi_pool_op.py": [U + "test_detection_ops.py"],
+    "test_roi_pool_op.py": [U + "test_tail_ops.py"],
     "test_scope.py": [U + "test_checkpoint_and_errors.py",
                       U + "test_aux_modules.py"],
     "test_seq_conv.py": [U + "test_sequence_ops.py",
