@@ -152,22 +152,36 @@ ELEMENTWISE_GRID = [
 ]
 
 
-@pytest.mark.parametrize("op", ["elementwise_add", "elementwise_mul"])
+_ELEMENTWISE_FNS = {
+    "elementwise_add": lambda x, y: x + y,
+    "elementwise_sub": lambda x, y: x - y,
+    "elementwise_mul": lambda x, y: x * y,
+    "elementwise_div": lambda x, y: x / y,
+    "elementwise_max": np.maximum,
+    "elementwise_min": np.minimum,
+    "elementwise_pow": np.power,
+}
+
+
+@pytest.mark.parametrize("op", sorted(_ELEMENTWISE_FNS))
 @pytest.mark.parametrize("xs,ys,axis,yview", ELEMENTWISE_GRID)
 def test_elementwise_ref_config(op, xs, ys, axis, yview):
-    x = rng.rand(*xs).astype("float32")
+    """The reference runs the SAME axis-broadcast grid for every
+    elementwise variant (test_elementwise_{add,sub,mul,div,max,min,
+    pow}_op.py share the TestElementwiseOp scaffolding)."""
+    x = rng.rand(*xs).astype("float32") + 0.5
     y = rng.rand(*ys).astype("float32") + 0.5
-    yb = y.reshape(yview)
-    exp = x + yb if op == "elementwise_add" else x * yb
+    exp = _ELEMENTWISE_FNS[op](x, y.reshape(yview))
     got, = run_op(op, {"X": x, "Y": y}, {"axis": axis})
-    np.testing.assert_allclose(got, exp, rtol=1e-6)
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
 
 
-def test_elementwise_ref_grad():
-    x = rng.rand(2, 3, 4).astype("float32")
+@pytest.mark.parametrize("op", ["elementwise_mul", "elementwise_div",
+                                "elementwise_sub", "elementwise_pow"])
+def test_elementwise_ref_grad(op):
+    x = rng.rand(2, 3, 4).astype("float32") + 0.5
     y = rng.rand(3).astype("float32") + 0.5
-    check_grad_fd("elementwise_mul", {"X": x, "Y": y}, "Y",
-                  attrs={"axis": 1})
+    check_grad_fd(op, {"X": x, "Y": y}, "Y", attrs={"axis": 1})
 
 
 # ---------------------------------------------------------------------------

@@ -123,7 +123,12 @@ TRANCHE = {
     "test_dropout_op.py": T1,
     "test_edit_distance_op.py": T1,
     "test_elementwise_add_op.py": T1,
+    "test_elementwise_div_op.py": T1,
+    "test_elementwise_max_op.py": T1,
+    "test_elementwise_min_op.py": T1,
     "test_elementwise_mul_op.py": T1,
+    "test_elementwise_pow_op.py": T1,
+    "test_elementwise_sub_op.py": T1,
     "test_expand_op.py": T2,
     "test_ftrl_op.py": T4,
     "test_gather_op.py": T1,
@@ -227,13 +232,6 @@ EQUIV = {
                         U + "test_rnn_numeric.py"],
     "test_dynrnn_gradient_check.py": [U + "test_control_flow.py"],
     "test_dynrnn_static_input.py": [U + "test_control_flow.py"],
-    "test_elementwise_div_op.py": [U + "test_ops_coverage.py"],
-    "test_elementwise_max_op.py": [U + "test_ops_coverage.py",
-                                   U + "test_grad_coverage_extras.py"],
-    "test_elementwise_min_op.py": [U + "test_ops_coverage.py",
-                                   U + "test_grad_coverage_extras.py"],
-    "test_elementwise_pow_op.py": [U + "test_ops_coverage.py"],
-    "test_elementwise_sub_op.py": [U + "test_ops_coverage.py"],
     "test_exception.py": [U + "test_checkpoint_and_errors.py"],
     "test_executor_and_mul.py": [U + "test_ops_numeric.py",
                                  U + "test_fit_a_line.py"],
