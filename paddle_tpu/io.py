@@ -23,6 +23,7 @@ __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "load_reference_model",
+    "save_reference_model",
     "get_inference_program",
     "save_checkpoint", "load_checkpoint",
     "get_parameter_value", "get_parameter_value_by_name",
@@ -145,6 +146,20 @@ def load_inference_model(dirname, executor, model_filename=None,
     load_params(executor, dirname)
     fetch_vars = [program.global_block().var(n) for n in meta["fetch"]]
     return program, meta["feed"], fetch_vars
+
+
+def save_reference_model(dirname, feeded_var_names, target_vars,
+                         executor, main_program=None):
+    """Era-FORMAT save_inference_model: writes the reference's on-disk
+    layout (__model__ ProgramDesc protobuf + one save_op-stream file per
+    param), so reference-era deployments — and this framework's own
+    load_reference_model — can serve a model trained here. The native
+    round-trip format is save_inference_model; this is the migration
+    EXIT path matching load_reference_model's entry path."""
+    from . import reference_format as _rf
+    return _rf.save_reference_inference_model(
+        dirname, feeded_var_names, target_vars, executor,
+        main_program=main_program)
 
 
 def load_reference_model(dirname, executor, model_filename=None):

@@ -21,7 +21,9 @@ from .core.framework import Block, Program
 
 __all__ = ["parse_program_desc", "read_lod_tensor_file",
            "adapt_sequence_layout",
-           "strip_feed_fetch"]
+           "strip_feed_fetch",
+           "serialize_program_desc", "write_lod_tensor_file",
+           "save_reference_inference_model"]
 
 
 # ---------------------------------------------------------------------------
@@ -470,3 +472,238 @@ def adapt_sequence_layout(program, feed_names):
                 v.lod_level = 1
             v.seq_len_var = ln
     return program
+
+
+# ---------------------------------------------------------------------------
+# era-format EXPORT: write ProgramDesc protobuf + save_op param files so
+# REFERENCE-era deployments can load models trained here. The wire layout
+# mirrors this module's own parser (field numbers cited there from
+# framework.proto); nothing below is translated reference code.
+# ---------------------------------------------------------------------------
+
+_DTYPE_ENUM = {v: k for k, v in _DTYPE.items()}          # name -> enum
+
+
+def _w_varint(v):
+    out = b""
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _w_tag(field, wire):
+    return _w_varint((field << 3) | wire)
+
+
+def _w_ld(field, payload):
+    if isinstance(payload, str):
+        payload = payload.encode("utf-8")
+    return _w_tag(field, 2) + _w_varint(len(payload)) + payload
+
+
+def _w_vi(field, v):
+    return _w_tag(field, 0) + _w_varint(v)
+
+
+def _encode_wire_attr(name, value):
+    """One OpDesc.Attr message. AttrType order mirrors _parse_attr's pick
+    table: INT FLOAT STRING INTS FLOATS STRINGS BOOLEAN BOOLEANS BLOCK
+    LONG."""
+    out = _w_ld(1, name)
+    if isinstance(value, bool):            # before int: bool IS int
+        return out + _w_vi(2, 6) + _w_vi(10, int(value))
+    if isinstance(value, (int, np.integer)):
+        return out + _w_vi(2, 0) + _w_vi(3, int(value))
+    if isinstance(value, (float, np.floating)):
+        return out + _w_vi(2, 1) + _w_tag(4, 5) + struct.pack(
+            "<f", float(value))
+    if isinstance(value, str):
+        return out + _w_vi(2, 2) + _w_ld(5, value)
+    if isinstance(value, (list, tuple)):
+        vals = list(value)
+        if not vals:
+            # an empty list has no observable element type; the era's
+            # OpDesc type check compares declared AttrType, so writing a
+            # guessed type would be wrong — omit the attr entirely (a
+            # repeated proto2 field left unset reads back as empty, and
+            # era ops' list attrs SetDefault to empty)
+            return None
+        if all(isinstance(x, bool) for x in vals) and vals:
+            return out + _w_vi(2, 7) + _w_ld(
+                11, b"".join(_w_varint(int(x)) for x in vals))
+        if all(isinstance(x, (int, np.integer)) for x in vals):
+            return out + _w_vi(2, 3) + _w_ld(
+                6, b"".join(_w_varint(int(x) & ((1 << 64) - 1))
+                            for x in vals))
+        if all(isinstance(x, (float, np.floating)) for x in vals):
+            return out + _w_vi(2, 4) + _w_ld(
+                7, struct.pack("<%df" % len(vals),
+                               *[float(x) for x in vals]))
+        if all(isinstance(x, str) for x in vals):
+            return out + _w_vi(2, 5) + b"".join(
+                _w_ld(8, x) for x in vals)
+    raise ValueError(
+        "cannot encode attr %r=%r (%s) in the era wire format"
+        % (name, value, type(value).__name__))
+
+
+def _encode_wire_var(var, var_type=7):
+    """VarDesc: name, VarType{type, LoDTensorDesc{TensorDesc, lod}},
+    persistable."""
+    body = _w_vi(1, var_type)
+    if var_type == 7:       # LOD_TENSOR
+        dims = var.shape if var.shape is not None else ()
+        tensor = _w_vi(1, _DTYPE_ENUM.get(var.dtype or "float32", 5))
+        tensor += b"".join(
+            _w_vi(2, int(d) & ((1 << 64) - 1)) for d in dims)
+        lodt = _w_ld(1, tensor)
+        if getattr(var, "lod_level", 0):
+            lodt += _w_vi(2, int(var.lod_level))
+        body += _w_ld(3, lodt)
+    out = _w_ld(1, var.name) + _w_ld(2, body)
+    if var.persistable:
+        out += _w_vi(3, 1)
+    return out
+
+
+def _encode_wire_op(op_type, inputs, outputs, attrs):
+    out = _w_ld(3, op_type)
+    for slot, args in inputs.items():
+        out += _w_ld(1, _w_ld(1, slot) + b"".join(
+            _w_ld(2, a) for a in args))
+    for slot, args in outputs.items():
+        out += _w_ld(2, _w_ld(1, slot) + b"".join(
+            _w_ld(2, a) for a in args))
+    for k in sorted(attrs):
+        if k.startswith("__"):
+            continue        # internal bookkeeping, never on the era wire
+        enc = _encode_wire_attr(k, attrs[k])
+        if enc is not None:
+            out += _w_ld(4, enc)
+    return out
+
+
+def serialize_program_desc(program, feed_names, fetch_names):
+    """Program (single-block inference graph) -> era ProgramDesc bytes,
+    with the feed/fetch plumbing the era's save_inference_model prepends
+    and appends (feed ops listed col n-1..0, the real serializer's
+    insert-at-0 order our own strip_feed_fetch handles)."""
+    # prune() empties orphaned sub-blocks but keeps their slots so
+    # attrs['sub_block'] indices stay stable — an empty trailing block
+    # is fine; a NON-empty one means live control flow we can't encode
+    for b in program.blocks[1:]:
+        if b.ops or b.vars:
+            raise ValueError(
+                "era export handles single-block inference programs; "
+                "block %d still carries ops/vars (export the pruned "
+                "inference program)" % b.idx)
+    blk = program.global_block()
+    # padded-dense sequence wiring (@SEQLEN companions, XLen slots,
+    # rank-bumped attrs) is THIS framework's layout — the era has no
+    # notion of it, so an exported sequence model would be silently
+    # unloadable there and double-adapted here. Refuse loudly.
+    for v in blk.vars.values():
+        if getattr(v, "lod_level", 0) or getattr(v, "seq_len_var", None):
+            raise ValueError(
+                "era export supports DENSE inference graphs; var %r "
+                "carries sequence (LoD) wiring — the padded-dense "
+                "layout does not serialize to valid era format"
+                % v.name)
+    # idx 0, parent -1 (64-bit two's-complement varint, as the era wrote)
+    body = _w_vi(1, 0) + _w_tag(2, 0) + _w_varint((1 << 64) - 1)
+    # feed/fetch carrier vars
+    class _FV:
+        def __init__(self, name):
+            self.name, self.persistable = name, False
+    body += _w_ld(3, _encode_wire_var(_FV("feed"), var_type=9))
+    body += _w_ld(3, _encode_wire_var(_FV("fetch"), var_type=10))
+    for name in sorted(blk.vars):
+        v = blk.vars[name]
+        if getattr(v, "type", None) in ("tensor_array", "rank_table"):
+            raise ValueError(
+                "era export supports dense inference graphs; var %r has "
+                "runtime type %r" % (name, v.type))
+        body += _w_ld(3, _encode_wire_var(v))
+    # feed ops inserted at index 0 each -> serialized order col n-1..0
+    for col in range(len(feed_names) - 1, -1, -1):
+        body += _w_ld(4, _encode_wire_op(
+            "feed", {"X": ["feed"]}, {"Out": [feed_names[col]]},
+            {"col": col}))
+    from .core.lowering import _SPECIAL
+    for op in blk.ops:
+        if op.type == "grad_of":
+            raise ValueError("era export takes the INFERENCE program; "
+                             "prune the backward first")
+        if op.type in _SPECIAL:
+            raise ValueError(
+                "era export supports dense inference graphs; op %r is a "
+                "graph-level (sub-block / LoD-structure) construct"
+                % op.type)
+        body += _w_ld(4, _encode_wire_op(op.type, op.inputs, op.outputs,
+                                         op.attrs))
+    for col, name in enumerate(fetch_names):
+        body += _w_ld(4, _encode_wire_op(
+            "fetch", {"X": [name]}, {"Out": ["fetch"]}, {"col": col}))
+    return _w_ld(1, body)
+
+
+def write_lod_tensor_file(path, arr, lod=None):
+    """save_op stream layout (the exact inverse of read_lod_tensor_file):
+    u32 version | u64 lod levels (+ per-level u64 nbytes + offsets) |
+    u32 tensor version | i32 desc size | TensorDesc | raw data."""
+    arr = np.ascontiguousarray(arr)
+    desc = _w_vi(1, _DTYPE_ENUM[str(arr.dtype)]) + b"".join(
+        _w_vi(2, d) for d in arr.shape)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<I", 0))
+        levels = lod or []
+        f.write(struct.pack("<Q", len(levels)))
+        for level in levels:
+            level = np.asarray(level, "<u8")
+            f.write(struct.pack("<Q", level.nbytes))
+            f.write(level.tobytes())
+        f.write(struct.pack("<I", 0))
+        f.write(struct.pack("<i", len(desc)))
+        f.write(desc)
+        f.write(arr.tobytes())
+
+
+def save_reference_inference_model(dirname, feeded_var_names, target_vars,
+                                   executor, main_program=None,
+                                   scope=None):
+    """Era-format save_inference_model: __model__ ProgramDesc protobuf +
+    one save_op-layout file per persistable param — a directory the
+    REFERENCE runtime (and this framework's load_reference_model) can
+    serve. The era counterpart wrote the same layout from C++
+    (save_op + Program.desc serialization)."""
+    import os as _os
+    from .core.executor import global_scope
+    from .core.framework import default_main_program
+
+    program = main_program if main_program is not None \
+        else default_main_program()
+    targets = [t if isinstance(t, str) else t.name for t in target_vars]
+    inference = program.prune(
+        [program.global_block().var(t) for t in targets], for_test=True)
+    scope = scope or global_scope()
+
+    _os.makedirs(dirname, exist_ok=True)
+    with open(_os.path.join(dirname, "__model__"), "wb") as f:
+        f.write(serialize_program_desc(
+            inference, list(feeded_var_names), targets))
+    for v in inference.global_block().vars.values():
+        if not v.persistable:
+            continue
+        val = scope.get(v.name)
+        if val is None:
+            raise ValueError(
+                "persistable var %r has no value in the scope — run the "
+                "startup program (or load params) first" % v.name)
+        write_lod_tensor_file(_os.path.join(dirname, v.name),
+                              np.asarray(val))
+    return inference

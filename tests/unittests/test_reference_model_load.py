@@ -651,3 +651,154 @@ def test_adapt_rejects_unhandled_sequence_restructuring_ops():
     prog = seq_program(op_desc("concat", [("X", ["words"])],
                                [("Out", ["out"])], [attr("axis", 0, 1)]))
     rf.adapt_sequence_layout(prog, ["words"])  # must not raise
+
+
+# --- era-format EXPORT (round 5): the migration EXIT path ------------------
+
+def _roundtrip(build, feeds, tmp_path, n=3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        feed_vars, target = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(7)
+    feed = {v.name: rng.rand(n, *[int(d) for d in v.shape[1:]])
+            .astype("float32") for v in feed_vars}
+    d = str(tmp_path / "era")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_reference_model(d, [v.name for v in feed_vars],
+                                      [target], exe, main_program=main)
+        want, = exe.run(main, feed=feed, fetch_list=[target])
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feed_names, fetches = fluid.io.load_reference_model(d, exe)
+        assert feed_names == [v.name for v in feed_vars]
+        got, = exe.run(prog, feed=feed, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_era_export_roundtrip_mlp(tmp_path):
+    """save_reference_model writes the era's on-disk layout; loading it
+    back through the (era-convention-validated) loader reproduces the
+    original outputs exactly."""
+    def build():
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        out = fluid.layers.fc(input=h, size=3, act="softmax")
+        return [x], out
+    _roundtrip(build, 1, tmp_path)
+
+
+def test_era_export_roundtrip_conv_multifeed(tmp_path):
+    """conv attrs (ints lists), two feeds (col attr order on the wire is
+    the era's inserted-at-0 reversal, exercised through strip_feed_fetch),
+    elementwise with axis."""
+    def build():
+        img = fluid.layers.data(name="img", shape=[2, 8, 8],
+                                dtype="float32")
+        extra = fluid.layers.data(name="extra", shape=[3], dtype="float32")
+        c = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                padding=1, act="relu")
+        p = fluid.layers.pool2d(input=c, pool_size=2, pool_stride=2,
+                                pool_type="max")
+        logits = fluid.layers.fc(input=p, size=3)
+        out = fluid.layers.softmax(
+            fluid.layers.elementwise_add(logits, extra))
+        return [img, extra], out
+    _roundtrip(build, 2, tmp_path)
+
+
+def test_era_export_rejects_unsupported(tmp_path):
+    exe = fluid.Executor(fluid.CPUPlace())
+    # backward present -> prune drops it, so export works; but a
+    # TensorArray var in the inference slice must refuse
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+        arr = fluid.layers.array_write(
+            x, fluid.layers.fill_constant([1], "int64", 0))
+        out = fluid.layers.array_read(
+            arr, fluid.layers.fill_constant([1], "int64", 0))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="dense inference|graph-level"):
+            fluid.io.save_reference_model(str(tmp_path / "bad"), ["x"],
+                                          [out], exe, main_program=main)
+
+
+def test_era_export_attr_types_survive_the_wire(tmp_path):
+    """One op of each attr kind through serialize->parse: int, float,
+    bool, str, ints, floats — including a NEGATIVE int (64-bit
+    two's-complement varint, the era encoding)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.scale(x, scale=-2.5, bias=0.5)   # floats
+        y = fluid.layers.reduce_sum(y, dim=[-1], keep_dim=True)  # neg int
+        out = fluid.layers.dropout(y, dropout_prob=0.0,
+                                   is_test=True)          # float+bool
+    raw = rf.serialize_program_desc(main, ["x"], [out.name])
+    feeds, fetches = rf.strip_feed_fetch(raw)
+    assert feeds == ["x"] and fetches == [out.name]
+    prog = rf.parse_program_desc(raw)
+    ops = {op.type: op for op in prog.global_block().ops}
+    assert ops["scale"].attrs["scale"] == -2.5
+    assert ops["reduce_sum"].attrs["dim"] == [-1]
+    assert ops["reduce_sum"].attrs["keep_dim"] is True
+    assert ops["dropout"].attrs["is_test"] is True
+    assert abs(ops["dropout"].attrs["dropout_prob"]) < 1e-7
+
+
+def test_era_export_rejects_sequence_models(tmp_path):
+    """Padded-dense sequence wiring has no valid era wire form — export
+    must refuse, not write a silently-incompatible desc (which the era
+    could not load and our own loader would double-adapt)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="w", shape=[4], dtype="float32",
+                                  lod_level=1)
+        pooled = fluid.layers.sequence_pool(input=words, pool_type="sum")
+        out = fluid.layers.fc(input=pooled, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="DENSE inference"):
+            fluid.io.save_reference_model(str(tmp_path / "seq"), ["w"],
+                                          [out], exe, main_program=main)
+
+
+def test_era_export_tolerates_emptied_subblocks(tmp_path):
+    """prune() empties orphaned sub-blocks but keeps their slots; a
+    train program with control flow OFF the inference path must still
+    export its dense slice."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(input=x, size=2, act="softmax")
+        # off-path branch with a sub-block (metrics-style)
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        arr = fluid.layers.array_write(fluid.layers.reduce_sum(x), i)
+    # a real orphaned sub-block slot (prune keeps emptied slots so
+    # attrs['sub_block'] indices stay stable)
+    main.create_block()
+    main.rollback()
+    assert len(main.blocks) > 1
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        d = str(tmp_path / "densepart")
+        fluid.io.save_reference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+        xs = np.random.RandomState(1).rand(2, 4).astype("f")
+        want, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_reference_model(d, exe)
+        got, = exe.run(prog, feed={"x": xs}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
