@@ -588,11 +588,93 @@ def _encode_wire_op(op_type, inputs, outputs, attrs):
     return out
 
 
+def _deadapt_for_wire(blk):
+    """The inverse of adapt_sequence_layout, computed per-op for the
+    wire: padded-dense sequence wiring (@SEQLEN companions, XLen/OutLen
+    slots, rank-bumped mul/elementwise/concat attrs, [B, T, ...] var
+    dims) becomes the era's flat-LoD-rows convention. Returns
+    (seq_names, skip_vars, op_view) where op_view(op) -> (inputs,
+    outputs, attrs) era-shaped, or raises for sequence ops outside the
+    adapter's handled set (the same set the import side rewires)."""
+    seq = {n for n, v in blk.vars.items() if getattr(v, "lod_level", 0)}
+    skip = {getattr(v, "seq_len_var", None) for v in blk.vars.values()}
+    skip.discard(None)
+
+    def _strip_len_slots(slot_map, op_type):
+        """Drop every slot that refers exclusively to @SEQLEN companion
+        vars (XLen/OutLen/YLen/DetectLen/... — driven by the skip set,
+        not a name allowlist); a slot mixing companion and real names
+        has no era form."""
+        out = {}
+        for s, names in slot_map.items():
+            hits = [n in skip for n in names if n]
+            if hits and all(hits):
+                continue
+            if any(hits):
+                raise ValueError(
+                    "era export: op %r slot %r mixes sequence-length "
+                    "companions with data vars" % (op_type, s))
+            out[s] = list(names)
+        return out
+
+    def op_view(op):
+        t = op.type
+        ins = _strip_len_slots(op.inputs, t)
+        outs = _strip_len_slots(op.outputs, t)
+        attrs = dict(op.attrs)
+        ins_names = [n for ns in ins.values() for n in ns if n]
+        if any(n in seq for n in ins_names):
+            if t in _UNHANDLED_SEQ_RESTRUCTURING:
+                raise ValueError(
+                    "era export: sequence op %r is outside the layout "
+                    "adapter's handled set" % t)
+            # The load-side adapter only ever PRODUCES the padded attr
+            # values inverted here (mul >=2, elementwise/concat axis
+            # >=2); a padded value outside that range (e.g. time-axis
+            # concat at axis 1) has no flat-era preimage — writing it
+            # would silently change semantics on the era side AND on
+            # re-import. Refuse loudly instead.
+            if t == "mul" and ins.get("X", [None])[0] in seq:
+                ncd = attrs.get("x_num_col_dims", 1)
+                if ncd < 2:
+                    raise ValueError(
+                        "era export: mul over sequence %r with "
+                        "x_num_col_dims=%d has no flat-era preimage"
+                        % (ins["X"][0], ncd))
+                attrs["x_num_col_dims"] = ncd - 1
+            elif t.startswith("elementwise_"):
+                x = ins.get("X", [None])[0]
+                y = ins.get("Y", [None])[0]
+                if x in seq and y not in seq:
+                    ax = attrs.get("axis", -1)
+                    if ax == 1:
+                        raise ValueError(
+                            "era export: elementwise %s over sequence "
+                            "%r broadcasts along the padded TIME axis "
+                            "(axis=1) — no flat-era preimage" % (t, x))
+                    if ax >= 2:
+                        attrs["axis"] = ax - 1
+            elif t == "concat":
+                ax = attrs.get("axis", 0)
+                if ax in (1, -2):
+                    raise ValueError(
+                        "era export: concat along the padded TIME axis "
+                        "is sequence_concat semantics — no flat-era "
+                        "preimage")
+                if ax >= 2:
+                    attrs["axis"] = ax - 1
+        return ins, outs, attrs
+
+    return seq, skip, op_view
+
+
 def serialize_program_desc(program, feed_names, fetch_names):
     """Program (single-block inference graph) -> era ProgramDesc bytes,
     with the feed/fetch plumbing the era's save_inference_model prepends
     and appends (feed ops listed col n-1..0, the real serializer's
-    insert-at-0 order our own strip_feed_fetch handles)."""
+    insert-at-0 order our own strip_feed_fetch handles). Sequence
+    programs are de-adapted to the era's flat-LoD-rows convention — the
+    exact inverse of what adapt_sequence_layout applies on load."""
     # prune() empties orphaned sub-blocks but keeps their slots so
     # attrs['sub_block'] indices stay stable — an empty trailing block
     # is fine; a NON-empty one means live control flow we can't encode
@@ -603,17 +685,6 @@ def serialize_program_desc(program, feed_names, fetch_names):
                 "block %d still carries ops/vars (export the pruned "
                 "inference program)" % b.idx)
     blk = program.global_block()
-    # padded-dense sequence wiring (@SEQLEN companions, XLen slots,
-    # rank-bumped attrs) is THIS framework's layout — the era has no
-    # notion of it, so an exported sequence model would be silently
-    # unloadable there and double-adapted here. Refuse loudly.
-    for v in blk.vars.values():
-        if getattr(v, "lod_level", 0) or getattr(v, "seq_len_var", None):
-            raise ValueError(
-                "era export supports DENSE inference graphs; var %r "
-                "carries sequence (LoD) wiring — the padded-dense "
-                "layout does not serialize to valid era format"
-                % v.name)
     # idx 0, parent -1 (64-bit two's-complement varint, as the era wrote)
     body = _w_vi(1, 0) + _w_tag(2, 0) + _w_varint((1 << 64) - 1)
     # feed/fetch carrier vars
@@ -622,13 +693,28 @@ def serialize_program_desc(program, feed_names, fetch_names):
             self.name, self.persistable = name, False
     body += _w_ld(3, _encode_wire_var(_FV("feed"), var_type=9))
     body += _w_ld(3, _encode_wire_var(_FV("fetch"), var_type=10))
+    seq_names, skip_vars, op_view = _deadapt_for_wire(blk)
+
+    class _FlatView:
+        """Era dims for a padded sequence var: [B, T, ...] -> [-1, ...]
+        flat rows (the dims adapt_sequence_layout re-pads on load)."""
+        def __init__(self, v):
+            self.name, self.dtype = v.name, v.dtype
+            self.persistable = v.persistable
+            self.lod_level = v.lod_level
+            self.shape = ((-1,) + tuple(v.shape[2:])) \
+                if v.shape is not None and len(v.shape) >= 2 else v.shape
+
     for name in sorted(blk.vars):
+        if name in skip_vars:
+            continue        # @SEQLEN companions never existed in the era
         v = blk.vars[name]
         if getattr(v, "type", None) in ("tensor_array", "rank_table"):
             raise ValueError(
                 "era export supports dense inference graphs; var %r has "
                 "runtime type %r" % (name, v.type))
-        body += _w_ld(3, _encode_wire_var(v))
+        body += _w_ld(3, _encode_wire_var(
+            _FlatView(v) if name in seq_names else v))
     # feed ops inserted at index 0 each -> serialized order col n-1..0
     for col in range(len(feed_names) - 1, -1, -1):
         body += _w_ld(4, _encode_wire_op(
@@ -644,8 +730,8 @@ def serialize_program_desc(program, feed_names, fetch_names):
                 "era export supports dense inference graphs; op %r is a "
                 "graph-level (sub-block / LoD-structure) construct"
                 % op.type)
-        body += _w_ld(4, _encode_wire_op(op.type, op.inputs, op.outputs,
-                                         op.attrs))
+        w_ins, w_outs, w_attrs = op_view(op)
+        body += _w_ld(4, _encode_wire_op(op.type, w_ins, w_outs, w_attrs))
     for col, name in enumerate(fetch_names):
         body += _w_ld(4, _encode_wire_op(
             "fetch", {"X": [name]}, {"Out": ["fetch"]}, {"col": col}))

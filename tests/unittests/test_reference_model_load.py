@@ -752,22 +752,98 @@ def test_era_export_attr_types_survive_the_wire(tmp_path):
     assert abs(ops["dropout"].attrs["dropout_prob"]) < 1e-7
 
 
-def test_era_export_rejects_sequence_models(tmp_path):
-    """Padded-dense sequence wiring has no valid era wire form — export
-    must refuse, not write a silently-incompatible desc (which the era
-    could not load and our own loader would double-adapt)."""
+def test_era_export_roundtrip_sequence_model(tmp_path):
+    """SEQUENCE export: the padded-dense wiring (@SEQLEN companions,
+    XLen slots, rank-bumped attrs, [B,T,...] dims) is de-adapted to the
+    era's flat-LoD-rows convention on the wire — the exact inverse of
+    adapt_sequence_layout, which re-applies on load. Round-trip must be
+    output-exact on ragged input."""
+    from paddle_tpu.core.lod import LoDTensor
     main, startup = fluid.Program(), fluid.Program()
     with fluid.unique_name.guard(), fluid.program_guard(main, startup):
         words = fluid.layers.data(name="w", shape=[4], dtype="float32",
                                   lod_level=1)
-        pooled = fluid.layers.sequence_pool(input=words, pool_type="sum")
+        h = fluid.layers.fc(input=words, size=6, act="tanh")
+        pooled = fluid.layers.sequence_pool(input=h, pool_type="sum")
+        out = fluid.layers.fc(input=pooled, size=2, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(9)
+    seqs = [rng.randn(L, 4).astype("float32") for L in (3, 5, 1)]
+    feed = {"w": LoDTensor.from_sequences(seqs)}
+    d = str(tmp_path / "seq")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_reference_model(d, ["w"], [out], exe,
+                                      main_program=main)
+        want, = exe.run(main, feed=feed, fetch_list=[out])
+    # the wire must be ERA-shaped: flat dims, no @SEQLEN, no XLen,
+    # un-bumped mul attr
+    raw = open(d + "/__model__", "rb").read()
+    prog = rf.parse_program_desc(raw)
+    gb = prog.global_block()
+    assert not any(n.endswith("@SEQLEN") for n in gb.vars)
+    assert tuple(gb.var("w").shape) == (-1, 4)
+    mul = next(op for op in gb.ops if op.type == "mul")
+    assert mul.attrs.get("x_num_col_dims", 1) == 1
+    assert "XLen" not in next(op for op in gb.ops
+                              if op.type == "sequence_pool").inputs
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog2, feeds, fetches = fluid.io.load_reference_model(d, exe)
+        got, = exe.run(prog2, feed=feed, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_era_export_roundtrip_lstm_model(tmp_path):
+    """dynamic LSTM export: XLen dropped on the wire, re-attached by the
+    load-side adapter; outputs exact on ragged input."""
+    from paddle_tpu.core.lod import LoDTensor
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="w", shape=[4], dtype="float32",
+                                  lod_level=1)
+        proj = fluid.layers.fc(input=words, size=12)
+        hidden, _ = fluid.layers.dynamic_lstm(input=proj, size=12)
+        pooled = fluid.layers.sequence_pool(input=hidden,
+                                            pool_type="last")
+        out = fluid.layers.fc(input=pooled, size=2, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(11)
+    seqs = [rng.randn(L, 4).astype("float32") * 0.5 for L in (4, 2, 6)]
+    feed = {"w": LoDTensor.from_sequences(seqs)}
+    d = str(tmp_path / "lstm")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_reference_model(d, ["w"], [out], exe,
+                                      main_program=main)
+        want, = exe.run(main, feed=feed, fetch_list=[out])
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog2, feeds, fetches = fluid.io.load_reference_model(d, exe)
+        got, = exe.run(prog2, feed=feed, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_era_export_rejects_unadaptable_sequence_ops(tmp_path):
+    """Sequence ops outside the adapter's handled set (lod_reset &co)
+    still refuse: their era form cannot be reconstructed."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="w", shape=[4], dtype="float32",
+                                  lod_level=1)
+        r = fluid.layers.lod_reset(x=words, target_lod=[0, 2, 4])
+        pooled = fluid.layers.sequence_pool(input=r, pool_type="sum")
         out = fluid.layers.fc(input=pooled, size=2)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
-        with pytest.raises(ValueError, match="DENSE inference"):
-            fluid.io.save_reference_model(str(tmp_path / "seq"), ["w"],
+        with pytest.raises(ValueError, match="handled set"):
+            fluid.io.save_reference_model(str(tmp_path / "bad2"), ["w"],
                                           [out], exe, main_program=main)
 
 
@@ -802,3 +878,26 @@ def test_era_export_tolerates_emptied_subblocks(tmp_path):
         got, = exe.run(prog, feed={"x": xs}, fetch_list=fetches)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_era_export_rejects_uninvertible_padded_attrs(tmp_path):
+    """Padded attr values the load-side adapter can never produce (time-
+    axis concat at axis=1) have no flat-era preimage — export must
+    refuse, not silently change semantics on the wire."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        a = fluid.layers.data(name="a", shape=[4], dtype="float32",
+                              lod_level=1)
+        b = fluid.layers.data(name="b", shape=[4], dtype="float32",
+                              lod_level=1)
+        cat = fluid.layers.concat([a, b], axis=1)   # padded TIME concat
+        pooled = fluid.layers.sequence_pool(input=cat, pool_type="sum")
+        out = fluid.layers.fc(input=pooled, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="TIME axis"):
+            fluid.io.save_reference_model(str(tmp_path / "bad3"),
+                                          ["a", "b"], [out], exe,
+                                          main_program=main)
