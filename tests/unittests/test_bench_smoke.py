@@ -7,6 +7,7 @@ compile path is covered in-process by test_mixed_precision.py). Guards the
 driver-facing artifact against regressions the unit suite wouldn't see.
 """
 import json
+import pytest
 import os
 import subprocess
 import sys
@@ -72,3 +73,24 @@ def test_sweeps_only_set_knobs_bench_reads():
         assert not unknown, (
             "%s sets BENCH_ vars bench.py never reads: %s"
             % (os.path.basename(path), sorted(unknown)))
+
+
+@pytest.mark.slow
+def test_bench_transformer_decode_smoke():
+    """The decode bench mode the sweep runs unattended: one subprocess
+    run on CPU at tiny dims must emit the JSON contract line with the
+    emitted-token unit."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(JAX_PLATFORMS="cpu", BENCH_MODEL="transformer",
+               BENCH_DECODE="1", BENCH_BATCH="2", BENCH_SEQ="16",
+               BENCH_BEAM="2", BENCH_STEPS="1", BENCH_WARMUP="1",
+               BENCH_LAYERS="2", BENCH_DMODEL="64")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, timeout=540)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "transformer_cached_decode_throughput"
+    assert rec["unit"] == "emitted tokens/sec/chip"
+    assert rec["value"] > 0
