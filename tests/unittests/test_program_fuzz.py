@@ -335,3 +335,29 @@ def test_random_program_era_export_roundtrip(seed, tmp_path):
         got, = exe.run(prog, feed={"x": xs}, fetch_list=fetches)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("seed", range(1, 30, 6))
+def test_random_program_native_desc_roundtrip(seed):
+    """Property: fuzz-generated programs survive the NATIVE desc
+    serializer (program_to_bytes/parse_from_string) with identical op
+    streams and outputs — the same guarantee the era-format fuzz pins
+    for the protobuf wire."""
+    from paddle_tpu.core.program_desc import program_to_bytes
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x, loss = _build_random(seed)
+    p2 = fluid.Program.parse_from_string(program_to_bytes(main))
+    assert [o.type for o in p2.global_block().ops] == \
+        [o.type for o in main.global_block().ops]
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(2000 + seed)
+    xs = rng.rand(3, DIM).astype("float32")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want, = exe.run(main, feed={"x": xs}, fetch_list=[loss])
+        got, = exe.run(p2, feed={"x": xs},
+                       fetch_list=[loss.name])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6)
