@@ -662,3 +662,61 @@ def test_tree_snapshot_matches_reference():
     assert sorted(live) == sorted(REFERENCE_TREE_FILES), {
         "only_live": sorted(set(live) - set(REFERENCE_TREE_FILES)),
         "only_frozen": sorted(set(REFERENCE_TREE_FILES) - set(live))}
+
+
+# --------------------------------------------------------------------------
+# The reference's python/paddle/v2/tests/ (the legacy-API test suite the
+# v2 compat shim answers to). Same dispositions.
+# --------------------------------------------------------------------------
+
+V2_TEST_FILES = """
+CMakeLists.txt cat.jpg test_data_feeder.py test_image.py test_layer.py
+test_op.py test_paramconf_order.py test_parameters.py test_rnn_layer.py
+test_topology.py
+""".split()
+
+V2_EQUIV = {
+    "test_data_feeder.py": [U + "test_api_surface_extras.py",
+                            B + "test_recognize_digits_v2.py"],
+    "test_image.py": [U + "test_v2_image.py"],
+    "test_layer.py": [U + "test_v2_layer_vocabulary.py"],
+    "test_op.py": [U + "test_api_parity_shims.py"],
+    "test_parameters.py": [U + "test_v2_image.py",
+                           B + "test_recognize_digits_v2.py"],
+    "test_rnn_layer.py": [U + "test_v2_layer_vocabulary.py"],
+    "test_topology.py": [B + "test_recognize_digits_v2.py"],
+}
+
+V2_SKIP = {
+    "CMakeLists.txt": "build-system file",
+    "cat.jpg": "test image asset for v2 test_image; the repo's image "
+               "tests synthesize arrays (zero-egress fixtures)",
+    "test_paramconf_order.py": "asserts the ordering of trainer_config "
+                               "protobuf parameter messages; the v2 shim "
+                               "builds fluid Programs directly, so no "
+                               "paramconf proto exists (SURVEY §2 "
+                               "trainer_config_helpers cut)",
+}
+
+
+def test_v2_tests_accounted_for():
+    disposed = set(V2_EQUIV) | set(V2_SKIP)
+    assert sorted(set(V2_TEST_FILES)) == sorted(disposed), {
+        "missing": sorted(set(V2_TEST_FILES) - disposed),
+        "unknown": sorted(disposed - set(V2_TEST_FILES))}
+    assert not set(V2_EQUIV) & set(V2_SKIP)
+    missing = [rel for targets in V2_EQUIV.values() for rel in targets
+               if not os.path.exists(os.path.join(TESTS_ROOT, rel))]
+    assert not missing, sorted(set(missing))
+
+
+def test_v2_snapshot_matches_reference():
+    d = "/root/reference/python/paddle/v2/tests"
+    if not os.path.isdir(d):
+        pytest.skip("reference checkout not present")
+    live = sorted(n for n in os.listdir(d)
+                  if n != "__init__.py" and n != "__pycache__"
+                  and not n.endswith((".pyc", ".swp", "~")))
+    assert live == sorted(V2_TEST_FILES), {
+        "only_live": sorted(set(live) - set(V2_TEST_FILES)),
+        "only_frozen": sorted(set(V2_TEST_FILES) - set(live))}
