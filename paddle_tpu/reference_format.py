@@ -97,6 +97,11 @@ def _f32(wire, v):
 
 _DTYPE = {0: "bool", 1: "int16", 2: "int32", 3: "int64",
           4: "float16", 5: "float32", 6: "float64"}
+# era op registrations whose name our registry modernized; applied on
+# load (era->ours) via THIS dict in parse_program_desc, and inverted on
+# export so the wire always carries the era registration
+_ERA_TO_OURS_NAME = {"top_k": "topk"}
+_OURS_TO_ERA_NAME = {v: k for k, v in _ERA_TO_OURS_NAME.items()}
 # VarType.Type values describing non-dense runtime objects
 _LOD_TENSOR, _READER = 7, 15
 _FEED_MINIBATCH, _FETCH_LIST = 9, 10
@@ -265,7 +270,9 @@ def parse_program_desc(raw):
         for op_type, ins, outs, attrs in ops:
             if op_type in ("feed", "fetch"):
                 continue  # recovered separately by strip_feed_fetch
-            blk.append_op(type=op_type, inputs=ins, outputs=outs,
+            # era registrations our registry modernized (top_k -> topk)
+            blk.append_op(type=_ERA_TO_OURS_NAME.get(op_type, op_type),
+                          inputs=ins, outputs=outs,
                           attrs=attrs, infer_shape=False)
     program.current_block_idx = 0
     return program
@@ -481,7 +488,58 @@ def adapt_sequence_layout(program, feed_names):
 # framework.proto); nothing below is translated reference code.
 # ---------------------------------------------------------------------------
 
+
+# Every op name the reference registers (frozen grep of REGISTER_OP* over
+# paddle/fluid/operators/*.cc, minus *_grad — the same snapshot the op
+# audit test asserts against; that test imports THIS list). The era
+# runtime can only load descs whose op types are in this set.
+ERA_REGISTERED_OP_NAMES = frozenset("""
+accuracy adadelta adagrad adam adamax array_to_lod_tensor assign
+assign_value auc average_accumulates batch_norm beam_search
+beam_search_decode bilinear_tensor_product bipartite_match box_coder cast
+channel_close channel_create channel_recv channel_send chunk_eval clip
+clip_by_norm concat cond conditional_block conv2d conv2d_transpose conv3d
+conv3d_transpose conv_shift cos_sim crf_decoding crop cross_entropy
+ctc_align cumsum decayed_adagrad delete_var depthwise_conv2d detection_map
+dropout edit_distance elementwise_add elementwise_div elementwise_max
+elementwise_min elementwise_mul elementwise_pow elementwise_sub expand
+feed fetch fill fill_constant fill_constant_batch_size_like
+fill_zeros_like ftrl gather gaussian_random
+gaussian_random_batch_size_like get_places go gru gru_unit hinge_loss
+huber_loss im2sequence increment iou_similarity is_empty l1_norm
+label_smooth layer_norm linear_chain_crf listen_and_serv load
+load_combine lod_array_length lod_rank_table lod_reset
+lod_tensor_to_array log_loss lookup_table lrn lstm lstm_unit lstmp
+margin_rank_loss matmul max_pool2d_with_index max_pool3d_with_index
+max_sequence_len maxout mean merge_lod_tensor mine_hard_examples minus
+modified_huber_loss momentum mul multiclass_nms multiplex nce norm
+one_hot pad parallel_do pool2d pool3d positive_negative_pair
+precision_recall prelu print prior_box proximal_adagrad proximal_gd
+rank_loss read read_from_array recurrent recv reorder_lod_tensor_by_rank
+reshape rmsprop rnn_memory_helper roi_pool row_conv save save_combine
+scale scatter select send sequence_concat sequence_conv sequence_erase
+sequence_expand sequence_pool sequence_reshape sequence_slice
+sequence_softmax sgd shrink_rnn_memory sigmoid_cross_entropy_with_logits
+sign smooth_l1_loss softmax softmax_with_cross_entropy split
+split_lod_tensor split_selected_rows spp squared_l2_distance
+squared_l2_norm sum target_assign top_k transpose uniform_random
+uniform_random_batch_size_like unpool warpctc while write_to_array
+""".split())
+
 _DTYPE_ENUM = {v: k for k, v in _DTYPE.items()}          # name -> enum
+
+# ops the era registers through family MACROS rather than REGISTER_OP
+# (REGISTER_ACTIVATION_OP / compare / logical / reduce) — they don't show
+# in the REGISTER_OP grep snapshot above but are loadable era types
+ERA_MACRO_REGISTERED_NAMES = frozenset("""
+sigmoid logsigmoid exp relu tanh tanh_shrink softshrink sqrt abs ceil
+floor cos sin round reciprocal log square softplus softsign brelu
+leaky_relu soft_relu elu relu6 pow stanh hard_shrink thresholded_relu
+hard_sigmoid swish
+less_than less_equal greater_than greater_equal equal not_equal
+logical_and logical_or logical_xor logical_not
+reduce_sum reduce_mean reduce_max reduce_min reduce_prod
+""".split())
 
 
 def _w_varint(v):
@@ -730,8 +788,27 @@ def serialize_program_desc(program, feed_names, fetch_names):
                 "era export supports dense inference graphs; op %r is a "
                 "graph-level (sub-block / LoD-structure) construct"
                 % op.type)
+        # our registry uses a few modernized names; the wire must carry
+        # the era registration (the load side aliases back)
+        wire_type = _OURS_TO_ERA_NAME.get(op.type, op.type)
+        if wire_type not in ERA_REGISTERED_OP_NAMES and \
+                wire_type not in ERA_MACRO_REGISTERED_NAMES:
+            # A desc naming a non-era op type would be unloadable by the
+            # reference runtime — refuse at write time. Covers both a
+            # TPU-native addition (fused_attention, pipeline, moe, ...)
+            # and the handful of this framework's FUSED parity lowerings
+            # of era APIs (square_error_cost, l2_normalize, ...) that
+            # the era expressed as op compositions; lowering those to
+            # era compositions at export is not implemented.
+            raise ValueError(
+                "era export: op %r has no era registration (it is "
+                "either a TPU-native addition or a fused parity "
+                "lowering the era expressed as an op composition) — "
+                "express the inference head with primitive era ops to "
+                "export" % op.type)
         w_ins, w_outs, w_attrs = op_view(op)
-        body += _w_ld(4, _encode_wire_op(op.type, w_ins, w_outs, w_attrs))
+        body += _w_ld(4, _encode_wire_op(wire_type, w_ins, w_outs,
+                                         w_attrs))
     for col, name in enumerate(fetch_names):
         body += _w_ld(4, _encode_wire_op(
             "fetch", {"X": [name]}, {"Out": ["fetch"]}, {"col": col}))

@@ -210,38 +210,8 @@ def test_special_map_to_graph_level_lowerings():
 # rule — core/lowering.py grad_of — so none has or needs its own entry).
 # ---------------------------------------------------------------------------
 
-REFERENCE_REGISTERED_NAMES = """
-accuracy adadelta adagrad adam adamax array_to_lod_tensor assign
-assign_value auc average_accumulates batch_norm beam_search
-beam_search_decode bilinear_tensor_product bipartite_match box_coder cast
-channel_close channel_create channel_recv channel_send chunk_eval clip
-clip_by_norm concat cond conditional_block conv2d conv2d_transpose conv3d
-conv3d_transpose conv_shift cos_sim crf_decoding crop cross_entropy
-ctc_align cumsum decayed_adagrad delete_var depthwise_conv2d detection_map
-dropout edit_distance elementwise_add elementwise_div elementwise_max
-elementwise_min elementwise_mul elementwise_pow elementwise_sub expand
-feed fetch fill fill_constant fill_constant_batch_size_like
-fill_zeros_like ftrl gather gaussian_random
-gaussian_random_batch_size_like get_places go gru gru_unit hinge_loss
-huber_loss im2sequence increment iou_similarity is_empty l1_norm
-label_smooth layer_norm linear_chain_crf listen_and_serv load
-load_combine lod_array_length lod_rank_table lod_reset
-lod_tensor_to_array log_loss lookup_table lrn lstm lstm_unit lstmp
-margin_rank_loss matmul max_pool2d_with_index max_pool3d_with_index
-max_sequence_len maxout mean merge_lod_tensor mine_hard_examples minus
-modified_huber_loss momentum mul multiclass_nms multiplex nce norm
-one_hot pad parallel_do pool2d pool3d positive_negative_pair
-precision_recall prelu print prior_box proximal_adagrad proximal_gd
-rank_loss read read_from_array recurrent recv reorder_lod_tensor_by_rank
-reshape rmsprop rnn_memory_helper roi_pool row_conv save save_combine
-scale scatter select send sequence_concat sequence_conv sequence_erase
-sequence_expand sequence_pool sequence_reshape sequence_slice
-sequence_softmax sgd shrink_rnn_memory sigmoid_cross_entropy_with_logits
-sign smooth_l1_loss softmax softmax_with_cross_entropy split
-split_lod_tensor split_selected_rows spp squared_l2_distance
-squared_l2_norm sum target_assign top_k transpose uniform_random
-uniform_random_batch_size_like unpool warpctc while write_to_array
-""".split()
+from paddle_tpu.reference_format import ERA_REGISTERED_OP_NAMES
+REFERENCE_REGISTERED_NAMES = sorted(ERA_REGISTERED_OP_NAMES)
 
 # name -> registered-op aliasing where ours differs
 NAME_ALIASES = {"top_k": "topk"}
