@@ -726,6 +726,85 @@ def _deadapt_for_wire(blk):
     return seq, skip, op_view
 
 
+class _OpStub(object):
+    """Era-composition op produced by _decompose_for_era (quacks like
+    Operator for the wire encoder / op_view)."""
+
+    def __init__(self, type, inputs, outputs, attrs):
+        self.type = type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs
+
+
+class _TmpLike(object):
+    """Wire view of a decomposition temporary: dtype/lod follow an
+    existing var; sequence sources get the era FLAT dims directly
+    ([B, T, ...] -> [-1, ...]) since this view bypasses the _FlatView
+    path real seq vars take."""
+
+    def __init__(self, name, src):
+        self.name = name
+        self.dtype = src.dtype
+        self.lod_level = getattr(src, "lod_level", 0)
+        if self.lod_level and src.shape is not None \
+                and len(src.shape) >= 2:
+            self.shape = (-1,) + tuple(src.shape[2:])
+        else:
+            self.shape = src.shape
+        self.persistable = False
+
+
+def _decompose_for_era(op, blk, alloc_name):
+    """Rewrite a fused parity op into the era op COMPOSITION the
+    reference-era layer would have emitted (the export-side analogue of
+    the parity layers). Returns ([(type, ins, outs, attrs)], new_vars)
+    or None when `op` needs no decomposition. new_vars: [(name,
+    like_existing_var_name)] temporaries to declare on the wire."""
+    t = op.type
+    if t == "square_error_cost":
+        x, y = op.inputs["X"][0], op.inputs["Y"][0]
+        out = op.outputs["Out"][0]
+        tmp = alloc_name(out + ".sub")
+        return ([("elementwise_sub", {"X": [x], "Y": [y]},
+                  {"Out": [tmp]}, {}),
+                 ("square", {"X": [tmp]}, {"Out": [out]}, {})],
+                [(tmp, x)])
+    if t in ("sequence_first_step", "sequence_last_step"):
+        pooltype = "FIRST" if t == "sequence_first_step" else "LAST"
+        return ([("sequence_pool", dict(op.inputs),
+                  dict(op.outputs),
+                  {"pooltype": pooltype})], [])
+    if t == "log_softmax":
+        x = op.inputs["X"][0]
+        out = op.outputs["Out"][0]
+        tmp = alloc_name(out + ".sm")
+        return ([("softmax", {"X": [x]}, {"Out": [tmp]}, {}),
+                 ("log", {"X": [tmp]}, {"Out": [out]}, {})],
+                [(tmp, x)])
+    if t in ("squeeze", "unsqueeze"):
+        x = op.inputs["X"][0]
+        xv = blk.vars.get(x)
+        if xv is not None and getattr(xv, "lod_level", 0):
+            # the padded output shape has no flat-era preimage — same
+            # refusal rule as the padded mul/concat attrs
+            raise ValueError(
+                "era export: %s over sequence %r would bake padded "
+                "dims into an era reshape — no flat-era preimage"
+                % (t, x))
+        out = op.outputs["Out"][0]
+        v = blk.vars.get(out)
+        shape = None if v is None else v.shape
+        if shape is None or sum(1 for d in shape if d == -1) > 1:
+            raise ValueError(
+                "era export: %s with non-static output shape %r cannot "
+                "decompose to era reshape" % (t, shape))
+        return ([("reshape", {"X": list(op.inputs["X"])},
+                  {"Out": [out]},
+                  {"shape": [int(d) for d in shape]})], [])
+    return None
+
+
 def serialize_program_desc(program, feed_names, fetch_names):
     """Program (single-block inference graph) -> era ProgramDesc bytes,
     with the feed/fetch plumbing the era's save_inference_model prepends
@@ -779,6 +858,14 @@ def serialize_program_desc(program, feed_names, fetch_names):
             "feed", {"X": ["feed"]}, {"Out": [feed_names[col]]},
             {"col": col}))
     from .core.lowering import _SPECIAL
+    tmp_counter = [0]
+
+    def _alloc_name(base):
+        tmp_counter[0] += 1
+        return "%s.era%d" % (base, tmp_counter[0])
+
+    wire_ops = []
+    extra_vars = []
     for op in blk.ops:
         if op.type == "grad_of":
             raise ValueError("era export takes the INFERENCE program; "
@@ -788,6 +875,19 @@ def serialize_program_desc(program, feed_names, fetch_names):
                 "era export supports dense inference graphs; op %r is a "
                 "graph-level (sub-block / LoD-structure) construct"
                 % op.type)
+        dec = _decompose_for_era(op, blk, _alloc_name)
+        if dec is not None:
+            sub_ops, new_vars = dec
+            extra_vars.extend(new_vars)
+            wire_ops.extend(
+                (_OpStub(t2, i2, o2, a2), op) for t2, i2, o2, a2 in sub_ops)
+        else:
+            wire_ops.append((op, op))
+    for tmp_name, like in extra_vars:
+        src = blk.vars[like]
+        body += _w_ld(3, _encode_wire_var(_TmpLike(tmp_name, src)))
+
+    for op, src_op in wire_ops:
         # our registry uses a few modernized names; the wire must carry
         # the era registration (the load side aliases back)
         wire_type = _OURS_TO_ERA_NAME.get(op.type, op.type)
@@ -805,7 +905,7 @@ def serialize_program_desc(program, feed_names, fetch_names):
                 "either a TPU-native addition or a fused parity "
                 "lowering the era expressed as an op composition) — "
                 "express the inference head with primitive era ops to "
-                "export" % op.type)
+                "export" % src_op.type)
         w_ins, w_outs, w_attrs = op_view(op)
         body += _w_ld(4, _encode_wire_op(wire_type, w_ins, w_outs,
                                          w_attrs))

@@ -947,3 +947,45 @@ def test_era_export_rejects_tpu_native_ops_and_aliases_topk(tmp_path):
         got, = exe.run(prog, feed={"x": xs}, fetch_list=fetches)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-6)
+
+
+def test_era_export_decomposes_fused_parity_ops(tmp_path):
+    """Fused parity lowerings with no single era registration decompose
+    into the era op COMPOSITIONS the reference layer would have emitted:
+    square_error_cost -> elementwise_sub + square, sequence_last_step ->
+    sequence_pool(LAST), log_softmax -> softmax + log, squeeze ->
+    reshape. Round-trip output-exact."""
+    from paddle_tpu.core.lod import LoDTensor
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                              lod_level=1)
+        y = fluid.layers.data(name="y", shape=[6], dtype="float32")
+        last = fluid.layers.sequence_last_step(
+            fluid.layers.fc(input=x, size=6))
+        sec = fluid.layers.square_error_cost(input=last, label=y)
+        out = fluid.layers.reduce_sum(sec, dim=[1], keep_dim=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(17)
+    seqs = [rng.randn(L, 4).astype("float32") for L in (2, 4, 3)]
+    feed = {"x": LoDTensor.from_sequences(seqs),
+            "y": rng.randn(3, 6).astype("float32")}
+    d = str(tmp_path / "dec")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_reference_model(d, ["x", "y"], [out], exe,
+                                      main_program=main)
+        want, = exe.run(main, feed=feed, fetch_list=[out])
+    raw = open(d + "/__model__", "rb").read()
+    prog = rf.parse_program_desc(raw)
+    types = [op.type for op in prog.global_block().ops]
+    assert "square_error_cost" not in types
+    assert "elementwise_sub" in types and "square" in types
+    assert "sequence_last_step" not in types
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog2, feeds, fetches = fluid.io.load_reference_model(d, exe)
+        got, = exe.run(prog2, feed=feed, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)

@@ -213,8 +213,10 @@ def test_special_map_to_graph_level_lowerings():
 from paddle_tpu.reference_format import ERA_REGISTERED_OP_NAMES
 REFERENCE_REGISTERED_NAMES = sorted(ERA_REGISTERED_OP_NAMES)
 
-# name -> registered-op aliasing where ours differs
-NAME_ALIASES = {"top_k": "topk"}
+# name -> registered-op aliasing where ours differs (single source:
+# the era<->ours map reference_format uses on load and export)
+from paddle_tpu.reference_format import _ERA_TO_OURS_NAME
+NAME_ALIASES = dict(_ERA_TO_OURS_NAME)
 
 NAME_SUBSUMED = {
     "feed", "fetch", "load", "load_combine", "save", "save_combine",
