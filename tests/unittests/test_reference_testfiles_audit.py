@@ -235,13 +235,15 @@ EQUIV = {
     "test_exception.py": [U + "test_checkpoint_and_errors.py"],
     "test_executor_and_mul.py": [U + "test_ops_numeric.py",
                                  U + "test_fit_a_line.py"],
-    "test_feed_fetch_method.py": [U + "test_program_tooling_zoo.py"],
-    "test_fetch_var.py": [U + "test_aux_modules.py"],
+    "test_feed_fetch_method.py": [U + "test_api_surface_extras.py"],
+    "test_fetch_var.py": [U + "test_aux_modules.py",
+                          U + "test_api_surface_extras.py"],
     "test_fill_constant_op.py": [U + "test_program_prune.py",
                                  U + "test_ops_coverage.py"],
     "test_fill_op.py": [U + "test_volumetric_ops.py"],
     "test_fill_zeros_like_op.py": [U + "test_loss_misc_ops.py"],
-    "test_framework_debug_str.py": [U + "test_aux_modules.py"],
+    "test_framework_debug_str.py": [U + "test_api_surface_extras.py",
+                                    U + "test_program_tooling_zoo.py"],
     "test_image_classification_layer.py": [U + "test_image_models.py"],
     "test_infer_shape.py": [U + "test_program_fuzz.py"],
     "test_inference_model_io.py": [U + "test_inference_model.py"],
@@ -273,7 +275,7 @@ EQUIV = {
     "test_normalization_wrapper.py": [
         U + "test_calc_gradient_weight_norm.py",
         U + "test_ops_coverage.py"],
-    "test_operator.py": [U + "test_program_tooling_zoo.py"],
+    "test_operator.py": [U + "test_api_surface_extras.py"],
     "test_operator_desc.py": [U + "test_program_tooling_zoo.py"],
     "test_optimizer.py": [U + "test_optimizer_numeric.py"],
     "test_parallel_op.py": [U + "test_api_parity_shims.py",
@@ -316,7 +318,7 @@ EQUIV = {
     "test_tensor.py": [U + "test_sequence_deep.py"],
     "test_unique_name.py": [U + "test_aux_modules.py"],
     "test_unpool_op.py": [U + "test_tail_ops.py"],
-    "test_variable.py": [U + "test_program_tooling_zoo.py"],
+    "test_variable.py": [U + "test_api_surface_extras.py"],
     "test_warpctc_op.py": [U + "test_ctc_ops.py"],
     "test_weight_normalization.py": [
         U + "test_calc_gradient_weight_norm.py"],
