@@ -149,7 +149,8 @@ def load_inference_model(dirname, executor, model_filename=None,
 
 
 def save_reference_model(dirname, feeded_var_names, target_vars,
-                         executor, main_program=None):
+                         executor, main_program=None,
+                         model_filename=None, params_filename=None):
     """Era-FORMAT save_inference_model: writes the reference's on-disk
     layout (__model__ ProgramDesc protobuf + one save_op-stream file per
     param), so reference-era deployments — and this framework's own
@@ -159,10 +160,12 @@ def save_reference_model(dirname, feeded_var_names, target_vars,
     from . import reference_format as _rf
     return _rf.save_reference_inference_model(
         dirname, feeded_var_names, target_vars, executor,
-        main_program=main_program)
+        main_program=main_program, model_filename=model_filename,
+        params_filename=params_filename)
 
 
-def load_reference_model(dirname, executor, model_filename=None):
+def load_reference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
     """Load a model directory saved by REFERENCE-era code
     (python/paddle/fluid/io.py:384 save_inference_model): a `__model__`
     ProgramDesc protobuf plus one save_op LoDTensor file per persistable
@@ -171,9 +174,10 @@ def load_reference_model(dirname, executor, model_filename=None):
 
     Parsing is a hand-rolled protobuf wire reader
     (paddle_tpu/reference_format.py — framework.proto's schema), so no
-    protobuf runtime is needed. Combined single-file params
-    (params_filename/save_combine) are not supported — the era's default
-    was one file per variable. Sequence models load through the
+    protobuf runtime is needed. params_filename loads the era's
+    COMBINED layout (save_combine: all streams in one file, sorted-name
+    order — io.py:120/210 sorts on both sides). Sequence models load
+    through the
     flat-LoD->padded layout adapter (adapt_sequence_layout). Control-flow
     ops in a LOADED desc (While/conditional_block sub-blocks) are not
     supported: the reference desc carries no loop-carry metadata and the
@@ -192,16 +196,21 @@ def load_reference_model(dirname, executor, model_filename=None):
     rf.adapt_sequence_layout(program, feed_names)
 
     scope = global_scope()
-    for v in program.list_vars():
-        if not v.persistable:
-            continue
-        path = os.path.join(dirname, v.name)
-        if not os.path.exists(path):
-            raise RuntimeError(
-                "reference model param file missing: %r (combined "
-                "params_filename saves are not supported)" % path)
-        arr, _lod = rf.read_lod_tensor_file(path)
-        scope.set(v.name, arr)
+    persistables = [v.name for v in program.list_vars() if v.persistable]
+    if params_filename:
+        combined = rf.read_combined_lod_tensor_file(
+            os.path.join(dirname, params_filename), persistables)
+        for name, arr in combined.items():
+            scope.set(name, arr)
+    else:
+        for name in persistables:
+            path = os.path.join(dirname, name)
+            if not os.path.exists(path):
+                raise RuntimeError(
+                    "reference model param file missing: %r (a combined "
+                    "save needs params_filename=...)" % path)
+            arr, _lod = rf.read_lod_tensor_file(path)
+            scope.set(name, arr)
 
     fetch_vars = [program.global_block().var(n) for n in fetch_names]
     return program, feed_names, fetch_vars

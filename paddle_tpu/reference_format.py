@@ -20,6 +20,8 @@ import numpy as np
 from .core.framework import Block, Program
 
 __all__ = ["parse_program_desc", "read_lod_tensor_file",
+           "read_combined_lod_tensor_file",
+           "write_combined_lod_tensor_file",
            "adapt_sequence_layout",
            "strip_feed_fetch",
            "serialize_program_desc", "write_lod_tensor_file",
@@ -304,17 +306,13 @@ def strip_feed_fetch(blocks):
 # LoDTensor stream (save_op output, one file per variable)
 # ---------------------------------------------------------------------------
 
-def read_lod_tensor_file(path):
-    """Parse one reference save_op file -> (np.ndarray, lod levels list).
+def _read_lod_tensor_stream(buf, pos):
+    """One LoDTensor stream at buf[pos:] -> (arr, lod, end_pos).
 
     Layout (lod_tensor.cc SerializeToStream):
       u32 version(0) | u64 lod_level | per level: u64 nbytes + size_t data
       | u32 tensor version(0) | i32 desc_size | TensorDesc proto | raw data
     """
-    with open(path, "rb") as f:
-        buf = f.read()
-    pos = 0
-
     def u32():
         nonlocal pos
         v = struct.unpack_from("<I", buf, pos)[0]
@@ -343,8 +341,45 @@ def read_lod_tensor_file(path):
     pos += 4
     dtype, dims = _parse_tensor_desc(buf[pos:pos + desc_size])
     pos += desc_size
-    arr = np.frombuffer(buf, np.dtype(dtype), offset=pos).reshape(dims)
+    n = int(np.prod(dims)) if dims else 1
+    arr = np.frombuffer(buf, np.dtype(dtype), count=n,
+                        offset=pos).reshape(dims)
+    pos += arr.nbytes
+    return arr, lod, pos
+
+
+def read_lod_tensor_file(path):
+    """Parse one reference save_op file -> (np.ndarray, lod levels)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    arr, lod, end = _read_lod_tensor_stream(buf, 0)
+    if end != len(buf):
+        raise ValueError(
+            "param file %r has %d trailing bytes after the tensor (a "
+            "COMBINED save_combine file needs params_filename=...)"
+            % (path, len(buf) - end))
     return arr, lod
+
+
+def read_combined_lod_tensor_file(path, names):
+    """Parse a save_combine file (save_combine_op.cc: the named tensors'
+    streams CONCATENATED, in sorted-by-name order — the era's io.py:120
+    sorts before emitting the op) -> {name: np.ndarray}."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    out, pos = {}, 0
+    for name in sorted(names):
+        if pos >= len(buf):
+            raise ValueError(
+                "combined params file %r exhausted before %r (have the "
+                "var names changed since save?)" % (path, name))
+        arr, _lod, pos = _read_lod_tensor_stream(buf, pos)
+        out[name] = arr
+    if pos != len(buf):
+        raise ValueError(
+            "combined params file %r has %d trailing bytes after the "
+            "%d named tensors" % (path, len(buf) - pos, len(names)))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -915,30 +950,44 @@ def serialize_program_desc(program, feed_names, fetch_names):
     return _w_ld(1, body)
 
 
-def write_lod_tensor_file(path, arr, lod=None):
-    """save_op stream layout (the exact inverse of read_lod_tensor_file):
+def _write_lod_tensor_stream(f, arr, lod=None):
+    """One save_op stream (the exact inverse of _read_lod_tensor_stream):
     u32 version | u64 lod levels (+ per-level u64 nbytes + offsets) |
     u32 tensor version | i32 desc size | TensorDesc | raw data."""
     arr = np.ascontiguousarray(arr)
     desc = _w_vi(1, _DTYPE_ENUM[str(arr.dtype)]) + b"".join(
         _w_vi(2, d) for d in arr.shape)
+    f.write(struct.pack("<I", 0))
+    levels = lod or []
+    f.write(struct.pack("<Q", len(levels)))
+    for level in levels:
+        level = np.asarray(level, "<u8")
+        f.write(struct.pack("<Q", level.nbytes))
+        f.write(level.tobytes())
+    f.write(struct.pack("<I", 0))
+    f.write(struct.pack("<i", len(desc)))
+    f.write(desc)
+    f.write(arr.tobytes())
+
+
+def write_lod_tensor_file(path, arr, lod=None):
     with open(path, "wb") as f:
-        f.write(struct.pack("<I", 0))
-        levels = lod or []
-        f.write(struct.pack("<Q", len(levels)))
-        for level in levels:
-            level = np.asarray(level, "<u8")
-            f.write(struct.pack("<Q", level.nbytes))
-            f.write(level.tobytes())
-        f.write(struct.pack("<I", 0))
-        f.write(struct.pack("<i", len(desc)))
-        f.write(desc)
-        f.write(arr.tobytes())
+        _write_lod_tensor_stream(f, arr, lod)
+
+
+def write_combined_lod_tensor_file(path, name_to_array):
+    """save_combine layout: the tensors' streams concatenated in
+    sorted-by-name order (matching the era's io.py sort and
+    read_combined_lod_tensor_file)."""
+    with open(path, "wb") as f:
+        for name in sorted(name_to_array):
+            _write_lod_tensor_stream(f, name_to_array[name])
 
 
 def save_reference_inference_model(dirname, feeded_var_names, target_vars,
                                    executor, main_program=None,
-                                   scope=None):
+                                   scope=None, model_filename=None,
+                                   params_filename=None):
     """Era-format save_inference_model: __model__ ProgramDesc protobuf +
     one save_op-layout file per persistable param — a directory the
     REFERENCE runtime (and this framework's load_reference_model) can
@@ -956,9 +1005,11 @@ def save_reference_inference_model(dirname, feeded_var_names, target_vars,
     scope = scope or global_scope()
 
     _os.makedirs(dirname, exist_ok=True)
-    with open(_os.path.join(dirname, "__model__"), "wb") as f:
+    with open(_os.path.join(dirname, model_filename or "__model__"),
+              "wb") as f:
         f.write(serialize_program_desc(
             inference, list(feeded_var_names), targets))
+    params = {}
     for v in inference.global_block().vars.values():
         if not v.persistable:
             continue
@@ -967,6 +1018,12 @@ def save_reference_inference_model(dirname, feeded_var_names, target_vars,
             raise ValueError(
                 "persistable var %r has no value in the scope — run the "
                 "startup program (or load params) first" % v.name)
-        write_lod_tensor_file(_os.path.join(dirname, v.name),
-                              np.asarray(val))
+        params[v.name] = np.asarray(val)
+    if params_filename:
+        # save_combine: one file, streams in sorted-name order
+        write_combined_lod_tensor_file(
+            _os.path.join(dirname, params_filename), params)
+    else:
+        for name, val in params.items():
+            write_lod_tensor_file(_os.path.join(dirname, name), val)
     return inference

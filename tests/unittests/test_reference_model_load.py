@@ -989,3 +989,45 @@ def test_era_export_decomposes_fused_parity_ops(tmp_path):
         got, = exe.run(prog2, feed=feed, fetch_list=fetches)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_era_export_combined_params_roundtrip(tmp_path):
+    """The era's COMBINED layout (params_filename / save_combine: every
+    param's stream in ONE file, sorted-name order — the era io.py sorts
+    on both save and load) round-trips output-exact, and the params
+    file really is single."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        out = fluid.layers.fc(input=h, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(29)
+    xs = rng.rand(4, 6).astype("float32")
+    d = str(tmp_path / "combined")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_reference_model(
+            d, ["x"], [out], exe, main_program=main,
+            model_filename="model.pb", params_filename="__params__")
+        want, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    files = sorted(os.listdir(d))
+    assert files == ["__params__", "model.pb"], files
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_reference_model(
+            d, exe, model_filename="model.pb",
+            params_filename="__params__")
+        got, = exe.run(prog, feed={"x": xs}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    # corrupt/truncated combined file fails loudly, not silently
+    import pytest as _p
+    with open(os.path.join(d, "__params__"), "r+b") as f:
+        f.truncate(10)
+    from paddle_tpu import reference_format as _rf
+    names = [v.name for v in prog.list_vars() if v.persistable]
+    with _p.raises((ValueError, struct.error, IndexError)):
+        _rf.read_combined_lod_tensor_file(
+            os.path.join(d, "__params__"), names)
