@@ -72,8 +72,18 @@ def create(model_dir):
             program, feeds, fetches = _io.load_inference_model(
                 model_dir, exe)
         else:
+            # era dirs come in two layouts: one save_op file per param
+            # (the default) or everything combined into a single params
+            # file (params_filename / save_combine — the common era
+            # C-API deployment shape). The C ABI has no params_filename
+            # argument, so detect generically: a lone non-model file in
+            # the dir IS the combined file, whatever it is named.
+            extras = [n for n in os.listdir(model_dir)
+                      if n not in ("__model__", "__model_meta__.json")
+                      and os.path.isfile(os.path.join(model_dir, n))]
+            params = extras[0] if len(extras) == 1 else None
             program, feeds, fetches = _io.load_reference_model(
-                model_dir, exe)
+                model_dir, exe, params_filename=params)
     h = _next_handle[0]
     _next_handle[0] += 1
     _predictors[h] = _Predictor(exe, scope, program, feeds, fetches)

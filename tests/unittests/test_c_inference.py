@@ -390,3 +390,34 @@ def test_run_lod_rejects_mismatched_feed_lists(tmp_path):
             capi_host.run_lod(h, ["x"], [], [list(xs.shape)], [()])
     finally:
         capi_host.destroy(h)
+
+
+def test_capi_autodetects_combined_era_dir(tmp_path):
+    """ptpu/capi_host create() on an era dir with a combined params
+    file (the common era C-API deployment layout) must auto-load it —
+    WHATEVER the file is named (the C ABI has no params_filename arg,
+    so a lone non-model file is detected as the combined file)."""
+    from paddle_tpu import capi_host
+    model_dir = str(tmp_path / "comb")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6], dtype="float32")
+        out = fluid.layers.fc(input=x, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_reference_model(model_dir, ["x"], [out], exe,
+                                      main_program=main,
+                                      params_filename="params.bin")
+        xs = np.random.RandomState(4).rand(2, 6).astype("f")
+        want, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    h = capi_host.create(model_dir)
+    try:
+        capi_host.run(h, ["x"], [np.ascontiguousarray(xs).tobytes()],
+                      [list(xs.shape)])
+        got = capi_host.output_array(h, 0)
+    finally:
+        capi_host.destroy(h)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
