@@ -1031,3 +1031,72 @@ def test_era_export_combined_params_roundtrip(tmp_path):
     with _p.raises((ValueError, struct.error, IndexError)):
         _rf.read_combined_lod_tensor_file(
             os.path.join(d, "__params__"), names)
+
+
+def test_era_export_roundtrip_resnet(tmp_path):
+    """A real conv net through the wire: resnet_cifar10 inference
+    (conv2d/batch_norm is_test/pool2d/elementwise_add residuals/fc/
+    softmax) exports and loads back output-exact — the fullest dense
+    op-mix stressor for the era serializer."""
+    from paddle_tpu.models.image_classification import resnet_cifar10
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                dtype="float32")
+        pred = resnet_cifar10(img, class_dim=10, depth=20, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(31)
+    xs = rng.rand(2, 3, 32, 32).astype("float32")
+    d = str(tmp_path / "resnet")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_reference_model(d, ["img"], [pred], exe,
+                                      main_program=main,
+                                      params_filename="__params__")
+        want, = exe.run(main, feed={"img": xs}, fetch_list=[pred])
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_reference_model(
+            d, exe, params_filename="__params__")
+        got, = exe.run(prog, feed={"img": xs}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_era_export_roundtrip_gru_and_bidirectional(tmp_path):
+    """GRU and a bidirectional LSTM pair (is_reverse=True leg) through
+    the export wire — the remaining era sequence-model shapes beyond
+    the single-direction LSTM round-trip."""
+    from paddle_tpu.core.lod import LoDTensor
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        w = fluid.layers.data(name="w", shape=[4], dtype="float32",
+                              lod_level=1)
+        proj = fluid.layers.fc(input=w, size=9)
+        gru = fluid.layers.dynamic_gru(input=proj, size=3)
+        fproj = fluid.layers.fc(input=w, size=12)
+        fwd, _ = fluid.layers.dynamic_lstm(input=fproj, size=12)
+        bproj = fluid.layers.fc(input=w, size=12)
+        bwd, _ = fluid.layers.dynamic_lstm(input=bproj, size=12,
+                                           is_reverse=True)
+        cat = fluid.layers.concat([gru, fwd, bwd], axis=-1)
+        pooled = fluid.layers.sequence_pool(input=cat, pool_type="max")
+        out = fluid.layers.fc(input=pooled, size=2, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(37)
+    seqs = [rng.randn(L, 4).astype("float32") * 0.5 for L in (5, 2, 4)]
+    feed = {"w": LoDTensor.from_sequences(seqs)}
+    d = str(tmp_path / "birnn")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_reference_model(d, ["w"], [out], exe,
+                                      main_program=main)
+        want, = exe.run(main, feed=feed, fetch_list=[out])
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_reference_model(d, exe)
+        got, = exe.run(prog, feed=feed, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
