@@ -1100,3 +1100,47 @@ def test_era_export_roundtrip_gru_and_bidirectional(tmp_path):
         got, = exe.run(prog, feed=feed, fetch_list=fetches)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_era_export_roundtrip_transformer_encoder(tmp_path):
+    """A dense transformer ENCODER (embeddings + sinusoid positions +
+    multi-head attention from primitive era ops + layer_norm + FFN)
+    through the export wire — the largest era-op-mix stressor. The
+    fused/beam paths are out of era scope by design (fused_attention
+    refuses; decode uses While)."""
+    from paddle_tpu.models import transformer as T
+    n_head, d_model, seq = 2, 16, 10
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        src = fluid.layers.data(name="src", shape=[seq, 1], dtype="int64")
+        pos = fluid.layers.data(name="pos", shape=[seq, 1], dtype="int64")
+        bias = fluid.layers.data(name="bias",
+                                 shape=[n_head, seq, seq],
+                                 dtype="float32")
+        enc_in = T.prepare_encoder(src, pos, 32, d_model, seq)
+        enc = T.encoder(enc_in, bias, n_layer=2, n_head=n_head,
+                        d_key=8, d_value=8, d_model=d_model,
+                        d_inner_hid=32)
+        pooled = fluid.layers.reduce_mean(enc, dim=[1])
+        out = fluid.layers.fc(input=pooled, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(41)
+    feed = {"src": rng.randint(1, 32, (3, seq, 1)).astype("int64"),
+            "pos": np.tile(np.arange(seq).reshape(1, seq, 1),
+                           (3, 1, 1)).astype("int64"),
+            "bias": np.zeros((3, n_head, seq, seq), "float32")}
+    d = str(tmp_path / "encoder")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_reference_model(
+            d, ["src", "pos", "bias"], [out], exe, main_program=main,
+            params_filename="__params__")
+        want, = exe.run(main, feed=feed, fetch_list=[out])
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_reference_model(
+            d, exe, params_filename="__params__")
+        got, = exe.run(prog, feed=feed, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
