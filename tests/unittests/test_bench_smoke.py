@@ -94,3 +94,25 @@ def test_bench_transformer_decode_smoke():
     assert rec["metric"] == "transformer_cached_decode_throughput"
     assert rec["unit"] == "emitted tokens/sec/chip"
     assert rec["value"] > 0
+
+
+def test_sweeps_only_set_flags_the_framework_reads():
+    """FLAGS_* vars in sweep scripts must exist in paddle_tpu source —
+    a typo'd flag would silently run the default configuration and bank
+    it under the wrong label (same trap as the BENCH_* check above)."""
+    import glob
+    import re
+    known = set()
+    for path in glob.glob(os.path.join(REPO, "paddle_tpu", "**", "*.py"),
+                          recursive=True):
+        with open(path) as f:
+            known |= set(re.findall(r'"(FLAGS_[A-Za-z0-9_]+)"', f.read()))
+    assert "FLAGS_conv_layout" in known
+    for path in sorted(glob.glob(os.path.join(REPO, "tools",
+                                              "perf_sweep*.sh"))):
+        with open(path) as f:
+            used = set(re.findall(r"(FLAGS_[A-Za-z0-9_]+)=", f.read()))
+        unknown = used - known
+        assert not unknown, (
+            "%s sets FLAGS_ vars the framework never reads: %s"
+            % (os.path.basename(path), sorted(unknown)))
