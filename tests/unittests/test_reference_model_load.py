@@ -1144,3 +1144,38 @@ def test_era_export_roundtrip_transformer_encoder(tmp_path):
         got, = exe.run(prog, feed=feed, fetch_list=fetches)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_era_export_roundtrip_embedding_model(tmp_path):
+    """word2vec/CTR-style heads: lookup_table (int64 ids, is_sparse
+    attr), concat, wide fc through the export wire — the sparse-ish
+    serving family's round trip."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        a = fluid.layers.data(name="a", shape=[1], dtype="int64")
+        b = fluid.layers.data(name="b", shape=[1], dtype="int64")
+        ea = fluid.layers.embedding(a, size=[50, 8], is_sparse=True,
+                                    param_attr="shared_emb")
+        eb = fluid.layers.embedding(b, size=[50, 8], is_sparse=True,
+                                    param_attr="shared_emb")
+        cat = fluid.layers.concat([ea, eb], axis=1)
+        out = fluid.layers.fc(input=cat, size=5, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(43)
+    feed = {"a": rng.randint(0, 50, (6, 1)).astype("int64"),
+            "b": rng.randint(0, 50, (6, 1)).astype("int64")}
+    d = str(tmp_path / "emb")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_reference_model(d, ["a", "b"], [out], exe,
+                                      main_program=main)
+        want, = exe.run(main, feed=feed, fetch_list=[out])
+    # shared embedding must serialize ONCE
+    assert sorted(n for n in os.listdir(d) if "emb" in n) == ["shared_emb"]
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_reference_model(d, exe)
+        got, = exe.run(prog, feed=feed, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
