@@ -78,6 +78,32 @@ def _await_devices(timeout_s):
     return out["devices"]
 
 
+def _multistep():
+    """BENCH_MULTISTEP=K: run the timed loop through the executors'
+    device-resident K-step mode (run(steps=K)) — one host dispatch/sync
+    per K training steps instead of per step. K=1 (default) is the plain
+    single-step path, byte-identical to the pre-multistep bench."""
+    return max(1, int(os.environ.get("BENCH_MULTISTEP", "1")))
+
+
+def _step_plan(steps, multistep):
+    """(outer_calls, total_steps): BENCH_STEPS counts TRAINING steps in
+    both modes, rounded up to a whole number of K-step blocks so a
+    K-misaligned BENCH_STEPS can't silently measure fewer steps."""
+    if multistep == 1:
+        return steps, steps
+    outer = max(1, -(-steps // multistep))
+    return outer, outer * multistep
+
+
+def _run_kw(multistep):
+    """Extra Executor.run kwargs for the timed loop. fetch_reduce='last'
+    mirrors what the single-step loop keeps (only the final out survives
+    the loop variable), so the loss sanity check sees the same value."""
+    return {"steps": multistep, "fetch_reduce": "last"} \
+        if multistep > 1 else {}
+
+
 def _mfu(flops_per_sec):
     """Model FLOPs utilization against the chip's peak (BENCH_PEAK_TFLOPS,
     default 197 = TPU v5e bf16), so every bench line self-describes how far
@@ -126,22 +152,25 @@ def bench_transformer():
     feed = {k: jnp.asarray(v) for k, v in feed.items()}
     jax.block_until_ready(list(feed.values()))
 
+    multistep = _multistep()
+    outer, total_steps = _step_plan(steps, multistep)
+    run_kw = _run_kw(multistep)
     exe = fluid.Executor(fluid.TPUPlace())
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
         for _ in range(warmup):
-            exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+            exe.run(main_prog, feed=feed, fetch_list=[avg_cost], **run_kw)
         t0 = time.perf_counter()
-        for _ in range(steps):
+        for _ in range(outer):
             out = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
-                          return_numpy=False)
+                          return_numpy=False, **run_kw)
         device_fetch_barrier(out)
         dt = time.perf_counter() - t0
         loss = np.asarray(out[0])
         assert np.isfinite(loss).all(), "non-finite loss"
 
-    tps = batch * seq * steps / dt
+    tps = batch * seq * total_steps / dt
     # training FLOPs/token ~ 6 * params (72*L*d^2 with d_inner=4d) plus
     # the attention matmuls (~12*L*seq*d fwd+bwd) plus the vocab
     # projection (6*d*V — at base config it rivals the whole body:
@@ -153,6 +182,7 @@ def bench_transformer():
         "metric": "transformer_train_throughput",
         "value": round(tps, 1), "unit": "tokens/sec/chip",
         "vs_baseline": None, "batch": batch, "seq": seq,
+        "multistep": multistep,
         "layers": n_layer, "d_model": d_model, "dtype": dtype,
         "fused_attention": fused, "fused_qkv": fused_qkv,
         "device": str(jax.devices()[0]),
@@ -259,22 +289,25 @@ def bench_stacked_lstm():
     feed = {"words": LoDTensor.from_sequences(seqs),
             "label": rng.randint(0, 2, (batch, 1)).astype("int64")}
 
+    multistep = _multistep()
+    outer, total_steps = _step_plan(steps, multistep)
+    run_kw = _run_kw(multistep)
     exe = fluid.Executor(fluid.TPUPlace())
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
         for _ in range(warmup):
-            exe.run(main_prog, feed=feed, fetch_list=[cost])
+            exe.run(main_prog, feed=feed, fetch_list=[cost], **run_kw)
         t0 = time.perf_counter()
-        for _ in range(steps):
+        for _ in range(outer):
             out = exe.run(main_prog, feed=feed, fetch_list=[cost],
-                          return_numpy=False)
+                          return_numpy=False, **run_kw)
         device_fetch_barrier(out)
         dt = time.perf_counter() - t0
         loss = np.asarray(out[0])
         assert np.isfinite(loss).all(), "non-finite loss"
 
-    tps = batch * seq * steps / dt
+    tps = batch * seq * total_steps / dt
     # fluid packing: dynamic_lstm(size=hid) has hidden width h = hid/4.
     # fwd FLOPs/token: layer 1 fc [emb=4h -> 4h] + recurrent [h, 4h]
     # = 2*4h*(4h+h) = 40h^2; layers >=2 take concat [4h+h -> 4h] + rec
@@ -286,6 +319,7 @@ def bench_stacked_lstm():
         "metric": "stacked_lstm_train_throughput",
         "value": round(tps, 1), "unit": "tokens/sec/chip",
         "vs_baseline": None, "batch": batch, "seq": seq,
+        "multistep": multistep,
         "hidden": hid, "stacked": stacked, "dtype": dtype,
         "device": str(jax.devices()[0]),
         "mfu": _mfu(tps * flops_per_token),
@@ -427,23 +461,40 @@ def main():
         jax.block_until_ready((xs, ys))
         feeds = {"image": xs, "label": ys}
 
+    multistep = _multistep()
+    if multistep > 1 and feed_mode != "device":
+        # loud-failure rule: the host feed modes exist to measure the
+        # input pipeline, but Executor.run(steps=K) REPLAYS an explicit
+        # feed for all K steps — the reader would fire once per K-block,
+        # crediting K steps of throughput to 1/K of the staging work.
+        # (The in-graph-reader path measures the pipeline under the
+        # loop honestly; bench.py doesn't build one yet.)
+        print(json.dumps(_error_line(
+            "BENCH_MULTISTEP>1 with BENCH_FEED=%s would replay one "
+            "staged batch per K-step block and overstate pipeline "
+            "throughput; use BENCH_FEED=device" % feed_mode)))
+        sys.stdout.flush()
+        os._exit(2)
+    outer, total_steps = _step_plan(steps, multistep)
+    run_kw = _run_kw(multistep)
     with fluid.scope_guard(scope):
         exe.run(startup)
         # warmup=0 is honored: the timed loop then includes compile time
         for _ in range(warmup):
             fd = stage(0) if feeds is None else feeds
-            exe.run(main_prog, feed=fd, fetch_list=[avg_cost])
+            exe.run(main_prog, feed=fd, fetch_list=[avg_cost], **run_kw)
         t0 = time.perf_counter()
-        for i in range(steps):
+        for i in range(outer):
             fd = stage(i) if feeds is None else feeds
             out = exe.run(main_prog, feed=fd,
-                          fetch_list=[avg_cost], return_numpy=False)
+                          fetch_list=[avg_cost], return_numpy=False,
+                          **run_kw)
         device_fetch_barrier(out)
         dt = time.perf_counter() - t0
         loss = np.asarray(out[0])
         assert np.isfinite(loss).all(), "non-finite loss"
 
-    ips = batch * steps / dt
+    ips = batch * total_steps / dt
     headline = (hw == 224 and class_dim == 1000)
     # ResNet-50 fwd = 4.09 GMACs = 8.18e9 FLOPs @ 224^2 (the commonly
     # quoted "4.1 GFLOPs" is MACs); training ~ 3x fwd. Audited round 4:
@@ -464,6 +515,7 @@ def main():
         "batch": batch,
         "dtype": dtype,
         "feed": feed_mode,
+        "multistep": multistep,
         "device": str(jax.devices()[0]),
         "mfu": _mfu(ips * flops_per_image)
         if headline and flops_per_image else None,

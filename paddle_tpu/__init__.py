@@ -22,7 +22,8 @@ from .core.framework import (Program, Operator, Variable, Parameter,
                              default_main_program, default_startup_program,
                              program_guard, switch_main_program,
                              switch_startup_program)
-from .core.executor import Executor, Scope, global_scope, scope_guard
+from .core.executor import (Executor, FetchHandle, Scope, global_scope,
+                            scope_guard)
 from .core.readers import EOFException
 from .core.backward import append_backward, calc_gradient
 from .core.framework import Block, get_var

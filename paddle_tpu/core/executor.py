@@ -86,6 +86,15 @@ class Scope(object):
         self._rng_counter += 1
         return self._rng_counter
 
+    def next_seed_block(self, k):
+        """Reserve k consecutive seeds, returning the first. A K-step
+        device-resident run consumes seed..seed+K-1 inside the loop; the
+        counter must advance past all of them so a later run never replays
+        a seed a loop step already used."""
+        first = self._rng_counter + 1
+        self._rng_counter += k
+        return first
+
 
 class _ScopeVar(object):
     def __init__(self, scope, name):
@@ -131,6 +140,51 @@ def as_numpy(tensor):
     return np.asarray(tensor)
 
 
+class FetchHandle(object):
+    """Lazy fetch result (`return_numpy=False`): wraps the device-resident
+    jax.Array so the caller decides when (if ever) to pay the device->host
+    sync. `np.asarray(handle)` / `.numpy()` materialize; `.array` hands out
+    the raw jax.Array (usable in jnp expressions via __jax_array__, still
+    async); `.block()` waits without copying. The dispatch that produced it
+    has already been enqueued — a timing loop should end with
+    core.utils.device_fetch_barrier, which unwraps handles."""
+
+    __slots__ = ("_arr",)
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    @property
+    def array(self):
+        return self._arr
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    def numpy(self):
+        return np.asarray(self._arr)
+
+    def block(self):
+        jax.block_until_ready(self._arr)
+        return self
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(self._arr)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._arr
+
+    def __repr__(self):
+        return "FetchHandle(shape=%r, dtype=%s)" % (
+            tuple(self._arr.shape), self._arr.dtype)
+
+
 def convert_feeds(program, feed, host=False):
     """Feed dict -> arrays for the jitted program. LoDTensor feeds expand
     to padded dense + the @SEQLEN lengths companion; plain arrays coerce
@@ -168,7 +222,7 @@ def convert_feeds(program, feed, host=False):
 
 
 def run_host_io_prepass(program, scope, feed_arrays, host=False,
-                        validate=None):
+                        validate=None, steps=1, stacked_out=None):
     """io pre-pass: reader ops execute host-side (core/readers.py).
     create_* ops build ReaderState objects in the scope; each `read` op
     pops the next record and injects it as a feed of the jitted program
@@ -181,7 +235,28 @@ def run_host_io_prepass(program, scope, feed_arrays, host=False,
     host). `validate(record, out_vars)` runs before the record is accepted
     (out_vars are the declared read_file output Variables, for shape-aware
     checks); on failure the record is pushed back so the error doesn't
-    consume it."""
+    consume it.
+
+    steps=K (multi-step execution): each `read` op pops K records
+    ATOMICALLY (ReaderBase.next_many pushes all K back on a mid-block EOF
+    or validation failure) and stacks each field with a leading K axis —
+    the device loop slices step t's feed out of the stack, and a
+    DoubleBufferReader keeps pre-staging records (lod padding +
+    device_put on its worker thread) for the NEXT K-block while the
+    current one computes. Atomicity spans ALL read ops of the program: a
+    failure at the second reader (EOF, validation, unstackable shapes)
+    pushes the first reader's already-popped block back too, so a failed
+    K-step run consumes nothing anywhere and paired streams (e.g. image
+    + label readers) can never skew. The stacked feed names are added to
+    `stacked_out` so the executor can key/slice them."""
+    multi_blocks = []     # [(state, records)] popped so far this call
+    multi_stacks = {}     # name -> stacked [K, ...] array, committed last
+
+    def _rollback():
+        for st, recs in reversed(multi_blocks):
+            for rec in reversed(recs):
+                st.push_back(rec)
+
     for op in program.global_block().ops:
         if op.type == "read":
             state = scope.get(op.inputs["Reader"][0])
@@ -189,24 +264,67 @@ def run_host_io_prepass(program, scope, feed_arrays, host=False,
                 raise RuntimeError(
                     "reader %r has no state; run the startup program "
                     "first" % op.inputs["Reader"][0])
-            record = state.next()
             out_names = op.outputs["Out"]
-            try:
+            out_vars = [_find_feed_var(program, n) for n in out_names]
+
+            def _check(record):
                 if len(record) != len(out_names):
                     raise ValueError(
                         "reader yielded %d fields but read_file declared "
                         "%d" % (len(record), len(out_names)))
                 if validate is not None:
-                    validate(record,
-                             [_find_feed_var(program, n) for n in out_names])
-            except Exception:
-                state.push_back(record)
-                raise
-            for out_name, val in zip(out_names, record):
-                feed_arrays[out_name] = _to_array(
-                    val, _find_feed_var(program, out_name), host=host)
+                    validate(record, out_vars)
+
+            if steps == 1:
+                record = state.next()
+                try:
+                    _check(record)
+                except Exception:
+                    state.push_back(record)
+                    raise
+                for out_name, val, var in zip(out_names, record, out_vars):
+                    feed_arrays[out_name] = _to_array(val, var, host=host)
+            else:
+                if hasattr(state, "ensure_staging_depth"):
+                    # a double buffer must be able to pre-stage the NEXT
+                    # K-block while this one computes
+                    state.ensure_staging_depth(steps)
+                try:
+                    # next_many pushes ITS block back itself on failure;
+                    # _rollback returns every EARLIER reader's block
+                    records = state.next_many(steps, validate=_check)
+                except Exception:
+                    _rollback()
+                    raise
+                multi_blocks.append((state, records))
+                # convert+stack BEFORE committing to feed_arrays: records
+                # whose field shapes differ can't stack, and that failure
+                # must also consume nothing (anywhere)
+                try:
+                    for i, (out_name, var) in enumerate(zip(out_names,
+                                                            out_vars)):
+                        fields = [_to_array(rec[i], var, host=host)
+                                  for rec in records]
+                        multi_stacks[out_name] = (
+                            np.stack(fields) if host else jnp.stack(fields))
+                except Exception:
+                    _rollback()
+                    raise
         elif readers.is_host_io_op(op.type):
+            if steps > 1:
+                raise RuntimeError(
+                    "program contains host io op %r in its main block: "
+                    "with steps=%d it would run once per CALL, not once "
+                    "per step like %d sequential runs would. Keep reader "
+                    "creation in the startup program (the standard "
+                    "split), or run this program with steps=1."
+                    % (op.type, steps, steps))
             readers.run_host_io_op(op, scope)
+    # all readers delivered their K-block: commit the stacks together
+    if multi_stacks:
+        feed_arrays.update(multi_stacks)
+        if stacked_out is not None:
+            stacked_out.update(multi_stacks)
 
 
 def _array_safety_enabled():
@@ -300,28 +418,55 @@ class Executor(object):
         self._array_safety = _array_safety_enabled()
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, use_program_cache=True):
+            return_numpy=True, use_program_cache=True, steps=1,
+            fetch_reduce="stack"):
+        """Run `program` once — or, with steps=K > 1, K times inside ONE
+        device-resident lax.scan dispatch: params/optimizer state stay
+        donated on device across the K steps and the host syncs once per
+        call instead of once per step. Explicit `feed` entries are replayed
+        identically every step; in-graph reader (`read` op) feeds are
+        popped K records at a time and sliced per step inside the loop.
+        `fetch_reduce` picks what the K per-step fetch values collapse to:
+        'stack' (default, leading-K axis), 'last', or 'mean'.
+
+        return_numpy=False returns FetchHandle objects (device-resident,
+        non-blocking): materialize with np.asarray(h) / h.numpy() when the
+        value is actually needed."""
         if program is None:
             program = default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError("steps must be >= 1, got %r" % (steps,))
+        if fetch_reduce not in lowering.FETCH_REDUCE_POLICIES:
+            raise ValueError("fetch_reduce must be one of %r, got %r"
+                             % (lowering.FETCH_REDUCE_POLICIES, fetch_reduce))
 
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
         feed_arrays = convert_feeds(program, feed)
 
-        run_host_io_prepass(program, scope, feed_arrays)
+        stacked_names = set()
+        run_host_io_prepass(program, scope, feed_arrays, steps=steps,
+                            stacked_out=stacked_names)
 
         feed_names = sorted(feed_arrays)
         # program._uid is mandatory (as in ParallelExecutor): id() of a GC'd
         # program can be recycled and silently serve a stale jitted fn.
         # trace_env_key() carries every trace-time env flag (conv layout,
         # flash dispatch, remat tuning) — flipping one between runs must
-        # re-trace, not silently serve the other configuration's fn
+        # re-trace, not silently serve the other configuration's fn.
+        # (steps, fetch_reduce, stacked feed set) shape the traced loop the
+        # same way: a K=8 'mean' fn must never serve a K=4 'stack' call.
         from .lowering import trace_env_key
+        unroll = lowering.resolve_multistep_unroll(
+            self.place.device().platform) if steps > 1 else False
         key = (program._uid, program._version,
                _feed_signature(feed_arrays), tuple(fetch_names),
-               trace_env_key())
+               trace_env_key(),
+               (steps, fetch_reduce if steps > 1 else None, unroll,
+                tuple(sorted(stacked_names))))
         compiled = False
         entry = self._cache.get(key) if use_program_cache else None
         if entry is not None:
@@ -330,9 +475,15 @@ class Executor(object):
             compiled = True
             state_rw, state_ro, state_out = lowering.analyze_state(
                 program, feed_names, fetch_names)
-            fn = lowering.build_program_fn(
-                program, feed_names, fetch_names, state_rw, state_ro,
-                state_out, collect_errors=True)
+            if steps > 1:
+                fn = lowering.lower_multi_step(
+                    program, feed_names, fetch_names, state_rw, state_ro,
+                    state_out, steps, fetch_reduce=fetch_reduce,
+                    stacked_feed_names=stacked_names, unroll=unroll)
+            else:
+                fn = lowering.build_program_fn(
+                    program, feed_names, fetch_names, state_rw, state_ro,
+                    state_out, collect_errors=True)
             jitted = jax.jit(fn, donate_argnums=(1,))
             entry = (jitted, state_rw, state_ro, state_out)
             if use_program_cache:
@@ -351,7 +502,8 @@ class Executor(object):
                 vals.append(v)
             return vals
 
-        seed = np.uint32(scope.next_seed())
+        seed = np.uint32(scope.next_seed() if steps == 1
+                         else scope.next_seed_block(steps))
         from .. import profiler as _prof
         profiling = _prof.is_active()
         t0 = time.perf_counter() if profiling else 0.0
@@ -369,8 +521,9 @@ class Executor(object):
         if profiling:
             jax.block_until_ready((fetches, new_state))
             dt = time.perf_counter() - t0
-            tag = "program_%s(v%d) fetch=%s" % (
+            tag = "program_%s(v%d)%s fetch=%s" % (
                 getattr(program, "_uid", "?"), program._version,
+                " x%d" % steps if steps > 1 else "",
                 ",".join(fetch_names) or "-")
             _prof.record_run(tag, dt, compiled=compiled)
         if self._array_safety:
@@ -381,7 +534,7 @@ class Executor(object):
                 list(zip(state_out, new_state)), context="Executor.run")
         if return_numpy:
             return [np.asarray(f) for f in fetches]
-        return fetches
+        return [FetchHandle(f) for f in fetches]
 
 
 

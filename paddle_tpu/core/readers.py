@@ -18,6 +18,7 @@ stages the next batch onto the device (jax.device_put) while the current
 step runs, so the host→device copy overlaps compute exactly like the
 reference's double_buffer reader overlapped H2D with CUDA streams.
 """
+import collections
 import queue
 import threading
 
@@ -45,40 +46,60 @@ def is_host_io_op(op_type):
 class ReaderBase(object):
     """Host-side reader state. next() returns one record (tuple of arrays)
     or raises EOFException; eof() peeks; reset() restarts; close() releases
-    threads/files (called when a startup re-run displaces the state)."""
+    threads/files (called when a startup re-run displaces the state).
+    Pushed-back records live in a deque, so a whole K-record block a
+    multi-step run could not use returns intact (next_many)."""
 
     def __init__(self):
-        self._peeked = None
+        self._pending = collections.deque()
 
     def next(self):
-        if self._peeked is not None:
-            out, self._peeked = self._peeked, None
-            return out
+        if self._pending:
+            return self._pending.popleft()
         return self._next()
 
     def push_back(self, record):
         """Return a just-popped record to the front of the stream (used by
         the executor prepass when a record fails validation, so the error
-        doesn't consume it)."""
-        if self._peeked is not None:
-            raise RuntimeError("push_back with a peeked record pending")
-        self._peeked = record
+        doesn't consume it). Multiple push_backs stack LIFO, so pushing a
+        block back newest-first restores the original order."""
+        self._pending.appendleft(record)
+
+    def next_many(self, k, validate=None):
+        """Pop k records atomically (the multi-step executor's K-block).
+        `validate(record)` vets each record as it is popped. If EOF or a
+        validation failure hits before all k are accepted, EVERY popped
+        record (including the offender) goes back on the stream in original
+        order and the error propagates — a failed K-step run consumes
+        nothing, so the caller can drain the remaining tail with steps=1
+        or fix the offending record's feed path."""
+        out = []
+        try:
+            for _ in range(k):
+                out.append(self.next())
+                if validate is not None:
+                    validate(out[-1])
+        except Exception:
+            for rec in reversed(out):
+                self.push_back(rec)
+            raise
+        return out
 
     def eof(self):
-        if self._peeked is not None:
+        if self._pending:
             return False
         try:
-            self._peeked = self._next()
+            self._pending.append(self._next())
             return False
         except EOFException:
             return True
 
     def reset(self):
-        self._peeked = None
+        self._pending.clear()
         self._reset()
 
     def close(self):
-        self._peeked = None
+        self._pending.clear()
 
     def _next(self):
         raise NotImplementedError
@@ -128,7 +149,7 @@ class MultiFileReader(ReaderBase):
     def _start(self):
         from ..recordio_writer import recordio_reader
         self._q = queue.Queue(self._capacity)
-        self._pending = list(self._filenames)
+        self._pending_files = list(self._filenames)
         self._lock = threading.Lock()
         self._live = self._thread_num
         self._gen += 1
@@ -138,9 +159,9 @@ class MultiFileReader(ReaderBase):
             try:
                 while gen == self._gen:
                     with lock:
-                        if not self._pending:
+                        if not self._pending_files:
                             break
-                        fname = self._pending.pop(0)
+                        fname = self._pending_files.pop(0)
                     for rec in recordio_reader(fname)():
                         q.put(rec)
                         if gen != self._gen:
@@ -162,7 +183,17 @@ class MultiFileReader(ReaderBase):
     def _next(self):
         if self._q is None:  # lazy start: no thread/file leak if displaced
             self._start()
-        item = self._q.get()
+        # poll with a liveness check: the EOF sentinel is one-shot, and a
+        # next_many that hit it mid-block consumed it while pushing its
+        # records back — once those drain, a plain q.get() would block
+        # forever on the dead workers instead of raising EOF again
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                if not any(t.is_alive() for t in self._threads):
+                    raise EOFException()
         if item is _EOF_SENTINEL:
             raise EOFException()
         if isinstance(item, _ReaderError):
@@ -269,7 +300,51 @@ class DoubleBufferReader(ReaderBase):
         self._capacity = max(1, int(capacity))
         self._place = place
         self._gen = 0
+        self._stashed_error = None
         _live_double_buffers.add(self)
+        self._start()
+
+    def ensure_staging_depth(self, k, max_wait=30.0):
+        """Grow the staged-batch queue to at least k records (no-op when
+        already that deep). The multi-step executor calls this with K so
+        the worker can pre-stage a WHOLE next K-step block (padding +
+        device_put per record) while the current block's scan computes —
+        with the default capacity of 2 the worker could only run 2 records
+        ahead and the host would stall re-staging mid-block. Already-staged
+        records are drained into the pending deque first, so nothing is
+        lost or reordered across the restart."""
+        k = int(k)
+        if k <= self._capacity:
+            return
+        import time
+        deadline = time.monotonic() + max_wait
+        self._gen += 1
+        staged = []
+
+        def drain():
+            try:
+                while True:
+                    staged.append(self._q.get_nowait())
+            except queue.Empty:
+                pass
+
+        while True:
+            drain()
+            if not self._thread.is_alive():
+                break
+            self._thread.join(timeout=0.05)
+            if time.monotonic() > deadline:
+                break  # wedged source read: restart anyway (same record-
+                       # loss edge _stop already accepts on reset/close)
+        drain()  # a put completed between the last drain and the join
+        for item in staged:
+            if item is _EOF_SENTINEL:
+                pass  # the restarted worker re-derives EOF from the source
+            elif isinstance(item, _ReaderError):
+                self._stashed_error = item
+            else:
+                self._pending.append(item)
+        self._capacity = k
         self._start()
 
     def _device(self):
@@ -305,7 +380,19 @@ class DoubleBufferReader(ReaderBase):
         self._thread.start()
 
     def _next(self):
-        item = self._q.get()
+        if self._stashed_error is not None:
+            err, self._stashed_error = self._stashed_error, None
+            raise err.error
+        # same one-shot-sentinel hazard as MultiFileReader._next: after a
+        # mid-block next_many consumed the sentinel and the worker exited,
+        # the drained tail must end in EOF again, not a hang on q.get()
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    raise EOFException()
         if item is _EOF_SENTINEL:
             raise EOFException()
         if isinstance(item, _ReaderError):
@@ -334,11 +421,15 @@ class DoubleBufferReader(ReaderBase):
 
     def _reset(self):
         self._stop()
+        # an error ensure_staging_depth stashed belongs to the OLD stream;
+        # surviving the reset would fail the fresh epoch's first read
+        self._stashed_error = None
         self._under.reset()
         self._start()
 
     def close(self):
         super(DoubleBufferReader, self).close()
+        self._stashed_error = None
         self._stop()
 
 

@@ -27,5 +27,9 @@ def device_fetch_barrier(out):
     import jax
     import jax.numpy as jnp
     import numpy as np
-    leaf = jax.tree_util.tree_leaves(out)[0]
+    from .executor import FetchHandle
+    leaf = jax.tree_util.tree_leaves(
+        out, is_leaf=lambda x: isinstance(x, FetchHandle))[0]
+    if isinstance(leaf, FetchHandle):
+        leaf = leaf.array
     np.asarray(jnp.sum(leaf.astype(jnp.float32)))

@@ -159,11 +159,24 @@ class ParallelExecutor(object):
     def device_count(self):
         return self.mesh.devices.size
 
-    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True,
+            steps=1, fetch_reduce="stack"):
+        """Sharded run; steps=K runs the K-step device-resident loop (see
+        Executor.run): the scan composes with the GSPMD shardings — feeds
+        stay batch-sharded per step, params keep their replicated / ZeRO
+        (sharded_weight_update) / tensor-parallel layouts across the loop
+        carry, and XLA still inserts the gradient collectives inside the
+        loop body. One host sync per K steps per call."""
         feed = feed if feed is not None else (feed_dict or {})
         program = self._program
         scope = self._scope
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError("steps must be >= 1, got %r" % (steps,))
+        if fetch_reduce not in lowering.FETCH_REDUCE_POLICIES:
+            raise ValueError("fetch_reduce must be one of %r, got %r"
+                             % (lowering.FETCH_REDUCE_POLICIES, fetch_reduce))
 
         feed_arrays = convert_feeds(program, feed, host=True)
 
@@ -194,23 +207,35 @@ class ParallelExecutor(object):
                     _check_divisible(
                         f, "reader record field %r" % getattr(v, "name", "?"))
 
+        stacked_names = set()
         run_host_io_prepass(program, scope, feed_arrays, host=True,
-                            validate=_validate_record)
+                            validate=_validate_record, steps=steps,
+                            stacked_out=stacked_names)
         feed_names = sorted(feed_arrays)
 
         def _feed_sharding(name, ndim):
             if _batch_leading(name):
+                # stacked reader feeds carry a leading K (time) axis; their
+                # batch dim moved to position 1 — the scan slices K off and
+                # each step sees the usual batch-dim-0 sharding
                 return batch_sharded(self.mesh, ndim,
-                                     axis_name=self._batch_axis)
+                                     axis_name=self._batch_axis,
+                                     batch_dim=1 if name in stacked_names
+                                     else 0)
             return replicated(self.mesh)
 
         # every trace-time env flag (conv layout, flash dispatch, remat
         # tuning) is traced into the fn — key on them so an env-var flip
-        # re-traces instead of serving the other configuration
+        # re-traces instead of serving the other configuration. (steps,
+        # fetch_reduce, stacked feeds) shape the traced loop the same way.
         from ..core.lowering import trace_env_key
+        unroll = lowering.resolve_multistep_unroll(
+            self.mesh.devices.flat[0].platform) if steps > 1 else False
         key = (program._uid, program._version,
                _feed_signature(feed_arrays), tuple(fetch_names),
-               trace_env_key())
+               trace_env_key(),
+               (steps, fetch_reduce if steps > 1 else None, unroll,
+                tuple(sorted(stacked_names))))
         compiled = False
         entry = self._cache.get(key)
         if entry is not None:
@@ -219,9 +244,16 @@ class ParallelExecutor(object):
             compiled = True
             state_rw, state_ro, state_out = lowering.analyze_state(
                 program, feed_names, fetch_names)
-            fn = lowering.build_program_fn(
-                program, feed_names, fetch_names, state_rw, state_ro,
-                state_out, mesh=self.mesh, collect_errors=True)
+            if steps > 1:
+                fn = lowering.lower_multi_step(
+                    program, feed_names, fetch_names, state_rw, state_ro,
+                    state_out, steps, fetch_reduce=fetch_reduce,
+                    stacked_feed_names=stacked_names, mesh=self.mesh,
+                    unroll=unroll)
+            else:
+                fn = lowering.build_program_fn(
+                    program, feed_names, fetch_names, state_rw, state_ro,
+                    state_out, mesh=self.mesh, collect_errors=True)
             rep = replicated(self.mesh)
             in_shardings = (
                 [_feed_sharding(n, feed_arrays[n].ndim)
@@ -258,7 +290,9 @@ class ParallelExecutor(object):
             feed_arrays[n], _feed_sharding(n, feed_arrays[n].ndim))
             for n in feed_names]
 
-        seed = jnp.asarray(np.uint32(scope.next_seed()))
+        seed = jnp.asarray(np.uint32(
+            scope.next_seed() if steps == 1
+            else scope.next_seed_block(steps)))
         from .. import profiler as _prof
         profiling = _prof.is_active()
         t0 = _time.perf_counter() if profiling else 0.0
@@ -286,4 +320,5 @@ class ParallelExecutor(object):
                 context="ParallelExecutor.run")
         if return_numpy:
             return [np.asarray(f) for f in fetches]
-        return fetches
+        from ..core.executor import FetchHandle
+        return [FetchHandle(f) for f in fetches]

@@ -611,7 +611,12 @@ def _encode_wire_attr(name, value):
     if isinstance(value, bool):            # before int: bool IS int
         return out + _w_vi(2, 6) + _w_vi(10, int(value))
     if isinstance(value, (int, np.integer)):
-        return out + _w_vi(2, 0) + _w_vi(3, int(value))
+        v = int(value)
+        if not (-(1 << 31) <= v < (1 << 31)):
+            # outside int32: the era's proto2 parser would silently
+            # truncate an INT varint — emit AttrType LONG (field 13)
+            return out + _w_vi(2, 9) + _w_vi(13, v & ((1 << 64) - 1))
+        return out + _w_vi(2, 0) + _w_vi(3, v)
     if isinstance(value, (float, np.floating)):
         return out + _w_vi(2, 1) + _w_tag(4, 5) + struct.pack(
             "<f", float(value))
@@ -651,7 +656,16 @@ def _encode_wire_var(var, var_type=7):
     body = _w_vi(1, var_type)
     if var_type == 7:       # LOD_TENSOR
         dims = var.shape if var.shape is not None else ()
-        tensor = _w_vi(1, _DTYPE_ENUM.get(var.dtype or "float32", 5))
+        dtype = var.dtype or "float32"
+        if dtype not in _DTYPE_ENUM:
+            # loud-failure rule (same as _write_lod_tensor_stream): a
+            # silent FP32 fallback would write a wrong data_type into the
+            # exported desc — e.g. uint8 image-feed vars
+            raise ValueError(
+                "era export: var %r has dtype %r with no era VarType "
+                "data_type enum — the reference runtime cannot load it"
+                % (var.name, dtype))
+        tensor = _w_vi(1, _DTYPE_ENUM[dtype])
         tensor += b"".join(
             _w_vi(2, int(d) & ((1 << 64) - 1)) for d in dims)
         lodt = _w_ld(1, tensor)
@@ -859,10 +873,15 @@ def serialize_program_desc(program, feed_names, fetch_names):
     blk = program.global_block()
     # idx 0, parent -1 (64-bit two's-complement varint, as the era wrote)
     body = _w_vi(1, 0) + _w_tag(2, 0) + _w_varint((1 << 64) - 1)
-    # feed/fetch carrier vars
+    # feed/fetch carrier vars: persistable=True like the era's
+    # prepend_feed_ops/append_fetch_ops wrote them — the era C++ executor
+    # creates non-persistable vars in a per-run LOCAL scope, so a
+    # non-persistable 'feed' var would shadow the outer-scope one
+    # SetFeedVariable filled (feed_list.at(col) out-of-range) and fetch
+    # results would land in the discarded local scope
     class _FV:
         def __init__(self, name):
-            self.name, self.persistable = name, False
+            self.name, self.persistable = name, True
     body += _w_ld(3, _encode_wire_var(_FV("feed"), var_type=9))
     body += _w_ld(3, _encode_wire_var(_FV("fetch"), var_type=10))
     seq_names, skip_vars, op_view = _deadapt_for_wire(blk)

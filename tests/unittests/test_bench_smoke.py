@@ -41,6 +41,37 @@ def test_bench_json_contract():
     assert "loss" in rec and rec["loss"] == rec["loss"]  # finite
 
 
+def test_bench_multistep_smoke():
+    """The BENCH_MULTISTEP=K leg of bench.py: one subprocess run on CPU
+    with tiny shapes through Executor.run(steps=8), so the multi-step
+    bench path can't silently rot. FLAGS_multistep_unroll=0 pins the
+    lax.scan lowering — one copy of the step in the module keeps the
+    compile comparable to the single-step smoke (the CPU-default full
+    unroll compiles K copies and belongs in a perf sweep, not CI)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_BATCH": "2", "BENCH_STEPS": "8", "BENCH_WARMUP": "1",
+        "BENCH_IMAGE_HW": "32", "BENCH_CLASS_DIM": "10",
+        "BENCH_DTYPE": "fp32", "BENCH_FEED": "device",
+        "BENCH_MULTISTEP": "8", "FLAGS_multistep_unroll": "0",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "resnet50_imagenet_train_throughput"
+    assert rec["value"] > 0
+    # the JSON line must record the multistep setting (BENCH_LOG lines
+    # are unlabeled otherwise and a K=8 number could masquerade as K=1)
+    assert rec["multistep"] == 8
+    assert rec["vs_baseline"] is None
+    assert "loss" in rec and rec["loss"] == rec["loss"]
+
+
 def test_tool_shell_scripts_parse():
     """bash -n every tools/*.sh: a syntax error in a sweep script would
     consume the round's only healthy tunnel window (the probe loop

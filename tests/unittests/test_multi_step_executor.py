@@ -1,0 +1,517 @@
+"""Multi-step device-resident execution (Executor.run(steps=K)).
+
+The contract under test: a K-step device-resident loop replays the exact
+per-step seed sequence Scope.next_seed would have issued, so parameters,
+optimizer accumulators, LR-decay counters, PRNG streams and dropout masks
+match K sequential single-step run() calls BIT-IDENTICALLY for fc/while
+programs. Conv programs are the one exception: XLA picks layout/fusion
+for the conv gradient per MODULE, and the K-step module's choice can
+round differently from the standalone step's at the last ULP (verified:
+the drift appears with barriers between steps, with fixed lr, in both
+loop modes — it is conv codegen context, not loop semantics), so the
+conv+bn assertions use a few-ULP tolerance. Both lowering modes
+(lax.scan and full unroll, FLAGS_multistep_unroll) are covered, as are
+the fetch-reduce policies, sticky in-graph assertions, the compile cache
+keying, reader-fed stacking, and the ParallelExecutor composition.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _copy_scope_state(src_init, scope, counter):
+    for n, v in src_init.items():
+        scope.set(n, v)
+    scope._rng_counter = counter
+
+
+def _snapshot(scope):
+    return {n: np.asarray(scope.get(n)) for n in scope.names()
+            if hasattr(scope.get(n), "dtype")}
+
+
+def _build_conv_bn(seed=11):
+    """conv + batch_norm (running-stat accumulators) + dropout (PRNG) +
+    fc, trained with Momentum under exponential LR decay (persistable
+    @LR_DECAY_COUNTER@ step counter) — every state species the ISSUE
+    names."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 8, 8],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                   padding=1, act="relu")
+        bn = fluid.layers.batch_norm(input=conv)
+        drop = fluid.layers.dropout(bn, dropout_prob=0.4)
+        pred = fluid.layers.fc(input=drop, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        lr = fluid.layers.exponential_decay(
+            learning_rate=0.1, decay_steps=2, decay_rate=0.8)
+        fluid.optimizer.Momentum(learning_rate=lr, momentum=0.9) \
+            .minimize(loss)
+    return main, startup, loss
+
+
+def _conv_bn_feed():
+    rng = np.random.RandomState(0)
+    return {"img": rng.rand(4, 1, 8, 8).astype("float32"),
+            "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+
+
+def _run_sequential(build, feed, k):
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        counter = scope._rng_counter
+        init = _snapshot(scope)
+        seq = [exe.run(main, feed=feed, fetch_list=[loss])[0]
+               for _ in range(k)]
+        final = _snapshot(scope)
+    return init, counter, np.concatenate(
+        [np.reshape(s, (1, -1)) for s in seq]), final
+
+
+def _run_multi(build, feed, k, init, counter, fetch_reduce="stack"):
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        _copy_scope_state(init, scope, counter)
+        out = exe.run(main, feed=feed, fetch_list=[loss], steps=k,
+                      fetch_reduce=fetch_reduce)
+        assert scope._rng_counter == counter + k
+        final = _snapshot(scope)
+    return np.asarray(out[0]), final
+
+
+def _assert_state_equal(a, b, rtol=0):
+    assert sorted(a) == sorted(b)
+    for n in a:
+        if rtol:
+            np.testing.assert_allclose(a[n], b[n], rtol=rtol, atol=1e-6,
+                                       err_msg=n)
+        else:
+            np.testing.assert_array_equal(a[n], b[n], err_msg=n)
+
+
+def _build_mlp(seed=13):
+    """fc + dropout + Momentum under exponential LR decay: every state
+    species (params, velocity accumulators, @LR_DECAY_COUNTER@, dropout
+    PRNG) without a conv — this family IS bit-exact across the module
+    boundary, so the strongest assertion applies."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.3)
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        lr = fluid.layers.exponential_decay(
+            learning_rate=0.05, decay_steps=2, decay_rate=0.8)
+        fluid.optimizer.Momentum(learning_rate=lr, momentum=0.9) \
+            .minimize(loss)
+    return main, startup, loss
+
+
+def _mlp_feed():
+    rng = np.random.RandomState(3)
+    xs = rng.rand(8, 16).astype("float32")
+    return {"x": xs, "y": (xs.sum(1, keepdims=True) * 0.1).astype("float32")}
+
+
+@pytest.mark.parametrize("unroll_flag", ["0", "1"])
+def test_mlp_multi_step_bit_identical(unroll_flag, monkeypatch):
+    monkeypatch.setenv("FLAGS_multistep_unroll", unroll_flag)
+    k = 4
+    feed = _mlp_feed()
+    init, counter, seq, seq_state = _run_sequential(_build_mlp, feed, k)
+    # losses must actually evolve or the parity assertion is vacuous
+    assert len({float(s[0]) for s in seq}) > 1
+    stacked, ms_state = _run_multi(_build_mlp, feed, k, init, counter)
+    assert stacked.shape[0] == k
+    np.testing.assert_array_equal(stacked.reshape(k, -1), seq)
+    # params, velocity accumulators, dropout PRNG, @LR_DECAY_COUNTER@
+    _assert_state_equal(seq_state, ms_state)
+    assert any("LR_DECAY_COUNTER" in n for n in ms_state)
+
+
+@pytest.mark.parametrize("unroll_flag", ["0", "1"])
+def test_conv_bn_multi_step_matches_sequential(unroll_flag, monkeypatch):
+    monkeypatch.setenv("FLAGS_multistep_unroll", unroll_flag)
+    k = 4
+    feed = _conv_bn_feed()
+    init, counter, seq, seq_state = _run_sequential(_build_conv_bn, feed, k)
+    assert len({float(s[0]) for s in seq}) > 1
+    stacked, ms_state = _run_multi(_build_conv_bn, feed, k, init, counter)
+    assert stacked.shape[0] == k
+    # conv grads: XLA's module-level layout/fusion choice rounds the last
+    # ULP differently inside the K-step module (see module docstring)
+    np.testing.assert_allclose(stacked.reshape(k, -1), seq, rtol=5e-5,
+                               atol=1e-6)
+    # params, momentum accumulators, BN running stats, @LR_DECAY_COUNTER@
+    _assert_state_equal(seq_state, ms_state, rtol=5e-5)
+    assert any("LR_DECAY_COUNTER" in n for n in ms_state)
+
+
+def test_fetch_reduce_policies():
+    k = 4
+    feed = _mlp_feed()
+    init, counter, seq, _ = _run_sequential(_build_mlp, feed, k)
+    last, _ = _run_multi(_build_mlp, feed, k, init, counter,
+                         fetch_reduce="last")
+    np.testing.assert_array_equal(last.reshape(1, -1), seq[-1:])
+    mean, _ = _run_multi(_build_mlp, feed, k, init, counter,
+                         fetch_reduce="mean")
+    np.testing.assert_allclose(mean.reshape(-1), seq.mean(0), rtol=1e-6)
+
+
+def test_bad_args_raise():
+    main, startup, loss = _build_conv_bn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="steps"):
+            exe.run(main, feed=_conv_bn_feed(), fetch_list=[loss], steps=0)
+        with pytest.raises(ValueError, match="fetch_reduce"):
+            exe.run(main, feed=_conv_bn_feed(), fetch_list=[loss], steps=2,
+                    fetch_reduce="sum")
+
+
+def _build_while(seed=5):
+    """A While-containing program whose loop output trains a parameter."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4, act="relu")
+        counter = layers.zeros(shape=[1], dtype="int32")
+        counter.stop_gradient = True
+        limit = layers.fill_constant(shape=[1], dtype="int32", value=3)
+        acc = layers.fill_constant(shape=[2, 4], dtype="float32", value=0.0)
+        cond = layers.less_than(x=counter, y=limit)
+        w_op = layers.While(cond=cond)
+        with w_op.block():
+            nacc = layers.elementwise_add(x=acc, y=h)
+            layers.assign(nacc, acc)
+            layers.increment(counter, 1, in_place=True)
+            layers.less_than(x=counter, y=limit, cond=cond)
+        pred = fluid.layers.fc(input=acc, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _while_feed():
+    rng = np.random.RandomState(1)
+    xs = rng.rand(2, 4).astype("float32")
+    return {"x": xs, "y": (xs.sum(1, keepdims=True) * 0.3).astype("float32")}
+
+
+@pytest.mark.parametrize("unroll_flag", ["0", "1"])
+def test_while_program_multi_step(unroll_flag, monkeypatch):
+    monkeypatch.setenv("FLAGS_multistep_unroll", unroll_flag)
+    k = 4
+    feed = _while_feed()
+    init, counter, seq, seq_state = _run_sequential(_build_while, feed, k)
+    assert len({float(s[0]) for s in seq}) > 1
+    stacked, ms_state = _run_multi(_build_while, feed, k, init, counter)
+    np.testing.assert_array_equal(stacked.reshape(k, -1), seq)
+    _assert_state_equal(seq_state, ms_state)
+
+
+def _build_growing_overflow():
+    """TensorArray whose per-run write count grows with a persistable step
+    counter: capacity 3 survives run 1 and overflows at run 2 — so inside
+    a K>=2 multi-step loop the flag trips at step j=1 < K and must stay
+    sticky until the host check."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        step = fluid.layers.nn.autoincreased_step_counter(begin=1)
+        iters = layers.cast(step, "int32") + layers.fill_constant(
+            shape=[1], dtype="int32", value=1)
+        counter = layers.zeros(shape=[1], dtype="int32")
+        counter.stop_gradient = True
+        arr = layers.create_array("float32", capacity=3)
+        x = layers.fill_constant(shape=[2], dtype="float32", value=1.0)
+        layers.array_write(x, counter, arr)
+        cond = layers.less_than(x=counter, y=iters)
+        w_op = layers.While(cond=cond)
+        with w_op.block():
+            v = layers.array_read(arr, counter)
+            layers.increment(counter, 1, in_place=True)
+            layers.array_write(v, counter, arr)
+            layers.less_than(x=counter, y=iters, cond=cond)
+        out = layers.array_read(arr, counter)
+    return main, startup, out
+
+
+@pytest.mark.parametrize("unroll_flag", ["0", "1"])
+def test_assertion_tripped_mid_loop_still_raises(unroll_flag, monkeypatch):
+    monkeypatch.setenv("FLAGS_multistep_unroll", unroll_flag)
+    exe = fluid.Executor(fluid.CPUPlace())
+    # sequential reference: clean at run 1, raises at run 2
+    main, startup, out = _build_growing_overflow()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, fetch_list=[out])
+        with pytest.raises(RuntimeError, match="overflowed its capacity"):
+            exe.run(main, fetch_list=[out])
+    # multi-step: the flag trips at step 1 of 4 and the K-step call raises
+    main2, startup2, out2 = _build_growing_overflow()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        with pytest.raises(RuntimeError, match="overflowed its capacity"):
+            exe.run(main2, fetch_list=[out2], steps=4)
+
+
+def test_compile_cache_keys_on_steps_and_reduce():
+    main, startup, loss = _build_conv_bn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = _conv_bn_feed()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        n1 = len(exe._cache)
+        exe.run(main, feed=feed, fetch_list=[loss], steps=2)
+        n2 = len(exe._cache)
+        assert n2 == n1 + 1                      # K joined the key
+        exe.run(main, feed=feed, fetch_list=[loss], steps=2)
+        assert len(exe._cache) == n2             # cache hit
+        exe.run(main, feed=feed, fetch_list=[loss], steps=3)
+        assert len(exe._cache) == n2 + 1         # different K
+        exe.run(main, feed=feed, fetch_list=[loss], steps=3,
+                fetch_reduce="mean")
+        assert len(exe._cache) == n2 + 2         # different fetch_reduce
+        # steps=1 ignores fetch_reduce (no loop to reduce over)
+        exe.run(main, feed=feed, fetch_list=[loss], fetch_reduce="mean")
+        assert len(exe._cache) == n2 + 2
+
+
+def test_fetch_handles_are_lazy():
+    import jax
+    main, startup, loss = _build_conv_bn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        h, = exe.run(main, feed=_conv_bn_feed(), fetch_list=[loss],
+                     steps=2, fetch_reduce="last", return_numpy=False)
+    assert isinstance(h, fluid.FetchHandle)
+    assert isinstance(h.array, jax.Array)
+    assert h.shape == h.array.shape and h.dtype == h.array.dtype
+    val = np.asarray(h)            # materializes via __array__
+    np.testing.assert_array_equal(val, h.numpy())
+    assert np.isfinite(val).all()
+    h.block()
+    from paddle_tpu.core.utils import device_fetch_barrier
+    device_fetch_barrier([h])      # timing-loop barrier unwraps handles
+
+
+def _make_recordio(tmp_path, n_batches=8):
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype("float32")
+
+    def reader():
+        for _ in range(n_batches):
+            xs = rng.rand(8, 4).astype("float32")
+            yield xs, (xs @ w).astype("float32")
+
+    path = str(tmp_path / "msr.recordio")
+    fluid.recordio_writer.convert_reader_to_recordio_file(path, reader)
+    return path
+
+
+def _build_reader_prog(path, seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        r = fluid.layers.open_recordio_file(
+            filename=path, shapes=[[-1, 4], [-1, 1]], lod_levels=[0, 0],
+            dtypes=["float32", "float32"])
+        r = fluid.layers.create_double_buffer_reader(r, capacity=2)
+        x, y = fluid.layers.read_file(r)
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_reader_fed_multi_step_matches_sequential(tmp_path):
+    path = _make_recordio(tmp_path)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    main, startup, loss = _build_reader_prog(path)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        counter = scope._rng_counter
+        init = _snapshot(scope)
+        seq = [float(exe.run(main, fetch_list=[loss])[0][0])
+               for _ in range(8)]
+        w_seq = np.asarray(scope.get("fc_0.w_0"))
+
+    main2, startup2, loss2 = _build_reader_prog(path)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup2)
+        _copy_scope_state(init, scope2, counter)
+        # two K=4 blocks: records stack [K, batch, ...] and slice per step
+        out1 = exe.run(main2, fetch_list=[loss2], steps=4)
+        out2 = exe.run(main2, fetch_list=[loss2], steps=4)
+        w_ms = np.asarray(scope2.get("fc_0.w_0"))
+    got = np.concatenate([np.asarray(out1[0]).ravel(),
+                          np.asarray(out2[0]).ravel()])
+    np.testing.assert_array_equal(got, np.asarray(seq, "float32"))
+    np.testing.assert_array_equal(w_seq, w_ms)
+
+
+def test_reader_eof_mid_block_consumes_nothing(tmp_path):
+    path = _make_recordio(tmp_path, n_batches=8)
+    from paddle_tpu.core.readers import EOFException
+    main, startup, loss = _build_reader_prog(path)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(2):
+            exe.run(main, fetch_list=[loss], steps=3)   # 6 of 8 consumed
+        with pytest.raises(EOFException):
+            exe.run(main, fetch_list=[loss], steps=3)   # only 2 left
+        # the failed block pushed both records back: drain them
+        out = exe.run(main, fetch_list=[loss], steps=2)
+        assert np.asarray(out[0]).shape[0] == 2
+        # the mid-block EOF consumed the double buffer's ONE-SHOT
+        # sentinel; with the tail drained, the stream must raise EOF
+        # again (not hang on the dead worker's queue)
+        with pytest.raises(EOFException):
+            exe.run(main, fetch_list=[loss])
+
+
+def test_reader_ragged_block_consumes_nothing(tmp_path):
+    """Records whose field shapes differ can't stack into a [K, ...] feed:
+    the failed K-step run must push the WHOLE block back (the stack
+    happens after next_many, so the push-back lives in the prepass)."""
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for i in range(4):
+            n = 8 if i != 2 else 6      # ragged third batch
+            xs = rng.rand(n, 4).astype("float32")
+            yield xs, xs[:, :1].copy()
+
+    path = str(tmp_path / "ragged.recordio")
+    fluid.recordio_writer.convert_reader_to_recordio_file(path, reader)
+    main, startup, loss = _build_reader_prog(path)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(Exception):
+            exe.run(main, fetch_list=[loss], steps=4)
+        # nothing consumed: all 4 records still drain one at a time
+        for _ in range(4):
+            exe.run(main, fetch_list=[loss])
+
+
+def test_main_block_reader_creation_rejected_multi_step(tmp_path):
+    """Reader-creation ops in the MAIN block run once per call — under
+    steps=K that silently diverges from K sequential runs, so the
+    executor refuses instead."""
+    path = _make_recordio(tmp_path)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, main):
+        # program_guard(main, main): creation ops land in the MAIN block
+        r = fluid.layers.open_recordio_file(
+            filename=path, shapes=[[-1, 4], [-1, 1]], lod_levels=[0, 0],
+            dtypes=["float32", "float32"])
+        x, y = fluid.layers.read_file(r)
+        s = fluid.layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(RuntimeError, match="once per CALL"):
+            exe.run(main, fetch_list=[s], steps=2)
+
+
+def test_reader_next_many_atomicity_unit():
+    from paddle_tpu.core.readers import IteratorReader, EOFException
+    r = IteratorReader(lambda: iter([1, 2, 3]))
+    with pytest.raises(EOFException):
+        r.next_many(4)
+    assert r.next_many(3) == [1, 2, 3]        # nothing was consumed
+
+    r2 = IteratorReader(lambda: iter([1, 2, 3]))
+
+    def veto_two(rec):
+        if rec == 2:
+            raise ValueError("bad record")
+    with pytest.raises(ValueError):
+        r2.next_many(3, validate=veto_two)
+    assert r2.next() == 1                     # offender pushed back too
+    assert r2.next() == 2
+
+
+def test_parallel_executor_multi_step_matches_single():
+    import jax
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+
+    def build(seed=33):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = seed
+        startup.random_seed = seed
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(input=x, size=32, act="relu")
+            pred = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9) \
+                .minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(3)
+    xs = rng.rand(64, 16).astype("float32")
+    ys = (xs.sum(1, keepdims=True) * 0.1).astype("float32")
+    k = 5
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    main, startup, loss = build()
+    s1 = fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        counter = s1._rng_counter
+        init = _snapshot(s1)
+        seq = [float(exe.run(main, feed={"x": xs, "y": ys},
+                             fetch_list=[loss])[0][0]) for _ in range(k)]
+        w_seq = np.asarray(s1.get("fc_0.w_0"))
+
+    for pexe_kw in ({}, {"sharded_weight_update": True}):
+        main2, startup2, loss2 = build()
+        s2 = fluid.Scope()
+        with fluid.scope_guard(s2):
+            exe.run(startup2)
+            _copy_scope_state(init, s2, counter)
+            pexe = fluid.ParallelExecutor(main_program=main2,
+                                          loss_name=loss2.name, **pexe_kw)
+            out = pexe.run(fetch_list=[loss2], feed={"x": xs, "y": ys},
+                           steps=k)
+            assert s2._rng_counter == counter + k
+            w_par = np.asarray(s2.get("fc_0.w_0"))
+        np.testing.assert_allclose(np.asarray(out[0]).ravel(), seq,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(w_seq, w_par, rtol=1e-4, atol=1e-5)

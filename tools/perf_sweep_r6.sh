@@ -1,0 +1,96 @@
+#!/bin/bash
+# Round-6 sweep: multi-step device-resident execution (PR 1). SUPERSEDES
+# perf_sweep_r5.sh as the NEXT_SWEEP target; r5's queue ran (or stays in
+# the historical record if the tunnel never healed). Cheapest-first; ONE
+# client at a time via tools/tpu_lock.sh; rc-gated banking; stderr kept
+# per run. Exits nonzero when wedged so the probe loop leaves the sweep
+# queued for the next healthy window.
+#
+# What r6 measures (BENCH_MULTISTEP, Executor.run(steps=K)):
+# - the TPU lax.scan K-step loop vs single-step dispatch, same configs —
+#   the dispatch-overhead win every later kernel PR is stacked on top of.
+#   CPU reference (2026-08-04, tunnel wedged): +65% tok/s at K=8 on the
+#   dispatch-bound tiny transformer; parity on compute-bound resnet50.
+# - K sensitivity (8/32) and fetch_reduce is 'last' in bench.py, so the
+#   JSON "multistep" field labels every line.
+# - one FLAGS_multistep_unroll=1 line: full unroll ALSO lets XLA fuse
+#   across step boundaries on TPU; worth one compile to know.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/perf_sweep_r6.log
+: > $LOG
+WEDGED=0
+N=0
+LOCK="tools/tpu_lock.sh"
+tunnel_ok() {
+  bash "$LOCK" timeout 120 python -c \
+    'import jax,sys; sys.exit(0 if any(d.platform!="cpu" for d in jax.devices()) else 1)' \
+    >/dev/null 2>&1
+}
+probe() {
+  [ "$WEDGED" = 1 ] && return 1
+  tunnel_ok && return 0
+  local rc=$?
+  if [ $rc -eq 75 ]; then
+    echo "- $(date -u +%FT%TZ) r6 sweep stopped: tpu_lock busy (rc=75)" >> BENCH_LOG.md
+  else
+    echo "- $(date -u +%FT%TZ) tunnel probe FAILED mid-r6-sweep" >> BENCH_LOG.md
+  fi
+  WEDGED=1
+  return 1
+}
+bank() {
+  git commit -q -m "perf sweep: bank measured bench lines" \
+    -- BENCH_LOG.md 2>/dev/null || true
+}
+run() {  # run <timeout_s> ENV=V...
+  [ "$WEDGED" = 1 ] && { echo "skip (wedged): $*" | tee -a $LOG; return; }
+  local to=$1; shift
+  N=$((N+1))
+  echo "=== [$N] $*" | tee -a $LOG
+  local line rc
+  bash "$LOCK" env "$@" BENCH_DEVICE_TIMEOUT=300 timeout -k 10 "$to" \
+    python bench.py >/tmp/bench_run.out 2>/tmp/bench_err_r6_$N.log
+  rc=$?
+  if [ $rc -eq 75 ]; then
+    echo "- $(date -u +%FT%TZ) r6 sweep stopped mid-run: tpu_lock busy" >> BENCH_LOG.md
+    WEDGED=1
+    return
+  fi
+  line=$(tail -1 /tmp/bench_run.out)
+  if [ $rc -ne 0 ]; then
+    line='{"error": "rc='$rc'"}'"$line"
+  fi
+  case "$line" in
+    *'"error"'*|"")
+      echo "- $(date -u +%FT%TZ) FAILED(rc=$rc, err=/tmp/bench_err_r6_$N.log): $*" >> BENCH_LOG.md
+      tail -3 /tmp/bench_err_r6_$N.log >> $LOG
+      case "$line" in
+        *"device init"*) WEDGED=1 ;;
+        *) tunnel_ok || WEDGED=1 ;;
+      esac ;;
+    *) printf -- '- %s `%s`\n  `%s`\n' "$(date -u +%FT%TZ)" "$*" "$line" \
+         >> BENCH_LOG.md
+       bank ;;
+  esac
+}
+# --- tier 1: single-step baselines for the day (cheap, known compiles) -----
+probe && run 900 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=16 BENCH_WARMUP=2
+probe && run 900 BENCH_MODEL=transformer BENCH_DTYPE=bf16 BENCH_STEPS=16 BENCH_WARMUP=2
+# --- tier 2: the K-step scan loop, same configs -----------------------------
+probe && run 1200 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_MULTISTEP=8
+probe && run 1200 BENCH_MODEL=transformer BENCH_DTYPE=bf16 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_MULTISTEP=8
+probe && run 1200 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=64 BENCH_WARMUP=2 BENCH_MULTISTEP=32
+# (no host-feed multistep tier: run(steps=K) replays an explicit feed
+# for all K steps, so BENCH_FEED=host* would credit K steps to 1/K of
+# the staging work — bench.py refuses the combination; measuring the
+# pipeline under the loop needs an in-graph-reader bench mode first)
+# --- tier 3: big compile LAST — one unrolled TPU line (K copies of the step)
+probe && run 2400 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_MULTISTEP=8 FLAGS_multistep_unroll=1
+bank
+# r5's queue never got a healthy window (wedged all round): if this
+# window is still alive, run it too — its remat/flash-tune items are
+# still unmeasured and it probes/banks/exits on its own.
+[ "$WEDGED" = 0 ] && bash tools/perf_sweep_r5.sh
+echo "=== r6 sweep done (wedged=$WEDGED) ===" | tee -a $LOG
+exit $WEDGED
