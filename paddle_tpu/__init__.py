@@ -71,6 +71,8 @@ from .reader import batch
 from . import datasets
 from . import recordio
 from . import recordio_writer
+from . import analysis
+from .analysis import ProgramVerificationError
 
 Tensor = LoDTensor
 

@@ -1,0 +1,74 @@
+"""Static program verifier: a pass pipeline over the Fluid graph IR,
+run BEFORE lowering.
+
+The reference stack validates programs piecemeal at run time (per-op
+InferShape inside the executor loop), so a malformed ProgramDesc fails
+deep inside op N with no pointer back to the layer call that built it —
+and the whole-program XLA rebuild inherits that as opaque trace/XLA
+failures after lowering has started. Like TVM's and TensorFlow's
+graph-level verification passes, this package checks the Program while
+it is still a graph:
+
+    result = analysis.analyze(program, feed_names=[...],
+                              fetch_names=[...])
+    for d in result:            # structured Diagnostics
+        print(d.format())
+    result.raise_if_errors()    # ProgramVerificationError
+
+Pipeline (pass_base.PASS_REGISTRY, registration order):
+  op-registry       unregistered op types (+ close-name suggestions)
+  reader-placement  host-io ops outside the io pre-pass's reach
+  carriers          feed/fetch well-formedness, sequence companions
+  def-use           use-before-def, cross-block captures, carrier
+                    hazards, dead writes/ops/unused vars
+  shape-infer       declared vs re-inferred shapes/dtypes (first
+                    inconsistent op)
+
+Entry points: `Executor.run(validate=True)` / FLAGS_validate_program=1
+(errors raise before any reader record is consumed), `tools/pplint.py`
+for saved programs (native desc, pickle, or era-wire protobuf), and the
+op_test harness (every op test validates its program for free). See
+ARCHITECTURE.md §2c for how to add a pass.
+"""
+from .diagnostics import (AnalysisResult, Diagnostic, ERROR, WARNING,
+                          ProgramVerificationError)
+from .pass_base import (AnalysisContext, AnalysisPass, PASS_REGISTRY,
+                        default_passes, register_pass)
+from . import structural  # registers op-registry/reader-placement/carriers
+from . import def_use     # registers def-use
+from . import shape_infer  # registers shape-infer
+from .structural import check_wire_carriers
+
+__all__ = [
+    "analyze", "validate_or_raise", "Diagnostic", "AnalysisResult",
+    "AnalysisContext", "AnalysisPass", "ProgramVerificationError",
+    "ERROR", "WARNING", "PASS_REGISTRY", "default_passes",
+    "register_pass", "check_wire_carriers",
+]
+
+
+def analyze(program, feed_names=None, fetch_names=None, steps=1,
+            passes=None):
+    """Run the analysis pipeline over `program`; returns AnalysisResult.
+
+    feed_names: names the caller will feed (None = assume every is_data
+    var, the layers.data contract). fetch_names: fetch targets (enables
+    precise dead-code/fetchability checks). steps: the Executor steps=K
+    setting (K>1 arms the multi-step reader-placement rule). passes:
+    explicit pass instances (default: the registered pipeline).
+    """
+    ctx = AnalysisContext(program, feed_names=feed_names,
+                          fetch_names=fetch_names, steps=steps)
+    for p in (passes if passes is not None else default_passes()):
+        p.run(ctx)
+    return ctx.result
+
+
+def validate_or_raise(program, feed_names=None, fetch_names=None, steps=1,
+                      passes=None):
+    """analyze() + raise ProgramVerificationError on any error-severity
+    finding (strict mode). Returns the AnalysisResult when clean."""
+    result = analyze(program, feed_names=feed_names,
+                     fetch_names=fetch_names, steps=steps, passes=passes)
+    result.raise_if_errors()
+    return result

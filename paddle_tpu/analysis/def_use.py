@@ -1,0 +1,258 @@
+"""Def-use analysis over the Program in EXECUTION order.
+
+Walks the global block with sub-blocks inlined at their owning op's
+position — the order `lower_block` traces them — tracking which names
+hold a value:
+
+  * use-before-def (error): a read the trace would fail with
+    `Env.read` KeyError, including reads of names declared in no
+    reachable block (invalid cross-block captures into while/cond
+    sub-blocks) and uninitialized While loop carries;
+  * carrier hazard (error): a persistable var read before its first
+    write that `lowering.analyze_state` classifies WRITE-ONLY — the
+    multi-step scan would seed its loop carry with zeros instead of the
+    scope value (the donation/aliasing trap), and a single-step run
+    fails with read-before-write;
+  * dead write (warning): write-after-write on the same name with no
+    intervening read — the first write can never be observed;
+  * dead ops / unused vars (warning): ops whose outputs nothing consumes
+    and declared vars no op touches.
+
+Env semantics being FLAT (name -> value across all blocks) is what makes
+this a plain set-tracking walk; sub-blocks of while/conditional_block
+read a snapshot of the enclosing env, so the strict ordering rules apply
+inside them too. Sub-blocks of other graph-level ops (rnn_scan,
+beam_search, listen_and_serv) bind step placeholders internally, so only
+existence — not ordering — is checked there.
+"""
+from ..core.framework import GRAD_SUFFIX
+from ..core.readers import is_host_io_op
+from .pass_base import (AnalysisPass, register_pass, attr_referenced_names)
+
+# sub-block owners whose bodies read a straight copy of the enclosing env
+# (strict ordering holds); every other owner gets the lenient walk
+_STRICT_SUB_OWNERS = frozenset({"while", "conditional_block"})
+
+# ops kept even when nothing consumes their outputs
+_EFFECTFUL_OPS = frozenset({"send", "recv", "listen_and_serv"})
+
+
+@register_pass
+class DefUsePass(AnalysisPass):
+    name = "def-use"
+
+    def run(self, ctx):
+        self.ctx = ctx
+        program = ctx.program
+        defined = set(ctx.feed_names)
+        # in-graph reader outputs are injected as feeds by the io
+        # pre-pass BEFORE the program body runs, regardless of where the
+        # read op sits in op order
+        for op in program.global_block().ops:
+            if op.type == "read":
+                defined.update(n for ns in op.outputs.values()
+                               for n in ns if n)
+        self._pending_stack = []  # per-frame {name: (op_idx, op)} writes
+        self._walk(program.global_block(), defined, strict=True)
+        self._dead_and_unused()
+
+    # ---- execution-order walk ---------------------------------------
+    def _walk(self, block, defined, strict):
+        ctx = self.ctx
+        pending = {}
+        self._pending_stack.append(pending)
+        try:
+            for op_idx, op in enumerate(block.ops):
+                if is_host_io_op(op.type):
+                    # host-side: reads host ReaderState (checked by the
+                    # reader-placement pass), outputs become feeds
+                    for ns in op.outputs.values():
+                        defined.update(n for n in ns if n)
+                    continue
+                if op.type == "while":
+                    self._check_while_carries(block, op_idx, op, defined)
+                for slot, names in op.inputs.items():
+                    if op.type == "conditional_block" and slot == "OutPrev":
+                        continue  # read_opt: zeros when undefined
+                    for name in names:
+                        self._check_read(block, op_idx, op, name, defined,
+                                         strict)
+                for sub in ctx.sub_blocks(op):
+                    self._walk(sub, set(defined),
+                               strict=strict and
+                               op.type in _STRICT_SUB_OWNERS)
+                for names in op.outputs.values():
+                    for name in names:
+                        if name:
+                            self._note_write(block, op_idx, op, name,
+                                             pending)
+                            defined.add(name)
+                # values the sub-block lowering writes back at top level
+                for key in ("carry_names", "out_names"):
+                    val = op.attrs.get(key)
+                    if isinstance(val, (list, tuple)):
+                        defined.update(n for n in val
+                                       if isinstance(n, str) and n)
+        finally:
+            self._pending_stack.pop()
+
+    def _note_read(self, name):
+        for frame in self._pending_stack:
+            frame.pop(name, None)
+
+    def _note_write(self, block, op_idx, op, name, pending):
+        ctx = self.ctx
+        prev = pending.get(name)
+        accumulates = (op.type == "grad_of"
+                       or op.attrs.get("__accumulate_outputs__", False))
+        if prev is not None and not accumulates \
+                and not name.endswith(GRAD_SUFFIX) \
+                and not ctx.sub_blocks(op):
+            prev_idx, prev_op = prev
+            ctx.warning(
+                "dead-write",
+                "op %d (%s) overwrites %r which op %d (%s) wrote and "
+                "nothing read in between — the first write is dead"
+                % (op_idx, op.type, name, prev_idx, prev_op.type),
+                block=block, op_idx=op_idx, op=op, var_names=(name,),
+                hint="drop the earlier op or read its result before "
+                     "overwriting")
+        sub_or_acc = accumulates or ctx.sub_blocks(op) \
+            or name.endswith(GRAD_SUFFIX)
+        pending[name] = None if sub_or_acc else (op_idx, op)
+        if pending[name] is None:
+            pending.pop(name)
+
+    def _check_while_carries(self, block, op_idx, op, defined):
+        carries = op.attrs.get("carry_names") or ()
+        missing = [n for n in carries
+                   if n not in defined and not self._scope_backed(n, block)]
+        if missing:
+            self.ctx.error(
+                "use-before-def",
+                "While loop carries %r, but they have no value before "
+                "the loop (XLA loop carries need an initial value)"
+                % (missing,),
+                block=block, op_idx=op_idx, op=op, var_names=missing,
+                hint="assign / array_write / fill_constant each carried "
+                     "var before `with while_op.block():`")
+            # While ops also list carries in their X input slot — mark
+            # them defined so the generic read check doesn't report the
+            # same defect twice with a worse hint
+            defined.update(missing)
+
+    def _scope_backed(self, name, block):
+        v = self.ctx.lookup(block, name)
+        return (v is not None and v.persistable
+                and name in self.ctx.state_in())
+
+    def _check_read(self, block, op_idx, op, name, defined, strict):
+        ctx = self.ctx
+        if not name:
+            return
+        self._note_read(name)
+        if name in defined:
+            return
+        var = ctx.lookup(block, name)
+        if var is not None and var.persistable:
+            if name in ctx.state_in():
+                defined.add(name)  # provided by the Scope at run start
+                return
+            ctx.error(
+                "carrier-hazard",
+                "persistable variable %r is read before its first write, "
+                "but the executor's state analysis classifies it "
+                "write-only: a multi-step (steps=K) scan carry would "
+                "start from ZEROS instead of the scope value, and a "
+                "single-step run fails with read-before-write" % name,
+                block=block, op_idx=op_idx, op=op, var_names=(name,),
+                hint="initialize the var with an op before this read, or "
+                     "reorder so the writing op comes first")
+            defined.add(name)  # suppress cascades
+            return
+        if op.type == "grad_of" and name.endswith(GRAD_SUFFIX):
+            return  # out-grad cotangents resolve via read_opt (zeros)
+        if not strict:
+            if var is None:
+                ctx.warning(
+                    "undefined-var",
+                    "op reads %r which is declared in no reachable block "
+                    "and never written" % name,
+                    block=block, op_idx=op_idx, op=op, var_names=(name,))
+            return
+        if var is None:
+            inside = " (invalid cross-block capture)" if block.idx != 0 \
+                else ""
+            ctx.error(
+                "use-before-def",
+                "op reads %r, which is declared in no block reachable "
+                "from block %d and is never written%s"
+                % (name, block.idx, inside),
+                block=block, op_idx=op_idx, op=op, var_names=(name,),
+                hint="declare the variable in this block or an ancestor, "
+                     "or fix the name")
+        else:
+            ctx.error(
+                "use-before-def",
+                "variable %r is read before any op writes it (and it is "
+                "neither fed, produced by a reader, nor persistable "
+                "state)" % name,
+                block=block, op_idx=op_idx, op=op, var_names=(name,),
+                hint="feed it, or move/add the producing op before this "
+                     "one")
+        defined.add(name)  # suppress cascades
+
+    # ---- whole-program liveness (dead ops, unused vars) --------------
+    def _dead_and_unused(self):
+        ctx = self.ctx
+        program = ctx.program
+        used = set()
+        written = set()
+        for block in program.blocks:
+            for op in block.ops:
+                used.update(n for n in op.all_input_vars() if n)
+                used.update(attr_referenced_names(op))
+                written.update(n for n in op.all_output_vars() if n)
+        used.update(ctx.fetch_names)
+        # a used sequence var pulls its lengths companion along at runtime
+        for v in program.list_vars():
+            comp = getattr(v, "seq_len_var", None)
+            if comp and (v.name in used or v.name in ctx.fetch_names):
+                used.add(comp)
+
+        for op_idx, op in enumerate(program.global_block().ops):
+            if (op.type in _EFFECTFUL_OPS or is_host_io_op(op.type)
+                    or ctx.sub_blocks(op)):
+                continue
+            outs = [n for ns in op.outputs.values() for n in ns if n]
+            if not outs:
+                continue  # output-less ops are markers; assume effectful
+            live = False
+            for n in outs:
+                v = ctx.lookup(program.global_block(), n)
+                if n in used or (v is not None and v.persistable):
+                    live = True
+                    break
+            if not live:
+                ctx.warning(
+                    "dead-op",
+                    "nothing consumes any output of this op (%s)"
+                    % ", ".join(sorted(outs)[:4]),
+                    block=program.global_block(), op_idx=op_idx, op=op,
+                    var_names=outs,
+                    hint="drop it, fetch its result, or prune the program")
+
+        companions = {getattr(v, "seq_len_var", None)
+                      for v in program.list_vars()}
+        for block in program.blocks:
+            for name, v in block.vars.items():
+                if (name in used or name in written
+                        or name in ctx.feed_names
+                        or getattr(v, "is_data", False) or v.persistable
+                        or name in companions):
+                    continue
+                ctx.warning(
+                    "unused-var",
+                    "variable %r is declared but no op reads or writes it"
+                    % name, block=block, var_names=(name,),
+                    hint="remove the declaration")

@@ -1,0 +1,127 @@
+"""Structured findings of the static program verifier.
+
+Each analysis pass reports `Diagnostic`s into an `AnalysisResult`; the
+Executor's strict mode raises `ProgramVerificationError` carrying the
+error-severity subset. Severity contract:
+
+  ERROR   — the program WILL fail (or silently compute garbage) when
+            lowered/executed as analyzed: use-before-def, unregistered
+            op, declared-vs-inferred shape conflict, carrier hazards.
+            Strict mode (`Executor.run(validate=True)` /
+            FLAGS_validate_program) raises on these.
+  WARNING — legal but suspicious: dead ops, unused vars, dead writes,
+            reader creation riding in a compute program. Reported by
+            `tools/pplint.py` (non-fatal unless --strict) and available
+            programmatically; strict mode does not raise on them.
+"""
+
+ERROR = "error"
+WARNING = "warning"
+
+
+class Diagnostic(object):
+    """One finding: severity, a stable kebab-case code, where (block/op),
+    which vars, a fix hint, and the offending op's Python creation stack
+    (Operator.callstack) when available."""
+
+    __slots__ = ("severity", "code", "message", "block_idx", "op_idx",
+                 "op_type", "var_names", "hint", "callstack")
+
+    def __init__(self, severity, code, message, block_idx=None, op_idx=None,
+                 op_type=None, var_names=(), hint=None, callstack=()):
+        self.severity = severity
+        self.code = code
+        self.message = message
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.op_type = op_type
+        self.var_names = tuple(var_names)
+        self.hint = hint
+        self.callstack = tuple(callstack or ())
+
+    def location(self):
+        parts = []
+        if self.block_idx is not None:
+            parts.append("block %d" % self.block_idx)
+        if self.op_idx is not None:
+            parts.append("op %d" % self.op_idx)
+        if self.op_type:
+            parts.append("(%s)" % self.op_type)
+        return " ".join(parts)
+
+    def format(self, with_callstack=True):
+        loc = self.location()
+        lines = ["%s[%s]%s %s" % (self.severity, self.code,
+                                  " " + loc + ":" if loc else ":",
+                                  self.message)]
+        if self.hint:
+            lines.append("    fix: %s" % self.hint)
+        if with_callstack and self.callstack:
+            from ..core.utils import format_callstack
+            lines.append("    created at:")
+            lines.append(format_callstack(self.callstack, prefix="      "))
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return "Diagnostic(%s, %s, %r)" % (self.severity, self.code,
+                                           self.message)
+
+
+class AnalysisResult(object):
+    """Ordered collection of diagnostics from one analyzer run."""
+
+    def __init__(self, diagnostics=None):
+        self.diagnostics = list(diagnostics or [])
+
+    def add(self, diag):
+        self.diagnostics.append(diag)
+
+    def extend(self, diags):
+        self.diagnostics.extend(diags)
+
+    @property
+    def errors(self):
+        return [d for d in self.diagnostics if d.severity == ERROR]
+
+    @property
+    def warnings(self):
+        return [d for d in self.diagnostics if d.severity == WARNING]
+
+    @property
+    def ok(self):
+        return not self.errors
+
+    def by_code(self, code):
+        return [d for d in self.diagnostics if d.code == code]
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def format(self, with_callstack=True):
+        lines = [d.format(with_callstack=with_callstack)
+                 for d in self.diagnostics]
+        lines.append("%d error(s), %d warning(s)"
+                     % (len(self.errors), len(self.warnings)))
+        return "\n".join(lines)
+
+    def raise_if_errors(self):
+        errs = self.errors
+        if errs:
+            raise ProgramVerificationError(errs)
+        return self
+
+
+class ProgramVerificationError(RuntimeError):
+    """Raised by strict validation when the analyzer finds errors.
+    Subclasses RuntimeError so existing broad except clauses keep
+    working; `.diagnostics` carries the structured findings."""
+
+    def __init__(self, diagnostics):
+        self.diagnostics = list(diagnostics)
+        msg = "program verification failed with %d error(s):\n%s" % (
+            len(self.diagnostics),
+            "\n".join(d.format() for d in self.diagnostics))
+        super(ProgramVerificationError, self).__init__(msg)

@@ -1,0 +1,127 @@
+"""Pass registry + shared AnalysisContext for the static program verifier.
+
+A pass is a class with a `name` and `run(ctx)`; `@register_pass` puts it in
+the default pipeline in registration order (structural checks first, then
+def-use, then shape inference — later passes may assume earlier invariants,
+e.g. shape inference skips ops the registry pass already flagged as
+unregistered). `analyze()` (package __init__) instantiates the pipeline
+fresh per program, so passes may keep per-run state on self.
+"""
+import collections
+
+from ..core.framework import _sub_block_indices
+from .diagnostics import (AnalysisResult, Diagnostic, ERROR, WARNING)
+
+PASS_REGISTRY = collections.OrderedDict()
+
+
+def register_pass(cls):
+    """Class decorator: add an AnalysisPass subclass to the default
+    pipeline (keyed by its `name`)."""
+    PASS_REGISTRY[cls.name] = cls
+    return cls
+
+
+def default_passes():
+    """Fresh instances of every registered pass, pipeline order."""
+    return [cls() for cls in PASS_REGISTRY.values()]
+
+
+class AnalysisContext(object):
+    """Everything a pass needs about the program under analysis.
+
+    feed_names=None means "unknown feeds": every is_data Variable (plus
+    its @SEQLEN companion) is assumed fed — the layers.data contract.
+    When the Executor validates, it passes the REAL feed set; is_data
+    vars are still unioned in because in-graph reader (`read` op)
+    outputs are injected by the io pre-pass, not listed in `feed`.
+    """
+
+    def __init__(self, program, feed_names=None, fetch_names=None, steps=1):
+        self.program = program
+        self.fetch_names = tuple(
+            f if isinstance(f, str) else f.name for f in (fetch_names or ()))
+        self.steps = int(steps)
+        self.result = AnalysisResult()
+        feeds = set(feed_names or ())
+        for v in program.list_vars():
+            if getattr(v, "is_data", False):
+                feeds.add(v.name)
+                if getattr(v, "seq_len_var", None):
+                    feeds.add(v.seq_len_var)
+        self.feed_names = frozenset(feeds)
+        self._state = None
+
+    # ---- helpers shared by passes ------------------------------------
+    def report(self, severity, code, message, block=None, op_idx=None,
+               op=None, var_names=(), hint=None):
+        self.result.add(Diagnostic(
+            severity, code, message,
+            block_idx=block.idx if block is not None else None,
+            op_idx=op_idx,
+            op_type=op.type if op is not None else None,
+            var_names=var_names, hint=hint,
+            callstack=getattr(op, "callstack", ()) if op is not None
+            else ()))
+
+    def error(self, *args, **kwargs):
+        self.report(ERROR, *args, **kwargs)
+
+    def warning(self, *args, **kwargs):
+        self.report(WARNING, *args, **kwargs)
+
+    def lookup(self, block, name):
+        """Variable for `name` searching block then ancestors (None if
+        undeclared anywhere on the chain)."""
+        b = block
+        while b is not None:
+            v = b.vars.get(name)
+            if v is not None:
+                return v
+            b = b.parent_block
+        return None
+
+    def state_in(self):
+        """Persistable vars the executor's state analysis would READ from
+        the Scope (state_rw + state_ro of lowering.analyze_state) — the
+        single source of truth for which read-before-write names are
+        legitimately scope-provided."""
+        if self._state is None:
+            from ..core.lowering import analyze_state
+            rw, ro, out = analyze_state(
+                self.program, sorted(self.feed_names), self.fetch_names)
+            self._state = (frozenset(rw) | frozenset(ro), frozenset(out))
+        return self._state[0]
+
+    def sub_blocks(self, op):
+        """Blocks an op's attrs reference (framework._sub_block_indices)."""
+        return [self.program.blocks[i] for i in _sub_block_indices(op)
+                if 0 <= i < len(self.program.blocks)]
+
+
+class AnalysisPass(object):
+    """Base class; subclasses set `name` and implement run(ctx)."""
+
+    name = "base"
+
+    def run(self, ctx):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def attr_referenced_names(op):
+    """Var names an op references through ATTRS rather than input slots —
+    the same conventions Block.rename_var rewrites (fwd_inputs/fwd_outputs
+    maps of grad_of ops, *_name/*_names bindings of control-flow
+    lowerings). Used as USES by dead-op/unused-var detection; over-
+    approximating (e.g. open_files' file_names) only suppresses warnings,
+    never invents one."""
+    names = []
+    for key, val in op.attrs.items():
+        if key in ("fwd_inputs", "fwd_outputs") and isinstance(val, dict):
+            for ns in val.values():
+                names.extend(n for n in ns if n)
+        elif key.endswith("_name") and isinstance(val, str):
+            names.append(val)
+        elif key.endswith("_names") and isinstance(val, (list, tuple)):
+            names.extend(n for n in val if isinstance(n, str) and n)
+    return names

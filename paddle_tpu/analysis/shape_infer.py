@@ -1,0 +1,99 @@
+"""Whole-program abstract shape/dtype verification.
+
+Re-derives every registered op's output shapes/dtypes with
+`registry.abstract_eval` — the same dual-sentinel jax.eval_shape
+machinery `append_op` uses at build time, factored read-only — and
+compares them against the DECLARED Variable shapes/dtypes. On a program
+built through the layers API the two always agree (the declarations came
+from this machinery); a conflict means the program was hand-edited,
+deserialized from a corrupted/incompatible desc, or a transform broke an
+invariant — exactly the class of bug that otherwise surfaces as an
+opaque XLA shape error deep inside `Executor.run`.
+
+Reports the FIRST inconsistent op and stops: one bad declaration poisons
+every shape downstream, so later findings would be cascades, not causes.
+Comparisons are conservative (only both-static dims conflict; -1 against
+anything passes) — zero false positives is the contract that lets
+FLAGS_validate_program=1 run across the whole test suite.
+"""
+from ..core import registry
+from .pass_base import AnalysisPass, register_pass
+
+
+def _canonical(dtype_name):
+    """Declared dtype as the backend will actually materialize it: without
+    jax_enable_x64, 64-bit declarations truncate to 32-bit (int64->int32,
+    float64->float32) — the lowering rules produce the truncated dtype, so
+    comparing against the raw declaration would flag every int64
+    fill_constant in a default-config program."""
+    import jax.dtypes
+    import numpy as np
+    return np.dtype(jax.dtypes.canonicalize_dtype(
+        np.dtype(dtype_name))).name
+
+
+@register_pass
+class ShapeInferencePass(AnalysisPass):
+    name = "shape-infer"
+
+    def run(self, ctx):
+        for block in ctx.program.blocks:
+            for op_idx, op in enumerate(block.ops):
+                if op.type == "grad_of":
+                    continue  # derived via vjp; fwd op already checked
+                res = registry.abstract_eval(block, op)
+                if res is None:
+                    continue  # unregistered/special/custom-infer/bailed
+                if self._check_op(ctx, block, op_idx, op, res):
+                    return  # first inconsistent op only
+
+    def _check_op(self, ctx, block, op_idx, op, res):
+        for slot, entries in res.items():
+            names = op.outputs.get(slot, [])
+            for name, entry in zip(names, entries):
+                if not name or entry is None:
+                    continue
+                var = ctx.lookup(block, name)
+                if var is None:
+                    continue
+                inferred_shape, _, inferred_dtype = entry
+                if var.dtype is not None and \
+                        _canonical(var.dtype) != inferred_dtype:
+                    ctx.error(
+                        "dtype-mismatch",
+                        "output %r (slot %s) is declared %s but the "
+                        "lowering rule produces %s"
+                        % (name, slot, var.dtype, inferred_dtype),
+                        block=block, op_idx=op_idx, op=op,
+                        var_names=(name,),
+                        hint="fix the declared dtype or cast the inputs")
+                    return True
+                declared = var.shape
+                if declared is None:
+                    continue
+                if len(declared) != len(inferred_shape):
+                    ctx.error(
+                        "shape-mismatch",
+                        "output %r (slot %s) is declared rank %d %r but "
+                        "the lowering rule produces rank %d %r"
+                        % (name, slot, len(declared), tuple(declared),
+                           len(inferred_shape), inferred_shape),
+                        block=block, op_idx=op_idx, op=op,
+                        var_names=(name,),
+                        hint="fix the declared shape (or the op attrs "
+                             "that drive it)")
+                    return True
+                for d, i in zip(declared, inferred_shape):
+                    if d >= 0 and i >= 0 and d != i:
+                        ctx.error(
+                            "shape-mismatch",
+                            "output %r (slot %s) is declared %r but the "
+                            "lowering rule produces %r"
+                            % (name, slot, tuple(declared),
+                               inferred_shape),
+                            block=block, op_idx=op_idx, op=op,
+                            var_names=(name,),
+                            hint="fix the declared shape (or the op "
+                                 "attrs that drive it)")
+                        return True
+        return False

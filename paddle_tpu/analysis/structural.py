@@ -1,0 +1,247 @@
+"""Structural passes: op-registry coverage, reader placement, feed/fetch
+carrier well-formedness.
+
+These unify validation that previously lived scattered across runtime
+paths: `registry.get`'s NotImplementedError (now caught before lowering),
+`run_host_io_prepass`'s "main block refuses steps>1" refusal, and the
+feed/fetch plumbing rules `reference_format.py` enforces on the era wire.
+"""
+from ..core import registry
+from ..core.readers import HOST_IO_OPS
+from .pass_base import AnalysisPass, register_pass
+from .diagnostics import Diagnostic, ERROR
+
+READER_CREATION_OPS = frozenset(HOST_IO_OPS - {"read"})
+
+
+def known_op_types():
+    """Op types SOME lowering path handles: registered rules, graph-level
+    specials (control flow / tensor arrays), host-side io ops, and the
+    generic gradient op."""
+    from ..core.lowering import _SPECIAL
+    return (set(registry._OPS) | set(_SPECIAL) | set(HOST_IO_OPS)
+            | {"grad_of"})
+
+
+@register_pass
+class OpRegistryPass(AnalysisPass):
+    """Unregistered-op detection: the runtime raises NotImplementedError
+    deep inside the jit trace; here it is a pre-lowering error with
+    close-name suggestions (registry.suggest) and the creation site."""
+
+    name = "op-registry"
+
+    def run(self, ctx):
+        known = known_op_types()
+        for block in ctx.program.blocks:
+            for i, op in enumerate(block.ops):
+                if op.type not in known:
+                    close = registry.suggest(op.type)
+                    ctx.error(
+                        "unregistered-op",
+                        "op type %r has no registered TPU lowering"
+                        % op.type,
+                        block=block, op_idx=i, op=op,
+                        hint=("did you mean %s?" %
+                              " / ".join(repr(c) for c in close))
+                        if close else
+                        "register a lowering rule (core/registry.py) or "
+                        "remove the op")
+                elif op.type == "grad_of":
+                    from ..core.lowering import SPECIAL_GRADS
+                    fwd = op.attrs.get("fwd_type")
+                    if fwd and fwd not in SPECIAL_GRADS \
+                            and not registry.is_registered(fwd):
+                        ctx.error(
+                            "unregistered-op",
+                            "grad_of op differentiates forward type %r "
+                            "which has no registered lowering" % fwd,
+                            block=block, op_idx=i, op=op,
+                            var_names=(fwd,))
+
+
+@register_pass
+class ReaderPlacementPass(AnalysisPass):
+    """In-graph reader op placement. The io pre-pass
+    (executor.run_host_io_prepass) executes host io ops of the GLOBAL
+    block only, and with steps>1 refuses reader-creation ops in the run
+    program (they would run once per CALL, not once per step) — both are
+    runtime failures this pass surfaces before any record is consumed."""
+
+    name = "reader-placement"
+
+    def run(self, ctx):
+        has_read = any(op.type == "read"
+                       for b in ctx.program.blocks for op in b.ops)
+        for block in ctx.program.blocks:
+            for i, op in enumerate(block.ops):
+                if op.type == "read":
+                    if block.idx != 0:
+                        ctx.error(
+                            "reader-placement",
+                            "`read` op in sub-block %d: the io pre-pass "
+                            "only executes readers in the global block, "
+                            "so this op is silently skipped and its "
+                            "outputs are never produced" % block.idx,
+                            block=block, op_idx=i, op=op,
+                            hint="hoist read_file out of the "
+                                 "while/conditional block")
+                        continue
+                    rnames = op.inputs.get("Reader", [])
+                    rvar = ctx.lookup(block, rnames[0]) if rnames else None
+                    if not rnames:
+                        ctx.error("reader-placement",
+                                  "`read` op has no Reader input",
+                                  block=block, op_idx=i, op=op)
+                    elif rvar is not None and not rvar.persistable:
+                        ctx.warning(
+                            "reader-placement",
+                            "reader variable %r is not persistable; its "
+                            "host-side state will not survive in the "
+                            "scope between runs" % rnames[0],
+                            block=block, op_idx=i, op=op,
+                            var_names=(rnames[0],))
+                elif op.type in READER_CREATION_OPS:
+                    if block.idx != 0:
+                        ctx.error(
+                            "reader-placement",
+                            "reader-creation op %r in sub-block %d is "
+                            "never executed by the io pre-pass"
+                            % (op.type, block.idx),
+                            block=block, op_idx=i, op=op)
+                    elif ctx.steps > 1:
+                        ctx.error(
+                            "reader-placement",
+                            "reader-creation op %r in the main block of a "
+                            "steps=%d run: it would execute once per CALL "
+                            "instead of once per step"
+                            % (op.type, ctx.steps),
+                            block=block, op_idx=i, op=op,
+                            hint="keep reader creation in the startup "
+                                 "program (the standard split), or run "
+                                 "with steps=1")
+                    elif has_read:
+                        ctx.warning(
+                            "reader-placement",
+                            "reader-creation op %r rides in the same "
+                            "program as `read` ops: re-running this "
+                            "program resets the reader every call"
+                            % op.type,
+                            block=block, op_idx=i, op=op,
+                            hint="keep reader creation in the startup "
+                                 "program")
+
+
+@register_pass
+class CarrierPass(AnalysisPass):
+    """Feed/fetch carrier well-formedness for the in-memory Program:
+    every fetch must be producible at the top level (written by a global
+    op or its sub-block carries, persistable, or fed), and sequence feeds
+    need their @SEQLEN companion declared. The era-wire (serialized
+    protobuf) carrier rules live in `check_wire_carriers` below."""
+
+    name = "carriers"
+
+    def run(self, ctx):
+        gblock = ctx.program.global_block()
+        producible = set(ctx.feed_names)
+        for op in gblock.ops:
+            producible.update(n for ns in op.outputs.values()
+                              for n in ns if n)
+            # sub-block carries are written back into the top-level env
+            for key in ("carry_names", "out_names"):
+                val = op.attrs.get(key)
+                if isinstance(val, (list, tuple)):
+                    producible.update(n for n in val if n)
+            cond = op.inputs.get("Condition")
+            if op.type == "while" and cond:
+                producible.add(cond[0])
+        for name in ctx.fetch_names:
+            if name in producible:
+                continue
+            v = ctx.lookup(gblock, name)
+            if v is not None and v.persistable:
+                continue  # scope read (evaluator.eval pattern)
+            ctx.error(
+                "bad-fetch",
+                "fetch target %r is neither produced by the program, "
+                "persistable, nor fed" % name,
+                var_names=(name,),
+                hint="fetch a variable the program writes, or mark it "
+                     "persistable so it survives in the scope")
+        for name in sorted(ctx.feed_names):
+            v = ctx.lookup(gblock, name)
+            if v is None:
+                if not name.endswith("@SEQLEN"):
+                    ctx.warning(
+                        "unknown-feed",
+                        "fed variable %r is not declared in the program"
+                        % name, var_names=(name,))
+                continue
+            if v.lod_level > 0 and not getattr(v, "seq_len_var", None):
+                ctx.warning(
+                    "bad-carrier",
+                    "sequence feed %r (lod_level=%d) has no @SEQLEN "
+                    "lengths companion; only LoDTensor feeds will work"
+                    % (name, v.lod_level), var_names=(name,))
+
+
+def check_wire_carriers(blocks):
+    """Era-wire feed/fetch plumbing checks on a parsed ProgramDesc
+    (reference_format._parse_blocks output or raw protobuf bytes) —
+    the serialized-format half of CarrierPass, run by tools/pplint.py
+    BEFORE parse_program_desc strips the plumbing:
+
+      * the 'feed'/'fetch' carrier vars exist and are persistable
+        (the era C++ executor creates non-persistable vars in a per-run
+        LOCAL scope, so a non-persistable carrier shadows the outer-scope
+        one SetFeedVariable filled — reference_format.py's rule);
+      * feed/fetch op col attrs are unique and contiguous 0..n-1;
+      * every feed Out / fetch X names a declared variable.
+
+    Returns a list of Diagnostics (errors only)."""
+    from .. import reference_format as rf
+    if isinstance(blocks, (bytes, bytearray)):
+        blocks = rf._parse_blocks(blocks)
+    diags = []
+
+    def err(msg, var_names=()):
+        diags.append(Diagnostic(ERROR, "bad-carrier", msg, block_idx=0,
+                                var_names=var_names))
+
+    if not blocks:
+        return diags
+    _, _, varz, ops = blocks[0]
+    var_info = {name: (vtype, persistable)
+                for name, vtype, persistable in varz}
+    declared = set(var_info)
+    plumbing = [(t, ins, outs, attrs) for t, ins, outs, attrs in ops
+                if t in ("feed", "fetch")]
+    for carrier in ("feed", "fetch"):
+        n_ops = sum(1 for t, _, _, _ in plumbing if t == carrier)
+        if not n_ops:
+            continue
+        info = var_info.get(carrier)
+        if info is None:
+            err("%d %s op(s) but no %r carrier variable is declared"
+                % (n_ops, carrier, carrier), (carrier,))
+        elif not info[1]:
+            err("%r carrier variable is not persistable: the era executor "
+                "would shadow it with a per-run local-scope var and "
+                "%s data would be lost" % (carrier, carrier), (carrier,))
+    for carrier, slot in (("feed", "Out"), ("fetch", "X")):
+        cols = []
+        for t, ins, outs, attrs in plumbing:
+            if t != carrier:
+                continue
+            cols.append(attrs.get("col", len(cols)))
+            names = (outs if carrier == "feed" else ins).get(slot, [])
+            if not names:
+                err("%s op has no %s slot" % (carrier, slot))
+            elif names[0] not in declared:
+                err("%s op references undeclared variable %r"
+                    % (carrier, names[0]), (names[0],))
+        if cols and sorted(cols) != list(range(len(cols))):
+            err("%s op col attrs %r are not contiguous 0..%d"
+                % (carrier, sorted(cols), len(cols) - 1))
+    return diags

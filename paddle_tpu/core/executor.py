@@ -312,6 +312,10 @@ def run_host_io_prepass(program, scope, feed_arrays, host=False,
                     raise
         elif readers.is_host_io_op(op.type):
             if steps > 1:
+                # an earlier read op may already have popped its K-block;
+                # this refusal must consume nothing anywhere, like every
+                # other multi-step failure
+                _rollback()
                 raise RuntimeError(
                     "program contains host io op %r in its main block: "
                     "with steps=%d it would run once per CALL, not once "
@@ -340,16 +344,56 @@ def _array_safety_enabled():
 
 def _raise_program_errors(errors):
     """Raise on tripped in-graph assertion flags (one host sync of the
-    combined '__any__' scalar in the common clean case). jit returns dicts
-    in sorted-key order, so prefer the message that names a variable over
-    the generic sub-block one when both tripped."""
+    combined '__any__' scalar in the common clean case). ALL tripped
+    flags are reported, not just the first: a K-step run can trip several
+    independent assertions and fixing them one raise at a time wastes a
+    full compile+run each round. Messages that name a variable sort
+    before the generic sub-block one so the most actionable line leads."""
     if not errors or not bool(errors["__any__"]):
         return
     tripped = [msg for msg, flag in errors.items()
                if msg != "__any__" and bool(flag)]
-    if tripped:
-        named = [m for m in tripped if m.startswith("tensor array '")]
-        raise RuntimeError((named or tripped)[0])
+    if not tripped:
+        return
+    named = [m for m in tripped if m.startswith("tensor array '")]
+    generic = [m for m in tripped if not m.startswith("tensor array '")]
+    ordered = named + generic
+    if len(ordered) == 1:
+        raise RuntimeError(ordered[0])
+    raise RuntimeError(
+        "%d in-graph assertions tripped in this run:\n- %s"
+        % (len(ordered), "\n- ".join(ordered)))
+
+
+def _validate_program_flag():
+    """FLAGS_validate_program: strict mode — every program is statically
+    verified (paddle_tpu/analysis) before its first lowering; analyzer
+    ERRORS raise ProgramVerificationError instead of surfacing later as
+    opaque trace/XLA failures. Same resolution style as
+    FLAGS_check_nan_inf; Executor.run(validate=...) overrides per call."""
+    return os.environ.get("FLAGS_validate_program", "") not in (
+        "", "0", "false", "False")
+
+
+def maybe_validate_program(program, feed_arrays, fetch_names, steps,
+                           cache, validate=None):
+    """Shared strict-mode gate for Executor.run and ParallelExecutor.run:
+    resolve the validate setting (explicit arg wins over the env flag),
+    run the static analyzer once per (program version, feed/fetch
+    signature, multi-step) — `cache` is the caller's set — and raise
+    ProgramVerificationError on findings. Must run BEFORE the io
+    pre-pass: a raise here consumes no reader records."""
+    if not (_validate_program_flag() if validate is None
+            else bool(validate)):
+        return
+    vkey = (program._uid, program._version, tuple(sorted(feed_arrays)),
+            tuple(fetch_names), steps > 1)
+    if vkey in cache:
+        return
+    from ..analysis import validate_or_raise
+    validate_or_raise(program, feed_names=list(feed_arrays),
+                      fetch_names=fetch_names, steps=steps)
+    cache.add(vkey)
 
 
 def _nan_inf_enabled(flag):
@@ -416,10 +460,11 @@ class Executor(object):
         self._cache = collections.OrderedDict()
         self._check_nan_inf = _nan_inf_enabled(check_nan_inf)
         self._array_safety = _array_safety_enabled()
+        self._validated = set()  # (uid, version, feeds, fetches, multi)
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, steps=1,
-            fetch_reduce="stack"):
+            fetch_reduce="stack", validate=None):
         """Run `program` once — or, with steps=K > 1, K times inside ONE
         device-resident lax.scan dispatch: params/optimizer state stay
         donated on device across the K steps and the host syncs once per
@@ -431,7 +476,16 @@ class Executor(object):
 
         return_numpy=False returns FetchHandle objects (device-resident,
         non-blocking): materialize with np.asarray(h) / h.numpy() when the
-        value is actually needed."""
+        value is actually needed.
+
+        validate=True runs the static analyzer (paddle_tpu/analysis) over
+        the program BEFORE lowering — use-before-def, shape/dtype
+        consistency, unregistered ops, reader placement — and raises
+        ProgramVerificationError on findings, pointing at the layer call
+        that built the bad op. Default None defers to the
+        FLAGS_validate_program env flag; validation is cached per
+        (program version, feed/fetch signature) so steady-state runs pay
+        nothing."""
         if program is None:
             program = default_main_program()
         feed = feed or {}
@@ -446,6 +500,9 @@ class Executor(object):
 
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
         feed_arrays = convert_feeds(program, feed)
+
+        maybe_validate_program(program, feed_arrays, fetch_names, steps,
+                               self._validated, validate=validate)
 
         stacked_names = set()
         run_host_io_prepass(program, scope, feed_arrays, steps=steps,

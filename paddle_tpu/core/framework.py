@@ -11,13 +11,45 @@ the hot path); `Program.to_string` provides the debug/serialization surface.
 import contextlib
 import copy
 import itertools
+import os
 import re
+import sys
 
 import numpy as np
 
 from . import unique_name
 
 GRAD_SUFFIX = "@GRAD"
+
+# the paddle_tpu package directory: frames inside it are framework
+# internals, filtered out of recorded op creation stacks
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + os.sep
+
+
+def _op_callstack(limit=4):
+    """Python creation site of an Operator: up to `limit` frames of the
+    USER code that (transitively) appended the op, innermost first —
+    frames inside the paddle_tpu package are skipped so diagnostics point
+    at the layer CALL, not framework internals (parity: the reference's
+    op_callstack attr, framework.py Operator.__init__). Raw
+    (filename, lineno, function) triples — no source lines are read here,
+    keeping op creation cheap; core.utils.format_callstack renders them
+    lazily. FLAGS_op_callstack=0 disables recording entirely."""
+    if os.environ.get("FLAGS_op_callstack", "1") in ("0", "false", "False"):
+        return ()
+    try:
+        f = sys._getframe(1)
+    except ValueError:  # pragma: no cover - no caller frame
+        return ()
+    frames = []
+    while f is not None and len(frames) < limit:
+        code = f.f_code
+        filename = code.co_filename
+        if not filename.startswith(_PKG_DIR) and \
+                "importlib" not in filename:
+            frames.append((filename, f.f_lineno, code.co_name))
+        f = f.f_back
+    return tuple(frames)
 
 _dtype_aliases = {
     "float32": "float32",
@@ -142,6 +174,10 @@ class Operator(object):
         # uids no matter what other programs were created before it, so
         # random inits are reproducible across processes and test orderings.
         self.uid = block.program._next_op_uid()
+        # user-code frames that created this op (the reference's
+        # op_callstack): analyzer diagnostics and lowering-time errors
+        # point here instead of at framework internals
+        self.callstack = _op_callstack()
         self.inputs = {}   # slot -> [var name]
         self.outputs = {}  # slot -> [var name]
         self.attrs = dict(attrs) if attrs else {}

@@ -179,6 +179,13 @@ class _Lazy(object):
         self.fn = fn
 
 
+class EnvReadError(KeyError):
+    """Env.read miss: a variable read before anything wrote it.
+    Subclasses KeyError so existing broad handlers keep working, while
+    lower_op can convert exactly THIS failure (and no other KeyError)
+    into a readable annotated RuntimeError."""
+
+
 class Env(object):
     """Name -> traced value mapping for one lowering pass."""
 
@@ -187,8 +194,8 @@ class Env(object):
 
     def read(self, name):
         if name not in self.values:
-            raise KeyError("variable %r read before it was written; "
-                           "is it fed / initialized?" % name)
+            raise EnvReadError("variable %r read before it was written; "
+                               "is it fed / initialized?" % name)
         v = self.values[name]
         if isinstance(v, _Lazy):
             v = v.fn()
@@ -384,7 +391,48 @@ def accumulate_error(env, flag):
     env.write(PROGRAM_ERR, flag if cur is None else cur | flag)
 
 
+def _annotate_op_error(e, op):
+    """Append the failing op's identity and Python creation site
+    (Operator.callstack — the reference's op_callstack attr) to a
+    lowering-time exception, so errors escaping the trace point at the
+    user's layer call instead of framework internals. Mutates the
+    exception's message in place (type preserved); nested lower_op
+    frames (sub-block bodies) each add one line, capped so a deep op
+    stack can't bury the original message."""
+    noted = getattr(e, "_op_notes", 0)
+    if noted >= 3 or not e.args or not isinstance(e.args[0], str):
+        return
+    from .utils import format_callstack
+    note = "\n  [while lowering op %r (uid %d)" % (op.type, op.uid)
+    cs = getattr(op, "callstack", ())
+    if cs and noted == 0:
+        note += ", created at:\n%s]" % format_callstack(cs, prefix="    ")
+    else:
+        note += "]"
+    e.args = (e.args[0] + note,) + e.args[1:]
+    e._op_notes = noted + 1
+
+
 def lower_op(ctx, op, env):
+    try:
+        _lower_op_inner(ctx, op, env)
+    except EnvReadError as e:
+        # str(KeyError) reprs its arg, which would render the multi-line
+        # creation-site note as literal \n escapes — re-raise the
+        # flagship Env.read failure (and ONLY it; ordinary KeyErrors from
+        # rules keep their type) as RuntimeError, chained so the original
+        # stays inspectable, and annotate THAT readably
+        if not (e.args and isinstance(e.args[0], str)):
+            raise
+        err = RuntimeError(e.args[0])
+        _annotate_op_error(err, op)
+        raise err from e
+    except Exception as e:
+        _annotate_op_error(e, op)
+        raise
+
+
+def _lower_op_inner(ctx, op, env):
     if op.type in _SPECIAL:
         _SPECIAL[op.type](ctx, op, env)
         return

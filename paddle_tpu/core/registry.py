@@ -61,10 +61,22 @@ def register(type, lower=None, infer=None, uses_rng=False):
     return deco
 
 
+def suggest(type, n=3):
+    """Registered op names close to `type` (difflib), best match first.
+    Shared by `get`'s error message and the analyzer's unregistered-op
+    diagnostic so both always agree on the hint."""
+    import difflib
+    return difflib.get_close_matches(type, sorted(_OPS), n=n, cutoff=0.6)
+
+
 def get(type):
     od = _OPS.get(type)
     if od is None:
-        raise NotImplementedError("op %r has no registered TPU lowering" % type)
+        close = suggest(type)
+        raise NotImplementedError(
+            "op %r has no registered TPU lowering%s" %
+            (type, ("; did you mean %s?" %
+                    " / ".join(repr(c) for c in close)) if close else ""))
     return od
 
 
@@ -114,6 +126,70 @@ def _struct_for(var, idx=0):
     return jax.ShapeDtypeStruct(shape, np.dtype(var.dtype))
 
 
+def abstract_eval(block, op):
+    """READ-ONLY dual-sentinel abstract evaluation of a registered op.
+
+    Runs the op's lowering rule under jax.eval_shape twice (BATCH_SENTINEL /
+    BATCH_SENTINEL_B) and maps sentinel-tracking dims back to -1 — the same
+    machinery `infer_and_set_shapes` uses at build time, factored out so the
+    static analyzer (paddle_tpu/analysis) can re-derive output shapes/dtypes
+    WITHOUT mutating any Variable and compare them against the declared ones.
+
+    Returns {slot: [entry | None]} for the op's declared output slots, each
+    entry (public_shape_with_-1, (shape_a, shape_b), dtype_name), or None
+    when the op can't be evaluated this way (unregistered, custom `infer`,
+    un-inferable input, or the rule raising under eval_shape).
+    """
+    if not is_registered(op.type):
+        return None
+    od = get(op.type)
+    if od.infer is not None:
+        return None  # custom infer mutates vars; not re-runnable read-only
+    import jax
+    try:
+        ins = {}
+        ins_b = {}
+        has_dynamic = False
+        for slot, names in op.inputs.items():
+            vars_ = [block.var_recursive(n) for n in names]
+            structs = [_struct_for(v) for v in vars_]
+            if any(s is None for s in structs):
+                return None  # un-inferable input
+            has_dynamic = has_dynamic or any(
+                -1 in (v.shape or ()) for v in vars_)
+            ins[slot] = structs
+            ins_b[slot] = [_struct_for(v, 1) for v in vars_]
+        ctx = AbstractCtx()
+        outs = jax.eval_shape(lambda i: od.lower(ctx, i, op.attrs), ins)
+        # second pass under a different sentinel: output dims that move with
+        # the sentinel are batch-derived (incl. folded products like
+        # [-1, K] -> [-1*K]); dims that agree are genuinely static
+        outs_b = jax.eval_shape(lambda i: od.lower(ctx, i, op.attrs),
+                                ins_b) if has_dynamic else outs
+        result = {}
+        for slot, structs in outs.items():
+            # slots the rule emits beyond the op's declared outputs
+            # (__errors__ flags, optional outs) carry no var to compare
+            if slot not in op.outputs or not isinstance(structs,
+                                                        (list, tuple)):
+                continue
+            structs_b = outs_b.get(slot, structs) if has_dynamic else structs
+            entries = []
+            for st, st_b in zip(structs, structs_b):
+                if st is None:
+                    entries.append(None)
+                    continue
+                sa = tuple(int(d) for d in st.shape)
+                sb = tuple(int(d) for d in st_b.shape)
+                public = tuple(-1 if d != db else d
+                               for d, db in zip(sa, sb))
+                entries.append((public, (sa, sb), np.dtype(st.dtype).name))
+            result[slot] = entries
+        return result
+    except Exception:
+        return None  # inference is best-effort; lowering gives real errors
+
+
 def infer_and_set_shapes(block, op):
     """Set output Variable shapes/dtypes by abstractly evaluating the lowering.
 
@@ -128,44 +204,17 @@ def infer_and_set_shapes(block, op):
     if od.infer is not None:
         od.infer(block, op, out_vars)
         return
-    import jax
-    try:
-        ins = {}
-        ins_b = {}
-        has_dynamic = False
-        for slot, names in op.inputs.items():
-            vars_ = [block.var_recursive(n) for n in names]
-            structs = [_struct_for(v) for v in vars_]
-            if any(s is None for s in structs):
-                return  # un-inferable input; leave outputs as declared
-            has_dynamic = has_dynamic or any(
-                -1 in (v.shape or ()) for v in vars_)
-            ins[slot] = structs
-            ins_b[slot] = [_struct_for(v, 1) for v in vars_]
-        ctx = AbstractCtx()
-        outs = jax.eval_shape(lambda i: od.lower(ctx, i, op.attrs), ins)
-        # second pass under a different sentinel: output dims that move with
-        # the sentinel are batch-derived (incl. folded products like
-        # [-1, K] -> [-1*K]); dims that agree are genuinely static
-        outs_b = jax.eval_shape(lambda i: od.lower(ctx, i, op.attrs),
-                                ins_b) if has_dynamic else outs
-    except Exception:
-        return  # inference is best-effort; executor lowering gives real errors
-    for slot, structs in outs.items():
-        if slot not in out_vars:
-            continue
-        structs_b = outs_b.get(slot, structs) if has_dynamic else structs
-        for var, st, st_b in zip(out_vars[slot], structs, structs_b):
-            if st is None:
+    res = abstract_eval(block, op)
+    if res is None:
+        return
+    for slot, entries in res.items():
+        for var, entry in zip(out_vars[slot], entries):
+            if entry is None:
                 continue
-            var.shape = tuple(
-                -1 if int(d) != int(db) else int(d)
-                for d, db in zip(st.shape, st_b.shape))
+            public, (shape_a, shape_b), dtype = entry
+            var.shape = public
             # keep the exact sentinel shapes for downstream inference (a -1
             # re-substitution would lose folded batch products); the public
             # snapshot invalidates the record if anything reassigns shape
-            var._abstract_shapes = (
-                tuple(int(d) for d in st.shape),
-                tuple(int(d) for d in st_b.shape),
-                var.shape)
-            var.dtype = np.dtype(st.dtype).name
+            var._abstract_shapes = (shape_a, shape_b, var.shape)
+            var.dtype = dtype

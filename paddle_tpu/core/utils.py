@@ -8,6 +8,22 @@ def pair(v):
     return (int(v), int(v))
 
 
+def format_callstack(frames, prefix="    "):
+    """Render Operator.callstack frames ((filename, lineno, function)
+    triples, innermost first) traceback-style. Source lines load lazily
+    via linecache — recording stays cheap, formatting pays only when an
+    error/diagnostic is actually shown."""
+    import linecache
+    lines = []
+    for filename, lineno, func in frames:
+        lines.append('%sFile "%s", line %d, in %s'
+                     % (prefix, filename, lineno, func))
+        src = linecache.getline(filename, lineno).strip()
+        if src:
+            lines.append(prefix + "  " + src)
+    return "\n".join(lines)
+
+
 def find_var(program, name):
     """Look a var up across all blocks of a program (None if absent)."""
     for block in program.blocks:

@@ -177,6 +177,14 @@ def _monkey_patch_reader_methods(reader_var):
 
 
 def _create_reader_var(op_type, inputs, attrs, shapes, dtypes, lod_levels):
+    # catch the ragged-spec mistake at BUILD time: read_file silently zips
+    # the three lists, so a shapes/dtypes length mismatch would truncate
+    # reader fields and only surface as a record-arity error mid-training
+    if not (len(shapes) == len(dtypes) == len(lod_levels)):
+        raise ValueError(
+            "%s: shapes (%d), dtypes (%d) and lod_levels (%d) must "
+            "describe the same number of reader fields"
+            % (op_type, len(shapes), len(dtypes), len(lod_levels)))
     name = unique_name.generate(op_type)
     startup_blk = default_startup_program().current_block()
     startup_var = startup_blk.create_var(name=name, persistable=True,

@@ -61,6 +61,7 @@ class ParallelExecutor(object):
                  sharded_weight_update=False):
         self._program = main_program if main_program is not None \
             else default_main_program()
+        self._validated = set()  # strict-mode analysis cache (see run)
         self.mesh = mesh if mesh is not None else data_parallel_mesh(
             devices=devices)
         # param name -> PartitionSpec for model/tensor parallelism; anything
@@ -179,6 +180,12 @@ class ParallelExecutor(object):
                              % (lowering.FETCH_REDUCE_POLICIES, fetch_reduce))
 
         feed_arrays = convert_feeds(program, feed, host=True)
+
+        # strict mode (FLAGS_validate_program): same pre-lowering static
+        # verification Executor.run performs
+        from ..core.executor import maybe_validate_program
+        maybe_validate_program(program, feed_arrays, fetch_names, steps,
+                               self._validated)
 
         def _batch_leading(name):
             return _var_batch_leading(_find_var(program, name))

@@ -510,9 +510,19 @@ def adapt_sequence_layout(program, feed_names):
     for name, ln in seqlen.items():
         v = block.vars.get(name)
         if v is not None:
+            # seq_len_var already pointing at the companion means this var
+            # was adapted by a previous call — don't bump its rank twice
+            already = getattr(v, "seq_len_var", None) == ln
             if not getattr(v, "lod_level", 0):
                 v.lod_level = 1
             v.seq_len_var = ln
+            # the era DECLARED this var flat ([total_rows, ...]); it now
+            # holds the padded layout ([num_seqs, max_len, ...]) — keep
+            # the declaration truthful so padded-array feeds pass
+            # convert_feeds' rank check and the static analyzer's shape
+            # re-inference matches what the lowering actually produces
+            if v.shape is not None and not already:
+                v.shape = (-1, -1) + tuple(v.shape[1:])
     return program
 
 

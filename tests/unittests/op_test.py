@@ -53,6 +53,12 @@ def run_op(op_type, inputs, attrs=None, out_slots=("Out",), n_outputs=None,
             loss = fluid.layers.mean(x=total)
             fluid.append_backward(loss)
             fetch += ["%s_0@GRAD" % s.lower() for s in fetch_grads]
+    # every op test statically verifies its program for free: a lowering
+    # rule whose eval_shape disagrees with the declared shapes, or a
+    # harness wiring bug, fails HERE with a pointed diagnostic instead of
+    # an opaque trace error inside exe.run
+    fluid.analysis.validate_or_raise(main, feed_names=list(feed),
+                                     fetch_names=fetch)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.Scope()
     with fluid.scope_guard(scope):

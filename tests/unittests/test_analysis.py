@@ -1,0 +1,586 @@
+"""Static program verifier (paddle_tpu/analysis).
+
+Three legs: (1) ZERO FALSE POSITIVES — the analyzer must come back clean
+on every program the fuzzer generates and on real book-style models;
+(2) a seeded corpus of known-bad programs it MUST flag, one per
+diagnostic class; (3) the wiring — Executor strict mode,
+FLAGS_validate_program, the op_test harness, op callstacks, and the
+tools/pplint.py CLI over saved-model round-trips (native desc and
+era-wire protobuf — the deserialize -> analyze path).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis
+from paddle_tpu.core import registry
+
+from test_program_fuzz import _build_random
+
+L = fluid.layers
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+PPLINT = os.path.join(REPO, "tools", "pplint.py")
+
+
+def _codes(result):
+    return {d.code for d in result}
+
+
+def _error_codes(result):
+    return {d.code for d in result.errors}
+
+
+# ---------------------------------------------------------------------------
+# zero false positives on valid programs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(30))
+def test_fuzz_programs_no_false_positives(seed):
+    """Every test_program_fuzz random DAG (forward + backward) analyzes
+    with zero errors."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x, loss = _build_random(seed)
+        fluid.append_backward(loss)
+    r = analysis.analyze(main, feed_names=["x"],
+                         fetch_names=[loss.name, "x@GRAD"])
+    assert not r.errors, r.format()
+    rs = analysis.analyze(startup)
+    assert not rs.errors, rs.format()
+
+
+def test_fit_a_line_program_clean():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[13], dtype="float32")
+        y = L.data(name="y", shape=[1], dtype="float32")
+        pred = L.fc(input=x, size=1)
+        cost = L.square_error_cost(input=pred, label=y)
+        loss = L.mean(x=cost)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    r = analysis.analyze(main, feed_names=["x", "y"],
+                         fetch_names=[loss.name])
+    assert not r.errors, r.format()
+    assert not r.warnings, r.format()
+    rs = analysis.analyze(startup)
+    assert not rs.errors and not rs.warnings, rs.format()
+
+
+def test_image_model_program_clean():
+    from paddle_tpu.models import image_classification
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        image, label, avg_cost, acc = image_classification.build_train(
+            model="resnet20", class_dim=4, image_shape=(3, 32, 32),
+            learning_rate=0.05)
+    r = analysis.analyze(main, feed_names=["image", "label"],
+                         fetch_names=[avg_cost.name, acc.name])
+    assert not r.errors, r.format()
+    rs = analysis.analyze(startup)
+    assert not rs.errors, rs.format()
+
+
+def test_while_and_sequence_programs_clean():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[4], dtype="float32")
+        i = L.fill_constant(shape=[1], dtype="int64", value=0)
+        n = L.fill_constant(shape=[1], dtype="int64", value=3)
+        acc = L.fill_constant(shape=[1, 4], dtype="float32", value=0.0)
+        state = L.elementwise_add(acc, x)
+        cond = L.less_than(x=i, y=n)
+        w = L.While(cond=cond)
+        with w.block():
+            v = L.tanh(x=state)
+            L.assign(v, state)
+            L.increment(x=i, value=1, in_place=True)
+            L.less_than(x=i, y=n, cond=cond)
+    r = analysis.analyze(main, feed_names=["x"],
+                         fetch_names=[state.name, i.name])
+    assert not r.errors, r.format()
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main2, startup2):
+        xs = L.data(name="xs", shape=[4], dtype="float32", lod_level=1)
+        out = L.sequence_pool(input=L.tanh(x=xs), pool_type="sum")
+    r2 = analysis.analyze(main2, feed_names=["xs"],
+                          fetch_names=[out.name])
+    assert not r2.errors, r2.format()
+
+
+# ---------------------------------------------------------------------------
+# seeded known-bad corpus: each builder returns
+#   (program, feed_names, fetch_names, steps, expected_code, is_error)
+# ---------------------------------------------------------------------------
+
+def _bad_use_before_def():
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="o", shape=[2, 2], dtype="float32")
+    b.append_op(type="relu", inputs={"X": ["ghost"]},
+                outputs={"Out": ["o"]}, infer_shape=False)
+    return p, [], ["o"], 1, "use-before-def", True
+
+
+def _bad_read_order():
+    # 'b' is declared and eventually written, but op 0 reads it first
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="a", shape=[2], dtype="float32", is_data=True)
+    blk.create_var(name="b", shape=[2], dtype="float32")
+    blk.create_var(name="o", shape=[2], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["b"]},
+                  outputs={"Out": ["o"]}, infer_shape=False)
+    blk.append_op(type="relu", inputs={"X": ["a"]},
+                  outputs={"Out": ["b"]}, infer_shape=False)
+    return p, ["a"], ["o"], 1, "use-before-def", True
+
+
+def _bad_cross_block_capture():
+    p = fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(p,
+                                                        fluid.Program()):
+        x = L.data(name="x", shape=[4], dtype="float32")
+        i = L.fill_constant(shape=[1], dtype="int64", value=0)
+        n = L.fill_constant(shape=[1], dtype="int64", value=2)
+        state = L.elementwise_add(
+            L.fill_constant(shape=[1, 4], dtype="float32", value=0.0), x)
+        cond = L.less_than(x=i, y=n)
+        w = L.While(cond=cond)
+        with w.block():
+            blk = p.current_block()
+            blk.append_op(type="relu", inputs={"X": ["phantom_var"]},
+                          outputs={"Out": [state]}, infer_shape=False)
+            L.increment(x=i, value=1, in_place=True)
+            L.less_than(x=i, y=n, cond=cond)
+    return p, ["x"], [state.name], 1, "use-before-def", True
+
+
+def _bad_while_carry():
+    p = fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(p,
+                                                        fluid.Program()):
+        x = L.data(name="x", shape=[4], dtype="float32")
+        i = L.fill_constant(shape=[1], dtype="int64", value=0)
+        n = L.fill_constant(shape=[1], dtype="int64", value=2)
+        carry = p.global_block().create_var(
+            name="uninit_carry", shape=[1, 4], dtype="float32")
+        cond = L.less_than(x=i, y=n)
+        w = L.While(cond=cond)
+        with w.block():
+            L.assign(L.tanh(x=x), carry)
+            L.increment(x=i, value=1, in_place=True)
+            L.less_than(x=i, y=n, cond=cond)
+    return p, ["x"], [carry.name], 1, "use-before-def", True
+
+
+def _bad_dead_write():
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="c", shape=[2], dtype="float32")
+    for val in (1.0, 2.0):
+        blk.append_op(type="fill_constant", outputs={"Out": ["c"]},
+                      attrs={"shape": [2], "dtype": "float32",
+                             "value": val}, infer_shape=False)
+    return p, [], ["c"], 1, "dead-write", False
+
+
+def _bad_dead_op():
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[2], dtype="float32", is_data=True)
+    blk.create_var(name="dead", shape=[2], dtype="float32")
+    blk.create_var(name="live", shape=[2], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["x"]},
+                  outputs={"Out": ["dead"]}, infer_shape=False)
+    blk.append_op(type="tanh", inputs={"X": ["x"]},
+                  outputs={"Out": ["live"]}, infer_shape=False)
+    return p, ["x"], ["live"], 1, "dead-op", False
+
+
+def _bad_unused_var():
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[2], dtype="float32", is_data=True)
+    blk.create_var(name="nobody", shape=[3], dtype="float32")
+    blk.create_var(name="o", shape=[2], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["x"]},
+                  outputs={"Out": ["o"]}, infer_shape=False)
+    return p, ["x"], ["o"], 1, "unused-var", False
+
+
+def _bad_dtype():
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[2, 3], dtype="float32", is_data=True)
+    blk.create_var(name="o", shape=[2, 3], dtype="int32")
+    blk.append_op(type="relu", inputs={"X": ["x"]},
+                  outputs={"Out": ["o"]}, infer_shape=False)
+    return p, ["x"], ["o"], 1, "dtype-mismatch", True
+
+
+def _bad_shape():
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[2, 3], dtype="float32", is_data=True)
+    blk.create_var(name="w", shape=[3, 4], dtype="float32", is_data=True)
+    blk.create_var(name="o", shape=[2, 7], dtype="float32")  # is [2, 4]
+    blk.append_op(type="mul", inputs={"X": ["x"], "Y": ["w"]},
+                  outputs={"Out": ["o"]}, infer_shape=False)
+    return p, ["x", "w"], ["o"], 1, "shape-mismatch", True
+
+
+def _bad_rank():
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[2, 3], dtype="float32", is_data=True)
+    blk.create_var(name="o", shape=[2, 3, 1], dtype="float32")
+    blk.append_op(type="tanh", inputs={"X": ["x"]},
+                  outputs={"Out": ["o"]}, infer_shape=False)
+    return p, ["x"], ["o"], 1, "shape-mismatch", True
+
+
+def _bad_unregistered():
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[2], dtype="float32", is_data=True)
+    blk.create_var(name="o", shape=[2], dtype="float32")
+    blk.append_op(type="frobnicate", inputs={"X": ["x"]},
+                  outputs={"Out": ["o"]}, infer_shape=False)
+    return p, ["x"], ["o"], 1, "unregistered-op", True
+
+
+def _bad_grad_fwd():
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[2], dtype="float32", is_data=True)
+    blk.create_var(name="x@GRAD", shape=[2], dtype="float32")
+    blk.append_op(type="grad_of", inputs={"X": ["x"]},
+                  outputs={"Out": ["x@GRAD"]},
+                  attrs={"fwd_type": "frobnicate", "fwd_attrs": {},
+                         "fwd_inputs": {"X": ["x"]},
+                         "fwd_outputs": {"Out": ["x"]}},
+                  infer_shape=False)
+    return p, ["x"], ["x@GRAD"], 1, "unregistered-op", True
+
+
+def _bad_reader_subblock():
+    p = fluid.Program()
+    gblk = p.global_block()
+    rv = gblk.create_var(name="rdr", persistable=True)
+    sub = p.create_block()
+    sub.create_var(name="rec", shape=[-1, 4], dtype="float32")
+    sub.append_op(type="read", inputs={"Reader": ["rdr"]},
+                  outputs={"Out": ["rec"]}, infer_shape=False)
+    p.rollback()
+    return p, [], [], 1, "reader-placement", True
+
+
+def _bad_reader_multistep():
+    p = fluid.Program()
+    blk = p.global_block()
+    rv = blk.create_var(name="rdr", persistable=True)
+    blk.append_op(type="create_double_buffer_reader",
+                  inputs={"UnderlyingReader": ["rdr"]},
+                  outputs={"Out": ["rdr2"]}, attrs={"capacity": 2},
+                  infer_shape=False)
+    blk.create_var(name="rdr2", persistable=True)
+    return p, [], [], 4, "reader-placement", True
+
+
+def _bad_fetch():
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[2], dtype="float32", is_data=True)
+    blk.create_var(name="o", shape=[2], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["x"]},
+                  outputs={"Out": ["o"]}, infer_shape=False)
+    return p, ["x"], ["nonexistent_fetch"], 1, "bad-fetch", True
+
+
+def _bad_carrier_hazard():
+    # persistable var read inside the loop body, first written AFTER the
+    # loop: analyze_state (block-order walk) classifies it write-only,
+    # so the scan carry would start from zeros
+    p = fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(p,
+                                                        fluid.Program()):
+        x = L.data(name="x", shape=[4], dtype="float32")
+        pvar = p.global_block().create_var(
+            name="pstate", shape=[1, 4], dtype="float32", persistable=True)
+        i = L.fill_constant(shape=[1], dtype="int64", value=0)
+        n = L.fill_constant(shape=[1], dtype="int64", value=2)
+        state = L.elementwise_add(
+            L.fill_constant(shape=[1, 4], dtype="float32", value=0.0), x)
+        cond = L.less_than(x=i, y=n)
+        w = L.While(cond=cond)
+        with w.block():
+            L.assign(L.elementwise_add(state, pvar), state)
+            L.increment(x=i, value=1, in_place=True)
+            L.less_than(x=i, y=n, cond=cond)
+        # first (and only) write to pvar comes after the loop
+        L.fill_constant(shape=[1, 4], dtype="float32", value=0.0, out=pvar)
+    return p, ["x"], [state.name], 1, "carrier-hazard", True
+
+
+_BAD_CORPUS = [
+    _bad_use_before_def, _bad_read_order, _bad_cross_block_capture,
+    _bad_while_carry, _bad_dead_write, _bad_dead_op, _bad_unused_var,
+    _bad_dtype, _bad_shape, _bad_rank, _bad_unregistered, _bad_grad_fwd,
+    _bad_reader_subblock, _bad_reader_multistep, _bad_fetch,
+    _bad_carrier_hazard,
+]
+
+
+@pytest.mark.parametrize("builder", _BAD_CORPUS,
+                         ids=[f.__name__ for f in _BAD_CORPUS])
+def test_known_bad_corpus_flagged(builder):
+    program, feeds, fetches, steps, code, is_error = builder()
+    r = analysis.analyze(program, feed_names=feeds, fetch_names=fetches,
+                         steps=steps)
+    assert code in _codes(r), \
+        "expected %s in:\n%s" % (code, r.format())
+    if is_error:
+        assert code in _error_codes(r), r.format()
+
+
+def test_uninitialized_while_carry_reported_once():
+    """The While op also lists carries in its X slot — one defect must
+    produce ONE diagnostic (the carry-specific one), not two."""
+    program, feeds, fetches, _, _, _ = _bad_while_carry()
+    r = analysis.analyze(program, feed_names=feeds, fetch_names=fetches)
+    assert len(r.errors) == 1, r.format()
+    assert "While loop carries" in r.errors[0].message
+
+
+# ---------------------------------------------------------------------------
+# wiring: Executor strict mode, flag, callstacks, registry hints
+# ---------------------------------------------------------------------------
+
+def _bad_program_for_exec():
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="a", shape=[2, 2], dtype="float32", is_data=True)
+    blk.create_var(name="o", shape=[2, 2], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["ghost"]},
+                  outputs={"Out": ["o"]}, infer_shape=False)
+    return p
+
+
+def test_executor_validate_raises_before_lowering():
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(fluid.ProgramVerificationError,
+                           match="use-before-def"):
+            exe.run(_bad_program_for_exec(),
+                    feed={"a": np.zeros((2, 2), "f")}, fetch_list=["o"],
+                    validate=True)
+
+
+def test_executor_validate_flag(monkeypatch):
+    monkeypatch.setenv("FLAGS_validate_program", "1")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(fluid.ProgramVerificationError):
+            exe.run(_bad_program_for_exec(),
+                    feed={"a": np.zeros((2, 2), "f")}, fetch_list=["o"])
+
+
+def test_executor_validate_clean_program_runs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[3], dtype="float32")
+        out = L.tanh(x=x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup, validate=True)
+        xv = np.ones((2, 3), "f")
+        for _ in range(2):  # second run hits the validation cache
+            got, = exe.run(main, feed={"x": xv}, fetch_list=[out],
+                           validate=True)
+        np.testing.assert_allclose(got, np.tanh(xv), rtol=1e-6)
+
+
+def test_lowering_error_names_op_and_callsite():
+    """Without validation, the trace-time error must still point at the
+    op and its creation site (the op_callstack satellite)."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(Exception, match="while lowering op"):
+            # validate=False: reach the lowering even under
+            # FLAGS_validate_program=1 (which would raise first)
+            exe.run(_bad_program_for_exec(),
+                    feed={"a": np.zeros((2, 2), "f")}, fetch_list=["o"],
+                    validate=False)
+
+
+def test_op_callstack_points_at_user_code():
+    p = fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(p, fluid.Program()):
+        x = L.data(name="x", shape=[3], dtype="float32")
+        L.tanh(x=x)
+    op = p.global_block().ops[-1]
+    assert op.callstack, "callstack not recorded"
+    filename, lineno, func = op.callstack[0]
+    assert filename == os.path.abspath(__file__), op.callstack
+    assert func == "test_op_callstack_points_at_user_code"
+
+
+def test_op_callstack_flag_disables(monkeypatch):
+    monkeypatch.setenv("FLAGS_op_callstack", "0")
+    p = fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(p, fluid.Program()):
+        x = L.data(name="x", shape=[3], dtype="float32")
+        L.tanh(x=x)
+    assert p.global_block().ops[-1].callstack == ()
+
+
+def test_registry_get_suggests_close_names():
+    with pytest.raises(NotImplementedError, match="relu"):
+        registry.get("reluu")
+    # no suggestion when nothing is close
+    with pytest.raises(NotImplementedError):
+        registry.get("zzqqxxyy_nothing_like_this")
+
+
+def test_raise_program_errors_aggregates_all():
+    from paddle_tpu.core import executor as ex
+    m1 = "tensor array 'arr' overflowed its capacity 4 inside traced"
+    m2 = ("a tensor array confined to a loop/conditional sub-block "
+          "overflowed")
+    errors = {"__any__": np.True_, m1: np.True_, m2: np.True_}
+    with pytest.raises(RuntimeError) as ei:
+        ex._raise_program_errors(errors)
+    s = str(ei.value)
+    assert m1 in s and m2 in s and "2 in-graph assertions" in s
+    # single tripped flag keeps the bare-message form
+    with pytest.raises(RuntimeError) as ei:
+        ex._raise_program_errors({"__any__": np.True_, m1: np.True_,
+                                  m2: np.False_})
+    assert str(ei.value) == m1
+
+
+def test_op_test_harness_validates():
+    """The op_test harness rejects a harness-level wiring bug via the
+    analyzer (unregistered op) rather than an opaque trace error."""
+    import op_test
+    with pytest.raises(fluid.ProgramVerificationError,
+                       match="unregistered-op"):
+        op_test.run_op("not_a_real_op_type",
+                       {"X": np.ones((2, 2), "f")})
+
+
+# ---------------------------------------------------------------------------
+# era-wire carrier checks (synthetic parsed blocks)
+# ---------------------------------------------------------------------------
+
+def _wire_blocks(feed_persistable=True, cols=(0,), declare_target=True):
+    varz = [("feed", (9, None, None, 0), feed_persistable),
+            ("fetch", (10, None, None, 0), True)]
+    if declare_target:
+        varz.append(("x", (7, "float32", [-1, 4], 0), False))
+        varz.append(("y", (7, "float32", [-1, 1], 0), False))
+    ops = [("feed", {"X": ["feed"]}, {"Out": ["x"]}, {"col": c})
+           for c in cols]
+    ops.append(("fetch", {"X": ["y"]}, {"Out": ["fetch"]}, {"col": 0}))
+    return [(0, -1, varz, ops)]
+
+
+def test_wire_carriers_clean():
+    assert analysis.check_wire_carriers(_wire_blocks()) == []
+
+
+def test_wire_carriers_non_persistable_feed():
+    diags = analysis.check_wire_carriers(
+        _wire_blocks(feed_persistable=False))
+    assert any("persistable" in d.message for d in diags), diags
+
+
+def test_wire_carriers_col_gap():
+    diags = analysis.check_wire_carriers(_wire_blocks(cols=(0, 2)))
+    assert any("contiguous" in d.message for d in diags), diags
+
+
+def test_wire_carriers_undeclared_target():
+    diags = analysis.check_wire_carriers(
+        _wire_blocks(declare_target=False))
+    assert any("undeclared" in d.message for d in diags), diags
+
+
+# ---------------------------------------------------------------------------
+# CI leg: pplint over saved-model round-trips (native + era wire)
+# ---------------------------------------------------------------------------
+
+def _save_small_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[13], dtype="float32")
+        pred = L.fc(input=x, size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            str(tmp_path / "native"), ["x"], [pred], exe,
+            main_program=main)
+        fluid.io.save_reference_model(
+            str(tmp_path / "era"), ["x"], [pred], exe, main_program=main)
+
+
+def _run_pplint(path, *extra):
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""))
+    return subprocess.run([sys.executable, PPLINT, str(path)] + list(extra),
+                          capture_output=True, text=True, env=env,
+                          timeout=300)
+
+
+def test_pplint_saved_model_roundtrip(tmp_path):
+    """save -> pplint must be clean for BOTH the native desc and the
+    era-wire protobuf (exercising the era deserialize -> analyze path
+    including the wire-level carrier checks)."""
+    _save_small_model(tmp_path)
+    for fmt in ("native", "era"):
+        out = _run_pplint(tmp_path / fmt)
+        assert out.returncode == 0, (fmt, out.stdout, out.stderr)
+        assert "0 error(s)" in out.stdout, (fmt, out.stdout)
+
+
+def test_pplint_reports_wire_diags_on_malformed_desc(tmp_path):
+    """Wire-level carrier diagnostics must be reported even when the
+    same malformation breaks/bypasses desc parsing — not swallowed
+    behind a load error."""
+    from paddle_tpu import reference_format as rf
+
+    class _FV:
+        def __init__(self, name):
+            self.name, self.persistable = name, True
+
+    body = rf._w_vi(1, 0) + rf._w_tag(2, 0) + rf._w_varint((1 << 64) - 1)
+    body += rf._w_ld(3, rf._encode_wire_var(_FV("feed"), var_type=9))
+    body += rf._w_ld(3, rf._encode_wire_var(_FV("fetch"), var_type=10))
+    # feed op WITHOUT an Out slot
+    body += rf._w_ld(4, rf._encode_wire_op("feed", {"X": ["feed"]}, {},
+                                           {"col": 0}))
+    bad = tmp_path / "corrupt_desc"
+    bad.write_bytes(rf._w_ld(1, body))
+    out = _run_pplint(bad)
+    assert out.returncode == 1, (out.stdout, out.stderr)
+    assert "bad-carrier" in out.stdout and "no Out slot" in out.stdout
+
+
+def test_pplint_flags_bad_program(tmp_path):
+    from paddle_tpu.core.program_desc import program_to_bytes
+    p, _, _, _, _, _ = _bad_unregistered()
+    bad = tmp_path / "bad_desc"
+    bad.write_bytes(program_to_bytes(p))
+    out = _run_pplint(bad)
+    assert out.returncode == 1, (out.stdout, out.stderr)
+    assert "unregistered-op" in out.stdout
+    assert "frobnicate" in out.stdout

@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""pplint — static verifier for saved paddle_tpu / era-Fluid programs.
+
+Runs the paddle_tpu/analysis pass pipeline (use-before-def, shape/dtype
+consistency, unregistered ops, reader placement, feed/fetch carriers)
+over a SERIALIZED program, without executing it:
+
+    tools/pplint.py <model-dir>              # save_inference_model /
+                                             # save_reference_model dir
+    tools/pplint.py <model-dir>/__model__    # a bare desc file
+    tools/pplint.py path --strict            # warnings also fail
+
+Accepted formats (auto-detected from the first bytes):
+  * native versioned JSON desc (core/program_desc.py)        -> b'{'
+  * round-1 legacy pickle                                    -> b'\\x80'
+  * era-wire ProgramDesc protobuf (reference_format.py)      -> anything
+    else; the wire-level feed/fetch carrier checks run BEFORE the desc
+    is parsed, then the parsed program goes through the full pipeline.
+
+Feed/fetch targets come from __model_meta__.json (native dirs) or the
+era feed/fetch plumbing ops (strip_feed_fetch). Exit codes: 0 clean,
+1 findings, 2 bad invocation / unreadable model.
+"""
+import argparse
+import json
+import os
+import sys
+
+# lint must never dial a TPU tunnel / take the exclusive client lock
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def load_program(path, model_filename=None, allow_pickle=False):
+    """-> (program, feed_names, fetch_names, wire_diagnostics)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import reference_format as rf
+    from paddle_tpu.analysis import check_wire_carriers
+
+    meta_feeds = meta_fetches = None
+    if os.path.isdir(path):
+        meta_path = os.path.join(path, "__model_meta__.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            meta_feeds, meta_fetches = meta.get("feed"), meta.get("fetch")
+        path = os.path.join(path, model_filename or "__model__")
+    with open(path, "rb") as f:
+        raw = f.read()
+
+    if raw[:1] == b"{":  # native versioned JSON desc
+        program = fluid.Program.parse_from_string(raw)
+        return program, meta_feeds, meta_fetches, []
+    if raw[:1] == b"\x80":  # round-1 legacy pickle artifact
+        # unpickling EXECUTES code from the file — never do that by
+        # default in a lint tool whose whole job is inspecting artifacts
+        # of unknown provenance
+        if not allow_pickle:
+            raise ValueError(
+                "legacy pickle desc: unpickling executes code from the "
+                "file; pass --allow-pickle only for artifacts you trust")
+        import pickle
+        program = pickle.loads(raw)
+        return program, meta_feeds, meta_fetches, []
+    # era-wire protobuf: carrier checks at the WIRE level first, then
+    # parse (which strips the feed/fetch plumbing) and the layout adapter.
+    # A malformation that also breaks parsing must still REPORT the wire
+    # diagnostics that explain it, not vanish behind a load error.
+    blocks = rf._parse_blocks(raw)
+    wire_diags = check_wire_carriers(blocks)
+    try:
+        program = rf.parse_program_desc(blocks)
+        feeds, fetches = rf.strip_feed_fetch(blocks)
+        rf.adapt_sequence_layout(program, feeds)
+    except Exception:
+        if wire_diags:
+            return None, None, None, wire_diags
+        raise
+    return program, meta_feeds or feeds, meta_fetches or fetches, wire_diags
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="pplint", description="static verifier for saved programs")
+    ap.add_argument("path", help="model directory or program desc file")
+    ap.add_argument("--model-filename", default=None,
+                    help="desc filename inside a model dir "
+                         "(default __model__)")
+    ap.add_argument("--steps", type=int, default=1,
+                    help="validate for Executor.run(steps=K) semantics")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too")
+    ap.add_argument("--no-callstack", action="store_true",
+                    help="omit op creation stacks from output")
+    ap.add_argument("--allow-pickle", action="store_true",
+                    help="permit loading round-1 legacy pickle descs "
+                         "(unpickling executes code — trusted files only)")
+    args = ap.parse_args(argv)
+
+    try:
+        program, feeds, fetches, wire_diags = load_program(
+            args.path, args.model_filename,
+            allow_pickle=args.allow_pickle)
+    except Exception as e:
+        print("pplint: cannot load %s: %s" % (args.path, e),
+              file=sys.stderr)
+        return 2
+
+    from paddle_tpu import analysis
+    if program is None:
+        # wire carrier errors AND an unparseable desc: the diagnostics
+        # are the explanation — report them instead of a bare load error
+        result = analysis.AnalysisResult(wire_diags)
+    else:
+        result = analysis.analyze(program, feed_names=feeds,
+                                  fetch_names=fetches, steps=args.steps)
+        result.diagnostics[:0] = wire_diags  # wire findings lead, in order
+
+    for d in result:
+        print(d.format(with_callstack=not args.no_callstack))
+    print("pplint: %d error(s), %d warning(s) in %s"
+          % (len(result.errors), len(result.warnings), args.path))
+    if result.errors or (args.strict and result.warnings):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
