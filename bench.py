@@ -20,6 +20,10 @@ def _error_line(msg):
     """The one-JSON-line error payload, with the SAME metric/unit mapping
     as the success paths so downstream aggregators keyed on metric names
     bucket error lines correctly."""
+    if os.environ.get("BENCH_SERVING") == "1":
+        return {"metric": "serving_throughput", "value": 0.0,
+                "unit": "requests/sec/chip", "vs_baseline": None,
+                "error": msg}
     model = os.environ.get("BENCH_MODEL", "resnet50")
     decode = os.environ.get("BENCH_DECODE") == "1"
     token_metric = {"transformer": "transformer_cached_decode_throughput"
@@ -326,6 +330,180 @@ def bench_stacked_lstm():
         "loss": float(loss.reshape(-1)[0])}))
 
 
+def _lat_ms(latencies, q):
+    """Nearest-rank percentile of a latency list, in ms (the SAME
+    percentile the serving /metrics endpoint reports — one definition)."""
+    from paddle_tpu.serving.metrics import _percentile
+    return round(_percentile(sorted(latencies), q) * 1e3, 3)
+
+
+def bench_serving():
+    """BENCH_SERVING=1: the online-inference leg (paddle_tpu/serving).
+    Saves a small MLP via save_inference_model, loads it into an
+    InferenceEngine (bucket warmup included), then measures
+
+      * serial baseline — the same requests one at a time, batch=1,
+        direct Executor.run (what serving WITHOUT a batcher would do),
+      * closed loop — BENCH_SERVING_CLIENTS threads, each firing its next
+        request when the previous completes,
+      * open loop — a FIXED arrival schedule computed up front (i/rate
+        offsets; no wall-clock dependence in what gets dispatched), rate
+        BENCH_SERVING_ARRIVAL_QPS (default 2x the serial baseline).
+
+    One JSON line: requests/sec (closed loop) as the headline value plus
+    open-loop qps, the serial baseline, latency percentiles and mean
+    batch occupancy. The coalescing win is value/serial_qps."""
+    import threading
+
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import serving
+
+    # clients >= max_batch by default so the closed loop can FILL a batch
+    # (a full batch dispatches immediately; a partial one waits out
+    # max_delay — with fewer clients than batch rows every cycle pays the
+    # full coalescing delay and throughput can't beat serial)
+    n_requests = int(os.environ.get("BENCH_SERVING_REQUESTS", "256"))
+    n_clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "16"))
+    max_batch = int(os.environ.get("BENCH_SERVING_MAX_BATCH", "8"))
+    max_delay = float(os.environ.get("BENCH_SERVING_MAX_DELAY_MS", "5"))
+    feat = int(os.environ.get("BENCH_SERVING_FEATURES", "64"))
+    hidden = int(os.environ.get("BENCH_SERVING_HIDDEN", "256"))
+    # depth sets the DISPATCH cost (kernels per jitted call) — the fixed
+    # per-call overhead batching amortizes; per-row compute stays small.
+    # A 2-layer toy on CPU is so dispatch-light that python queueing
+    # overhead rivals it and the coalescing win drowns in host noise.
+    n_layers = int(os.environ.get("BENCH_SERVING_LAYERS", "4"))
+    n_serial = min(n_requests, int(os.environ.get("BENCH_SERVING_SERIAL",
+                                                  "64")))
+
+    import tempfile
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        h = x
+        for _ in range(n_layers):
+            h = fluid.layers.fc(input=h, size=hidden, act="relu")
+        pred = fluid.layers.fc(input=h, size=10, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace())
+    model_dir = tempfile.mkdtemp(prefix="ptpu_bench_serving_")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe,
+                                      main_prog)
+
+    engine = serving.InferenceEngine(
+        model_dir, place=fluid.TPUPlace(), name="bench",
+        max_batch_size=max_batch, max_queue_delay_ms=max_delay,
+        queue_capacity=max(1024, n_requests))
+    import shutil
+    shutil.rmtree(model_dir, ignore_errors=True)  # loaded; don't leak
+    # a model dir per bench/CI run into the temp dir
+    rng = np.random.RandomState(0)
+    inputs = [rng.rand(1, feat).astype("float32")
+              for _ in range(n_requests)]
+
+    # Loud-honesty rule (same as every other BENCH leg): a request only
+    # counts when its result has MATERIALIZED on the host — .numpy() per
+    # request, the slice a real client reads. Counting at scatter time
+    # would credit enqueue rate (JAX async dispatch) against a serial
+    # baseline that pays full execution + D2H, and the coalescing "win"
+    # could never lose.
+
+    # serial batch=1 baseline: direct Executor.run per request, no queue
+    t0 = time.perf_counter()
+    for i in range(n_serial):
+        engine.run_direct({"x": inputs[i]}, batch_bucket=1)
+    serial_qps = n_serial / (time.perf_counter() - t0)
+
+    # closed loop; latency = client-observed submit -> materialized.
+    # A client thread dying silently would SHORTEN the wall clock while
+    # the request count stays nominal — inflating the headline — so any
+    # client failure fails the whole leg through the _error_line path.
+    closed_lat, client_errors, lat_lock = [], [], threading.Lock()
+    per_client = n_requests // n_clients
+
+    def client(cid):
+        lats = []
+        try:
+            for i in range(per_client):
+                t = time.perf_counter()
+                fut = engine.submit({"x": inputs[cid * per_client + i]})
+                fut.result(120).numpy()
+                lats.append(time.perf_counter() - t)
+        except Exception as e:  # noqa: BLE001 - reported as leg failure
+            with lat_lock:
+                client_errors.append("client %d: %r" % (cid, e))
+        with lat_lock:
+            closed_lat.extend(lats)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    closed_dt = time.perf_counter() - t0
+    if client_errors:
+        engine.close(drain=False)
+        print(json.dumps(_error_line(
+            "serving closed loop: %d client(s) failed: %s"
+            % (len(client_errors), "; ".join(client_errors[:3])))))
+        sys.stdout.flush()
+        os._exit(2)
+    closed_qps = (per_client * n_clients) / closed_dt
+
+    # open loop: fixed schedule, rate defaults to 2x the serial baseline
+    rate = float(os.environ.get("BENCH_SERVING_ARRIVAL_QPS", "0")) \
+        or 2.0 * serial_qps
+    schedule = [i / rate for i in range(n_requests)]
+    futures, submit_at, open_lat = [], [], []
+    t0 = time.perf_counter()
+    try:  # same one-JSON-line contract as the closed loop on failure
+        for i, offset in enumerate(schedule):
+            delay = t0 + offset - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            submit_at.append(time.perf_counter())
+            futures.append(engine.submit({"x": inputs[i]}))
+        for f, ts in zip(futures, submit_at):
+            f.result(120).numpy()
+            open_lat.append(time.perf_counter() - ts)
+    except Exception as e:  # noqa: BLE001 - reported as leg failure
+        engine.close(drain=False)
+        print(json.dumps(_error_line(
+            "serving open loop failed after %d/%d results: %r"
+            % (len(open_lat), n_requests, e))))
+        sys.stdout.flush()
+        os._exit(2)
+    open_dt = time.perf_counter() - t0
+    open_qps = n_requests / open_dt
+
+    snap = engine.metrics.snapshot()
+    engine.close()
+    print(json.dumps({
+        "metric": "serving_throughput",
+        "value": round(closed_qps, 1),
+        "unit": "requests/sec/chip",
+        "vs_baseline": None,
+        "serial_qps": round(serial_qps, 1),
+        "open_qps": round(open_qps, 1),
+        "open_arrival_qps": round(rate, 1),
+        "clients": n_clients, "requests": n_requests,
+        "max_batch": max_batch, "max_delay_ms": max_delay,
+        "layers": n_layers, "hidden": hidden,
+        "mean_batch_occupancy": snap["mean_batch_occupancy"],
+        "row_utilization": snap["row_utilization"],
+        "closed_p50_ms": _lat_ms(closed_lat, 0.50),
+        "closed_p95_ms": _lat_ms(closed_lat, 0.95),
+        "closed_p99_ms": _lat_ms(closed_lat, 0.99),
+        "open_p50_ms": _lat_ms(open_lat, 0.50),
+        "open_p95_ms": _lat_ms(open_lat, 0.95),
+        "open_p99_ms": _lat_ms(open_lat, 0.99),
+        "device": str(jax.devices()[0])}))
+
+
 # fwd FLOPs per 224x224 image (2x the usual MACs figure — VGG16's famous
 # "15.5G" is MACs, so fwd = 31e9); models build_train supports but this
 # table lacks still bench (mfu reported null)
@@ -383,6 +561,9 @@ def main():
             "accelerator expected but only CPU devices initialized")))
         sys.stdout.flush()
         os._exit(3)
+    if os.environ.get("BENCH_SERVING") == "1":
+        bench_serving()
+        return
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "transformer":
         if os.environ.get("BENCH_DECODE") == "1":

@@ -73,6 +73,7 @@ from . import recordio
 from . import recordio_writer
 from . import analysis
 from .analysis import ProgramVerificationError
+from . import serving
 
 Tensor = LoDTensor
 

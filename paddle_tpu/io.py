@@ -47,16 +47,66 @@ def _var_list(main_program, predicate, vars):
     return [v for v in main_program.list_vars() if predicate(v)]
 
 
+def _reader_var_names(program):
+    """Names wired into host-io (reader) ops anywhere in `program`.
+    In-graph reader vars are persistable but their scope value is a
+    host-side ReaderState, not a tensor — runtime plumbing, never
+    checkpoint payload, on both the save and load side. Detected from
+    the OPS (not the `reader_shapes` attribute layers/io.py sets) so the
+    classification survives a program_desc serialization round trip."""
+    from .core import readers as _readers
+    names = set()
+    if program is None:
+        return names
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type == "read" or _readers.is_host_io_op(op.type):
+                for slot in list(op.inputs.values()) + \
+                        list(op.outputs.values()):
+                    if op.type == "read" and slot is op.outputs.get("Out"):
+                        continue  # the data outputs ARE tensors
+                    names.update(slot)
+    return names
+
+
+def _is_reader_var(v, reader_names=()):
+    return hasattr(v, "reader_shapes") or v.name in reader_names
+
+
 def save_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
+              predicate=None, filename=None, allow_missing=False):
+    """Write `vars` (or the program's persistables) as .npy files + a JSON
+    manifest. A var that has NO value in the scope is checkpoint
+    corruption — the file set would silently omit a parameter and a later
+    load would leave it at init — so it raises unless `allow_missing=True`
+    (the legacy lenient behavior, for intentionally partial saves)."""
     vars = _var_list(main_program, predicate or is_persistable, vars)
-    os.makedirs(dirname, exist_ok=True)
     scope = global_scope()
-    manifest = {}
+    reader_names = _reader_var_names(main_program)
+    from .core.readers import ReaderBase
+    # resolve and CHECK every var before the first byte is written: a
+    # raise mid-write into a pre-existing checkpoint dir would leave the
+    # old manifest pointing at a mix of new and old arrays — silent
+    # corruption a later load couldn't detect. Values stay unconverted
+    # here (np.asarray of a device array copies to host; doing that for
+    # ALL vars up front would pin the whole checkpoint in host memory).
+    to_write = []
     for v in vars:
         val = scope.get(v.name)
         if val is None:
-            continue
+            if allow_missing or _is_reader_var(v, reader_names):
+                continue
+            raise RuntimeError(
+                "save_vars: variable %r has no value in the current scope "
+                "— saving would silently omit it from the checkpoint. Run "
+                "the startup program first, or pass allow_missing=True "
+                "for an intentionally partial save." % v.name)
+        if isinstance(val, ReaderBase):
+            continue  # live reader state: runtime plumbing, not a tensor
+        to_write.append((v, val))
+    os.makedirs(dirname, exist_ok=True)
+    manifest = {}
+    for v, val in to_write:
         arr = np.asarray(val)
         safe = v.name.replace("/", "__")
         np.save(os.path.join(dirname, safe + ".npy"), arr)
@@ -68,23 +118,45 @@ def save_vars(executor, dirname, main_program=None, vars=None,
 
 
 def save_params(executor, dirname, main_program=None, vars=None,
-                filename=None):
-    save_vars(executor, dirname, main_program, vars, is_parameter, filename)
+                filename=None, allow_missing=False):
+    save_vars(executor, dirname, main_program, vars, is_parameter, filename,
+              allow_missing=allow_missing)
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
-    save_vars(executor, dirname, main_program, None, is_persistable, filename)
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      allow_missing=False):
+    save_vars(executor, dirname, main_program, None, is_persistable, filename,
+              allow_missing=allow_missing)
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None, params_only=False):
+              predicate=None, filename=None, params_only=False,
+              allow_missing=False):
+    """Restore vars from a save_vars directory. A requested var that the
+    manifest does NOT carry would silently stay at its init value — the
+    classic corrupted-resume — so it raises unless `allow_missing=True`
+    (legacy lenient behavior, for deliberately partial restores)."""
     with open(os.path.join(dirname, "manifest.json")) as f:
         manifest = json.load(f)
     scope = global_scope()
     want = None
     if vars is not None or main_program is not None:
+        reader_names = _reader_var_names(main_program)
         want = set(v.name for v in
-                   _var_list(main_program, predicate or is_persistable, vars))
+                   _var_list(main_program, predicate or is_persistable, vars)
+                   if not _is_reader_var(v, reader_names))
+    # strict check BEFORE the first scope.set: raising half-restored
+    # would leave a mix of loaded and stale values behind for a caller
+    # that catches the error — the load-side twin of save_vars' rule
+    if want is not None and not allow_missing:
+        absent = sorted(want - set(manifest))
+        if absent:
+            raise RuntimeError(
+                "load_vars: %d requested variable(s) are not in the "
+                "manifest at %r and would silently keep their init "
+                "values: %s. Pass allow_missing=True for an "
+                "intentionally partial restore."
+                % (len(absent), dirname, absent))
     for name, meta in manifest.items():
         if want is not None and name not in want:
             continue
@@ -94,13 +166,16 @@ def load_vars(executor, dirname, main_program=None, vars=None,
         scope.set(name, arr)
 
 
-def load_params(executor, dirname, main_program=None, filename=None):
+def load_params(executor, dirname, main_program=None, filename=None,
+                allow_missing=False):
     load_vars(executor, dirname, main_program, None, is_parameter, filename,
-              params_only=True)
+              params_only=True, allow_missing=allow_missing)
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
-    load_vars(executor, dirname, main_program, None, is_persistable, filename)
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      allow_missing=False):
+    load_vars(executor, dirname, main_program, None, is_persistable, filename,
+              allow_missing=allow_missing)
 
 
 def get_inference_program(target_vars, main_program=None):
@@ -143,6 +218,16 @@ def load_inference_model(dirname, executor, model_filename=None,
         program = _program_desc.program_from_bytes(raw)
     with open(os.path.join(dirname, "__model_meta__.json")) as f:
         meta = json.load(f)
+    # strict mode (FLAGS_validate_program=1, same gate as Executor.run —
+    # literally the same flag resolver, so strictness can't drift):
+    # a malformed saved model is rejected HERE with structured
+    # Diagnostics, before params load or any request traces it.
+    # serving.InferenceEngine validates unconditionally.
+    from .core.executor import _validate_program_flag
+    if _validate_program_flag():
+        from .analysis import validate_or_raise
+        validate_or_raise(program, feed_names=meta["feed"],
+                          fetch_names=meta["fetch"])
     load_params(executor, dirname)
     fetch_vars = [program.global_block().var(n) for n in meta["fetch"]]
     return program, meta["feed"], fetch_vars

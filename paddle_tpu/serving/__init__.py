@@ -1,0 +1,34 @@
+"""paddle_tpu.serving — batched online inference runtime.
+
+The deploy surface the reference era scattered across
+`listen_and_serv_op`, the capi, and hand-rolled frontends, rebuilt as a
+TPU-native in-process engine:
+
+    from paddle_tpu import serving
+    engine = serving.InferenceEngine("my_model_dir")   # native or
+                                                       # era-wire format
+    out = engine.infer({"x": batch})                   # coalesced with
+                                                       # concurrent callers
+    serving.ModelServer(engine, port=8080).serve_forever()
+
+Pieces: `engine.InferenceEngine` (model load + verify + bucketed traced
+dispatch + warmup), `batcher.Batcher` (dynamic micro-batching with
+deadlines, bounded-queue backpressure, graceful drain),
+`server.ModelServer` (stdlib threaded HTTP JSON frontend),
+`metrics.ServingMetrics` (QPS/latency/occupancy, Prometheus + profiler
+integration). CLI: `tools/ptpu_serve.py`. Design notes:
+ARCHITECTURE.md §15.
+"""
+from .batcher import (Batcher, DeadlineExceededError, QueueFullError,
+                      RequestFuture, RequestTooLargeError, ServingClosedError,
+                      ServingError)
+from .engine import InferenceEngine, InvalidRequestError, ResultSlice
+from .metrics import ServingMetrics
+from .server import ModelServer
+
+__all__ = [
+    "InferenceEngine", "ModelServer", "Batcher", "ServingMetrics",
+    "RequestFuture", "ResultSlice", "ServingError", "QueueFullError",
+    "DeadlineExceededError", "ServingClosedError", "RequestTooLargeError",
+    "InvalidRequestError",
+]

@@ -1,0 +1,258 @@
+"""Dynamic micro-batching: a bounded request queue + one coalescing loop.
+
+Requests enter via `submit()` (any thread) and wait at most
+`max_queue_delay_ms` — or until `max_batch_size` rows are pending — before
+the worker pops a contiguous batch, drops requests whose deadline already
+passed (answered with `DeadlineExceededError` BEFORE any padding/dispatch
+work is spent on them), and hands the rest to the engine's dispatch
+function in one call. Dispatch returns per-request result slices built on
+lazy FetchHandles: the device dispatch is enqueued but no D2H has
+happened; each future materializes only its own rows when asked.
+
+Robustness contract (the parts of serving that are the subsystem, not an
+afterthought):
+  * bounded queue — `submit()` on a full queue raises `QueueFullError`
+    immediately (backpressure beats unbounded latency),
+  * per-request deadlines — expired requests never reach the device,
+  * graceful shutdown — `close(drain=True)` stops intake, drains every
+    in-flight and queued request, then joins the worker.
+"""
+import collections
+import threading
+import time
+
+__all__ = ["Batcher", "RequestFuture", "ServingError", "QueueFullError",
+           "DeadlineExceededError", "ServingClosedError",
+           "RequestTooLargeError"]
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-runtime errors (HTTP layer maps these to
+    status codes)."""
+
+
+class QueueFullError(ServingError):
+    """Fast rejection: the bounded request queue is at capacity."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed while it waited in the queue."""
+
+
+class ServingClosedError(ServingError):
+    """The engine is shutting down (or closed) and rejects new work."""
+
+
+class RequestTooLargeError(ServingError):
+    """A single request exceeds max_batch_size rows — it could never be
+    dispatched; reject at submit time instead of wedging the queue."""
+
+
+class RequestFuture(object):
+    """Completion handle for one submitted request.
+
+    `result(timeout)` blocks until the batcher scatters the batch output
+    (or fails the request) and returns the per-request value. The value a
+    successful dispatch sets is an `engine.ResultSlice`: device-resident,
+    row-sliced lazily — `result()` triggers only this request's D2H.
+    """
+
+    __slots__ = ("_event", "_value", "_error", "latency_s", "bucket")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+        self.latency_s = None   # submit -> scatter, set by the worker
+        self.bucket = None      # (batch_bucket, seq_bucket|None) dispatched
+
+    def done(self):
+        return self._event.is_set()
+
+    def set_result(self, value):
+        self._value = value
+        self._event.set()
+
+    def set_exception(self, exc):
+        self._error = exc
+        self._event.set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not completed within %rs" % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+# dispatch this far ahead of a pending deadline: a batch released exactly
+# AT the deadline would lose the strict expiry check to scheduler jitter
+_DEADLINE_MARGIN_S = 1e-3
+
+
+class _Request(object):
+    __slots__ = ("feed", "rows", "future", "deadline", "enqueued_at")
+
+    def __init__(self, feed, rows, deadline):
+        self.feed = feed
+        self.rows = rows
+        self.future = RequestFuture()
+        self.deadline = deadline          # monotonic seconds, or None
+        self.enqueued_at = time.monotonic()
+
+
+class Batcher(object):
+    """The coalescing loop. `dispatch_fn(requests)` (the engine) pads the
+    requests into one bucket, runs the executor once, and scatters
+    per-request results into `req.future` — the worker only decides WHAT
+    rides in a batch and WHEN it leaves."""
+
+    def __init__(self, dispatch_fn, max_batch_size=32, max_queue_delay_ms=5,
+                 queue_capacity=256, metrics=None, name="batcher"):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self._dispatch = dispatch_fn
+        self.max_batch_size = int(max_batch_size)
+        self.max_queue_delay_s = float(max_queue_delay_ms) / 1e3
+        self.queue_capacity = int(queue_capacity)
+        self._metrics = metrics
+        self._queue = collections.deque()
+        self._pending_rows = 0   # running sum over _queue (O(1) wakeups:
+        self._deadlined = 0      # a burst must not cost O(n^2) rescans)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        self._draining = False
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="ptpu-" + name)
+        if metrics is not None:
+            metrics.bind_queue_depth(lambda: len(self._queue))
+        self._worker.start()
+
+    # ---------------------------------------------------------- intake --
+    def submit(self, feed, rows, deadline_ms=None):
+        """Enqueue one request; returns its RequestFuture. Raises
+        QueueFullError / ServingClosedError / RequestTooLargeError
+        WITHOUT blocking — backpressure must be cheap for the caller."""
+        if rows < 1:
+            raise ValueError("request must carry at least one row")
+        if rows > self.max_batch_size:
+            raise RequestTooLargeError(
+                "request has %d rows but max_batch_size is %d"
+                % (rows, self.max_batch_size))
+        deadline = (time.monotonic() + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        req = _Request(feed, rows, deadline)
+        with self._cond:
+            if self._closed:
+                raise ServingClosedError("serving engine is shut down")
+            if len(self._queue) >= self.queue_capacity:
+                if self._metrics is not None:
+                    self._metrics.on_queue_full()
+                raise QueueFullError(
+                    "request queue at capacity (%d); retry with backoff"
+                    % self.queue_capacity)
+            self._queue.append(req)
+            self._pending_rows += req.rows
+            if req.deadline is not None:
+                self._deadlined += 1
+            self._cond.notify()
+        if self._metrics is not None:
+            self._metrics.on_submit()
+        return req.future
+
+    def queue_depth(self):
+        return len(self._queue)
+
+    # ---------------------------------------------------------- worker --
+    def _collect_batch(self):
+        """Wait for work, honor the delay/size policy, pop one batch.
+        Returns (requests, expired) or (None, None) on shutdown."""
+        with self._cond:
+            while not self._queue:
+                if self._closed:
+                    return None, None
+                self._cond.wait()
+            # coalescing window: anchored at the OLDEST pending request so
+            # queue time is bounded by max_queue_delay even under trickle
+            # arrivals; a full batch releases immediately. A pending
+            # DEADLINE inside the window caps it — a request whose
+            # deadline is shorter than max_queue_delay must be dispatched
+            # before it expires, not held for coalescing it can't afford
+            # (waiting the full window would 504 every such request under
+            # light load).
+            leave_at = self._queue[0].enqueued_at + self.max_queue_delay_s
+            while not (self._closed or self._draining):
+                if self._pending_rows >= self.max_batch_size \
+                        or leave_at <= time.monotonic():
+                    break  # O(1) fast paths BEFORE any deadline scan
+                wake_at = leave_at
+                if self._deadlined:  # only then is a scan needed at all
+                    wake_at = min(
+                        [leave_at] + [r.deadline - _DEADLINE_MARGIN_S
+                                      for r in self._queue
+                                      if r.deadline is not None])
+                remaining = wake_at - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+            batch, expired, rows, now = [], [], 0, time.monotonic()
+            while self._queue:
+                req = self._queue[0]
+                if req.deadline is not None and req.deadline < now:
+                    expired.append(self._pop_head())
+                    continue
+                if rows + req.rows > self.max_batch_size:
+                    break
+                batch.append(self._pop_head())
+                rows += req.rows
+            return batch, expired
+
+    def _pop_head(self):
+        """Pop the queue head, keeping the incremental counters true.
+        Caller holds the lock."""
+        req = self._queue.popleft()
+        self._pending_rows -= req.rows
+        if req.deadline is not None:
+            self._deadlined -= 1
+        return req
+
+    def _loop(self):
+        while True:
+            batch, expired = self._collect_batch()
+            if batch is None:
+                return
+            for req in expired:
+                req.future.set_exception(DeadlineExceededError(
+                    "deadline passed after %.1fms in queue"
+                    % ((time.monotonic() - req.enqueued_at) * 1e3)))
+            if expired and self._metrics is not None:
+                self._metrics.on_deadline_expired(len(expired))
+            if not batch:
+                continue
+            try:
+                self._dispatch(batch)
+            except Exception as e:  # noqa: BLE001 — fail the batch, not
+                for req in batch:   # the worker: serving must outlive one
+                    if not req.future.done():   # bad request batch
+                        req.future.set_exception(e)
+                if self._metrics is not None:
+                    self._metrics.on_error(len(batch))
+
+    # -------------------------------------------------------- shutdown --
+    def close(self, drain=True, timeout=None):
+        """Stop intake; with drain=True the worker finishes every queued
+        request first (in max_batch_size chunks, no further coalescing
+        delay), otherwise pending requests fail with ServingClosedError."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._draining = drain
+            if not drain:
+                while self._queue:
+                    self._pop_head().future.set_exception(
+                        ServingClosedError("serving engine shut down "
+                                           "before dispatch"))
+            self._cond.notify_all()
+        self._worker.join(timeout)

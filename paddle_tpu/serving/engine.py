@@ -1,0 +1,599 @@
+"""InferenceEngine: a loaded model + private Scope + bucketed dispatch.
+
+Load path: a `save_inference_model` directory (native versioned JSON
+desc) or a reference-era `save_inference_model` directory (era-wire
+ProgramDesc protobuf, via `io.load_reference_model`) — auto-detected.
+The program goes through the full `paddle_tpu/analysis` pass pipeline AT
+LOAD: a malformed model is rejected with structured `Diagnostic`s before
+it can take traffic, instead of surfacing as an opaque trace/XLA error
+inside some unlucky request's batch.
+
+Shape discipline (the TVM fixed-shape-artifact idea applied to serving):
+every dispatch — coalesced batch or single request — runs at a shape from
+a small configured lattice of (batch bucket, seq bucket) pairs, pre-traced
+at startup (`warmup()`) so steady state never compiles. Bucketing is also
+what makes the correctness invariant testable: at a FIXED compiled shape,
+XLA row results depend only on that row's values, so a request's rows are
+bit-identical whether it was dispatched alone (`run_direct` at the same
+bucket) or coalesced with strangers. Across DIFFERENT shapes XLA may
+vectorize reductions differently — which is exactly why the engine never
+dispatches at ad-hoc shapes.
+
+Sequence feeds ride the `core/lod.py` machinery: each request's LoDTensor
+pads to the batch's seq bucket (`to_padded(max_len=seq_bucket)`) and the
+`@SEQLEN` companion carries true lengths; pad rows get length 1 over zero
+data so length-normalizing ops can't manufacture NaN/Inf in rows nobody
+reads.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..core.executor import Executor, Scope, scope_guard
+from ..core.framework import convert_dtype
+from ..core.lod import LoDTensor
+from ..core.utils import find_var
+from .batcher import Batcher, ServingError
+from .metrics import ServingMetrics
+
+__all__ = ["InferenceEngine", "ResultSlice", "InvalidRequestError"]
+
+SEQLEN_SUFFIX = "@SEQLEN"
+
+
+class InvalidRequestError(ServingError):
+    """The request's feeds don't match the model contract (missing feed,
+    wrong feature dims, sequence longer than the largest bucket, ...)."""
+
+
+def _default_batch_buckets(max_batch_size):
+    buckets, b = [], 1
+    while b < max_batch_size:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch_size)
+    return buckets
+
+
+def _covering_bucket(buckets, n, what):
+    for b in buckets:
+        if b >= n:
+            return b
+    raise InvalidRequestError(
+        "%s %d exceeds the largest configured bucket %d"
+        % (what, n, buckets[-1]))
+
+
+class ResultSlice(object):
+    """One request's share of a dispatched batch: lazy FetchHandles plus
+    this request's row range. The dispatch has been enqueued on device;
+    `numpy()` pays the device->host copy for THESE rows only (the row
+    slice happens device-side before the transfer on a real
+    accelerator; on the CPU backend np.asarray is already a zero-copy
+    view, so slicing host-side skips a ~200us XLA slice dispatch per
+    request). Per-fetch row policy comes from the engine's static
+    classification: "rows" (declared leading dim -1: always slice),
+    "whole" (parameters/persistables/scalars: never per-row), "dynamic"
+    (concrete non-param leading dim: slice whenever the runtime leading
+    dim equals the bucket — when ambiguous, slicing is the safe default,
+    since returning the full batch would hand one client co-batched
+    strangers' rows)."""
+
+    __slots__ = ("_fetch_names", "_handles", "_row_policy",
+                 "_device_slice", "_lo", "_hi", "_bucket_rows", "bucket")
+
+    def __init__(self, fetch_names, handles, row_policy, lo, hi,
+                 bucket_rows, bucket, device_slice=True):
+        self._fetch_names = fetch_names
+        self._handles = handles
+        self._row_policy = row_policy  # name -> rows|whole|dynamic
+        self._device_slice = device_slice
+        self._lo = lo
+        self._hi = hi
+        self._bucket_rows = bucket_rows
+        self.bucket = bucket  # (batch_bucket, seq_bucket | None)
+
+    def numpy(self):
+        out = {}
+        for name, h in zip(self._fetch_names, self._handles):
+            policy = self._row_policy[name]
+            slice_rows = policy == "rows" or (
+                policy == "dynamic" and h.shape
+                and h.shape[0] == self._bucket_rows)
+            if not slice_rows:
+                out[name] = np.asarray(h.array)
+            elif self._device_slice:
+                out[name] = np.asarray(h.array[self._lo:self._hi])
+            else:
+                out[name] = np.asarray(h.array)[self._lo:self._hi]
+        return out
+
+    def __repr__(self):
+        return "ResultSlice(rows=[%d:%d), bucket=%r)" % (
+            self._lo, self._hi, self.bucket)
+
+
+class _NormalizedRequest(object):
+    """A request's feeds, validated and split by kind: dense arrays
+    (dtype-cast, [rows, *feat]) and sequence LoDTensors (+max length).
+    `shape_sig` captures every CONCRETE feature shape: requests only
+    coalesce within a signature, so a model with free (-1) feature dims
+    can serve mixed widths without one width poisoning the other's
+    batch (they can't share one padded array)."""
+
+    __slots__ = ("rows", "dense", "seqs", "max_seq_len", "shape_sig")
+
+    def __init__(self, rows, dense, seqs, max_seq_len):
+        self.rows = rows
+        self.dense = dense          # name -> np.ndarray [rows, *feat]
+        self.seqs = seqs            # name -> LoDTensor with `rows` seqs
+        self.max_seq_len = max_seq_len
+        self.shape_sig = tuple(sorted(
+            [(n, a.shape[1:]) for n, a in dense.items()] +
+            [(n, lt.data.shape[1:]) for n, lt in seqs.items()]))
+
+
+class InferenceEngine(object):
+    def __init__(self, model_dir=None, model_format="auto",
+                 model_filename=None, params_filename=None, place=None,
+                 name=None, program=None, feed_names=None, fetch_vars=None,
+                 batch_buckets=None, seq_buckets=None, max_batch_size=None,
+                 max_queue_delay_ms=5.0, queue_capacity=256,
+                 default_deadline_ms=None, validate=True, warmup=True,
+                 latency_window=2048):
+        from ..places import CPUPlace
+        self.name = name or (os.path.basename(os.path.normpath(model_dir))
+                             if model_dir else "model")
+        self._scope = Scope()
+        self._exe = Executor(place if place is not None else CPUPlace())
+        self._run_lock = threading.Lock()   # Executor cache isn't
+        self.default_deadline_ms = default_deadline_ms  # thread-safe
+        self.closed = False
+        # device-side row slicing only pays for itself when there is a
+        # transfer to shrink; on the CPU backend it's a pure ~200us
+        # dispatch tax per request (np.asarray is zero-copy there)
+        self._device_slice = \
+            self._exe.place.device().platform != "cpu"
+
+        validated_at_load = False
+        if program is None:
+            if model_dir is None:
+                raise ValueError("need model_dir or an in-memory program")
+            program, feed_names, fetch_vars = self._load(
+                model_dir, model_format, model_filename, params_filename)
+            # under FLAGS_validate_program=1 the native loader already
+            # ran the full pipeline (io.load_inference_model) — don't
+            # walk the program a second time at startup
+            from ..core.executor import _validate_program_flag
+            validated_at_load = (self._loaded_format == "native"
+                                 and _validate_program_flag())
+        elif feed_names is None or fetch_vars is None:
+            raise ValueError("in-memory program needs feed_names and "
+                             "fetch_vars")
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = [v if isinstance(v, str) else v.name
+                            for v in fetch_vars]
+
+        if validate and not validated_at_load:
+            from .. import analysis
+            analysis.validate_or_raise(self.program,
+                                       feed_names=self.feed_names,
+                                       fetch_names=self.fetch_names)
+
+        # feed contract: per-feed declared feature dims + sequence-ness
+        self._feed_vars = {}
+        self._seq_feeds = set()
+        for n in self.feed_names:
+            var = find_var(self.program, n)
+            if var is None:
+                # a broken ARTIFACT (deploy fault), not a bad request —
+                # InvalidRequestError here would file it as a client 400
+                raise ValueError(
+                    "model metadata names feed %r but the program has no "
+                    "such variable" % n)
+            self._feed_vars[n] = var
+            if var.lod_level > 1:
+                raise ValueError(
+                    "feed %r has lod_level=%d: the serving batcher "
+                    "coalesces single-level sequences only (the era "
+                    "served nested-LoD decodes from host loops, not "
+                    "saved graphs)" % (n, var.lod_level))
+            if var.lod_level > 0 or find_var(
+                    self.program, n + SEQLEN_SUFFIX) is not None:
+                self._seq_feeds.add(n)
+
+        # per-fetch row policy, decided ONCE: leading dim -1 = "rows"
+        # (what layers.data/infer-shape propagate for batch outputs);
+        # parameters/persistables/scalars = "whole" (never per-row);
+        # a concrete non-param leading dim = "dynamic" — sliced when it
+        # matches the dispatched bucket, because returning it whole
+        # would leak co-batched strangers' rows to every client.
+        from ..core.framework import Parameter
+        self._fetch_row_policy = {}
+        for n in self.fetch_names:
+            var = find_var(self.program, n)
+            shape = list(var.shape or []) if var is not None else []
+            if var is not None and (isinstance(var, Parameter)
+                                    or var.persistable or not shape):
+                self._fetch_row_policy[n] = "whole"
+            elif shape and shape[0] == -1:
+                self._fetch_row_policy[n] = "rows"
+            else:
+                self._fetch_row_policy[n] = "dynamic"
+
+        if batch_buckets:
+            self.batch_buckets = sorted(set(int(b) for b in batch_buckets))
+            self.max_batch_size = (int(max_batch_size) if max_batch_size
+                                   else self.batch_buckets[-1])
+        else:
+            self.max_batch_size = int(max_batch_size or 32)
+            self.batch_buckets = _default_batch_buckets(self.max_batch_size)
+        if self.max_batch_size > self.batch_buckets[-1]:
+            raise ValueError(
+                "max_batch_size %d exceeds the largest batch bucket %d"
+                % (self.max_batch_size, self.batch_buckets[-1]))
+        self.seq_buckets = (sorted(set(int(s) for s in seq_buckets))
+                            if seq_buckets else
+                            ([16, 32, 64, 128, 256] if self._seq_feeds
+                             else []))
+
+        self.metrics = ServingMetrics(latency_window=latency_window)
+        self._batcher = Batcher(
+            self._dispatch, max_batch_size=self.max_batch_size,
+            max_queue_delay_ms=max_queue_delay_ms,
+            queue_capacity=queue_capacity, metrics=self.metrics,
+            name=self.name)
+        if warmup:
+            try:
+                self.warmup()
+            except Exception:
+                # the batcher worker is already running: a constructor
+                # that raises must not leak a live thread per retry
+                self.close(drain=False)
+                raise
+
+    # ------------------------------------------------------------ load --
+    def _load(self, model_dir, model_format, model_filename,
+              params_filename):
+        from .. import io as _io
+        if model_format == "auto":
+            native_meta = os.path.join(model_dir, "__model_meta__.json")
+            model_format = ("native" if os.path.exists(native_meta)
+                            else "reference")
+        self._loaded_format = model_format
+        with scope_guard(self._scope):
+            if model_format == "native":
+                return _io.load_inference_model(
+                    model_dir, self._exe, model_filename=model_filename,
+                    params_filename=params_filename)
+            if model_format == "reference":
+                return _io.load_reference_model(
+                    model_dir, self._exe, model_filename=model_filename,
+                    params_filename=params_filename)
+        raise ValueError("model_format must be auto|native|reference, "
+                         "got %r" % model_format)
+
+    # ------------------------------------------------------- normalize --
+    def normalize_feed(self, feed):
+        """Validate one request's feed dict against the model contract.
+        Dense feeds: array-likes [rows, *feat] (feature dims checked
+        against declared dims where those are concrete). Sequence feeds:
+        a LoDTensor or a list of per-sequence arrays."""
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise InvalidRequestError("request is missing feeds %r (model "
+                                      "expects %r)" % (missing,
+                                                       self.feed_names))
+        extra = [n for n in feed if n not in self.feed_names]
+        if extra:
+            raise InvalidRequestError("request has unknown feeds %r (model "
+                                      "expects %r)" % (extra,
+                                                       self.feed_names))
+        rows = None
+        dense, seqs, max_seq_len = {}, {}, 0
+        for n in self.feed_names:
+            var, value = self._feed_vars[n], feed[n]
+            if n in self._seq_feeds:
+                if isinstance(value, LoDTensor):
+                    if value.lod_level() > 1:
+                        raise InvalidRequestError(
+                            "feed %r: nested (multi-level) LoD is not "
+                            "servable; send single-level sequences" % n)
+                    lt = value
+                elif isinstance(value, (list, tuple)):
+                    lt = LoDTensor.from_sequences(
+                        [np.asarray(s) for s in value])
+                else:
+                    raise InvalidRequestError(
+                        "feed %r is a sequence input: send a LoDTensor or "
+                        "a list of per-sequence arrays" % n)
+                lengths = lt.seq_lengths() if lt.lod else \
+                    np.asarray([len(lt.data)], dtype=np.int32)
+                n_seqs = len(lengths)
+                if n_seqs == 0:
+                    raise InvalidRequestError(
+                        "feed %r carries zero sequences" % n)
+                if len(lengths) and int(lengths.min()) < 1:
+                    # a real row with @SEQLEN=0 divides-by-zero in
+                    # length-normalizing ops — the client's fault, so a
+                    # typed 400 here, not a NaN-shaped 500 later
+                    raise InvalidRequestError(
+                        "feed %r contains an empty sequence; every "
+                        "sequence needs at least one step" % n)
+                # per-token feature dims must match the declaration HERE:
+                # a bad shape discovered inside the batcher's concat
+                # would fail every innocent co-batched request
+                want = list(var.shape or [])[2:]
+                got = list(lt.data.shape)[1:]
+                if len(got) != len(want) or any(
+                        w >= 0 and w != g for w, g in zip(want, got)):
+                    raise InvalidRequestError(
+                        "feed %r has per-token shape %r but the model "
+                        "declares %r" % (n, got, want))
+                max_seq_len = max(max_seq_len,
+                                  int(lengths.max()) if n_seqs else 0)
+                seqs[n] = lt
+                r = n_seqs
+            else:
+                arr = np.asarray(value)
+                if var.dtype is not None:
+                    arr = arr.astype(convert_dtype(var.dtype), copy=False)
+                if arr.ndim < 1:
+                    raise InvalidRequestError(
+                        "feed %r must carry a leading batch-rows dim, "
+                        "got a scalar" % n)
+                want = list(var.shape or [])[1:]
+                got = list(arr.shape)[1:]
+                if len(got) != len(want) or any(
+                        w >= 0 and w != g for w, g in zip(want, got)):
+                    raise InvalidRequestError(
+                        "feed %r has per-row shape %r but the model "
+                        "declares %r" % (n, got, want))
+                dense[n] = arr
+                r = arr.shape[0]
+            if rows is None:
+                rows = r
+            elif r != rows:
+                raise InvalidRequestError(
+                    "feeds disagree on batch rows: %r carries %d, earlier "
+                    "feeds carry %d" % (n, r, rows))
+        if rows < 1:
+            raise InvalidRequestError("request carries zero rows")
+        return _NormalizedRequest(rows, dense, seqs, max_seq_len)
+
+    # --------------------------------------------------------- padding --
+    def _pad_batch(self, normalized, batch_bucket, seq_bucket):
+        """Coalesce normalized requests into one bucket-shaped feed dict.
+        Shared by the batcher dispatch AND `run_direct`, so the reference
+        path pads byte-identically to the serving path."""
+        feed = {}
+        for n in self.feed_names:
+            var = self._feed_vars[n]
+            if n in self._seq_feeds:
+                data_parts, len_parts = [], []
+                for req in normalized:
+                    padded, lengths = req.seqs[n].to_padded(
+                        max_len=seq_bucket)
+                    if var.dtype is not None:
+                        padded = padded.astype(convert_dtype(var.dtype),
+                                               copy=False)
+                    data_parts.append(padded)
+                    len_parts.append(lengths)
+                data = np.concatenate(data_parts, axis=0)
+                lengths = np.concatenate(len_parts, axis=0)
+                pad_rows = batch_bucket - data.shape[0]
+                if pad_rows:
+                    data = np.concatenate(
+                        [data, np.zeros((pad_rows,) + data.shape[1:],
+                                        dtype=data.dtype)], axis=0)
+                    lengths = np.concatenate(
+                        [lengths, np.ones(pad_rows, dtype=lengths.dtype)])
+                feed[n] = data
+                feed[n + SEQLEN_SUFFIX] = lengths
+            else:
+                arr = np.concatenate([req.dense[n] for req in normalized],
+                                     axis=0)
+                pad_rows = batch_bucket - arr.shape[0]
+                if pad_rows:
+                    arr = np.concatenate(
+                        [arr, np.zeros((pad_rows,) + arr.shape[1:],
+                                       dtype=arr.dtype)], axis=0)
+                feed[n] = arr
+        return feed
+
+    def _pick_buckets(self, rows, max_seq_len):
+        batch_bucket = _covering_bucket(self.batch_buckets, rows,
+                                        "batch rows")
+        seq_bucket = None
+        if self._seq_feeds:
+            seq_bucket = _covering_bucket(self.seq_buckets,
+                                          max(max_seq_len, 1),
+                                          "sequence length")
+        return batch_bucket, seq_bucket
+
+    # -------------------------------------------------------- dispatch --
+    def _run(self, feed):
+        """One executor dispatch under the run lock; returns lazy
+        FetchHandles and whether this call compiled a new bucket.
+        Compile detection compares the cache KEY SET, not its length —
+        at LRU capacity an insert+evict keeps the length constant."""
+        with self._run_lock:
+            before = set(self._exe._cache)
+            # validate=False: the engine already verified the program at
+            # load; re-validating per (bucket) feed signature would walk
+            # the whole program once more per warmup shape under
+            # FLAGS_validate_program=1
+            handles = self._exe.run(self.program, feed=feed,
+                                    fetch_list=self.fetch_names,
+                                    scope=self._scope, return_numpy=False,
+                                    validate=False)
+            compiled = any(k not in before for k in self._exe._cache)
+        return handles, compiled
+
+    def _dispatch(self, requests):
+        """Batcher callback. Requests are grouped by concrete-shape
+        signature (one group, in the common all-dims-declared case) and
+        each group pads into one bucket dispatch; a group that fails
+        fails only ITS requests, never a co-batched group's."""
+        groups = {}
+        for req in requests:
+            groups.setdefault(req.feed.shape_sig, []).append(req)
+        for reqs in groups.values():
+            try:
+                self._dispatch_group(reqs)
+            except Exception as e:  # noqa: BLE001 — isolate the group
+                for r in reqs:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                self.metrics.on_error(len(reqs))
+
+    def _dispatch_group(self, requests):
+        """Pad one shape-compatible group -> one run -> scatter."""
+        t0 = time.monotonic()
+        normalized = [req.feed for req in requests]  # pre-normalized
+        rows = sum(r.rows for r in normalized)
+        batch_bucket, seq_bucket = self._pick_buckets(
+            rows, max(r.max_seq_len for r in normalized))
+        feed = self._pad_batch(normalized, batch_bucket, seq_bucket)
+        handles, compiled = self._run(feed)
+        now = time.monotonic()
+        offset, latencies = 0, []
+        for req, norm in zip(requests, normalized):
+            req.future.bucket = (batch_bucket, seq_bucket)
+            req.future.latency_s = now - req.enqueued_at
+            latencies.append(req.future.latency_s)
+            req.future.set_result(ResultSlice(
+                self.fetch_names, handles, self._fetch_row_policy,
+                offset, offset + norm.rows, batch_bucket,
+                (batch_bucket, seq_bucket),
+                device_slice=self._device_slice))
+            offset += norm.rows
+        self.metrics.on_batch(len(requests), rows, batch_bucket, latencies)
+        from .. import profiler as _prof
+        if _prof.is_active():
+            tag = "serving/%s b%d%s" % (
+                self.name, batch_bucket,
+                "s%d" % seq_bucket if seq_bucket else "")
+            _prof.record_run(tag, now - t0, compiled=compiled)
+
+    # ---------------------------------------------------------- public --
+    def submit(self, feed, deadline_ms=None):
+        """Enqueue one request for coalesced dispatch; returns a
+        RequestFuture whose result is a ResultSlice. Normalization happens
+        HERE, on the caller's thread — a malformed request fails fast and
+        never costs the batcher loop anything. Oversized requests are the
+        batcher's check (RequestTooLargeError at its submit)."""
+        norm = self.normalize_feed(feed)
+        if self._seq_feeds:     # reject unservable lengths before queueing
+            _covering_bucket(self.seq_buckets, max(norm.max_seq_len, 1),
+                             "sequence length")
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        return self._batcher.submit(norm, norm.rows,
+                                    deadline_ms=deadline_ms)
+
+    def infer(self, feed, deadline_ms=None, timeout=30.0):
+        """Synchronous convenience: submit + wait + materialize this
+        request's rows. Returns {fetch_name: np.ndarray}."""
+        return self.submit(feed, deadline_ms=deadline_ms) \
+            .result(timeout).numpy()
+
+    def run_direct(self, feed, batch_bucket=None, seq_bucket=None):
+        """The reference path every test leans on: ONE request, padded by
+        the same `_pad_batch` helper, run directly through Executor.run —
+        no queue, no coalescing. At a given bucket shape this is
+        bit-identical to the rows the same request gets back from a
+        coalesced batch, because both run the same compiled executable at
+        the same shape. Returns ({fetch_name: np.ndarray}, bucket)."""
+        norm = self.normalize_feed(feed)
+        auto_b, auto_s = self._pick_buckets(norm.rows, norm.max_seq_len)
+        batch_bucket = batch_bucket or auto_b
+        seq_bucket = seq_bucket or auto_s
+        if batch_bucket < norm.rows:
+            raise InvalidRequestError(
+                "batch_bucket=%d cannot hold the request's %d rows"
+                % (batch_bucket, norm.rows))
+        if seq_bucket is not None and seq_bucket < norm.max_seq_len:
+            raise InvalidRequestError(
+                "seq_bucket=%d cannot hold the request's longest "
+                "sequence (%d steps)" % (seq_bucket, norm.max_seq_len))
+        padded = self._pad_batch([norm], batch_bucket, seq_bucket)
+        handles, _ = self._run(padded)
+        res = ResultSlice(self.fetch_names, handles,
+                          self._fetch_row_policy, 0, norm.rows,
+                          batch_bucket, (batch_bucket, seq_bucket),
+                          device_slice=self._device_slice)
+        return res.numpy(), (batch_bucket, seq_bucket)
+
+    def warmup(self, buckets=None):
+        """Pre-trace the bucket lattice so steady state never compiles.
+        `buckets`: explicit [(batch, seq|None), ...] (default: the full
+        configured lattice). Feature dims that the model declares as -1
+        warm up at 1 — real traffic at other dims compiles on first hit."""
+        if buckets is None:
+            if self._seq_feeds:
+                buckets = [(b, s) for b in self.batch_buckets
+                           for s in self.seq_buckets]
+            else:
+                buckets = [(b, None) for b in self.batch_buckets]
+        from ..core.executor import _jit_cache_capacity
+        capacity = _jit_cache_capacity()
+        if 0 < capacity < len(buckets):
+            raise ValueError(
+                "bucket lattice has %d shapes but the executor keeps at "
+                "most %d compiled programs (LRU): warmup would evict its "
+                "own buckets and steady state would recompile. Shrink "
+                "the lattice or raise PADDLE_TPU_JIT_CACHE_SIZE."
+                % (len(buckets), capacity))
+        compiled = 0
+        for batch_bucket, seq_bucket in buckets:
+            feed = {}
+            for n in self.feed_names:
+                var = self._feed_vars[n]
+                dtype = convert_dtype(var.dtype) if var.dtype else "float32"
+                if n in self._seq_feeds:
+                    feat = [d if d >= 0 else 1
+                            for d in list(var.shape or [])[2:]]
+                    feed[n] = np.zeros([batch_bucket, seq_bucket or 1]
+                                       + feat, dtype=dtype)
+                    feed[n + SEQLEN_SUFFIX] = np.ones(batch_bucket,
+                                                      dtype=np.int32)
+                else:
+                    feat = [d if d >= 0 else 1
+                            for d in list(var.shape or [])[1:]]
+                    feed[n] = np.zeros([batch_bucket] + feat, dtype=dtype)
+            _, did_compile = self._run(feed)
+            compiled += bool(did_compile)
+        self.metrics.on_warmup_compile(compiled)
+        return compiled
+
+    def queue_depth(self):
+        return self._batcher.queue_depth()
+
+    def describe(self):
+        """The /v1/models entry for this engine."""
+        return {
+            "name": self.name,
+            "feeds": [
+                {"name": n,
+                 "shape": list(self._feed_vars[n].shape or []),
+                 "dtype": convert_dtype(self._feed_vars[n].dtype)
+                 if self._feed_vars[n].dtype else None,
+                 "sequence": n in self._seq_feeds}
+                for n in self.feed_names],
+            "fetches": self.fetch_names,
+            "batch_buckets": self.batch_buckets,
+            "seq_buckets": self.seq_buckets,
+            "max_batch_size": self.max_batch_size,
+            "status": "closed" if self.closed else "serving",
+            "metrics": self.metrics.snapshot(),
+        }
+
+    def close(self, drain=True, timeout=None):
+        """Graceful shutdown: stop intake, drain queued requests (every
+        in-flight batch completes and scatters), join the worker."""
+        self.closed = True
+        self._batcher.close(drain=drain, timeout=timeout)

@@ -1,0 +1,181 @@
+"""Serving metrics: QPS, latency percentiles, batch occupancy, queue
+depth, rejection/deadline counters.
+
+One `ServingMetrics` per `InferenceEngine`. Writers are the request
+threads (submit/reject) and the batcher worker (dispatch); readers are
+`/metrics` (Prometheus text), `/v1/models` (JSON), and bench.py — all
+under one lock, all O(window) worst case.
+
+The batcher worker also threads every dispatch into
+`profiler.record_run` (tag `serving/<model> b<batch>[xs<seq>]`) when the
+profiler is active, so `profile_report()` shows training and serving
+entries side by side in the same Event table.
+"""
+import collections
+import threading
+import time
+
+__all__ = ["ServingMetrics"]
+
+
+def _percentile(sorted_vals, q):
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class ServingMetrics(object):
+    """Thread-safe counters + a bounded latency window.
+
+    Occupancy bookkeeping distinguishes REQUESTS from ROWS: a batch of 5
+    one-row requests padded into an 8-row bucket counts occupancy 5
+    (requests/batch — the coalescing win) and row utilization 5/8 (how
+    much of the compiled bucket carried real data).
+    """
+
+    def __init__(self, latency_window=2048):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.requests_total = 0        # accepted into the queue
+        self.responses_total = 0       # scattered back successfully
+        self.rejected_queue_full = 0   # fast backpressure rejections
+        self.deadline_expired = 0      # dropped before batching
+        self.errors_total = 0          # dispatch/scatter failures
+        self.batches_total = 0         # device dispatches
+        self.batch_requests_total = 0  # requests across all batches
+        self.batch_rows_total = 0      # real rows across all batches
+        self.bucket_rows_total = 0     # padded bucket rows dispatched
+        self.warmup_compiles = 0       # buckets traced at startup
+        self._latencies = collections.deque(maxlen=latency_window)
+        self._queue_depth_fn = None    # live gauge, set by the batcher
+
+    def bind_queue_depth(self, fn):
+        self._queue_depth_fn = fn
+
+    def on_submit(self):
+        with self._lock:
+            self.requests_total += 1
+
+    def on_queue_full(self):
+        with self._lock:
+            self.rejected_queue_full += 1
+
+    def on_deadline_expired(self, n=1):
+        with self._lock:
+            self.deadline_expired += n
+
+    def on_error(self, n=1):
+        with self._lock:
+            self.errors_total += n
+
+    def on_warmup_compile(self, n=1):
+        with self._lock:
+            self.warmup_compiles += n
+
+    def on_batch(self, num_requests, num_rows, bucket_rows, latencies_s):
+        """One dispatch scattered: latencies_s are per-request
+        submit->scatter times (dispatch enqueued; D2H still pending —
+        that cost is the caller's, paid per-request on materialize)."""
+        with self._lock:
+            self.batches_total += 1
+            self.batch_requests_total += num_requests
+            self.batch_rows_total += num_rows
+            self.bucket_rows_total += bucket_rows
+            self.responses_total += num_requests
+            self._latencies.extend(latencies_s)
+
+    def queue_depth(self):
+        fn = self._queue_depth_fn
+        return fn() if fn is not None else 0
+
+    def snapshot(self):
+        with self._lock:
+            lat = sorted(self._latencies)
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            batches = max(self.batches_total, 1)
+            return {
+                "uptime_s": round(elapsed, 3),
+                "requests_total": self.requests_total,
+                "responses_total": self.responses_total,
+                "rejected_queue_full": self.rejected_queue_full,
+                "deadline_expired": self.deadline_expired,
+                "errors_total": self.errors_total,
+                "batches_total": self.batches_total,
+                "qps": round(self.responses_total / elapsed, 3),
+                "mean_batch_occupancy":
+                    round(self.batch_requests_total / batches, 3),
+                "row_utilization":
+                    round(self.batch_rows_total /
+                          max(self.bucket_rows_total, 1), 4),
+                "warmup_compiles": self.warmup_compiles,
+                "queue_depth": self.queue_depth(),
+                "latency_ms": {
+                    "p50": round(_percentile(lat, 0.50) * 1e3, 3),
+                    "p95": round(_percentile(lat, 0.95) * 1e3, 3),
+                    "p99": round(_percentile(lat, 0.99) * 1e3, 3),
+                    "window": len(lat),
+                },
+            }
+
+    def render_prometheus(self, model="default"):
+        """Prometheus text exposition for one model (the /metrics
+        contract). Multi-model servers must use `render_prometheus_all`
+        — concatenating per-model expositions would repeat each family's
+        HELP/TYPE header, which Prometheus rejects as a whole scrape."""
+        return render_prometheus_all({model: self})
+
+
+# (family, type, help, snapshot key) — one HELP/TYPE per family in the
+# exposition, one labeled sample line per model
+_FAMILIES = [
+    ("requests_total", "counter", "accepted requests", "requests_total"),
+    ("responses_total", "counter", "completed requests",
+     "responses_total"),
+    ("rejected_queue_full_total", "counter",
+     "fast rejections due to a full queue (backpressure)",
+     "rejected_queue_full"),
+    ("deadline_expired_total", "counter",
+     "requests dropped before batching: deadline passed",
+     "deadline_expired"),
+    ("errors_total", "counter", "dispatch failures", "errors_total"),
+    ("batches_total", "counter", "device dispatches", "batches_total"),
+    ("qps", "gauge", "responses per second since start", "qps"),
+    ("mean_batch_occupancy", "gauge",
+     "mean requests coalesced per dispatch", "mean_batch_occupancy"),
+    ("row_utilization", "gauge", "real rows / padded bucket rows",
+     "row_utilization"),
+    ("queue_depth", "gauge", "requests waiting right now", "queue_depth"),
+]
+
+
+def _escape_label(value):
+    """Prometheus exposition label escaping: backslash, double quote,
+    newline — an unescaped quote in a model name would invalidate the
+    whole scrape for every model on the server."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def render_prometheus_all(named_metrics):
+    """One valid exposition covering {model_name: ServingMetrics}."""
+    snaps = {_escape_label(name): m.snapshot()
+             for name, m in sorted(named_metrics.items())}
+    lines = []
+    for family, mtype, help_text, key in _FAMILIES:
+        lines.append("# HELP ptpu_serving_%s %s" % (family, help_text))
+        lines.append("# TYPE ptpu_serving_%s %s" % (family, mtype))
+        for model, s in snaps.items():
+            lines.append('ptpu_serving_%s{model="%s"} %s'
+                         % (family, model, s[key]))
+    lines.append("# HELP ptpu_serving_latency_ms request latency "
+                 "percentiles (submit -> scatter)")
+    lines.append("# TYPE ptpu_serving_latency_ms gauge")
+    for model, s in snaps.items():
+        for q in ("p50", "p95", "p99"):
+            lines.append(
+                'ptpu_serving_latency_ms{model="%s",quantile="%s"} %s'
+                % (model, q, s["latency_ms"][q]))
+    return "\n".join(lines) + "\n"

@@ -72,6 +72,41 @@ def test_bench_multistep_smoke():
     assert "loss" in rec and rec["loss"] == rec["loss"]
 
 
+def test_bench_serving_smoke():
+    """The BENCH_SERVING leg: one subprocess run on CPU with a tiny MLP
+    through the real InferenceEngine + batcher. The acceptance gates ride
+    here: coalescing must actually coalesce (mean batch occupancy > 1)
+    and closed-loop throughput must beat the serial batch=1 baseline —
+    otherwise the serving runtime is a queue with extra steps."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_SERVING": "1",
+        "BENCH_SERVING_REQUESTS": "128", "BENCH_SERVING_SERIAL": "32",
+        "BENCH_SERVING_CLIENTS": "16", "BENCH_SERVING_MAX_BATCH": "8",
+        # deep-and-narrow: dispatch-bound, so the coalescing win is a
+        # multiple, not a margin host noise can flip (see bench_serving)
+        "BENCH_SERVING_HIDDEN": "64", "BENCH_SERVING_LAYERS": "10",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "serving_throughput"
+    assert rec["unit"] == "requests/sec/chip"
+    assert rec["vs_baseline"] is None
+    assert rec["mean_batch_occupancy"] > 1.0
+    assert rec["value"] > rec["serial_qps"] > 0
+    assert rec["open_qps"] > 0
+    for k in ("closed_p50_ms", "closed_p95_ms", "closed_p99_ms",
+              "open_p50_ms", "open_p95_ms", "open_p99_ms",
+              "row_utilization"):
+        assert rec[k] >= 0
+
+
 def test_tool_shell_scripts_parse():
     """bash -n every tools/*.sh: a syntax error in a sweep script would
     consume the round's only healthy tunnel window (the probe loop

@@ -77,6 +77,127 @@ def test_load_checkpoint_empty_dir_returns_none(tmp_path):
         assert fluid.io.load_checkpoint(exe, str(tmp_path), main) is None
 
 
+def test_save_vars_missing_from_scope_raises(tmp_path):
+    """Silent checkpoint corruption, save side: a persistable var with no
+    scope value used to be skipped quietly, producing a checkpoint that
+    omits params with no signal. Now it raises; allow_missing=True keeps
+    the legacy lenient behavior for intentionally partial saves."""
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        # startup NOT run: every param is missing from the scope
+        with pytest.raises(RuntimeError, match="allow_missing"):
+            fluid.io.save_params(exe, str(tmp_path / "a"), main)
+        # legacy opt-out: writes an (explicitly) partial manifest
+        fluid.io.save_params(exe, str(tmp_path / "b"), main,
+                             allow_missing=True)
+        import json
+        with open(str(tmp_path / "b" / "manifest.json")) as f:
+            assert json.load(f) == {}
+
+
+def test_failed_save_leaves_existing_checkpoint_intact(tmp_path):
+    """The strict save must check EVERY var before writing the first
+    byte: a raise mid-write into an existing checkpoint dir would leave
+    the old manifest over a mix of new and old arrays — undetectable
+    corruption at load time."""
+    import json
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "ckpt")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_params(exe, d, main)          # good checkpoint
+        with open(d + "/manifest.json") as f:
+            manifest_before = f.read()
+        good = {n: np.asarray(scope.get(n)).copy()
+                for n in json.loads(manifest_before)}
+        # poison ONE param mid-list, then retry the save over the dir
+        victim = sorted(good)[len(good) // 2]
+        scope.drop(victim)
+        for n in good:                              # perturb live values
+            if scope.get(n) is not None:
+                scope.set(n, np.asarray(scope.get(n)) + 1.0)
+        with pytest.raises(RuntimeError, match="allow_missing"):
+            fluid.io.save_params(exe, d, main)
+    # the old checkpoint must be byte-for-byte untouched
+    with open(d + "/manifest.json") as f:
+        assert f.read() == manifest_before
+    for n, arr in good.items():
+        fname = json.loads(manifest_before)[n]["file"]
+        np.testing.assert_array_equal(np.load(d + "/" + fname), arr)
+
+
+def test_load_vars_missing_from_manifest_raises(tmp_path):
+    """Silent checkpoint corruption, load side: a requested var absent
+    from the manifest used to be silently left at its init value — the
+    classic corrupted resume. Now it raises, naming the absentees."""
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # save only ONE parameter, then ask for all of them back
+        some_param = main.all_parameters()[0]
+        fluid.io.save_params(exe, str(tmp_path), main, vars=[some_param])
+        with pytest.raises(RuntimeError, match="manifest"):
+            fluid.io.load_params(exe, str(tmp_path), main)
+        # legacy opt-out: partial restore proceeds
+        fluid.io.load_params(exe, str(tmp_path), main, allow_missing=True)
+    # manifest-driven loads (no program to cross-check) stay lenient:
+    # load_inference_model-style restores load exactly what was saved
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.io.load_params(exe, str(tmp_path))
+        assert scope2.get(some_param.name) is not None
+
+
+def test_checkpoint_roundtrip_with_reader_program(tmp_path):
+    """Reader vars are persistable but their scope value is live host
+    ReaderState — strict save/load must treat them as runtime plumbing
+    (skipped on save, not demanded on load), not corruption."""
+    def gen():
+        r = np.random.RandomState(0)
+        for _ in range(8):
+            xs = r.rand(4, 6).astype("float32")
+            yield xs, xs[:, :1].copy()
+
+    path = str(tmp_path / "ckpt_reader.recordio")
+    fluid.recordio_writer.convert_reader_to_recordio_file(path, gen)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        r = fluid.layers.open_recordio_file(
+            filename=path, shapes=[[-1, 6], [-1, 1]], lod_levels=[0, 0],
+            dtypes=["float32", "float32"])
+        x, y = fluid.layers.read_file(r)
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    ckpt = str(tmp_path / "ckpt")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, fetch_list=[loss])
+        # strict mode must neither choke on the live ReaderState at save
+        # nor demand the reader var back at load
+        fluid.io.save_persistables(exe, ckpt, main)
+        fluid.io.load_persistables(exe, ckpt, main)
+        l2, = exe.run(main, fetch_list=[loss])
+        assert np.isfinite(np.asarray(l2)).all()
+        # the reader classification must survive a desc round trip: a
+        # DESERIALIZED program loses the layers.io python attributes, so
+        # detection has to come from the ops, or resume from a reloaded
+        # program would false-positive as corruption
+        from paddle_tpu.core import program_desc
+        reloaded = program_desc.program_from_bytes(
+            program_desc.program_to_bytes(main))
+        fluid.io.load_persistables(exe, ckpt, reloaded)
+
+
 def test_run_main_before_startup_raises():
     main, startup, loss = _build()
     exe = fluid.Executor(fluid.CPUPlace())

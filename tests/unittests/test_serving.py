@@ -1,0 +1,673 @@
+"""paddle_tpu.serving: batched online inference runtime.
+
+The load-bearing invariant: a request's rows come back BIT-IDENTICAL
+whether the request was dispatched alone or coalesced with strangers,
+because every dispatch runs at a bucket shape from the engine's lattice
+and XLA row results at a fixed compiled shape depend only on that row's
+values. The reference side of each comparison is `engine.run_direct` —
+one request, the same padding helper, a plain single-request
+`Executor.run` — pinned to the bucket the batch actually used (the
+future records it).
+
+Robustness legs: queue-full fast rejection, per-request deadline expiry
+before batching, graceful drain on shutdown, era-wire model served over
+HTTP, known-bad saved models rejected at load by the static verifier.
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import serving
+from paddle_tpu.serving.batcher import Batcher
+
+
+def _save_dense_model(tmp_path, seed=0, feat=6, classes=3):
+    """fc->relu->fc->softmax inference dir; returns (dir, ref_fn) where
+    ref_fn(x) runs the ORIGINAL program directly for sanity checks."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "dense_model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe, main)
+    return d
+
+
+def _save_seq_model(tmp_path, seed=0, vocab=40, emb=8, classes=2):
+    """embedding -> sequence sum-pool -> fc softmax (a sequence model:
+    the feed is a LoDTensor and rides the @SEQLEN machinery)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        e = fluid.layers.embedding(input=words, size=[vocab, emb])
+        pool = fluid.layers.sequence_pool(input=e, pool_type="sum")
+        pred = fluid.layers.fc(input=pool, size=classes, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "seq_model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["words"], [pred], exe, main)
+    return d
+
+
+def _concurrent_submit(engine, feeds):
+    """Fire all feeds from distinct threads; return futures in order."""
+    futures = [None] * len(feeds)
+
+    def fire(i):
+        futures[i] = engine.submit(feeds[i])
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(len(feeds))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return futures
+
+
+# --------------------------------------------------------------------------
+# bit-exactness: batched == single-request Executor.run at the same bucket
+# --------------------------------------------------------------------------
+
+def test_batched_bit_identical_dense(tmp_path):
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[4],
+                                     max_queue_delay_ms=30)
+    rng = np.random.RandomState(3)
+    feeds = [{"x": rng.rand(1, 6).astype("f")} for _ in range(4)]
+    futures = _concurrent_submit(engine, feeds)
+    results = [f.result(30) for f in futures]
+    # with one bucket and 4 concurrent 1-row requests they coalesce;
+    # regardless of how many batches actually formed, every request must
+    # match its own single-request run at the bucket it was dispatched at
+    fetch = engine.fetch_names[0]
+    for feed, res in zip(feeds, results):
+        batched = res.numpy()[fetch]
+        direct, _ = engine.run_direct(feed, batch_bucket=res.bucket[0])
+        np.testing.assert_array_equal(batched, direct[fetch])
+    assert engine.metrics.snapshot()["mean_batch_occupancy"] > 1.0
+    engine.close()
+
+
+def test_batched_bit_identical_sequence(tmp_path):
+    """Sequence model: ragged requests pad to the (batch, seq) bucket via
+    core/lod.py + @SEQLEN; coalesced rows must equal the single-request
+    run bit for bit, including requests of different lengths sharing one
+    batch."""
+    d = _save_seq_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[8],
+                                     seq_buckets=[8, 16],
+                                     max_queue_delay_ms=30)
+    rng = np.random.RandomState(5)
+    feeds = []
+    for n_seq, lens in ((1, [3]), (2, [5, 2]), (3, [7, 1, 4])):
+        feeds.append({"words": [rng.randint(0, 40, (l, 1)).astype("int64")
+                                for l in lens]})
+    futures = _concurrent_submit(engine, feeds)
+    results = [f.result(30) for f in futures]
+    fetch = engine.fetch_names[0]
+    for feed, res in zip(feeds, results):
+        batched = res.numpy()[fetch]
+        direct, _ = engine.run_direct(feed, batch_bucket=res.bucket[0],
+                                      seq_bucket=res.bucket[1])
+        np.testing.assert_array_equal(batched, direct[fetch])
+        assert batched.shape[0] == len(feed["words"])
+    engine.close()
+
+
+def test_lodtensor_and_list_feeds_agree(tmp_path):
+    """A LoDTensor feed and the equivalent list-of-sequences feed are the
+    same request; same bucket -> same bits."""
+    from paddle_tpu.core.lod import LoDTensor
+    d = _save_seq_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[2],
+                                     seq_buckets=[8],
+                                     max_queue_delay_ms=1)
+    rng = np.random.RandomState(7)
+    seqs = [rng.randint(0, 40, (4, 1)).astype("int64"),
+            rng.randint(0, 40, (6, 1)).astype("int64")]
+    a = engine.infer({"words": seqs})
+    b = engine.infer({"words": LoDTensor.from_sequences(seqs)})
+    np.testing.assert_array_equal(a[engine.fetch_names[0]],
+                                  b[engine.fetch_names[0]])
+    engine.close()
+
+
+def test_warmup_precompiles_lattice(tmp_path):
+    """After warmup, traffic at any lattice shape never compiles: the
+    executor cache holds every (batch, seq) bucket."""
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[1, 2, 4],
+                                     max_queue_delay_ms=1)
+    assert engine.metrics.snapshot()["warmup_compiles"] == 3
+    n_compiled = len(engine._exe._cache)
+    rng = np.random.RandomState(0)
+    for rows in (1, 2, 3, 4, 1):
+        engine.infer({"x": rng.rand(rows, 6).astype("f")})
+    assert len(engine._exe._cache) == n_compiled  # steady state: no trace
+    engine.close()
+
+
+# --------------------------------------------------------------------------
+# concurrency, backpressure, deadlines, drain
+# --------------------------------------------------------------------------
+
+def test_concurrent_clients_mixed_rows(tmp_path):
+    """Many clients, mixed row counts, multiple batches: every response
+    correct (vs run_direct at its own bucket), metrics add up."""
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, max_batch_size=8,
+                                     max_queue_delay_ms=2)
+    rng = np.random.RandomState(11)
+    feeds = [{"x": rng.rand(int(rng.randint(1, 4)), 6).astype("f")}
+             for _ in range(24)]
+    futures = _concurrent_submit(engine, feeds)
+    fetch = engine.fetch_names[0]
+    for feed, fut in zip(feeds, futures):
+        res = fut.result(60)
+        direct, _ = engine.run_direct(feed, batch_bucket=res.bucket[0])
+        np.testing.assert_array_equal(res.numpy()[fetch], direct[fetch])
+    snap = engine.metrics.snapshot()
+    assert snap["responses_total"] == 24
+    assert snap["batches_total"] >= 1
+    assert snap["errors_total"] == 0
+    engine.close()
+
+
+def test_queue_full_fast_rejection():
+    """Backpressure: a full bounded queue rejects IMMEDIATELY with
+    QueueFullError — no blocking, no unbounded latency — and the batcher
+    keeps serving once the worker unblocks."""
+    release, started = threading.Event(), threading.Event()
+    served = []
+
+    def slow_dispatch(requests):
+        started.set()
+        release.wait(30)
+        for r in requests:
+            served.append(r.rows)
+            r.future.set_result("ok")
+
+    b = Batcher(slow_dispatch, max_batch_size=1, max_queue_delay_ms=0,
+                queue_capacity=2)
+    futures = [b.submit({"r": 0}, rows=1)]
+    started.wait(10)                    # worker busy inside dispatch
+    futures.append(b.submit({"r": 1}, rows=1))
+    futures.append(b.submit({"r": 2}, rows=1))   # queue now at capacity 2
+    t0 = time.monotonic()
+    with pytest.raises(serving.QueueFullError):
+        b.submit({"r": 3}, rows=1)
+    assert time.monotonic() - t0 < 0.5  # fast, not queued-then-timed-out
+    release.set()
+    for f in futures:
+        assert f.result(30) == "ok"
+    b.close()
+
+
+def test_deadline_expired_dropped_before_batching():
+    """Requests whose deadline passes while queued are answered with
+    DeadlineExceededError and NEVER reach dispatch (no device work for a
+    client that already hung up)."""
+    release, started = threading.Event(), threading.Event()
+    dispatched = []
+
+    def dispatch(requests):
+        started.set()
+        release.wait(30)
+        for r in requests:
+            dispatched.append(r.feed["tag"])
+            r.future.set_result("ok")
+
+    b = Batcher(dispatch, max_batch_size=4, max_queue_delay_ms=0,
+                queue_capacity=16)
+    first = b.submit({"tag": "keeps-worker-busy"}, rows=1)
+    started.wait(10)
+    doomed = b.submit({"tag": "doomed"}, rows=1, deadline_ms=10)
+    alive = b.submit({"tag": "alive"}, rows=1)   # no deadline
+    time.sleep(0.05)                              # doomed expires in queue
+    release.set()
+    assert first.result(30) == "ok"
+    assert alive.result(30) == "ok"
+    with pytest.raises(serving.DeadlineExceededError):
+        doomed.result(30)
+    assert "doomed" not in dispatched
+    b.close()
+
+
+def test_engine_deadline_metrics(tmp_path):
+    """Deadline expiry through the real engine: a request stuck BEHIND
+    other dispatches past its deadline is dropped (counted in metrics,
+    typed error) — the batcher can only beat deadlines it controls; a
+    busy device queue is exactly when shedding matters."""
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[1],
+                                     max_queue_delay_ms=0,
+                                     queue_capacity=64)
+    rng = np.random.RandomState(0)
+    # hold the engine's run lock: the worker blocks inside the filler's
+    # dispatch while the doomed request's 1ms deadline expires in queue
+    with engine._run_lock:
+        filler = engine.submit({"x": rng.rand(1, 6).astype("f")})
+        doomed = engine.submit({"x": rng.rand(1, 6).astype("f")},
+                               deadline_ms=1)
+        time.sleep(0.05)
+    filler.result(30)
+    with pytest.raises(serving.DeadlineExceededError):
+        doomed.result(30)
+    assert engine.metrics.snapshot()["deadline_expired"] == 1
+    engine.close()
+
+
+def test_short_deadline_caps_coalescing_window(tmp_path):
+    """The fix the batcher exists to honor: a deadline SHORTER than
+    max_queue_delay must cap the coalescing window, not lose to it — the
+    request dispatches early and succeeds instead of 504ing under light
+    load."""
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[4],
+                                     max_queue_delay_ms=2000,
+                                     queue_capacity=8)
+    rng = np.random.RandomState(0)
+    t0 = time.monotonic()
+    out = engine.infer({"x": rng.rand(1, 6).astype("f")},
+                       deadline_ms=150, timeout=10)
+    elapsed = time.monotonic() - t0
+    assert out[engine.fetch_names[0]].shape[0] == 1
+    assert elapsed < 1.0   # dispatched at the deadline, not the 2s window
+    engine.close()
+
+
+def test_graceful_drain_on_shutdown(tmp_path):
+    """close(drain=True) completes every queued request before the worker
+    exits; submits AFTER close are rejected with ServingClosedError."""
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, max_batch_size=4,
+                                     max_queue_delay_ms=500,
+                                     queue_capacity=64)
+    rng = np.random.RandomState(1)
+    feeds = [{"x": rng.rand(1, 6).astype("f")} for _ in range(10)]
+    futures = _concurrent_submit(engine, feeds)
+    engine.close(drain=True, timeout=60)   # long delay window: drain must
+    fetch = engine.fetch_names[0]          # cut it short, not wait it out
+    for feed, fut in zip(feeds, futures):
+        res = fut.result(5)                # already completed by drain
+        assert res.numpy()[fetch].shape[0] == 1
+    with pytest.raises(serving.ServingClosedError):
+        engine.submit(feeds[0])
+
+
+def test_invalid_requests_rejected_before_queue(tmp_path):
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[1, 2],
+                                     max_queue_delay_ms=1)
+    rng = np.random.RandomState(0)
+    with pytest.raises(serving.InvalidRequestError):
+        engine.submit({})                                  # missing feed
+    with pytest.raises(serving.InvalidRequestError):
+        engine.submit({"x": rng.rand(1, 5).astype("f")})   # wrong feat dim
+    with pytest.raises(serving.InvalidRequestError):
+        engine.submit({"x": rng.rand(1, 6).astype("f"),
+                       "bogus": rng.rand(1, 2).astype("f")})
+    with pytest.raises(serving.RequestTooLargeError):
+        engine.submit({"x": rng.rand(3, 6).astype("f")})   # > max bucket
+    assert engine.metrics.snapshot()["requests_total"] == 0
+    engine.close()
+
+
+def test_bad_sequence_shape_cannot_poison_batch(tmp_path):
+    """A sequence request with wrong per-token feature dims must be
+    rejected at submit (the caller's thread, typed error) — discovered
+    inside the batcher's concat it would fail every innocent co-batched
+    request."""
+    d = _save_seq_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[4],
+                                     seq_buckets=[8],
+                                     max_queue_delay_ms=20)
+    rng = np.random.RandomState(0)
+    with pytest.raises(serving.InvalidRequestError):
+        engine.submit({"words": [rng.randint(0, 40, (3, 2))
+                                 .astype("int64")]})   # feat 2, wants 1
+    # an innocent request right after is untouched
+    good = [rng.randint(0, 40, (3, 1)).astype("int64")]
+    out = engine.infer({"words": good})
+    assert out[engine.fetch_names[0]].shape[0] == 1
+    assert engine.metrics.snapshot()["errors_total"] == 0
+    engine.close()
+
+
+def test_warmup_refuses_lattice_beyond_jit_cache(tmp_path, monkeypatch):
+    """'Steady state never compiles' must fail loudly when it can't hold:
+    a bucket lattice larger than the executor's LRU capacity would evict
+    its own warmup and recompile on every miss."""
+    monkeypatch.setenv("PADDLE_TPU_JIT_CACHE_SIZE", "2")
+    d = _save_dense_model(tmp_path)
+    before = threading.active_count()
+    with pytest.raises(ValueError, match="PADDLE_TPU_JIT_CACHE_SIZE"):
+        serving.InferenceEngine(d, batch_buckets=[1, 2, 4])
+    # the failed constructor must not leak its batcher worker thread
+    # (a server retry-loop would accumulate one per attempt)
+    deadline = time.monotonic() + 5
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before
+
+
+# --------------------------------------------------------------------------
+# model loading: verifier at load, era-wire over HTTP
+# --------------------------------------------------------------------------
+
+def _write_bad_model(tmp_path):
+    """A saved model whose program reads a var nobody produces/feeds:
+    the def-use pass must reject it at LOAD, not mid-request."""
+    from paddle_tpu.core import program_desc
+    p = fluid.Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[-1, 4], dtype="float32", is_data=True)
+    blk.create_var(name="o", shape=[-1, 4], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["ghost"]},
+                  outputs={"Out": ["o"]}, infer_shape=False)
+    d = str(tmp_path / "bad_model")
+    os.makedirs(d)
+    with open(os.path.join(d, "__model__"), "wb") as f:
+        f.write(program_desc.program_to_bytes(p))
+    with open(os.path.join(d, "__model_meta__.json"), "w") as f:
+        json.dump({"feed": ["x"], "fetch": ["o"]}, f)
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump({}, f)
+    return d
+
+
+def test_engine_rejects_known_bad_model(tmp_path):
+    d = _write_bad_model(tmp_path)
+    with pytest.raises(fluid.ProgramVerificationError) as ei:
+        serving.InferenceEngine(d)
+    assert any(diag.code == "use-before-def"
+               for diag in ei.value.diagnostics)
+
+
+def test_load_inference_model_validates_behind_flag(tmp_path,
+                                                    monkeypatch):
+    """FLAGS_validate_program=1 arms the same verifier inside plain
+    load_inference_model; default stays lenient (the analyzer is opt-in
+    outside serving)."""
+    d = _write_bad_model(tmp_path)
+    exe = fluid.Executor(fluid.CPUPlace())
+    monkeypatch.setenv("FLAGS_validate_program", "1")
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(fluid.ProgramVerificationError):
+            fluid.io.load_inference_model(d, exe)
+    monkeypatch.delenv("FLAGS_validate_program")
+    with fluid.scope_guard(fluid.Scope()):
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        assert feeds == ["x"]
+
+
+def test_era_wire_model_served_over_http(tmp_path):
+    """End to end across the whole stack: train-era export
+    (save_reference_model: wire ProgramDesc + LoDTensor param files) ->
+    InferenceEngine auto-detects the era format -> ThreadingHTTPServer ->
+    JSON predict — responses match the original program's outputs."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[5], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        pred = fluid.layers.fc(input=h, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "era_model")
+    rng = np.random.RandomState(9)
+    xs = rng.rand(2, 5).astype("f")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        want, = exe.run(main.prune(pred), feed={"x": xs},
+                        fetch_list=[pred])
+        fluid.io.save_reference_model(d, ["x"], [pred], exe, main)
+
+    engine = serving.InferenceEngine(d, name="era", batch_buckets=[2, 4],
+                                     max_queue_delay_ms=1)
+    server = serving.ModelServer(engine, port=0).start()
+    base = "http://%s" % server.address
+    try:
+        body = json.dumps({"inputs": {"x": xs.tolist()}}).encode()
+        resp = json.loads(urllib.request.urlopen(urllib.request.Request(
+            base + "/v1/models/era:predict", data=body,
+            headers={"Content-Type": "application/json"})).read())
+        got = np.asarray(resp["outputs"][engine.fetch_names[0]],
+                         dtype="f")
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5,
+                                   atol=1e-6)
+        assert resp["bucket"][0] == 2
+
+        # the rest of the surface
+        health = json.loads(urllib.request.urlopen(
+            base + "/healthz").read())
+        assert health["status"] == "ok"
+        models = json.loads(urllib.request.urlopen(
+            base + "/v1/models").read())
+        assert [m["name"] for m in models["models"]] == ["era"]
+        assert models["models"][0]["metrics"]["responses_total"] == 1
+        metrics_text = urllib.request.urlopen(
+            base + "/metrics").read().decode()
+        assert 'ptpu_serving_qps{model="era"}' in metrics_text
+
+        # error mapping: unknown model -> 404, malformed inputs -> 400
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/models/nope:predict", data=body))
+        assert he.value.code == 404
+        bad = json.dumps({"inputs": {"x": [[1.0, 2.0]]}}).encode()
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/models/era:predict", data=bad))
+        assert he.value.code == 400
+    finally:
+        server.shutdown()
+    # after shutdown the engine refuses work
+    with pytest.raises(serving.ServingClosedError):
+        engine.submit({"x": xs})
+
+
+def test_http_deadline_maps_to_504(tmp_path):
+    """A request that expires in the queue comes back as HTTP 504 — a
+    fast typed error, not a stalled connection."""
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, name="m", batch_buckets=[1],
+                                     max_queue_delay_ms=0,
+                                     queue_capacity=64)
+    server = serving.ModelServer(engine, port=0).start()
+    base = "http://%s" % server.address
+    rng = np.random.RandomState(0)
+    try:
+        # hold the run lock so the 1ms deadline expires while queued
+        # behind a dispatch-in-progress; release it shortly after the
+        # HTTP request lands so the batcher can form the next batch and
+        # answer the expired request
+        engine._run_lock.acquire()
+        engine.submit({"x": rng.rand(1, 6).astype("f")})
+        threading.Timer(0.1, engine._run_lock.release).start()
+        body = json.dumps({"inputs": {"x": rng.rand(1, 6).tolist()},
+                           "deadline_ms": 1}).encode()
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/models/m:predict", data=body))
+        assert he.value.code == 504
+    finally:
+        server.shutdown()
+
+
+def test_fetch_row_policy(tmp_path):
+    """Per-fetch row policy: a fetched PARAMETER whose leading dim
+    equals the bucket comes back whole (never per-row); a batch output
+    (declared leading -1) is sliced to the request's rows; a
+    non-persistable fetch with a concrete leading dim matching the
+    bucket is sliced too — returning it whole could hand one client
+    co-batched strangers' rows, and privacy beats shape fidelity in the
+    ambiguous case."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=3, bias_attr=False,
+                               param_attr=fluid.ParamAttr(name="w_fc"))
+        fixed = fluid.layers.fill_constant(shape=[6, 2], dtype="float32",
+                                           value=3.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    engine = serving.InferenceEngine(
+        program=main, feed_names=["x"],
+        fetch_vars=[pred, main.global_block().var("w_fc"), fixed],
+        batch_buckets=[6],      # == w_fc's AND fixed's leading dim
+        max_queue_delay_ms=1, warmup=False, validate=False)
+    with fluid.scope_guard(engine._scope):
+        exe.run(startup)
+    engine.warmup()
+    rng = np.random.RandomState(2)
+    out = engine.infer({"x": rng.rand(2, 6).astype("f")})
+    assert out[engine.fetch_names[0]].shape == (2, 3)   # rows: sliced
+    assert out["w_fc"].shape == (6, 3)                  # param: whole
+    assert out[fixed.name].shape == (2, 2)              # dynamic: sliced
+    engine.close()
+
+
+def test_free_feature_dim_requests_group_by_shape(tmp_path):
+    """A model with a free (-1) feature dim serves mixed widths: the
+    dispatcher groups coalesced requests by concrete shape signature, so
+    a [1,8] and a [1,16] request in the same window each succeed instead
+    of one poisoning the other's concat."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[-1, -1], dtype="float32",
+                              append_batch_size=False)
+        out = fluid.layers.reduce_sum(x, dim=1, keep_dim=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    engine = serving.InferenceEngine(
+        program=main, feed_names=["x"], fetch_vars=[out],
+        batch_buckets=[2], max_queue_delay_ms=30, warmup=False,
+        validate=False)
+    with fluid.scope_guard(engine._scope):
+        exe.run(startup)
+    rng = np.random.RandomState(4)
+    feeds = [{"x": rng.rand(1, 8).astype("f")},
+             {"x": rng.rand(1, 16).astype("f")}]
+    futures = _concurrent_submit(engine, feeds)
+    for feed, fut in zip(feeds, futures):
+        got = fut.result(30).numpy()[engine.fetch_names[0]]
+        np.testing.assert_allclose(
+            got, feed["x"].sum(axis=1, keepdims=True), rtol=1e-6)
+    assert engine.metrics.snapshot()["errors_total"] == 0
+    engine.close()
+
+
+def test_empty_sequence_rejected(tmp_path):
+    """A zero-length sequence would put @SEQLEN=0 on a REAL row and
+    divide-by-zero in length-normalizing ops — a client fault, answered
+    as a typed 400-class error at submit, not a NaN-shaped 500 later."""
+    d = _save_seq_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[2],
+                                     seq_buckets=[8],
+                                     max_queue_delay_ms=1)
+    rng = np.random.RandomState(0)
+    with pytest.raises(serving.InvalidRequestError, match="empty"):
+        engine.submit({"words": [rng.randint(0, 40, (3, 1)).astype("i8"),
+                                 np.zeros((0, 1), dtype="int64")]})
+    engine.close()
+
+
+def test_run_direct_bucket_too_small_rejected(tmp_path):
+    """run_direct with an explicit bucket smaller than the request gives
+    the typed error naming rows vs bucket, not a numpy crash."""
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[1, 4],
+                                     max_queue_delay_ms=1)
+    rng = np.random.RandomState(0)
+    with pytest.raises(serving.InvalidRequestError, match="rows"):
+        engine.run_direct({"x": rng.rand(2, 6).astype("f")},
+                          batch_bucket=1)
+    engine.close()
+
+
+def test_scalar_dense_feed_rejected(tmp_path):
+    """A 0-d value for a dense feed is a typed client error (400 over
+    HTTP), not an IndexError deep in normalize."""
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[1],
+                                     max_queue_delay_ms=1)
+    with pytest.raises(serving.InvalidRequestError, match="scalar"):
+        engine.submit({"x": np.float32(5.0)})
+    engine.close()
+
+
+def test_chunked_post_rejected_411(tmp_path):
+    """Chunked POSTs carry no Content-Length; the body would desync the
+    keep-alive stream, so the server answers 411 and drops the
+    connection instead of misreading chunk data as the next request."""
+    import socket
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, name="m", batch_buckets=[1],
+                                     max_queue_delay_ms=1)
+    server = serving.ModelServer(engine, port=0).start()
+    host, port = server.httpd.server_address[:2]
+    try:
+        s = socket.create_connection((host, port), timeout=10)
+        s.sendall(b"POST /v1/models/m:predict HTTP/1.1\r\n"
+                  b"Host: x\r\nTransfer-Encoding: chunked\r\n\r\n"
+                  b"5\r\nhello\r\n0\r\n\r\n")
+        resp = s.recv(65536).decode()
+        assert resp.startswith("HTTP/1.1 411"), resp[:80]
+        s.close()
+    finally:
+        server.shutdown()
+
+
+def test_multi_model_metrics_single_exposition(tmp_path):
+    """/metrics with several registered models must emit each family's
+    HELP/TYPE exactly once (Prometheus rejects the whole scrape on a
+    repeated header), with one labeled sample per model."""
+    d = _save_dense_model(tmp_path)
+    a = serving.InferenceEngine(d, name="a", batch_buckets=[1],
+                                max_queue_delay_ms=1, warmup=False)
+    b = serving.InferenceEngine(d, name="b", batch_buckets=[1],
+                                max_queue_delay_ms=1, warmup=False)
+    server = serving.ModelServer({"a": a, "b": b}, port=0).start()
+    try:
+        text = urllib.request.urlopen(
+            "http://%s/metrics" % server.address).read().decode()
+        assert text.count("# TYPE ptpu_serving_requests_total counter") \
+            == 1
+        assert text.count("# TYPE ptpu_serving_qps gauge") == 1
+        assert 'ptpu_serving_qps{model="a"}' in text
+        assert 'ptpu_serving_qps{model="b"}' in text
+    finally:
+        server.shutdown()
+
+
+def test_profiler_report_covers_serving(tmp_path):
+    """Serving dispatches land in the SAME profiler table as training
+    runs (profiler.record_run under a serving/ tag)."""
+    from paddle_tpu import profiler
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[1],
+                                     max_queue_delay_ms=1)
+    rng = np.random.RandomState(0)
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    try:
+        engine.infer({"x": rng.rand(1, 6).astype("f")})
+    finally:
+        profiler.stop_profiler()
+    report = profiler.profile_report()
+    profiler.reset_profiler()
+    assert "serving/" in report
+    engine.close()

@@ -1,0 +1,243 @@
+#!/usr/bin/env python3
+"""ptpu_serve — serve a saved model over HTTP with dynamic micro-batching.
+
+    tools/ptpu_serve.py <model-dir> [--port 8080] [--host 127.0.0.1]
+        [--format auto|native|reference] [--params-filename NAME]
+        [--name NAME] [--place cpu|tpu]
+        [--warmup-buckets 1,4,8x32,8x64] [--max-batch 32]
+        [--max-delay-ms 5] [--deadline-ms N] [--queue-capacity 256]
+
+`--warmup-buckets` configures the (batch, seq) lattice: bare integers are
+batch buckets, `BxS` pairs add S to the seq-bucket set (sequence models
+warm the full batch-buckets x seq-buckets product). Endpoints:
+/v1/models, /v1/models/<name>:predict, /healthz, /metrics.
+
+Deploy smoke gate:
+
+    tools/ptpu_serve.py <model-dir> --selfcheck 32
+
+loads the model, fires N random requests through the REAL batcher from
+concurrent threads, compares every response bit-for-bit against a direct
+single-request Executor.run at the same bucket, prints a verdict, and
+exits nonzero on any mismatch — wire it before flipping traffic.
+"""
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def parse_buckets(spec):
+    """'1,4,8x32,8x64' -> (batch_buckets=[1,4,8], seq_buckets=[32,64])."""
+    if not spec:
+        return None, None
+    batch, seq = set(), set()
+    for part in spec.split(","):
+        part = part.strip().lower()
+        if not part:
+            continue
+        if "x" in part:
+            b, s = part.split("x", 1)
+            batch.add(int(b))
+            seq.add(int(s))
+        else:
+            batch.add(int(part))
+    return sorted(batch) or None, sorted(seq) or None
+
+
+def selfcheck(engine, n_requests, rows_max=4, seed=0):
+    """Fire n random requests through the batcher concurrently; verify
+    each against run_direct at the bucket the batch actually used.
+    Returns the number of mismatches (submit failures count)."""
+    import time
+
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    rows_max = max(1, min(rows_max, engine.max_batch_size))
+    feed_specs = engine.describe()["feeds"]
+    requests = []
+    for _ in range(n_requests):
+        rows = int(rng.randint(1, rows_max + 1))
+        feed = {}
+        for spec in feed_specs:
+            name, dtype = spec["name"], spec["dtype"] or "float32"
+            if spec["sequence"]:
+                feat = [d if d >= 0 else 1 for d in spec["shape"][2:]]
+                max_s = engine.seq_buckets[-1] if engine.seq_buckets else 8
+                lens = rng.randint(1, max(2, max_s // 2), size=rows)
+                if "int" in dtype:
+                    feed[name] = [rng.randint(0, 4, [int(l)] + feat)
+                                  .astype(dtype) for l in lens]
+                else:
+                    feed[name] = [rng.randn(*([int(l)] + feat))
+                                  .astype(dtype) for l in lens]
+            else:
+                feat = [d if d >= 0 else 1 for d in spec["shape"][1:]]
+                if "int" in dtype:
+                    feed[name] = rng.randint(0, 4, [rows] + feat) \
+                        .astype(dtype)
+                else:
+                    feed[name] = rng.randn(*([rows] + feat)).astype(dtype)
+        requests.append(feed)
+
+    from paddle_tpu.serving import QueueFullError
+    futures = [None] * n_requests
+
+    # the gate tests BIT-EXACTNESS, not deadline shedding: a server-level
+    # --deadline-ms default would false-fail the whole check the moment
+    # the first uncached bucket compiles (hundreds of ms); disable it for
+    # the selfcheck traffic and restore after
+    saved_deadline = engine.default_deadline_ms
+    engine.default_deadline_ms = None
+
+    def fire(i):
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                futures[i] = engine.submit(requests[i])
+                return
+            except QueueFullError:       # smoke gate: back off, retry
+                if time.monotonic() > deadline:
+                    futures[i] = QueueFullError("retries exhausted")
+                    return
+                time.sleep(0.005)
+            except Exception as e:  # noqa: BLE001 — a gate must report,
+                futures[i] = e      # not die with a thread traceback
+                return
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(n_requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    engine.default_deadline_ms = saved_deadline
+
+    mismatches = 0
+    for i, fut in enumerate(futures):
+        if not hasattr(fut, "result"):   # submit failed: counts as fail
+            mismatches += 1
+            print("selfcheck FAILED SUBMIT: request %d: %r" % (i, fut),
+                  file=sys.stderr)
+            continue
+        try:
+            got = fut.result(120).numpy()
+        except Exception as e:  # noqa: BLE001
+            mismatches += 1
+            print("selfcheck FAILED REQUEST: %d: %r" % (i, e),
+                  file=sys.stderr)
+            continue
+        want, _ = engine.run_direct(requests[i],
+                                    batch_bucket=fut.bucket[0],
+                                    seq_bucket=fut.bucket[1])
+        for name in engine.fetch_names:
+            if not np.array_equal(got[name], want[name]):
+                mismatches += 1
+                print("selfcheck MISMATCH: request %d fetch %r "
+                      "(bucket %r)" % (i, name, fut.bucket),
+                      file=sys.stderr)
+                break
+    return mismatches
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="ptpu_serve",
+        description="batched online inference server for saved models")
+    ap.add_argument("model_dir")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--format", default="auto",
+                    choices=["auto", "native", "reference"])
+    ap.add_argument("--model-filename", default=None)
+    ap.add_argument("--params-filename", default=None)
+    ap.add_argument("--name", default=None,
+                    help="model name in URLs (default: dir basename)")
+    ap.add_argument("--place", default="cpu", choices=["cpu", "tpu"])
+    ap.add_argument("--warmup-buckets", default=None,
+                    help="e.g. 1,4,8x32,8x64 (BxS adds a seq bucket)")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip startup tracing (first requests compile)")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="max coalesced rows per dispatch (default: the "
+                         "largest batch bucket, or 32 with no explicit "
+                         "buckets)")
+    ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="default per-request deadline (requests may "
+                         "override per call)")
+    ap.add_argument("--queue-capacity", type=int, default=256)
+    ap.add_argument("--selfcheck", type=int, default=0, metavar="N",
+                    help="fire N local requests through the batcher, "
+                         "verify bit-exactness vs direct runs, exit "
+                         "(nonzero on any mismatch) — deploy smoke gate")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.place == "cpu":
+        # only pin the platform for an explicitly-CPU server, and only
+        # BEFORE jax initializes — with --place tpu the env must stay
+        # untouched or the image's axon platform silently falls back to
+        # CPU and "serves" on the wrong device
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as fluid
+    from paddle_tpu import serving
+
+    batch_buckets, seq_buckets = parse_buckets(args.warmup_buckets)
+    place = fluid.TPUPlace() if args.place == "tpu" else fluid.CPUPlace()
+    try:
+        engine = serving.InferenceEngine(
+            args.model_dir, model_format=args.format,
+            model_filename=args.model_filename,
+            params_filename=args.params_filename, place=place,
+            name=args.name, batch_buckets=batch_buckets,
+            seq_buckets=seq_buckets, max_batch_size=args.max_batch,
+            max_queue_delay_ms=args.max_delay_ms,
+            queue_capacity=args.queue_capacity,
+            default_deadline_ms=args.deadline_ms,
+            warmup=not args.no_warmup)
+    except fluid.ProgramVerificationError as e:
+        print("ptpu_serve: model REJECTED by the static verifier:\n%s"
+              % e, file=sys.stderr)
+        return 2
+
+    if args.selfcheck:
+        bad = selfcheck(engine, args.selfcheck)
+        snap = engine.metrics.snapshot()
+        print(json.dumps({
+            "selfcheck": "pass" if bad == 0 else "fail",
+            "requests": args.selfcheck, "mismatches": bad,
+            "mean_batch_occupancy": snap["mean_batch_occupancy"],
+            "batches": snap["batches_total"]}))
+        engine.close()
+        return 1 if bad else 0
+
+    server = serving.ModelServer(engine, host=args.host, port=args.port,
+                                 verbose=args.verbose)
+    print("ptpu_serve: %r (%s) on http://%s — buckets batch=%s seq=%s"
+          % (engine.name, args.format, server.address,
+             engine.batch_buckets, engine.seq_buckets or "-"))
+
+    def handle_sig(signum, frame):
+        # only unblock serve_forever from a side thread here (calling the
+        # blocking httpd.shutdown() on the main thread would deadlock);
+        # the DRAIN runs synchronously on the main thread below, so the
+        # process cannot exit before in-flight batches complete
+        threading.Thread(target=server.httpd.shutdown,
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, handle_sig)
+    signal.signal(signal.SIGINT, handle_sig)  # Ctrl-C takes the same
+    server.serve_forever()                    # drain path as SIGTERM
+    server.shutdown()   # idempotent: stop loop, drain engines, join
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
