@@ -24,6 +24,9 @@ def _error_line(msg):
         return {"metric": "serving_throughput", "value": 0.0,
                 "unit": "requests/sec/chip", "vs_baseline": None,
                 "error": msg}
+    if os.environ.get("BENCH_CKPT") == "1":
+        return {"metric": "ckpt_async_steps_per_sec", "value": 0.0,
+                "unit": "steps/sec", "vs_baseline": None, "error": msg}
     model = os.environ.get("BENCH_MODEL", "resnet50")
     decode = os.environ.get("BENCH_DECODE") == "1"
     token_metric = {"transformer": "transformer_cached_decode_throughput"
@@ -525,6 +528,110 @@ _IMAGE_MODELS = {
 }
 
 
+def bench_ckpt():
+    """BENCH_CKPT=1: checkpointing overhead. Trains the same small Adam
+    MLP three ways — no checkpointing, SYNCHRONOUS save every E steps
+    (save blocks until the snapshot is published), ASYNC save every E
+    steps (capture-only on the training thread, write on the manager's
+    background thread) — and reports steps/s plus the training-loop STALL
+    each mode paid to checkpointing (time blocked inside save calls) and
+    the background save latency. One JSON line; the async-vs-sync stall
+    gap is the number the subsystem exists to create.
+
+    Knobs: BENCH_STEPS (timed steps), BENCH_CKPT_EVERY (save period E),
+    BENCH_CKPT_DIM (MLP width — scales checkpoint bytes), BENCH_BATCH,
+    BENCH_WARMUP."""
+    import shutil
+    import tempfile
+
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.core.utils import device_fetch_barrier
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "40")))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    dim = int(os.environ.get("BENCH_CKPT_DIM", "256"))
+    every = max(1, int(os.environ.get("BENCH_CKPT_EVERY", "5")))
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main_prog,
+                                                        startup):
+        x = fluid.layers.data(name="x", shape=[dim], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=dim, act="tanh")
+        h = fluid.layers.fc(input=h, size=dim, act="tanh")
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        # Adam: 2 moments per param — checkpoint bytes ~3x params, the
+        # realistic ratio a real trainer snapshots
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    xs = jnp.asarray(rng.rand(batch, dim).astype("float32"))
+    ys = jnp.asarray(rng.rand(batch, 1).astype("float32"))
+    jax.block_until_ready((xs, ys))
+    feed = {"x": xs, "y": ys}
+    exe = fluid.Executor(fluid.TPUPlace())
+
+    results = {}
+    for mode in ("none", "sync", "async"):
+        ckdir = tempfile.mkdtemp(prefix="bench_ckpt_%s_" % mode)
+        scope = fluid.Scope()
+        mgr = None
+        if mode != "none":
+            mgr = CheckpointManager(ckdir, max_to_keep=3,
+                                    async_save=(mode == "async"),
+                                    max_in_flight=2)
+        handles, stall, drain = [], 0.0, 0.0
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(warmup):
+                exe.run(main_prog, feed=feed, fetch_list=[loss])
+            out = None
+            t0 = time.perf_counter()
+            for i in range(1, steps + 1):
+                out = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                              return_numpy=False)
+                if mgr is not None and i % every == 0:
+                    ts = time.perf_counter()
+                    handles.append(mgr.save(i, program=main_prog,
+                                            scope=scope,
+                                            wait=(mode == "sync")))
+                    stall += time.perf_counter() - ts
+            device_fetch_barrier(out)
+            loop_dt = time.perf_counter() - t0
+            if mgr is not None:
+                td = time.perf_counter()
+                mgr.wait()
+                drain = time.perf_counter() - td
+                mgr.close()
+        writes = [h.write_seconds for h in handles
+                  if h.write_seconds is not None]
+        results[mode] = {
+            "steps_per_sec": round(steps / loop_dt, 2),
+            "stall_ms": round(stall * 1e3, 3),
+            "drain_ms": round(drain * 1e3, 3),
+            "save_latency_ms": round(
+                1e3 * sum(writes) / len(writes), 3) if writes else None,
+            "saves": len(handles),
+        }
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "ckpt_async_steps_per_sec",
+        "value": results["async"]["steps_per_sec"],
+        "unit": "steps/sec",
+        "vs_baseline": None,
+        "batch": batch, "dim": dim, "steps": steps, "every": every,
+        "modes": results,
+        "device": str(jax.devices()[0]),
+    }))
+
+
 def main():
     # Exclusive-client lock FIRST, synchronously, with a generous timeout:
     # a wait here means another TPU client (e.g. the 2-min probe loop) is
@@ -563,6 +670,9 @@ def main():
         os._exit(3)
     if os.environ.get("BENCH_SERVING") == "1":
         bench_serving()
+        return
+    if os.environ.get("BENCH_CKPT") == "1":
+        bench_ckpt()
         return
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "transformer":

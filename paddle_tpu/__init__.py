@@ -74,6 +74,8 @@ from . import recordio_writer
 from . import analysis
 from .analysis import ProgramVerificationError
 from . import serving
+from . import checkpoint
+from .checkpoint import CheckpointManager
 
 Tensor = LoDTensor
 

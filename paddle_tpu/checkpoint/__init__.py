@@ -1,0 +1,38 @@
+"""paddle_tpu.checkpoint — fault-tolerant asynchronous checkpointing.
+
+The training-state snapshot subsystem (ARCHITECTURE.md §16): a
+`CheckpointManager` captures FULL training state at a step boundary —
+persistables + optimizer accumulators, in-graph reader positions, the
+Scope seed cursor, the global step and the program itself — publishes it
+atomically (temp dir + fsync + one rename), writes asynchronously on a
+background thread with a bounded in-flight budget, hash-verifies on load
+and walks back to the newest valid snapshot on corruption, and
+garbage-collects with a `max_to_keep` + `keep_every_n_steps` policy.
+
+    mgr = checkpoint.CheckpointManager("ckpt/", max_to_keep=5)
+    step = mgr.restore(program=main) or 0        # resume if possible
+    while step < total:
+        exe.run(main, ...); step += 1
+        if step % 100 == 0:
+            mgr.save(step, program=main)         # async, non-blocking
+    mgr.close()
+
+The headline guarantee (tested): training N steps straight through is
+bit-identical to training K, crashing, and resuming from the step-K
+snapshot — params, optimizer moments, reader position, per-step seeds —
+and a kill -9 at ANY point during a save never leaves `restore` pointing
+at a torn checkpoint. Legacy `io.save_checkpoint`/`load_checkpoint` are
+thin shims over this manager.
+"""
+from .manager import CheckpointManager, SaveHandle
+from .retention import RetentionPolicy, apply_retention
+from .snapshot import (find_valid_snapshot, list_steps, load_manifest,
+                       load_verified_arrays, read_snapshot_meta,
+                       verify_snapshot, verify_snapshot_light)
+
+__all__ = [
+    "CheckpointManager", "SaveHandle", "RetentionPolicy",
+    "apply_retention", "find_valid_snapshot", "list_steps",
+    "load_manifest", "load_verified_arrays", "read_snapshot_meta",
+    "verify_snapshot", "verify_snapshot_light",
+]

@@ -1,0 +1,468 @@
+"""CheckpointManager: fault-tolerant asynchronous checkpointing with
+bit-exact resume.
+
+What a snapshot captures (all of it at ONE step boundary, so the saved
+state is exactly "the moment after step N"):
+
+  * every persistable scope value — params, optimizer accumulators,
+    beta-pow counters, the @LR_DECAY_COUNTER@ — tagged in the manifest
+    with its owner param when it is an optimizer accumulator
+  * every in-graph reader's position (`ReaderBase.state_dict`), including
+    a DoubleBufferReader's staging depth
+  * the Scope seed cursor (`Scope.seed_state`), so per-step dropout/rng
+    after resume replays the straight-through run bit-for-bit
+  * the training program itself (core/program_desc bytes) + its version
+
+Async protocol: `save(step)` captures state synchronously — reader
+positions and the seed cursor are cheap host dicts; device values are
+captured as fresh device-side copies (`jnp.copy`, an async dispatch), so
+the next training step's donated in-place update can't mutate or delete
+what the snapshot references — then hands the job to a single background
+writer thread that materializes, hashes and atomically publishes the
+snapshot (snapshot.py) while training continues. A bounded in-flight
+budget (`max_in_flight`) makes `save` block when the writer falls behind,
+so back-to-back saves can't pile up unboundedly in memory.
+
+`restore` walks back to the newest snapshot whose hash tree verifies
+(corruption/torn saves are skipped, never half-loaded) and puts
+everything back: values, reader positions, seed cursor.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+
+from . import snapshot as _snap
+from .retention import RetentionPolicy, apply_retention
+
+__all__ = ["CheckpointManager", "SaveHandle"]
+
+
+def _capture_value(val):
+    """Snapshot one scope value so later training steps can't touch it.
+    jax.Arrays get a device-side copy: the copy is a NEW buffer, so the
+    next Executor.run donating the original (in-place param update) can
+    neither mutate nor delete what we hold; the dispatch is async, so
+    capture doesn't stall training on a device sync. FetchHandles (PR-1
+    return_numpy=False) unwrap to their device array first. Host numpy
+    values are copied host-side."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.executor import FetchHandle
+    if isinstance(val, FetchHandle):
+        val = val.array
+    if isinstance(val, jax.Array):
+        return jnp.copy(val)
+    return np.array(val, copy=True)
+
+
+class SaveHandle(object):
+    """One in-flight (or finished) save. `result()` blocks until the
+    snapshot is published and returns its directory; a failed save
+    re-raises its error here (and from CheckpointManager.wait)."""
+
+    def __init__(self, step):
+        self.step = int(step)
+        self._done = threading.Event()
+        self._path = None
+        self._exc = None
+        self._observed = False  # error already delivered via result()
+        self.write_seconds = None  # background write+fsync+hash duration
+
+    def done(self):
+        return self._done.is_set()
+
+    def exception(self):
+        return self._exc
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("checkpoint save for step %d still in "
+                               "flight after %ss" % (self.step, timeout))
+        if self._exc is not None:
+            self._observed = True
+            raise self._exc
+        return self._path
+
+    def _finish(self, path=None, exc=None):
+        self._path = path
+        self._exc = exc
+        self._done.set()
+
+    def __repr__(self):
+        state = ("failed" if self._exc is not None else
+                 "done" if self._done.is_set() else "in-flight")
+        return "SaveHandle(step=%d, %s)" % (self.step, state)
+
+
+class _SaveJob(object):
+    __slots__ = ("step", "values", "meta", "program_bytes", "validate",
+                 "handle")
+
+    def __init__(self, step, values, meta, program_bytes, validate,
+                 handle):
+        self.step = step
+        self.values = values
+        self.meta = meta
+        self.program_bytes = program_bytes
+        self.validate = validate
+        self.handle = handle
+
+
+class CheckpointManager(object):
+    def __init__(self, checkpoint_dir, max_to_keep=None,
+                 keep_every_n_steps=None, async_save=True,
+                 max_in_flight=2, validate=None):
+        """max_to_keep=None keeps every snapshot (the legacy
+        io.save_checkpoint behavior the shim preserves); set it to bound
+        disk. validate=None defers to FLAGS_validate_program (the PR-2
+        strict-mode flag): when armed, the program recorded in each
+        snapshot is statically verified at save time — a checkpoint that
+        cannot be re-lowered is a failed save, not a surprise at resume."""
+        self.checkpoint_dir = str(checkpoint_dir)
+        self.policy = RetentionPolicy(max_to_keep=max_to_keep,
+                                      keep_every_n_steps=keep_every_n_steps)
+        self.async_save = bool(async_save)
+        self._inflight = threading.Semaphore(max(1, int(max_in_flight)))
+        self._validate = validate
+        self._lock = threading.Lock()
+        self._pending = []           # SaveHandles not yet collected
+        self._queue = None
+        self._thread = None
+        self._closed = False
+        _live_managers.add(self)
+
+    # --------------------------------------------------------- capture --
+    def _resolve_validate(self):
+        if self._validate is not None:
+            return bool(self._validate)
+        from ..core.executor import _validate_program_flag
+        return _validate_program_flag()
+
+    def save(self, step, program=None, scope=None, wait=False, extra=None):
+        """Snapshot full training state after step `step`. Returns a
+        SaveHandle; with async_save the write happens on the background
+        thread and this call only pays capture (device-side copies +
+        host dicts) — unless `max_in_flight` older saves are still
+        writing, in which case it blocks until one drains."""
+        if self._closed:
+            raise RuntimeError("CheckpointManager is closed")
+        from ..core.framework import Parameter, default_main_program
+        from ..core.executor import global_scope
+        from ..core.readers import ReaderBase
+        from ..core import program_desc as _pd
+        from ..io import _is_reader_var, _reader_var_names
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+
+        reader_names = _reader_var_names(program)
+        acc_owner = getattr(program, "_accumulator_owner", {})
+        # only OUTERMOST readers are recorded: an inner reader (one some
+        # decorator wraps as its `_under`) is replayed THROUGH the
+        # decorator's load_state_dict — recording it too would replay the
+        # chain twice, race the decorator's worker thread against the
+        # main-thread replay, and make restore order-dependent. Inner-ness
+        # is decided by live-object identity (the creation ops live in the
+        # STARTUP program, which save never sees).
+        inner_reader_ids = set()
+        for v in program.list_vars():
+            if not v.persistable:
+                continue
+            under = getattr(scope.get(v.name), "_under", None)
+            while under is not None:
+                inner_reader_ids.add(id(under))
+                under = getattr(under, "_under", None)
+        values, reader_states = [], {}
+        for v in program.list_vars():
+            if not v.persistable:
+                continue
+            val = scope.get(v.name)
+            # same classification io.save_vars applies: live readers are
+            # runtime plumbing, not tensor payload
+            if isinstance(val, ReaderBase) or _is_reader_var(
+                    v, reader_names):
+                if hasattr(val, "state_dict") \
+                        and id(val) not in inner_reader_ids:
+                    reader_states[v.name] = val.state_dict()
+                continue
+            if val is None:
+                raise RuntimeError(
+                    "checkpoint save: persistable variable %r has no "
+                    "value in the scope — the snapshot would silently "
+                    "omit it and resume would leave it at init. Run the "
+                    "startup program first." % v.name)
+            entry = {"is_param": isinstance(v, Parameter)}
+            if v.name in acc_owner:
+                # optimizer accumulator: tie it to its owner param in the
+                # manifest ("" = optimizer-global state like beta pows)
+                entry["owner"] = acc_owner[v.name]
+            values.append((v.name, entry, _capture_value(val)))
+
+        meta = {"seed_cursor": int(scope.seed_state()),
+                "reader_states": reader_states,
+                "program_version": int(getattr(program, "_version", 0)),
+                "wall_time": time.time()}
+        if extra:
+            meta["extra"] = dict(extra)
+        job = _SaveJob(int(step), values, meta,
+                       _pd.program_to_bytes(program),
+                       self._resolve_validate(), SaveHandle(step))
+        if wait or not self.async_save:
+            # inline write: raises on failure (the sync contract)
+            self._run_job(job, reraise=True)
+            return job.handle
+        with self._lock:
+            # prune finished handles (a day-long run must not accumulate
+            # one per save) and surface the first background failure HERE,
+            # loudly — a trainer that ignores its SaveHandles must not run
+            # for days believing checkpoints exist while every write fails
+            failed = [h for h in self._pending
+                      if h.done() and h.exception() is not None
+                      and not h._observed]
+            self._pending = [h for h in self._pending if not h.done()]
+            if not failed:
+                self._pending.append(job.handle)
+        if failed:
+            # this save is NOT enqueued: checkpointing is broken and the
+            # caller must know before trusting another interval to it
+            raise failed[0].exception()
+        self._inflight.acquire()  # bounded budget: backpressure here
+        self._ensure_thread()
+        self._queue.put(job)
+        return job.handle
+
+    # ----------------------------------------------------------- write --
+    def _run_job(self, job, reraise=False):
+        try:
+            if job.validate:
+                # verify the program the snapshot RECORDS (parsed back
+                # from its own bytes, so what is checked is what a resume
+                # will actually load)
+                from ..core import program_desc as _pd
+                from ..analysis import validate_or_raise
+                validate_or_raise(_pd.program_from_bytes(job.program_bytes))
+            t0 = time.perf_counter()
+            path = _snap.write_snapshot(
+                self.checkpoint_dir, job.step, job.values, job.meta,
+                program_bytes=job.program_bytes)
+            apply_retention(self.checkpoint_dir, self.policy,
+                            protect=(job.step,))
+            job.handle.write_seconds = time.perf_counter() - t0
+            job.handle._finish(path=path)
+        except BaseException as e:  # surfaced via handle / wait()
+            job.handle._finish(exc=e)
+            if reraise:
+                raise
+        finally:
+            job.values = None  # release captured device copies promptly
+
+    def _writer_loop(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            try:
+                self._run_job(job)
+            finally:
+                self._inflight.release()
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            import queue as _q
+            self._queue = _q.Queue()
+            self._thread = threading.Thread(target=self._writer_loop,
+                                            daemon=True,
+                                            name="ckpt-writer")
+            self._thread.start()
+
+    def wait(self, timeout=None):
+        """Drain every in-flight save; re-raises the first failure. A
+        handle that is still in flight when `timeout` expires goes BACK
+        on the pending list — its eventual failure must surface at the
+        next save()/wait()/close(), not vanish with the timeout."""
+        with self._lock:
+            handles, self._pending = self._pending, []
+        first_exc = None
+        unfinished = []
+        for h in handles:
+            try:
+                h.result(timeout)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                if first_exc is None:
+                    first_exc = e
+                if not h.done():
+                    unfinished.append(h)
+        if unfinished:
+            with self._lock:
+                self._pending = unfinished + self._pending
+        if first_exc is not None:
+            raise first_exc
+        return handles
+
+    def close(self, timeout=30.0):
+        """Drain pending saves and stop the writer thread."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.wait(timeout)
+        finally:
+            if self._thread is not None and self._thread.is_alive():
+                self._queue.put(None)
+                self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --------------------------------------------------------- restore --
+    def latest_step(self, deep=True):
+        found = _snap.find_valid_snapshot(self.checkpoint_dir, deep=deep)
+        return None if found is None else found[0]
+
+    def steps(self):
+        """All published steps, oldest first (validity not checked)."""
+        return [s for s, _ in _snap.list_steps(self.checkpoint_dir)]
+
+    def restore(self, program=None, scope=None, executor=None, step=None,
+                allow_missing=False):
+        """Load the newest VALID snapshot (or `step`) into `scope`:
+        persistable values, reader positions, seed cursor. Returns the
+        restored step, or None when no snapshot exists at all. A snapshot
+        whose hash tree fails verification is skipped and the next-newest
+        one is used — a torn or bit-flipped save can cost at most one
+        checkpoint interval, never a wrong resume. A PINNED `step` that
+        is missing or corrupt raises instead: the caller asked for
+        exactly that state, and a silent fresh start would overwrite
+        good checkpoints via retention.
+
+        With `program`, the restore is strict the way load_vars is: every
+        persistable the program declares (reader plumbing aside) must be
+        in the manifest, and live reader states recorded in the snapshot
+        must exist in the scope (run the startup program first)."""
+        del executor  # parity with io signatures; scope is the store
+        from ..core.executor import global_scope
+        scope = scope if scope is not None else global_scope()
+        # resume entry point: sweep dead writers' droppings first — this
+        # also RECOVERS a step dir a killed same-step re-save left parked
+        # as step_<N>.old.<pid> (see snapshot.clean_stale_tmp)
+        _snap.clean_stale_tmp(self.checkpoint_dir)
+        for found_step, path in self._candidates(step):
+            # cheap structural probe (snapshot.json, manifest hash,
+            # files exist, program hash); array payloads are verified
+            # below AS they are read — one pass over the bytes, not a
+            # hash pass plus a load pass
+            if _snap.verify_snapshot_light(path):
+                continue
+            manifest = _snap.load_manifest(path)
+            meta = _snap.read_snapshot_meta(path)
+
+            if program is not None and not allow_missing:
+                from ..io import _is_reader_var, _reader_var_names
+                reader_names = _reader_var_names(program)
+                want = set(v.name for v in program.list_vars()
+                           if v.persistable
+                           and not _is_reader_var(v, reader_names))
+                absent = sorted(want - set(manifest))
+                if absent:
+                    raise RuntimeError(
+                        "checkpoint restore: snapshot step_%d at %r does "
+                        "not carry %d persistable variable(s) the program "
+                        "needs: %s (allow_missing=True for a deliberate "
+                        "partial restore)" % (found_step,
+                                              self.checkpoint_dir,
+                                              len(absent), absent))
+            reader_states = ({} if meta.get("legacy")
+                             else meta.get("reader_states") or {})
+            if program is not None:
+                # liveness BEFORE the first scope.set: raising after
+                # params landed would leave a half-restored scope
+                for rname in reader_states:
+                    if not hasattr(scope.get(rname), "load_state_dict"):
+                        raise RuntimeError(
+                            "checkpoint restore: snapshot records reader "
+                            "state for %r but the scope has no live "
+                            "reader there — run the startup program "
+                            "first, then restore" % rname)
+            try:
+                loaded = _snap.load_verified_arrays(path, manifest)
+            except (OSError, ValueError):
+                continue  # torn or bit-flipped arrays: walk back
+            # all-or-nothing from here: every value is in memory and
+            # verified, so nothing below can leave scope half-updated
+            for name, arr in loaded.items():
+                scope.set(name, arr)
+
+            if not meta.get("legacy") and "seed_cursor" in meta:
+                scope.set_seed_state(meta["seed_cursor"])
+            for rname, rstate in reader_states.items():
+                live = scope.get(rname)
+                if hasattr(live, "load_state_dict"):
+                    live.load_state_dict(rstate)
+            return found_step
+        if step is not None:
+            raise ValueError(
+                "checkpoint restore: pinned step_%d under %r is missing "
+                "or fails verification — refusing to silently start "
+                "fresh (omit `step` to fall back to the newest valid "
+                "snapshot)" % (int(step), self.checkpoint_dir))
+        return None
+
+    def _candidates(self, step=None):
+        """Snapshot dirs to try, newest first (or the one pinned step)."""
+        if step is not None:
+            path = os.path.join(self.checkpoint_dir,
+                                _snap.step_dir_name(step))
+            return [(int(step), path)] if os.path.isdir(path) else []
+        return list(reversed(_snap.list_steps(self.checkpoint_dir)))
+
+    def load_program(self, step=None, before=None):
+        """The training program recorded in the newest valid snapshot (or
+        `step`), parsed — the servable-model hook serving/engine.py rides.
+        Returns (program, step, snapshot_path). `before` restricts to
+        steps strictly older — a caller that found the returned
+        snapshot's ARRAYS corrupt walks back by retrying with
+        before=<that step>."""
+        from ..core import program_desc as _pd
+        _snap.clean_stale_tmp(self.checkpoint_dir)
+        for found_step, path in self._candidates(step):
+            if before is not None and found_step >= before:
+                continue
+            # light verify covers everything this path reads (the
+            # program's own hash included); callers loading arrays from
+            # the returned path verify them as they read
+            # (snapshot.load_verified_arrays)
+            if _snap.verify_snapshot_light(path):
+                continue
+            meta = _snap.read_snapshot_meta(path)
+            prog = meta.get("program")
+            if not prog:
+                raise ValueError(
+                    "snapshot step_%d carries no recorded program "
+                    "(legacy io.save_checkpoint layout?)" % found_step)
+            with open(os.path.join(path, prog["file"]), "rb") as f:
+                program = _pd.program_from_bytes(f.read())
+            return program, found_step, path
+        raise FileNotFoundError(
+            "no valid snapshot under %r" % self.checkpoint_dir)
+
+
+# Interpreter-exit safety: drain live managers so an in-flight async save
+# finishes (or is abandoned at a kill point the atomic protocol already
+# tolerates) instead of dying as a half-written tmp dir on clean exits.
+import atexit
+import weakref
+
+_live_managers = weakref.WeakSet()
+
+
+@atexit.register
+def _drain_managers():
+    for m in list(_live_managers):
+        try:
+            m.close(timeout=30.0)
+        except Exception:
+            pass

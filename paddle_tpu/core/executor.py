@@ -95,6 +95,17 @@ class Scope(object):
         self._rng_counter += k
         return first
 
+    def seed_state(self):
+        """The rng cursor as checkpoint payload: with it restored
+        (set_seed_state), the runs after a resume draw exactly the seeds
+        the straight-through run would have — per-step dropout masks and
+        every other in-graph rng replay bit-for-bit. Exported by
+        checkpoint.CheckpointManager at each snapshot."""
+        return int(self._rng_counter)
+
+    def set_seed_state(self, counter):
+        self._rng_counter = int(counter)
+
 
 class _ScopeVar(object):
     def __init__(self, scope, name):

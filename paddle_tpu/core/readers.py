@@ -48,15 +48,27 @@ class ReaderBase(object):
     or raises EOFException; eof() peeks; reset() restarts; close() releases
     threads/files (called when a startup re-run displaces the state).
     Pushed-back records live in a deque, so a whole K-record block a
-    multi-step run could not use returns intact (next_many)."""
+    multi-step run could not use returns intact (next_many).
+
+    Checkpointing: `_consumed` counts records DELIVERED to the trainer
+    (push_back refunds, so a failed multi-step K-block nets to zero and
+    mid-K-block positions round-trip exactly). state_dict/load_state_dict
+    snapshot/restore the position by deterministic replay: reset() the
+    chain, then re-consume `_consumed` records. Exact for deterministic
+    sources (recordio files, seeded shuffle, multi-pass); best-effort for
+    MultiFileReader's thread-racy interleave."""
 
     def __init__(self):
         self._pending = collections.deque()
+        self._consumed = 0
 
     def next(self):
         if self._pending:
-            return self._pending.popleft()
-        return self._next()
+            rec = self._pending.popleft()
+        else:
+            rec = self._next()
+        self._consumed += 1
+        return rec
 
     def push_back(self, record):
         """Return a just-popped record to the front of the stream (used by
@@ -64,6 +76,23 @@ class ReaderBase(object):
         doesn't consume it). Multiple push_backs stack LIFO, so pushing a
         block back newest-first restores the original order."""
         self._pending.appendleft(record)
+        self._consumed -= 1
+
+    def state_dict(self):
+        """Snapshot of this reader's stream position (checkpoint
+        payload). Cheap: a host dict, never tensor data."""
+        return {"reader": type(self).__name__,
+                "consumed": int(self._consumed)}
+
+    def load_state_dict(self, state):
+        """Restore a state_dict position by deterministic replay: reset
+        the whole decorator chain (reseeding shuffle buffers, rewinding
+        passes), then re-consume and discard the recorded number of
+        records. After this, the next record delivered is exactly the one
+        the checkpointed run would have read next."""
+        self.reset()
+        for _ in range(int(state.get("consumed", 0))):
+            self.next()
 
     def next_many(self, k, validate=None):
         """Pop k records atomically (the multi-step executor's K-block).
@@ -96,6 +125,7 @@ class ReaderBase(object):
 
     def reset(self):
         self._pending.clear()
+        self._consumed = 0
         self._reset()
 
     def close(self):
@@ -418,6 +448,23 @@ class DoubleBufferReader(ReaderBase):
             self._thread.join(timeout=0.05)
             if deadline is not None and time.monotonic() > deadline:
                 return
+
+    def state_dict(self):
+        """Position + staging depth. `consumed` counts records the TRAINER
+        got — records the worker pre-staged but nobody read are not
+        consumed, so resume replays them instead of losing them."""
+        d = super(DoubleBufferReader, self).state_dict()
+        d["capacity"] = int(self._capacity)
+        return d
+
+    def load_state_dict(self, state):
+        """Replay-restore, then re-grow staging to the recorded depth (a
+        multi-step run's ensure_staging_depth(K) survives resume — the
+        first post-restore K-block finds its staging budget already
+        sized)."""
+        super(DoubleBufferReader, self).load_state_dict(state)
+        self.ensure_staging_depth(int(state.get("capacity",
+                                                self._capacity)))
 
     def _reset(self):
         self._stop()

@@ -6,8 +6,10 @@ files (one per var, like the reference's one-file-per-var LoDTensor dumps)
 plus a JSON manifest; `save_inference_model` prunes to the fetch subgraph
 (Program.prune) and stores it in the versioned self-describing desc format
 (core/program_desc.py — the reference's ProgramDesc proto equivalent).
-Orbax-grade sharded checkpointing for the distributed path lives in
-parallel/checkpoint.py; this module is the single-host surface.
+Training checkpoints are a first-class subsystem now: `save_checkpoint`/
+`load_checkpoint` below are deprecation shims over
+`paddle_tpu.checkpoint.CheckpointManager` (atomic async snapshots, hash
+verification, retention, bit-exact resume — ARCHITECTURE.md §16).
 """
 import json
 import os
@@ -302,24 +304,42 @@ def load_reference_model(dirname, executor, model_filename=None,
 
 
 def save_checkpoint(executor, checkpoint_dir, main_program=None,
-                    trainer_id=0, step=0):
-    """Checkpoint/resume (parity: fluid.io checkpoint utilities)."""
-    d = os.path.join(checkpoint_dir, "step_%d" % step)
-    save_persistables(executor, d, main_program)
-    with open(os.path.join(checkpoint_dir, "LATEST"), "w") as f:
-        f.write(str(step))
+                    trainer_id=0, step=0, max_to_keep=None,
+                    keep_every_n_steps=None):
+    """Checkpoint save (parity: fluid.io checkpoint utilities).
+
+    Deprecation shim: delegates to `checkpoint.CheckpointManager` with a
+    synchronous save, so the legacy one-call API now gets the full
+    subsystem — atomic publication (temp dir + fsync + rename; a kill
+    mid-save can no longer corrupt the run), per-file content hashes,
+    seed-cursor + reader-position capture, and optional retention
+    (max_to_keep/keep_every_n_steps; default keeps everything, the legacy
+    behavior). Long-running trainers should hold a CheckpointManager
+    directly for async saves instead of re-opening one per call."""
+    from .checkpoint import CheckpointManager
+    mgr = CheckpointManager(checkpoint_dir, max_to_keep=max_to_keep,
+                            keep_every_n_steps=keep_every_n_steps,
+                            async_save=False)
+    try:
+        mgr.save(step, program=main_program)
+    finally:
+        mgr.close()
 
 
 def load_checkpoint(executor, checkpoint_dir, main_program=None):
-    latest = os.path.join(checkpoint_dir, "LATEST")
-    if not os.path.exists(latest):
-        return None
-    with open(latest) as f:
-        step = int(f.read().strip())
-    load_persistables(executor,
-                      os.path.join(checkpoint_dir, "step_%d" % step),
-                      main_program)
-    return step
+    """Checkpoint restore; returns the restored step or None.
+
+    Deprecation shim over `CheckpointManager.restore`: the newest VALID
+    snapshot wins — LATEST is only a hint, so a missing/stale pointer or
+    a torn/bit-flipped newest save falls back to the newest snapshot
+    whose hash tree verifies instead of raising (or worse, resuming from
+    garbage). A missing checkpoint dir returns None, like before."""
+    from .checkpoint import CheckpointManager
+    mgr = CheckpointManager(checkpoint_dir, async_save=False)
+    try:
+        return mgr.restore(program=main_program, executor=executor)
+    finally:
+        mgr.close()
 
 
 def get_parameter_value(para, executor):

@@ -79,6 +79,11 @@ class Optimizer(object):
         if shape is None:
             shape = param.shape
         helper = LayerHelper(name)
+        # persistable=True is load-bearing twice over: the executor's
+        # donated state write-back keeps the accumulator device-resident
+        # across steps, and checkpoint.CheckpointManager snapshots exactly
+        # the persistable set — a non-persistable moment would silently
+        # reset at every resume
         var = helper.create_global_variable(
             name=unique_name.generate(name + "_" + param.name),
             persistable=True, dtype=dtype, shape=shape)
@@ -221,6 +226,11 @@ class AdamOptimizer(Optimizer):
             dtype="float32", shape=[1])
         helper.set_variable_initializer(
             var, initializer=ConstantInitializer(value=float(fill_value)))
+        # optimizer-global state (beta pows): owner "" marks it in
+        # program._accumulator_owner so the checkpoint manifest tags it as
+        # optimizer state and the sharded-weight-update path never
+        # pattern-matches it to some unlucky param
+        var.block.program._accumulator_owner.setdefault(var.name, "")
         return var
 
     def _append_optimize_op(self, block, param_and_grad):
@@ -499,6 +509,7 @@ class ModelAverage(Optimizer):
             name=unique_name.generate("ma_counter"), persistable=True,
             dtype="float32", shape=[1])
         helper.set_variable_initializer(var, ConstantInitializer(0.0))
+        var.block.program._accumulator_owner.setdefault(var.name, "")
         default_main_program().current_block().append_op(
             type="increment", inputs={"X": [var]}, outputs={"Out": [var]},
             attrs={"step": 1.0}, infer_shape=False)

@@ -14,7 +14,8 @@ import random
 import threading
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
-           "firstn", "xmap_readers", "batch", "ComposeNotAligned"]
+           "firstn", "xmap_readers", "batch", "skip",
+           "ComposeNotAligned"]
 
 
 class ComposeNotAligned(ValueError):
@@ -128,6 +129,31 @@ def firstn(reader, n):
                 break
             yield item
     return firstn_reader
+
+
+def skip(reader, n):
+    """Drop the first n samples of the FIRST iteration only — the
+    host-pipeline half of checkpoint resume. In-graph readers restore
+    their position via `ReaderBase.load_state_dict` (deterministic
+    replay); a host feeding loop resumes the same way by wrapping its
+    creator in `skip(creator, batches_consumed)` so the post-resume
+    stream starts exactly where the checkpointed run stopped.
+    Deterministic creators (seeded shuffle, file readers) replay
+    bit-identically. Later iterations (the NEXT epochs of a multi-pass
+    loop) yield the full stream — only the resume epoch is partial."""
+    state = {"pending": int(n)}
+
+    def skip_reader():
+        it = reader()
+        pending, state["pending"] = state["pending"], 0
+        for _ in range(pending):
+            try:
+                next(it)
+            except StopIteration:
+                return
+        for item in it:
+            yield item
+    return skip_reader
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):

@@ -256,6 +256,71 @@ class InferenceEngine(object):
                 raise
 
     # ------------------------------------------------------------ load --
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir, fetch_list, feed_names=None,
+                        step=None, warmup=True, **engine_kw):
+        """Serve the newest VALID training checkpoint directly — no
+        export step between "training saved a snapshot" and "it takes
+        traffic". The snapshot's recorded program is pruned to the fetch
+        subgraph (backward/optimizer ops dropped, exactly like
+        save_inference_model), its hash-verified param values load into
+        the engine's private Scope, and the engine warms up its bucket
+        lattice as usual. A torn or bit-flipped newest snapshot is
+        skipped for the newest one that verifies, so a crashed trainer
+        can never push garbage weights into serving.
+
+        fetch_list: fetch var names in the training program.
+        feed_names: defaults to the pruned program's data vars (the
+        layers.data inputs feeding the fetch subgraph).
+        step pins an exact snapshot; default newest valid.
+        """
+        from ..checkpoint import CheckpointManager, load_verified_arrays
+        target_names = [v if isinstance(v, str) else v.name
+                        for v in fetch_list]
+        mgr = CheckpointManager(checkpoint_dir, async_save=False)
+        try:
+            before = None
+            while True:
+                program, found_step, snap_path = mgr.load_program(
+                    step=step, before=before)
+                inference = program.prune(target_names, for_test=True)
+                wanted = set(v.name for v in inference.list_vars()
+                             if v.persistable)
+                try:
+                    # single pass: each param file is read once, hashed
+                    # against the manifest, and decoded from those bytes
+                    arrays = load_verified_arrays(snap_path, names=wanted)
+                    break
+                except (OSError, ValueError):
+                    if step is not None:
+                        raise  # the user pinned THIS snapshot
+                    before = found_step  # corrupt arrays: walk back
+        finally:
+            mgr.close()
+        if feed_names is None:
+            feed_names = [v.name for v in inference.list_vars()
+                          if getattr(v, "is_data", False)
+                          and not v.persistable]
+        fetch_vars = [inference.global_block().var(n)
+                      for n in target_names]
+        engine = cls(program=inference, feed_names=feed_names,
+                     fetch_vars=fetch_vars,
+                     name=engine_kw.pop("name", None)
+                     or "ckpt-step-%d" % found_step,
+                     warmup=False, **engine_kw)
+        try:
+            # params BEFORE warmup: the first traced bucket already needs
+            # initialized persistables
+            for name, arr in arrays.items():
+                engine._scope.set(name, arr)
+            if warmup:
+                engine.warmup()
+        except Exception:
+            engine.close(drain=False)  # no thread leak per failed load
+            raise
+        engine.checkpoint_step = found_step
+        return engine
+
     def _load(self, model_dir, model_format, model_filename,
               params_filename):
         from .. import io as _io

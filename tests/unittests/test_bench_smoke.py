@@ -107,6 +107,42 @@ def test_bench_serving_smoke():
         assert rec[k] >= 0
 
 
+def test_bench_ckpt_smoke():
+    """The BENCH_CKPT leg: one subprocess run on CPU comparing no
+    checkpointing vs sync saves vs async saves. The acceptance gate rides
+    here: async checkpointing must stall the training loop LESS than
+    synchronous saves of the same snapshots — otherwise the background
+    writer is decoration. Sized so the gap is a multiple (the sync stall
+    includes materialize+hash+fsync of an Adam-sized snapshot; the async
+    stall is capture only)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_CKPT": "1",
+        "BENCH_STEPS": "20", "BENCH_CKPT_EVERY": "4",
+        "BENCH_CKPT_DIM": "128", "BENCH_BATCH": "8", "BENCH_WARMUP": "1",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "ckpt_async_steps_per_sec"
+    assert rec["unit"] == "steps/sec"
+    assert rec["value"] > 0
+    modes = rec["modes"]
+    assert set(modes) == {"none", "sync", "async"}
+    assert modes["sync"]["saves"] == modes["async"]["saves"] == 5
+    assert modes["none"]["stall_ms"] == 0.0
+    # the headline gate: async checkpointing stalls training less than
+    # synchronous saves of identical snapshots
+    assert modes["async"]["stall_ms"] < modes["sync"]["stall_ms"], modes
+    assert modes["sync"]["save_latency_ms"] > 0
+    assert modes["async"]["save_latency_ms"] > 0
+
+
 def test_tool_shell_scripts_parse():
     """bash -n every tools/*.sh: a syntax error in a sweep script would
     consume the round's only healthy tunnel window (the probe loop

@@ -8,6 +8,11 @@ over a SERIALIZED program, without executing it:
     tools/pplint.py <model-dir>              # save_inference_model /
                                              # save_reference_model dir
     tools/pplint.py <model-dir>/__model__    # a bare desc file
+    tools/pplint.py <checkpoint-dir>         # CheckpointManager root:
+                                             # lints the program recorded
+                                             # in the newest VALID snapshot
+    tools/pplint.py <ckpt>/step_100          # one snapshot (its program
+                                             # hash-verified before lint)
     tools/pplint.py path --strict            # warnings also fail
 
 Accepted formats (auto-detected from the first bytes):
@@ -34,6 +39,46 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 
+def _resolve_checkpoint_dir(path):
+    """Map a checkpoint layout onto the program desc it records, or None
+    when `path` is not a checkpoint. Accepts a checkpoint ROOT (step_<N>
+    dirs / LATEST: the newest snapshot whose hash tree verifies wins,
+    like CheckpointManager.restore) or one snapshot dir (snapshot.json:
+    linted exactly as given — corruption is a hard error here, since the
+    user pointed at THIS snapshot). Both paths verify only what the lint
+    reads (structure, manifest hash, the program's own sha256) — array
+    payloads are ptpu_ckpt verify's job, not GBs of reads for a lint."""
+    from paddle_tpu.checkpoint import snapshot as snap
+    if os.path.exists(os.path.join(path, snap.SNAPSHOT_FILE)):
+        problems = snap.verify_snapshot_light(path)
+        if problems:
+            raise ValueError("corrupt snapshot %s: %s"
+                             % (path, "; ".join(problems)))
+        meta = snap.read_snapshot_meta(path)
+    elif snap.list_steps(path) or os.path.exists(
+            os.path.join(path, snap.LATEST_FILE)):
+        # newest-first walk, but only as much hashing as the lint needs:
+        # structure + manifest hash + the recorded program's own sha256
+        # (verify_snapshot_light) — NOT every array file, which on a real
+        # checkpoint is GBs of reads for zero lint value
+        meta = None
+        for _, cand in reversed(snap.list_steps(path)):
+            if snap.verify_snapshot_light(cand):
+                continue
+            meta, path = snap.read_snapshot_meta(cand), cand
+            break
+        if meta is None:
+            raise ValueError("checkpoint dir %s has no snapshot that "
+                             "verifies" % path)
+    else:
+        return None
+    prog = meta.get("program")
+    if not prog:
+        raise ValueError("snapshot %s records no program (legacy "
+                         "io.save_checkpoint layout)" % path)
+    return os.path.join(path, prog["file"])
+
+
 def load_program(path, model_filename=None, allow_pickle=False):
     """-> (program, feed_names, fetch_names, wire_diagnostics)."""
     import paddle_tpu as fluid
@@ -42,12 +87,19 @@ def load_program(path, model_filename=None, allow_pickle=False):
 
     meta_feeds = meta_fetches = None
     if os.path.isdir(path):
-        meta_path = os.path.join(path, "__model_meta__.json")
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
-                meta = json.load(f)
-            meta_feeds, meta_fetches = meta.get("feed"), meta.get("fetch")
-        path = os.path.join(path, model_filename or "__model__")
+        ckpt_desc = _resolve_checkpoint_dir(path)
+        if ckpt_desc is not None:
+            # training-checkpoint program: no feed/fetch contract is
+            # recorded; analysis falls back to the is_data convention
+            path = ckpt_desc
+        else:
+            meta_path = os.path.join(path, "__model_meta__.json")
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                meta_feeds, meta_fetches = (meta.get("feed"),
+                                            meta.get("fetch"))
+            path = os.path.join(path, model_filename or "__model__")
     with open(path, "rb") as f:
         raw = f.read()
 
