@@ -27,6 +27,9 @@ def _error_line(msg):
     if os.environ.get("BENCH_CKPT") == "1":
         return {"metric": "ckpt_async_steps_per_sec", "value": 0.0,
                 "unit": "steps/sec", "vs_baseline": None, "error": msg}
+    if os.environ.get("BENCH_RESIL") == "1":
+        return {"metric": "resil_guarded_steps_per_sec", "value": 0.0,
+                "unit": "steps/sec", "vs_baseline": None, "error": msg}
     model = os.environ.get("BENCH_MODEL", "resnet50")
     decode = os.environ.get("BENCH_DECODE") == "1"
     token_metric = {"transformer": "transformer_cached_decode_throughput"
@@ -632,6 +635,113 @@ def bench_ckpt():
     }))
 
 
+def bench_resil():
+    """BENCH_RESIL=1: numerical-guard overhead. Trains the deep-narrow
+    smoke MLP four ways — guards off/on x single-step/steps=K — and
+    reports steps/s for each plus the two overhead percentages. The
+    guards add per-grad all-finite reductions (fused into the backward)
+    plus ONE lax.cond gating every persistable update; the number this
+    leg exists to defend is overhead < 10% on both legs
+    (test_bench_resil_smoke asserts it). Batch defaults to 256: guard
+    cost is proportional to STATE traffic while step cost scales with
+    batch compute, so a degenerate tiny-batch toy would report a
+    state/compute ratio no real trainer has.
+
+    Knobs: BENCH_STEPS, BENCH_WARMUP, BENCH_BATCH, BENCH_RESIL_LAYERS,
+    BENCH_RESIL_HIDDEN, BENCH_MULTISTEP (K for the multi-step leg),
+    BENCH_RESIL_REPEATS (timed-loop repeats; min taken, host-noise
+    armor)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from paddle_tpu.resilience import install_numeric_guards
+
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "64")))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    n_layers = int(os.environ.get("BENCH_RESIL_LAYERS", "10"))
+    hidden = int(os.environ.get("BENCH_RESIL_HIDDEN", "64"))
+    k = max(2, int(os.environ.get("BENCH_MULTISTEP", "8")))
+    repeats = max(1, int(os.environ.get("BENCH_RESIL_REPEATS", "3")))
+
+    rng = np.random.RandomState(0)
+    xs = jnp.asarray(rng.rand(batch, hidden).astype("float32"))
+    ys = jnp.asarray(rng.rand(batch, 1).astype("float32"))
+    jax.block_until_ready((xs, ys))
+    feed = {"x": xs, "y": ys}
+
+    def build(guarded):
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main_prog,
+                                                            startup):
+            x = fluid.layers.data(name="x", shape=[hidden],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = x
+            for _ in range(n_layers):
+                h = fluid.layers.fc(input=h, size=hidden, act="relu")
+            p = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                x=fluid.layers.square_error_cost(input=p, label=y))
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        if guarded:
+            install_numeric_guards(main_prog, loss=loss)
+        return main_prog, startup, loss
+
+    exe = fluid.Executor(fluid.TPUPlace())
+
+    def measure(guarded, multistep):
+        main_prog, startup, loss = build(guarded)
+        run_kw = {"steps": multistep, "fetch_reduce": "last"} \
+            if multistep > 1 else {}
+        outer = max(1, -(-steps // multistep))
+        scope = fluid.Scope()
+        best = None
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(warmup):
+                exe.run(main_prog, feed=feed, fetch_list=[loss], **run_kw)
+            # per-call materialization (return_numpy default): the
+            # realistic trainer pattern — a loop that reads its loss
+            # every dispatch. Comparing an ASYNC unguarded loop against
+            # the guard's mandatory per-dispatch flag sync would charge
+            # the guard for the loop style, not the guard work.
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                for _ in range(outer):
+                    out = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                                  **run_kw)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            loss_v = np.asarray(out[0])
+            assert np.isfinite(loss_v).all(), "non-finite loss"
+        return outer * multistep / best
+
+    plain_off = measure(False, 1)
+    plain_on = measure(True, 1)
+    multi_off = measure(False, k)
+    multi_on = measure(True, k)
+
+    def overhead(off, on):
+        return round((off / on - 1.0) * 100.0, 2)
+
+    print(json.dumps({
+        "metric": "resil_guarded_steps_per_sec",
+        "value": round(plain_on, 2),
+        "unit": "steps/sec",
+        "vs_baseline": None,
+        "batch": batch, "layers": n_layers, "hidden": hidden,
+        "steps": steps, "multistep": k, "repeats": repeats,
+        "plain_steps_per_sec": round(plain_off, 2),
+        "guarded_steps_per_sec": round(plain_on, 2),
+        "multistep_steps_per_sec": round(multi_off, 2),
+        "multistep_guarded_steps_per_sec": round(multi_on, 2),
+        "overhead_pct_plain": overhead(plain_off, plain_on),
+        "overhead_pct_multistep": overhead(multi_off, multi_on),
+        "device": str(jax.devices()[0]),
+    }))
+
+
 def main():
     # Exclusive-client lock FIRST, synchronously, with a generous timeout:
     # a wait here means another TPU client (e.g. the 2-min probe loop) is
@@ -673,6 +783,9 @@ def main():
         return
     if os.environ.get("BENCH_CKPT") == "1":
         bench_ckpt()
+        return
+    if os.environ.get("BENCH_RESIL") == "1":
+        bench_resil()
         return
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "transformer":

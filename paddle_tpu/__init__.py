@@ -76,6 +76,10 @@ from .analysis import ProgramVerificationError
 from . import serving
 from . import checkpoint
 from .checkpoint import CheckpointManager
+from . import resilience
+from .resilience import (Supervisor, TrainingAborted,
+                         install_numeric_guards, NumericalGuardError,
+                         DispatchTimeoutError)
 
 Tensor = LoDTensor
 

@@ -328,7 +328,7 @@ class CheckpointManager(object):
         return [s for s, _ in _snap.list_steps(self.checkpoint_dir)]
 
     def restore(self, program=None, scope=None, executor=None, step=None,
-                allow_missing=False):
+                allow_missing=False, before=None):
         """Load the newest VALID snapshot (or `step`) into `scope`:
         persistable values, reader positions, seed cursor. Returns the
         restored step, or None when no snapshot exists at all. A snapshot
@@ -338,6 +338,12 @@ class CheckpointManager(object):
         is missing or corrupt raises instead: the caller asked for
         exactly that state, and a silent fresh start would overwrite
         good checkpoints via retention.
+
+        `before=N` restricts to snapshots strictly older than step N —
+        the resilience supervisor's rollback entry point: a second
+        rollback that made no progress past its last restore walks back
+        one snapshot further instead of reloading the same (possibly
+        poisoned-at-capture) state forever.
 
         With `program`, the restore is strict the way load_vars is: every
         persistable the program declares (reader plumbing aside) must be
@@ -351,6 +357,8 @@ class CheckpointManager(object):
         # as step_<N>.old.<pid> (see snapshot.clean_stale_tmp)
         _snap.clean_stale_tmp(self.checkpoint_dir)
         for found_step, path in self._candidates(step):
+            if before is not None and found_step >= before:
+                continue
             # cheap structural probe (snapshot.json, manifest hash,
             # files exist, program hash); array payloads are verified
             # below AS they are read — one pass over the bytes, not a

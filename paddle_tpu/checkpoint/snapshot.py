@@ -58,6 +58,13 @@ __all__ = [
 # --------------------------------------------------------------- faults --
 _fault_counter = {"n": 0}
 
+# Unified fault registry (resilience/faults.py): an armed FaultPlan with
+# `ckpt_kill@N` entries points this at its checkpoint-crossing hook, so
+# the PR-4 PTPU_CKPT_FAULT_AT idea rides the same registry as every other
+# injectable fault. The legacy env var keeps working unchanged (its
+# counter only advances while it is set, preserving the sweep contract).
+_fault_hook = None
+
 
 def _maybe_fault():
     """Torn-write fault injection (tests only): when PTPU_CKPT_FAULT_AT=N
@@ -66,12 +73,14 @@ def _maybe_fault():
     points bracket every durability step of the write protocol, so a test
     sweeping N proves no kill point can publish a torn snapshot."""
     target = os.environ.get("PTPU_CKPT_FAULT_AT")
-    if not target:
+    if target:
+        n = _fault_counter["n"]
+        _fault_counter["n"] = n + 1
+        if n == int(target):
+            os.kill(os.getpid(), signal.SIGKILL)
         return
-    n = _fault_counter["n"]
-    _fault_counter["n"] = n + 1
-    if n == int(target):
-        os.kill(os.getpid(), signal.SIGKILL)
+    if _fault_hook is not None:
+        _fault_hook()  # FaultPlan keeps its own crossing counter
 
 
 # ---------------------------------------------------------------- bytes --

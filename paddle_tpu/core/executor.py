@@ -232,8 +232,14 @@ def convert_feeds(program, feed, host=False):
     return feed_arrays
 
 
+class _DispatchCancelled(Exception):
+    """Internal: a watchdog-abandoned worker reached a cancellation
+    checkpoint; the dispatch unwinds without touching more state."""
+
+
 def run_host_io_prepass(program, scope, feed_arrays, host=False,
-                        validate=None, steps=1, stacked_out=None):
+                        validate=None, steps=1, stacked_out=None,
+                        cancelled=None):
     """io pre-pass: reader ops execute host-side (core/readers.py).
     create_* ops build ReaderState objects in the scope; each `read` op
     pops the next record and injects it as a feed of the jitted program
@@ -264,11 +270,22 @@ def run_host_io_prepass(program, scope, feed_arrays, host=False,
     multi_stacks = {}     # name -> stacked [K, ...] array, committed last
 
     def _rollback():
+        if cancelled is not None and cancelled.is_set():
+            # watchdog-abandoned worker: the caller's recovery restores
+            # the readers' positions itself — a late refund here would
+            # prepend stale records into the freshly restored stream
+            return
         for st, recs in reversed(multi_blocks):
             for rec in reversed(recs):
                 st.push_back(rec)
 
     for op in program.global_block().ops:
+        if cancelled is not None and cancelled.is_set():
+            # watchdog-abandoned worker: stop consuming reader records
+            # NOW — the caller's recovery (rollback) is about to rewind
+            # the very readers this loop would keep advancing (no
+            # refund either: see _rollback)
+            raise _DispatchCancelled()
         if op.type == "read":
             state = scope.get(op.inputs["Reader"][0])
             if state is None:
@@ -353,25 +370,132 @@ def _array_safety_enabled():
         "0", "false", "False")
 
 
-def _raise_program_errors(errors):
+# message prefix check_finite_guard (ops/guard_ops.py) stamps on its
+# sticky assertion flags; _raise_program_errors keys the typed raise on it
+GUARD_MSG_PREFIX = "numerical guard:"
+
+
+class NumericalGuardError(RuntimeError):
+    """A device-side numerical guard (resilience.install_numeric_guards)
+    tripped: non-finite loss/grad/param detected in-graph. The gated
+    state updates of the offending step were skipped on device, so the
+    scope still holds the last-good values — a supervisor can skip the
+    batch, retry, or roll back without fearing poisoned params."""
+
+
+class DispatchTimeoutError(RuntimeError):
+    """Executor.run(timeout=)/ParallelExecutor.run(timeout=) watchdog: a
+    dispatch (io pre-pass + device computation) exceeded its deadline.
+    `cache_key` carries the compile-cache key of the wedged program when
+    it got far enough to compute one. After this raise the abandoned
+    worker stops at its next cancellation checkpoint (before each read
+    op of the io pre-pass, before dispatch, and before the scope
+    write-back — which in watchdog mode runs only AFTER the device
+    sync, so a wedged execution can never park unresolved arrays in the
+    scope). The checkpoints are check-then-act: a worker that passed
+    one microseconds before the deadline may still complete that one
+    action, and donated buffers may already be consumed — device state
+    is indeterminate, so recover by rollback/abort, not by trusting the
+    scope (resilience.Supervisor encodes exactly that)."""
+
+    def __init__(self, message, cache_key=None):
+        super(DispatchTimeoutError, self).__init__(message)
+        self.cache_key = cache_key
+
+
+def dispatch_with_deadline(run_impl, timeout, what):
+    """The executors' shared watchdog wrapper: run
+    `run_impl(cancelled, info)` under `run_with_deadline` and attach the
+    compile-cache key the impl recorded in `info` to a timeout raise —
+    ONE copy of the protocol for Executor.run and
+    ParallelExecutor.run."""
+    info = {}
+    try:
+        return run_with_deadline(
+            lambda cancelled: run_impl(cancelled, info), timeout,
+            what=what)
+    except DispatchTimeoutError as e:
+        e.cache_key = info.get("cache_key")
+        raise
+
+
+def run_with_deadline(fn, timeout, what="dispatch"):
+    """Run fn(cancelled_event) on a watchdog-monitored worker thread and
+    join with `timeout` seconds. On expiry the worker is abandoned (its
+    cancelled event set, so it won't touch the scope when it eventually
+    unblocks) and DispatchTimeoutError raises on the caller's thread.
+    The jax context that matters (default_device) is thread-local, so fn
+    must establish it itself."""
+    import threading
+    box = {}
+    cancelled = threading.Event()
+
+    def work():
+        try:
+            box["value"] = fn(cancelled)
+        except BaseException as e:  # noqa: BLE001 — re-raised on caller
+            box["error"] = e
+
+    t = threading.Thread(target=work, daemon=True, name="ptpu-watchdog")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        cancelled.set()
+        raise DispatchTimeoutError(
+            "%s did not complete within %.3fs (hang watchdog)"
+            % (what, timeout))
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+# Fault-injection hook (resilience/faults.py): None in production. When a
+# FaultPlan is armed it points at the plan's executor hook, which may
+# raise an injected dispatch error or sleep (slow-step) at the chosen
+# step indices — the single seam every recovery path is proved through.
+_fault_hook = None
+
+
+def _raise_program_errors(errors, include_non_guard=True):
     """Raise on tripped in-graph assertion flags (one host sync of the
     combined '__any__' scalar in the common clean case). ALL tripped
     flags are reported, not just the first: a K-step run can trip several
     independent assertions and fixing them one raise at a time wastes a
     full compile+run each round. Messages that name a variable sort
-    before the generic sub-block one so the most actionable line leads."""
+    before the generic sub-block one so the most actionable line leads.
+
+    Guard flags (GUARD_MSG_PREFIX) raise the typed NumericalGuardError so
+    a supervisor can classify the fault without string matching; with
+    include_non_guard=False (FLAGS_tensor_array_safety=0 but guards
+    installed) only guard messages are considered. A \\x00-joined key
+    carries a VECTOR of flags (check_finite_guard packs its per-var
+    checks into one output); it is unpacked here, one sync, after
+    __any__ tripped."""
     if not errors or not bool(errors["__any__"]):
         return
-    tripped = [msg for msg, flag in errors.items()
-               if msg != "__any__" and bool(flag)]
+    tripped = []
+    for msg, flag in errors.items():
+        if msg == "__any__":
+            continue
+        if "\x00" in msg:
+            vals = np.asarray(flag)
+            tripped.extend(m for m, f in zip(msg.split("\x00"), vals)
+                           if bool(f))
+        elif bool(flag):
+            tripped.append(msg)
+    if not include_non_guard:
+        tripped = [m for m in tripped if m.startswith(GUARD_MSG_PREFIX)]
     if not tripped:
         return
     named = [m for m in tripped if m.startswith("tensor array '")]
     generic = [m for m in tripped if not m.startswith("tensor array '")]
     ordered = named + generic
+    cls = (NumericalGuardError
+           if any(m.startswith(GUARD_MSG_PREFIX) for m in ordered)
+           else RuntimeError)
     if len(ordered) == 1:
-        raise RuntimeError(ordered[0])
-    raise RuntimeError(
+        raise cls(ordered[0])
+    raise cls(
         "%d in-graph assertions tripped in this run:\n- %s"
         % (len(ordered), "\n- ".join(ordered)))
 
@@ -475,7 +599,7 @@ class Executor(object):
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, steps=1,
-            fetch_reduce="stack", validate=None):
+            fetch_reduce="stack", validate=None, timeout=None):
         """Run `program` once — or, with steps=K > 1, K times inside ONE
         device-resident lax.scan dispatch: params/optimizer state stay
         donated on device across the K steps and the host syncs once per
@@ -496,7 +620,32 @@ class Executor(object):
         that built the bad op. Default None defers to the
         FLAGS_validate_program env flag; validation is cached per
         (program version, feed/fetch signature) so steady-state runs pay
-        nothing."""
+        nothing.
+
+        timeout=SECONDS arms the hang watchdog (None = off, the default,
+        zero overhead): the whole dispatch — io pre-pass, compile if any,
+        device execution, fetch readiness — runs on a monitored worker
+        thread, and a dispatch that exceeds the deadline raises
+        DispatchTimeoutError carrying the compile-cache key. Watchdog
+        mode syncs each call (the deadline needs a completion signal), so
+        it trades PR-1's async dispatch pipelining for bounded latency —
+        that is the watchdog's documented cost. After a timeout the
+        abandoned worker never writes the scope, but donated buffers may
+        already be consumed: recover by checkpoint rollback or abort."""
+        if timeout is None:
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy, use_program_cache, steps,
+                                  fetch_reduce, validate)
+        return dispatch_with_deadline(
+            lambda cancelled, info: self._run_impl(
+                program, feed, fetch_list, scope, return_numpy,
+                use_program_cache, steps, fetch_reduce, validate,
+                cancelled=cancelled, info=info, sync=True),
+            timeout, "Executor.run dispatch")
+
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
+                  use_program_cache, steps, fetch_reduce, validate,
+                  cancelled=None, info=None, sync=False):
         if program is None:
             program = default_main_program()
         feed = feed or {}
@@ -515,9 +664,32 @@ class Executor(object):
         maybe_validate_program(program, feed_arrays, fetch_names, steps,
                                self._validated, validate=validate)
 
+        if info is not None:
+            # preliminary watchdog identity: a dispatch that wedges in
+            # the io pre-pass (or an injected pre-pass fault) still gets
+            # a cache key on its DispatchTimeoutError; refined below
+            # once the stacked-feed set is known
+            info["cache_key"] = (program._uid, program._version,
+                                 _feed_signature(feed_arrays),
+                                 tuple(fetch_names))
+
+        # fault-injection seam (resilience/faults.py): BEFORE the io
+        # pre-pass and the seed draw, so an injected dispatch failure or
+        # slow step consumes no reader records and no rng — a retried
+        # step replays bit-exactly
+        if _fault_hook is not None:
+            _fault_hook("dispatch", program=program, steps=steps,
+                        feed_arrays=feed_arrays)
+
         stacked_names = set()
-        run_host_io_prepass(program, scope, feed_arrays, steps=steps,
-                            stacked_out=stacked_names)
+        try:
+            run_host_io_prepass(program, scope, feed_arrays, steps=steps,
+                                stacked_out=stacked_names,
+                                cancelled=cancelled)
+        except _DispatchCancelled:
+            return None  # deadline already raised on the caller's thread
+        if cancelled is not None and cancelled.is_set():
+            return None
 
         feed_names = sorted(feed_arrays)
         # program._uid is mandatory (as in ParallelExecutor): id() of a GC'd
@@ -535,6 +707,8 @@ class Executor(object):
                trace_env_key(),
                (steps, fetch_reduce if steps > 1 else None, unroll,
                 tuple(sorted(stacked_names))))
+        if info is not None:
+            info["cache_key"] = key
         compiled = False
         entry = self._cache.get(key) if use_program_cache else None
         if entry is not None:
@@ -579,6 +753,22 @@ class Executor(object):
             fetches, new_state, errors = jitted(
                 [feed_arrays[n] for n in feed_names],
                 read_state(state_rw), read_state(state_ro), seed)
+        if cancelled is not None and cancelled.is_set():
+            # the caller already raised DispatchTimeoutError and may be
+            # mid-rollback: a late scope write here would race the
+            # restore and resurrect stale state
+            return None
+        if sync:
+            # watchdog mode: the deadline needs a completion signal, so
+            # the worker waits for the device BEFORE the scope write-back
+            # — an execution-phase hang must leave the scope without the
+            # unresolved async arrays (np.asarray on one would block the
+            # diagnostic-bundle capture and any inspection forever; the
+            # old donated-and-deleted buffers raise instead, which
+            # write_bundle records per-var as state_unavailable)
+            jax.block_until_ready((fetches, new_state))
+            if cancelled is not None and cancelled.is_set():
+                return None
         # write state back BEFORE anything that can raise (including the
         # profiler's block_until_ready): state_rw inputs were donated to the
         # jit, so on an exception path the scope must already hold the
@@ -594,8 +784,13 @@ class Executor(object):
                 " x%d" % steps if steps > 1 else "",
                 ",".join(fetch_names) or "-")
             _prof.record_run(tag, dt, compiled=compiled)
-        if self._array_safety:
-            _raise_program_errors(errors)
+        # guard flags raise even with FLAGS_tensor_array_safety=0: a
+        # program that INSTALLED guards opted into the one-fetch sync
+        has_guards = bool(errors) and any(
+            m.startswith(GUARD_MSG_PREFIX) for m in errors)
+        if self._array_safety or has_guards:
+            _raise_program_errors(errors,
+                                  include_non_guard=self._array_safety)
         if self._check_nan_inf:
             check_finite(
                 list(zip(fetch_names, fetches)) +

@@ -645,10 +645,15 @@ def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
             if errors:
                 # one combined scalar: the caller host-syncs only this in
                 # the common (no-error) case, per-message flags only after
-                # it trips
-                any_flag = errors[next(iter(errors))]
+                # it trips. A key may carry a VECTOR of flags under a
+                # \x00-joined message list (check_finite_guard packs all
+                # its per-var flags into one [N] output — N+1 scalar
+                # outputs cost real per-dispatch marshalling time);
+                # vectors fold in via .any() so __any__ stays scalar.
+                any_flag = jnp.asarray(False)
                 for f in errors.values():
-                    any_flag = any_flag | f
+                    any_flag = any_flag | (
+                        f.any() if getattr(f, "ndim", 0) else f)
                 errors["__any__"] = any_flag
             return fetches, new_state, errors
         return fetches, new_state

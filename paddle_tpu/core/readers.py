@@ -24,13 +24,45 @@ import threading
 
 import numpy as np
 
-__all__ = ["EOFException", "HOST_IO_OPS", "run_host_io_op", "is_host_io_op"]
+__all__ = ["EOFException", "HOST_IO_OPS", "run_host_io_op", "is_host_io_op",
+           "set_fault_listener"]
 
 
 class EOFException(Exception):
     """Raised by a `read` op when the underlying reader is exhausted
     (parity: the reference reader's has_next() turning false;
     `reader.eof()` is the polite way to check first)."""
+
+
+# Fault-injection seam (resilience/faults.py): None in production. When a
+# FaultPlan is armed it points at the plan's reader hook, which can stall,
+# raise, or poison a record at a chosen stream position — keyed on the
+# reader's own delivered-record counter so it stays deterministic even
+# when a DoubleBufferReader worker pre-stages ahead of the training loop.
+_fault_hook = None
+
+# Supervisor fault channel: a reader worker thread that hits an exception
+# notifies this listener IMMEDIATELY (from the worker), instead of the
+# error surfacing only at the next `read` — a supervisor learns about a
+# dying input pipeline while the current step is still computing.
+_fault_listener = None
+
+
+def set_fault_listener(fn):
+    """Install `fn(reader, exc)` as the reader-worker fault channel;
+    returns the previous listener (restore it when done). fn runs ON the
+    worker thread and must be quick and exception-safe."""
+    global _fault_listener
+    old, _fault_listener = _fault_listener, fn
+    return old
+
+
+def _notify_fault(reader, exc):
+    if _fault_listener is not None:
+        try:
+            _fault_listener(reader, exc)
+        except Exception:
+            pass  # a broken listener must not mask the real fault
 
 
 # op types the Executor runs host-side instead of lowering to XLA
@@ -63,10 +95,18 @@ class ReaderBase(object):
         self._consumed = 0
 
     def next(self):
+        if _fault_hook is not None:
+            # "read" phase: may sleep (injected stall) or raise (injected
+            # reader error / early EOF) BEFORE the record pops, so the
+            # stream position is untouched by the failure
+            _fault_hook("read", self)
         if self._pending:
             rec = self._pending.popleft()
         else:
             rec = self._next()
+        if _fault_hook is not None:
+            # "record" phase: may poison the popped record (NaN feeds)
+            rec = _fault_hook("record", self, record=rec) or rec
         self._consumed += 1
         return rec
 
@@ -175,6 +215,7 @@ class MultiFileReader(ReaderBase):
         self._gen = 0
         self._threads = []
         self._q = None
+        self._died = None  # _ReaderError a worker died with (sticky)
 
     def _start(self):
         from ..recordio_writer import recordio_reader
@@ -197,6 +238,8 @@ class MultiFileReader(ReaderBase):
                         if gen != self._gen:
                             return
             except Exception as e:  # bad/corrupt file: surface, don't hang
+                _notify_fault(self, e)  # supervisor channel: immediately
+                self._died = _ReaderError(e)  # sticky: dead != exhausted
                 q.put(_ReaderError(e))
                 return
             finally:
@@ -216,18 +259,31 @@ class MultiFileReader(ReaderBase):
         # poll with a liveness check: the EOF sentinel is one-shot, and a
         # next_many that hit it mid-block consumed it while pushing its
         # records back — once those drain, a plain q.get() would block
-        # forever on the dead workers instead of raising EOF again
+        # forever on the dead workers instead of raising EOF again.
+        # Pin THIS call's queue/threads in locals: a reset (e.g. a
+        # checkpoint restore replaying the stream after a watchdog
+        # abandoned a dispatch inside this very loop) swaps them, and a
+        # stale poller re-reading self._q would steal records from the
+        # freshly reset stream — pinned, it sees its dead generation and
+        # exits with a harmless EOF instead
+        q, threads = self._q, self._threads
         while True:
             try:
-                item = self._q.get(timeout=0.05)
+                item = q.get(timeout=0.05)
                 break
             except queue.Empty:
-                if not any(t.is_alive() for t in self._threads):
+                if not any(t.is_alive() for t in threads):
+                    if self._died is not None:
+                        # a stream killed by a worker ERROR is not
+                        # exhausted: re-raise the death, sticky, so a
+                        # supervisor's escalation chain keeps seeing a
+                        # reader fault instead of a clean end-of-data
+                        self._died.reraise()
                     raise EOFException()
         if item is _EOF_SENTINEL:
             raise EOFException()
         if isinstance(item, _ReaderError):
-            raise item.error
+            item.reraise()
         return item
 
     def _stop(self):
@@ -247,12 +303,14 @@ class MultiFileReader(ReaderBase):
     def _reset(self):
         if self._threads:
             self._stop()
+        self._died = None  # a fresh scan gets a fresh verdict
         # lazy: the next read starts fresh threads
 
     def close(self):
         super(MultiFileReader, self).close()
         if self._threads:
             self._stop()
+        self._died = None
 
 
 _EOF_SENTINEL = object()
@@ -331,6 +389,7 @@ class DoubleBufferReader(ReaderBase):
         self._place = place
         self._gen = 0
         self._stashed_error = None
+        self._died = None  # _ReaderError the worker died with (sticky)
         _live_double_buffers.add(self)
         self._start()
 
@@ -399,6 +458,11 @@ class DoubleBufferReader(ReaderBase):
                     q.put(_EOF_SENTINEL)
                     return
                 except Exception as e:  # propagate reader errors to next()
+                    # fault channel FIRST: the supervisor hears about the
+                    # dying pipeline now, not at the next read (which may
+                    # be a full staged-queue later)
+                    _notify_fault(self, e)
+                    self._died = _ReaderError(e)  # sticky: dead != EOF
                     q.put(_ReaderError(e))
                     return
                 staged = tuple(
@@ -411,22 +475,35 @@ class DoubleBufferReader(ReaderBase):
 
     def _next(self):
         if self._stashed_error is not None:
+            # stashed by ensure_staging_depth's drain (PR-1 fix): re-raise
+            # WITH the worker's original traceback so the callstack names
+            # the frame that actually died, not this replay site
             err, self._stashed_error = self._stashed_error, None
-            raise err.error
+            err.reraise()
         # same one-shot-sentinel hazard as MultiFileReader._next: after a
         # mid-block next_many consumed the sentinel and the worker exited,
-        # the drained tail must end in EOF again, not a hang on q.get()
+        # the drained tail must end in EOF again, not a hang on q.get().
+        # Queue/thread pinned in locals for the same stale-poller reason
+        # (a reset during a watchdog-abandoned read must not let this
+        # loop steal from the restarted stream's queue).
+        q, thread = self._q, self._thread
         while True:
             try:
-                item = self._q.get(timeout=0.05)
+                item = q.get(timeout=0.05)
                 break
             except queue.Empty:
-                if not self._thread.is_alive():
+                if not thread.is_alive():
+                    if self._died is not None:
+                        # worker died on an ERROR, not the sentinel: a
+                        # dead stream must keep raising its death (a
+                        # supervisor would otherwise read a clean
+                        # end-of-data and truncate training silently)
+                        self._died.reraise()
                     raise EOFException()
         if item is _EOF_SENTINEL:
             raise EOFException()
         if isinstance(item, _ReaderError):
-            raise item.error
+            item.reraise()
         return item
 
     def _stop(self, max_wait=None):
@@ -470,19 +547,36 @@ class DoubleBufferReader(ReaderBase):
         self._stop()
         # an error ensure_staging_depth stashed belongs to the OLD stream;
         # surviving the reset would fail the fresh epoch's first read
+        # (the sticky worker-death verdict likewise)
         self._stashed_error = None
+        self._died = None
         self._under.reset()
         self._start()
 
     def close(self):
         super(DoubleBufferReader, self).close()
         self._stashed_error = None
+        self._died = None
         self._stop()
 
 
 class _ReaderError(object):
+    """A worker-thread exception in transit to the consuming thread. The
+    original traceback rides on the exception object itself; `reraise`
+    re-raises WITH it so the visible callstack reaches into the worker
+    (the frame that actually died), not just the stash-and-replay site.
+    Tagged `_reader_fault` so a supervisor can classify the failure as
+    reader-class without string matching."""
+
     def __init__(self, error):
         self.error = error
+        try:
+            error._reader_fault = True
+        except Exception:
+            pass  # exceptions with __slots__: classification degrades only
+
+    def reraise(self):
+        raise self.error.with_traceback(self.error.__traceback__)
 
 
 # Interpreter-exit safety: a daemon worker parked inside jax.device_put /

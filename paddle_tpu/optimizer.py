@@ -23,8 +23,56 @@ __all__ = [
     "DecayedAdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
     "FtrlOptimizer", "ModelAverage", "Optimizer",
     "ProximalGD", "ProximalAdagrad", "ProximalGDOptimizer",
-    "ProximalAdagradOptimizer",
+    "ProximalAdagradOptimizer", "scale_learning_rate",
+    "persistable_lr_names",
 ]
+
+
+def persistable_lr_names(program):
+    """Names of the PERSISTABLE learning-rate variables the program's
+    update ops read (in op order, deduped). Empty for scheduler-derived
+    rates, which are recomputed in-graph each step — the single source
+    of truth for both scale_learning_rate and the resilience
+    Supervisor's construction-time lr_scale validation."""
+    names = []
+    for op in program.global_block().ops:
+        for n in op.inputs.get("LearningRate", ()):
+            if n and n not in names:
+                v = program.global_block().vars.get(n)
+                if v is not None and v.persistable:
+                    names.append(n)
+    return names
+
+
+def scale_learning_rate(program, scope, factor):
+    """Scale every persistable learning-rate variable the program's
+    update ops read by `factor`, in the scope (device- or host-side
+    value, dtype preserved). The resilience supervisor's rollback
+    re-entry damping: after restoring a snapshot it can re-enter the
+    divergent region at e.g. 0.5x LR instead of replaying the same blowup.
+
+    Returns the list of scaled var names. Scheduler-computed rates
+    (exponential_decay etc.) are re-derived in-graph from their counter
+    every step, so there is no persistable to scale — if NO update op
+    reads a persistable LR, this raises so the caller knows the damping
+    did not take (wrap the scheduler output in a persistable var, or
+    rebuild with a float learning_rate, to use lr_scale)."""
+    import numpy as np
+    scaled = []
+    for n in persistable_lr_names(program):
+        val = scope.get(n)
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        scope.set(n, (arr * factor).astype(arr.dtype))
+        scaled.append(n)
+    if not scaled:
+        raise ValueError(
+            "scale_learning_rate: no persistable learning-rate variable "
+            "holds a value in the scope — scheduler-derived rates are "
+            "recomputed in-graph each step and cannot be damped this "
+            "way")
+    return scaled
 
 
 class Optimizer(object):

@@ -161,13 +161,32 @@ class ParallelExecutor(object):
         return self.mesh.devices.size
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True,
-            steps=1, fetch_reduce="stack"):
+            steps=1, fetch_reduce="stack", timeout=None):
         """Sharded run; steps=K runs the K-step device-resident loop (see
         Executor.run): the scan composes with the GSPMD shardings — feeds
         stay batch-sharded per step, params keep their replicated / ZeRO
         (sharded_weight_update) / tensor-parallel layouts across the loop
         carry, and XLA still inserts the gradient collectives inside the
-        loop body. One host sync per K steps per call."""
+        loop body. One host sync per K steps per call.
+
+        timeout=SECONDS arms the same hang watchdog Executor.run(timeout=)
+        has: the dispatch runs on a monitored worker thread and raises
+        DispatchTimeoutError past the deadline (device state then
+        indeterminate — recover by rollback/abort, see
+        paddle_tpu.resilience)."""
+        if timeout is None:
+            return self._run_impl(fetch_list, feed, feed_dict, return_numpy,
+                                  steps, fetch_reduce)
+        from ..core.executor import dispatch_with_deadline
+        return dispatch_with_deadline(
+            lambda cancelled, info: self._run_impl(
+                fetch_list, feed, feed_dict, return_numpy, steps,
+                fetch_reduce, cancelled=cancelled, info=info, sync=True),
+            timeout, "ParallelExecutor.run dispatch")
+
+    def _run_impl(self, fetch_list, feed=None, feed_dict=None,
+                  return_numpy=True, steps=1, fetch_reduce="stack",
+                  cancelled=None, info=None, sync=False):
         feed = feed if feed is not None else (feed_dict or {})
         program = self._program
         scope = self._scope
@@ -183,9 +202,22 @@ class ParallelExecutor(object):
 
         # strict mode (FLAGS_validate_program): same pre-lowering static
         # verification Executor.run performs
+        from ..core import executor as _exe_mod
         from ..core.executor import maybe_validate_program
         maybe_validate_program(program, feed_arrays, fetch_names, steps,
                                self._validated)
+
+        if info is not None:
+            # preliminary watchdog identity (refined after the prepass)
+            info["cache_key"] = (program._uid, program._version,
+                                 _feed_signature(feed_arrays),
+                                 tuple(fetch_names))
+
+        # same fault-injection seam as Executor._run_impl: before the io
+        # pre-pass and seed draw, so injected failures consume nothing
+        if _exe_mod._fault_hook is not None:
+            _exe_mod._fault_hook("dispatch", program=program, steps=steps,
+                                 feed_arrays=feed_arrays)
 
         def _batch_leading(name):
             return _var_batch_leading(_find_var(program, name))
@@ -215,9 +247,14 @@ class ParallelExecutor(object):
                         f, "reader record field %r" % getattr(v, "name", "?"))
 
         stacked_names = set()
-        run_host_io_prepass(program, scope, feed_arrays, host=True,
-                            validate=_validate_record, steps=steps,
-                            stacked_out=stacked_names)
+        from ..core.executor import _DispatchCancelled
+        try:
+            run_host_io_prepass(program, scope, feed_arrays, host=True,
+                                validate=_validate_record, steps=steps,
+                                stacked_out=stacked_names,
+                                cancelled=cancelled)
+        except _DispatchCancelled:
+            return None  # watchdog deadline already raised on the caller
         feed_names = sorted(feed_arrays)
 
         def _feed_sharding(name, ndim):
@@ -243,6 +280,8 @@ class ParallelExecutor(object):
                trace_env_key(),
                (steps, fetch_reduce if steps > 1 else None, unroll,
                 tuple(sorted(stacked_names))))
+        if info is not None:
+            info["cache_key"] = key
         compiled = False
         entry = self._cache.get(key)
         if entry is not None:
@@ -305,11 +344,22 @@ class ParallelExecutor(object):
         t0 = _time.perf_counter() if profiling else 0.0
         fetches, new_state, errors = jitted(feed_vals, read_state(state_rw),
                                             read_state(state_ro), seed)
+        if cancelled is not None and cancelled.is_set():
+            # caller already raised DispatchTimeoutError; a late scope
+            # write would race its rollback (see Executor._run_impl)
+            return None
+        if sync:
+            # watchdog mode: device-sync BEFORE the scope write-back so
+            # an execution-phase hang can't park unresolved arrays in
+            # the scope (see Executor._run_impl)
+            jax.block_until_ready((fetches, new_state))
+            if cancelled is not None and cancelled.is_set():
+                return None
         # state write-back precedes any raise point (incl. the sync below):
         # rw inputs were donated (see Executor.run)
         for n, v in zip(state_out, new_state):
             scope.set(n, v)
-        if self._sync_dispatch:
+        if self._sync_dispatch and not sync:
             jax.block_until_ready((fetches, new_state))
         if profiling:
             jax.block_until_ready((fetches, new_state))
@@ -318,8 +368,12 @@ class ParallelExecutor(object):
                 ",".join(fetch_names) or "-")
             _prof.record_run(tag, _time.perf_counter() - t0,
                              compiled=compiled)
-        if self._array_safety:
-            _raise_program_errors(errors)
+        from ..core.executor import GUARD_MSG_PREFIX
+        has_guards = bool(errors) and any(
+            m.startswith(GUARD_MSG_PREFIX) for m in errors)
+        if self._array_safety or has_guards:
+            _raise_program_errors(errors,
+                                  include_non_guard=self._array_safety)
         if self._check_nan_inf:
             check_finite(
                 list(zip(fetch_names, fetches)) +

@@ -14,7 +14,7 @@ import time
 import jax
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
-           "profile_report"]
+           "profile_report", "record_event"]
 
 _active = False
 _trace_dir = None
@@ -43,6 +43,15 @@ def record_run(tag, seconds, compiled=False):
         e["total"] += seconds
         e["max"] = max(e["max"], seconds)
         e["min"] = min(e["min"], seconds)
+
+
+def record_event(tag, seconds=0.0):
+    """Count a discrete runtime event into the Event table — the
+    resilience supervisor tags every recovery action this way
+    (`resilience/<fault>:<action>` rows), so one profile_report() shows
+    training dispatches and fault handling side by side. `seconds` is
+    the time the handler spent (0 for pure bookkeeping events)."""
+    record_run(tag, seconds, compiled=False)
 
 
 _SORT_KEYS = ("calls", "total", "max", "min", "ave")

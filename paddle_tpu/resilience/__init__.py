@@ -1,0 +1,56 @@
+"""paddle_tpu.resilience — supervised training that survives bad batches,
+hangs, and dying input pipelines (ARCHITECTURE.md §17).
+
+Detection + policy + recovery as one subsystem over the PR-1 executor,
+PR-4 checkpoints, and the reader stack:
+
+  * guards    — device-side fused all-finite checks appended to the
+                lowered step (sticky assertion flags, ONE extra fetch,
+                composes with steps=K) that GATE every persistable
+                update in-graph, plus a host-side loss-EMA divergence
+                detector. `FLAGS_check_nan_inf`'s job, done without a
+                per-tensor D2H sweep and without poisoned params.
+  * watchdog  — per-dispatch deadlines (`Executor.run(timeout=)` →
+                typed DispatchTimeoutError) and self-contained
+                diagnostic bundles `tools/ptpu_doctor.py` can replay.
+  * Supervisor — the policy engine: per fault class (numeric / hang /
+                reader / dispatch) an escalation chain of skip_batch →
+                retry(backoff) → rollback(lr_scale) → abort(bundle),
+                every action in a structured event log + profiler tags.
+  * faults    — a deterministic fault plan (`PTPU_FAULT_PLAN` env or
+                programmatic) injecting NaN feeds, reader stalls/EOFs/
+                errors, dispatch exceptions, slow steps and checkpoint
+                kills at chosen indices, so every recovery path above is
+                provable in CI.
+
+Quickstart:
+
+    from paddle_tpu import resilience as rz
+    mgr = fluid.CheckpointManager("ckpt/")
+    sup = rz.Supervisor(exe, main_prog, checkpoint_manager=mgr,
+                        watchdog_timeout=120,
+                        policies={"numeric": [rz.skip_batch(2),
+                                              rz.rollback(2, lr_scale=0.5),
+                                              rz.abort("bundles/")]})
+    rz.install_numeric_guards(main_prog, loss=avg_cost)
+    sup.train(10000, fetch_list=[avg_cost], checkpoint_every=100)
+"""
+from ..core.executor import DispatchTimeoutError, NumericalGuardError
+from .faults import (FaultPlan, InjectedDispatchError, InjectedFault,
+                     InjectedReaderError, active_plan)
+from .guards import (DivergenceDetector, DivergenceFault,
+                     install_numeric_guards)
+from .supervisor import (DEFAULT_POLICIES, FAULT_CLASSES, Action,
+                         Supervisor, TrainingAborted, abort, retry,
+                         rollback, skip_batch)
+from .watchdog import read_bundle, write_bundle
+
+__all__ = [
+    "Supervisor", "TrainingAborted", "Action", "skip_batch", "retry",
+    "rollback", "abort", "DEFAULT_POLICIES", "FAULT_CLASSES",
+    "install_numeric_guards", "DivergenceDetector", "DivergenceFault",
+    "NumericalGuardError", "DispatchTimeoutError",
+    "FaultPlan", "InjectedFault", "InjectedDispatchError",
+    "InjectedReaderError", "active_plan",
+    "write_bundle", "read_bundle",
+]

@@ -1,0 +1,278 @@
+"""Deterministic fault injection: one registry for every failure mode.
+
+A `FaultPlan` is an ordered set of (kind, index[, arg]) entries — parsed
+from the `PTPU_FAULT_PLAN` env var (`"nan_feed@5;reader_stall@8:0.5"`) or
+built programmatically — that injects failures at chosen indices so every
+recovery path (resilience.Supervisor policies, checkpoint rollback, the
+hang watchdog) is provable in CI instead of waited for in production.
+Arming a plan installs hooks at three seams:
+
+  * `core.executor._fault_hook` — fires per DISPATCH, keyed on the step
+    counter (`plan.set_step`, which the Supervisor advances): `nan_feed`
+    poisons a float feed array, `dispatch_exc` raises
+    InjectedDispatchError, `slow_step` sleeps `arg` seconds (trips the
+    watchdog). All fire BEFORE the io pre-pass and seed draw, so a
+    failed attempt consumes nothing and retries replay bit-exactly.
+  * `core.readers._fault_hook` — fires per RECORD, keyed on each
+    reader's own delivered-record counter (deterministic even when a
+    DoubleBufferReader worker pre-stages ahead of the training loop):
+    `reader_nan` poisons the record's float fields, `reader_exc` raises
+    InjectedReaderError (from the worker thread for buffered readers —
+    exercising the immediate fault channel), `reader_stall` sleeps,
+    `reader_eof` ends the stream early.
+  * `checkpoint.snapshot._fault_hook` — `ckpt_kill@N` SIGKILLs at the
+    Nth durability crossing of the write protocol, subsuming PR-4's
+    `PTPU_CKPT_FAULT_AT` (which keeps working unchanged) under this
+    registry.
+
+Entries are ONE-SHOT by default (`kind@idx`); `kind@idx*` repeats every
+time the index matches. One plan may be armed per process at a time.
+"""
+import os
+import threading
+
+import numpy as np
+
+__all__ = ["FaultPlan", "InjectedFault", "InjectedDispatchError",
+           "InjectedReaderError", "active_plan"]
+
+_KINDS = frozenset({
+    "nan_feed", "dispatch_exc", "slow_step",
+    "reader_nan", "reader_exc", "reader_stall", "reader_eof",
+    "ckpt_kill",
+})
+_READER_KINDS = frozenset({"reader_nan", "reader_exc", "reader_stall",
+                           "reader_eof"})
+
+
+class InjectedFault(RuntimeError):
+    """Base of all plan-injected failures (so tests/supervisors can tell
+    injected faults from organic ones when they need to)."""
+
+
+class InjectedDispatchError(InjectedFault):
+    """Injected executor-dispatch failure (fault kind `dispatch_exc`)."""
+
+
+class InjectedReaderError(InjectedFault):
+    """Injected reader failure (fault kind `reader_exc`); tagged
+    reader-class for the supervisor's fault classifier."""
+    _reader_fault = True
+
+
+class _Entry(object):
+    __slots__ = ("kind", "at", "arg", "repeat", "fired")
+
+    def __init__(self, kind, at, arg=None, repeat=False):
+        if kind not in _KINDS:
+            raise ValueError(
+                "unknown fault kind %r; known kinds: %s"
+                % (kind, ", ".join(sorted(_KINDS))))
+        self.kind = kind
+        self.at = int(at)
+        self.arg = arg
+        self.repeat = bool(repeat)
+        self.fired = False
+
+    def __repr__(self):
+        return "%s@%d%s%s" % (self.kind, self.at,
+                              ":%g" % self.arg if self.arg is not None
+                              else "", "*" if self.repeat else "")
+
+
+def _parse_entry(spec):
+    """'kind@idx[:arg][*]' -> _Entry. Raises LOUDLY on malformed specs
+    (the FLAGS_conv_layout rule: a typo'd plan silently injecting nothing
+    would green-light an untested recovery path)."""
+    s = spec.strip()
+    repeat = s.endswith("*")
+    if repeat:
+        s = s[:-1]
+    if "@" not in s:
+        raise ValueError("fault spec %r: expected 'kind@index[:arg]'" % spec)
+    kind, _, rest = s.partition("@")
+    arg = None
+    if ":" in rest:
+        at_s, _, arg_s = rest.partition(":")
+        arg = float(arg_s)
+    else:
+        at_s = rest
+    return _Entry(kind.strip(), int(at_s), arg=arg, repeat=repeat)
+
+
+_active = None
+_lock = threading.Lock()
+
+
+def active_plan():
+    """The currently armed FaultPlan, or None."""
+    return _active
+
+
+class FaultPlan(object):
+    def __init__(self, entries=()):
+        self.entries = []
+        for e in entries:
+            if isinstance(e, _Entry):
+                self.entries.append(e)
+            elif isinstance(e, str):
+                self.entries.append(_parse_entry(e))
+            else:
+                kind, at = e[0], e[1]
+                arg = e[2] if len(e) > 2 else None
+                self.entries.append(_Entry(kind, at, arg=arg))
+        self._step = 0
+        self._ckpt_crossings = 0
+        # one-shot bookkeeping is check-then-act; reader hooks fire from
+        # worker threads (DoubleBuffer pre-staging), so _take must be
+        # atomic or a "one-shot" could fire twice in a tight race
+        self._take_lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, spec=None):
+        """Parse PTPU_FAULT_PLAN (or an explicit spec string). Returns
+        None when the var is unset/empty — callers can arm
+        unconditionally via `plan = FaultPlan.from_env();
+        if plan: plan.arm()`."""
+        spec = os.environ.get("PTPU_FAULT_PLAN", "") if spec is None \
+            else spec
+        spec = spec.strip()
+        if not spec:
+            return None
+        return cls([s for s in spec.split(";") if s.strip()])
+
+    # ------------------------------------------------------------ state --
+    def set_step(self, step):
+        """Advance the step cursor the dispatch-level faults key on (the
+        Supervisor calls this before every attempt)."""
+        self._step = int(step)
+
+    def pending(self):
+        """Entries that have not fired yet (one-shot bookkeeping)."""
+        return [e for e in self.entries if e.repeat or not e.fired]
+
+    def _take(self, kinds, at):
+        with self._take_lock:
+            for e in self.entries:
+                if e.kind in kinds and e.at == at \
+                        and (e.repeat or not e.fired):
+                    e.fired = True
+                    return e
+        return None
+
+    # ------------------------------------------------------------- arm --
+    def arm(self):
+        """Install this plan's hooks (executor, readers, checkpoint).
+        Raises if another plan is armed — overlapping plans would make
+        the injection schedule nondeterministic."""
+        global _active
+        from ..core import executor as _exe
+        from ..core import readers as _rdr
+        from ..checkpoint import snapshot as _snap
+        with _lock:
+            if _active is not None and _active is not self:
+                raise RuntimeError("another FaultPlan is already armed")
+            _active = self
+            _exe._fault_hook = self._executor_hook
+            _rdr._fault_hook = self._reader_hook
+            _snap._fault_hook = self._ckpt_hook
+        return self
+
+    def disarm(self):
+        global _active
+        from ..core import executor as _exe
+        from ..core import readers as _rdr
+        from ..checkpoint import snapshot as _snap
+        with _lock:
+            if _active is self:
+                _active = None
+                _exe._fault_hook = None
+                _rdr._fault_hook = None
+                _snap._fault_hook = None
+
+    def __enter__(self):
+        return self.arm()
+
+    def __exit__(self, *exc):
+        self.disarm()
+
+    # ----------------------------------------------------------- hooks --
+    def _executor_hook(self, point, program=None, steps=1,
+                       feed_arrays=None):
+        del point, program
+        e = self._take(("slow_step",), self._step)
+        if e is not None:
+            import time
+            time.sleep(e.arg if e.arg is not None else 1.0)
+        e = self._take(("dispatch_exc",), self._step)
+        if e is not None:
+            raise InjectedDispatchError(
+                "injected dispatch failure at step %d (fault plan)"
+                % self._step)
+        e = self._take(("nan_feed",), self._step)
+        if e is not None and feed_arrays is not None:
+            _poison_first_float(feed_arrays)
+
+    def _reader_hook(self, phase, reader, record=None):
+        # fire only at SOURCE readers (no `_under` wrapper): in a
+        # decorator chain both the inner reader (worker thread,
+        # pre-staging ahead) and the outer one pass every index, and
+        # whichever hit a one-shot entry first would win by thread
+        # timing — source-level injection is deterministic in stream
+        # order regardless of buffering
+        if getattr(reader, "_under", None) is not None:
+            return None
+        at = reader._consumed
+        if phase == "read":
+            e = self._take(("reader_stall",), at)
+            if e is not None:
+                import time
+                time.sleep(e.arg if e.arg is not None else 1.0)
+            e = self._take(("reader_eof",), at)
+            if e is not None:
+                from ..core.readers import EOFException
+                raise EOFException()
+            e = self._take(("reader_exc",), at)
+            if e is not None:
+                raise InjectedReaderError(
+                    "injected reader failure at record %d (fault plan)"
+                    % at)
+            return None
+        # phase == "record": poison the popped record's float fields
+        e = self._take(("reader_nan",), at)
+        if e is None:
+            return None
+        poisoned = []
+        hit = False
+        for f in record:
+            a = np.array(f, copy=True)
+            if not hit and np.issubdtype(a.dtype, np.floating):
+                a.reshape(-1)[0] = np.nan
+                hit = True
+            poisoned.append(a)
+        return tuple(poisoned)
+
+    def _ckpt_hook(self):
+        n = self._ckpt_crossings
+        self._ckpt_crossings = n + 1
+        e = self._take(("ckpt_kill",), n)
+        if e is not None:
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _poison_first_float(feed_arrays):
+    """Overwrite the first element of the first float feed with NaN —
+    in place in the feed dict, deterministically (sorted name order)."""
+    import jax.numpy as jnp
+    for name in sorted(feed_arrays):
+        v = feed_arrays[name]
+        dt = np.dtype(getattr(v, "dtype", np.asarray(v).dtype))
+        if not np.issubdtype(dt, np.floating):
+            continue
+        a = np.array(np.asarray(v), copy=True)
+        a.reshape(-1)[0] = np.nan
+        feed_arrays[name] = jnp.asarray(a) if not isinstance(
+            v, np.ndarray) else a
+        return name
+    return None

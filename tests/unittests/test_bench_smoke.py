@@ -143,6 +143,48 @@ def test_bench_ckpt_smoke():
     assert modes["async"]["save_latency_ms"] > 0
 
 
+def test_bench_resil_smoke():
+    """The BENCH_RESIL leg: one subprocess run on CPU comparing guards
+    off vs on, single-step and steps=K. The acceptance gate rides here:
+    the numerical guards (per-grad all-finite checks fused into the
+    backward + one lax.cond gating the state updates) must cost < 10%
+    on the smoke model in BOTH modes — otherwise "always-on guards" is
+    a lie and nobody ships them. The box is a single shared core, so
+    one noise-retry is allowed before the gate fails (the bench itself
+    already takes min-of-repeats)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_RESIL": "1",
+        "BENCH_STEPS": "48", "BENCH_WARMUP": "2",
+        # lax.scan lowering for the K=8 leg (same reasoning as
+        # test_bench_multistep_smoke: the CPU-default unroll compiles
+        # K copies and belongs in a perf sweep, not CI)
+        "FLAGS_multistep_unroll": "0",
+    })
+    for attempt in (0, 1):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=900)
+        assert out.returncode == 0, out.stdout + out.stderr
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "resil_guarded_steps_per_sec"
+        assert rec["unit"] == "steps/sec"
+        assert rec["value"] > 0
+        assert rec["vs_baseline"] is None
+        for k in ("plain_steps_per_sec", "guarded_steps_per_sec",
+                  "multistep_steps_per_sec",
+                  "multistep_guarded_steps_per_sec"):
+            assert rec[k] > 0
+        if max(rec["overhead_pct_plain"],
+               rec["overhead_pct_multistep"]) < 10.0:
+            break
+    assert rec["overhead_pct_plain"] < 10.0, rec
+    assert rec["overhead_pct_multistep"] < 10.0, rec
+
+
 def test_tool_shell_scripts_parse():
     """bash -n every tools/*.sh: a syntax error in a sweep script would
     consume the round's only healthy tunnel window (the probe loop
