@@ -95,19 +95,10 @@ def sha256_file(path):
 _sha256_file = sha256_file
 
 
-def _fsync_dir(path):
-    fd = os.open(path, os.O_RDONLY)
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-
-
-def _write_bytes(path, data):
-    with open(path, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
+# one shared implementation of the durability primitives (also used by
+# core/compile_cache.py — a crash-safety fix lands in both)
+from ..core.utils import fsync_dir as _fsync_dir
+from ..core.utils import write_bytes_fsync as _write_bytes
 
 
 def step_dir_name(step):

@@ -176,7 +176,12 @@ def _backward_sweep(block, path_flags, needed, no_grad, seed_names,
                 "fwd_attrs": dict(op.attrs),
                 "fwd_inputs": {s: list(n) for s, n in op.inputs.items()},
                 "fwd_outputs": {s: list(n) for s, n in op.outputs.items()},
-                "no_grad_names": tuple(no_grad),
+                # sorted: no_grad is a SET, and set iteration order
+                # varies with PYTHONHASHSEED — an unsorted tuple here
+                # made byte-identical model builds serialize differently
+                # per process, re-keying the persistent compile cache on
+                # every restart (found by its cross-process hit test)
+                "no_grad_names": tuple(sorted(no_grad)),
                 "__accumulate_outputs__": True,
             },
             infer_shape=False)

@@ -1,21 +1,91 @@
-"""Persistent XLA compilation cache (opt-in).
+"""Compile caches: jax's persistent HLO cache + the paddle_tpu AOT
+artifact cache.
 
 TPU compiles are expensive (20-40 s for a ResNet-50 train step; tens of
-minutes for remat graphs at large batch). jax ships a persistent
-executable cache keyed on the HLO + compile options; enabling it makes
-every repeat bench config / restarted sweep load its executable from
-disk instead of recompiling — directly attacking the round-4 failure
-mode where a 20-min remat compile burned the tunnel window twice.
+minutes for remat graphs at large batch), and every process start pays
+them again: serving warmup re-traces its whole bucket lattice, a trainer
+restarting after a rollback re-compiles the very step it just ran, and
+the round-4 sweeps lost entire tunnel windows to 20-minute remat
+compiles. Two layers attack that:
 
-Enable with FLAGS_compile_cache_dir=<dir> (bench.py defaults it to
-/tmp/ptpu_compile_cache; the test suite leaves it off — CPU compiles are
-cheap and test isolation matters more). The reference era had no
-counterpart (its op-by-op executor had nothing to cache); this is a
-TPU-native runtime feature.
+1. ``maybe_enable_persistent_cache`` — jax's own persistent compilation
+   cache (HLO + compile options -> executable). Kills the XLA *backend
+   compile*, but a fresh process still pays the full Python trace and
+   lowering of every program.
+
+2. The **AOT artifact cache** (this module's main export): serialized
+   *compiled executables* (``jax.experimental.serialize_executable``)
+   keyed by the same signature the executors' in-process jit cache
+   already computes — program CONTENT hash + feed/fetch signature +
+   ``(K, fetch_reduce, unroll, stacked-feeds)`` + trace-time env flags +
+   device/platform + jax version. A warm process start skips trace,
+   lowering AND compile: one disk read, one deserialize, dispatch.
+
+Integrity model (the checkpoint/snapshot.py discipline): entries are
+written into a ``.tmp_*.<pid>`` directory with per-file fsync, published
+by ONE ``os.rename``, and carry sha256 hashes of the payload in
+``meta.json``; loads re-hash before deserializing, so a torn or
+bit-flipped entry is SKIPPED WITH A WARNING and the caller falls back to
+a fresh compile — never a half-loaded executable. The deserialization
+itself is a pickle (jax's wire format), which is why the hash check is
+mandatory, the default cache dir is per-uid, and a shared cache dir must
+be trusted like the checkpoint root: whoever can write it can execute
+code in your process.
+
+Enable with FLAGS_aot_cache_dir=<dir> (ptpu_serve defaults it on, and
+bench.py's BENCH_COMPILE_CACHE leg measures it; the test suite leaves
+it off — CPU compiles are cheap and test isolation matters more). ''
+is the explicit off switch. The reference era had no counterpart: its
+op-by-op executor had nothing to cache.
 """
+import hashlib
+import json
 import os
+import pickle
+import shutil
+import time
+import warnings
+
+AOT_FORMAT_VERSION = 1
+AOT_ENTRY_PREFIX = "aot_"
+AOT_TMP_PREFIX = ".tmp_aot_"
+META_FILE = "meta.json"
+PAYLOAD_FILE = "payload.bin"
+TREES_FILE = "trees.pkl"
 
 _enabled_dir = None
+_aot_default_dir = None
+_warned = set()
+
+# always-on counters (the profiler's per-tag view needs an active
+# profiler; subprocess tests and bench legs read these instead)
+_aot_stats = {"hits": 0, "misses": 0, "stores": 0, "store_errors": 0,
+              "load_errors": 0, "saved_s": 0.0}
+
+
+def aot_stats():
+    """Snapshot of the process-wide AOT cache counters: hits (disk loads
+    that replaced a compile), misses (keys with no usable entry), stores
+    (entries published by this process), load_errors (corrupt/stale
+    entries skipped), store_errors, saved_s (recorded compile seconds
+    avoided, net of deserialize time)."""
+    return dict(_aot_stats)
+
+
+def reset_aot_stats():
+    for k in _aot_stats:
+        _aot_stats[k] = 0.0 if k == "saved_s" else 0
+
+
+def _warn_once(key, message):
+    """One warning per distinct failure site per process: a cache is an
+    optimization and must not spam, but a silently swallowed enable
+    failure (the pre-PR-6 behavior) means nobody learns the cache was
+    off until the bench numbers look wrong."""
+    if key in _warned:
+        return
+    _warned.add(key)
+    warnings.warn(message, RuntimeWarning, stacklevel=3)
 
 
 def default_cache_dir():
@@ -33,22 +103,50 @@ def maybe_enable_persistent_cache(default_dir=None):
     FLAGS_compile_cache_dir (or ``default_dir`` when the flag is UNSET).
     An explicitly-set EMPTY flag disables the cache even when the caller
     passes a default — the supported off switch for compile-inclusive
-    timing runs. Returns the directory in effect, or None when off."""
+    timing runs. Returns the directory in effect, or None when off.
+
+    Once enabled, the cache stays pinned at the first directory for the
+    life of the process: jax keeps no per-entry dir association, so
+    repointing mid-process would split entries across dirs and serve
+    neither reliably. A mid-process flag change WARNS and keeps
+    returning the enabled dir (it used to silently ignore the new
+    value), and an enable failure WARNS with the reason instead of
+    silently returning None."""
     global _enabled_dir
     if "FLAGS_compile_cache_dir" in os.environ:
         path = os.environ["FLAGS_compile_cache_dir"]  # '' = explicit off
     else:
         path = default_dir
+    if _enabled_dir is not None:
+        # already enabled: the dir in effect wins for the whole process
+        if path and os.path.abspath(path) != os.path.abspath(_enabled_dir):
+            _warn_once(
+                "xla-cache-repoint",
+                "FLAGS_compile_cache_dir changed to %r but the persistent "
+                "compilation cache is already enabled at %r; the cache "
+                "stays there for the life of this process" %
+                (path, _enabled_dir))
+        elif not path and "FLAGS_compile_cache_dir" in os.environ:
+            # only an EXPLICIT '' is a disable request; a later call
+            # with no flag and no default is a plain query
+            _warn_once(
+                "xla-cache-disable",
+                "FLAGS_compile_cache_dir was cleared but the persistent "
+                "compilation cache is already enabled at %r; it cannot "
+                "be disabled mid-process" % _enabled_dir)
+        return _enabled_dir
     if not path:
         return None
-    if _enabled_dir is not None:
-        return _enabled_dir
     try:
         import jax
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         _enabled_dir = path  # the cache IS active from this point
-    except Exception:   # cache is an optimization, never a failure source
+    except Exception as e:  # cache is an optimization, never a failure
+        _warn_once("xla-cache-enable",
+                   "could not enable the persistent compilation cache at "
+                   "%r: %s: %s — compiles will not be cached to disk"
+                   % (path, type(e).__name__, e))
         return None
     try:
         # cache even fast compiles: sweep configs repeat across processes
@@ -57,3 +155,413 @@ def maybe_enable_persistent_cache(default_dir=None):
     except Exception:
         pass
     return _enabled_dir
+
+
+# ------------------------------------------------------ AOT artifact cache
+def default_aot_cache_dir():
+    """Per-user default for the AOT artifact cache (see default_cache_dir
+    for why per-uid: entries deserialize via pickle)."""
+    import tempfile
+    return os.path.join(tempfile.gettempdir(),
+                        "ptpu_aot_cache_%d" % os.getuid())
+
+
+def maybe_enable_aot_cache(default_dir=None):
+    """Process-default for the AOT artifact cache dir, mirroring
+    maybe_enable_persistent_cache's flag contract: FLAGS_aot_cache_dir
+    wins when set ('' = explicit off), else ``default_dir``. Unlike the
+    jax cache, the AOT cache has no global jax config to pin, so the
+    flag is re-read on every dispatch and MAY change mid-process — this
+    helper only records the default used when the flag is unset."""
+    global _aot_default_dir
+    if "FLAGS_aot_cache_dir" not in os.environ and default_dir:
+        # the flag (when set) is re-read live by active_aot_cache_dir;
+        # recording ITS value here would outlive the env var and keep
+        # serving a dir the operator meant to retire
+        _aot_default_dir = default_dir
+    return active_aot_cache_dir()
+
+
+def active_aot_cache_dir():
+    """The AOT cache dir in effect for the next dispatch, or None (off).
+    FLAGS_aot_cache_dir is re-read every call ('' = explicit off) so
+    tests and tools can toggle it without process-global state; the
+    maybe_enable_aot_cache default applies only while the flag is
+    unset."""
+    if "FLAGS_aot_cache_dir" in os.environ:
+        return os.environ["FLAGS_aot_cache_dir"] or None
+    return _aot_default_dir
+
+
+# -- key schema ----------------------------------------------------------
+_program_hash_cache = {}  # (program uid, version) -> content sha256
+
+
+def program_content_hash(program):
+    """sha256 of the program's serialized desc (core/program_desc bytes)
+    — the cross-process identity the in-process (uid, version) key can't
+    provide: uids are per-process counters, but two processes building
+    the same model byte-for-byte produce the same desc. Returns None
+    (warn once) for programs the desc format can't serialize; those fall
+    back to the in-process cache only."""
+    key = (program._uid, program._version)
+    got = _program_hash_cache.get(key)
+    if got is not None:
+        return got
+    try:
+        from .program_desc import program_to_bytes
+        digest = hashlib.sha256(program_to_bytes(program)).hexdigest()
+    except Exception as e:
+        _warn_once("program-hash:%s" % type(e).__name__,
+                   "program is not serializable (%s: %s); the AOT "
+                   "artifact cache is skipped for it (in-process jit "
+                   "cache still applies)" % (type(e).__name__, e))
+        return None
+    if len(_program_hash_cache) > 256:
+        _program_hash_cache.clear()
+    _program_hash_cache[key] = digest
+    return digest
+
+
+def _jsonable(v):
+    """Canonicalize key-material values for hashing: tuples/lists
+    recurse, None/str/bool/int/float pass through, anything else (e.g. a
+    PartitionSpec) stringifies via repr — stable within a jax version,
+    which the key already pins."""
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in sorted(v.items())}
+    if v is None or isinstance(v, (str, bool, int, float)):
+        return v
+    return repr(v)
+
+
+def aot_entry_key(program, feed_sig, fetch_names, trace_env, multi,
+                  device, extra=None):
+    """Build the persistent cache key for one executor dispatch.
+
+    Returns (key_hash, key_material) or None when the program has no
+    content hash. key_material is the full human-readable dict recorded
+    in the entry's meta.json (ptpu_cache inspect shows it); key_hash is
+    sha256 over its canonical JSON. Everything that shapes the compiled
+    artifact is in here — see ARCHITECTURE.md §18 for the schema:
+
+      * format version (schema changes invalidate everything),
+      * jax version (serialized executables are not portable across it),
+      * platform + device kind + device count (an artifact compiled for
+        one chip topology must never load on another),
+      * program content hash (any program edit re-keys),
+      * feed signature, fetch names,
+      * trace-time env flags (lowering.trace_env_key),
+      * the multi-step tuple (K, fetch_reduce, unroll, stacked feeds),
+      * extra: caller-specific config (ParallelExecutor's mesh + param
+        shardings).
+    """
+    prog_hash = program_content_hash(program)
+    if prog_hash is None:
+        return None
+    import jax
+    material = {
+        "format_version": AOT_FORMAT_VERSION,
+        "jax_version": jax.__version__,
+        "platform": getattr(device, "platform", str(device)),
+        "device_kind": getattr(device, "device_kind", ""),
+        "num_devices": 1 if extra is None else extra.get("num_devices", 1),
+        "program_sha256": prog_hash,
+        "program_random_seed": int(getattr(program, "random_seed", 0) or 0),
+        "feed_sig": _jsonable(feed_sig),
+        "fetch_names": _jsonable(tuple(fetch_names)),
+        "trace_env": _jsonable(trace_env),
+        "multi": _jsonable(multi),
+        "extra": _jsonable(extra or {}),
+    }
+    blob = json.dumps(material, sort_keys=True).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest(), material
+
+
+def entry_dir(cache_dir, key_hash):
+    return os.path.join(cache_dir, AOT_ENTRY_PREFIX + key_hash)
+
+
+# -- write protocol (checkpoint/snapshot.py fsync+rename discipline,
+#    one shared implementation in core/utils.py) --------------------------
+from .utils import fsync_dir as _fsync_dir              # noqa: E402
+from .utils import write_bytes_fsync as _write_bytes    # noqa: E402
+
+
+def aot_store(cache_dir, key_hash, key_material, compiled,
+              compile_seconds):
+    """Serialize one compiled executable into the cache, atomically.
+
+    Best-effort by contract: every failure warns once — a full disk or
+    an unwritable dir must never fail the training step that just
+    compiled successfully. The entry is INVISIBLE until one os.rename
+    publishes it (no torn reads), and meta.json records the sha256 of
+    both artifact files plus the compile seconds this process paid —
+    the number a later process's profiler reports as time saved.
+
+    Returns True when the artifact is AVAILABLE on disk afterwards
+    (published by this process, or a racing process published the same
+    key — either way a restart will load it); False only on real
+    failure, which the caller uses to decide the donation tradeoff
+    (no artifact = no reason to keep the donation-free executable)."""
+    try:
+        from jax.experimental import serialize_executable
+        payload, in_tree, out_tree = serialize_executable.serialize(
+            compiled)
+        trees = pickle.dumps((in_tree, out_tree))
+        os.makedirs(cache_dir, exist_ok=True)
+        final = entry_dir(cache_dir, key_hash)
+        if os.path.isdir(final):
+            return True  # another process already published this key
+        tmp = os.path.join(cache_dir, "%s%s.%d"
+                           % (AOT_TMP_PREFIX, key_hash, os.getpid()))
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        _write_bytes(os.path.join(tmp, PAYLOAD_FILE), payload)
+        _write_bytes(os.path.join(tmp, TREES_FILE), trees)
+        meta = {
+            "format_version": AOT_FORMAT_VERSION,
+            "key_hash": key_hash,
+            "key": key_material,
+            "payload_sha256": hashlib.sha256(payload).hexdigest(),
+            "trees_sha256": hashlib.sha256(trees).hexdigest(),
+            "payload_bytes": len(payload),
+            "compile_seconds": float(compile_seconds),
+            "created_at": time.time(),
+        }
+        _write_bytes(os.path.join(tmp, META_FILE),
+                     json.dumps(meta, indent=1, sort_keys=True)
+                     .encode("utf-8"))
+        _fsync_dir(tmp)
+        try:
+            os.rename(tmp, final)  # the commit point
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            return os.path.isdir(final)  # lost the race = still cached
+        _fsync_dir(cache_dir)
+        _aot_stats["stores"] += 1
+        return True
+    except Exception as e:  # noqa: BLE001 — cache writes are best-effort
+        _aot_stats["store_errors"] += 1
+        _warn_once("aot-store:%s" % type(e).__name__,
+                   "could not store an AOT compile artifact in %r (%s: "
+                   "%s); compiles will not be reusable across processes"
+                   % (cache_dir, type(e).__name__, e))
+        return False
+
+
+def _entry_problems(path, key_material=None, deep=True):
+    """Verification shared by loads and `ptpu_cache verify`: returns a
+    list of problem strings (empty = entry is loadable). deep=False
+    skips the payload re-hash (structure + metadata only)."""
+    problems = []
+    meta_path = os.path.join(path, META_FILE)
+    try:
+        with open(meta_path, "rb") as f:
+            meta = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError) as e:
+        return ["meta.json unreadable: %s" % e]
+    if meta.get("format_version") != AOT_FORMAT_VERSION:
+        problems.append("format_version %r != %d"
+                        % (meta.get("format_version"), AOT_FORMAT_VERSION))
+    if key_material is not None and meta.get("key") != _jsonable(
+            key_material):
+        # hash collision or a hand-edited entry: either way, not ours
+        problems.append("recorded key material does not match the "
+                        "requested key")
+    for fname, hkey in ((PAYLOAD_FILE, "payload_sha256"),
+                        (TREES_FILE, "trees_sha256")):
+        fpath = os.path.join(path, fname)
+        if not os.path.exists(fpath):
+            problems.append("%s missing" % fname)
+            continue
+        if not deep:
+            continue
+        h = hashlib.sha256()
+        try:
+            with open(fpath, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+        except OSError as e:
+            problems.append("%s unreadable: %s" % (fname, e))
+            continue
+        if h.hexdigest() != meta.get(hkey):
+            problems.append("%s sha256 mismatch (bit flip or torn "
+                            "write)" % fname)
+    return problems
+
+
+def read_entry_meta(path):
+    with open(os.path.join(path, META_FILE), "rb") as f:
+        return json.loads(f.read().decode("utf-8"))
+
+
+def aot_load(cache_dir, key_hash, key_material):
+    """Load one entry: hash-verify, deserialize, return
+    (compiled_executable, seconds_saved) — or None on miss/corruption
+    (the caller compiles fresh; that fallback is the cache's ONLY
+    failure mode).
+
+    A *stale* entry cannot be reached from here: jax version, device
+    kind and format version are inside the hashed key, so a changed
+    environment computes a different key_hash and simply misses. What
+    this function defends against is the same-key entry whose BYTES are
+    wrong — torn write, bit flip, hand edit — which the sha256 check
+    catches before any byte reaches pickle. Corrupt entries are removed
+    (best-effort) so the fresh compile can re-publish the slot."""
+    path = entry_dir(cache_dir, key_hash)
+    if not os.path.isdir(path):
+        _aot_stats["misses"] += 1
+        return None
+    t0 = time.perf_counter()
+    problems = _entry_problems(path, key_material=key_material, deep=True)
+    if problems:
+        _aot_stats["load_errors"] += 1
+        _warn_once("aot-corrupt:%s" % key_hash[:16],
+                   "AOT cache entry %s is not loadable (%s); skipping it "
+                   "and compiling fresh" % (path, "; ".join(problems)))
+        shutil.rmtree(path, ignore_errors=True)
+        return None
+    try:
+        meta = read_entry_meta(path)
+        with open(os.path.join(path, PAYLOAD_FILE), "rb") as f:
+            payload = f.read()
+        with open(os.path.join(path, TREES_FILE), "rb") as f:
+            in_tree, out_tree = pickle.loads(f.read())
+        from jax.experimental import serialize_executable
+        compiled = serialize_executable.deserialize_and_load(
+            payload, in_tree, out_tree)
+    except Exception as e:  # noqa: BLE001 — fall back to a fresh compile
+        _aot_stats["load_errors"] += 1
+        _warn_once("aot-load:%s" % type(e).__name__,
+                   "AOT cache entry %s failed to deserialize (%s: %s); "
+                   "skipping it and compiling fresh"
+                   % (path, type(e).__name__, e))
+        shutil.rmtree(path, ignore_errors=True)
+        return None
+    load_s = time.perf_counter() - t0
+    saved = max(0.0, float(meta.get("compile_seconds") or 0.0) - load_s)
+    _aot_stats["hits"] += 1
+    _aot_stats["saved_s"] += saved
+    return compiled, saved
+
+
+def discard_bad_entry(cache_dir, key_hash, reason):
+    """An executable that failed AT CALL TIME (argument avals rejected)
+    despite a verified entry on disk: count a load error (any earlier
+    hit count stands — the load itself succeeded), warn once, and
+    remove the entry so the fresh compile re-publishes the slot."""
+    _aot_stats["load_errors"] += 1
+    _warn_once("aot-call:%s" % key_hash[:16],
+               "AOT cache entry %s loaded but was unusable (%s); "
+               "discarded, compiling fresh"
+               % (entry_dir(cache_dir, key_hash), reason))
+    shutil.rmtree(entry_dir(cache_dir, key_hash), ignore_errors=True)
+
+
+# -- maintenance (ptpu_cache CLI) ----------------------------------------
+def list_entries(cache_dir):
+    """[(entry_path, meta_or_None)] for every published entry, newest
+    first by created_at (unreadable meta -> None, still listed so verify
+    and gc see torn entries)."""
+    if not os.path.isdir(cache_dir):
+        return []
+    out = []
+    for name in os.listdir(cache_dir):
+        if not name.startswith(AOT_ENTRY_PREFIX):
+            continue
+        path = os.path.join(cache_dir, name)
+        if not os.path.isdir(path):
+            continue
+        try:
+            meta = read_entry_meta(path)
+        except (OSError, ValueError):
+            meta = None
+        out.append((path, meta))
+    out.sort(key=lambda pm: (pm[1] or {}).get("created_at", 0.0),
+             reverse=True)
+    return out
+
+
+def verify_entry(path):
+    """Deep-verify one entry; list of problems (empty = ok)."""
+    return _entry_problems(path, deep=True)
+
+
+def entry_size_bytes(path):
+    total = 0
+    for name in os.listdir(path):
+        try:
+            total += os.path.getsize(os.path.join(path, name))
+        except OSError:
+            pass
+    return total
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # alive under another uid — not ours to sweep
+    except OSError:
+        return True
+    return True
+
+
+def clean_stale_tmp(cache_dir):
+    """Sweep dead writers' unpublished tmp dirs (the checkpoint
+    clean_stale_tmp rule: only entries with a parseable pid suffix whose
+    pid is dead; EPERM counts as alive)."""
+    removed = []
+    if not os.path.isdir(cache_dir):
+        return removed
+    for name in os.listdir(cache_dir):
+        if not name.startswith(AOT_TMP_PREFIX):
+            continue
+        pid_part = name.rsplit(".", 1)[-1]
+        if not pid_part.isdigit() or _pid_alive(int(pid_part)):
+            continue
+        path = os.path.join(cache_dir, name)
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    return removed
+
+
+def gc_aot_cache(cache_dir, max_age_days=None, max_total_mb=None,
+                 dry_run=False):
+    """Retention for the artifact cache, reusing the checkpoint
+    discipline: age window first (entries older than max_age_days go),
+    then a size budget (newest entries kept until max_total_mb is
+    spent, LRU-by-created_at beyond it). Returns (doomed_paths,
+    kept_paths); with dry_run nothing is deleted. Stale tmp droppings
+    are always swept (never in dry_run's doomed list — they were never
+    published)."""
+    entries = list_entries(cache_dir)
+    now = time.time()
+    doomed, kept = [], []
+    budget = None if max_total_mb is None else max_total_mb * (1 << 20)
+    spent = 0
+    for path, meta in entries:  # newest first
+        age_days = (now - (meta or {}).get("created_at", 0.0)) / 86400.0
+        size = entry_size_bytes(path)
+        if meta is None:
+            doomed.append(path)  # unreadable meta: unloadable anyway
+            continue
+        if max_age_days is not None and age_days > max_age_days:
+            doomed.append(path)
+            continue
+        if budget is not None and spent + size > budget:
+            doomed.append(path)
+            continue
+        spent += size
+        kept.append(path)
+    if not dry_run:
+        for path in doomed:
+            shutil.rmtree(path, ignore_errors=True)
+        clean_stale_tmp(cache_dir)
+    return doomed, kept

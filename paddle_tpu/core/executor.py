@@ -16,6 +16,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import compile_cache
 from . import lowering
 from . import readers
 from .framework import default_main_program, convert_dtype
@@ -596,10 +597,13 @@ class Executor(object):
         self._check_nan_inf = _nan_inf_enabled(check_nan_inf)
         self._array_safety = _array_safety_enabled()
         self._validated = set()  # (uid, version, feeds, fetches, multi)
+        self._tuned = {}  # (uid, version) -> tuning entry | None, so
+        # apply_tuned costs one store read per program, not per dispatch
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, steps=1,
-            fetch_reduce="stack", validate=None, timeout=None):
+            fetch_reduce="stack", validate=None, timeout=None,
+            apply_tuned=False):
         """Run `program` once — or, with steps=K > 1, K times inside ONE
         device-resident lax.scan dispatch: params/optimizer state stay
         donated on device across the K steps and the host syncs once per
@@ -622,6 +626,18 @@ class Executor(object):
         (program version, feed/fetch signature) so steady-state runs pay
         nothing.
 
+        apply_tuned=True consults the tuning store (paddle_tpu.tuning)
+        for a recorded config under this program's content signature on
+        this device and starts at the tuned point: tuned `steps` applies
+        when the caller left steps=1 AND the program is reader-fed (an
+        explicit-feed program would replay the same batch K times — a
+        semantic change, so it is never auto-applied), the recorded
+        fetch_reduce rides along when the caller left the default
+        'stack' (so fetches keep single-step shape instead of a
+        surprise leading-K axis), and a tuned multistep_unroll
+        overrides the platform default for the lowered loop. No
+        recorded config = unchanged behavior.
+
         timeout=SECONDS arms the hang watchdog (None = off, the default,
         zero overhead): the whole dispatch — io pre-pass, compile if any,
         device execution, fetch readiness — runs on a monitored worker
@@ -635,17 +651,20 @@ class Executor(object):
         if timeout is None:
             return self._run_impl(program, feed, fetch_list, scope,
                                   return_numpy, use_program_cache, steps,
-                                  fetch_reduce, validate)
+                                  fetch_reduce, validate,
+                                  apply_tuned=apply_tuned)
         return dispatch_with_deadline(
             lambda cancelled, info: self._run_impl(
                 program, feed, fetch_list, scope, return_numpy,
                 use_program_cache, steps, fetch_reduce, validate,
-                cancelled=cancelled, info=info, sync=True),
+                cancelled=cancelled, info=info, sync=True,
+                apply_tuned=apply_tuned),
             timeout, "Executor.run dispatch")
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
                   use_program_cache, steps, fetch_reduce, validate,
-                  cancelled=None, info=None, sync=False):
+                  cancelled=None, info=None, sync=False,
+                  apply_tuned=False):
         if program is None:
             program = default_main_program()
         feed = feed or {}
@@ -654,6 +673,17 @@ class Executor(object):
         steps = int(steps)
         if steps < 1:
             raise ValueError("steps must be >= 1, got %r" % (steps,))
+        tuned_unroll = None
+        if apply_tuned:
+            from .. import tuning
+            tkey = (program._uid, program._version)
+            if tkey not in self._tuned:
+                self._tuned[tkey] = tuning.lookup_program(
+                    program, self.place.device())
+            cfg = self._tuned[tkey]
+            if cfg is not None:
+                steps, fetch_reduce, tuned_unroll = tuning.apply_to_run(
+                    cfg, program, steps, fetch_reduce)
         if fetch_reduce not in lowering.FETCH_REDUCE_POLICIES:
             raise ValueError("fetch_reduce must be one of %r, got %r"
                              % (lowering.FETCH_REDUCE_POLICIES, fetch_reduce))
@@ -702,21 +732,157 @@ class Executor(object):
         from .lowering import trace_env_key
         unroll = lowering.resolve_multistep_unroll(
             self.place.device().platform) if steps > 1 else False
+        if tuned_unroll is not None and steps > 1:
+            unroll = tuned_unroll
+        multi_sig = (steps, fetch_reduce if steps > 1 else None, unroll,
+                     tuple(sorted(stacked_names)))
         key = (program._uid, program._version,
                _feed_signature(feed_arrays), tuple(fetch_names),
-               trace_env_key(),
-               (steps, fetch_reduce if steps > 1 else None, unroll,
-                tuple(sorted(stacked_names))))
+               trace_env_key(), multi_sig)
         if info is not None:
             info["cache_key"] = key
+
+        def read_state(names):
+            vals = []
+            for n in names:
+                v = scope.get(n)
+                if v is None:
+                    raise RuntimeError(
+                        "persistable variable %r is not initialized in the "
+                        "scope; run the startup program first" % n)
+                vals.append(v)
+            return vals
+
         compiled = False
+        aot_hit = False
+        aot_saved = 0.0
+        aot_compile_s = 0.0  # eager lower+compile time paid THIS call
+        aot_entry = None  # (dir, key_hash) when this call loaded from disk
         entry = self._cache.get(key) if use_program_cache else None
         if entry is not None:
             self._cache.move_to_end(key)  # LRU touch
         else:
-            compiled = True
             state_rw, state_ro, state_out = lowering.analyze_state(
                 program, feed_names, fetch_names)
+            # persistent AOT artifact cache (core/compile_cache.py): on
+            # an in-process miss, a warm disk entry replaces the whole
+            # trace+lower+compile with one deserialize — the restart /
+            # serving-warmup cold-start killer. Off (akey=None) unless
+            # FLAGS_aot_cache_dir / maybe_enable_aot_cache enabled it.
+            # use_program_cache=False opts out of caching wholesale:
+            # consulting the disk cache there would re-deserialize (and
+            # count a hit + 'time saved') on EVERY call of the loop.
+            aot_dir = (compile_cache.active_aot_cache_dir()
+                       if use_program_cache else None)
+            akey = None
+            if aot_dir is not None:
+                akey = compile_cache.aot_entry_key(
+                    program, _feed_signature(feed_arrays),
+                    tuple(fetch_names), trace_env_key(), multi_sig,
+                    self.place.device())
+            executable = None
+            if akey is not None:
+                loaded = compile_cache.aot_load(aot_dir, *akey)
+                if loaded is not None:
+                    executable, aot_saved = loaded
+                    aot_hit = True
+                    aot_entry = (aot_dir, akey[0])
+            if executable is None:
+                compiled = True
+                if steps > 1:
+                    fn = lowering.lower_multi_step(
+                        program, feed_names, fetch_names, state_rw,
+                        state_ro, state_out, steps,
+                        fetch_reduce=fetch_reduce,
+                        stacked_feed_names=stacked_names, unroll=unroll)
+                else:
+                    fn = lowering.build_program_fn(
+                        program, feed_names, fetch_names, state_rw,
+                        state_ro, state_out, collect_errors=True)
+                if akey is not None:
+                    # eager AOT: lower+compile NOW (against the real
+                    # argument avals — .lower only traces, it consumes
+                    # nothing) so the executable can be serialized.
+                    # Serialized artifacts are compiled WITHOUT buffer
+                    # donation: a deserialized executable with
+                    # input-output aliasing corrupts the heap on its
+                    # second call in this jax (bisected: numpy or jax
+                    # array state alike; the donation-free variant is
+                    # stable and bit-identical). The cold process keeps
+                    # THIS executable too — one compile, not two — so a
+                    # cache-enabled key trades in-place state donation
+                    # for restartability; inference programs (serving
+                    # warmup, the headline path) have no donated state
+                    # at all. Store failures fall back to the plain
+                    # donating jit below.
+                    try:
+                        t0c = time.perf_counter()
+                        with jax.default_device(self.place.device()):
+                            comp = jax.jit(fn).lower(
+                                [feed_arrays[n] for n in feed_names],
+                                read_state(state_rw),
+                                read_state(state_ro),
+                                np.uint32(0)).compile()
+                        aot_compile_s = time.perf_counter() - t0c
+                        if compile_cache.aot_store(
+                                aot_dir, akey[0], akey[1], comp,
+                                aot_compile_s):
+                            executable = comp
+                        # store failed (full disk, lost race to an
+                        # unreadable dir): comp bought no
+                        # restartability, so don't pay its donation
+                        # loss for the whole process — fall through to
+                        # the donating jit (costs one extra compile on
+                        # this rare path)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        # cache; the jitted fn path raises real trace
+                        # errors with their op annotations at dispatch
+                        pass
+                if executable is None:
+                    executable = jax.jit(fn, donate_argnums=(1,))
+            entry = (executable, state_rw, state_ro, state_out)
+            if use_program_cache:
+                _cache_put_lru(self._cache, key, entry,
+                               _jit_cache_capacity())
+        jitted, state_rw, state_ro, state_out = entry
+
+        seed = np.uint32(scope.next_seed() if steps == 1
+                         else scope.next_seed_block(steps))
+        from .. import profiler as _prof
+        profiling = _prof.is_active()
+        t0 = time.perf_counter() if profiling else 0.0
+        try:
+            with jax.default_device(self.place.device()):
+                fetches, new_state, errors = jitted(
+                    [feed_arrays[n] for n in feed_names],
+                    read_state(state_rw), read_state(state_ro), seed)
+        except TypeError:
+            if aot_entry is None and not isinstance(
+                    jitted, jax.stages.Compiled):
+                raise  # a plain jit retraces by itself; this is real
+            # a fixed-aval Compiled rejected the live argument avals —
+            # either an AOT-loaded entry recorded under different aval
+            # promotion, or an in-process entry whose state avals
+            # drifted under an unchanged key (e.g. a persistable
+            # restored at a different dtype), which the donating jit
+            # used to absorb by retracing. Aval checking precedes
+            # execution, so nothing was donated/consumed — drop the
+            # disk entry and fall back to a fresh (retracing) compile,
+            # the cache's only failure mode.
+            if aot_entry is None:
+                aot_dir = compile_cache.active_aot_cache_dir()
+                akey = compile_cache.aot_entry_key(
+                    program, _feed_signature(feed_arrays),
+                    tuple(fetch_names), trace_env_key(), multi_sig,
+                    self.place.device()) if aot_dir else None
+                if akey is not None:
+                    aot_entry = (aot_dir, akey[0])
+            if aot_entry is not None:
+                compile_cache.discard_bad_entry(
+                    *aot_entry, reason="argument avals rejected at "
+                    "call time")
+            aot_hit, aot_saved, aot_entry = False, 0.0, None
+            compiled = True
             if steps > 1:
                 fn = lowering.lower_multi_step(
                     program, feed_names, fetch_names, state_rw, state_ro,
@@ -731,28 +897,10 @@ class Executor(object):
             if use_program_cache:
                 _cache_put_lru(self._cache, key, entry,
                                _jit_cache_capacity())
-        jitted, state_rw, state_ro, state_out = entry
-
-        def read_state(names):
-            vals = []
-            for n in names:
-                v = scope.get(n)
-                if v is None:
-                    raise RuntimeError(
-                        "persistable variable %r is not initialized in the "
-                        "scope; run the startup program first" % n)
-                vals.append(v)
-            return vals
-
-        seed = np.uint32(scope.next_seed() if steps == 1
-                         else scope.next_seed_block(steps))
-        from .. import profiler as _prof
-        profiling = _prof.is_active()
-        t0 = time.perf_counter() if profiling else 0.0
-        with jax.default_device(self.place.device()):
-            fetches, new_state, errors = jitted(
-                [feed_arrays[n] for n in feed_names],
-                read_state(state_rw), read_state(state_ro), seed)
+            with jax.default_device(self.place.device()):
+                fetches, new_state, errors = jitted(
+                    [feed_arrays[n] for n in feed_names],
+                    read_state(state_rw), read_state(state_ro), seed)
         if cancelled is not None and cancelled.is_set():
             # the caller already raised DispatchTimeoutError and may be
             # mid-rollback: a late scope write here would race the
@@ -783,7 +931,14 @@ class Executor(object):
                 getattr(program, "_uid", "?"), program._version,
                 " x%d" % steps if steps > 1 else "",
                 ",".join(fetch_names) or "-")
-            _prof.record_run(tag, dt, compiled=compiled)
+            # a compiled call's seconds include its compile, like the
+            # lazy-jit path where tracing happens inside the timed
+            # dispatch — the eager AOT lower+compile ran before t0, so
+            # add it back or Compile(s) reports a 30s compile as free
+            _prof.record_run(tag, dt + (aot_compile_s if compiled
+                                        else 0.0),
+                             compiled=compiled, aot_hit=aot_hit,
+                             saved_s=aot_saved)
         # guard flags raise even with FLAGS_tensor_array_safety=0: a
         # program that INSTALLED guards opted into the one-fetch sync
         has_guards = bool(errors) and any(

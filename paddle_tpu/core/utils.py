@@ -49,3 +49,26 @@ def device_fetch_barrier(out):
     if isinstance(leaf, FetchHandle):
         leaf = leaf.array
     np.asarray(jnp.sum(leaf.astype(jnp.float32)))
+
+
+def fsync_dir(path):
+    """fsync a directory fd — the step that makes a just-renamed entry
+    durable against power loss. Shared by checkpoint/snapshot.py and
+    core/compile_cache.py so the crash-safety discipline has ONE
+    implementation."""
+    import os
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_bytes_fsync(path, data):
+    """Write + flush + fsync one file (the durability sibling of
+    fsync_dir; see its note on sharing)."""
+    import os
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())

@@ -272,34 +272,18 @@ class ParallelExecutor(object):
         # tuning) is traced into the fn — key on them so an env-var flip
         # re-traces instead of serving the other configuration. (steps,
         # fetch_reduce, stacked feeds) shape the traced loop the same way.
+        from ..core import compile_cache
         from ..core.lowering import trace_env_key
         unroll = lowering.resolve_multistep_unroll(
             self.mesh.devices.flat[0].platform) if steps > 1 else False
+        multi_sig = (steps, fetch_reduce if steps > 1 else None, unroll,
+                     tuple(sorted(stacked_names)))
         key = (program._uid, program._version,
                _feed_signature(feed_arrays), tuple(fetch_names),
-               trace_env_key(),
-               (steps, fetch_reduce if steps > 1 else None, unroll,
-                tuple(sorted(stacked_names))))
+               trace_env_key(), multi_sig)
         if info is not None:
             info["cache_key"] = key
-        compiled = False
-        entry = self._cache.get(key)
-        if entry is not None:
-            self._cache.move_to_end(key)  # LRU touch
-        else:
-            compiled = True
-            state_rw, state_ro, state_out = lowering.analyze_state(
-                program, feed_names, fetch_names)
-            if steps > 1:
-                fn = lowering.lower_multi_step(
-                    program, feed_names, fetch_names, state_rw, state_ro,
-                    state_out, steps, fetch_reduce=fetch_reduce,
-                    stacked_feed_names=stacked_names, mesh=self.mesh,
-                    unroll=unroll)
-            else:
-                fn = lowering.build_program_fn(
-                    program, feed_names, fetch_names, state_rw, state_ro,
-                    state_out, mesh=self.mesh, collect_errors=True)
+        def build_jitted(state_rw, state_ro, state_out, donate):
             rep = replicated(self.mesh)
             in_shardings = (
                 [_feed_sharding(n, feed_arrays[n].ndim)
@@ -311,10 +295,94 @@ class ParallelExecutor(object):
             out_shardings = (rep,
                              [self._state_sharding(n) for n in state_out],
                              rep)
-            jitted = jax.jit(fn, in_shardings=in_shardings,
-                             out_shardings=out_shardings,
-                             donate_argnums=(1,))
-            entry = (jitted, state_rw, state_ro, state_out)
+            if steps > 1:
+                fn = lowering.lower_multi_step(
+                    program, feed_names, fetch_names, state_rw,
+                    state_ro, state_out, steps,
+                    fetch_reduce=fetch_reduce,
+                    stacked_feed_names=stacked_names, mesh=self.mesh,
+                    unroll=unroll)
+            else:
+                fn = lowering.build_program_fn(
+                    program, feed_names, fetch_names, state_rw,
+                    state_ro, state_out, mesh=self.mesh,
+                    collect_errors=True)
+            return jax.jit(fn, in_shardings=in_shardings,
+                           out_shardings=out_shardings,
+                           donate_argnums=(1,) if donate else ())
+
+        def aot_key():
+            # the sharded executable is keyed on everything that shapes
+            # it beyond the Executor signature — mesh topology, axis
+            # names, per-state param shardings (serialized executables
+            # bake the partitioning in)
+            aot_dir = compile_cache.active_aot_cache_dir()
+            if aot_dir is None:
+                return None, None
+            return aot_dir, compile_cache.aot_entry_key(
+                program, _feed_signature(feed_arrays),
+                tuple(fetch_names), trace_env_key(), multi_sig,
+                self.mesh.devices.flat[0],
+                extra={
+                    "executor": "parallel",
+                    "num_devices": int(self.mesh.devices.size),
+                    "mesh_axes": {a: int(s) for a, s in
+                                  self.mesh.shape.items()},
+                    "batch_axis": self._batch_axis,
+                    "param_shardings": {
+                        n: self._param_shardings[n]
+                        for n in sorted(self._param_shardings)},
+                })
+
+        compiled = False
+        aot_hit = False
+        aot_saved = 0.0
+        aot_compile_s = 0.0  # eager lower+compile time paid THIS call
+        aot_entry = None  # (dir, key_hash) when loaded from disk
+        entry = self._cache.get(key)
+        if entry is not None:
+            self._cache.move_to_end(key)  # LRU touch
+        else:
+            state_rw, state_ro, state_out = lowering.analyze_state(
+                program, feed_names, fetch_names)
+            aot_dir, akey = aot_key()
+            executable = None
+            if akey is not None:
+                loaded = compile_cache.aot_load(aot_dir, *akey)
+                if loaded is not None:
+                    executable, aot_saved = loaded
+                    aot_hit = True
+                    aot_entry = (aot_dir, akey[0])
+            if executable is None:
+                compiled = True
+                if akey is not None:
+                    try:
+                        t0c = _time.perf_counter()
+                        # serialized artifacts compile WITHOUT donation
+                        # (deserialized input-output aliasing corrupts
+                        # the heap — see Executor._run_impl); lower()
+                        # only traces, so raw scope values suffice and
+                        # the explicit in_shardings decide placement
+                        comp = build_jitted(
+                            state_rw, state_ro, state_out,
+                            donate=False).lower(
+                            [feed_arrays[n] for n in feed_names],
+                            [scope.get(n) for n in state_rw],
+                            [scope.get(n) for n in state_ro],
+                            jnp.asarray(np.uint32(0))).compile()
+                        aot_compile_s = _time.perf_counter() - t0c
+                        if compile_cache.aot_store(
+                                aot_dir, akey[0], akey[1], comp,
+                                aot_compile_s):
+                            executable = comp
+                        # store failed: no artifact on disk, so keep
+                        # donation (see Executor._run_impl)
+                    except Exception:  # noqa: BLE001 — cache is
+                        pass           # best-effort; jit path raises
+                if executable is None:
+                    executable = build_jitted(state_rw, state_ro,
+                                              state_out, donate=True)
+            entry = (executable, state_rw, state_ro, state_out)
             _cache_put_lru(self._cache, key, entry, _jit_cache_capacity())
         jitted, state_rw, state_ro, state_out = entry
 
@@ -342,8 +410,37 @@ class ParallelExecutor(object):
         from .. import profiler as _prof
         profiling = _prof.is_active()
         t0 = _time.perf_counter() if profiling else 0.0
-        fetches, new_state, errors = jitted(feed_vals, read_state(state_rw),
-                                            read_state(state_ro), seed)
+        try:
+            fetches, new_state, errors = jitted(
+                feed_vals, read_state(state_rw), read_state(state_ro),
+                seed)
+        except TypeError:
+            if aot_entry is None and not isinstance(
+                    jitted, jax.stages.Compiled):
+                raise  # a plain jit retraces by itself; this is real
+            # a fixed-aval Compiled (AOT-loaded, or in-process under
+            # drifted state avals) rejected the live arguments — aval
+            # checking precedes execution, nothing was consumed; drop
+            # the disk entry and fall back to a fresh donating jit
+            # (see Executor._run_impl for the matching path)
+            if aot_entry is None:
+                aot_dir_, akey_ = aot_key()
+                if akey_ is not None:
+                    aot_entry = (aot_dir_, akey_[0])
+            if aot_entry is not None:
+                compile_cache.discard_bad_entry(
+                    *aot_entry, reason="argument avals rejected at "
+                    "call time")
+            aot_hit, aot_saved, aot_entry = False, 0.0, None
+            compiled = True
+            jitted = build_jitted(state_rw, state_ro, state_out,
+                                  donate=True)
+            entry = (jitted, state_rw, state_ro, state_out)
+            _cache_put_lru(self._cache, key, entry,
+                           _jit_cache_capacity())
+            fetches, new_state, errors = jitted(
+                feed_vals, read_state(state_rw), read_state(state_ro),
+                seed)
         if cancelled is not None and cancelled.is_set():
             # caller already raised DispatchTimeoutError; a late scope
             # write would race its rollback (see Executor._run_impl)
@@ -366,8 +463,12 @@ class ParallelExecutor(object):
             tag = "pexe_program_%s(v%d)x%d fetch=%s" % (
                 program._uid, program._version, self.device_count,
                 ",".join(fetch_names) or "-")
-            _prof.record_run(tag, _time.perf_counter() - t0,
-                             compiled=compiled)
+            # add the eager AOT compile time back for compiled calls —
+            # it ran before t0 (see Executor._run_impl)
+            _prof.record_run(tag, _time.perf_counter() - t0
+                             + (aot_compile_s if compiled else 0.0),
+                             compiled=compiled, aot_hit=aot_hit,
+                             saved_s=aot_saved)
         from ..core.executor import GUARD_MSG_PREFIX
         has_guards = bool(errors) and any(
             m.startswith(GUARD_MSG_PREFIX) for m in errors)

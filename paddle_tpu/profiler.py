@@ -14,27 +14,39 @@ import time
 import jax
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
-           "profile_report", "record_event"]
+           "profile_report", "record_event", "cache_stats"]
 
 _active = False
 _trace_dir = None
 _span = [None, None]
 _entries = {}  # tag -> {"calls", "runs", "total", "max", "min",
-#                        "compiles", "compile_s"} (see record_run)
+#                        "compiles", "compile_s", "aot_hits", "saved_s"}
+#                       (see record_run)
 
 
 def is_active():
     return _active
 
 
-def record_run(tag, seconds, compiled=False):
+def record_run(tag, seconds, compiled=False, aot_hit=False, saved_s=0.0):
     """Executor hook: one jitted dispatch of `tag` took `seconds` (blocked).
     Calls that traced+compiled are counted separately (Compiles/Compile(s))
-    so Total/Max/Min/Ave stay honest cache-hit execution times."""
+    so Total/Max/Min/Ave stay honest cache-hit execution times.
+
+    aot_hit=True marks a call whose executable came from the persistent
+    AOT artifact cache (core/compile_cache.py) instead of a fresh
+    compile — still an execution call (the deserialize happens before
+    the timed dispatch), but counted in its own column with `saved_s`,
+    the compile seconds the recording process paid minus the load time,
+    so warm-vs-cold process starts are visible per tag in one report."""
     e = _entries.setdefault(tag, {"calls": 0, "runs": 0, "total": 0.0,
                                   "max": 0.0, "min": float("inf"),
-                                  "compiles": 0, "compile_s": 0.0})
+                                  "compiles": 0, "compile_s": 0.0,
+                                  "aot_hits": 0, "saved_s": 0.0})
     e["calls"] += 1
+    if aot_hit:
+        e["aot_hits"] += 1
+        e["saved_s"] += saved_s
     if compiled:
         e["compiles"] += 1
         e["compile_s"] += seconds
@@ -43,6 +55,22 @@ def record_run(tag, seconds, compiled=False):
         e["total"] += seconds
         e["max"] = max(e["max"], seconds)
         e["min"] = min(e["min"], seconds)
+
+
+def cache_stats():
+    """Aggregate compile-cache accounting over every profiled tag:
+    {"compiles", "aot_hits", "warm_calls", "saved_s"} — compiles are
+    fresh trace+compile calls, aot_hits replaced a compile with a disk
+    load, warm_calls hit the in-process jit cache, saved_s totals the
+    recorded compile time avoided. The cross-process cache tests assert
+    "zero new compiles" on exactly this counter."""
+    compiles = sum(e["compiles"] for e in _entries.values())
+    aot_hits = sum(e.get("aot_hits", 0) for e in _entries.values())
+    calls = sum(e["calls"] for e in _entries.values())
+    return {"compiles": compiles, "aot_hits": aot_hits,
+            "warm_calls": calls - compiles - aot_hits,
+            "saved_s": sum(e.get("saved_s", 0.0)
+                           for e in _entries.values())}
 
 
 def record_event(tag, seconds=0.0):
@@ -95,17 +123,28 @@ def profile_report(sorted_key=None):
     rows = [(tag, e["calls"], e["total"], e["max"],
              0.0 if e["min"] == float("inf") else e["min"],
              e["total"] / max(e["runs"], 1),  # mean over EXEC calls only
-             e["compiles"], e["compile_s"])
+             e["compiles"], e["compile_s"],
+             e.get("aot_hits", 0), e.get("saved_s", 0.0))
             for tag, e in _entries.items()]
     keyidx = {"calls": 1, "total": 2, "max": 3, "min": 4, "ave": 5}
     if sorted_key is not None:
         rows.sort(key=lambda r: r[keyidx[sorted_key]], reverse=True)
-    lines = ["%-40s %8s %10s %10s %10s %10s %9s %10s" %
+    lines = ["%-40s %8s %10s %10s %10s %10s %9s %10s %7s %9s" %
              ("Entry", "Calls", "Total(s)", "Max(s)", "Min(s)", "Ave(s)",
-              "Compiles", "Compile(s)")]
-    for tag, calls, total, mx, mn, ave, ncomp, comp in rows:
-        lines.append("%-40s %8d %10.4f %10.4f %10.4f %10.4f %9d %10.4f"
-                     % (tag[:40], calls, total, mx, mn, ave, ncomp, comp))
+              "Compiles", "Compile(s)", "AOTHit", "Saved(s)")]
+    for (tag, calls, total, mx, mn, ave, ncomp, comp, ahit,
+         saved) in rows:
+        lines.append("%-40s %8d %10.4f %10.4f %10.4f %10.4f %9d %10.4f "
+                     "%7d %9.4f"
+                     % (tag[:40], calls, total, mx, mn, ave, ncomp, comp,
+                        ahit, saved))
+    if rows:
+        cs = cache_stats()
+        lines.append(
+            "compile cache: %d compiles, %d AOT hits, %d warm calls, "
+            "%.4fs compile time saved"
+            % (cs["compiles"], cs["aot_hits"], cs["warm_calls"],
+               cs["saved_s"]))
     return "\n".join(lines)
 
 

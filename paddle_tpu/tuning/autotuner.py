@@ -1,0 +1,268 @@
+"""Autotuner: recorded search over the execution knobs the runtime
+already exposes.
+
+The TVM insight (PAPERS, arXiv:1802.04799) applied at the runtime
+layer: the knobs that decide paddle_tpu's throughput — multistep K,
+FLAGS_multistep_unroll, remat segment length, guard granularity, the
+serving bucket lattice — are cheap to *enumerate* and expensive to get
+wrong, so measure each candidate once against the bench harness, record
+the winner in the TuningStore, and let every later process start at the
+tuned point instead of the default.
+
+Measurement discipline (the bench.py BENCH_RESIL rules): warmup runs
+excluded, min-of-repeats against host noise, per-candidate fresh Scope
+so no candidate trains on another's warmed state, and the score is a
+throughput (higher = better) so "tuned beats default" is one
+comparison.
+
+Two concrete searches cover the acceptance knobs; `Autotuner` itself is
+generic — any knob dict + measure callback (guard granularity rides
+this: candidates {"guard_granular": True/False} with a measure fn that
+installs guards on a per-candidate program clone).
+"""
+import os
+import time
+
+from .store import TuningStore, device_key, program_signature
+
+__all__ = ["Autotuner", "TuningResult", "tune_training_multistep",
+           "tune_serving_batching"]
+
+
+class TuningResult(object):
+    """Outcome of one search: `best` (knob dict), `best_score`,
+    `results` ([(knobs, score)] for every candidate, search order), and
+    `store_path` when recorded."""
+
+    def __init__(self, best, best_score, results, score_unit):
+        self.best = best
+        self.best_score = best_score
+        self.results = results
+        self.score_unit = score_unit
+        self.store_path = None
+
+    def __repr__(self):
+        return ("TuningResult(best=%r, best_score=%.3f %s, %d candidates)"
+                % (self.best, self.best_score, self.score_unit,
+                   len(self.results)))
+
+
+class Autotuner(object):
+    """Grid search over explicit candidates. `measure(knobs)` returns a
+    throughput score (higher = better); it is called `repeats` times per
+    candidate and the MAX kept (min-of-times == max-of-throughputs: the
+    least-noise observation). A candidate whose measure raises is
+    skipped with its error recorded — one broken corner of the knob
+    space must not kill the search."""
+
+    def __init__(self, measure, repeats=2, score_unit="units/sec",
+                 verbose=False):
+        self.measure = measure
+        self.repeats = max(1, int(repeats))
+        self.score_unit = score_unit
+        self.verbose = verbose
+
+    def search(self, candidates):
+        results = []
+        best, best_score = None, None
+        for knobs in candidates:
+            score, error = None, None
+            for _ in range(self.repeats):
+                try:
+                    s = float(self.measure(dict(knobs)))
+                except Exception as e:  # noqa: BLE001 — recorded below
+                    error = "%s: %s" % (type(e).__name__, e)
+                    continue  # a transient repeat failure must not
+                score = s if score is None else max(score, s)  # void a
+            if score is not None:      # repeat that already measured
+                error = None
+            results.append((dict(knobs), score, error))
+            if self.verbose:
+                print("[ptpu_tune] %r -> %s"
+                      % (knobs, error or "%.3f %s" % (score,
+                                                      self.score_unit)))
+            if error is None and (best_score is None or
+                                  score > best_score):
+                best, best_score = dict(knobs), score
+        if best is None:
+            raise RuntimeError(
+                "autotuner: every candidate failed: %s"
+                % "; ".join("%r: %s" % (k, e) for k, _, e in results))
+        return TuningResult(best, best_score, results, self.score_unit)
+
+
+def _record(result, program, signature, device, store, searched,
+            extra_knobs=None):
+    """Fold a search result into the store under the program's content
+    signature (or the caller's explicit one)."""
+    if store is False:
+        return result
+    st = store if isinstance(store, TuningStore) else TuningStore(
+        root=store if isinstance(store, str) else None)
+    sig = signature or (program_signature(program)
+                        if program is not None else None)
+    if sig is None:
+        return result  # unhashable program: measured but not recorded
+    knobs = dict(result.best)
+    if extra_knobs:
+        knobs.update(extra_knobs)
+    result.store_path = st.put(
+        sig, device_key(device), knobs, score=result.best_score,
+        score_unit=result.score_unit, searched=searched)
+    return result
+
+
+def tune_training_multistep(program, startup, feed, fetch_list,
+                            place=None, k_candidates=(1, 2, 4, 8),
+                            unroll_candidates=(None,), steps=24,
+                            warmup=2, repeats=2, store=None,
+                            signature=None, verbose=False):
+    """Search multistep K (and optionally the unroll policy) for one
+    training program; record the winner so `Executor.run(...,
+    apply_tuned=True)` starts there.
+
+    feed: a dict replayed every step (measurement only — the recorded K
+    applies in production to reader-fed programs, where K steps consume
+    K records). Score: training steps/sec, min-of-repeats per candidate,
+    fresh Scope per measurement so candidates can't warm each other.
+    unroll_candidates entries: None (platform auto), False (lax.scan),
+    True (full unroll); the K=1 candidate ignores unroll (no loop)."""
+    from ..core.executor import Executor, Scope, scope_guard
+    from ..places import CPUPlace
+    exe = Executor(place if place is not None else CPUPlace())
+    device = exe.place.device()
+
+    def measure(knobs):
+        k = int(knobs["steps"])
+        unroll = knobs.get("multistep_unroll")
+        run_kw = {}
+        saved_unroll = os.environ.get("FLAGS_multistep_unroll")
+        if k > 1:
+            run_kw = {"steps": k, "fetch_reduce": "last"}
+            if unroll is not None:
+                # pin via the documented env flag for the measurement;
+                # production applies it per-dispatch through apply_tuned
+                # (the caller's own flag value is restored after)
+                os.environ["FLAGS_multistep_unroll"] = \
+                    "1" if unroll else "0"
+        try:
+            scope = Scope()
+            with scope_guard(scope):
+                exe.run(startup)
+                outer = max(1, -(-steps // k))
+                for _ in range(warmup):
+                    exe.run(program, feed=feed, fetch_list=fetch_list,
+                            **run_kw)
+                out = None
+                t0 = time.perf_counter()
+                for _ in range(outer):
+                    out = exe.run(program, feed=feed,
+                                  fetch_list=fetch_list,
+                                  return_numpy=False, **run_kw)
+                from ..core.utils import device_fetch_barrier
+                device_fetch_barrier(out)
+                dt = time.perf_counter() - t0
+            return outer * k / dt
+        finally:
+            if k > 1 and unroll is not None:
+                if saved_unroll is None:
+                    os.environ.pop("FLAGS_multistep_unroll", None)
+                else:
+                    os.environ["FLAGS_multistep_unroll"] = saved_unroll
+
+    candidates = []
+    for k in k_candidates:
+        if int(k) == 1:
+            candidates.append({"steps": 1})
+            continue
+        for u in unroll_candidates:
+            c = {"steps": int(k)}
+            if u is not None:
+                c["multistep_unroll"] = bool(u)
+            candidates.append(c)
+    result = Autotuner(measure, repeats=repeats,
+                       score_unit="steps/sec",
+                       verbose=verbose).search(candidates)
+    # record the fetch policy the measurement actually used, so
+    # apply_tuned reproduces the measured configuration instead of
+    # surprising the caller with K-stacked fetches
+    extra = ({"fetch_reduce": "last"}
+             if int(result.best.get("steps", 1)) > 1 else None)
+    return _record(result, program, signature, device, store,
+                   searched={"k_candidates": list(k_candidates),
+                             "unroll_candidates": [
+                                 None if u is None else bool(u)
+                                 for u in unroll_candidates]},
+                   extra_knobs=extra)
+
+
+def tune_serving_batching(engine_factory, request_feeds,
+                          candidates=None, concurrency=8, repeats=2,
+                          store=None, signature=None, program=None,
+                          place=None, verbose=False):
+    """Search the serving batching knobs (bucket lattice / max batch /
+    coalescing window) for one model; record the winner so
+    `InferenceEngine(..., apply_tuned=True)` starts there.
+
+    engine_factory(knobs) -> a warmed InferenceEngine built with those
+    knobs (closed here after measurement). request_feeds: the
+    representative request sample fired through the real batcher from
+    `concurrency` client threads, closed-loop. Score: requests/sec of
+    fully-materialized responses.
+
+    candidates default to a lattice sweep: serial (max_batch 1) vs
+    power-of-two coalescing widths — exactly the knob whose default
+    (32) can be 10x wrong for a dispatch-bound model on one device.
+    """
+    import threading
+
+    if candidates is None:
+        candidates = [{"max_batch_size": 1, "batch_buckets": [1]},
+                      {"max_batch_size": 8, "batch_buckets": [1, 2, 4, 8]},
+                      {"max_batch_size": 16,
+                       "batch_buckets": [1, 2, 4, 8, 16]}]
+
+    device = None
+
+    def measure(knobs):
+        nonlocal device
+        engine = engine_factory(dict(knobs))
+        try:
+            if device is None:
+                device = engine._exe.place.device()
+            reqs = list(request_feeds)
+            done = [0] * concurrency
+
+            def client(ci):
+                i = ci
+                while i < len(reqs):
+                    engine.infer(reqs[i])
+                    done[ci] += 1
+                    i += concurrency
+
+            # one pass un-timed: first-hit compiles out of the window
+            engine.infer(reqs[0])
+            threads = [threading.Thread(target=client, args=(ci,))
+                       for ci in range(concurrency)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            if sum(done) != len(reqs):
+                raise RuntimeError("clients completed %d/%d requests"
+                                   % (sum(done), len(reqs)))
+            return len(reqs) / dt
+        finally:
+            engine.close()
+
+    result = Autotuner(measure, repeats=repeats,
+                       score_unit="requests/sec",
+                       verbose=verbose).search(candidates)
+    if device is None:
+        from ..places import CPUPlace
+        device = (place or CPUPlace()).device()
+    return _record(result, program, signature, device, store,
+                   searched={"candidates": [dict(c) for c in candidates],
+                             "concurrency": concurrency})

@@ -143,6 +143,45 @@ def test_bench_ckpt_smoke():
     assert modes["async"]["save_latency_ms"] > 0
 
 
+def test_bench_compile_cache_smoke():
+    """The BENCH_COMPILE_CACHE leg: cold vs warm process start for (a)
+    serving warmup over a bucket lattice and (b) trainer restart +
+    rollback re-entry, against one persistent AOT artifact cache dir.
+    The acceptance gate rides here: the WARM process must pay ZERO
+    fresh compiles (every executable loads from disk) and its measured
+    wall time must drop. Results must also be bit-identical across the
+    cold/warm serving runs (same check scalar)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_COMPILE_CACHE": "1",
+        "BENCH_CCACHE_DIM": "32", "BENCH_CCACHE_LAYERS": "6",
+        "BENCH_CCACHE_BUCKETS": "1,2,4", "BENCH_CCACHE_STEPS": "4",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    lines = [json.loads(l) for l in out.stdout.strip().splitlines()
+             if l.startswith("{")]
+    recs = {r["metric"]: r for r in lines}
+    assert set(recs) == {"compile_cache_serving_warmup",
+                         "compile_cache_trainer_restart"}
+    for rec in recs.values():
+        # THE gate: a warm start recompiles nothing, loads everything
+        assert rec["warm_recompiles"] == 0, rec
+        assert rec["warm"]["hits"] > 0 and rec["warm"]["load_errors"] == 0
+        assert rec["cold"]["hits"] == 0 and rec["cold"]["stores"] > 0
+        assert rec["value"] > 1.0, rec  # measured wall-time drop
+    serving = recs["compile_cache_serving_warmup"]
+    assert serving["cold"]["check"] == serving["warm"]["check"]
+    trainer = recs["compile_cache_trainer_restart"]
+    assert trainer["cold"]["restored_step"] is None
+    assert trainer["warm"]["restored_step"] == 4  # rollback re-entry
+
+
 def test_bench_resil_smoke():
     """The BENCH_RESIL leg: one subprocess run on CPU comparing guards
     off vs on, single-step and steps=K. The acceptance gate rides here:

@@ -29,9 +29,11 @@ def test_profiler_records_per_entry_stats(capsys):
     assert "Calls" in out and "Compile(s)" in out
     report = profiler.profile_report(sorted_key="calls")
     # the training program entry ran 4 times; startup ran once each
-    # 7 numeric columns after the (possibly space-containing) tag
-    counts = sorted(int(line.split()[-7]) for line in
-                    report.splitlines()[1:])
+    # 9 numeric columns after the (possibly space-containing) tag; the
+    # "compile cache:" footer is a summary, not an entry row
+    counts = sorted(int(line.split()[-9]) for line in
+                    report.splitlines()[1:]
+                    if not line.startswith("compile cache:"))
     assert counts[-1] == 4, report
     with pytest.raises(ValueError, match="sorted_key"):
         profiler.profile_report(sorted_key="bogus")

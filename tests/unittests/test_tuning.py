@@ -1,0 +1,319 @@
+"""paddle_tpu.tuning — autotuner, store, and the apply_tuned plumbing.
+
+Acceptance (ISSUE 6): tuned configs beat untuned defaults on >= 2
+CPU-measurable bench models (multistep K on a dispatch-bound trainer;
+the serving batching lattice under concurrent load), and a recorded
+config round-trips through the on-disk store into a fresh Executor /
+InferenceEngine.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import tuning
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture
+def store_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "tstore")
+    monkeypatch.setenv("FLAGS_tuning_store_dir", d)
+    yield d
+
+
+def _deep_narrow(layers=12, hidden=32, opt=True):
+    """Dispatch-bound: many tiny kernels, so per-dispatch overhead
+    dominates and multistep K (or batching) wins by a robust multiple —
+    the PR-1 bench shape, chosen so a noisy CI box can't flip the
+    comparison."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[hidden], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = x
+        for _ in range(layers):
+            h = fluid.layers.fc(input=h, size=hidden, act="relu")
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        if opt:
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+# ----------------------------------------------------------------- store --
+def test_store_round_trip_and_versioning(store_dir):
+    st = tuning.TuningStore()
+    assert st.root == store_dir
+    st.put("prog:abc", "cpu/x86", {"steps": 8}, score=123.0,
+           score_unit="steps/sec")
+    entry = st.get("prog:abc", "cpu/x86")
+    assert entry["knobs"] == {"steps": 8} and entry["score"] == 123.0
+    # unknown device / signature reads as untuned
+    assert st.get("prog:abc", "tpu/v5e") is None
+    assert st.get("prog:other", "cpu/x86") is None
+    # unknown knob names fail the put, not the later apply
+    with pytest.raises(ValueError, match="unknown tuning knob"):
+        st.put("prog:abc", "cpu/x86", {"stepz": 8})
+    # a version bump invalidates: stale configs are never applied
+    path = st._entry_path("prog:abc", "cpu/x86")
+    record = json.loads(open(path).read())
+    record["store_version"] = 0
+    open(path, "w").write(json.dumps(record))
+    assert st.get("prog:abc", "cpu/x86") is None
+    # torn file reads as untuned, the safe fallback
+    open(path, "w").write('{"store_ver')
+    assert st.get("prog:abc", "cpu/x86") is None
+
+
+def test_program_signature_stable_across_rebuilds(store_dir):
+    m1, _, _ = _deep_narrow()
+    m2, _, _ = _deep_narrow()
+    s1 = tuning.program_signature(m1)
+    s2 = tuning.program_signature(m2)
+    assert s1 == s2 and s1.startswith("prog:")
+    m3, _, _ = _deep_narrow(layers=13)
+    assert tuning.program_signature(m3) != s1
+
+
+def test_autotuner_skips_broken_candidates():
+    def measure(knobs):
+        if knobs["steps"] == 3:
+            raise RuntimeError("boom")
+        return float(knobs["steps"])
+    res = tuning.Autotuner(measure, repeats=1).search(
+        [{"steps": 1}, {"steps": 3}, {"steps": 2}])
+    assert res.best == {"steps": 2}
+    assert [e for _, s, e in res.results if e] == ["RuntimeError: boom"]
+    with pytest.raises(RuntimeError, match="every candidate failed"):
+        tuning.Autotuner(lambda k: 1 / 0, repeats=1).search([{"steps": 1}])
+
+
+# ------------------------------------- acceptance: tuned beats defaults --
+def test_tuned_multistep_beats_default(store_dir, monkeypatch):
+    """Bench model 1 (training): on the dispatch-bound MLP, the tuner
+    must pick K > 1 and its measured score must beat the K=1 default —
+    the +65%-at-K=8 PR-1 result, re-proven by search."""
+    monkeypatch.setenv("FLAGS_multistep_unroll", "0")  # cheap compiles
+    main, startup, loss = _deep_narrow()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, 32).astype("f"),
+            "y": rng.rand(16, 1).astype("f")}
+    result = tuning.tune_training_multistep(
+        main, startup, feed, [loss], k_candidates=(1, 8), steps=32,
+        warmup=1, repeats=3, store=True)
+    assert result.best["steps"] == 8, result.results
+    k1 = [s for kn, s, _ in result.results if kn == {"steps": 1}][0]
+    assert result.best_score > k1 * 1.2, result.results
+    assert result.store_path and os.path.exists(result.store_path)
+
+
+def test_tuned_serving_lattice_beats_serial(store_dir):
+    """Bench model 2 (serving): under 8 concurrent clients, a coalescing
+    bucket lattice must beat the serial max_batch=1 config — the PR-3
+    occupancy result, re-proven by search and recorded."""
+    from paddle_tpu.serving import InferenceEngine
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        h = x
+        for _ in range(10):
+            h = fluid.layers.fc(input=h, size=64, act="relu")
+        out = fluid.layers.fc(input=h, size=1)
+    infer = main.prune([out.name], for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+    def engine_factory(knobs):
+        engine = InferenceEngine(
+            program=infer, feed_names=["x"], fetch_vars=[out],
+            batch_buckets=knobs.get("batch_buckets"),
+            max_batch_size=knobs.get("max_batch_size"),
+            max_queue_delay_ms=knobs.get("max_queue_delay_ms"),
+            warmup=False, validate=False)
+        for name in scope.names():
+            if scope.get(name) is not None:
+                engine._scope.set(name, scope.get(name))
+        engine.warmup()  # params first, like from_checkpoint
+        return engine
+
+    rng = np.random.RandomState(1)
+    reqs = [{"x": rng.rand(1, 16).astype("f")} for _ in range(48)]
+    candidates = [
+        {"max_batch_size": 1, "batch_buckets": [1]},          # serial
+        {"max_batch_size": 8, "batch_buckets": [1, 2, 4, 8],  # coalesce
+         "max_queue_delay_ms": 4.0},
+    ]
+    result = tuning.tune_serving_batching(
+        engine_factory, reqs, candidates=candidates, concurrency=8,
+        repeats=3, store=True, program=infer)
+    assert result.best["max_batch_size"] == 8, result.results
+    serial = [s for kn, s, _ in result.results
+              if kn["max_batch_size"] == 1][0]
+    assert result.best_score > serial * 1.2, result.results
+
+    # round-trip into a fresh engine: apply_tuned picks the recorded
+    # lattice up by program signature, explicit args still win
+    engine = InferenceEngine(
+        program=infer, feed_names=["x"], fetch_vars=[out],
+        warmup=False, validate=False, apply_tuned=True)
+    try:
+        assert engine.batch_buckets == [1, 2, 4, 8]
+        assert engine.max_batch_size == 8
+        assert engine._batcher.max_queue_delay_s == pytest.approx(0.004)
+    finally:
+        engine.close(drain=False)
+    engine = InferenceEngine(
+        program=infer, feed_names=["x"], fetch_vars=[out],
+        batch_buckets=[1, 2], warmup=False, validate=False,
+        apply_tuned=True)
+    try:
+        assert engine.batch_buckets == [1, 2]  # explicit beats tuned
+    finally:
+        engine.close(drain=False)
+
+
+# -------------------------------------------- executor round-trip (K) ----
+def _make_recordio(tmp_path, n_batches=16):
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype("float32")
+
+    def reader():
+        for _ in range(n_batches):
+            xs = rng.rand(8, 4).astype("float32")
+            yield xs, (xs @ w).astype("float32")
+
+    path = str(tmp_path / "tune.recordio")
+    fluid.recordio_writer.convert_reader_to_recordio_file(path, reader)
+    return path
+
+
+def _reader_prog(path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        r = fluid.layers.open_recordio_file(
+            filename=path, shapes=[[-1, 4], [-1, 1]], lod_levels=[0, 0],
+            dtypes=["float32", "float32"])
+        x, y = fluid.layers.read_file(r)
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_executor_apply_tuned_round_trip(store_dir, tmp_path,
+                                         monkeypatch):
+    """A recorded K round-trips into a fresh Executor: reader-fed
+    programs start at the tuned K (4 records per call, stacked
+    fetches); explicit-feed programs are left at steps=1 because K
+    replays of one batch would change training semantics."""
+    monkeypatch.setenv("FLAGS_multistep_unroll", "0")
+    path = _make_recordio(tmp_path)
+    main, startup, loss = _reader_prog(path)
+    sig = tuning.program_signature(main)
+    tuning.TuningStore().put(
+        sig, tuning.device_key(fluid.CPUPlace().device()),
+        {"steps": 4, "multistep_unroll": False}, score=1.0)
+
+    main2, startup2, loss2 = _reader_prog(path)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup2)
+        out = exe.run(main2, feed={}, fetch_list=[loss2],
+                      apply_tuned=True)
+        # tuned K=4 applied: the stacked fetch carries a leading-4 axis
+        assert np.asarray(out[0]).shape[0] == 4
+        # untuned dispatch of the same program: steps=1 shape
+        out = exe.run(main2, feed={}, fetch_list=[loss2])
+        assert np.asarray(out[0]).shape == (1,)
+
+    # a recorded fetch_reduce (what tune_training_multistep measures
+    # with) rides along: K applies WITHOUT a surprise leading-K axis
+    tuning.TuningStore().put(
+        sig, tuning.device_key(fluid.CPUPlace().device()),
+        {"steps": 4, "multistep_unroll": False, "fetch_reduce": "last"},
+        score=1.0)
+    main4, startup4, loss4 = _reader_prog(path)
+    exe4 = fluid.Executor(fluid.CPUPlace())
+    s4 = fluid.Scope()
+    with fluid.scope_guard(s4):
+        exe4.run(startup4)
+        out = exe4.run(main4, feed={}, fetch_list=[loss4],
+                       apply_tuned=True)
+        assert np.asarray(out[0]).shape == (1,)  # 'last', not stacked
+        # an explicit non-default fetch_reduce still wins over tuned
+        out = exe4.run(main4, feed={}, fetch_list=[loss4],
+                       fetch_reduce="mean", apply_tuned=True)
+        assert np.asarray(out[0]).shape == (1,)
+
+    # explicit-feed program with a recorded K: never auto-applied
+    m3, st3, l3 = _deep_narrow(layers=2)
+    tuning.TuningStore().put(
+        tuning.program_signature(m3),
+        tuning.device_key(fluid.CPUPlace().device()), {"steps": 8})
+    exe3 = fluid.Executor(fluid.CPUPlace())
+    s3 = fluid.Scope()
+    with fluid.scope_guard(s3):
+        exe3.run(st3)
+        out = exe3.run(m3, feed={"x": np.ones((4, 32), "f"),
+                                 "y": np.ones((4, 1), "f")},
+                       fetch_list=[l3], apply_tuned=True)
+        assert np.asarray(out[0]).shape == (1,)
+    # and a program with NO recorded config is simply untouched
+    with fluid.scope_guard(s3):
+        out = exe3.run(m3, feed={"x": np.ones((4, 32), "f"),
+                                 "y": np.ones((4, 1), "f")},
+                       fetch_list=[l3], apply_tuned=True)
+        assert np.asarray(out[0]).shape == (1,)
+
+
+# ------------------------------------------------------------------ CLI --
+def test_ptpu_tune_cli(store_dir):
+    tool = os.path.join(REPO, "tools", "ptpu_tune.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "FLAGS_tuning_store_dir": store_dir})
+
+    def run(*args):
+        return subprocess.run([sys.executable, tool] + list(args),
+                              env=env, capture_output=True, text=True,
+                              timeout=600)
+
+    out = run("list", "--json")
+    assert out.returncode == 1  # empty store = nothing found
+
+    out = run("train-smoke", "--k", "1,8", "--steps", "24",
+              "--layers", "8", "--json")
+    assert out.returncode == 0, out.stdout + out.stderr
+    record = json.loads(out.stdout.strip().splitlines()[-1])
+    assert record["best"]["steps"] in (1, 8)
+    assert record["store_path"]
+
+    out = run("list", "--json")
+    assert out.returncode == 0
+    entries = json.loads(out.stdout)["entries"]
+    assert len(entries) == 1
+    assert entries[0]["signature"] == record["signature"]
+
+    out = run("show", record["signature"])
+    assert out.returncode == 0
+    knobs = json.loads(out.stdout)["knobs"]
+    # stored knobs = the winning candidate plus the measured fetch
+    # policy (recorded so apply_tuned reproduces the measured config)
+    for k, v in record["best"].items():
+        assert knobs[k] == v
+    if record["best"]["steps"] > 1:
+        assert knobs["fetch_reduce"] == "last"
+    assert run("show", "prog:nope").returncode == 1

@@ -72,8 +72,14 @@ def cmd_inspect(args):
 def cmd_replay(args):
     import numpy as np
     import paddle_tpu as fluid
+    from paddle_tpu.core.compile_cache import (default_cache_dir,
+                                               maybe_enable_persistent_cache)
     from paddle_tpu.core.executor import NumericalGuardError
     from paddle_tpu.resilience.watchdog import read_bundle
+    # a replay of a remat-heavy training step pays the same compile the
+    # wedged trainer did; the persistent cache makes repeat replays (and
+    # a replay on the machine that trained) load it from disk instead
+    maybe_enable_persistent_cache(default_cache_dir())
     meta, program, feeds, state = read_bundle(args.bundle)
     if program is None or feeds is None:
         print("REPLAY UNSUPPORTED: bundle carries %s" % (

@@ -189,6 +189,17 @@ def main(argv=None):
     import paddle_tpu as fluid
     from paddle_tpu import serving
 
+    # serving warmup is the cold start that hurts most: pre-tracing the
+    # whole bucket lattice recompiles every shape on every restart.
+    # Default BOTH compile caches on (per-uid dirs) so a restarted
+    # server loads its lattice from disk; FLAGS_compile_cache_dir='' /
+    # FLAGS_aot_cache_dir='' stay the explicit off switches.
+    from paddle_tpu.core.compile_cache import (
+        default_aot_cache_dir, default_cache_dir,
+        maybe_enable_aot_cache, maybe_enable_persistent_cache)
+    maybe_enable_persistent_cache(default_cache_dir())
+    maybe_enable_aot_cache(default_aot_cache_dir())
+
     batch_buckets, seq_buckets = parse_buckets(args.warmup_buckets)
     place = fluid.TPUPlace() if args.place == "tpu" else fluid.CPUPlace()
     try:
