@@ -26,6 +26,21 @@ so back-to-back saves can't pile up unboundedly in memory.
 `restore` walks back to the newest snapshot whose hash tree verifies
 (corruption/torn saves are skipped, never half-loaded) and puts
 everything back: values, reader positions, seed cursor.
+
+Reshard-on-restore (the elasticity refactor): a snapshot records the
+DEVICE LAYOUT it was captured under — the cohort shape
+(parallel.DeviceLayout) in snapshot.json and, per value, the mesh
+PartitionSpec the live array was sharded with. Arrays are always
+PERSISTED as full global host arrays (the background writer's np.asarray
+is the re-GATHER across the source mesh), so `restore(layout=...)` can
+re-SPLIT them onto any target mesh: each value is device_put with its
+recorded spec adapted to the target (axes the new mesh lacks are
+dropped; a dim the new axis size no longer divides falls back to
+replicated). A snapshot written under N devices therefore restores
+under M<N, M>N or M=N — and at M=N the values are bit-identical to a
+plain `restore()`, only placement differs. This is what lets the
+cluster Supervisor roll a shrunken/grown cohort back onto a new mesh
+shape (resilience/cluster.py).
 """
 import os
 import threading
@@ -37,6 +52,59 @@ from . import snapshot as _snap
 from .retention import RetentionPolicy, apply_retention
 
 __all__ = ["CheckpointManager", "SaveHandle"]
+
+
+# ------------------------------------------------------------ sharding --
+def _spec_to_json(spec):
+    """PartitionSpec -> JSON list (str | [str, ...] | None per dim)."""
+    out = []
+    for p in tuple(spec):
+        if isinstance(p, (list, tuple)):
+            out.append([str(a) for a in p])
+        else:
+            out.append(None if p is None else str(p))
+    return out
+
+
+def _adapt_spec(spec_json, mesh, shape):
+    """A recorded per-var spec, adapted to the TARGET mesh: mesh axes
+    the target doesn't have are dropped, and a dim whose new combined
+    axis size no longer divides it falls back to replicated on that dim
+    (correctness first — an uneven split would corrupt the value)."""
+    from jax.sharding import PartitionSpec as P
+    if not spec_json:
+        return P()
+    out = []
+    for i, ent in enumerate(spec_json[:len(shape)]):
+        axes = (list(ent) if isinstance(ent, (list, tuple))
+                else ([] if ent is None else [ent]))
+        kept = [a for a in axes if a in mesh.shape]
+        if kept:
+            factor = 1
+            for a in kept:
+                factor *= int(mesh.shape[a])
+            if factor <= 0 or int(shape[i]) % factor != 0:
+                kept = []
+        out.append(tuple(kept) if len(kept) > 1
+                   else (kept[0] if kept else None))
+    return P(*out)
+
+
+def _resolve_layout_mesh(layout):
+    """restore(layout=...) accepts a parallel.DeviceLayout, a live jax
+    Mesh, or a bare device count (int) — normalize to a Mesh."""
+    import jax
+    from jax.sharding import Mesh
+    if isinstance(layout, Mesh):
+        return layout
+    if isinstance(layout, int):
+        from ..parallel.distributed import DeviceLayout
+        layout = DeviceLayout(local_device_count=layout)
+    if hasattr(layout, "local_mesh"):
+        return layout.local_mesh()
+    raise TypeError(
+        "restore(layout=...) wants a parallel.DeviceLayout, a jax Mesh "
+        "or a device count, got %r" % (layout,))
 
 
 def _capture_value(val):
@@ -55,6 +123,21 @@ def _capture_value(val):
     if isinstance(val, jax.Array):
         return jnp.copy(val)
     return np.array(val, copy=True)
+
+
+def _live_sharding_spec(val):
+    """The JSON'd PartitionSpec of a NamedSharding'd device value, or
+    None for replicated/host values (nothing worth recording: restore
+    treats an absent spec as replicated)."""
+    import jax
+    from jax.sharding import NamedSharding
+    if not isinstance(val, jax.Array):
+        return None
+    sh = getattr(val, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return None
+    spec = _spec_to_json(sh.spec)
+    return spec if any(p is not None for p in spec) else None
 
 
 class SaveHandle(object):
@@ -140,12 +223,19 @@ class CheckpointManager(object):
         from ..core.executor import _validate_program_flag
         return _validate_program_flag()
 
-    def save(self, step, program=None, scope=None, wait=False, extra=None):
+    def save(self, step, program=None, scope=None, wait=False, extra=None,
+             layout=None):
         """Snapshot full training state after step `step`. Returns a
         SaveHandle; with async_save the write happens on the background
         thread and this call only pays capture (device-side copies +
         host dicts) — unless `max_in_flight` older saves are still
-        writing, in which case it blocks until one drains."""
+        writing, in which case it blocks until one drains.
+
+        `layout` (a parallel.DeviceLayout) records the cohort shape the
+        snapshot was captured under; defaults to the process's active
+        layout (parallel.active_layout()) when one is set. Per-value
+        mesh shardings are recorded from the live arrays either way, so
+        restore(layout=...) can reshard onto a different mesh."""
         if self._closed:
             raise RuntimeError("CheckpointManager is closed")
         from ..core.framework import Parameter, default_main_program
@@ -197,12 +287,23 @@ class CheckpointManager(object):
                 # optimizer accumulator: tie it to its owner param in the
                 # manifest ("" = optimizer-global state like beta pows)
                 entry["owner"] = acc_owner[v.name]
-            values.append((v.name, entry, _capture_value(val)))
+            captured = _capture_value(val)
+            spec = _live_sharding_spec(captured)
+            if spec:
+                # the spec this value was sharded with on its SOURCE
+                # mesh — what restore(layout=) adapts to the target
+                entry["sharding"] = spec
+            values.append((v.name, entry, captured))
 
         meta = {"seed_cursor": int(scope.seed_state()),
                 "reader_states": reader_states,
                 "program_version": int(getattr(program, "_version", 0)),
                 "wall_time": time.time()}
+        if layout is None:
+            from ..parallel.distributed import active_layout
+            layout = active_layout()
+        if layout is not None:
+            meta["device_layout"] = layout.to_json()
         if extra:
             meta["extra"] = dict(extra)
         job = _SaveJob(int(step), values, meta,
@@ -328,7 +429,7 @@ class CheckpointManager(object):
         return [s for s, _ in _snap.list_steps(self.checkpoint_dir)]
 
     def restore(self, program=None, scope=None, executor=None, step=None,
-                allow_missing=False, before=None):
+                allow_missing=False, before=None, layout=None):
         """Load the newest VALID snapshot (or `step`) into `scope`:
         persistable values, reader positions, seed cursor. Returns the
         restored step, or None when no snapshot exists at all. A snapshot
@@ -348,10 +449,26 @@ class CheckpointManager(object):
         With `program`, the restore is strict the way load_vars is: every
         persistable the program declares (reader plumbing aside) must be
         in the manifest, and live reader states recorded in the snapshot
-        must exist in the scope (run the startup program first)."""
+        must exist in the scope (run the startup program first).
+
+        `layout` (a parallel.DeviceLayout, a jax Mesh, or a device
+        count) RESHARDS the restore onto that target: every loaded
+        value is device_put with its recorded source PartitionSpec
+        adapted to the target mesh (absent axes dropped, non-dividing
+        dims replicated; values recorded without a spec replicate).
+        The snapshot may have been written under a different device
+        count — persisted arrays are global, so shrink (M<N), grow
+        (M>N) and same-shape (M=N) all load the same bytes; at M=N the
+        values are bit-identical to a plain restore. A layout the live
+        process cannot satisfy (fewer devices than it names) raises
+        before anything lands in the scope."""
         del executor  # parity with io signatures; scope is the store
         from ..core.executor import global_scope
         scope = scope if scope is not None else global_scope()
+        # resolve the target mesh FIRST: an unsatisfiable layout must
+        # raise before any snapshot bytes (or scope writes) are touched
+        target_mesh = None if layout is None else _resolve_layout_mesh(
+            layout)
         # resume entry point: sweep dead writers' droppings first — this
         # also RECOVERS a step dir a killed same-step re-save left parked
         # as step_<N>.old.<pid> (see snapshot.clean_stale_tmp)
@@ -399,6 +516,22 @@ class CheckpointManager(object):
                 loaded = _snap.load_verified_arrays(path, manifest)
             except (OSError, ValueError):
                 continue  # torn or bit-flipped arrays: walk back
+            if target_mesh is not None:
+                # reshard: re-split every global array onto the target
+                # mesh per its adapted source spec. device_put the whole
+                # set BEFORE the first scope.set — a placement failure
+                # (bad spec, device loss) must not leave the scope
+                # half-restored.
+                import jax
+                from jax.sharding import NamedSharding
+                placed = {}
+                for name, arr in loaded.items():
+                    spec = _adapt_spec(
+                        manifest.get(name, {}).get("sharding"),
+                        target_mesh, np.shape(arr))
+                    placed[name] = jax.device_put(
+                        arr, NamedSharding(target_mesh, spec))
+                loaded = placed
             # all-or-nothing from here: every value is in memory and
             # verified, so nothing below can leave scope half-updated
             for name, arr in loaded.items():

@@ -456,6 +456,16 @@ def run_with_deadline(fn, timeout, what="dispatch"):
 # step indices — the single seam every recovery path is proved through.
 _fault_hook = None
 
+# Step-barrier hook (resilience/cluster.py): None outside elastic runs.
+# An elastic worker installs one that raises ClusterFenced when the
+# cluster plan has moved past the generation this process is training
+# under. It fires at the very top of every dispatch — BEFORE the fault
+# hook, the io pre-pass and the seed draw — so a fenced attempt consumes
+# nothing (no reader records, no rng) and the step replays bit-exactly
+# once the cohort reconfigures, even when the fence lands mid-train()
+# inside a loop the worker does not control.
+_barrier_hook = None
+
 
 def _raise_program_errors(errors, include_non_guard=True):
     """Raise on tripped in-graph assertion flags (one host sync of the
@@ -702,6 +712,11 @@ class Executor(object):
             info["cache_key"] = (program._uid, program._version,
                                  _feed_signature(feed_arrays),
                                  tuple(fetch_names))
+
+        # cluster step barrier (resilience/cluster.py): a fenced cohort
+        # stops HERE, before anything is consumed
+        if _barrier_hook is not None:
+            _barrier_hook("dispatch", program=program, steps=steps)
 
         # fault-injection seam (resilience/faults.py): BEFORE the io
         # pre-pass and the seed draw, so an injected dispatch failure or

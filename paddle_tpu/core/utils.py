@@ -72,3 +72,23 @@ def write_bytes_fsync(path, data):
         f.write(data)
         f.flush()
         os.fsync(f.fileno())
+
+
+def atomic_write_json(path, obj, fsync=False, **dump_kw):
+    """Publish a JSON document atomically: serialize, write to a
+    pid-suffixed tmp sibling, one os.replace. Readers never see a torn
+    document. fsync=True adds the write_bytes_fsync durability step for
+    documents that must survive power loss (the cluster plan); liveness
+    signals (heartbeats, fired every fraction of a second) skip it.
+    ONE implementation for every tmp+replace JSON writer so the
+    atomicity discipline can't drift per copy."""
+    import json
+    import os
+    data = json.dumps(obj, **dump_kw).encode("utf-8")
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    if fsync:
+        write_bytes_fsync(tmp, data)
+    else:
+        with open(tmp, "wb") as f:
+            f.write(data)
+    os.replace(tmp, path)

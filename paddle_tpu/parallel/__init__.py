@@ -7,7 +7,8 @@ from .ring_attention import ring_attention, ring_attention_sharded, \
 from .pipeline import pipeline_apply, pipeline_stages_spec, \
     stack_stage_params, sequential_reference
 from .distributed import init_distributed, shutdown_distributed, \
-    global_mesh, is_initialized as distributed_is_initialized
+    global_mesh, DeviceLayout, active_layout, set_active_layout, \
+    is_initialized as distributed_is_initialized
 from .moe import moe_layer, init_moe_params, moe_param_specs
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 from . import tp

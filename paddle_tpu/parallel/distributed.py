@@ -16,6 +16,16 @@ Env contract (reference names first, jax-standard fallbacks):
   TRAINERS / num_processes        — number of host processes in the job
   TRAINER_ID / process_id         — this process's rank
   PADDLE_COORDINATOR / coordinator_address — "host:port" of rank 0
+
+Elastic rescale (resilience/cluster.py) additionally needs the runtime to
+be RE-initializable in one process: `shutdown_distributed()` tears down
+the client AND drops every piece of cached mesh/device state this module
+holds (the active `DeviceLayout`), so a worker can leave a 2-host cohort
+and re-join a 1-host one without leaking the old world's shape into the
+new mesh. `DeviceLayout` is the explicit description of one cohort shape
+(process count, rank, local device count, mesh axes) — the thing a
+checkpoint records at save and `CheckpointManager.restore(layout=)`
+reshards onto.
 """
 import os
 
@@ -25,7 +35,8 @@ from .mesh import make_mesh, Mesh
 
 __all__ = ["init_distributed", "is_initialized", "shutdown_distributed",
            "global_mesh", "process_count", "process_index",
-           "local_device_count", "global_device_count"]
+           "local_device_count", "global_device_count",
+           "DeviceLayout", "active_layout", "set_active_layout"]
 
 # _noop: a single-host init_distributed() ran (nothing to rendezvous).
 # _client: jax.distributed.initialize actually joined a process group.
@@ -33,6 +44,104 @@ __all__ = ["init_distributed", "is_initialized", "shutdown_distributed",
 # after an early no-op init, and shutdown only tears down a real client.
 _noop = False
 _client = False
+# the process's current cohort shape (elastic workers set it each
+# generation); shutdown_distributed drops it — cached device state must
+# not outlive the world it described
+_layout = None
+
+
+class DeviceLayout(object):
+    """One cohort shape: `num_processes` host processes, this process at
+    `process_index`, each using `local_device_count` of its devices with
+    `mesh_axes` laid over them. JSON round-trips (checkpoint metadata,
+    the cluster plan), and `local_mesh()` materializes the jax Mesh this
+    process trains on — the restore-time resharding target."""
+
+    __slots__ = ("num_processes", "process_index", "local_device_count",
+                 "mesh_axes", "batch_axis")
+
+    def __init__(self, num_processes=1, process_index=0,
+                 local_device_count=None, mesh_axes=None, batch_axis="dp"):
+        self.num_processes = int(num_processes)
+        self.process_index = int(process_index)
+        if not (0 <= self.process_index < self.num_processes):
+            raise ValueError(
+                "process_index %d outside [0, %d)" % (self.process_index,
+                                                      self.num_processes))
+        self.local_device_count = (None if local_device_count is None
+                                   else int(local_device_count))
+        self.mesh_axes = dict(mesh_axes) if mesh_axes else {batch_axis: -1}
+        self.batch_axis = batch_axis
+
+    @property
+    def total_device_count(self):
+        """Cluster-wide chip count (None until local count is resolved)."""
+        if self.local_device_count is None:
+            return None
+        return self.num_processes * self.local_device_count
+
+    def resolved_local_device_count(self):
+        return (self.local_device_count if self.local_device_count
+                is not None else len(jax.devices()))
+
+    def local_mesh(self):
+        """The Mesh over this process's slice of devices. With fewer
+        live devices than the layout asks for, raises — a silent
+        smaller mesh would break the cohort's divisibility contract."""
+        want = self.resolved_local_device_count()
+        devices = jax.devices()
+        if len(devices) < want:
+            raise ValueError(
+                "DeviceLayout wants %d local devices but only %d exist "
+                "(XLA_FLAGS=--xla_force_host_platform_device_count=%d "
+                "for a virtual CPU mesh)" % (want, len(devices), want))
+        return make_mesh(self.mesh_axes, devices[:want])
+
+    def to_json(self):
+        return {"num_processes": self.num_processes,
+                "process_index": self.process_index,
+                "local_device_count": self.local_device_count,
+                "mesh_axes": dict(self.mesh_axes),
+                "batch_axis": self.batch_axis}
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(num_processes=d.get("num_processes", 1),
+                   process_index=d.get("process_index", 0),
+                   local_device_count=d.get("local_device_count"),
+                   mesh_axes=d.get("mesh_axes"),
+                   batch_axis=d.get("batch_axis", "dp"))
+
+    def __eq__(self, other):
+        return isinstance(other, DeviceLayout) \
+            and self.to_json() == other.to_json()
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __repr__(self):
+        return ("DeviceLayout(procs=%d, rank=%d, local_devices=%s, "
+                "axes=%r)" % (self.num_processes, self.process_index,
+                              self.local_device_count, self.mesh_axes))
+
+
+def active_layout():
+    """The cohort shape this process currently trains under, or None.
+    Elastic workers set it each generation; plain single-host jobs never
+    need to."""
+    return _layout
+
+
+def set_active_layout(layout):
+    """Install `layout` (a DeviceLayout or None) as the process's
+    current cohort shape; returns the previous one."""
+    global _layout
+    if layout is not None and not isinstance(layout, DeviceLayout):
+        raise TypeError("set_active_layout wants a DeviceLayout or None, "
+                        "got %r" % (layout,))
+    old = _layout
+    _layout = layout
+    return old
 
 
 def _env_int(*names):
@@ -50,6 +159,11 @@ def init_distributed(coordinator_address=None, num_processes=None,
     Arguments fall back to the env contract above. Call once per host
     process before any jax device use; after it, jax.devices() is GLOBAL
     (all chips of all hosts) and `global_mesh` can span the pod.
+
+    Re-initialization: after `shutdown_distributed()` a fresh call joins
+    a NEW process group (possibly with a different world size/rank) —
+    the elastic-rescale entry point. A call while a client is live stays
+    a no-op returning False, as before.
     """
     global _noop, _client
     if _client:
@@ -87,11 +201,15 @@ def is_initialized():
 
 
 def shutdown_distributed():
-    global _noop, _client
+    """Leave the process group and DROP all cached mesh/device state
+    (the active DeviceLayout) — after this, `init_distributed` can form
+    a new, differently-shaped world in the same process. Idempotent."""
+    global _noop, _client, _layout
     if _client:
         jax.distributed.shutdown()
         _client = False
     _noop = False
+    _layout = None
 
 
 def process_count():

@@ -213,6 +213,12 @@ class ParallelExecutor(object):
                                  _feed_signature(feed_arrays),
                                  tuple(fetch_names))
 
+        # same cluster step barrier as Executor._run_impl: a fenced
+        # cohort stops before anything is consumed
+        if _exe_mod._barrier_hook is not None:
+            _exe_mod._barrier_hook("dispatch", program=program,
+                                   steps=steps)
+
         # same fault-injection seam as Executor._run_impl: before the io
         # pre-pass and seed draw, so injected failures consume nothing
         if _exe_mod._fault_hook is not None:

@@ -19,9 +19,19 @@ PR-4 checkpoints, and the reader stack:
                 every action in a structured event log + profiler tags.
   * faults    — a deterministic fault plan (`PTPU_FAULT_PLAN` env or
                 programmatic) injecting NaN feeds, reader stalls/EOFs/
-                errors, dispatch exceptions, slow steps and checkpoint
-                kills at chosen indices, so every recovery path above is
-                provable in CI.
+                errors, dispatch exceptions, slow steps, checkpoint
+                kills — and cluster faults: whole-worker SIGKILLs
+                (`host_death`) and heartbeat stalls — at chosen
+                indices, so every recovery path above is provable in
+                CI.
+  * cluster   — the elastic multi-host layer (ARCHITECTURE.md §19): a
+                ClusterCoordinator that heartbeat-monitors a cohort of
+                ElasticWorkers, fences it on host death, rolls every
+                survivor back to the newest valid snapshot and
+                RESHARDS it onto the new mesh shape
+                (CheckpointManager.restore(layout=)); replacement
+                workers grow the mesh back at a step barrier with no
+                aborted step. `tools/ptpu_elastic.py` launches it.
 
 Quickstart:
 
@@ -44,6 +54,9 @@ from .supervisor import (DEFAULT_POLICIES, FAULT_CLASSES, Action,
                          Supervisor, TrainingAborted, abort, retry,
                          rollback, skip_batch)
 from .watchdog import read_bundle, write_bundle
+from .heartbeat import HeartbeatMonitor, HeartbeatWriter, read_heartbeats
+from .cluster import (ClusterAborted, ClusterCoordinator, ClusterFenced,
+                      ElasticWorker)
 
 __all__ = [
     "Supervisor", "TrainingAborted", "Action", "skip_batch", "retry",
@@ -53,4 +66,7 @@ __all__ = [
     "FaultPlan", "InjectedFault", "InjectedDispatchError",
     "InjectedReaderError", "active_plan",
     "write_bundle", "read_bundle",
+    "HeartbeatWriter", "HeartbeatMonitor", "read_heartbeats",
+    "ClusterCoordinator", "ElasticWorker", "ClusterFenced",
+    "ClusterAborted",
 ]

@@ -13,6 +13,15 @@ Arming a plan installs hooks at three seams:
     InjectedDispatchError, `slow_step` sleeps `arg` seconds (trips the
     watchdog). All fire BEFORE the io pre-pass and seed draw, so a
     failed attempt consumes nothing and retries replay bit-exactly.
+    Cluster faults ride the same seam, keyed on the same
+    coordinator-visible step cursor: `host_death@N` SIGKILLs the whole
+    worker process at step N (the deterministic "a host just died"
+    for the elastic multi-process CI leg — nothing of step N is
+    consumed, so the newest snapshot is at most N-1), and
+    `heartbeat_stall@N[:secs]` stops the heartbeat thread's writes
+    from step N for `secs` seconds (default: forever) WITHOUT touching
+    the training loop — the "wedged but not dead" host the coordinator
+    must fence out on missed heartbeats alone.
   * `core.readers._fault_hook` — fires per RECORD, keyed on each
     reader's own delivered-record counter (deterministic even when a
     DoubleBufferReader worker pre-stages ahead of the training loop):
@@ -39,7 +48,7 @@ __all__ = ["FaultPlan", "InjectedFault", "InjectedDispatchError",
 _KINDS = frozenset({
     "nan_feed", "dispatch_exc", "slow_step",
     "reader_nan", "reader_exc", "reader_stall", "reader_eof",
-    "ckpt_kill",
+    "ckpt_kill", "host_death", "heartbeat_stall",
 })
 _READER_KINDS = frozenset({"reader_nan", "reader_exc", "reader_stall",
                            "reader_eof"})
@@ -123,6 +132,7 @@ class FaultPlan(object):
                 self.entries.append(_Entry(kind, at, arg=arg))
         self._step = 0
         self._ckpt_crossings = 0
+        self._hb_stall_until = 0.0  # monotonic deadline (inf = forever)
         # one-shot bookkeeping is check-then-act; reader hooks fire from
         # worker threads (DoubleBuffer pre-staging), so _take must be
         # atomic or a "one-shot" could fire twice in a tight race
@@ -197,9 +207,27 @@ class FaultPlan(object):
         self.disarm()
 
     # ----------------------------------------------------------- hooks --
+    def heartbeat_stalled(self):
+        """True while an injected heartbeat stall is in effect
+        (HeartbeatWriter.beat consults this before every write)."""
+        import time
+        return time.monotonic() < self._hb_stall_until
+
     def _executor_hook(self, point, program=None, steps=1,
                        feed_arrays=None):
         del point, program
+        e = self._take(("host_death",), self._step)
+        if e is not None:
+            # the whole WORKER dies, exactly like a preempted host: no
+            # atexit, no cleanup, before anything of this step is
+            # consumed (the same SIGKILL discipline as ckpt_kill)
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+        e = self._take(("heartbeat_stall",), self._step)
+        if e is not None:
+            import time
+            self._hb_stall_until = time.monotonic() + (
+                e.arg if e.arg is not None else float("inf"))
         e = self._take(("slow_step",), self._step)
         if e is not None:
             import time

@@ -130,7 +130,7 @@ class Supervisor(object):
     def __init__(self, executor, program, scope=None,
                  checkpoint_manager=None, policies=None,
                  watchdog_timeout=None, divergence=None, bundle_dir=None,
-                 metrics_window=64):
+                 metrics_window=64, restore_layout=None):
         """Wrap `executor` dispatches of `program` in detection +
         recovery. `policies` maps fault class -> escalation chain
         (missing classes use DEFAULT_POLICIES). `watchdog_timeout` arms
@@ -138,9 +138,13 @@ class Supervisor(object):
         `divergence` is a guards.DivergenceDetector fed every step's
         first fetch. `checkpoint_manager` enables rollback (and
         train(checkpoint_every=)); without one, rollback actions
-        escalate straight past themselves. Registers itself on the
-        reader fault channel so worker-thread errors surface in the
-        event log the moment they happen."""
+        escalate straight past themselves. `restore_layout` (a
+        parallel.DeviceLayout) makes every rollback restore reshard
+        onto that target mesh — the elastic worker's setting, so a
+        local rollback lands state exactly where the cohort's current
+        mesh shape wants it. Registers itself on the reader fault
+        channel so worker-thread errors surface in the event log the
+        moment they happen."""
         self.exe = executor
         self.program = program
         # ParallelExecutor owns its scope and takes no program/scope per
@@ -172,6 +176,7 @@ class Supervisor(object):
         self.watchdog_timeout = watchdog_timeout
         self.divergence = divergence
         self.bundle_dir = bundle_dir
+        self.restore_layout = restore_layout
         self.step = 0          # completed training steps (save label)
         self.events = []       # structured recovery log
         self.metrics = collections.deque(maxlen=int(metrics_window))
@@ -249,6 +254,13 @@ class Supervisor(object):
             except EOFException:
                 raise
             except Exception as e:  # noqa: BLE001 — classified below
+                if getattr(e, "_cluster_fence", False):
+                    # a cluster fence is not a fault: the coordinator
+                    # moved the plan and THIS process must reconfigure —
+                    # hand it up to the elastic worker loop untouched
+                    # (nothing was consumed: the barrier fires before
+                    # the prepass and seed draw)
+                    raise
                 outcome = self._handle_fault(self._classify(e), e,
                                              feed=feed, steps=steps)
                 if outcome == "skip":
@@ -426,7 +438,8 @@ class Supervisor(object):
         before = bound if self._made_progress else min(
             self._last_restore_step, bound)
         restored = self.ckpt.restore(program=self.program,
-                                     scope=self.scope, before=before)
+                                     scope=self.scope, before=before,
+                                     layout=self.restore_layout)
         if restored is None:
             self._log("_", "rollback_unavailable",
                       detail="no valid snapshot%s" % (

@@ -116,13 +116,9 @@ class TuningStore(object):
             record["meta"] = dict(meta)
         os.makedirs(self.root, exist_ok=True)
         path = self._entry_path(signature, dev_key)
-        tmp = "%s.tmp.%d" % (path, os.getpid())
-        with open(tmp, "wb") as f:
-            f.write(json.dumps(record, indent=1, sort_keys=True)
-                    .encode("utf-8"))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        from ..core.utils import atomic_write_json
+        atomic_write_json(path, record, fsync=True, indent=1,
+                          sort_keys=True)
         return path
 
     def get(self, signature, dev_key):

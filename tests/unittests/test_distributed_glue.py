@@ -56,6 +56,52 @@ def test_multi_process_env_requires_coordinator(monkeypatch):
     assert not dist.is_initialized()
 
 
+def test_shutdown_then_reinit_forms_a_new_world(monkeypatch):
+    """Elastic rescale contract: after shutdown_distributed a FRESH
+    init joins a new (differently shaped) world — and shutdown drops
+    every piece of cached mesh/device state (the active layout), so
+    nothing of the old world leaks into the new one."""
+    monkeypatch.setattr(dist, "_noop", False)
+    monkeypatch.setattr(dist, "_client", False)
+    monkeypatch.setattr(dist, "_layout", None)
+    calls = []
+    monkeypatch.setattr(dist.jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(dist.jax.distributed, "shutdown", lambda: None)
+    assert dist.init_distributed(coordinator_address="h:1",
+                                 num_processes=2, process_id=1) is True
+    dist.set_active_layout(dist.DeviceLayout(num_processes=2,
+                                             process_index=1,
+                                             local_device_count=2))
+    # a second init while live stays a no-op
+    assert dist.init_distributed(coordinator_address="h:1",
+                                 num_processes=2, process_id=1) is False
+    dist.shutdown_distributed()
+    assert dist.active_layout() is None          # cached state dropped
+    assert not dist.is_initialized()
+    assert dist.init_distributed(coordinator_address="h:2",
+                                 num_processes=1, process_id=0) is True
+    assert [c["num_processes"] for c in calls] == [2, 1]
+    dist.shutdown_distributed()
+
+
+def test_device_layout_roundtrip_and_mesh():
+    lay = dist.DeviceLayout(num_processes=3, process_index=2,
+                            local_device_count=2,
+                            mesh_axes={"dp": -1})
+    assert dist.DeviceLayout.from_json(lay.to_json()) == lay
+    assert lay.total_device_count == 6
+    mesh = lay.local_mesh()
+    assert mesh.devices.size == 2 and mesh.axis_names == ("dp",)
+    with pytest.raises(ValueError, match="local devices"):
+        dist.DeviceLayout(
+            local_device_count=len(jax.devices()) + 1).local_mesh()
+    with pytest.raises(ValueError):
+        dist.DeviceLayout(num_processes=2, process_index=2)
+    with pytest.raises(TypeError):
+        dist.set_active_layout("not a layout")
+
+
 def test_global_mesh_spans_all_devices(monkeypatch):
     monkeypatch.setattr(dist, "_noop", True)
     mesh = dist.global_mesh()

@@ -1,0 +1,264 @@
+"""Reshard-on-restore as a pure unit (ARCHITECTURE.md §19): a snapshot
+written under device count N restores under M<N, M>N and M=N — params,
+optimizer accumulators, the seed cursor and reader positions all
+bit-identical to the source state, with placement (and only placement)
+following the target DeviceLayout. At M=N the values equal a plain
+`restore()` bit-for-bit.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu.checkpoint import CheckpointManager, load_manifest, \
+    list_steps
+from paddle_tpu.checkpoint.manager import _adapt_spec, _spec_to_json
+from paddle_tpu.parallel import DeviceLayout
+from paddle_tpu.parallel.mesh import make_mesh, P
+
+EXE = fluid.Executor(fluid.CPUPlace())
+R = np.random.RandomState(11)
+DATA = [R.rand(8, 6).astype("f") for _ in range(8)]
+
+_CACHE = {}
+
+
+def _build():
+    """Adam + dropout trainer (accumulators and the seed cursor are
+    load-bearing), sized so the ZeRO-style auto shardings apply."""
+    if "built" not in _CACHE:
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 21
+        startup.random_seed = 21
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(input=x, size=16, act="tanh")
+            h = fluid.layers.dropout(h, dropout_prob=0.2)
+            p = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                x=fluid.layers.square_error_cost(input=p, label=y))
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        _CACHE["built"] = (main, startup, loss)
+    return _CACHE["built"]
+
+
+def _mesh(n):
+    return make_mesh({"dp": n}, jax.devices()[:n])
+
+
+def _train_and_snapshot(tmp, n_devices, steps=3):
+    """Train `steps` steps on an n-device sharded-weight-update mesh and
+    snapshot; returns (ckpt_dir, reference state dict, seed cursor)."""
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        EXE.run(startup)
+        pexe = fluid.ParallelExecutor(main_program=main,
+                                      mesh=_mesh(n_devices),
+                                      sharded_weight_update=True)
+        for i in range(steps):
+            pexe.run([loss.name], feed={"x": DATA[i],
+                                        "y": DATA[i][:, :1]})
+        d = os.path.join(tmp, "ckpt_n%d" % n_devices)
+        mgr = CheckpointManager(d, async_save=False)
+        mgr.save(steps, program=main, scope=scope,
+                 layout=DeviceLayout(local_device_count=n_devices))
+        mgr.close()
+        state = {n: np.asarray(scope.get(n)).copy()
+                 for n in scope.names()}
+        return d, state, scope.seed_state()
+
+
+def _restored(ckpt_dir, layout, step=3):
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        EXE.run(startup)
+        mgr = CheckpointManager(ckpt_dir, async_save=False)
+        got = mgr.restore(program=main, scope=scope, step=step,
+                          layout=layout)
+        mgr.close()
+        assert got == step
+        return scope
+
+
+@pytest.mark.parametrize("m", [2, 8, 4])
+def test_reshard_n4_to_m(tmp_path, m):
+    """N=4 snapshot restored under M∈{2 (shrink), 8 (grow), 4 (same)}:
+    every persistable bit-identical, placed on the M-device mesh with
+    its recorded spec adapted."""
+    d, want, cursor = _train_and_snapshot(str(tmp_path), 4)
+    layout = DeviceLayout(local_device_count=m)
+    scope = _restored(d, layout)
+    man = load_manifest(list_steps(d)[0][1])
+    sharded = [n for n, e in man.items() if e.get("sharding")]
+    assert sharded, "source snapshot recorded no sharding specs"
+    # accumulators were sharded too (ZeRO layout), not just params
+    assert any(n.startswith("moment") for n in sharded), sharded
+    for n, v in want.items():
+        got = scope.get(n)
+        np.testing.assert_array_equal(v, np.asarray(got),
+                                      err_msg="value %r diverged" % n)
+        assert isinstance(got, jax.Array), n
+    for n in sharded:
+        got = scope.get(n)
+        assert len(got.sharding.device_set) == m, \
+            (n, m, got.sharding)
+    assert scope.seed_state() == cursor
+
+
+def test_reshard_same_shape_bit_exact_vs_plain_restore(tmp_path):
+    """M=N: restore(layout=) and plain restore() land bit-identical
+    values — placement is the ONLY difference."""
+    d, _, _ = _train_and_snapshot(str(tmp_path), 4)
+    main, startup, loss = _build()
+    plain = fluid.Scope()
+    with fluid.scope_guard(plain):
+        EXE.run(startup)
+        CheckpointManager(d, async_save=False).restore(
+            program=main, scope=plain, step=3)
+    layout = _restored(d, DeviceLayout(local_device_count=4))
+    for n in plain.names():
+        np.testing.assert_array_equal(
+            np.asarray(plain.get(n)), np.asarray(layout.get(n)),
+            err_msg="M=N reshard diverged from plain restore at %r" % n)
+
+
+def test_reshard_then_train_matches_small_mesh_reference(tmp_path):
+    """The elasticity contract end to end, in one process: train 3 steps
+    on N=4, snapshot, reshard-restore onto M=2, train 3 more — final
+    state bit-identical to a fresh M=2 run restored from the same
+    snapshot (the 'from-scratch run on the small mesh')."""
+    d, _, _ = _train_and_snapshot(str(tmp_path), 4)
+    main, startup, loss = _build()
+
+    def continue_on_two(scope):
+        with fluid.scope_guard(scope):
+            pexe = fluid.ParallelExecutor(main_program=main,
+                                          mesh=_mesh(2),
+                                          sharded_weight_update=True)
+            out = []
+            for i in range(3, 6):
+                v, = pexe.run([loss.name], feed={"x": DATA[i],
+                                                 "y": DATA[i][:, :1]})
+                out.append(np.asarray(v).copy())
+            return out, {n: np.asarray(scope.get(n)).copy()
+                         for n in scope.names()}
+
+    la = DeviceLayout(local_device_count=2)
+    losses_a, state_a = continue_on_two(_restored(d, la))
+    losses_b, state_b = continue_on_two(_restored(d, la))
+    for a, b in zip(losses_a, losses_b):
+        np.testing.assert_array_equal(a, b)
+    assert set(state_a) == set(state_b)
+    for n in state_a:
+        np.testing.assert_array_equal(state_a[n], state_b[n],
+                                      err_msg=n)
+
+
+def test_reshard_reader_positions_and_seed_roundtrip(tmp_path):
+    """Reader-fed snapshot: restore(layout=) puts reader positions and
+    the seed cursor back exactly like a plain restore does."""
+    root = tmp_path / "data"
+    root.mkdir()
+
+    def gen():
+        r = np.random.RandomState(3)
+        for _ in range(32):
+            xs = r.rand(4, 6).astype("float32")
+            yield xs, xs[:, :1].copy()
+
+    path = str(root / "data.recordio")
+    fluid.recordio_writer.convert_reader_to_recordio_file(path, gen)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        rdr = fluid.layers.open_recordio_file(
+            filename=path, shapes=[[-1, 6], [-1, 1]],
+            lod_levels=[0, 0], dtypes=["float32", "float32"])
+        x, y = fluid.layers.read_file(rdr)
+        h = fluid.layers.fc(input=x, size=8, act="tanh")
+        h = fluid.layers.dropout(h, dropout_prob=0.2)
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    def fresh(consume):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            EXE.run(startup)
+            for _ in range(consume):
+                EXE.run(main, fetch_list=[loss])
+        return scope
+
+    src = fresh(4)
+    d = str(tmp_path / "ckpt")
+    with fluid.scope_guard(src):
+        mgr = CheckpointManager(d, async_save=False)
+        mgr.save(4, program=main, scope=src)
+        mgr.close()
+
+    out = {}
+    for tag, layout in (("plain", None),
+                        ("reshard", DeviceLayout(local_device_count=2))):
+        scope = fresh(0)
+        with fluid.scope_guard(scope):
+            mgr = CheckpointManager(d, async_save=False)
+            assert mgr.restore(program=main, scope=scope, step=4,
+                               layout=layout) == 4
+            # the next records consumed must be the source run's 5th+
+            vals = [np.asarray(EXE.run(main, fetch_list=[loss])[0])
+                    for _ in range(2)]
+            mgr.close()
+        out[tag] = (vals, scope.seed_state())
+    for a, b in zip(out["plain"][0], out["reshard"][0]):
+        np.testing.assert_array_equal(a, b)
+    assert out["plain"][1] == out["reshard"][1]
+
+
+def test_adapt_spec_units():
+    """Spec adaptation: absent axes dropped, non-dividing dims fall
+    back to replicated, compound specs keep the surviving axes."""
+    mesh2 = _mesh(2)
+    # dp survives, divides
+    assert tuple(_adapt_spec(["dp", None], mesh2, (8, 3))) == ("dp", None)
+    # axis absent from the mesh: dropped
+    assert tuple(_adapt_spec(["mp", None], mesh2, (8, 3))) in ((None,),
+                                                               (None, None))
+    # dim not divisible by the new axis size: replicated
+    assert tuple(_adapt_spec(["dp"], mesh2, (7,))) == (None,)
+    # compound entry keeps only live axes
+    got = _adapt_spec([["dp", "mp"]], mesh2, (8,))
+    assert tuple(got) == ("dp",)
+    # no recorded spec -> fully replicated
+    assert tuple(_adapt_spec(None, mesh2, (4, 4))) == ()
+    # round trip through the JSON form
+    assert _spec_to_json(P("dp", None)) == ["dp", None]
+    assert _spec_to_json(P(("dp", "mp"))) == [["dp", "mp"]]
+
+
+def test_restore_layout_rejects_oversized_mesh(tmp_path):
+    """A layout the live process cannot satisfy raises BEFORE anything
+    lands in the scope."""
+    d, _, _ = _train_and_snapshot(str(tmp_path), 2)
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        EXE.run(startup)
+        before = {n: np.asarray(scope.get(n)).copy()
+                  for n in scope.names()}
+        mgr = CheckpointManager(d, async_save=False)
+        with pytest.raises(ValueError, match="local devices"):
+            mgr.restore(program=main, scope=scope, step=3,
+                        layout=DeviceLayout(
+                            local_device_count=len(jax.devices()) + 1))
+        mgr.close()
+        for n, v in before.items():
+            np.testing.assert_array_equal(v, np.asarray(scope.get(n)))

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""ptpu_elastic — launch, kill and replace elastic training workers.
+
+The operational front-end of paddle_tpu.resilience.cluster
+(ARCHITECTURE.md §19): spawns a cohort of worker processes, runs the
+ClusterCoordinator over them (heartbeat monitoring, fence/rollback/
+reshard on host death, grow on replacement join), and optionally
+replaces dead workers so the mesh grows back.
+
+    # 2 workers, built-in demo MLP, kill worker 1 at step 10 via the
+    # fault registry, spawn a replacement once the cohort rescales:
+    python tools/ptpu_elastic.py launch --cluster-dir /tmp/el \
+        --workers 2 --steps 40 --demo --host-devices 4 --total-devices 4 \
+        --fault-worker 1 --fault-plan host_death@10 --replace
+
+    # the same binary is the demo worker entry point (spawned per
+    # worker by `launch --demo`):
+    python tools/ptpu_elastic.py worker --cluster-dir /tmp/el \
+        --worker-id w0 --steps 40
+
+Custom trainers: point --worker-cmd at any script that constructs an
+`ElasticWorker` (see the demo_build in this file for the build_fn
+shape); the launcher hands it PTPU_CLUSTER_DIR / PTPU_WORKER_ID /
+PTPU_ELASTIC_STEPS via env.
+
+Exit codes: 0 = the cohort finished training; 1 = ClusterAborted (the
+merged diagnostic bundle path is printed); 2 = usage error.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+# ------------------------------------------------------------ demo model --
+def demo_build(layout):
+    """The built-in demo trainer: a deterministic feed-fed MLP (Adam +
+    dropout, so the snapshot seed cursor is load-bearing). Batch 8 —
+    divisible across any dp size the demo meshes use."""
+    import numpy as np
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    startup.random_seed = 11
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="tanh")
+        h = fluid.layers.dropout(h, dropout_prob=0.1)
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    rng = np.random.RandomState(5)
+    data = [rng.rand(8, 6).astype("float32") for _ in range(32)]
+
+    def feed_fn(i):
+        xb = data[i % len(data)]
+        return {"x": xb, "y": xb[:, :1].copy()}
+
+    del layout  # the demo trains the same program at every mesh shape
+    return {"main": main, "startup": startup, "loss": loss,
+            "feed_fn": feed_fn}
+
+
+def cmd_worker(args):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.resilience.cluster import ClusterAborted, ElasticWorker
+    worker = ElasticWorker(
+        args.cluster_dir, args.worker_id, demo_build,
+        checkpoint_every=args.checkpoint_every,
+        watchdog_timeout=args.watchdog_timeout,
+        sharded_weight_update=args.sharded_weight_update,
+        step_delay=args.step_delay)
+    try:
+        out = worker.run(args.steps)
+    except ClusterAborted as e:
+        print("worker %s: %s" % (args.worker_id, e), file=sys.stderr)
+        return 1
+    print("worker %s finished: %s" % (args.worker_id, out))
+    return 0
+
+
+# -------------------------------------------------------------- launcher --
+class _WorkerPool(object):
+    """Child-process bookkeeping: spawn, kill, replace."""
+
+    def __init__(self, args):
+        self.args = args
+        self.procs = {}   # worker_id -> Popen
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def _worker_env(self, worker_id, with_fault):
+        env = dict(os.environ)
+        env["PTPU_CLUSTER_DIR"] = self.args.cluster_dir
+        env["PTPU_WORKER_ID"] = worker_id
+        env["PTPU_ELASTIC_STEPS"] = str(self.args.steps)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        if self.args.host_devices:
+            env["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=%d"
+                % self.args.host_devices)
+        if with_fault and self.args.fault_plan:
+            env["PTPU_FAULT_PLAN"] = self.args.fault_plan
+        else:
+            env.pop("PTPU_FAULT_PLAN", None)
+        return env
+
+    def spawn(self, with_fault=False):
+        with self._lock:
+            worker_id = "w%d" % self._next
+            self._next += 1
+        if self.args.worker_cmd:
+            cmd = self.args.worker_cmd.split() + [
+                "--cluster-dir", self.args.cluster_dir,
+                "--worker-id", worker_id, "--steps", str(self.args.steps)]
+        else:
+            cmd = [sys.executable, os.path.abspath(__file__), "worker",
+                   "--cluster-dir", self.args.cluster_dir,
+                   "--worker-id", worker_id,
+                   "--steps", str(self.args.steps),
+                   "--checkpoint-every", str(self.args.checkpoint_every)]
+            if self.args.watchdog_timeout:
+                cmd += ["--watchdog-timeout",
+                        str(self.args.watchdog_timeout)]
+            if self.args.sharded_weight_update:
+                cmd += ["--sharded-weight-update"]
+            if self.args.step_delay:
+                cmd += ["--step-delay", str(self.args.step_delay)]
+        proc = subprocess.Popen(cmd,
+                                env=self._worker_env(worker_id,
+                                                     with_fault))
+        self.procs[worker_id] = proc
+        # reap immediately on exit: a SIGKILL'd worker must not linger
+        # as a zombie pid the heartbeat monitor reads as alive
+        threading.Thread(target=proc.wait, daemon=True).start()
+        print("[ptpu_elastic] spawned %s (pid %d%s)"
+              % (worker_id, proc.pid,
+                 ", fault plan armed" if with_fault
+                 and self.args.fault_plan else ""))
+        return worker_id
+
+    def kill_all(self):
+        for wid, p in self.procs.items():
+            if p.poll() is None:
+                p.kill()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — shutdown best-effort
+                pass
+
+
+def cmd_launch(args):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.resilience.cluster import (ClusterAborted,
+                                               ClusterCoordinator)
+    os.makedirs(args.cluster_dir, exist_ok=True)
+    pool = _WorkerPool(args)
+    replaced = {"n": 0}
+
+    def on_event(ev):
+        # the "replace a dead host" operator action, automated: once the
+        # cohort has rescaled around a death, spawn a fresh worker — the
+        # coordinator grows the mesh back at a step barrier
+        if args.replace and ev.get("event") == "rescale" \
+                and replaced["n"] < args.max_replacements:
+            replaced["n"] += 1
+            pool.spawn(with_fault=False)
+
+    coord = ClusterCoordinator(
+        args.cluster_dir, num_workers=args.workers,
+        heartbeat_timeout=args.heartbeat_timeout,
+        total_device_count=args.total_devices,
+        local_device_count=args.local_devices,
+        max_rescales=args.max_rescales,
+        on_event=on_event)
+    for i in range(args.workers):
+        pool.spawn(with_fault=(i == args.fault_worker))
+    try:
+        summary = coord.run(deadline=args.deadline)
+    except ClusterAborted as e:
+        print("[ptpu_elastic] ABORTED: %s" % e, file=sys.stderr)
+        if e.bundle:
+            print("[ptpu_elastic] merged bundle: %s" % e.bundle,
+                  file=sys.stderr)
+        return 1
+    finally:
+        pool.kill_all()
+    print("[ptpu_elastic] done: %s" % json.dumps(
+        {"gen": summary["gen"], "steps": summary["steps"],
+         "rescales": coord.rescales,
+         "events": [e["event"] for e in summary["events"]]}))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="ptpu_elastic",
+                                 description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd")
+
+    lp = sub.add_parser("launch", help="spawn a cohort + coordinator")
+    lp.add_argument("--cluster-dir", required=True)
+    lp.add_argument("--workers", type=int, default=2)
+    lp.add_argument("--steps", type=int, default=40)
+    lp.add_argument("--demo", action="store_true",
+                    help="use the built-in demo MLP worker (default "
+                         "when --worker-cmd is not given)")
+    lp.add_argument("--worker-cmd", default=None,
+                    help="custom worker command (gets --cluster-dir/"
+                         "--worker-id/--steps appended)")
+    lp.add_argument("--host-devices", type=int, default=None,
+                    help="XLA virtual CPU devices per worker process")
+    lp.add_argument("--total-devices", type=int, default=None,
+                    help="fixed cluster chip budget re-split across the "
+                         "live cohort (shrink => each survivor's mesh "
+                         "grows)")
+    lp.add_argument("--local-devices", type=int, default=None,
+                    help="fixed local mesh size per worker")
+    lp.add_argument("--checkpoint-every", type=int, default=4)
+    lp.add_argument("--watchdog-timeout", type=float, default=None)
+    lp.add_argument("--sharded-weight-update", action="store_true")
+    lp.add_argument("--step-delay", type=float, default=0.0,
+                    help="demo-worker pacing: sleep per step (gives a "
+                         "replacement worker time to join mid-run)")
+    lp.add_argument("--heartbeat-timeout", type=float, default=3.0)
+    lp.add_argument("--max-rescales", type=int, default=8)
+    lp.add_argument("--fault-plan", default=None,
+                    help="PTPU_FAULT_PLAN spec armed in ONE worker "
+                         "(e.g. host_death@10)")
+    lp.add_argument("--fault-worker", type=int, default=-1,
+                    help="index of the worker that gets --fault-plan")
+    lp.add_argument("--replace", action="store_true",
+                    help="spawn a replacement worker after each rescale")
+    lp.add_argument("--max-replacements", type=int, default=1)
+    lp.add_argument("--deadline", type=float, default=None,
+                    help="abort the whole run after this many seconds")
+    lp.set_defaults(fn=cmd_launch)
+
+    wp = sub.add_parser("worker", help="built-in demo worker")
+    wp.add_argument("--cluster-dir",
+                    default=os.environ.get("PTPU_CLUSTER_DIR"))
+    wp.add_argument("--worker-id",
+                    default=os.environ.get("PTPU_WORKER_ID"))
+    wp.add_argument("--steps", type=int,
+                    default=int(os.environ.get("PTPU_ELASTIC_STEPS",
+                                               "40")))
+    wp.add_argument("--checkpoint-every", type=int, default=4)
+    wp.add_argument("--watchdog-timeout", type=float, default=None)
+    wp.add_argument("--sharded-weight-update", action="store_true")
+    wp.add_argument("--step-delay", type=float, default=0.0)
+    wp.set_defaults(fn=cmd_worker)
+
+    args = ap.parse_args(argv)
+    if args.cmd is None:
+        ap.print_help()
+        return 2
+    if args.cmd == "worker" and (not args.cluster_dir
+                                 or not args.worker_id):
+        ap.error("worker needs --cluster-dir and --worker-id "
+                 "(or PTPU_CLUSTER_DIR / PTPU_WORKER_ID)")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
