@@ -24,6 +24,10 @@ def _error_line(msg):
         return {"metric": "serving_throughput", "value": 0.0,
                 "unit": "requests/sec/chip", "vs_baseline": None,
                 "error": msg}
+    if os.environ.get("BENCH_POOL") == "1":
+        return {"metric": "serving_pool_throughput", "value": 0.0,
+                "unit": "requests/sec/chip", "vs_baseline": None,
+                "error": msg}
     if os.environ.get("BENCH_CKPT") == "1":
         return {"metric": "ckpt_async_steps_per_sec", "value": 0.0,
                 "unit": "steps/sec", "vs_baseline": None, "error": msg}
@@ -514,6 +518,162 @@ def bench_serving():
         "device": str(jax.devices()[0])}))
 
 
+def bench_pool():
+    """BENCH_POOL=1: the serving-HA leg (serving/pool.ReplicaPool).
+    Saves the deep-and-narrow serving MLP once, then for each replica
+    count in BENCH_POOL_REPLICAS (default "1,2,4") drives the SAME
+    open-loop arrival schedule through a pool and injects the two
+    events the subsystem exists to survive:
+
+      * mid-run replica kill (at 1/3 of the schedule, pools with >1
+        replica): a hard `kill_replica` while requests are queued on
+        the victim — traffic must redistribute with zero client-visible
+        errors,
+      * mid-run weight reload (at 2/3): `pool.reload(model_dir)` swaps
+        a freshly warmed engine into every replica under load — zero
+        dropped requests.
+
+    One JSON line: per-leg qps, p50/p99 client latency, error counts
+    (the acceptance number is 0), retries/timeouts, and whether the
+    kill/reload fired. Latency = submit -> materialized on the client
+    thread (failovers included), the same loud-honesty rule as
+    bench_serving. Knobs: BENCH_POOL_REQUESTS, BENCH_POOL_REPLICAS,
+    BENCH_POOL_ARRIVAL_QPS (default 1.5x the measured serial qps),
+    BENCH_POOL_MAX_BATCH, BENCH_SERVING_LAYERS/HIDDEN/FEATURES."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import serving
+
+    n_requests = int(os.environ.get("BENCH_POOL_REQUESTS", "240"))
+    replica_counts = [int(r) for r in os.environ.get(
+        "BENCH_POOL_REPLICAS", "1,2,4").split(",") if r.strip()]
+    max_batch = int(os.environ.get("BENCH_POOL_MAX_BATCH", "8"))
+    max_delay = float(os.environ.get("BENCH_POOL_MAX_DELAY_MS", "5"))
+    feat = int(os.environ.get("BENCH_SERVING_FEATURES", "64"))
+    hidden = int(os.environ.get("BENCH_SERVING_HIDDEN", "64"))
+    n_layers = int(os.environ.get("BENCH_SERVING_LAYERS", "10"))
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main_prog,
+                                                        startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        h = x
+        for _ in range(n_layers):
+            h = fluid.layers.fc(input=h, size=hidden, act="relu")
+        pred = fluid.layers.fc(input=h, size=10, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace())
+    model_dir = tempfile.mkdtemp(prefix="ptpu_bench_pool_")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe,
+                                      main_prog)
+
+    rng = np.random.RandomState(0)
+    inputs = [rng.rand(1, feat).astype("float32")
+              for _ in range(n_requests)]
+
+    # serial baseline (sets the open-loop arrival rate)
+    probe = serving.InferenceEngine(model_dir, place=fluid.TPUPlace(),
+                                    name="pool-probe",
+                                    max_batch_size=max_batch,
+                                    max_queue_delay_ms=max_delay)
+    t0 = time.perf_counter()
+    n_serial = min(48, n_requests)
+    for i in range(n_serial):
+        probe.run_direct({"x": inputs[i]}, batch_bucket=1)
+    serial_qps = n_serial / (time.perf_counter() - t0)
+    probe.close()
+    rate = float(os.environ.get("BENCH_POOL_ARRIVAL_QPS", "0")) \
+        or 1.5 * serial_qps
+
+    legs = {}
+    for n_rep in replica_counts:
+        pool = serving.ReplicaPool(
+            model_dir, replicas=n_rep, name="bench-pool",
+            max_batch_size=max_batch, max_queue_delay_ms=max_delay,
+            queue_capacity=max(1024, n_requests),
+            attempt_timeout_s=30.0, retries=3)
+        kill_at = n_requests // 3 if n_rep > 1 else None
+        reload_at = (2 * n_requests) // 3
+        events, futures, submit_at = [], [], []
+        errors, latencies, lat_lock = [], [], threading.Lock()
+
+        def finish(i, fut, ts):
+            try:
+                fut.result(120).numpy()
+                with lat_lock:
+                    latencies.append(time.perf_counter() - ts)
+            except Exception as e:  # noqa: BLE001 — the error COUNT is
+                with lat_lock:      # the leg's acceptance number
+                    errors.append("req %d: %r" % (i, e))
+
+        waiters = []
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            delay = t0 + i / rate - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            if kill_at is not None and i == kill_at:
+                pool.kill_replica(n_rep - 1)
+                events.append("kill@%d" % i)
+            if i == reload_at:
+                # reload the SAME weights, CONCURRENTLY with the arrival
+                # stream: the event under test is the swap-under-load,
+                # and bit-identical weights keep every response
+                # comparable. The thread is joined before the leg ends
+                # so its completion is part of the measured wall.
+                reload_t = threading.Thread(
+                    target=pool.reload, kwargs={"model_dir": model_dir})
+                reload_t.start()
+                waiters.append(reload_t)
+                events.append("reload@%d" % i)
+            ts = time.perf_counter()
+            try:
+                fut = pool.submit({"x": inputs[i]})
+            except Exception as e:  # noqa: BLE001
+                with lat_lock:
+                    errors.append("submit %d: %r" % (i, e))
+                continue
+            w = threading.Thread(target=finish, args=(i, fut, ts))
+            w.start()
+            waiters.append(w)
+        for w in waiters:
+            w.join()
+        wall = time.perf_counter() - t0
+        snap = pool.metrics.snapshot()
+        pool.close()
+        legs[str(n_rep)] = {
+            "qps": round(len(latencies) / wall, 1),
+            "p50_ms": _lat_ms(latencies, 0.50),
+            "p99_ms": _lat_ms(latencies, 0.99),
+            "errors": len(errors),
+            "error_samples": errors[:3],
+            "completed": len(latencies),
+            "retries": snap["retries_total"],
+            "attempt_timeouts": snap["attempt_timeouts_total"],
+            "events": events,
+        }
+
+    shutil.rmtree(model_dir, ignore_errors=True)
+    headline = legs[str(replica_counts[-1])]
+    print(json.dumps({
+        "metric": "serving_pool_throughput",
+        "value": headline["qps"],
+        "unit": "requests/sec/chip",
+        "vs_baseline": None,
+        "serial_qps": round(serial_qps, 1),
+        "arrival_qps": round(rate, 1),
+        "requests": n_requests, "max_batch": max_batch,
+        "layers": n_layers, "hidden": hidden,
+        "legs": legs,
+        "total_errors": sum(l["errors"] for l in legs.values()),
+        "device": str(jax.devices()[0])}))
+
+
 # fwd FLOPs per 224x224 image (2x the usual MACs figure — VGG16's famous
 # "15.5G" is MACs, so fwd = 31e9); models build_train supports but this
 # table lacks still bench (mfu reported null)
@@ -983,6 +1143,9 @@ def main():
         os._exit(3)
     if os.environ.get("BENCH_SERVING") == "1":
         bench_serving()
+        return
+    if os.environ.get("BENCH_POOL") == "1":
+        bench_pool()
         return
     if os.environ.get("BENCH_CKPT") == "1":
         bench_ckpt()

@@ -33,6 +33,20 @@ Arming a plan installs hooks at three seams:
     Nth durability crossing of the write protocol, subsuming PR-4's
     `PTPU_CKPT_FAULT_AT` (which keeps working unchanged) under this
     registry.
+  * `serving_fault` — the SERVING seam: `serving.pool.ReplicaPool`'s
+    pre-dispatch tap consults the armed plan before every replica
+    dispatch, keyed on that REPLICA's own dispatch count (deterministic
+    per replica regardless of routing): `replica_exc@N` raises
+    InjectedReplicaError inside the Nth dispatch (the batcher's group
+    isolation fails only that batch; the pool must fail the requests
+    over), `replica_wedge@N[:secs]` sleeps the replica's batcher worker
+    `secs` seconds (default: effectively forever) — the wedged-engine
+    case only per-attempt timeouts can detect — and `replica_poison@N`
+    NaNs every float value in the replica's private Scope, the
+    crashed-trainer-pushed-garbage-weights case the pool's finite-output
+    check must catch. One-shot entries fire on the FIRST replica to
+    reach count N; the recovery invariant (zero client-visible errors)
+    must hold whichever replica that is.
 
 Entries are ONE-SHOT by default (`kind@idx`); `kind@idx*` repeats every
 time the index matches. One plan may be armed per process at a time.
@@ -43,12 +57,13 @@ import threading
 import numpy as np
 
 __all__ = ["FaultPlan", "InjectedFault", "InjectedDispatchError",
-           "InjectedReaderError", "active_plan"]
+           "InjectedReaderError", "InjectedReplicaError", "active_plan"]
 
 _KINDS = frozenset({
     "nan_feed", "dispatch_exc", "slow_step",
     "reader_nan", "reader_exc", "reader_stall", "reader_eof",
     "ckpt_kill", "host_death", "heartbeat_stall",
+    "replica_exc", "replica_wedge", "replica_poison",
 })
 _READER_KINDS = frozenset({"reader_nan", "reader_exc", "reader_stall",
                            "reader_eof"})
@@ -67,6 +82,13 @@ class InjectedReaderError(InjectedFault):
     """Injected reader failure (fault kind `reader_exc`); tagged
     reader-class for the supervisor's fault classifier."""
     _reader_fault = True
+
+
+class InjectedReplicaError(InjectedFault):
+    """Injected serving-replica dispatch failure (fault kind
+    `replica_exc`); tagged replica-class so the pool's failover logic
+    and tests can tell an injected replica fault from an organic one."""
+    _replica_fault = True
 
 
 class _Entry(object):
@@ -280,6 +302,29 @@ class FaultPlan(object):
             poisoned.append(a)
         return tuple(poisoned)
 
+    def serving_fault(self, replica_id, dispatch_count, engine=None):
+        """Serving seam: called by ReplicaPool's pre-dispatch tap with
+        the dispatching replica's id and ITS OWN dispatch count (the
+        key). Unlike the executor/reader seams this one is pulled
+        (`active_plan()` at the tap) rather than pushed at arm() — the
+        pool may not exist when a training-only plan arms, and arming
+        must not import the serving stack."""
+        e = self._take(("replica_wedge",), dispatch_count)
+        if e is not None:
+            import time
+            # sleeps ON the replica's batcher worker: every request
+            # queued behind this dispatch stalls — only the pool's
+            # per-attempt timeout can see it, exactly like a real wedge
+            time.sleep(e.arg if e.arg is not None else 3600.0)
+        e = self._take(("replica_poison",), dispatch_count)
+        if e is not None and engine is not None:
+            _poison_scope_floats(engine._scope)
+        e = self._take(("replica_exc",), dispatch_count)
+        if e is not None:
+            raise InjectedReplicaError(
+                "injected replica failure on replica %s at dispatch %d "
+                "(fault plan)" % (replica_id, dispatch_count))
+
     def _ckpt_hook(self):
         n = self._ckpt_crossings
         self._ckpt_crossings = n + 1
@@ -287,6 +332,24 @@ class FaultPlan(object):
         if e is not None:
             import signal
             os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _poison_scope_floats(scope):
+    """NaN the first element of EVERY float array in a Scope — the
+    `replica_poison` payload. Poisoning every float persistable (not
+    just the first) makes the corruption reach the outputs of any model
+    topology: one NaN weight element propagates through its matmul
+    column, and softmax/normalizing heads spread it across the row."""
+    for name in sorted(scope.names()):
+        v = scope.get(name)
+        if v is None:
+            continue
+        a = np.asarray(v)
+        if not np.issubdtype(a.dtype, np.floating) or a.size == 0:
+            continue
+        a = np.array(a, copy=True)
+        a.reshape(-1)[0] = np.nan
+        scope.set(name, a)
 
 
 def _poison_first_float(feed_arrays):

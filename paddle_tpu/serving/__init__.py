@@ -16,14 +16,19 @@ dispatch + warmup), `batcher.Batcher` (dynamic micro-batching with
 deadlines, bounded-queue backpressure, graceful drain),
 `server.ModelServer` (stdlib threaded HTTP JSON frontend),
 `metrics.ServingMetrics` (QPS/latency/occupancy, Prometheus + profiler
-integration). CLI: `tools/ptpu_serve.py`. Design notes:
-ARCHITECTURE.md §15.
+integration), `pool.ReplicaPool` (N replicas behind one endpoint:
+health-gated least-loaded routing, circuit breakers, failover retry +
+tail hedging, adaptive admission, zero-downtime weight reload). CLI:
+`tools/ptpu_serve.py` (`--replicas N`, `--selfcheck --kill-replica`).
+Design notes: ARCHITECTURE.md §15 (engine/batcher), §20 (the pool).
 """
 from .batcher import (Batcher, DeadlineExceededError, QueueFullError,
                       RequestFuture, RequestTooLargeError, ServingClosedError,
                       ServingError)
 from .engine import InferenceEngine, InvalidRequestError, ResultSlice
 from .metrics import ServingMetrics
+from .pool import (AttemptTimeoutError, PoisonedOutputError, PoolFuture,
+                   PoolMetrics, PoolResult, ReplicaPool)
 from .server import ModelServer
 
 __all__ = [
@@ -31,4 +36,6 @@ __all__ = [
     "RequestFuture", "ResultSlice", "ServingError", "QueueFullError",
     "DeadlineExceededError", "ServingClosedError", "RequestTooLargeError",
     "InvalidRequestError",
+    "ReplicaPool", "PoolFuture", "PoolResult", "PoolMetrics",
+    "AttemptTimeoutError", "PoisonedOutputError",
 ]

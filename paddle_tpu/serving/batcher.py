@@ -57,25 +57,50 @@ class RequestFuture(object):
     row-sliced lazily — `result()` triggers only this request's D2H.
     """
 
-    __slots__ = ("_event", "_value", "_error", "latency_s", "bucket")
+    __slots__ = ("_event", "_value", "_error", "_callbacks", "_cb_lock",
+                 "latency_s", "bucket")
 
     def __init__(self):
         self._event = threading.Event()
         self._value = None
         self._error = None
+        self._callbacks = []
+        self._cb_lock = threading.Lock()
         self.latency_s = None   # submit -> scatter, set by the worker
         self.bucket = None      # (batch_bucket, seq_bucket|None) dispatched
 
     def done(self):
         return self._event.is_set()
 
+    def add_done_callback(self, fn):
+        """Run fn(self) once the future completes — immediately (on the
+        calling thread) if it already has, otherwise on the completing
+        thread (the batcher worker). The ReplicaPool rides this for
+        health accounting and failover wakeups; callbacks must be cheap
+        and must not block (they run inside the dispatch loop)."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _fire_callbacks(self):
+        self._event.set()
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — an observer must never
+                pass           # fail the dispatch loop that notified it
+
     def set_result(self, value):
         self._value = value
-        self._event.set()
+        self._fire_callbacks()
 
     def set_exception(self, exc):
         self._error = exc
-        self._event.set()
+        self._fire_callbacks()
 
     def result(self, timeout=None):
         if not self._event.wait(timeout):
@@ -123,6 +148,8 @@ class Batcher(object):
         self._cond = threading.Condition(self._lock)
         self._closed = False
         self._draining = False
+        self._drainers = 0       # live drain() calls: worker skips the
+        self._dispatching = False  # coalescing window while any waits
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="ptpu-" + name)
         if metrics is not None:
@@ -182,7 +209,7 @@ class Batcher(object):
             # (waiting the full window would 504 every such request under
             # light load).
             leave_at = self._queue[0].enqueued_at + self.max_queue_delay_s
-            while not (self._closed or self._draining):
+            while not (self._closed or self._draining or self._drainers):
                 if self._pending_rows >= self.max_batch_size \
                         or leave_at <= time.monotonic():
                     break  # O(1) fast paths BEFORE any deadline scan
@@ -206,6 +233,11 @@ class Batcher(object):
                     break
                 batch.append(self._pop_head())
                 rows += req.rows
+            # mark the worker busy while STILL holding the lock: between
+            # popping a batch and scattering its results the queue may be
+            # empty, and a drain() that declared victory in that window
+            # would return with requests mid-dispatch
+            self._dispatching = bool(batch)
             return batch, expired
 
     def _pop_head(self):
@@ -229,6 +261,13 @@ class Batcher(object):
             if expired and self._metrics is not None:
                 self._metrics.on_deadline_expired(len(expired))
             if not batch:
+                if expired:
+                    # an expired-only collection may have just emptied
+                    # the queue: a drain() waiter parked on the
+                    # condition would otherwise never be woken (the
+                    # dispatch path's finally-notify is skipped here)
+                    with self._cond:
+                        self._cond.notify_all()
                 continue
             try:
                 self._dispatch(batch)
@@ -238,21 +277,60 @@ class Batcher(object):
                         req.future.set_exception(e)
                 if self._metrics is not None:
                     self._metrics.on_error(len(batch))
+            finally:
+                with self._cond:
+                    self._dispatching = False
+                    self._cond.notify_all()   # wake drain() waiters
+
+    # ----------------------------------------------------------- drain --
+    def drain(self, timeout=None):
+        """Block until everything queued or mid-dispatch has been
+        scattered (results set on every future). Intake stays open —
+        this is the ONE drain implementation: `close(drain=True)` calls
+        it after stopping intake, and the ReplicaPool's engine swap
+        calls it directly on the outgoing engine (new submissions
+        already route to the fresh engine, so the wait converges).
+        While a drain is waiting the worker skips the coalescing window
+        — queued work leaves in max_batch_size chunks immediately.
+        Returns True when drained, False on timeout."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cond:
+            self._drainers += 1
+            self._cond.notify_all()        # cut the coalescing wait short
+            try:
+                while self._queue or self._dispatching:
+                    if not self._worker.is_alive() and not self._queue:
+                        return True        # worker exited post-dispatch
+                    remaining = None
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return False
+                    self._cond.wait(timeout=remaining)
+                return True
+            finally:
+                self._drainers -= 1
 
     # -------------------------------------------------------- shutdown --
     def close(self, drain=True, timeout=None):
         """Stop intake; with drain=True the worker finishes every queued
-        request first (in max_batch_size chunks, no further coalescing
-        delay), otherwise pending requests fail with ServingClosedError."""
+        request first (via the shared `drain()` implementation — no
+        further coalescing delay), otherwise pending requests fail with
+        ServingClosedError."""
         with self._cond:
-            if self._closed:
-                return
+            already = self._closed
             self._closed = True
-            self._draining = drain
-            if not drain:
+            if drain and not already:
+                self._draining = True
+            if not drain and not already:
                 while self._queue:
                     self._pop_head().future.set_exception(
                         ServingClosedError("serving engine shut down "
                                            "before dispatch"))
             self._cond.notify_all()
+        if already:
+            return
+        if drain:
+            self.drain(timeout)
         self._worker.join(timeout)

@@ -544,8 +544,17 @@ class InferenceEngine(object):
                         r.future.set_exception(e)
                 self.metrics.on_error(len(reqs))
 
+    # pre-dispatch tap: the ReplicaPool points this at its per-replica
+    # fault/bookkeeping hook (dispatch counting, injected replica faults).
+    # Raising here fails only this group — the batcher's group isolation
+    # turns it into per-request exceptions the pool can fail over.
+    _replica_tap = None
+
     def _dispatch_group(self, requests):
         """Pad one shape-compatible group -> one run -> scatter."""
+        tap = self._replica_tap
+        if tap is not None:
+            tap()
         t0 = time.monotonic()
         normalized = [req.feed for req in requests]  # pre-normalized
         rows = sum(r.rows for r in normalized)
@@ -580,7 +589,15 @@ class InferenceEngine(object):
         HERE, on the caller's thread — a malformed request fails fast and
         never costs the batcher loop anything. Oversized requests are the
         batcher's check (RequestTooLargeError at its submit)."""
-        norm = self.normalize_feed(feed)
+        return self.submit_normalized(self.normalize_feed(feed),
+                                      deadline_ms=deadline_ms)
+
+    def submit_normalized(self, norm, deadline_ms=None):
+        """Enqueue an already-normalized request (a `normalize_feed`
+        result). The ReplicaPool normalizes once on the caller's thread
+        and resubmits the SAME normalized request to a different replica
+        on failover — every engine of a pool serves one program, so the
+        contract check never needs repeating."""
         if self._seq_feeds:     # reject unservable lengths before queueing
             _covering_bucket(self.seq_buckets, max(norm.max_seq_len, 1),
                              "sequence length")
@@ -685,6 +702,15 @@ class InferenceEngine(object):
             "status": "closed" if self.closed else "serving",
             "metrics": self.metrics.snapshot(),
         }
+
+    def drain(self, timeout=None):
+        """Complete everything queued/mid-dispatch WITHOUT closing — the
+        batcher's shared drain implementation, the same one
+        close(drain=True) runs. The pool's zero-downtime engine swap
+        rides it (via close) on the outgoing engine after the atomic
+        pointer flip: requests accepted before the flip finish against
+        the weights they were accepted under, with nothing dropped."""
+        return self._batcher.drain(timeout)
 
     def close(self, drain=True, timeout=None):
         """Graceful shutdown: stop intake, drain queued requests (every

@@ -1,0 +1,995 @@
+"""ReplicaPool: N InferenceEngine replicas behind one submit surface.
+
+The high-availability layer ROADMAP item 3 asks for: one wedged or
+poisoned engine must never take every request down with it, and
+promoting a new checkpoint must never drop a request. The TensorFlow
+system paper's stance (replica-level fault tolerance is RUNTIME design,
+not deployment glue) applied to this repo's serving stack:
+
+  * N `InferenceEngine` replicas, each with its own private Scope and
+    batcher, placed round-robin over the visible devices. One program,
+    one weight set — at a fixed bucket shape every replica produces
+    BIT-IDENTICAL rows, so routing (and failover) is invisible in the
+    results.
+  * least-loaded routing over the replicas the health machine calls
+    routable, with a per-replica state machine
+
+        healthy -> degraded -> ejected -> (cooldown probe) -> healthy
+
+    driven by rolling error-rate and latency circuit breakers plus a
+    consecutive-failure fast path. Ejected replicas take no traffic
+    until their cooldown passes; then ONE live request probes them
+    (half-open breaker) — success readmits as degraded, failure re-arms
+    the cooldown.
+  * bounded retry-with-backoff onto a DIFFERENT replica for retryable
+    failures (dispatch errors, a replica closing mid-swap, non-finite
+    outputs from poisoned weights, per-attempt timeouts — the only
+    signal a silently wedged replica emits), plus optional tail hedging
+    (`hedge_delay_ms`): after the delay, a duplicate attempt races on
+    another replica and the first completion wins.
+  * adaptive admission control: an AIMD limit on pool-wide in-flight
+    attempts shrinks multiplicatively on overload signals (every queue
+    full, attempt timeouts) and recovers additively on successes, so
+    overload degrades to fast 429s instead of collapsing latency for
+    everyone.
+  * zero-downtime weight reload: `pool.reload()` warms a FRESH engine
+    per replica off the newest valid snapshot (an AOT-cache hit, PR 6)
+    or re-read model dir, atomically swaps the engine pointer under the
+    replica's submit lock, then drains the outgoing engine with the
+    batcher's shared drain — every accepted request completes against
+    the weights it was accepted under; every request after the flip
+    sees the new ones. A training job promotes snapshots into serving
+    with zero dropped requests.
+
+Fault injection: the pre-dispatch tap consults the armed
+`resilience.faults.FaultPlan` (`replica_exc@N` / `replica_wedge@N[:s]` /
+`replica_poison@N`, keyed on the replica's own dispatch count), so every
+failover path above is provable in CI. Design notes: ARCHITECTURE.md §20.
+"""
+import collections
+import os
+import threading
+import time
+
+import numpy as np
+
+from .batcher import (DeadlineExceededError, QueueFullError,
+                      RequestTooLargeError, ServingClosedError,
+                      ServingError)
+from .engine import InferenceEngine, InvalidRequestError
+
+__all__ = ["ReplicaPool", "PoolFuture", "PoolResult", "PoolMetrics",
+           "AttemptTimeoutError", "PoisonedOutputError",
+           "HEALTHY", "DEGRADED", "EJECTED"]
+
+HEALTHY, DEGRADED, EJECTED = "healthy", "degraded", "ejected"
+_STATE_GAUGE = {HEALTHY: 0, DEGRADED: 1, EJECTED: 2}
+
+
+class AttemptTimeoutError(ServingError):
+    """One replica attempt exceeded `attempt_timeout_s` — the replica is
+    presumed wedged; the request fails over. Never client-visible unless
+    every retry also fails."""
+
+
+class PoisonedOutputError(ServingError):
+    """A replica returned non-finite values (`check_finite=True`):
+    treated as a replica failure — retried elsewhere, counted against
+    the replica's breaker — never returned to the client as a 200."""
+
+
+def _retryable(exc):
+    """Failures that are the REPLICA's fault (or transient) retry on a
+    different replica; failures that are the request's own fault (bad
+    feed, too large, deadline passed) never do — retrying them would
+    burn capacity reproducing a 4xx."""
+    if isinstance(exc, (InvalidRequestError, RequestTooLargeError,
+                        DeadlineExceededError)):
+        return False
+    return True
+
+
+class PoolResult(object):
+    """A materialized pool response (`check_finite` pools validate the
+    arrays before handing them over, so the lazy slice is already paid
+    for). Duck-types ResultSlice.numpy()."""
+
+    __slots__ = ("_outputs", "bucket")
+
+    def __init__(self, outputs, bucket):
+        self._outputs = outputs
+        self.bucket = bucket
+
+    def numpy(self):
+        return self._outputs
+
+
+class PoolMetrics(object):
+    """Pool-level counters + a bounded client-latency window (submit ->
+    terminal). Per-replica QPS/occupancy/queue metrics stay on each
+    replica engine's own ServingMetrics — /metrics labels them
+    {model, replica}."""
+
+    def __init__(self, latency_window=2048):
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.responses_total = 0
+        self.errors_total = 0            # client-visible failures
+        self.retries_total = 0           # failover resubmissions
+        self.hedges_total = 0            # tail-hedge duplicates fired
+        self.rejected_queue_full = 0     # admission + all-queues-full 429s
+        self.attempt_timeouts_total = 0  # wedge detections
+        self.poisoned_results_total = 0  # non-finite outputs caught
+        self.reloads_total = 0
+        self.replica_kills_total = 0
+        self.ejections_total = 0
+        self._latencies = collections.deque(maxlen=latency_window)
+
+    def _bump(self, field, n=1):
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def on_submit(self):
+        self._bump("requests_total")
+
+    def on_success(self, latency_s):
+        with self._lock:
+            self.responses_total += 1
+            if latency_s is not None:
+                self._latencies.append(latency_s)
+
+    def on_error(self):
+        self._bump("errors_total")
+
+    def on_retry(self):
+        self._bump("retries_total")
+
+    def on_hedge(self):
+        self._bump("hedges_total")
+
+    def on_queue_full(self):
+        self._bump("rejected_queue_full")
+
+    def on_attempt_timeout(self):
+        self._bump("attempt_timeouts_total")
+
+    def on_poisoned(self):
+        self._bump("poisoned_results_total")
+
+    def on_reload(self):
+        self._bump("reloads_total")
+
+    def on_kill(self):
+        self._bump("replica_kills_total")
+
+    def on_eject(self):
+        self._bump("ejections_total")
+
+    def snapshot(self):
+        from .metrics import _percentile
+        with self._lock:
+            lat = sorted(self._latencies)
+            return {
+                "requests_total": self.requests_total,
+                "responses_total": self.responses_total,
+                "errors_total": self.errors_total,
+                "retries_total": self.retries_total,
+                "hedges_total": self.hedges_total,
+                "rejected_queue_full": self.rejected_queue_full,
+                "attempt_timeouts_total": self.attempt_timeouts_total,
+                "poisoned_results_total": self.poisoned_results_total,
+                "reloads_total": self.reloads_total,
+                "replica_kills_total": self.replica_kills_total,
+                "ejections_total": self.ejections_total,
+                "latency_ms": {
+                    "p50": round(_percentile(lat, 0.50) * 1e3, 3),
+                    "p95": round(_percentile(lat, 0.95) * 1e3, 3),
+                    "p99": round(_percentile(lat, 0.99) * 1e3, 3),
+                    "window": len(lat),
+                },
+            }
+
+
+class _Admission(object):
+    """AIMD concurrency limit over pool-wide in-flight attempts. Starts
+    wide open (the sum of replica queue capacities); every overload
+    signal multiplies it down, every success creeps it back up (+1 per
+    `limit` successes). The floor keeps one slot per replica so the pool
+    can always probe its way out of a shrunken limit."""
+
+    def __init__(self, hi, lo, decrease=0.85):
+        self._lock = threading.Lock()
+        self.hi = float(max(hi, lo))
+        self.lo = float(max(lo, 1))
+        self.limit = self.hi
+        self._decrease = decrease
+
+    def allow(self, inflight):
+        with self._lock:
+            return inflight < self.limit
+
+    def on_success(self):
+        with self._lock:
+            self.limit = min(self.hi, self.limit + 1.0 / max(self.limit, 1))
+
+    def on_overload(self):
+        with self._lock:
+            self.limit = max(self.lo, self.limit * self._decrease)
+
+
+class _Replica(object):
+    __slots__ = ("idx", "engine", "state", "dead", "inflight",
+                 "dispatches", "generation", "window",
+                 "consecutive_failures", "ejected_until", "probe_inflight",
+                 "lock", "swap_lock")
+
+    def __init__(self, idx, engine, window):
+        self.idx = idx
+        self.engine = engine
+        self.state = HEALTHY
+        self.dead = False          # hard-killed: never routed, no probes
+        self.inflight = 0          # attempts submitted, not yet completed
+        self.dispatches = 0        # pre-dispatch tap count (fault key)
+        self.generation = 0        # bumps on every engine swap
+        self.window = collections.deque(maxlen=window)  # (ok, latency_s)
+        self.consecutive_failures = 0
+        self.ejected_until = 0.0
+        self.probe_inflight = False
+        self.lock = threading.Lock()       # health state + counters
+        self.swap_lock = threading.Lock()  # engine pointer flips
+
+
+class _Attempt(object):
+    __slots__ = ("replica", "generation", "future", "started_at",
+                 "timeout_at", "hedge", "probe", "consumed", "timed_out")
+
+    def __init__(self, replica, future, timeout_s, hedge=False,
+                 probe=False):
+        self.replica = replica
+        self.generation = replica.generation
+        self.future = future
+        self.started_at = time.monotonic()
+        self.timeout_at = (self.started_at + timeout_s
+                           if timeout_s is not None else None)
+        self.hedge = hedge
+        self.probe = probe
+        self.consumed = False    # result() has judged this attempt
+        self.timed_out = False
+
+
+class PoolFuture(object):
+    """Completion handle for one pool request. `result(timeout)` drives
+    the failover machine on the CALLER's thread: it waits on the live
+    attempts, fails retryable errors over to other replicas (bounded,
+    with exponential backoff), fires the optional tail hedge, validates
+    outputs, and returns a PoolResult (or the lazy ResultSlice when
+    `check_finite=False`). Attempt completions recorded by the batcher
+    workers only set a wake flag — no device or blocking work ever runs
+    on a dispatch thread."""
+
+    def __init__(self, pool, norm, deadline_ms):
+        self._pool = pool
+        self._norm = norm
+        self._t0 = time.monotonic()
+        self._deadline_at = (self._t0 + deadline_ms / 1e3
+                             if deadline_ms is not None else None)
+        self._attempts = []
+        self._driver = threading.Lock()   # one result() driver at a time
+        self._wake = threading.Event()
+        self._value = None
+        self._error = None
+        self._retries_used = 0
+        self._hedged = False
+        self._last_error = None
+        self.latency_s = None
+        self.bucket = None
+
+    def done(self):
+        """Terminal only: a pool future is done once a `result()` call
+        has produced a value or a final error. The failover machine is
+        caller-driven, so an attempt completing with a RETRYABLE error
+        does not make the future done — result() may still rescue it on
+        another replica."""
+        return self._value is not None or self._error is not None
+
+    def remaining_deadline_ms(self):
+        if self._deadline_at is None:
+            return None
+        rem = (self._deadline_at - time.monotonic()) * 1e3
+        if rem <= 0:
+            raise DeadlineExceededError(
+                "deadline passed after %.1fms (during failover)"
+                % ((time.monotonic() - self._t0) * 1e3))
+        return rem
+
+    # ------------------------------------------------------------ drive --
+    def result(self, timeout=None):
+        with self._driver:
+            if self._error is not None:
+                raise self._error
+            if self._value is not None:
+                return self._value
+            return self._drive(timeout)
+
+    def _fail(self, exc):
+        self._error = exc
+        self._pool.metrics.on_error()
+        raise exc
+
+    def _succeed(self, att, value):
+        self.latency_s = time.monotonic() - self._t0
+        self.bucket = att.future.bucket
+        if hasattr(value, "bucket") and value.bucket is None:
+            value.bucket = self.bucket
+        self._value = value
+        self._pool.metrics.on_success(self.latency_s)
+        return value
+
+    def _drive(self, timeout):
+        pool = self._pool
+        end = time.monotonic() + timeout if timeout is not None else None
+        while True:
+            now = time.monotonic()
+            wake_at = []
+            for att in list(self._attempts):
+                if att.consumed:
+                    continue
+                if att.future.done():
+                    att.consumed = True
+                    err = att.future._error
+                    if err is None:
+                        ok, payload = pool._validate_result(att)
+                        if ok:
+                            return self._succeed(att, payload)
+                        err = payload
+                    if not _retryable(err):
+                        self._fail(err)
+                    self._last_error = err
+                elif att.timeout_at is not None and now >= att.timeout_at:
+                    att.consumed = True
+                    att.timed_out = True
+                    pool._on_attempt_timeout(att)
+                    self._last_error = AttemptTimeoutError(
+                        "replica %d did not answer within %.3fs (presumed "
+                        "wedged); failing over" % (att.replica.idx,
+                                                   pool.attempt_timeout_s))
+                elif att.timeout_at is not None:
+                    wake_at.append(att.timeout_at)
+
+            live = [a for a in self._attempts if not a.consumed]
+            if not live:
+                if self._deadline_at is not None \
+                        and now >= self._deadline_at:
+                    self._fail(DeadlineExceededError(
+                        "deadline passed after %.1fms (all attempts "
+                        "failed or timed out)" % ((now - self._t0) * 1e3)))
+                if self._retries_used >= pool.retries:
+                    self._fail(self._last_error if self._last_error
+                               is not None else RuntimeError(
+                                   "pool request ended with no attempts"))
+                delay = pool.retry_backoff_s * (2 ** self._retries_used)
+                self._retries_used += 1
+                pool.metrics.on_retry()
+                if delay > 0:
+                    if end is not None:
+                        delay = min(delay, max(end - time.monotonic(), 0))
+                    time.sleep(delay)
+                try:
+                    pool._submit_attempt(
+                        self, exclude={a.replica for a in self._attempts})
+                except DeadlineExceededError as e:
+                    self._fail(e)
+                except (QueueFullError, ServingClosedError) as e:
+                    # transient: capacity may free / swap may finish —
+                    # loop again and spend another retry on it. Keep the
+                    # FIRST real failure as the reported cause: a
+                    # poisoned/wedged outage must not surface to the
+                    # client dressed up as a capacity 429 just because
+                    # the failed replicas are now all excluded
+                    if self._last_error is None:
+                        self._last_error = e
+                continue
+
+            # tail hedging: one duplicate attempt on another replica once
+            # the primary has been quiet for hedge_delay
+            if (pool.hedge_delay_s is not None and not self._hedged
+                    and len(live) == 1 and not live[0].hedge):
+                hedge_due = live[0].started_at + pool.hedge_delay_s
+                if now >= hedge_due:
+                    self._hedged = True
+                    try:
+                        pool._submit_attempt(
+                            self,
+                            exclude={a.replica for a in self._attempts},
+                            hedge=True)
+                        pool.metrics.on_hedge()
+                    except (QueueFullError, ServingClosedError,
+                            DeadlineExceededError):
+                        pass   # hedging is best-effort by definition
+                    continue
+                wake_at.append(hedge_due)
+
+            if end is not None:
+                if now >= end:
+                    raise TimeoutError(
+                        "pool request not completed within %rs" % timeout)
+                wake_at.append(end)
+            dt = min(wake_at) - now if wake_at else None
+            self._wake.wait(dt if dt is None or dt > 0 else 0)
+            self._wake.clear()
+
+
+class ReplicaPool(object):
+    """N engine replicas behind one engine-shaped surface (submit /
+    infer / run_direct / describe / metrics / close), plus the pool
+    verbs: reload, kill_replica, restart_replica, pool_state."""
+
+    def __init__(self, model_dir=None, replicas=2, place=None, name=None,
+                 checkpoint_dir=None, fetch_list=None, feed_names=None,
+                 step=None, engine_factory=None,
+                 # failover / hedging
+                 retries=2, retry_backoff_ms=5.0, attempt_timeout_s=30.0,
+                 hedge_delay_ms=None, check_finite=True,
+                 # health machine / breakers
+                 window=64, min_samples=8, degrade_error_rate=0.25,
+                 eject_error_rate=0.5, eject_consecutive=3,
+                 latency_degrade_s=None, eject_cooldown_s=2.0,
+                 recover_samples=4,
+                 # admission
+                 admission=True, default_deadline_ms=None,
+                 latency_window=2048, **engine_kw):
+        if int(replicas) < 1:
+            raise ValueError("ReplicaPool needs replicas >= 1, got %r"
+                             % (replicas,))
+        if engine_factory is None and model_dir is None \
+                and checkpoint_dir is None:
+            raise ValueError("need model_dir, checkpoint_dir or an "
+                             "engine_factory")
+        self.name = name or self._default_name(model_dir, checkpoint_dir)
+        self.num_replicas = int(replicas)
+        self.retries = int(retries)
+        self.retry_backoff_s = float(retry_backoff_ms) / 1e3
+        self.attempt_timeout_s = (float(attempt_timeout_s)
+                                  if attempt_timeout_s else None)
+        self.hedge_delay_s = (float(hedge_delay_ms) / 1e3
+                              if hedge_delay_ms is not None else None)
+        self.check_finite = bool(check_finite)
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.degrade_error_rate = float(degrade_error_rate)
+        self.eject_error_rate = float(eject_error_rate)
+        self.eject_consecutive = int(eject_consecutive)
+        self.latency_degrade_s = latency_degrade_s
+        self.eject_cooldown_s = float(eject_cooldown_s)
+        self.recover_samples = int(recover_samples)
+        self.default_deadline_ms = default_deadline_ms
+        self.closed = False
+        self.metrics = PoolMetrics(latency_window=latency_window)
+        self.events = []              # (monotonic, kind, replica, detail)
+        self._events_lock = threading.Lock()
+        self._route_lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+        self._source = {"model_dir": model_dir,
+                        "checkpoint_dir": checkpoint_dir,
+                        "fetch_list": fetch_list,
+                        "feed_names": feed_names, "step": step}
+        self._factory = engine_factory
+        self._place = place
+        self._engine_kw = dict(engine_kw)
+
+        self._replicas = []
+        try:
+            for i in range(self.num_replicas):
+                eng = self._build_engine(i)
+                rep = _Replica(i, eng, self.window)
+                self._attach_tap(rep)
+                self._replicas.append(rep)
+        except Exception:
+            for rep in self._replicas:   # no thread leak per failed ctor
+                rep.engine.close(drain=False)
+            raise
+        cap = sum(r.engine._batcher.queue_capacity for r in self._replicas)
+        self._admission = _Admission(hi=cap, lo=self.num_replicas) \
+            if admission else None
+
+    # ------------------------------------------------------------ build --
+    @staticmethod
+    def _default_name(model_dir, checkpoint_dir):
+        for d in (model_dir, checkpoint_dir):
+            if d:
+                return os.path.basename(os.path.normpath(d))
+        return "pool"
+
+    def _place_for(self, idx):
+        """Round-robin placement over the visible devices. An explicit
+        place (or list of places) wins; default is TPUPlace(idx), whose
+        device() already wraps modulo the accelerator count and falls
+        back to CPU when none exist."""
+        from ..places import TPUPlace
+        place = self._place
+        if isinstance(place, (list, tuple)):
+            return place[idx % len(place)]
+        if place is not None:
+            return place
+        return TPUPlace(idx)
+
+    def _build_engine(self, idx):
+        """One warmed replica engine off the current source. With the
+        AOT compile cache on (ptpu_serve defaults it on), warmup is a
+        disk load, not a recompile — what makes reload/restart cheap."""
+        place = self._place_for(idx)
+        ename = "%s@%d" % (self.name, idx)
+        if self._factory is not None:
+            return self._factory(idx, place)
+        src = self._source
+        if src["checkpoint_dir"] is not None:
+            if src["fetch_list"] is None:
+                raise ValueError("checkpoint_dir serving needs fetch_list")
+            return InferenceEngine.from_checkpoint(
+                src["checkpoint_dir"], src["fetch_list"],
+                feed_names=src["feed_names"], step=src["step"],
+                place=place, name=ename, **self._engine_kw)
+        return InferenceEngine(src["model_dir"], place=place, name=ename,
+                               **self._engine_kw)
+
+    def _attach_tap(self, rep, engine=None):
+        # capture the engine the tap is ATTACHED to, never resolve
+        # rep.engine at dispatch time: during a swap the outgoing
+        # engine's drain still dispatches, and a replica_poison landing
+        # there must poison the engine being drained — not NaN the
+        # freshly promoted replacement's weights through the stale tap
+        eng = engine if engine is not None else rep.engine
+
+        def tap():
+            with rep.lock:
+                count = rep.dispatches
+                rep.dispatches += 1
+            from ..resilience import faults as _faults
+            plan = _faults.active_plan()
+            if plan is not None:
+                plan.serving_fault(rep.idx, count, engine=eng)
+        eng._replica_tap = tap
+
+    def _event(self, kind, replica, detail=""):
+        with self._events_lock:
+            self.events.append((time.monotonic(), kind, replica, detail))
+
+    # ----------------------------------------------------------- health --
+    def _record_outcome(self, rep, ok, latency_s=None):
+        """One attempt outcome -> the replica's rolling window -> state
+        transitions. Called from done-callbacks (failures, and successes
+        on check_finite=False pools) and from result() validation."""
+        now = time.monotonic()
+        with rep.lock:
+            rep.window.append((1 if ok else 0, latency_s))
+            was_probe, rep.probe_inflight = rep.probe_inflight, False
+            if ok:
+                rep.consecutive_failures = 0
+            else:
+                rep.consecutive_failures += 1
+            if rep.dead:
+                return
+            if rep.state == EJECTED:
+                if was_probe and ok:
+                    rep.state = DEGRADED     # half-open probe succeeded
+                    rep.window.clear()
+                    rep.window.append((1, latency_s))
+                    self._event("probe_ok", rep.idx)
+                elif not ok:
+                    rep.ejected_until = now + self.eject_cooldown_s
+                    if was_probe:
+                        self._event("probe_failed", rep.idx)
+                return
+            n = len(rep.window)
+            errs = sum(1 for o, _ in rep.window if not o)
+            if rep.consecutive_failures >= self.eject_consecutive or (
+                    n >= self.min_samples
+                    and errs / n >= self.eject_error_rate):
+                rep.state = EJECTED
+                rep.ejected_until = now + self.eject_cooldown_s
+                self.metrics.on_eject()
+                self._event("eject", rep.idx,
+                            "%d consecutive failures, %d/%d window errors"
+                            % (rep.consecutive_failures, errs, n))
+                return
+            if n >= self.min_samples \
+                    and errs / n >= self.degrade_error_rate:
+                if rep.state != DEGRADED:
+                    rep.state = DEGRADED
+                    self._event("degrade", rep.idx,
+                                "error rate %d/%d" % (errs, n))
+                return
+            if self.latency_degrade_s is not None and n >= self.min_samples:
+                lats = sorted(l for _, l in rep.window if l is not None)
+                if lats:
+                    p99 = lats[min(len(lats) - 1,
+                                   int(round(0.99 * (len(lats) - 1))))]
+                    if p99 > self.latency_degrade_s:
+                        if rep.state != DEGRADED:
+                            rep.state = DEGRADED
+                            self._event("degrade", rep.idx,
+                                        "p99 %.3fs" % p99)
+                        return
+            if rep.state == DEGRADED and n >= self.recover_samples:
+                tail = list(rep.window)[-self.recover_samples:]
+                if all(o for o, _ in tail):
+                    rep.state = HEALTHY
+                    self._event("recover", rep.idx)
+
+    def _release_probe(self, att):
+        """Unblock the half-open slot when a probe attempt ends WITHOUT
+        reaching _record_outcome (deadline expiry, engine closed):
+        neither outcome says anything about replica health, but leaving
+        probe_inflight set would block every future probe and strand
+        the replica in EJECTED forever."""
+        if att.probe:
+            with att.replica.lock:
+                att.replica.probe_inflight = False
+
+    def _on_attempt_timeout(self, att):
+        self.metrics.on_attempt_timeout()
+        if self._admission is not None:
+            self._admission.on_overload()
+        if att.generation == att.replica.generation:
+            self._record_outcome(att.replica, ok=False)
+
+    def _attempt_done(self, fut, att):
+        """Inner-future done-callback: bookkeeping only (the caller's
+        result() drive does the judging). Runs on the completing batcher
+        worker — must stay cheap and non-blocking."""
+        rep = att.replica
+        with rep.lock:
+            rep.inflight -= 1
+        err = att.future._error
+        if att.timed_out:
+            pass          # already counted as a failure at timeout time
+        elif err is None:
+            if self._admission is not None:
+                self._admission.on_success()
+            if not self.check_finite:
+                # finite-checking pools record success at validation
+                self._record_outcome(rep, ok=True,
+                                     latency_s=att.future.latency_s)
+        elif isinstance(err, DeadlineExceededError):
+            # not the replica's fault (client deadline), but a deadline
+            # expiring IN QUEUE is the latency-collapse signal adaptive
+            # admission exists for: shed earlier next time
+            if self._admission is not None:
+                self._admission.on_overload()
+            self._release_probe(att)
+        elif isinstance(err, ServingClosedError):
+            # swap/kill closed the engine: no health signal
+            self._release_probe(att)
+        elif att.generation != rep.generation:
+            pass          # outcome of a swapped-out engine: stale signal
+        else:
+            self._record_outcome(rep, ok=False)
+        fut._wake.set()
+
+    def _validate_result(self, att):
+        """Judge a completed attempt's payload on the caller's thread.
+        check_finite pools materialize here (the client was about to
+        anyway) and treat non-finite floats as a replica failure —
+        poisoned weights produce well-formed NaN tensors, which is
+        exactly the corruption a 200 must never carry."""
+        slice_ = att.future._value
+        if not self.check_finite:
+            return True, slice_
+        try:
+            outputs = slice_.numpy()
+        except Exception as e:  # noqa: BLE001 — materialize failure =
+            if att.generation == att.replica.generation:  # replica fault
+                self._record_outcome(att.replica, ok=False)
+            return False, e
+        for fname, arr in outputs.items():
+            a = np.asarray(arr)
+            if np.issubdtype(a.dtype, np.floating) \
+                    and not np.isfinite(a).all():
+                self.metrics.on_poisoned()
+                if att.generation == att.replica.generation:
+                    self._record_outcome(att.replica, ok=False)
+                return False, PoisonedOutputError(
+                    "replica %d returned non-finite values in fetch %r"
+                    % (att.replica.idx, fname))
+        if att.generation == att.replica.generation:
+            self._record_outcome(att.replica, ok=True,
+                                 latency_s=att.future.latency_s)
+        # a stale-generation success (engine swapped mid-flight) is still
+        # a valid result for the client — it just isn't a health signal
+        return True, PoolResult(outputs, att.future.bucket)
+
+    # ---------------------------------------------------------- routing --
+    def _pick(self, exclude=()):
+        """(replica, is_probe) — least-loaded healthy first; degraded
+        only when no healthy candidate exists; a cooldown-expired
+        ejected replica gets ONE concurrent live-traffic probe
+        (half-open breaker) ahead of regular routing, else ejected
+        replicas are last-resort only."""
+        now = time.monotonic()
+        with self._route_lock:
+            healthy, degraded, last_resort = [], [], []
+            probe = None
+            for rep in self._replicas:
+                if rep.dead or rep in exclude:
+                    continue
+                with rep.lock:
+                    state, load = rep.state, rep.inflight
+                    probe_due = (state == EJECTED and not rep.probe_inflight
+                                 and now >= rep.ejected_until)
+                if state == HEALTHY:
+                    healthy.append((load, rep.idx, rep))
+                elif state == DEGRADED:
+                    degraded.append((load, rep.idx, rep))
+                elif probe_due and probe is None:
+                    probe = rep
+                else:
+                    last_resort.append((load, rep.idx, rep))
+            if probe is not None:
+                with probe.lock:
+                    probe.probe_inflight = True
+                return probe, True
+            for bucket in (healthy, degraded, last_resort):
+                if bucket:
+                    return min(bucket)[2], False
+        return None, False
+
+    def _submit_attempt(self, fut, exclude=(), hedge=False):
+        """Route one attempt; on a full/closed replica move on to the
+        next candidate. Raises QueueFullError when EVERY routable
+        replica rejected (the admission controller hears about it)."""
+        tried = set(exclude)
+        rejected_any = False
+        deadline_ms = fut.remaining_deadline_ms()   # raises when spent
+        while True:
+            rep, probe = self._pick(exclude=tried)
+            if rep is None:
+                # overload signals (admission shrink, 429 counter) only
+                # when a replica actually REJECTED here — exhausting the
+                # exclude set on a failover is the request running out
+                # of replicas, not the pool running out of capacity
+                if rejected_any:
+                    if self._admission is not None:
+                        self._admission.on_overload()
+                    self.metrics.on_queue_full()
+                raise QueueFullError(
+                    "no replica can accept the request (all full, "
+                    "ejected or excluded); retry with backoff")
+            try:
+                with rep.swap_lock:
+                    inner = rep.engine.submit_normalized(
+                        fut._norm, deadline_ms=deadline_ms)
+            except (QueueFullError, ServingClosedError):
+                if probe:
+                    with rep.lock:
+                        rep.probe_inflight = False
+                tried.add(rep)
+                rejected_any = True
+                continue
+            except Exception:
+                if probe:
+                    with rep.lock:
+                        rep.probe_inflight = False
+                raise
+            with rep.lock:
+                rep.inflight += 1
+            att = _Attempt(rep, inner, self.attempt_timeout_s,
+                           hedge=hedge, probe=probe)
+            fut._attempts.append(att)
+            inner.add_done_callback(
+                lambda _f, a=att, f=fut: self._attempt_done(f, a))
+            return att
+
+    # ----------------------------------------------------------- public --
+    def submit(self, feed, deadline_ms=None):
+        """Normalize once (caller's thread — malformed requests fail
+        fast, before any routing), admission-check, route the first
+        attempt. Returns a PoolFuture."""
+        if self.closed:
+            raise ServingClosedError("replica pool is shut down")
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        norm = self._any_engine().normalize_feed(feed)
+        if self._admission is not None and not self._admission.allow(
+                self.total_inflight()):
+            self.metrics.on_queue_full()
+            raise QueueFullError(
+                "pool admission limit %.0f reached (overload shedding); "
+                "retry with backoff" % self._admission.limit)
+        fut = PoolFuture(self, norm, deadline_ms)
+        self._submit_attempt(fut)
+        self.metrics.on_submit()
+        return fut
+
+    def infer(self, feed, deadline_ms=None, timeout=30.0):
+        return self.submit(feed, deadline_ms=deadline_ms) \
+            .result(timeout).numpy()
+
+    def run_direct(self, feed, batch_bucket=None, seq_bucket=None):
+        """The single-request reference path, on any live replica — the
+        pool invariant is that WHICH replica is unobservable in the
+        bits."""
+        return self._any_engine().run_direct(
+            feed, batch_bucket=batch_bucket, seq_bucket=seq_bucket)
+
+    def _any_engine(self):
+        for rep in self._replicas:
+            if not rep.dead and not rep.engine.closed:
+                return rep.engine
+        raise ServingClosedError("no live replica in the pool")
+
+    def total_inflight(self):
+        return sum(rep.inflight for rep in self._replicas)
+
+    @property
+    def fetch_names(self):
+        return self._any_engine().fetch_names
+
+    @property
+    def feed_names(self):
+        return self._any_engine().feed_names
+
+    @property
+    def max_batch_size(self):
+        return self._any_engine().max_batch_size
+
+    @property
+    def batch_buckets(self):
+        return self._any_engine().batch_buckets
+
+    @property
+    def seq_buckets(self):
+        return self._any_engine().seq_buckets
+
+    def queue_depth(self):
+        return sum(rep.engine.queue_depth() for rep in self._replicas
+                   if not rep.dead)
+
+    def replica_metrics(self):
+        """{replica_index: ServingMetrics} for /metrics labeling."""
+        return {rep.idx: rep.engine.metrics for rep in self._replicas}
+
+    def pool_state(self):
+        """The /healthz payload: per-replica state + aggregate counts."""
+        reps = []
+        counts = {HEALTHY: 0, DEGRADED: 0, EJECTED: 0}
+        for rep in self._replicas:
+            with rep.lock:
+                st = rep.state
+                reps.append({"replica": rep.idx, "state": st,
+                             "dead": rep.dead, "inflight": rep.inflight,
+                             "dispatches": rep.dispatches,
+                             "generation": rep.generation})
+            counts[st] += 1
+        out = {"replicas": reps, "healthy": counts[HEALTHY],
+               "degraded": counts[DEGRADED], "ejected": counts[EJECTED],
+               "inflight": self.total_inflight()}
+        if self._admission is not None:
+            out["admission_limit"] = round(self._admission.limit, 1)
+        return out
+
+    def describe(self):
+        base = self._any_engine().describe()
+        base["name"] = self.name
+        base["status"] = "closed" if self.closed else "serving"
+        base["pool"] = self.pool_state()
+        base["metrics"] = self.metrics.snapshot()
+        return base
+
+    # -------------------------------------------------- reload / verbs --
+    def reload(self, checkpoint_dir=None, model_dir=None, step=None,
+               timeout=None):
+        """Zero-downtime weight promotion, one replica at a time: build
+        and WARM a fresh engine off the newest valid snapshot of
+        `checkpoint_dir` (or re-read `model_dir`; no argument = re-read
+        the pool's current source, which for a checkpoint pool means
+        "newest valid snapshot NOW" — the trainer-promotes flow), then
+        atomically swap it in under the replica's submit lock and drain
+        the outgoing engine. Requests accepted before a replica's flip
+        complete against the old weights; requests after it get the new
+        ones; nothing is ever dropped, and the other replicas keep
+        serving throughout. Returns the served checkpoint step (None
+        for model-dir pools)."""
+        with self._reload_lock:
+            if self.closed:
+                raise ServingClosedError("replica pool is shut down")
+            if checkpoint_dir is not None:
+                self._source["checkpoint_dir"] = checkpoint_dir
+                self._source["model_dir"] = None
+            if model_dir is not None:
+                self._source["model_dir"] = model_dir
+                self._source["checkpoint_dir"] = None
+            if step is not None:
+                self._source["step"] = step
+            served_step = None
+            for rep in self._replicas:
+                if rep.dead:
+                    continue    # killed replicas stay down (restart_
+                                # replica is the explicit revive)
+                fresh = self._build_engine(rep.idx)
+                served_step = getattr(fresh, "checkpoint_step",
+                                      served_step)
+                with rep.swap_lock:
+                    old, rep.engine = rep.engine, fresh
+                    rep.generation += 1
+                self._attach_tap(rep, engine=fresh)
+                with rep.lock:
+                    was_ejected = rep.state == EJECTED
+                    rep.window.clear()
+                    rep.consecutive_failures = 0
+                    rep.probe_inflight = False
+                    if rep.state == DEGRADED:
+                        rep.state = HEALTHY
+                    elif was_ejected:
+                        # new weights cure a poisoned-weights ejection,
+                        # but a wedge-class cause can be environmental
+                        # (the old worker may literally still be stuck):
+                        # keep the half-open path — the cooldown
+                        # restarts and ONE live probe readmits a
+                        # genuinely recovered replica immediately,
+                        # instead of routing preferred traffic straight
+                        # back into a bad device
+                        rep.ejected_until = (time.monotonic()
+                                             + self.eject_cooldown_s)
+                self._event("swap", rep.idx,
+                            "generation %d" % rep.generation)
+                # close rides the batcher's shared drain: everything
+                # accepted pre-flip completes (old weights) before the
+                # old engine's worker joins. An EJECTED replica's old
+                # engine may be WEDGED mid-dispatch — draining it could
+                # block this reload (and, via _reload_lock, every future
+                # reload) forever; its queued work was already failed
+                # over, so fail the leftovers fast instead
+                if was_ejected:
+                    old.close(drain=False, timeout=1.0)
+                else:
+                    old.close(drain=True, timeout=timeout)
+            self.metrics.on_reload()
+            return served_step
+
+    def kill_replica(self, idx, drain=False):
+        """Hard-eject one replica (deploy gates, ops): never routed
+        again, no probes, engine closed. Queued requests on it fail
+        with ServingClosedError and the pool fails them over — the
+        kill-a-replica invariant is zero client-visible errors."""
+        rep = self._replicas[idx]
+        with rep.lock:
+            rep.dead = True
+            rep.state = EJECTED
+            rep.ejected_until = float("inf")
+        self.metrics.on_kill()
+        self._event("kill", idx)
+        # drain=False by default: a kill simulates failure, and a WEDGED
+        # engine's close(drain=True) would never return
+        rep.engine.close(drain=drain, timeout=1.0)
+
+    def restart_replica(self, idx):
+        """Revive a killed (or just unhealthy) replica with a freshly
+        built engine off the current source."""
+        rep = self._replicas[idx]
+        fresh = self._build_engine(idx)
+        with rep.swap_lock:
+            old, rep.engine = rep.engine, fresh
+            rep.generation += 1
+        self._attach_tap(rep, engine=fresh)
+        with rep.lock:
+            rep.dead = False
+            rep.state = HEALTHY
+            rep.window.clear()
+            rep.consecutive_failures = 0
+            rep.probe_inflight = False
+            rep.ejected_until = 0.0
+        self._event("restart", idx, "generation %d" % rep.generation)
+        if not old.closed:
+            old.close(drain=True, timeout=1.0)
+
+    def close(self, drain=True, timeout=None):
+        self.closed = True
+        for rep in self._replicas:
+            if rep.dead:
+                continue
+            # never drain an EJECTED replica: a wedged worker would hold
+            # the close forever, and its queued requests were already
+            # failed over (attempt timeouts) — fail the leftovers fast
+            rep_drain = drain and rep.state != EJECTED
+            rep.engine.close(drain=rep_drain,
+                             timeout=timeout if rep_drain else 1.0)

@@ -138,14 +138,40 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         self._drain_body()
         if self.path == "/healthz":
-            alive = any(not e.closed for e in self.registry.values())
-            self._reply(200 if alive else 503,
-                        {"status": "ok" if alive else "shutting down"})
+            # an entry can serve when it isn't closed AND (for pools) at
+            # least one replica is still routable — a pool whose every
+            # replica is ejected/dead must read unhealthy to the LB even
+            # though the process is up. pool_state() takes every
+            # replica's lock, so compute it ONCE per pool and derive
+            # both the verdict and the payload from that.
+            pool_states = {name: e.pool_state()
+                           for name, e in sorted(self.registry.items())
+                           if hasattr(e, "pool_state")}
+
+            def _can_serve(name, e):
+                if e.closed:
+                    return False
+                s = pool_states.get(name)
+                if s is not None:
+                    return (s["healthy"] + s["degraded"]) > 0
+                return True
+
+            alive = any(_can_serve(n, e)
+                        for n, e in self.registry.items())
+            payload = {"status": "ok" if alive else "unavailable"}
+            if pool_states:
+                payload["pools"] = pool_states
+            self._reply(200 if alive else 503, payload)
             return
         if self.path == "/metrics":
             from .metrics import render_prometheus_all
-            text = render_prometheus_all(
-                {name: e.metrics for name, e in self.registry.items()})
+            plain, pools = {}, {}
+            for name, e in self.registry.items():
+                if hasattr(e, "replica_metrics"):
+                    pools[name] = e
+                else:
+                    plain[name] = e.metrics
+            text = render_prometheus_all(plain, pools=pools)
             self._reply(200, text.encode("utf-8"),
                         content_type="text/plain; version=0.0.4")
             return

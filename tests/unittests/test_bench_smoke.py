@@ -107,6 +107,47 @@ def test_bench_serving_smoke():
         assert rec[k] >= 0
 
 
+def test_bench_pool_smoke():
+    """The BENCH_POOL leg: one subprocess run on CPU driving the same
+    open-loop schedule through 1- and 2-replica pools with a mid-run
+    replica kill (2-replica leg) and a mid-run zero-downtime reload
+    (both legs). The acceptance gate rides here: ZERO client-visible
+    errors across both events — otherwise the pool's availability story
+    is decoration."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_POOL": "1",
+        "BENCH_POOL_REQUESTS": "90", "BENCH_POOL_REPLICAS": "1,2",
+        "BENCH_POOL_MAX_BATCH": "8", "BENCH_SERVING_LAYERS": "6",
+        "BENCH_SERVING_HIDDEN": "64",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "serving_pool_throughput"
+    assert rec["unit"] == "requests/sec/chip"
+    assert rec["vs_baseline"] is None
+    assert rec["value"] > 0
+    legs = rec["legs"]
+    assert set(legs) == {"1", "2"}
+    # the acceptance gate: zero errors across the kill AND the reload
+    assert rec["total_errors"] == 0, rec
+    for n, leg in legs.items():
+        assert leg["errors"] == 0, leg
+        assert leg["completed"] == 90
+        assert leg["qps"] > 0
+        assert leg["p99_ms"] >= leg["p50_ms"] >= 0
+        assert any(e.startswith("reload@") for e in leg["events"])
+    # the kill fired in the multi-replica leg only
+    assert any(e.startswith("kill@") for e in legs["2"]["events"])
+    assert not any(e.startswith("kill@") for e in legs["1"]["events"])
+
+
 def test_bench_ckpt_smoke():
     """The BENCH_CKPT leg: one subprocess run on CPU comparing no
     checkpointing vs sync saves vs async saves. The acceptance gate rides

@@ -11,8 +11,17 @@ from paddle_tpu.models import image_classification
 from paddle_tpu.models.image_classification import build_train
 
 
-@pytest.mark.parametrize("model", ["resnet50", "resnet101", "vgg16",
-                                   "alexnet", "googlenet", "se_resnext50"])
+# resnet101 and se_resnext50 are the two slowest builds (~60s/~50s of
+# pure XLA:CPU compile each) and exercise the SAME building blocks as
+# resnet50/googlenet, which stay in the fast tier — tier-1 was
+# overrunning its 870s verify budget, and a truncated run is worse
+# signal than a deferred depth-variant (PR 8 triage; the slow tier
+# still runs them by default)
+@pytest.mark.parametrize("model", [
+    "resnet50",
+    pytest.param("resnet101", marks=pytest.mark.slow),
+    "vgg16", "alexnet", "googlenet",
+    pytest.param("se_resnext50", marks=pytest.mark.slow)])
 def test_model_one_step(model):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.unique_name.guard(), fluid.program_guard(main, startup):
@@ -72,8 +81,13 @@ def test_build_train_uint8_input_matches_float_feed():
     def build(u8):
         main, startup = fluid.Program(), fluid.Program()
         with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            # resnet20: the uint8 cast+normalize path under test lives in
+            # build_train's SHARED input handling, not in any model's
+            # depth — the cifar-sized net proves it at a fraction of the
+            # 2x resnet50 compile this test used to pay (PR 8 tier-1
+            # budget triage)
             image, label, cost, acc = build_train(
-                model="resnet50", class_dim=8, image_shape=(3, 32, 32),
+                model="resnet20", class_dim=8, image_shape=(3, 32, 32),
                 learning_rate=0.0, momentum=0.0, uint8_input=u8)
         return main, startup, cost
 

@@ -1,0 +1,720 @@
+"""paddle_tpu.serving.pool: replica pool with health-gated routing.
+
+The load-bearing invariants:
+
+  * ROUTING IS INVISIBLE IN THE BITS — a pooled request's rows are
+    bit-identical to a single-engine `run_direct` at the same bucket,
+    regardless of which replica served it or how many failovers it took
+    (every replica loads the same weights and dispatches at lattice
+    shapes).
+  * FAILURES ARE NOT CLIENT-VISIBLE — an injected replica exception,
+    wedge, poison, or a hard mid-traffic kill redistributes load with
+    zero client-visible errors (the acceptance legs).
+  * RELOAD DROPS NOTHING — `pool.reload()` under concurrent load
+    completes every accepted request, and post-reload responses come
+    from the NEW weights, bit-exact vs a fresh engine on the promoted
+    snapshot.
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import serving
+from paddle_tpu.resilience.faults import FaultPlan
+from paddle_tpu.serving.batcher import Batcher
+from paddle_tpu.serving.pool import DEGRADED, EJECTED, HEALTHY
+
+
+def _save_dense_model(tmp_path, seed=0, feat=6, classes=3):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "dense_model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe, main)
+    return d
+
+
+def _pool(d, replicas=2, **kw):
+    kw.setdefault("batch_buckets", [4])
+    kw.setdefault("max_queue_delay_ms", 3)
+    kw.setdefault("place", fluid.CPUPlace())
+    return serving.ReplicaPool(d, replicas=replicas, **kw)
+
+
+def _reference(d):
+    return serving.InferenceEngine(d, batch_buckets=[4],
+                                   max_queue_delay_ms=1)
+
+
+def _concurrent(pool, feeds):
+    futures = [None] * len(feeds)
+
+    def fire(i):
+        try:
+            futures[i] = pool.submit(feeds[i])
+        except Exception as e:  # noqa: BLE001 — collected, not raised
+            futures[i] = e      # from a worker thread
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(len(feeds))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return futures
+
+
+def _collect_bit_exact(pool, ref, feeds, futures, timeout=60):
+    """Every future must succeed AND bit-match run_direct at its bucket.
+    Returns the number of client-visible errors (acceptance: 0)."""
+    fetch = ref.fetch_names[0]
+    errors = []
+    for i, fut in enumerate(futures):
+        if not hasattr(fut, "result"):
+            errors.append((i, fut))
+            continue
+        try:
+            got = fut.result(timeout).numpy()
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, e))
+            continue
+        want, _ = ref.run_direct(feeds[i], batch_bucket=fut.bucket[0],
+                                 seq_bucket=fut.bucket[1])
+        np.testing.assert_array_equal(got[fetch], want[fetch])
+    return errors
+
+
+# --------------------------------------------------------------------------
+# routing determinism: pooled == single-engine run_direct, bit for bit
+# --------------------------------------------------------------------------
+
+def test_pool_routing_bit_identical(tmp_path):
+    """24 concurrent mixed-row requests over 3 replicas: every response
+    bit-identical to the single-engine reference at its own bucket, and
+    the load actually spread (this is the satellite-4 determinism
+    leg)."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=3)
+    ref = _reference(d)
+    rng = np.random.RandomState(3)
+    feeds = [{"x": rng.rand(int(rng.randint(1, 4)), 6).astype("f")}
+             for _ in range(24)]
+    futures = _concurrent(pool, feeds)
+    errors = _collect_bit_exact(pool, ref, feeds, futures)
+    assert errors == []
+    served = [r.dispatches for r in pool._replicas]
+    assert sum(1 for s in served if s > 0) >= 2, served
+    assert pool.metrics.snapshot()["responses_total"] == 24
+    assert pool.metrics.snapshot()["errors_total"] == 0
+    pool.close()
+    ref.close()
+
+
+def test_pool_invalid_request_fails_fast_no_retry(tmp_path):
+    """A malformed request is the CLIENT's fault: typed error on the
+    caller's thread, no routing, no retries, no replica blamed."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2)
+    rng = np.random.RandomState(0)
+    with pytest.raises(serving.InvalidRequestError):
+        pool.submit({"x": rng.rand(1, 5).astype("f")})  # wrong feat dim
+    with pytest.raises(serving.RequestTooLargeError):
+        pool.submit({"x": rng.rand(9, 6).astype("f")})  # > largest bucket
+    assert pool.metrics.snapshot()["retries_total"] == 0
+    for rep in pool._replicas:
+        assert len(rep.window) == 0
+    pool.close()
+
+
+# --------------------------------------------------------------------------
+# failover: injected replica faults, zero client-visible errors
+# --------------------------------------------------------------------------
+
+def test_pool_failover_injected_exc(tmp_path):
+    """replica_exc@1 fails some replica's 2nd dispatch inside the
+    batcher; the pool must retry those requests on another replica —
+    zero client-visible errors, all bits exact."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2, retries=3)
+    ref = _reference(d)
+    rng = np.random.RandomState(5)
+    feeds = [{"x": rng.rand(1, 6).astype("f")} for _ in range(12)]
+    with FaultPlan(["replica_exc@1"]):
+        futures = _concurrent(pool, feeds)
+        errors = _collect_bit_exact(pool, ref, feeds, futures)
+    assert errors == []
+    snap = pool.metrics.snapshot()
+    assert snap["retries_total"] >= 1      # the failover actually fired
+    assert snap["errors_total"] == 0
+    # the faulted dispatch was recorded against SOME replica's window
+    assert any(any(not ok for ok, _ in rep.window)
+               for rep in pool._replicas)
+    pool.close()
+    ref.close()
+
+
+def test_pool_failover_wedged_replica(tmp_path):
+    """replica_wedge sleeps a replica's batcher worker mid-dispatch (the
+    silent-wedge case): per-attempt timeouts must detect it, fail the
+    stuck requests over, and the breaker must eject the wedged replica
+    — zero client-visible errors."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2, retries=3, attempt_timeout_s=0.4,
+                 eject_consecutive=2, eject_cooldown_s=30.0)
+    ref = _reference(d)
+    rng = np.random.RandomState(7)
+    feeds = [{"x": rng.rand(1, 6).astype("f")} for _ in range(16)]
+    with FaultPlan(["replica_wedge@1:2.0"]):
+        futures = _concurrent(pool, feeds)
+        errors = _collect_bit_exact(pool, ref, feeds, futures)
+    assert errors == []
+    snap = pool.metrics.snapshot()
+    assert snap["attempt_timeouts_total"] >= 1
+    assert snap["errors_total"] == 0
+    assert any(rep.state == EJECTED for rep in pool._replicas)
+    pool.close(timeout=5)      # ejected replicas close without drain
+    ref.close()
+
+
+def test_pool_poisoned_replica_failover(tmp_path):
+    """replica_poison NaNs one replica's weights (the crashed-trainer-
+    pushed-garbage case): the finite-output check must catch every
+    poisoned response BEFORE the client sees it, fail over, and eject
+    the poisoned replica — zero client-visible errors, all results
+    finite and bit-exact vs the healthy reference."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2, retries=3, eject_consecutive=2,
+                 eject_cooldown_s=30.0)
+    ref = _reference(d)
+    rng = np.random.RandomState(9)
+    feeds = [{"x": rng.rand(1, 6).astype("f")} for _ in range(16)]
+    with FaultPlan(["replica_poison@1"]):
+        futures = _concurrent(pool, feeds)
+        errors = _collect_bit_exact(pool, ref, feeds, futures)
+    assert errors == []
+    snap = pool.metrics.snapshot()
+    assert snap["poisoned_results_total"] >= 1
+    assert snap["errors_total"] == 0
+    assert any(rep.state == EJECTED for rep in pool._replicas)
+    pool.close()
+    ref.close()
+
+
+def test_pool_kill_replica_under_load(tmp_path):
+    """THE kill-a-replica acceptance leg: hard-kill a replica while
+    requests are queued on it and keep submitting after — traffic
+    redistributes with ZERO client-visible errors and every response
+    stays bit-exact."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=3, retries=3, max_queue_delay_ms=10)
+    ref = _reference(d)
+    rng = np.random.RandomState(11)
+    feeds = [{"x": rng.rand(1, 6).astype("f")} for _ in range(30)]
+    futures = _concurrent(pool, feeds[:15])     # wave 1 in flight
+    pool.kill_replica(1)
+    futures += _concurrent(pool, feeds[15:])    # wave 2 post-kill
+    errors = _collect_bit_exact(pool, ref, feeds, futures)
+    assert errors == []
+    state = pool.pool_state()
+    assert state["replicas"][1]["dead"] is True
+    assert state["healthy"] == 2
+    assert pool.metrics.snapshot()["replica_kills_total"] == 1
+    # the dead replica is out of rotation: new traffic avoids it
+    before = pool._replicas[1].dispatches
+    futures = _concurrent(pool, feeds[:6])
+    assert _collect_bit_exact(pool, ref, feeds[:6], futures) == []
+    assert pool._replicas[1].dispatches == before
+    # and a restart revives it with a fresh engine
+    pool.restart_replica(1)
+    assert pool.pool_state()["healthy"] == 3
+    out = pool.infer(feeds[0])
+    want, _ = ref.run_direct(feeds[0], batch_bucket=4)
+    np.testing.assert_array_equal(out[ref.fetch_names[0]],
+                                  want[ref.fetch_names[0]])
+    pool.close()
+    ref.close()
+
+
+def test_pool_hedging_rescues_tail(tmp_path):
+    """Tail hedging: with a long attempt timeout, a wedged primary is
+    rescued by the hedge attempt racing on the other replica — the
+    request completes fast and clean instead of waiting out the
+    wedge."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2, retries=2, attempt_timeout_s=30.0,
+                 hedge_delay_ms=80.0)
+    ref = _reference(d)
+    rng = np.random.RandomState(13)
+    feed = {"x": rng.rand(1, 6).astype("f")}
+    with FaultPlan(["replica_wedge@0:1.2"]):
+        t0 = time.monotonic()
+        out = pool.infer(feed, timeout=10.0)
+        elapsed = time.monotonic() - t0
+    want, _ = ref.run_direct(feed, batch_bucket=4)
+    np.testing.assert_array_equal(out[ref.fetch_names[0]],
+                                  want[ref.fetch_names[0]])
+    assert elapsed < 1.0, elapsed   # hedge answered, not the wedge
+    assert pool.metrics.snapshot()["hedges_total"] == 1
+    time.sleep(1.2 - min(elapsed, 1.2))   # wedge expires pre-teardown
+    pool.close(timeout=5)
+    ref.close()
+
+
+# --------------------------------------------------------------------------
+# health state machine
+# --------------------------------------------------------------------------
+
+def test_health_state_machine_transitions(tmp_path):
+    """Drive the breaker directly: healthy -> degraded on window error
+    rate, -> ejected on consecutive failures, half-open probe after the
+    cooldown readmits on success, clean tail recovers to healthy."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2, min_samples=4, degrade_error_rate=0.25,
+                 eject_error_rate=0.75, eject_consecutive=3,
+                 eject_cooldown_s=0.2, recover_samples=3)
+    rep = pool._replicas[0]
+
+    for _ in range(3):
+        pool._record_outcome(rep, ok=True, latency_s=0.01)
+    assert rep.state == HEALTHY
+    # 2 failures in a 5-sample window = 40% > degrade threshold
+    pool._record_outcome(rep, ok=False)
+    pool._record_outcome(rep, ok=False)
+    assert rep.state == DEGRADED
+    # a third CONSECUTIVE failure ejects
+    pool._record_outcome(rep, ok=False)
+    assert rep.state == EJECTED
+    # while ejected (cooldown pending) routing avoids it
+    picked, probe = pool._pick()
+    assert picked is pool._replicas[1] and not probe
+    # after the cooldown the NEXT pick is a half-open probe of it
+    time.sleep(0.25)
+    picked, probe = pool._pick()
+    assert picked is rep and probe
+    # concurrent picks do NOT double-probe
+    picked2, probe2 = pool._pick()
+    assert picked2 is pool._replicas[1] and not probe2
+    # probe success readmits as degraded...
+    pool._record_outcome(rep, ok=True, latency_s=0.01)
+    assert rep.state == DEGRADED
+    # ...and a clean tail recovers to healthy
+    for _ in range(3):
+        pool._record_outcome(rep, ok=True, latency_s=0.01)
+    assert rep.state == HEALTHY
+    # failed probe re-arms the cooldown instead
+    for _ in range(3):
+        pool._record_outcome(rep, ok=False)
+    assert rep.state == EJECTED
+    time.sleep(0.25)
+    picked, probe = pool._pick()
+    assert picked is rep and probe
+    pool._record_outcome(rep, ok=False)
+    assert rep.state == EJECTED
+    picked, probe = pool._pick()
+    assert picked is pool._replicas[1] and not probe  # cooldown re-armed
+    pool.close()
+
+
+def test_probe_released_on_deadline_expiry(tmp_path):
+    """A half-open probe whose request dies of DEADLINE expiry (no
+    health signal either way) must release the probe slot — leaving
+    probe_inflight set would block every future probe and strand the
+    replica in EJECTED forever."""
+    from paddle_tpu.serving import pool as pool_mod
+    from paddle_tpu.serving.batcher import RequestFuture
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2)
+    rep = pool._replicas[0]
+    with rep.lock:
+        rep.state = EJECTED
+        rep.ejected_until = 0.0       # cooldown already passed
+    picked, probe = pool._pick()
+    assert picked is rep and probe    # the half-open slot is taken
+    inner = RequestFuture()
+    att = pool_mod._Attempt(rep, inner, None, probe=True)
+    with rep.lock:
+        rep.inflight += 1
+    pf = pool_mod.PoolFuture(pool, None, None)
+    inner.add_done_callback(lambda _f: pool._attempt_done(pf, att))
+    inner.set_exception(serving.DeadlineExceededError("expired in queue"))
+    assert rep.probe_inflight is False
+    assert rep.state == EJECTED       # deadline expiry is NOT a failure
+    picked2, probe2 = pool._pick()
+    assert picked2 is rep and probe2  # probeable again
+    pool.close()
+
+
+def test_latency_breaker_degrades(tmp_path):
+    """The latency circuit: a replica answering successfully but slower
+    than the configured p99 bound is degraded (taken out of preferred
+    routing) without a single error."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2, min_samples=4, latency_degrade_s=0.05)
+    rep = pool._replicas[0]
+    for _ in range(5):
+        pool._record_outcome(rep, ok=True, latency_s=0.2)
+    assert rep.state == DEGRADED
+    picked, _ = pool._pick()
+    assert picked is pool._replicas[1]
+    pool.close()
+
+
+# --------------------------------------------------------------------------
+# admission control
+# --------------------------------------------------------------------------
+
+def test_pool_admission_sheds_on_overload(tmp_path):
+    """Overload degrades to fast 429s, not collapse: when the routable
+    capacity can't absorb the load (here: one replica dead, the other's
+    queue at capacity) the pool rejects immediately with QueueFullError
+    and the AIMD limit shrinks below the static capacity; once the
+    backlog drains, traffic flows again and the limit creeps back up."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2, queue_capacity=4, max_queue_delay_ms=0,
+                 retries=0)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(1, 6).astype("f")}
+    pool.kill_replica(1)         # routable capacity is now HALF of what
+    hi = pool._admission.hi      # the admission limit assumes
+    lock = pool._replicas[0].engine._run_lock
+    lock.acquire()               # wedge the survivor's dispatch
+    try:
+        accepted, rejected = [], 0
+        t0 = time.monotonic()
+        for _ in range(32):
+            try:
+                accepted.append(pool.submit(feed))
+            except serving.QueueFullError:
+                rejected += 1
+        assert time.monotonic() - t0 < 5.0   # fast shedding, no blocking
+        assert rejected > 0
+        limit_under_load = pool._admission.limit
+        assert limit_under_load < hi         # AIMD shrank on overload
+    finally:
+        lock.release()
+    for fut in accepted:
+        fut.result(30)          # the accepted backlog all completes
+    assert pool.metrics.snapshot()["rejected_queue_full"] == rejected
+    out = pool.infer(feed)      # and fresh traffic flows again
+    assert out[pool.fetch_names[0]].shape[0] == 1
+    assert pool._admission.limit > limit_under_load   # AIMD recovery
+    pool.close()
+
+
+# --------------------------------------------------------------------------
+# drain sharing + reload
+# --------------------------------------------------------------------------
+
+def test_batcher_drain_is_shared_and_nonclosing():
+    """`drain()` completes everything queued/mid-dispatch while intake
+    stays OPEN — the engine-swap primitive. close(drain=True) rides the
+    same implementation."""
+    release, started = threading.Event(), threading.Event()
+    served = []
+
+    def dispatch(requests):
+        started.set()
+        release.wait(30)
+        for r in requests:
+            served.append(r.rows)
+            r.future.set_result("ok")
+
+    b = Batcher(dispatch, max_batch_size=2, max_queue_delay_ms=5000,
+                queue_capacity=16)
+    futs = [b.submit({"i": i}, rows=1) for i in range(5)]
+    started.wait(10)
+    # a timed-out drain reports False and leaves everything intact
+    assert b.drain(timeout=0.05) is False
+    release.set()
+    assert b.drain(timeout=30) is True     # waits out queue AND dispatch
+    assert len(served) == 5
+    for f in futs:
+        assert f.result(1) == "ok"
+    # intake is still open after a drain
+    release.clear()
+    f = b.submit({"i": 99}, rows=1)
+    release.set()
+    assert f.result(10) == "ok"
+    b.close(drain=True)
+    with pytest.raises(serving.ServingClosedError):
+        b.submit({"i": 100}, rows=1)
+
+
+def test_drain_wakes_on_expired_only_collection():
+    """A collection that pops ONLY expired requests empties the queue
+    without dispatching anything — the drain() waiter must still be
+    woken (regression: the notify lived only on the dispatch path, so
+    this exact sequence parked drain()/close(drain=True) forever)."""
+    release, started = threading.Event(), threading.Event()
+
+    def dispatch(requests):
+        started.set()
+        release.wait(30)
+        for r in requests:
+            r.future.set_result("ok")
+
+    b = Batcher(dispatch, max_batch_size=4, max_queue_delay_ms=0,
+                queue_capacity=16)
+    first = b.submit({"i": 0}, rows=1)
+    started.wait(10)                       # worker busy inside dispatch
+    doomed = b.submit({"i": 1}, rows=1, deadline_ms=5)
+    time.sleep(0.05)                       # doomed expires while queued
+    done = []
+    t = threading.Thread(target=lambda: done.append(b.drain(timeout=10)))
+    t.start()
+    time.sleep(0.05)
+    release.set()
+    t.join(15)
+    assert not t.is_alive()
+    assert done == [True]                  # drained, not timed out
+    assert first.result(5) == "ok"
+    with pytest.raises(serving.DeadlineExceededError):
+        doomed.result(5)
+    b.close()
+
+
+def test_engine_drain_under_load(tmp_path):
+    """engine.drain() empties the queue without closing; submits keep
+    working afterwards."""
+    d = _save_dense_model(tmp_path)
+    engine = serving.InferenceEngine(d, batch_buckets=[4],
+                                     max_queue_delay_ms=500,
+                                     queue_capacity=64)
+    rng = np.random.RandomState(1)
+    feeds = [{"x": rng.rand(1, 6).astype("f")} for _ in range(8)]
+    futs = [engine.submit(f) for f in feeds]
+    assert engine.drain(timeout=30) is True
+    for f in futs:
+        assert f.done()          # drained, not dropped — long window cut
+    out = engine.infer(feeds[0])  # intake still open
+    assert out[engine.fetch_names[0]].shape[0] == 1
+    engine.close()
+
+
+def _train_two_snapshots(tmp_path):
+    """A tiny trained model checkpointed at two steps with DIFFERENT
+    weights; returns (ckpt_dir, pred_name)."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    r = np.random.RandomState(4)
+    scope = fluid.Scope()
+    ck = str(tmp_path / "ck")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xb, yb = r.rand(8, 6).astype("f"), r.rand(8, 1).astype("f")
+        with CheckpointManager(ck, async_save=False) as mgr:
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            mgr.save(1, program=main, scope=scope)
+            for _ in range(3):
+                exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            mgr.save(4, program=main, scope=scope)
+    return ck, pred.name
+
+
+def test_pool_reload_under_load_promotes_new_weights(tmp_path):
+    """THE reload acceptance leg: a pool serving snapshot step 1 takes
+    continuous concurrent traffic while `reload()` promotes snapshot
+    step 4 (the newest valid). Zero requests dropped; every response
+    bit-matches EITHER the old or the new reference engine (the swap is
+    per-replica, so both generations serve during the transition); after
+    reload() returns, responses are bit-exact from the NEW weights."""
+    ck, pred_name = _train_two_snapshots(tmp_path)
+    pool = serving.ReplicaPool(
+        checkpoint_dir=ck, fetch_list=[pred_name], step=1, replicas=2,
+        batch_buckets=[4], max_queue_delay_ms=2,
+        place=fluid.CPUPlace(), check_finite=True)
+    ref_old = serving.InferenceEngine.from_checkpoint(
+        ck, fetch_list=[pred_name], step=1, batch_buckets=[4])
+    ref_new = serving.InferenceEngine.from_checkpoint(
+        ck, fetch_list=[pred_name], step=4, batch_buckets=[4])
+    fetch = ref_old.fetch_names[0]
+    # sanity: the promotion actually changes the weights
+    rng = np.random.RandomState(6)
+    probe_feed = {"x": rng.rand(2, 6).astype("f")}
+    a, _ = ref_old.run_direct(probe_feed, batch_bucket=4)
+    b, _ = ref_new.run_direct(probe_feed, batch_bucket=4)
+    assert not np.array_equal(a[fetch], b[fetch])
+
+    stop = threading.Event()
+    outcomes, lock = [], threading.Lock()
+
+    def client(cid):
+        r = np.random.RandomState(100 + cid)
+        while not stop.is_set():
+            feed = {"x": r.rand(1, 6).astype("f")}
+            try:
+                fut = pool.submit(feed)
+                got = fut.result(30).numpy()[fetch]
+            except Exception as e:  # noqa: BLE001 — client-visible = fail
+                with lock:
+                    outcomes.append(("error", repr(e)))
+                continue
+            w_old, _ = ref_old.run_direct(feed, batch_bucket=fut.bucket[0])
+            w_new, _ = ref_new.run_direct(feed, batch_bucket=fut.bucket[0])
+            if np.array_equal(got, w_old[fetch]):
+                tag = "old"
+            elif np.array_equal(got, w_new[fetch]):
+                tag = "new"
+            else:
+                tag = "MISMATCH"
+            with lock:
+                outcomes.append((tag, None))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)                      # traffic flowing on step-1
+    # default source: "newest valid snapshot NOW" — the trainer-promotes
+    # flow (the pool was pinned to step 1; drop the pin)
+    served = pool.reload(step=4)
+    time.sleep(0.3)                      # traffic flowing on step-4
+    stop.set()
+    for t in threads:
+        t.join()
+    assert served == 4
+    tags = [t for t, _ in outcomes]
+    assert "error" not in tags, outcomes[:5]      # zero dropped requests
+    assert "MISMATCH" not in tags                 # never garbage bits
+    assert "old" in tags and "new" in tags, set(tags)
+    # after reload() returned, responses come from the NEW weights only,
+    # bit-exact vs a fresh engine on the promoted snapshot
+    for _ in range(6):
+        feed = {"x": rng.rand(1, 6).astype("f")}
+        fut = pool.submit(feed)
+        got = fut.result(30).numpy()[fetch]
+        want, _ = ref_new.run_direct(feed, batch_bucket=fut.bucket[0])
+        np.testing.assert_array_equal(got, want[fetch])
+    assert all(rep.generation == 1 for rep in pool._replicas)
+    assert pool.metrics.snapshot()["reloads_total"] == 1
+    pool.close()
+    ref_old.close()
+    ref_new.close()
+
+
+def test_pool_reload_model_dir_zero_drops(tmp_path):
+    """Model-dir pools reload too (same weights here — the event under
+    test is the swap-under-load): every in-flight and trailing request
+    completes bit-exact, nothing dropped."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2, max_queue_delay_ms=10)
+    ref = _reference(d)
+    rng = np.random.RandomState(15)
+    feeds = [{"x": rng.rand(1, 6).astype("f")} for _ in range(20)]
+    futures = _concurrent(pool, feeds[:10])
+    reloader = threading.Thread(target=pool.reload,
+                                kwargs={"model_dir": d})
+    reloader.start()
+    futures += _concurrent(pool, feeds[10:])
+    reloader.join(60)
+    assert not reloader.is_alive()
+    errors = _collect_bit_exact(pool, ref, feeds, futures)
+    assert errors == []
+    assert all(rep.generation == 1 for rep in pool._replicas)
+    pool.close()
+    ref.close()
+
+
+# --------------------------------------------------------------------------
+# HTTP integration: per-replica metrics labels, pool state in /healthz
+# --------------------------------------------------------------------------
+
+def test_pool_http_server_integration(tmp_path):
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2, name="hm")
+    server = serving.ModelServer(pool, port=0).start()
+    base = "http://%s" % server.address
+    rng = np.random.RandomState(2)
+    xs = rng.rand(2, 6).astype("f")
+    try:
+        body = json.dumps({"inputs": {"x": xs.tolist()}}).encode()
+        resp = json.loads(urllib.request.urlopen(urllib.request.Request(
+            base + "/v1/models/hm:predict", data=body,
+            headers={"Content-Type": "application/json"})).read())
+        want, _ = pool.run_direct({"x": xs}, batch_bucket=4)
+        np.testing.assert_allclose(
+            np.asarray(resp["outputs"][pool.fetch_names[0]], "f"),
+            want[pool.fetch_names[0]], rtol=1e-6)
+
+        health = json.loads(urllib.request.urlopen(
+            base + "/healthz").read())
+        assert health["status"] == "ok"
+        assert health["pools"]["hm"]["healthy"] == 2
+        assert len(health["pools"]["hm"]["replicas"]) == 2
+
+        text = urllib.request.urlopen(base + "/metrics").read().decode()
+        # per-replica labels on the serving families...
+        assert 'ptpu_serving_qps{model="hm",replica="0"}' in text
+        assert 'ptpu_serving_qps{model="hm",replica="1"}' in text
+        # ...pool families present...
+        assert 'ptpu_serving_replica_state{model="hm",replica="0"} 0' \
+            in text
+        assert 'ptpu_serving_pool_retries_total{model="hm"}' in text
+        # ...and HELP/TYPE exactly once per family (Prometheus rejects
+        # the whole scrape otherwise)
+        assert text.count("# TYPE ptpu_serving_qps gauge") == 1
+        assert text.count(
+            "# TYPE ptpu_serving_replica_state gauge") == 1
+
+        # kill every replica: /healthz must go 503 BEFORE the LB finds
+        # out the hard way (process up, pool unroutable)
+        pool.kill_replica(0)
+        pool.kill_replica(1)
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(base + "/healthz")
+        assert he.value.code == 503
+        assert json.loads(he.value.read())["pools"]["hm"]["healthy"] == 0
+    finally:
+        server.shutdown()
+
+
+def test_pool_selfcheck_cli_kill_replica(tmp_path):
+    """The deploy gate end to end as a subprocess: ptpu_serve
+    --replicas 2 --selfcheck with --kill-replica must pass (exit 0,
+    zero mismatches) — the failover invariant wired into CI the same
+    way an operator would wire it into a deploy."""
+    import subprocess
+    import sys
+    d = _save_dense_model(tmp_path)
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "ptpu_serve.py"),
+         d, "--replicas", "2", "--selfcheck", "24", "--kill-replica",
+         "1", "--max-batch", "4"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["selfcheck"] == "pass"
+    assert rec["mismatches"] == 0
+    assert rec["killed_replica"] == 1
+    assert rec["pool"]["replicas"][1]["dead"] is True
+    # the victim took traffic before the kill, the survivor after
+    assert rec["pool"]["replicas"][0]["dispatches"] > 0

@@ -3,9 +3,15 @@
 
     tools/ptpu_serve.py <model-dir> [--port 8080] [--host 127.0.0.1]
         [--format auto|native|reference] [--params-filename NAME]
-        [--name NAME] [--place cpu|tpu]
+        [--name NAME] [--place cpu|tpu] [--replicas N]
         [--warmup-buckets 1,4,8x32,8x64] [--max-batch 32]
         [--max-delay-ms 5] [--deadline-ms N] [--queue-capacity 256]
+
+`--replicas N` serves N engine replicas behind one endpoint (a
+`serving.ReplicaPool`): least-loaded routing, per-replica health-gated
+circuit breakers, failover with bounded retry, adaptive admission, and
+zero-downtime weight reload. /metrics labels every serving family
+{model, replica}; /healthz carries the pool state.
 
 `--warmup-buckets` configures the (batch, seq) lattice: bare integers are
 batch buckets, `BxS` pairs add S to the seq-bucket set (sequence models
@@ -19,7 +25,11 @@ Deploy smoke gate:
 loads the model, fires N random requests through the REAL batcher from
 concurrent threads, compares every response bit-for-bit against a direct
 single-request Executor.run at the same bucket, prints a verdict, and
-exits nonzero on any mismatch — wire it before flipping traffic.
+exits nonzero on any mismatch — wire it before flipping traffic. With
+`--replicas N --kill-replica IDX` the gate hard-kills replica IDX while
+the first wave of requests is in flight and submits a second wave after:
+any client-visible error fails the deploy — the failover invariant
+(traffic redistributes with zero dropped requests) as a gate.
 """
 import argparse
 import json
@@ -51,10 +61,16 @@ def parse_buckets(spec):
     return sorted(batch) or None, sorted(seq) or None
 
 
-def selfcheck(engine, n_requests, rows_max=4, seed=0):
+def selfcheck(engine, n_requests, rows_max=4, seed=0, kill_replica=None):
     """Fire n random requests through the batcher concurrently; verify
     each against run_direct at the bucket the batch actually used.
-    Returns the number of mismatches (submit failures count)."""
+    Returns the number of mismatches (submit failures count).
+
+    kill_replica (pools only): hard-kill that replica index MID-GATE —
+    the first half of the requests is in flight when the replica dies,
+    the second half is submitted after. Any client-visible error or bit
+    mismatch fails the gate: this is the failover invariant (traffic
+    redistributes with zero dropped requests) as a deploy check."""
     import time
 
     import numpy as np
@@ -113,8 +129,20 @@ def selfcheck(engine, n_requests, rows_max=4, seed=0):
 
     threads = [threading.Thread(target=fire, args=(i,))
                for i in range(n_requests)]
-    for t in threads:
-        t.start()
+    if kill_replica is None:
+        for t in threads:
+            t.start()
+    else:
+        # two waves around the kill: wave 1 is in flight (some of it
+        # queued ON the victim) when the replica dies, wave 2 arrives
+        # after — both must come back complete and bit-exact
+        half = max(1, n_requests // 2)
+        for t in threads[:half]:
+            t.start()
+        time.sleep(0.05)          # let wave 1 spread across the queues
+        engine.kill_replica(kill_replica)
+        for t in threads[half:]:
+            t.start()
     for t in threads:
         t.join()
     engine.default_deadline_ms = saved_deadline
@@ -173,12 +201,35 @@ def main(argv=None):
                     help="default per-request deadline (requests may "
                          "override per call)")
     ap.add_argument("--queue-capacity", type=int, default=256)
+    ap.add_argument("--replicas", type=int, default=1, metavar="N",
+                    help="serve N engine replicas behind one endpoint "
+                         "(least-loaded routing, health-gated circuit "
+                         "breakers, failover, zero-downtime reload) — "
+                         "round-robin over the visible devices")
+    ap.add_argument("--attempt-timeout-s", type=float, default=30.0,
+                    help="pool failover: per-replica attempt timeout "
+                         "(how long a wedged replica can hold a request "
+                         "before it retries elsewhere)")
+    ap.add_argument("--hedge-delay-ms", type=float, default=None,
+                    help="pool tail hedging: duplicate a quiet request "
+                         "onto a second replica after this delay")
     ap.add_argument("--selfcheck", type=int, default=0, metavar="N",
                     help="fire N local requests through the batcher, "
                          "verify bit-exactness vs direct runs, exit "
                          "(nonzero on any mismatch) — deploy smoke gate")
+    ap.add_argument("--kill-replica", type=int, default=None,
+                    metavar="IDX",
+                    help="with --selfcheck on a --replicas pool: hard-"
+                         "kill replica IDX mid-gate; ANY client-visible "
+                         "error fails the gate (the failover invariant "
+                         "as a deploy check)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
+    if args.kill_replica is not None and not args.selfcheck:
+        ap.error("--kill-replica requires --selfcheck")
+    if args.kill_replica is not None and args.replicas < 2:
+        ap.error("--kill-replica needs --replicas >= 2 (killing the only "
+                 "replica cannot redistribute anything)")
 
     if args.place == "cpu":
         # only pin the platform for an explicitly-CPU server, and only
@@ -201,31 +252,61 @@ def main(argv=None):
     maybe_enable_aot_cache(default_aot_cache_dir())
 
     batch_buckets, seq_buckets = parse_buckets(args.warmup_buckets)
-    place = fluid.TPUPlace() if args.place == "tpu" else fluid.CPUPlace()
+    engine_kw = dict(
+        model_format=args.format, model_filename=args.model_filename,
+        params_filename=args.params_filename, name=args.name,
+        batch_buckets=batch_buckets, seq_buckets=seq_buckets,
+        max_batch_size=args.max_batch,
+        max_queue_delay_ms=args.max_delay_ms,
+        queue_capacity=args.queue_capacity, warmup=not args.no_warmup)
     try:
-        engine = serving.InferenceEngine(
-            args.model_dir, model_format=args.format,
-            model_filename=args.model_filename,
-            params_filename=args.params_filename, place=place,
-            name=args.name, batch_buckets=batch_buckets,
-            seq_buckets=seq_buckets, max_batch_size=args.max_batch,
-            max_queue_delay_ms=args.max_delay_ms,
-            queue_capacity=args.queue_capacity,
-            default_deadline_ms=args.deadline_ms,
-            warmup=not args.no_warmup)
+        if args.replicas > 1:
+            # pool placement: None = TPUPlace(i) round-robin over the
+            # visible accelerators; an explicit --place cpu pins all
+            # replicas to the host backend
+            engine_kw.pop("name")
+            engine = serving.ReplicaPool(
+                args.model_dir, replicas=args.replicas,
+                place=fluid.CPUPlace() if args.place == "cpu" else None,
+                name=args.name,
+                default_deadline_ms=args.deadline_ms,
+                attempt_timeout_s=args.attempt_timeout_s,
+                hedge_delay_ms=args.hedge_delay_ms, **engine_kw)
+        else:
+            place = (fluid.TPUPlace() if args.place == "tpu"
+                     else fluid.CPUPlace())
+            engine = serving.InferenceEngine(
+                args.model_dir, place=place,
+                default_deadline_ms=args.deadline_ms, **engine_kw)
     except fluid.ProgramVerificationError as e:
         print("ptpu_serve: model REJECTED by the static verifier:\n%s"
               % e, file=sys.stderr)
         return 2
 
     if args.selfcheck:
-        bad = selfcheck(engine, args.selfcheck)
-        snap = engine.metrics.snapshot()
-        print(json.dumps({
+        bad = selfcheck(engine, args.selfcheck,
+                        kill_replica=args.kill_replica)
+        if hasattr(engine, "replica_metrics"):   # pool: aggregate
+            snaps = [m.snapshot()
+                     for m in engine.replica_metrics().values()]
+            batches = sum(s["batches_total"] for s in snaps)
+            occupancy = round(
+                sum(s["batches_total"] * s["mean_batch_occupancy"]
+                    for s in snaps) / max(batches, 1), 3)
+        else:
+            snap = engine.metrics.snapshot()
+            batches = snap["batches_total"]
+            occupancy = snap["mean_batch_occupancy"]
+        record = {
             "selfcheck": "pass" if bad == 0 else "fail",
             "requests": args.selfcheck, "mismatches": bad,
-            "mean_batch_occupancy": snap["mean_batch_occupancy"],
-            "batches": snap["batches_total"]}))
+            "mean_batch_occupancy": occupancy, "batches": batches}
+        if args.replicas > 1:
+            record["replicas"] = args.replicas
+            record["pool"] = engine.pool_state()
+            if args.kill_replica is not None:
+                record["killed_replica"] = args.kill_replica
+        print(json.dumps(record))
         engine.close()
         return 1 if bad else 0
 
