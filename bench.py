@@ -38,6 +38,9 @@ def _error_line(msg):
         return {"metric": "compile_cache_serving_warmup", "value": 0.0,
                 "unit": "x cold/warm warmup_s", "vs_baseline": None,
                 "error": msg}
+    if os.environ.get("BENCH_SHARDED") == "1":
+        return {"metric": "sharded_update_steps_per_sec", "value": 0.0,
+                "unit": "steps/sec", "vs_baseline": None, "error": msg}
     model = os.environ.get("BENCH_MODEL", "resnet50")
     decode = os.environ.get("BENCH_DECODE") == "1"
     token_metric = {"transformer": "transformer_cached_decode_throughput"
@@ -799,6 +802,129 @@ def bench_ckpt():
     }))
 
 
+def bench_sharded():
+    """BENCH_SHARDED=1: ZeRO-style sharded weight update vs the
+    replicated data-parallel baseline (parallel/plan.py,
+    ARCHITECTURE.md §21). Trains the same Adam MLP twice on an N-device
+    mesh from identical init — replicated update state vs
+    `sharded_weight_update=True` — and reports steps/s for both, the
+    per-chip update-state bytes each plan's memory accounting prices
+    (the 1/N the sharding exists to buy), and the max absolute fetch
+    divergence between the two loss streams (must be 0: sharding the
+    update never changes the math). One JSON line.
+
+    Knobs: BENCH_STEPS (timed steps), BENCH_WARMUP, BENCH_BATCH (global
+    batch, split over the mesh), BENCH_SHARDED_DIM (MLP width — scales
+    the update-state bytes), BENCH_SHARDED_DEVICES (mesh size, default
+    every visible device)."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.core.utils import device_fetch_barrier
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    n = int(os.environ.get("BENCH_SHARDED_DEVICES",
+                           str(len(jax.devices()))))
+    if n < 2:
+        print(json.dumps(_error_line(
+            "BENCH_SHARDED needs a multi-device mesh (%d visible); on "
+            "CPU run under XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=N" % n)))
+        sys.stdout.flush()
+        os._exit(2)
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    if batch % n:
+        batch = ((batch + n - 1) // n) * n  # divisibility contract
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "30")))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    dim = int(os.environ.get("BENCH_SHARDED_DIM", "256"))
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = 5
+    startup.random_seed = 5
+    with fluid.unique_name.guard(), fluid.program_guard(main_prog,
+                                                        startup):
+        x = fluid.layers.data(name="x", shape=[dim], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=dim, act="tanh")
+        h = fluid.layers.fc(input=h, size=dim, act="tanh")
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        # Adam: the 2-moments-per-param update state the sharding halves
+        # per doubling of the mesh — the realistic ZeRO target
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(batch, dim).astype("float32")
+    ys = rng.rand(batch, 1).astype("float32")
+    feed = {"x": xs, "y": ys}
+    mesh = make_mesh({"dp": n}, jax.devices()[:n])
+    exe = fluid.Executor(fluid.TPUPlace())
+
+    results, mem, losses = {}, {}, {}
+    init = None
+    for mode in ("replicated", "sharded"):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            if init is None:
+                # REAL copies, not np.asarray views: on the CPU backend
+                # np.asarray of a jax array is zero-copy, and the
+                # donated in-place update frees the viewed buffer —
+                # the "identical init" would silently mutate under the
+                # second leg (found as a warm-compile-cache-only bench
+                # failure: cache hits shifted allocator reuse timing)
+                init = {nm: np.array(scope.get(nm), copy=True)
+                        for nm in scope.names()}
+            else:
+                for nm, v in init.items():
+                    scope.set(nm, v)
+            scope._rng_counter = 0
+            pexe = fluid.ParallelExecutor(
+                main_program=main_prog, loss_name=loss.name, mesh=mesh,
+                sharded_weight_update=(mode == "sharded"))
+            mem[mode] = pexe.plan.memory_report()
+            for _ in range(warmup):
+                pexe.run([loss.name], feed=feed)
+            handles = []
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                handles.append(pexe.run([loss.name], feed=feed,
+                                        return_numpy=False)[0])
+            device_fetch_barrier(handles[-1:])
+            dt = time.perf_counter() - t0
+            # materialize AFTER the clock: the per-step losses feed the
+            # divergence check, not the throughput number
+            losses[mode] = [float(np.ravel(np.asarray(h))[0])
+                            for h in handles]
+            results[mode] = round(steps / dt, 2)
+            assert all(np.isfinite(v) for v in losses[mode]), \
+                "non-finite loss in %s leg" % mode
+
+    divergence = max(abs(a - b) for a, b in
+                     zip(losses["replicated"], losses["sharded"]))
+    upd_r = mem["replicated"]["update_state"]["per_chip_bytes"]
+    upd_s = mem["sharded"]["update_state"]["per_chip_bytes"]
+    print(json.dumps({
+        "metric": "sharded_update_steps_per_sec",
+        "value": results["sharded"],
+        "unit": "steps/sec",
+        "vs_baseline": None,
+        "devices": n, "batch": batch, "dim": dim, "steps": steps,
+        "replicated_steps_per_sec": results["replicated"],
+        "sharded_steps_per_sec": results["sharded"],
+        "update_state_bytes_per_chip": {
+            "replicated": upd_r, "sharded": upd_s,
+            "ratio": round(upd_s / max(upd_r, 1), 4)},
+        "params_bytes_per_chip": {
+            "replicated": mem["replicated"]["params"]["per_chip_bytes"],
+            "sharded": mem["sharded"]["params"]["per_chip_bytes"]},
+        "fetch_divergence": divergence,
+        "final_loss": losses["sharded"][-1],
+        "device": str(jax.devices()[0]),
+    }))
+
+
 def bench_resil():
     """BENCH_RESIL=1: numerical-guard overhead. Trains the deep-narrow
     smoke MLP four ways — guards off/on x single-step/steps=K — and
@@ -1152,6 +1278,9 @@ def main():
         return
     if os.environ.get("BENCH_RESIL") == "1":
         bench_resil()
+        return
+    if os.environ.get("BENCH_SHARDED") == "1":
+        bench_sharded()
         return
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "transformer":

@@ -56,14 +56,13 @@ __all__ = ["CheckpointManager", "SaveHandle"]
 
 # ------------------------------------------------------------ sharding --
 def _spec_to_json(spec):
-    """PartitionSpec -> JSON list (str | [str, ...] | None per dim)."""
-    out = []
-    for p in tuple(spec):
-        if isinstance(p, (list, tuple)):
-            out.append([str(a) for a in p])
-        else:
-            out.append(None if p is None else str(p))
-    return out
+    """PartitionSpec -> JSON list (str | [str, ...] | None per dim).
+    ONE implementation, in parallel/plan.py (the plan serializes specs
+    into cache keys with the same encoding restore reads back — two
+    copies drifting would silently split placement from keying);
+    imported lazily to keep checkpoint import-light."""
+    from ..parallel.plan import _spec_to_json as impl
+    return impl(spec)
 
 
 def _adapt_spec(spec_json, mesh, shape):
@@ -92,19 +91,23 @@ def _adapt_spec(spec_json, mesh, shape):
 
 def _resolve_layout_mesh(layout):
     """restore(layout=...) accepts a parallel.DeviceLayout, a live jax
-    Mesh, or a bare device count (int) — normalize to a Mesh."""
+    Mesh, a parallel.ShardingPlan (its mesh is the target; its specs
+    become authoritative placement, see restore), or a bare device
+    count (int) — normalize to (mesh, plan-or-None)."""
     import jax
     from jax.sharding import Mesh
     if isinstance(layout, Mesh):
-        return layout
+        return layout, None
+    if hasattr(layout, "sharding_for") and hasattr(layout, "mesh"):
+        return layout.mesh, layout  # a ShardingPlan (duck-typed)
     if isinstance(layout, int):
         from ..parallel.distributed import DeviceLayout
         layout = DeviceLayout(local_device_count=layout)
     if hasattr(layout, "local_mesh"):
-        return layout.local_mesh()
+        return layout.local_mesh(), None
     raise TypeError(
-        "restore(layout=...) wants a parallel.DeviceLayout, a jax Mesh "
-        "or a device count, got %r" % (layout,))
+        "restore(layout=...) wants a parallel.DeviceLayout, a jax Mesh, "
+        "a parallel.ShardingPlan or a device count, got %r" % (layout,))
 
 
 def _capture_value(val):
@@ -451,11 +454,17 @@ class CheckpointManager(object):
         in the manifest, and live reader states recorded in the snapshot
         must exist in the scope (run the startup program first).
 
-        `layout` (a parallel.DeviceLayout, a jax Mesh, or a device
-        count) RESHARDS the restore onto that target: every loaded
-        value is device_put with its recorded source PartitionSpec
-        adapted to the target mesh (absent axes dropped, non-dividing
-        dims replicated; values recorded without a spec replicate).
+        `layout` (a parallel.DeviceLayout, a jax Mesh, a
+        parallel.ShardingPlan, or a device count) RESHARDS the restore
+        onto that target: every loaded value is device_put with its
+        recorded source PartitionSpec adapted to the target mesh
+        (absent axes dropped — the update-state shard axis included —
+        non-dividing dims replicated; values recorded without a spec
+        replicate). A ShardingPlan target goes further: for every var
+        the plan covers, the PLAN's spec is authoritative (still
+        divisibility-guarded), so the restored state lands exactly in
+        the layout the new cohort's ParallelExecutor will run it under
+        — no second device_put on the first step.
         The snapshot may have been written under a different device
         count — persisted arrays are global, so shrink (M<N), grow
         (M>N) and same-shape (M=N) all load the same bytes; at M=N the
@@ -467,8 +476,8 @@ class CheckpointManager(object):
         scope = scope if scope is not None else global_scope()
         # resolve the target mesh FIRST: an unsatisfiable layout must
         # raise before any snapshot bytes (or scope writes) are touched
-        target_mesh = None if layout is None else _resolve_layout_mesh(
-            layout)
+        target_mesh, target_plan = (None, None) if layout is None \
+            else _resolve_layout_mesh(layout)
         # resume entry point: sweep dead writers' droppings first — this
         # also RECOVERS a step dir a killed same-step re-save left parked
         # as step_<N>.old.<pid> (see snapshot.clean_stale_tmp)
@@ -526,9 +535,18 @@ class CheckpointManager(object):
                 from jax.sharding import NamedSharding
                 placed = {}
                 for name, arr in loaded.items():
-                    spec = _adapt_spec(
-                        manifest.get(name, {}).get("sharding"),
-                        target_mesh, np.shape(arr))
+                    spec_json = manifest.get(name, {}).get("sharding")
+                    if target_plan is not None:
+                        plan_spec = target_plan.spec_for(name)
+                        if plan_spec is not None:
+                            # the new world's plan wins over the
+                            # recorded source spec — but through the
+                            # same divisibility guard, so a plan built
+                            # for a different program shape can't split
+                            # a value unevenly
+                            spec_json = _spec_to_json(plan_spec)
+                    spec = _adapt_spec(spec_json, target_mesh,
+                                       np.shape(arr))
                     placed[name] = jax.device_put(
                         arr, NamedSharding(target_mesh, spec))
                 loaded = placed

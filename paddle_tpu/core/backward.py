@@ -69,14 +69,30 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
     _backward_sweep(block, path_flags, needed, no_grad, {loss.name}, fwd_len)
 
-    # collect (param, grad) pairs
+    # collect (param, grad) pairs — in CANONICAL (sorted-by-name) order,
+    # not construction order. The pair order drives everything the
+    # optimizer appends downstream: gradient-clip/regularization ops,
+    # accumulator creation (whose unique_name counters land in var
+    # names) and the per-param update ops. Construction order is
+    # insertion order today, but nothing asserts it stays hash-seed-free
+    # as builders evolve — and the PR-6 no_grad_names bug showed what a
+    # set-ordered tuple in program bytes costs: byte-identical model
+    # builds serializing differently per process, re-keying the
+    # persistent compile cache and the ShardingPlan's shard walk on
+    # every restart. Sorting here makes the program bytes, the plan and
+    # the cache key restart-stable by construction (asserted again in
+    # Optimizer._create_optimization_pass, the contract's consumer).
     if parameter_list is not None:
         params = [block.var_recursive(p) if isinstance(p, str) else p
                   for p in parameter_list]
     else:
         params = [p for p in block.program.all_parameters() if p.trainable]
+    names = [p.name for p in params]
+    assert len(set(names)) == len(names), \
+        "duplicate parameter names break the canonical grad-pair order: %r" \
+        % sorted(n for n in names if names.count(n) > 1)
     pairs = []
-    for p in params:
+    for p in sorted(params, key=lambda p: p.name):
         g = block.vars.get(grad_var_name(p.name))
         if g is not None and p.name in needed:
             pairs.append((p, g))

@@ -157,6 +157,86 @@ def maybe_enable_persistent_cache(default_dir=None):
     return _enabled_dir
 
 
+import contextlib
+import threading
+
+# donating_multidevice_compile_guard state: a refcount so OVERLAPPING
+# guarded compiles on different threads keep the cache suspended until
+# the LAST one exits — restoring while another thread's donating
+# compile is still in flight would let that compile store/load through
+# the cache, the exact corruption the guard exists to prevent.
+_guard_lock = threading.Lock()
+_guard_depth = 0
+_guard_prev = None
+
+
+@contextlib.contextmanager
+def donating_multidevice_compile_guard():
+    """Suspend the jax persistent compilation cache around the FIRST
+    call of a DONATING ParallelExecutor jit (the call that compiles).
+
+    Why: in this jax, executables that round-trip through serialization
+    lose buffer-donation integrity — PR 6 bisected it for
+    serialize_executable (the AOT cache compiles donation-free as the
+    workaround), and the SAME failure class surfaces through jax's own
+    persistent HLO cache for multi-device executables: a warm-cache
+    ParallelExecutor training step nondeterministically reads/writes
+    freed donated buffers, producing silently wrong numerics (measured:
+    ~3 in 4 warm runs of the BENCH_SHARDED two-leg bench diverged, up
+    to completely different loss trajectories; with donation stripped
+    OR the cache suspended, 0 in 40+). The single-device Executor's
+    donating jits have run warm-cache through the whole suite since
+    PR 6 without a flake and keep the cache; EVERY ParallelExecutor
+    donating compile opts out, mesh size 1 included — a 1-device mesh
+    still produces the same pxla executable class, and losing one warm
+    start is cheaper than extending the corruption surface.
+
+    Cost: ParallelExecutor programs don't warm-start from the HLO cache
+    — the AOT artifact cache (donation-free by construction, hash
+    verified) is the supported cold-start path for them. The guard is
+    REFCOUNTED: overlapping guarded compiles keep the cache suspended
+    until the last exits; an unguarded compile on another thread during
+    that window simply skips the cache once (correctness unaffected)."""
+    import jax
+    global _guard_depth, _guard_prev
+    try:
+        from jax._src import compilation_cache as _cc
+        reset = _cc.reset_cache
+    except (ImportError, AttributeError):
+        # no reset hook on this jax: the used/unused decision is
+        # latched per process, so flipping the dir alone cannot opt a
+        # compile out — warn (once) that PE numerics depend on a cold
+        # cache and proceed without the guard
+        if jax.config.jax_compilation_cache_dir:
+            _warn_once(
+                "donating-compile-guard",
+                "this jax cannot suspend the persistent compilation "
+                "cache per-compile (no compilation_cache.reset_cache); "
+                "ParallelExecutor warm starts may hit the "
+                "donation-after-deserialization bug — clear "
+                "FLAGS_compile_cache_dir for multi-device training")
+        yield
+        return
+    with _guard_lock:
+        if _guard_depth == 0:
+            prev = jax.config.jax_compilation_cache_dir
+            if prev:
+                _guard_prev = prev
+                jax.config.update("jax_compilation_cache_dir", None)
+                reset()  # drop the "cache used" latch + handle
+        _guard_depth += 1
+    try:
+        yield
+    finally:
+        with _guard_lock:
+            _guard_depth -= 1
+            if _guard_depth == 0 and _guard_prev is not None:
+                jax.config.update("jax_compilation_cache_dir",
+                                  _guard_prev)
+                _guard_prev = None
+                reset()  # re-latch against the restored dir
+
+
 # ------------------------------------------------------ AOT artifact cache
 def default_aot_cache_dir():
     """Per-user default for the AOT artifact cache (see default_cache_dir

@@ -187,10 +187,27 @@ class EnvReadError(KeyError):
 
 
 class Env(object):
-    """Name -> traced value mapping for one lowering pass."""
+    """Name -> traced value mapping for one lowering pass.
 
-    def __init__(self):
+    `constraints` ({name: NamedSharding}, optional) is the ShardingPlan's
+    gradient-placement seam: every write/accumulate of a constrained name
+    pins the traced value with `lax.with_sharding_constraint`, so GSPMD
+    lowers a sharded param's gradient sum as reduce-scatter onto the
+    owner's shard instead of a full all-reduce (parallel/plan.py
+    grad_constraints; ARCHITECTURE.md §21). Applied per partial
+    accumulation too — constraining each contribution keeps the running
+    sum on the shard layout throughout the backward."""
+
+    def __init__(self, constraints=None):
         self.values = {}
+        self._constraints = constraints or None
+
+    def _constrain(self, name, value):
+        if self._constraints is not None and _is_traced_array(value):
+            sharding = self._constraints.get(name)
+            if sharding is not None:
+                return jax.lax.with_sharding_constraint(value, sharding)
+        return value
 
     def read(self, name):
         if name not in self.values:
@@ -210,11 +227,12 @@ class Env(object):
         return v
 
     def write(self, name, value):
-        self.values[name] = value
+        self.values[name] = self._constrain(name, value)
 
     def accumulate(self, name, value):
         cur = self.read_opt(name)
-        self.values[name] = value if cur is None else cur + value
+        self.values[name] = self._constrain(
+            name, value if cur is None else cur + value)
 
     def __contains__(self, name):
         return name in self.values
@@ -591,7 +609,8 @@ def _lower_grad_of(ctx, op, env):
 
 
 def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
-                     state_out, mesh=None, collect_errors=False):
+                     state_out, mesh=None, collect_errors=False,
+                     shard_constraints=None):
     """Build the pure function for a Program.
 
     fn(feed_vals, state_rw_vals, state_ro_vals, seed)
@@ -607,6 +626,11 @@ def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
     (e.g. TensorArray capacity overflows) the caller must raise on — the
     checkify-style escape hatch for conditions only detectable inside lax
     control flow, where Python can't raise.
+
+    shard_constraints ({var name: NamedSharding}, ParallelExecutor only):
+    values written under these names are pinned with
+    with_sharding_constraint as they are produced — the ShardingPlan's
+    gradient reduce-scatter placement (see Env).
     """
     def fn(feed_vals, state_rw_vals, state_ro_vals, seed):
         base_key = jax.random.fold_in(
@@ -616,7 +640,7 @@ def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
         # (fetches, persistable state) and everything fed from outside
         ctx.remat_keep = (set(fetch_names) | set(state_out) | set(state_rw)
                          | set(state_ro) | set(feed_names))
-        env = Env()
+        env = Env(constraints=shard_constraints)
         for n, v in zip(feed_names, feed_vals):
             env.write(n, v)
         for n, v in zip(state_rw, state_rw_vals):
@@ -715,7 +739,8 @@ def resolve_multistep_unroll(platform=None):
 
 def lower_multi_step(program, feed_names, fetch_names, state_rw, state_ro,
                      state_out, steps, fetch_reduce="stack",
-                     stacked_feed_names=(), mesh=None, unroll=False):
+                     stacked_feed_names=(), mesh=None, unroll=False,
+                     shard_constraints=None):
     """K-step device-resident training loop around build_program_fn.
 
     Returns fn(feed_vals, state_rw_vals, state_ro_vals, seed) with the SAME
@@ -750,7 +775,8 @@ def lower_multi_step(program, feed_names, fetch_names, state_rw, state_ro,
                          % (FETCH_REDUCE_POLICIES, fetch_reduce))
     step_fn = build_program_fn(program, feed_names, fetch_names, state_rw,
                                state_ro, state_out, mesh=mesh,
-                               collect_errors=True)
+                               collect_errors=True,
+                               shard_constraints=shard_constraints)
     rw_pos = {n: i for i, n in enumerate(state_rw)}
     out_pos = {n: i for i, n in enumerate(state_out)}
     stacked = frozenset(stacked_feed_names)

@@ -122,6 +122,13 @@ class Optimizer(object):
 
     def _add_accumulator(self, name, param, dtype="float32", fill_value=0.0,
                          shape=None):
+        # called in the canonical sorted-param order established by
+        # _create_optimization_pass (ModelAverage's construction-time
+        # sums ride all_parameters' insertion order, which is
+        # deterministic per build): the unique_name counter baked into
+        # the accumulator's name (and so into the program bytes, the
+        # compile-cache key and the ShardingPlan walk) must not depend
+        # on a caller-assembled order
         if param.name in self._accumulators[name]:
             return self._accumulators[name][param.name]
         if shape is None:
@@ -150,6 +157,21 @@ class Optimizer(object):
     def _create_optimization_pass(self, parameters_and_grads, loss,
                                   startup_program=None):
         program = loss.block.program
+        # Canonical order contract (ARCHITECTURE.md §21): accumulators
+        # are created — and update ops appended — in sorted-param-name
+        # order, never whatever order the caller assembled. Accumulator
+        # names carry unique_name counters, so the iteration order here
+        # IS part of the serialized program bytes: a hash-seed- or
+        # caller-order-dependent walk would re-key the persistent
+        # compile cache and shuffle the ShardingPlan's shard walk on
+        # every process restart. append_backward already returns pairs
+        # sorted; re-sort + assert here so a hand-built list gets the
+        # same guarantee.
+        parameters_and_grads = sorted(parameters_and_grads,
+                                      key=lambda pg: pg[0].name)
+        names = [p.name for p, _ in parameters_and_grads]
+        assert len(set(names)) == len(names), \
+            "duplicate params break the canonical update order: %r" % names
         with program_guard(program, startup_program or
                            default_startup_program()):
             self.helper = LayerHelper(self.__class__.__name__)

@@ -2,6 +2,7 @@
 from .mesh import make_mesh, data_parallel_mesh, replicated, batch_sharded, \
     Mesh, NamedSharding, P
 from .parallel_executor import ParallelExecutor
+from .plan import ShardingPlan, VarPlan
 from .ring_attention import ring_attention, ring_attention_sharded, \
     attention_reference, sequence_parallel_specs
 from .pipeline import pipeline_apply, pipeline_stages_spec, \

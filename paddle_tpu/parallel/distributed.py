@@ -55,13 +55,23 @@ class DeviceLayout(object):
     `process_index`, each using `local_device_count` of its devices with
     `mesh_axes` laid over them. JSON round-trips (checkpoint metadata,
     the cluster plan), and `local_mesh()` materializes the jax Mesh this
-    process trains on — the restore-time resharding target."""
+    process trains on — the restore-time resharding target.
+
+    `shard_axis` names the mesh axis the ShardingPlan splits the weight
+    update over (params + optimizer accumulators, parallel/plan.py).
+    None (the default) means update state follows `batch_axis` — the
+    standard ZeRO-over-dp layout; a distinct axis (e.g. a dp×zero mesh)
+    is named explicitly. Serialized in to_json/from_json so a snapshot
+    records which axis its sharded update state was split over and a
+    resharding restore (checkpoint/manager.py `_adapt_spec`) can drop or
+    re-divide that axis on the target layout's mesh."""
 
     __slots__ = ("num_processes", "process_index", "local_device_count",
-                 "mesh_axes", "batch_axis")
+                 "mesh_axes", "batch_axis", "shard_axis")
 
     def __init__(self, num_processes=1, process_index=0,
-                 local_device_count=None, mesh_axes=None, batch_axis="dp"):
+                 local_device_count=None, mesh_axes=None, batch_axis="dp",
+                 shard_axis=None):
         self.num_processes = int(num_processes)
         self.process_index = int(process_index)
         if not (0 <= self.process_index < self.num_processes):
@@ -72,6 +82,11 @@ class DeviceLayout(object):
                                    else int(local_device_count))
         self.mesh_axes = dict(mesh_axes) if mesh_axes else {batch_axis: -1}
         self.batch_axis = batch_axis
+        if shard_axis is not None and shard_axis not in self.mesh_axes:
+            raise ValueError(
+                "shard_axis %r is not one of the layout's mesh axes %r"
+                % (shard_axis, sorted(self.mesh_axes)))
+        self.shard_axis = shard_axis
 
     @property
     def total_device_count(self):
@@ -97,12 +112,19 @@ class DeviceLayout(object):
                 "for a virtual CPU mesh)" % (want, len(devices), want))
         return make_mesh(self.mesh_axes, devices[:want])
 
+    def resolved_shard_axis(self):
+        """The axis update-state sharding uses: `shard_axis` when named,
+        else the batch axis (ZeRO-over-dp default)."""
+        return self.shard_axis if self.shard_axis is not None \
+            else self.batch_axis
+
     def to_json(self):
         return {"num_processes": self.num_processes,
                 "process_index": self.process_index,
                 "local_device_count": self.local_device_count,
                 "mesh_axes": dict(self.mesh_axes),
-                "batch_axis": self.batch_axis}
+                "batch_axis": self.batch_axis,
+                "shard_axis": self.shard_axis}
 
     @classmethod
     def from_json(cls, d):
@@ -110,7 +132,8 @@ class DeviceLayout(object):
                    process_index=d.get("process_index", 0),
                    local_device_count=d.get("local_device_count"),
                    mesh_axes=d.get("mesh_axes"),
-                   batch_axis=d.get("batch_axis", "dp"))
+                   batch_axis=d.get("batch_axis", "dp"),
+                   shard_axis=d.get("shard_axis"))
 
     def __eq__(self, other):
         return isinstance(other, DeviceLayout) \
@@ -121,8 +144,11 @@ class DeviceLayout(object):
 
     def __repr__(self):
         return ("DeviceLayout(procs=%d, rank=%d, local_devices=%s, "
-                "axes=%r)" % (self.num_processes, self.process_index,
-                              self.local_device_count, self.mesh_axes))
+                "axes=%r%s)" % (
+                    self.num_processes, self.process_index,
+                    self.local_device_count, self.mesh_axes,
+                    ", shard_axis=%r" % self.shard_axis
+                    if self.shard_axis is not None else ""))
 
 
 def active_layout():

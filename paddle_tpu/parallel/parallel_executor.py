@@ -5,16 +5,19 @@ parallel_executor.cc + details/ (SSA graph, NCCL allreduce op handles,
 num_threads / allow_op_delay scheduling knobs).
 
 TPU-native design: NO replicated programs, NO explicit allreduce. The same
-whole-program XLA function the single-chip Executor builds is jitted with
-GSPMD shardings — feeds sharded on the batch dim over the 'dp' mesh axis,
-params/optimizer state replicated. XLA then partitions the computation and
-inserts gradient all-reduces over ICI automatically, overlapping them with
-the backward pass (what the reference's allow_op_delay tried to approximate
-by hand). The scheduling knobs are accepted and ignored — XLA owns the
-schedule.
+whole-program XLA function the single-chip Executor builds is jitted
+(pjit) under an explicit ShardingPlan (parallel/plan.py): feeds sharded
+on the batch dim over the 'dp' mesh axis, params/optimizer state placed
+per the plan — replicated in the reference-parity default, split 1/N
+over the shard axis with `sharded_weight_update=True` (ZeRO-style,
+arXiv:2004.13336: grads reduce-scatter onto the owning shard, the update
+runs on the shard, params all-gather on use). XLA partitions the
+computation and inserts the collectives over ICI automatically,
+overlapping them with the backward pass (what the reference's
+allow_op_delay tried to approximate by hand). The scheduling knobs are
+accepted and ignored — XLA owns the schedule.
 """
 import collections
-import re
 import time as _time
 
 import numpy as np
@@ -31,6 +34,9 @@ from ..core.executor import (global_scope, _feed_signature,
                              _cache_put_lru, _jit_cache_capacity)
 from ..core.utils import find_var as _find_var
 from .mesh import data_parallel_mesh, replicated, batch_sharded, NamedSharding, P
+from .plan import ShardingPlan, _match_accumulator_param  # noqa: F401
+# (_match_accumulator_param re-exported: the fallback attribution moved
+# into plan.py with the rest of the partitioner)
 
 
 def _var_batch_leading(v):
@@ -42,66 +48,78 @@ def _var_batch_leading(v):
     return not shape or shape[0] in (-1, None)
 
 
-def _match_accumulator_param(vname, params_by_len_desc):
-    """Fallback accumulator->param attribution by the naming convention
-    "<acc>_<param>_<n>" when program._accumulator_owner has no entry.
-    params_by_len_desc must be sorted longest-first so `fc.w` never claims
-    `my_fc.w`'s accumulator."""
-    return next(
-        (p for p in params_by_len_desc
-         if re.search(r"(^|_)%s(_\d+)?$" % re.escape(p), vname)),
-        None)
-
-
 class ParallelExecutor(object):
     def __init__(self, use_cuda=None, loss_name=None, main_program=None,
                  num_threads=None, allow_op_delay=False, share_vars_from=None,
                  use_tpu=None, devices=None, mesh=None, param_shardings=None,
-                 batch_axis="dp", check_nan_inf=None,
-                 sharded_weight_update=False):
+                 batch_axis=None, check_nan_inf=None,
+                 sharded_weight_update=False, plan=None, shard_axis=None):
         self._program = main_program if main_program is not None \
             else default_main_program()
         self._validated = set()  # strict-mode analysis cache (see run)
+        if plan is not None:
+            # the plan IS the distribution config: silently ignoring a
+            # conflicting mesh/partitioner kwarg would split placement
+            # across two meshes (state per plan.mesh, feeds per the
+            # other) or drop overrides the caller thinks are in force
+            if mesh is not None and mesh != plan.mesh:
+                raise ValueError(
+                    "plan= was built over mesh %r but mesh= is %r — "
+                    "pass one or the other"
+                    % (dict(plan.mesh.shape), dict(mesh.shape)))
+            if param_shardings or sharded_weight_update \
+                    or shard_axis is not None:
+                raise ValueError(
+                    "plan= already decides param_shardings / "
+                    "sharded_weight_update / shard_axis; build the "
+                    "plan with those (ShardingPlan.build) instead of "
+                    "passing both")
+            if batch_axis is not None and batch_axis != plan.batch_axis:
+                raise ValueError(
+                    "plan= was built with batch_axis=%r but "
+                    "batch_axis=%r was passed — the plan decides"
+                    % (plan.batch_axis, batch_axis))
+            mesh = plan.mesh
         self.mesh = mesh if mesh is not None else data_parallel_mesh(
             devices=devices)
-        # param name -> PartitionSpec for model/tensor parallelism; anything
-        # absent is replicated (pure data parallel, the reference's only mode)
-        self._param_shardings = dict(param_shardings or {})
-        self._batch_axis = batch_axis
-        # ZeRO-style cross-replica weight-update sharding (Xu et al. 2020,
-        # arXiv:2004.13336): params + their optimizer accumulators are laid
-        # out sharded over the dp axis, so GSPMD turns the gradient
-        # all-reduce into reduce-scatter, each replica updates only its
-        # shard, and the new weights are all-gathered for the next step.
-        # Optimizer-state memory drops ~dp-fold. Explicit param_shardings
-        # win over the automatic assignment.
-        if sharded_weight_update:
-            self._param_shardings = dict(
-                self._auto_weight_update_shardings(),
-                **self._param_shardings)
-        # ParamAttr(mesh_axes=...) annotations: Program-reachable tensor
-        # parallelism. Precedence: explicit param_shardings > mesh_axes >
-        # auto ZeRO (an annotated param keeps its TP layout even under
-        # sharded_weight_update — its optimizer accumulators follow it so
-        # param and moments never sit in conflicting layouts). An
-        # annotation whose axes are ALL absent from this mesh is a no-op
-        # (the same model definition reused on a dp-only mesh keeps its
-        # ZeRO sharding instead of degrading to full replication).
-        explicit = dict(param_shardings or {})
-        acc_owner = getattr(self._program, "_accumulator_owner", {})
-        for p_ in self._program.global_block().all_parameters():
-            axes = getattr(p_, "mesh_axes", None)
-            if not axes or p_.name in explicit:
-                continue
-            resolved = [a if a in self.mesh.axis_names else None
-                        for a in axes]
-            if all(a is None for a in resolved):
-                continue
-            spec = P(*resolved)
-            self._param_shardings[p_.name] = spec
-            for acc, owner in acc_owner.items():
-                if owner == p_.name and acc not in explicit:
-                    self._param_shardings[acc] = spec
+        self._batch_axis = plan.batch_axis if plan is not None \
+            else (batch_axis if batch_axis is not None else "dp")
+        # The distribution plan (parallel/plan.py, ARCHITECTURE.md §21):
+        # every param, gradient and optimizer accumulator gets a
+        # PartitionSpec over the mesh. sharded_weight_update=True arms
+        # the ZeRO-style assignment (Xu et al. 2020, arXiv:2004.13336):
+        # params + accumulators split dim 0 over the shard axis, so GSPMD
+        # turns the gradient all-reduce into reduce-scatter, each replica
+        # updates only its 1/N shard, and the new weights all-gather on
+        # use — optimizer-state memory drops ~N-fold. Precedence inside
+        # the partitioner: explicit param_shardings > ParamAttr
+        # mesh_axes annotations (accumulators follow) > auto ZeRO.
+        # shard_axis defaults to the batch axis, or to the active
+        # DeviceLayout's recorded shard axis when one is set (the
+        # elastic-training handoff: a resharded cohort keeps the
+        # snapshot's update-sharding axis).
+        if plan is None:
+            if shard_axis is None:
+                from .distributed import active_layout
+                lay = active_layout()
+                shard_axis = getattr(lay, "shard_axis", None) \
+                    if lay is not None else None
+                if shard_axis is not None \
+                        and shard_axis not in self.mesh.axis_names:
+                    # INHERITED from the active DeviceLayout, not
+                    # user-typed: an eval/aux executor over a plain dp
+                    # mesh in an elastic process whose cohort shards
+                    # over 'zero' must fall back leniently (like the
+                    # batch-axis default), not trip the typo guard
+                    shard_axis = None
+            plan = ShardingPlan.build(
+                self._program, self.mesh, batch_axis=self._batch_axis,
+                shard_axis=shard_axis, shard_update=sharded_weight_update,
+                overrides=param_shardings)
+        self.plan = plan
+        # legacy view: param name -> PartitionSpec for every var the plan
+        # shards (or the caller pinned); anything absent is replicated
+        self._param_shardings = plan.spec_map()
         self._cache = collections.OrderedDict()
         # XLA:CPU collectives deadlock when several executions are in
         # flight at once (each rendezvous needs one thread per virtual
@@ -115,46 +133,8 @@ class ParallelExecutor(object):
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
 
-    def _auto_weight_update_shardings(self):
-        """P(batch_axis) on dim 0 for every parameter — and every optimizer
-        accumulator, resolved via the exact acc->param map
-        Optimizer._add_accumulator records on the Program
-        (program._accumulator_owner). Only when the map has no entry (e.g. a
-        program deserialized without optimizer metadata) fall back to the
-        naming convention "<acc>_<param>_<n>", matching the LONGEST param
-        name so `fc.w` never claims `my_fc.w`'s accumulator."""
-        dp = self.mesh.shape.get(self._batch_axis, 1)
-        if dp <= 1:
-            return {}
-        specs = {}
-        params = {p.name: p.shape
-                  for p in self._program.global_block().all_parameters()}
-        for name, shape in params.items():
-            if shape and shape[0] is not None and shape[0] % dp == 0 \
-                    and int(np.prod(shape)) >= dp:
-                specs[name] = P(self._batch_axis)
-        acc_owner = getattr(self._program, "_accumulator_owner", {})
-        # fallback matching runs against ALL program parameters longest-first
-        # (not just the sharded ones) so a suffix-named param present in
-        # specs can never claim an accumulator whose true owner was merely
-        # excluded from sharding (leading dim not divisible by dp)
-        by_len = sorted(params, key=len, reverse=True)
-        for v in self._program.global_block().vars.values():
-            if v.name in specs or not getattr(v, "persistable", False):
-                continue
-            pname = acc_owner.get(v.name)
-            if pname is None:
-                pname = _match_accumulator_param(v.name, by_len)
-            if pname in specs and tuple(v.shape or ()) == tuple(
-                    params[pname] or ()):
-                specs[v.name] = specs[pname]
-        return specs
-
     def _state_sharding(self, name):
-        spec = self._param_shardings.get(name)
-        if spec is None:
-            return replicated(self.mesh)
-        return NamedSharding(self.mesh, spec)
+        return self.plan.sharding_for(name)
 
     @property
     def device_count(self):
@@ -301,18 +281,23 @@ class ParallelExecutor(object):
             out_shardings = (rep,
                              [self._state_sharding(n) for n in state_out],
                              rep)
+            # the plan's gradient constraints pin each sharded param's
+            # grad to the owner's shard layout inside the traced step, so
+            # GSPMD lowers the cross-replica gradient sum as
+            # reduce-scatter straight onto the updating shard
+            constraints = self.plan.grad_constraints() or None
             if steps > 1:
                 fn = lowering.lower_multi_step(
                     program, feed_names, fetch_names, state_rw,
                     state_ro, state_out, steps,
                     fetch_reduce=fetch_reduce,
                     stacked_feed_names=stacked_names, mesh=self.mesh,
-                    unroll=unroll)
+                    unroll=unroll, shard_constraints=constraints)
             else:
                 fn = lowering.build_program_fn(
                     program, feed_names, fetch_names, state_rw,
                     state_ro, state_out, mesh=self.mesh,
-                    collect_errors=True)
+                    collect_errors=True, shard_constraints=constraints)
             return jax.jit(fn, in_shardings=in_shardings,
                            out_shardings=out_shardings,
                            donate_argnums=(1,) if donate else ())
@@ -320,8 +305,10 @@ class ParallelExecutor(object):
         def aot_key():
             # the sharded executable is keyed on everything that shapes
             # it beyond the Executor signature — mesh topology, axis
-            # names, per-state param shardings (serialized executables
-            # bake the partitioning in)
+            # names, and the FULL ShardingPlan in canonical JSON
+            # (serialized executables bake the partitioning in; any plan
+            # change — a different shard axis, one var's override — is a
+            # different executable and must be a different key)
             aot_dir = compile_cache.active_aot_cache_dir()
             if aot_dir is None:
                 return None, None
@@ -335,9 +322,7 @@ class ParallelExecutor(object):
                     "mesh_axes": {a: int(s) for a, s in
                                   self.mesh.shape.items()},
                     "batch_axis": self._batch_axis,
-                    "param_shardings": {
-                        n: self._param_shardings[n]
-                        for n in sorted(self._param_shardings)},
+                    "plan": self.plan.to_json(),
                 })
 
         compiled = False
@@ -364,18 +349,31 @@ class ParallelExecutor(object):
                 if akey is not None:
                     try:
                         t0c = _time.perf_counter()
+
                         # serialized artifacts compile WITHOUT donation
                         # (deserialized input-output aliasing corrupts
-                        # the heap — see Executor._run_impl); lower()
-                        # only traces, so raw scope values suffice and
-                        # the explicit in_shardings decide placement
+                        # the heap — see Executor._run_impl). Lower from
+                        # AVALS, not live values: scope arrays may still
+                        # be committed to a DIFFERENT plan's layout
+                        # (fresh executor over a scope another plan
+                        # trained — the elastic-reshard handoff), and
+                        # lowering committed arrays against conflicting
+                        # explicit in_shardings raises, silently
+                        # forfeiting the artifact; the in_shardings
+                        # alone decide placement.
+                        def _aval(v):
+                            return jax.ShapeDtypeStruct(
+                                np.shape(v),
+                                getattr(v, "dtype", None)
+                                or np.asarray(v).dtype)
+
                         comp = build_jitted(
                             state_rw, state_ro, state_out,
                             donate=False).lower(
-                            [feed_arrays[n] for n in feed_names],
-                            [scope.get(n) for n in state_rw],
-                            [scope.get(n) for n in state_ro],
-                            jnp.asarray(np.uint32(0))).compile()
+                            [_aval(feed_arrays[n]) for n in feed_names],
+                            [_aval(scope.get(n)) for n in state_rw],
+                            [_aval(scope.get(n)) for n in state_ro],
+                            jax.ShapeDtypeStruct((), np.uint32)).compile()
                         aot_compile_s = _time.perf_counter() - t0c
                         if compile_cache.aot_store(
                                 aot_dir, akey[0], akey[1], comp,
@@ -415,11 +413,32 @@ class ParallelExecutor(object):
             else scope.next_seed_block(steps)))
         from .. import profiler as _prof
         profiling = _prof.is_active()
+
+        def _donating_call_guard(fn_obj):
+            # a donating jit must never compile through the jax
+            # persistent HLO cache: warm-cache deserialization breaks
+            # donation in this jax (silently wrong numerics — see
+            # compile_cache.donating_multidevice_compile_guard). Every
+            # call of a plain-jit entry is guarded, not just the first:
+            # a plain jit also RETRACES silently when state avals drift
+            # under an unchanged key, and a first call that failed
+            # leaves the entry cached with its compile still pending —
+            # both would otherwise compile unguarded. The guard is a
+            # refcounted pair of free config flips (measured ~1µs) on
+            # the cache-enabled path and a no-op otherwise; AOT
+            # artifacts (jax.stages.Compiled) are donation-free and
+            # never guarded.
+            import contextlib
+            if not isinstance(fn_obj, jax.stages.Compiled):
+                return compile_cache.donating_multidevice_compile_guard()
+            return contextlib.nullcontext()
+
         t0 = _time.perf_counter() if profiling else 0.0
         try:
-            fetches, new_state, errors = jitted(
-                feed_vals, read_state(state_rw), read_state(state_ro),
-                seed)
+            with _donating_call_guard(jitted):
+                fetches, new_state, errors = jitted(
+                    feed_vals, read_state(state_rw),
+                    read_state(state_ro), seed)
         except TypeError:
             if aot_entry is None and not isinstance(
                     jitted, jax.stages.Compiled):
@@ -444,9 +463,10 @@ class ParallelExecutor(object):
             entry = (jitted, state_rw, state_ro, state_out)
             _cache_put_lru(self._cache, key, entry,
                            _jit_cache_capacity())
-            fetches, new_state, errors = jitted(
-                feed_vals, read_state(state_rw), read_state(state_ro),
-                seed)
+            with _donating_call_guard(jitted):
+                fetches, new_state, errors = jitted(
+                    feed_vals, read_state(state_rw),
+                    read_state(state_ro), seed)
         if cancelled is not None and cancelled.is_set():
             # caller already raised DispatchTimeoutError; a late scope
             # write would race its rollback (see Executor._run_impl)

@@ -142,8 +142,8 @@ class ClusterCoordinator(object):
                  heartbeat_timeout=3.0, poll_interval=0.05,
                  fence_timeout=60.0, join_timeout=180.0, max_rescales=8,
                  total_device_count=None, local_device_count=None,
-                 mesh_axes=None, batch_axis="dp", bundle_dir=None,
-                 allow_grow=True, on_event=None):
+                 mesh_axes=None, batch_axis="dp", shard_axis=None,
+                 bundle_dir=None, allow_grow=True, on_event=None):
         """`num_workers` is the INITIAL cohort size (formation waits for
         that many registrations); later joiners grow the cohort when
         `allow_grow`. Device assignment per member: with
@@ -169,6 +169,18 @@ class ClusterCoordinator(object):
         self.local_device_count = local_device_count
         self.mesh_axes = dict(mesh_axes) if mesh_axes else None
         self.batch_axis = batch_axis
+        # the update-state shard axis (parallel/plan.py): published in
+        # every generation's plan so resharded cohorts keep the
+        # sharded-weight-update layout across rescales. Validated HERE
+        # (same rule as DeviceLayout) — deferring it would make every
+        # worker's layout constructor raise instead, read as a cohort
+        # of worker deaths burning fence/rollback cycles to abort.
+        if shard_axis is not None and shard_axis not in (
+                self.mesh_axes or {batch_axis: -1}):
+            raise ValueError(
+                "shard_axis %r is not one of the cluster's mesh axes %r"
+                % (shard_axis, sorted(self.mesh_axes or {batch_axis: -1})))
+        self.shard_axis = shard_axis
         self.bundle_dir = bundle_dir
         self.allow_grow = bool(allow_grow)
         self.on_event = on_event
@@ -208,6 +220,8 @@ class ClusterCoordinator(object):
                     batch_axis=self.batch_axis)
         if self.mesh_axes:
             plan["mesh_axes"] = self.mesh_axes
+        if self.shard_axis is not None:
+            plan["shard_axis"] = self.shard_axis
         plan = write_plan(self.cluster_dir, plan)
         self._plans.append(plan)
         return plan
@@ -603,7 +617,11 @@ class ElasticWorker(object):
             process_index=int(me["rank"]),
             local_device_count=me.get("local_device_count"),
             mesh_axes=plan.get("mesh_axes"),
-            batch_axis=plan.get("batch_axis", "dp"))
+            batch_axis=plan.get("batch_axis", "dp"),
+            # the cohort's update-state shard axis (parallel/plan.py)
+            # rides the cluster plan so a resharded generation keeps
+            # the sharded-update layout the snapshot recorded
+            shard_axis=plan.get("shard_axis"))
 
     def _run_generation(self, plan, num_steps):
         from ..parallel.parallel_executor import ParallelExecutor
@@ -635,6 +653,7 @@ class ElasticWorker(object):
                 pexe = ParallelExecutor(
                     main_program=main, mesh=layout.local_mesh(),
                     batch_axis=layout.batch_axis,
+                    shard_axis=layout.shard_axis,
                     sharded_weight_update=self.sharded_weight_update)
                 step = self._restore_or_init(plan, mgr, main, scope,
                                              layout, rank, exe)

@@ -15,6 +15,15 @@
 #   JSON "multistep" field labels every line.
 # - one FLAGS_multistep_unroll=1 line: full unroll ALSO lets XLA fuse
 #   across step boundaries on TPU; worth one compile to know.
+# - re-queued 2026-08-05 with tier 2b (BENCH_SHARDED, PR 9): replicated
+#   vs ZeRO-style sharded weight update on the real multi-chip mesh —
+#   steps/s both legs + per-chip update-state bytes from the plan's
+#   memory accounting + the fetch-divergence column. CPU reference
+#   (8 virtual devices, 2-layer dim-256 Adam MLP): sharded ~2.1x
+#   steps/s of replicated (update math on 1/8 shards beats 8x
+#   redundant updates even with the gathers), update-state bytes/chip
+#   ratio 0.125, divergence 2.4e-7 (ulp-level reduction-tree
+#   difference, see test_bench_sharded_smoke).
 set -u
 cd "$(dirname "$0")/.."
 LOG=/tmp/perf_sweep_r6.log
@@ -85,6 +94,9 @@ probe && run 1200 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=64 BENCH_WARMUP=2
 # for all K steps, so BENCH_FEED=host* would credit K steps to 1/K of
 # the staging work — bench.py refuses the combination; measuring the
 # pipeline under the loop needs an in-graph-reader bench mode first)
+# --- tier 2b: sharded weight update on the real mesh (PR 9) ----------------
+probe && run 1200 BENCH_SHARDED=1 BENCH_STEPS=32 BENCH_WARMUP=2
+probe && run 1200 BENCH_SHARDED=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_SHARDED_DIM=1024
 # --- tier 3: big compile LAST — one unrolled TPU line (K copies of the step)
 probe && run 2400 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_MULTISTEP=8 FLAGS_multistep_unroll=1
 bank
