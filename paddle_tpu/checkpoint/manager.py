@@ -249,6 +249,14 @@ class CheckpointManager(object):
         program = program if program is not None else default_main_program()
         scope = scope if scope is not None else global_scope()
 
+        # pipelined-dispatch quiesce: a prefetcher may hold a staged
+        # K-block it popped for the NEXT step — those records have not
+        # trained, so they must be refunded before reader positions are
+        # read, or the snapshot would record them as consumed and resume
+        # would skip them (core/dispatch.py, ARCHITECTURE.md §22)
+        from ..core.dispatch import rollback_all_staged
+        rollback_all_staged(scope)
+
         reader_names = _reader_var_names(program)
         acc_owner = getattr(program, "_accumulator_owner", {})
         # only OUTERMOST readers are recorded: an inner reader (one some
@@ -474,6 +482,11 @@ class CheckpointManager(object):
         del executor  # parity with io signatures; scope is the store
         from ..core.executor import global_scope
         scope = scope if scope is not None else global_scope()
+        # pipelined-dispatch quiesce BEFORE reader replay: a staged
+        # prefetch block refunded AFTER load_state_dict's reset+replay
+        # would prepend stale records into the freshly restored stream
+        from ..core.dispatch import rollback_all_staged
+        rollback_all_staged(scope)
         # resolve the target mesh FIRST: an unsatisfiable layout must
         # raise before any snapshot bytes (or scope writes) are touched
         target_mesh, target_plan = (None, None) if layout is None \

@@ -1,0 +1,434 @@
+"""Pipelined dispatch: the shared host/device-overlap plumbing.
+
+Both hot loops — the serving batcher and the training executors — used to
+leave the device idle behind host work: the batcher's one worker formed,
+padded, dispatched and scattered strictly in sequence, and Executor.run
+performed the whole host-io prepass (reader pops, padding, H2D) serially
+before every dispatch. This module is the one seam both runtimes front
+instead of triplicating the overlap machinery (the first slice of the
+ROADMAP item-5 shared runtime core):
+
+  * `InflightWindow` — bounds how many dispatches may be outstanding on
+    the device at once (the serving batcher's continuous-batching window).
+    Dispatches already return pre-D2H FetchHandles, so "outstanding" is
+    tracked by a dedicated completion thread that blocks on the OLDEST
+    dispatch's handles — the only place a host sync happens, and it is
+    off the dispatch path by construction. The completion thread also
+    measures device idle gaps (time between one dispatch's completion
+    and the next dispatch's enqueue) for the profiler's utilization
+    columns.
+
+  * `HostIoPrefetcher` — runs the NEXT step's host-io prepass (reader
+    pops, lod padding, stacking, H2D placement) on a background thread
+    while the current step executes on device. The staged block is
+    consumed by the next matching `run()` call; anything else — a fence,
+    an injected fault, a checkpoint capture, a different program/steps
+    signature — rolls the staged reader pops back exactly
+    (`ReaderBase.push_back` refunds `_consumed`), so every replay
+    invariant the serial prepass proved (retry bit-exactness,
+    fence-consumes-nothing, checkpoint reader positions) survives the
+    overlap. See ARCHITECTURE.md §22 for the invariant proofs.
+
+Checkpoint composition: `rollback_all_staged(scope)` is the quiesce hook
+`checkpoint.CheckpointManager` calls before capturing or restoring reader
+positions — a staged-but-untrained block must never be recorded as
+consumed.
+"""
+import queue
+import threading
+import time
+import weakref
+
+__all__ = ["InflightWindow", "HostIoPrefetcher", "rollback_all_staged",
+           "CANCELLED"]
+
+
+# sentinel: take() observed the caller's watchdog cancellation while
+# waiting for the staging thread — the run unwinds without a refund (the
+# caller's recovery restores reader positions itself, exactly like the
+# serial prepass's cancelled-rollback contract)
+CANCELLED = object()
+
+_CLOSE = object()
+
+
+class InflightWindow(object):
+    """Bounded window of dispatched-but-not-device-complete batches.
+
+    The dispatch worker `acquire()`s a slot before enqueueing a batch and
+    hands the resulting (lazy, pre-D2H) fetch handles to `track()`; a
+    dedicated completion thread blocks on each tracked dispatch's handles
+    in FIFO order and releases the slot when the device finishes. With
+    depth >= 2 the device always has the next batch queued behind the
+    running one while the host pads the one after — continuous batching.
+
+    Device-idle accounting: completion of dispatch i at t_ready and
+    enqueue of dispatch i+1 at t_enq > t_ready means the device sat idle
+    for (t_enq - t_ready); the completion thread sums these gaps per
+    window and reports them through `profiler.record_idle` under the
+    window's tag (the host-observable lower bound on device idleness —
+    a dispatch enqueued before the previous completed counts zero)."""
+
+    def __init__(self, depth, tag=None):
+        if depth < 1:
+            raise ValueError("InflightWindow depth must be >= 1, got %r"
+                             % (depth,))
+        self.depth = int(depth)
+        self.tag = tag
+        self._sem = threading.Semaphore(self.depth)
+        self._q = queue.Queue()
+        self._lock = threading.Lock()
+        self._last_ready = None   # monotonic completion of previous batch
+        self._idle_s = 0.0
+        self._gaps = 0
+        self._completed = 0
+        self._thread = threading.Thread(
+            target=self._completion_loop, daemon=True,
+            name="ptpu-window-%s" % (tag or "anon"))
+        self._thread.start()
+
+    # ------------------------------------------------------------ slots --
+    def acquire(self, timeout=None):
+        """Take one in-flight slot (blocks while `depth` dispatches are
+        outstanding). Returns False on timeout."""
+        return self._sem.acquire(timeout=timeout) if timeout is not None \
+            else self._sem.acquire()
+
+    def release(self):
+        """Give a slot back WITHOUT tracking (the dispatch failed before
+        any device work was enqueued)."""
+        self._sem.release()
+
+    def track(self, handles, enqueued_at=None):
+        """Register an enqueued dispatch's fetch handles; the completion
+        thread releases the slot (and accounts the idle gap) once the
+        device finishes them. `handles` may be empty (a dispatch that
+        produced no device work releases immediately)."""
+        self._q.put((tuple(handles or ()),
+                     time.monotonic() if enqueued_at is None
+                     else enqueued_at))
+
+    # ------------------------------------------------------- completion --
+    def _completion_loop(self):
+        import jax
+        from .. import profiler as _prof
+        while True:
+            item = self._q.get()
+            if item is _CLOSE:
+                return
+            handles, enq_t = item
+            arrays = [getattr(h, "array", h) for h in handles]
+            try:
+                if arrays:
+                    # the window's ONE host sync — on the completion
+                    # thread, never the dispatch path
+                    _prof.note_sync("window/completion")
+                    jax.block_until_ready(arrays)
+            except Exception:
+                pass  # a failed batch already failed its futures; the
+                # slot must come back regardless
+            ready = time.monotonic()
+            with self._lock:
+                if self._last_ready is not None and enq_t > self._last_ready:
+                    gap = enq_t - self._last_ready
+                    self._idle_s += gap
+                    self._gaps += 1
+                    if self.tag and _prof.is_active():
+                        _prof.record_idle(self.tag, gap)
+                self._last_ready = ready
+                self._completed += 1
+            self._sem.release()
+
+    def stats(self):
+        with self._lock:
+            return {"idle_s": self._idle_s, "gaps": self._gaps,
+                    "completed": self._completed}
+
+    def close(self, timeout=None):
+        self._q.put(_CLOSE)
+        self._thread.join(timeout)
+
+
+# ---------------------------------------------------------------------------
+# Host-io prefetch
+# ---------------------------------------------------------------------------
+
+_live_prefetchers = weakref.WeakSet()
+
+
+class _StagedBlock(object):
+    """One prefetched prepass result, parked until the next dispatch.
+
+    Identity (program/scope/steps/host) decides whether the next run may
+    consume it; `popped` is the exact refund ledger — (reader_state,
+    records) in pop order, so `refund()` restores every stream position
+    bit-exactly (push_back reversed, like the prepass's own rollback)."""
+
+    __slots__ = ("program", "scope", "steps", "host", "arrays", "stacked",
+                 "popped", "error", "dropped")
+
+    def __init__(self, program, scope, steps, host):
+        self.program = program
+        self.scope = scope
+        self.steps = steps
+        self.host = host
+        self.arrays = {}
+        self.stacked = set()
+        self.popped = []     # [(reader_state, [record, ...])]
+        self.error = None
+        self.dropped = False  # cancelled: recovery owns the positions
+
+    def matches(self, program, scope, steps, host):
+        return (self.program is program and self.scope is scope
+                and self.steps == steps and self.host == host)
+
+    def refund(self):
+        if self.dropped:
+            return
+        for state, records in reversed(self.popped):
+            for rec in reversed(records):
+                state.push_back(rec)
+        self.popped = []
+
+
+class _OrEvent(object):
+    """is_set() over two events: the run-local watchdog cancellation and
+    the prefetcher's own abandon flag — run_host_io_prepass's
+    cancellation checkpoints honor either."""
+
+    __slots__ = ("_a", "_b")
+
+    def __init__(self, a, b):
+        self._a, self._b = a, b
+
+    def is_set(self):
+        return (self._a is not None and self._a.is_set()) or \
+            self._b.is_set()
+
+
+class HostIoPrefetcher(object):
+    """Background host-io prepass: pops, pads and places step N+1's
+    reader records while step N executes on device.
+
+    Protocol (one owner executor, calls from its dispatch thread):
+      * `kick(...)` at the end of a successful dispatch starts the
+        background prepass for the next step.
+      * `take(program, scope, steps, host)` at the top of the next
+        dispatch (AFTER the barrier/fault hooks — a hook that raises
+        must find the staged pops refundable) waits for the staging
+        thread and returns the staged block when the identity matches;
+        a mismatch refunds the staged pops and returns None (the caller
+        runs the prepass inline); a staged prepass ERROR re-raises here,
+        on the consuming thread, with nothing consumed (the staging
+        thread refunded before parking the error). Returns the CANCELLED
+        sentinel when the caller's watchdog fired mid-wait.
+      * `rollback()` refunds whatever is staged (fence/fault/checkpoint
+        paths).
+
+    The staging thread is the ONLY consumer of the readers between kick
+    and take, so `ReaderBase` needs no new locking; `reader.eof()` polls
+    from other threads race the staging pop and are unsupported while a
+    prefetcher is armed — end epochs on the EOFException instead (it
+    surfaces at take(), stream position intact).
+
+    Cost model: one fresh daemon thread per kick (~50-100us create) —
+    deliberate, because a staged block's lifetime must end crisply at
+    take/rollback and take()'s join wakes the moment the thread exits.
+    Against the millisecond-class steps where prefetch pays at all
+    (K-blocks amortize it further) the churn is noise; a step fast
+    enough to feel it gains nothing from prefetch in the first place —
+    leave it off there."""
+
+    def __init__(self, name="prefetch"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._thread = None
+        self._inflight = None        # _StagedBlock the thread is filling
+        self._staged = None          # _StagedBlock once the thread ran
+        self._abandon = threading.Event()
+        _live_prefetchers.add(self)
+
+    # ----------------------------------------------------------- status --
+    def has_work(self):
+        """A staging thread is running or a block is parked."""
+        with self._lock:
+            return self._thread is not None or self._staged is not None
+
+    # ------------------------------------------------------------- kick --
+    def kick(self, program, scope, steps, host, place=None, validate=None,
+             stage_fn=None, cancelled=None):
+        """Start the background prepass for the next step. `place` pins
+        the staging device for the Executor path (jnp placement on the
+        staging thread targets the dispatch device, not the thread's
+        default); `stage_fn(arrays, stacked)` lets the ParallelExecutor
+        do its own sharded device_put per feed on the staging thread;
+        `validate` is the per-record check (PE divisibility), forwarded
+        to the prepass."""
+        from .executor import run_host_io_prepass
+        if self.has_work():
+            # defensive: the owner always take()s/rolls back before
+            # kicking again; a stale block must not leak records
+            self.rollback()
+        with self._lock:
+            self._abandon.clear()
+            block = _StagedBlock(program, scope, steps, host)
+            cancel = _OrEvent(cancelled, self._abandon)
+
+            def work():
+                try:
+                    ctx = None
+                    if place is not None:
+                        import jax
+                        ctx = jax.default_device(place.device())
+                        ctx.__enter__()
+                    try:
+                        run_host_io_prepass(
+                            program, scope, block.arrays, host=host,
+                            validate=validate, steps=steps,
+                            stacked_out=block.stacked,
+                            cancelled=cancel, place=place,
+                            popped_out=block.popped)
+                        if stage_fn is not None:
+                            stage_fn(block.arrays, block.stacked)
+                    finally:
+                        if ctx is not None:
+                            ctx.__exit__(None, None, None)
+                except BaseException as e:  # noqa: BLE001 — parked for
+                    # the consuming thread. Refund anything this block
+                    # committed before failing (steps>1 prepass rolls
+                    # back internally and commits nothing on failure;
+                    # steps=1 commits pop-by-pop, and an error block is
+                    # discarded whole — its earlier pops must go back so
+                    # the error consumes NOTHING, which is what the
+                    # fence/retry invariants need)
+                    block.refund()
+                    block.error = e
+                with self._lock:
+                    self._staged = block
+                    self._inflight = None
+                    self._thread = None
+
+            t = threading.Thread(target=work, daemon=True,
+                                 name="ptpu-prefetch-%s" % self.name)
+            self._thread = t
+            self._inflight = block
+            t.start()
+
+    # ------------------------------------------------------------- take --
+    def take(self, program, scope, steps, host, cancelled=None):
+        """Claim the staged block for this dispatch (see class doc).
+        Identity is checked BEFORE a parked staging error: an error
+        staged for a DIFFERENT signature (e.g. EOF from a steps=8 kick
+        when only 5 records remained, followed by a steps=1 tail pass
+        or an eval program through the same executor) consumed nothing
+        — the staging thread refunded before parking it — so this
+        mismatched dispatch must fall back to its own inline prepass,
+        not fail on a stranger's error. The error re-raises only when
+        the MATCHING dispatch arrives, exactly where the serial prepass
+        would have raised it."""
+        block = self._wait(cancelled)
+        if block is CANCELLED:
+            return CANCELLED
+        if block is None:
+            return None
+        if not block.matches(program, scope, steps, host):
+            if block.error is None:
+                block.refund()
+            return None
+        if block.error is not None:
+            raise block.error
+        return block
+
+    def rollback(self, cancelled=None):
+        """Refund the staged pops (fence / fault / checkpoint quiesce).
+        With `cancelled` set the block is dropped WITHOUT refund — the
+        caller's recovery restores reader positions itself, and a late
+        refund would prepend stale records into the restored stream."""
+        block = self._wait(cancelled)
+        if block is CANCELLED or block is None:
+            return
+        block.refund()
+
+    def _wait(self, cancelled=None):
+        """Join the staging thread and detach the staged block. On
+        watchdog cancellation mid-wait: abandon the staging thread (it
+        stops at its next prepass checkpoint without refunding) and mark
+        the block it is filling as dropped — whoever detaches it later
+        discards it without refund, because the caller's recovery owns
+        the reader positions from here."""
+        while True:
+            with self._lock:
+                t = self._thread
+                if t is None:
+                    block, self._staged = self._staged, None
+                    if block is not None and block.dropped:
+                        block = None  # parked by an abandoned staging run
+                    return block
+            if cancelled is not None and cancelled.is_set():
+                self._abandon.set()
+                with self._lock:
+                    if self._staged is not None:
+                        self._staged.dropped = True
+                        self._staged = None
+                    if self._inflight is not None:
+                        self._inflight.dropped = True
+                return CANCELLED
+            t.join(timeout=0.05)
+
+    def close(self):
+        """Refund anything staged and forget the prefetcher (executor
+        teardown / tests)."""
+        self.rollback()
+        _live_prefetchers.discard(self)
+
+
+def has_read_ops(program, cache):
+    """Does `program` pop reader records in its main block? Cached per
+    (uid, version) in the caller's dict — consulted per dispatch, walked
+    once per program."""
+    key = (program._uid, program._version)
+    if key not in cache:
+        cache[key] = any(op.type == "read"
+                         for op in program.global_block().ops)
+    return cache[key]
+
+
+def kick_next_prepass(executor, program, scope, steps, host, cancelled,
+                      name, **kick_kw):
+    """The executors' shared kick choreography (ONE copy for
+    Executor._run_impl and ParallelExecutor._run_impl): lazily arm the
+    executor's prefetcher and kick the next step's prepass — a no-op
+    for readerless programs (nothing to stage) and for a cancelled
+    (watchdog-abandoned) worker (its recovery owns the readers).
+    Returns the (possibly just-created) prefetcher. `kick_kw` carries
+    the per-executor staging strategy: Executor pins `place=`;
+    ParallelExecutor passes `validate=`/`stage_fn=` for its sharded
+    device_put."""
+    if cancelled is not None and cancelled.is_set():
+        return executor._prefetcher
+    if not has_read_ops(program, executor._has_read):
+        return executor._prefetcher
+    pf = executor._prefetcher
+    if pf is None:
+        pf = executor._prefetcher = HostIoPrefetcher(name=name)
+    pf.kick(program, scope, steps, host, cancelled=cancelled, **kick_kw)
+    return pf
+
+
+def rollback_all_staged(scope=None):
+    """Quiesce hook: refund every live prefetcher's staged pops (all
+    prefetchers, or only those staging for `scope`). Checkpoint save
+    calls this before reading reader positions — a staged block's
+    records have not trained, so recording them as consumed would skip
+    them on resume; restore calls it before replaying positions so a
+    stale staged block can't refund into the freshly reset stream
+    afterwards. Runs on the trainer thread between dispatches, where no
+    take() is concurrently in flight."""
+    for pf in list(_live_prefetchers):
+        if not pf.has_work():
+            continue
+        if scope is not None:
+            block = pf._staged if pf._staged is not None else pf._inflight
+            if block is not None and block.scope is not scope:
+                continue
+        pf.rollback()

@@ -179,13 +179,19 @@ class FetchHandle(object):
         return self._arr.dtype
 
     def numpy(self):
+        from .. import profiler as _prof
+        _prof.note_sync("fetch/materialize")
         return np.asarray(self._arr)
 
     def block(self):
+        from .. import profiler as _prof
+        _prof.note_sync("fetch/block")
         jax.block_until_ready(self._arr)
         return self
 
     def __array__(self, dtype=None, copy=None):
+        from .. import profiler as _prof
+        _prof.note_sync("fetch/materialize")
         a = np.asarray(self._arr)
         return a.astype(dtype) if dtype is not None else a
 
@@ -240,7 +246,7 @@ class _DispatchCancelled(Exception):
 
 def run_host_io_prepass(program, scope, feed_arrays, host=False,
                         validate=None, steps=1, stacked_out=None,
-                        cancelled=None):
+                        cancelled=None, place=None, popped_out=None):
     """io pre-pass: reader ops execute host-side (core/readers.py).
     create_* ops build ReaderState objects in the scope; each `read` op
     pops the next record and injects it as a feed of the jitted program
@@ -254,6 +260,19 @@ def run_host_io_prepass(program, scope, feed_arrays, host=False,
     (out_vars are the declared read_file output Variables, for shape-aware
     checks); on failure the record is pushed back so the error doesn't
     consume it.
+
+    place: the dispatch place. A reader that stages asynchronously
+    (DoubleBufferReader) gets it pinned (`pin_place`) so its staging
+    thread device_puts to the DEVICE THE DISPATCH RUNS ON — without the
+    pin the worker stages to the process default device and a
+    non-default place re-pays the transfer on the main thread (an
+    explicit double_buffer(place=...) always wins).
+
+    popped_out: refund ledger for the pipelined-dispatch prefetcher
+    (core/dispatch.py) — every (reader_state, records) block that REMAINS
+    consumed when this call returns is appended, in pop order, so a
+    staged-but-never-dispatched prepass can push everything back exactly.
+    Blocks an internal failure already rolled back are not listed.
 
     steps=K (multi-step execution): each `read` op pops K records
     ATOMICALLY (ReaderBase.next_many pushes all K back on a mid-block EOF
@@ -293,6 +312,10 @@ def run_host_io_prepass(program, scope, feed_arrays, host=False,
                 raise RuntimeError(
                     "reader %r has no state; run the startup program "
                     "first" % op.inputs["Reader"][0])
+            if place is not None and hasattr(state, "pin_place"):
+                # async-staging readers stage straight to the dispatch
+                # device (H2D on the staging thread, not re-paid here)
+                state.pin_place(place)
             out_names = op.outputs["Out"]
             out_vars = [_find_feed_var(program, n) for n in out_names]
 
@@ -313,6 +336,8 @@ def run_host_io_prepass(program, scope, feed_arrays, host=False,
                     raise
                 for out_name, val, var in zip(out_names, record, out_vars):
                     feed_arrays[out_name] = _to_array(val, var, host=host)
+                if popped_out is not None:
+                    popped_out.append((state, [record]))
             else:
                 if hasattr(state, "ensure_staging_depth"):
                     # a double buffer must be able to pre-stage the NEXT
@@ -358,6 +383,8 @@ def run_host_io_prepass(program, scope, feed_arrays, host=False,
         feed_arrays.update(multi_stacks)
         if stacked_out is not None:
             stacked_out.update(multi_stacks)
+    if popped_out is not None:
+        popped_out.extend(multi_blocks)
 
 
 def _array_safety_enabled():
@@ -609,11 +636,16 @@ class Executor(object):
         self._validated = set()  # (uid, version, feeds, fetches, multi)
         self._tuned = {}  # (uid, version) -> tuning entry | None, so
         # apply_tuned costs one store read per program, not per dispatch
+        self._prefetcher = None  # core/dispatch.HostIoPrefetcher, armed
+        # lazily by the first run(prefetch=True) on a reader-fed program
+        self._has_read = {}  # (uid, version) -> program has `read` ops
+        self._last_ready_t = None  # profiling: previous dispatch's
+        # completion time, for the device-idle-gap column
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, steps=1,
             fetch_reduce="stack", validate=None, timeout=None,
-            apply_tuned=False):
+            apply_tuned=False, prefetch=False):
         """Run `program` once — or, with steps=K > 1, K times inside ONE
         device-resident lax.scan dispatch: params/optimizer state stay
         donated on device across the K steps and the host syncs once per
@@ -657,24 +689,37 @@ class Executor(object):
         it trades PR-1's async dispatch pipelining for bounded latency —
         that is the watchdog's documented cost. After a timeout the
         abandoned worker never writes the scope, but donated buffers may
-        already be consumed: recover by checkpoint rollback or abort."""
+        already be consumed: recover by checkpoint rollback or abort.
+
+        prefetch=True pipelines the host-io prepass (ARCHITECTURE.md
+        §22): after each dispatch of a reader-fed program, a background
+        stage pops the NEXT step's records (or the next K-block), pads
+        and device_puts them while the current step executes on device;
+        the next run() consumes the staged feeds instead of paying the
+        prepass on the dispatch path. A fence, fault, checkpoint
+        capture, or any signature change rolls the staged pops back
+        exactly (push_back refunds the stream position), so retry
+        bit-exactness and fence-consumes-nothing hold unchanged. With a
+        prefetcher armed, poll end-of-data via the EOFException (it
+        surfaces here with stream position intact), not reader.eof()."""
         if timeout is None:
             return self._run_impl(program, feed, fetch_list, scope,
                                   return_numpy, use_program_cache, steps,
                                   fetch_reduce, validate,
-                                  apply_tuned=apply_tuned)
+                                  apply_tuned=apply_tuned,
+                                  prefetch=prefetch)
         return dispatch_with_deadline(
             lambda cancelled, info: self._run_impl(
                 program, feed, fetch_list, scope, return_numpy,
                 use_program_cache, steps, fetch_reduce, validate,
                 cancelled=cancelled, info=info, sync=True,
-                apply_tuned=apply_tuned),
+                apply_tuned=apply_tuned, prefetch=prefetch),
             timeout, "Executor.run dispatch")
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
                   use_program_cache, steps, fetch_reduce, validate,
                   cancelled=None, info=None, sync=False,
-                  apply_tuned=False):
+                  apply_tuned=False, prefetch=False):
         if program is None:
             program = default_main_program()
         feed = feed or {}
@@ -714,25 +759,49 @@ class Executor(object):
                                  tuple(fetch_names))
 
         # cluster step barrier (resilience/cluster.py): a fenced cohort
-        # stops HERE, before anything is consumed
-        if _barrier_hook is not None:
-            _barrier_hook("dispatch", program=program, steps=steps)
-
-        # fault-injection seam (resilience/faults.py): BEFORE the io
-        # pre-pass and the seed draw, so an injected dispatch failure or
-        # slow step consumes no reader records and no rng — a retried
-        # step replays bit-exactly
-        if _fault_hook is not None:
-            _fault_hook("dispatch", program=program, steps=steps,
-                        feed_arrays=feed_arrays)
-
-        stacked_names = set()
+        # stops HERE, before anything is consumed — including anything a
+        # prefetcher staged: a hook raise refunds the staged pops so a
+        # fenced/faulted attempt still consumes nothing
+        pf = self._prefetcher
         try:
-            run_host_io_prepass(program, scope, feed_arrays, steps=steps,
-                                stacked_out=stacked_names,
-                                cancelled=cancelled)
-        except _DispatchCancelled:
-            return None  # deadline already raised on the caller's thread
+            if _barrier_hook is not None:
+                _barrier_hook("dispatch", program=program, steps=steps)
+
+            # fault-injection seam (resilience/faults.py): BEFORE the io
+            # pre-pass and the seed draw, so an injected dispatch failure
+            # or slow step consumes no reader records and no rng — a
+            # retried step replays bit-exactly
+            if _fault_hook is not None:
+                _fault_hook("dispatch", program=program, steps=steps,
+                            feed_arrays=feed_arrays)
+        except BaseException:
+            if pf is not None:
+                pf.rollback(cancelled=cancelled)
+            raise
+
+        from . import dispatch as _dispatch
+        stacked_names = set()
+        staged = None
+        if pf is not None and pf.has_work():
+            # consult the prefetcher even on a prefetch=False call: a
+            # staged block for a different signature must be refunded
+            # BEFORE the inline prepass pops the stream, or the staged
+            # records would replay out of order
+            staged = pf.take(program, scope, steps, False,
+                             cancelled=cancelled)
+            if staged is _dispatch.CANCELLED:
+                return None  # deadline raised on the caller's thread
+        if staged is not None:
+            feed_arrays.update(staged.arrays)
+            stacked_names = set(staged.stacked)
+        else:
+            try:
+                run_host_io_prepass(program, scope, feed_arrays,
+                                    steps=steps,
+                                    stacked_out=stacked_names,
+                                    cancelled=cancelled, place=self.place)
+            except _DispatchCancelled:
+                return None  # deadline raised on the caller's thread
         if cancelled is not None and cancelled.is_set():
             return None
 
@@ -929,6 +998,7 @@ class Executor(object):
             # diagnostic-bundle capture and any inspection forever; the
             # old donated-and-deleted buffers raise instead, which
             # write_bundle records per-var as state_unavailable)
+            _prof.note_sync("executor/watchdog_sync")
             jax.block_until_ready((fetches, new_state))
             if cancelled is not None and cancelled.is_set():
                 return None
@@ -939,33 +1009,64 @@ class Executor(object):
         # and the caller can't even checkpoint/inspect.
         for n, v in zip(state_out, new_state):
             scope.set(n, v)
-        if profiling:
-            jax.block_until_ready((fetches, new_state))
-            dt = time.perf_counter() - t0
-            tag = "program_%s(v%d)%s fetch=%s" % (
-                getattr(program, "_uid", "?"), program._version,
-                " x%d" % steps if steps > 1 else "",
-                ",".join(fetch_names) or "-")
-            # a compiled call's seconds include its compile, like the
-            # lazy-jit path where tracing happens inside the timed
-            # dispatch — the eager AOT lower+compile ran before t0, so
-            # add it back or Compile(s) reports a 30s compile as free
-            _prof.record_run(tag, dt + (aot_compile_s if compiled
-                                        else 0.0),
-                             compiled=compiled, aot_hit=aot_hit,
-                             saved_s=aot_saved)
-        # guard flags raise even with FLAGS_tensor_array_safety=0: a
-        # program that INSTALLED guards opted into the one-fetch sync
-        has_guards = bool(errors) and any(
-            m.startswith(GUARD_MSG_PREFIX) for m in errors)
-        if self._array_safety or has_guards:
-            _raise_program_errors(errors,
-                                  include_non_guard=self._array_safety)
-        if self._check_nan_inf:
-            check_finite(
-                list(zip(fetch_names, fetches)) +
-                list(zip(state_out, new_state)), context="Executor.run")
+        # pipelined dispatch: kick the NEXT step's host-io prepass NOW —
+        # the staging thread pops/pads/device_puts while this step's
+        # device work (and any sync below: guard flags, profiling,
+        # return_numpy D2H) proceeds. Kicked only for reader-fed
+        # programs; a cancelled (watchdog-abandoned) worker never kicks.
+        if prefetch:
+            pf = _dispatch.kick_next_prepass(
+                self, program, scope, steps, False, cancelled, "exe",
+                place=self.place)
+        try:
+            if profiling:
+                _prof.note_sync("executor/profiling")
+                jax.block_until_ready((fetches, new_state))
+                t_ready = time.perf_counter()
+                dt = t_ready - t0
+                # device-idle gap: this dispatch STARTED after the
+                # previous one had already completed — the device sat
+                # with nothing queued for (t0 - last_ready). Observable
+                # only in profiling mode, where completion times exist.
+                idle = None
+                if self._last_ready_t is not None and t0 > self._last_ready_t:
+                    idle = t0 - self._last_ready_t
+                self._last_ready_t = t_ready
+                tag = "program_%s(v%d)%s fetch=%s" % (
+                    getattr(program, "_uid", "?"), program._version,
+                    " x%d" % steps if steps > 1 else "",
+                    ",".join(fetch_names) or "-")
+                # a compiled call's seconds include its compile, like the
+                # lazy-jit path where tracing happens inside the timed
+                # dispatch — the eager AOT lower+compile ran before t0, so
+                # add it back or Compile(s) reports a 30s compile as free
+                _prof.record_run(tag, dt + (aot_compile_s if compiled
+                                            else 0.0),
+                                 compiled=compiled, aot_hit=aot_hit,
+                                 saved_s=aot_saved, idle_s=idle)
+            # guard flags raise even with FLAGS_tensor_array_safety=0: a
+            # program that INSTALLED guards opted into the one-fetch sync
+            has_guards = bool(errors) and any(
+                m.startswith(GUARD_MSG_PREFIX) for m in errors)
+            if self._array_safety or has_guards:
+                _raise_program_errors(errors,
+                                      include_non_guard=self._array_safety)
+            if self._check_nan_inf:
+                check_finite(
+                    list(zip(fetch_names, fetches)) +
+                    list(zip(state_out, new_state)),
+                    context="Executor.run")
+        except BaseException:
+            # a raise after the kick (tripped guard, nan check) hands
+            # control to a supervisor that may drop batches or restore
+            # reader positions: the just-staged next block must be
+            # refunded first so the stream position is exactly what the
+            # failed step left (its own records consumed, nothing more)
+            if pf is not None:
+                pf.rollback(cancelled=cancelled)
+            raise
         if return_numpy:
+            _prof.note_sync("executor/return_numpy")
             return [np.asarray(f) for f in fetches]
         return [FetchHandle(f) for f in fetches]
 

@@ -154,6 +154,17 @@ class ReaderBase(object):
             raise
         return out
 
+    def pin_place(self, place):
+        """Tell the chain which device dispatches will run on, so any
+        async-staging decorator below (DoubleBufferReader) device_puts
+        to THAT device on its worker thread instead of the process
+        default — otherwise a non-default place re-pays the transfer on
+        the dispatch thread. Called by the executors' io prepass; an
+        explicit double_buffer(place=...) always wins."""
+        under = getattr(self, "_under", None)
+        if under is not None and hasattr(under, "pin_place"):
+            under.pin_place(place)
+
     def eof(self):
         if self._pending:
             return False
@@ -436,6 +447,15 @@ class DoubleBufferReader(ReaderBase):
         self._capacity = k
         self._start()
 
+    def pin_place(self, place):
+        """Executor io-prepass handoff: stage to the DISPATCH device on
+        the worker thread (the whole point of the double buffer — H2D
+        off the hot path). An explicit constructor place always wins; a
+        pin lands on the very next staged record (the worker re-reads
+        the target per record), no restart needed."""
+        if self._place is None and place is not None:
+            self._place = place
+
     def _device(self):
         if self._place is not None:
             try:
@@ -447,7 +467,7 @@ class DoubleBufferReader(ReaderBase):
     def _start(self):
         self._q = queue.Queue(self._capacity)
         self._gen += 1
-        gen, q, dev = self._gen, self._q, self._device()
+        gen, q = self._gen, self._q
 
         def worker():
             import jax
@@ -465,6 +485,9 @@ class DoubleBufferReader(ReaderBase):
                     self._died = _ReaderError(e)  # sticky: dead != EOF
                     q.put(_ReaderError(e))
                     return
+                # target re-read per record: a pin_place arriving after
+                # the worker started takes effect without a restart
+                dev = self._device()
                 staged = tuple(
                     jax.device_put(np.asarray(f), dev) if dev is not None
                     else jax.device_put(np.asarray(f)) for f in rec)

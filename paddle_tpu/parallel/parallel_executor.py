@@ -132,6 +132,11 @@ class ParallelExecutor(object):
         self._scope = global_scope()
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
+        self._prefetcher = None  # core/dispatch.HostIoPrefetcher, armed
+        # lazily by the first run(prefetch=True) on a reader-fed program
+        self._has_read = {}  # (uid, version) -> program has `read` ops
+        self._last_ready_t = None  # profiling: previous completion, for
+        # the device-idle-gap column
 
     def _state_sharding(self, name):
         return self.plan.sharding_for(name)
@@ -141,7 +146,7 @@ class ParallelExecutor(object):
         return self.mesh.devices.size
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True,
-            steps=1, fetch_reduce="stack", timeout=None):
+            steps=1, fetch_reduce="stack", timeout=None, prefetch=False):
         """Sharded run; steps=K runs the K-step device-resident loop (see
         Executor.run): the scan composes with the GSPMD shardings — feeds
         stay batch-sharded per step, params keep their replicated / ZeRO
@@ -153,20 +158,28 @@ class ParallelExecutor(object):
         has: the dispatch runs on a monitored worker thread and raises
         DispatchTimeoutError past the deadline (device state then
         indeterminate — recover by rollback/abort, see
-        paddle_tpu.resilience)."""
+        paddle_tpu.resilience).
+
+        prefetch=True pipelines the host-io prepass exactly like
+        Executor.run(prefetch=True) — the next step's reader records
+        pop, pad AND device_put (with their batch shardings) on a
+        background stage while the current step executes; staged pops
+        roll back exactly on fence/fault/checkpoint (ARCHITECTURE.md
+        §22)."""
         if timeout is None:
             return self._run_impl(fetch_list, feed, feed_dict, return_numpy,
-                                  steps, fetch_reduce)
+                                  steps, fetch_reduce, prefetch=prefetch)
         from ..core.executor import dispatch_with_deadline
         return dispatch_with_deadline(
             lambda cancelled, info: self._run_impl(
                 fetch_list, feed, feed_dict, return_numpy, steps,
-                fetch_reduce, cancelled=cancelled, info=info, sync=True),
+                fetch_reduce, cancelled=cancelled, info=info, sync=True,
+                prefetch=prefetch),
             timeout, "ParallelExecutor.run dispatch")
 
     def _run_impl(self, fetch_list, feed=None, feed_dict=None,
                   return_numpy=True, steps=1, fetch_reduce="stack",
-                  cancelled=None, info=None, sync=False):
+                  cancelled=None, info=None, sync=False, prefetch=False):
         feed = feed if feed is not None else (feed_dict or {})
         program = self._program
         scope = self._scope
@@ -194,16 +207,26 @@ class ParallelExecutor(object):
                                  tuple(fetch_names))
 
         # same cluster step barrier as Executor._run_impl: a fenced
-        # cohort stops before anything is consumed
-        if _exe_mod._barrier_hook is not None:
-            _exe_mod._barrier_hook("dispatch", program=program,
-                                   steps=steps)
+        # cohort stops before anything is consumed — a hook raise also
+        # refunds anything a prefetcher staged (fence-consumes-nothing
+        # covers the staged block too)
+        pf = self._prefetcher
+        try:
+            if _exe_mod._barrier_hook is not None:
+                _exe_mod._barrier_hook("dispatch", program=program,
+                                       steps=steps)
 
-        # same fault-injection seam as Executor._run_impl: before the io
-        # pre-pass and seed draw, so injected failures consume nothing
-        if _exe_mod._fault_hook is not None:
-            _exe_mod._fault_hook("dispatch", program=program, steps=steps,
-                                 feed_arrays=feed_arrays)
+            # same fault-injection seam as Executor._run_impl: before the
+            # io pre-pass and seed draw, so injected failures consume
+            # nothing
+            if _exe_mod._fault_hook is not None:
+                _exe_mod._fault_hook("dispatch", program=program,
+                                     steps=steps,
+                                     feed_arrays=feed_arrays)
+        except BaseException:
+            if pf is not None:
+                pf.rollback(cancelled=cancelled)
+            raise
 
         def _batch_leading(name):
             return _var_batch_leading(_find_var(program, name))
@@ -232,27 +255,43 @@ class ParallelExecutor(object):
                     _check_divisible(
                         f, "reader record field %r" % getattr(v, "name", "?"))
 
-        stacked_names = set()
+        from ..core import dispatch as _dispatch
         from ..core.executor import _DispatchCancelled
-        try:
-            run_host_io_prepass(program, scope, feed_arrays, host=True,
-                                validate=_validate_record, steps=steps,
-                                stacked_out=stacked_names,
-                                cancelled=cancelled)
-        except _DispatchCancelled:
-            return None  # watchdog deadline already raised on the caller
+        stacked_names = set()
+        staged = None
+        if pf is not None and pf.has_work():
+            # consult even on a prefetch=False call: a mismatched staged
+            # block must be refunded before the inline prepass pops
+            staged = pf.take(program, scope, steps, True,
+                             cancelled=cancelled)
+            if staged is _dispatch.CANCELLED:
+                return None  # deadline raised on the caller's thread
+        if staged is not None:
+            feed_arrays.update(staged.arrays)
+            stacked_names = set(staged.stacked)
+        else:
+            try:
+                run_host_io_prepass(program, scope, feed_arrays, host=True,
+                                    validate=_validate_record, steps=steps,
+                                    stacked_out=stacked_names,
+                                    cancelled=cancelled)
+            except _DispatchCancelled:
+                return None  # watchdog deadline raised on the caller
         feed_names = sorted(feed_arrays)
 
-        def _feed_sharding(name, ndim):
+        def _sharding_for(name, ndim, stacked):
             if _batch_leading(name):
                 # stacked reader feeds carry a leading K (time) axis; their
                 # batch dim moved to position 1 — the scan slices K off and
                 # each step sees the usual batch-dim-0 sharding
                 return batch_sharded(self.mesh, ndim,
                                      axis_name=self._batch_axis,
-                                     batch_dim=1 if name in stacked_names
+                                     batch_dim=1 if name in stacked
                                      else 0)
             return replicated(self.mesh)
+
+        def _feed_sharding(name, ndim):
+            return _sharding_for(name, ndim, stacked_names)
 
         # every trace-time env flag (conv layout, flash dispatch, remat
         # tuning) is traced into the fn — key on them so an env-var flip
@@ -482,31 +521,64 @@ class ParallelExecutor(object):
         # rw inputs were donated (see Executor.run)
         for n, v in zip(state_out, new_state):
             scope.set(n, v)
-        if self._sync_dispatch and not sync:
-            jax.block_until_ready((fetches, new_state))
-        if profiling:
-            jax.block_until_ready((fetches, new_state))
-            tag = "pexe_program_%s(v%d)x%d fetch=%s" % (
-                program._uid, program._version, self.device_count,
-                ",".join(fetch_names) or "-")
-            # add the eager AOT compile time back for compiled calls —
-            # it ran before t0 (see Executor._run_impl)
-            _prof.record_run(tag, _time.perf_counter() - t0
-                             + (aot_compile_s if compiled else 0.0),
-                             compiled=compiled, aot_hit=aot_hit,
-                             saved_s=aot_saved)
-        from ..core.executor import GUARD_MSG_PREFIX
-        has_guards = bool(errors) and any(
-            m.startswith(GUARD_MSG_PREFIX) for m in errors)
-        if self._array_safety or has_guards:
-            _raise_program_errors(errors,
-                                  include_non_guard=self._array_safety)
-        if self._check_nan_inf:
-            check_finite(
-                list(zip(fetch_names, fetches)) +
-                list(zip(state_out, new_state)),
-                context="ParallelExecutor.run")
+        # pipelined dispatch: stage the NEXT step's reader block (pop,
+        # pad, sharded device_put) while this step's device work — and
+        # the CPU-backend collective sync below — proceeds
+        if prefetch:
+            def _stage(arrays, stacked):
+                # the prefetched feeds' H2D happens HERE, on the
+                # staging thread, already in their batch shardings —
+                # the dispatch thread's device_put then sees an
+                # identically-sharded array (no transfer)
+                for n, a in list(arrays.items()):
+                    arrays[n] = jax.device_put(
+                        a, _sharding_for(n, np.ndim(a), stacked))
+
+            pf = _dispatch.kick_next_prepass(
+                self, program, scope, steps, True, cancelled, "pexe",
+                validate=_validate_record, stage_fn=_stage)
+        try:
+            if self._sync_dispatch and not sync:
+                _prof.note_sync("pexe/cpu_collective_serialize")
+                jax.block_until_ready((fetches, new_state))
+            if profiling:
+                _prof.note_sync("pexe/profiling")
+                jax.block_until_ready((fetches, new_state))
+                t_ready = _time.perf_counter()
+                idle = None
+                if self._last_ready_t is not None \
+                        and t0 > self._last_ready_t:
+                    idle = t0 - self._last_ready_t
+                self._last_ready_t = t_ready
+                tag = "pexe_program_%s(v%d)x%d fetch=%s" % (
+                    program._uid, program._version, self.device_count,
+                    ",".join(fetch_names) or "-")
+                # add the eager AOT compile time back for compiled calls —
+                # it ran before t0 (see Executor._run_impl)
+                _prof.record_run(tag, t_ready - t0
+                                 + (aot_compile_s if compiled else 0.0),
+                                 compiled=compiled, aot_hit=aot_hit,
+                                 saved_s=aot_saved, idle_s=idle)
+            from ..core.executor import GUARD_MSG_PREFIX
+            has_guards = bool(errors) and any(
+                m.startswith(GUARD_MSG_PREFIX) for m in errors)
+            if self._array_safety or has_guards:
+                _raise_program_errors(errors,
+                                      include_non_guard=self._array_safety)
+            if self._check_nan_inf:
+                check_finite(
+                    list(zip(fetch_names, fetches)) +
+                    list(zip(state_out, new_state)),
+                    context="ParallelExecutor.run")
+        except BaseException:
+            # raise after the kick (tripped guard, nan check): refund the
+            # staged next block so the stream position is exactly what
+            # the failed step left (see Executor._run_impl)
+            if pf is not None:
+                pf.rollback(cancelled=cancelled)
+            raise
         if return_numpy:
+            _prof.note_sync("pexe/return_numpy")
             return [np.asarray(f) for f in fetches]
         from ..core.executor import FetchHandle
         return [FetchHandle(f) for f in fetches]

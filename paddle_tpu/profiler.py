@@ -9,26 +9,100 @@ time, and blocked run times — plus a jax.profiler trace (TensorBoard/XProf)
 for intra-computation detail.
 """
 import contextlib
+import threading
 import time
 
 import jax
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
-           "profile_report", "record_event", "cache_stats"]
+           "profile_report", "record_event", "cache_stats", "note_sync",
+           "sync_stats", "dispatch_path", "record_idle"]
 
 _active = False
 _trace_dir = None
 _span = [None, None]
 _entries = {}  # tag -> {"calls", "runs", "total", "max", "min",
-#                        "compiles", "compile_s", "aot_hits", "saved_s"}
-#                       (see record_run)
+#                        "compiles", "compile_s", "aot_hits", "saved_s",
+#                        "idle_s", "gaps"}  (see record_run/record_idle)
+_syncs = {}    # tag -> host-sync count (see note_sync)
+_syncs_on_dispatch = 0  # syncs observed on a marked dispatch-path thread
+_sync_lock = threading.Lock()  # note_sync is called from dispatch
+# workers, completion threads and clients at once — an unlocked
+# read-modify-write could lose exactly the dispatch-path increment the
+# no-premature-sync regression tests exist to catch
+_tls = threading.local()  # .dispatch_path: this thread IS a hot
+# dispatch loop (serving batcher worker, training step loop) — any
+# note_sync here is a premature sync the pipeline regression test fails
 
 
 def is_active():
     return _active
 
 
-def record_run(tag, seconds, compiled=False, aot_hit=False, saved_s=0.0):
+def note_sync(tag):
+    """Count one host<->device synchronization point (block_until_ready,
+    np.asarray of a device array, watchdog completion wait). Every sync
+    site on the runtime's dispatch paths calls this, tagged by WHY it
+    synced — so "the device pipeline never stalls on the host" is a
+    testable property (`sync_stats`), not a code-review hope. Counting
+    is ALWAYS on (a dict increment at a site already paying a
+    millisecond-class device wait — unlike timing, it needs no extra
+    sync of its own, so it must not require the profiler's
+    sync-everything mode). Syncs observed on a thread inside a
+    `dispatch_path()` region additionally count as on-dispatch-path:
+    the pipelined batcher/trainer regression tests assert that number
+    stays zero."""
+    global _syncs_on_dispatch
+    with _sync_lock:
+        _syncs[tag] = _syncs.get(tag, 0) + 1
+        if getattr(_tls, "dispatch_path", False):
+            _syncs_on_dispatch += 1
+
+
+@contextlib.contextmanager
+def dispatch_path():
+    """Mark the current thread as a hot dispatch loop for the duration:
+    any note_sync inside is a premature host sync (it stalls the next
+    dispatch behind a D2H wait). The serving batcher's dispatch worker
+    wraps each dispatch in this; tests wrap training step loops."""
+    prev = getattr(_tls, "dispatch_path", False)
+    _tls.dispatch_path = True
+    try:
+        yield
+    finally:
+        _tls.dispatch_path = prev
+
+
+def sync_stats():
+    """{"by_tag": {tag: count}, "total", "on_dispatch_path"} since the
+    last reset_profiler(). Counting is always-on (see note_sync), so
+    counts accumulate from process start across unprofiled traffic —
+    call reset_profiler() to scope a measurement window."""
+    with _sync_lock:
+        return {"by_tag": dict(_syncs),
+                "total": sum(_syncs.values()),
+                "on_dispatch_path": _syncs_on_dispatch}
+
+
+def record_idle(tag, idle_s):
+    """Account `idle_s` seconds the device spent with no dispatch queued
+    under `tag` (between one dispatch's completion and the next
+    dispatch's enqueue). The serving InflightWindow's completion thread
+    and the executors' profiling path report through here; the report's
+    Idle(s)/Util% columns render it."""
+    e = _entries.setdefault(tag, _fresh_entry())
+    e["idle_s"] += idle_s
+    e["gaps"] += 1
+
+
+def _fresh_entry():
+    return {"calls": 0, "runs": 0, "total": 0.0, "max": 0.0,
+            "min": float("inf"), "compiles": 0, "compile_s": 0.0,
+            "aot_hits": 0, "saved_s": 0.0, "idle_s": 0.0, "gaps": 0}
+
+
+def record_run(tag, seconds, compiled=False, aot_hit=False, saved_s=0.0,
+               idle_s=None):
     """Executor hook: one jitted dispatch of `tag` took `seconds` (blocked).
     Calls that traced+compiled are counted separately (Compiles/Compile(s))
     so Total/Max/Min/Ave stay honest cache-hit execution times.
@@ -38,12 +112,16 @@ def record_run(tag, seconds, compiled=False, aot_hit=False, saved_s=0.0):
     compile — still an execution call (the deserialize happens before
     the timed dispatch), but counted in its own column with `saved_s`,
     the compile seconds the recording process paid minus the load time,
-    so warm-vs-cold process starts are visible per tag in one report."""
-    e = _entries.setdefault(tag, {"calls": 0, "runs": 0, "total": 0.0,
-                                  "max": 0.0, "min": float("inf"),
-                                  "compiles": 0, "compile_s": 0.0,
-                                  "aot_hits": 0, "saved_s": 0.0})
+    so warm-vs-cold process starts are visible per tag in one report.
+
+    idle_s: seconds the device sat with nothing queued before this
+    dispatch was enqueued (None = previous completion unknown or the
+    device still had work) — feeds the Idle(s)/Util% columns."""
+    e = _entries.setdefault(tag, _fresh_entry())
     e["calls"] += 1
+    if idle_s is not None:
+        e["idle_s"] += idle_s
+        e["gaps"] += 1
     if aot_hit:
         e["aot_hits"] += 1
         e["saved_s"] += saved_s
@@ -120,24 +198,37 @@ def profile_report(sorted_key=None):
     sorted_key: None (insertion order) | 'calls' | 'total' | 'max' | 'min'
     | 'ave' (reference profiler.py sorted_key contract)."""
     _check_sorted_key(sorted_key)
-    rows = [(tag, e["calls"], e["total"], e["max"],
-             0.0 if e["min"] == float("inf") else e["min"],
-             e["total"] / max(e["runs"], 1),  # mean over EXEC calls only
-             e["compiles"], e["compile_s"],
-             e.get("aot_hits", 0), e.get("saved_s", 0.0))
-            for tag, e in _entries.items()]
+    rows = []
+    for tag, e in _entries.items():
+        total = e["total"]
+        idle = e.get("idle_s", 0.0)
+        # device utilization under this tag between first and last
+        # dispatch: busy time over busy+observed idle gaps. Only
+        # meaningful where completion times were observed (profiling
+        # executors, the serving in-flight window) — tags with no idle
+        # observations render "-".
+        util = (100.0 * total / (total + idle)
+                if (total + idle) > 0 and e.get("gaps", 0) else None)
+        rows.append((tag, e["calls"], total, e["max"],
+                     0.0 if e["min"] == float("inf") else e["min"],
+                     total / max(e["runs"], 1),  # mean over EXEC calls
+                     e["compiles"], e["compile_s"],
+                     e.get("aot_hits", 0), e.get("saved_s", 0.0),
+                     idle, util))
     keyidx = {"calls": 1, "total": 2, "max": 3, "min": 4, "ave": 5}
     if sorted_key is not None:
         rows.sort(key=lambda r: r[keyidx[sorted_key]], reverse=True)
-    lines = ["%-40s %8s %10s %10s %10s %10s %9s %10s %7s %9s" %
+    lines = ["%-40s %8s %10s %10s %10s %10s %9s %10s %7s %9s %8s %6s" %
              ("Entry", "Calls", "Total(s)", "Max(s)", "Min(s)", "Ave(s)",
-              "Compiles", "Compile(s)", "AOTHit", "Saved(s)")]
+              "Compiles", "Compile(s)", "AOTHit", "Saved(s)", "Idle(s)",
+              "Util%")]
     for (tag, calls, total, mx, mn, ave, ncomp, comp, ahit,
-         saved) in rows:
+         saved, idle, util) in rows:
         lines.append("%-40s %8d %10.4f %10.4f %10.4f %10.4f %9d %10.4f "
-                     "%7d %9.4f"
+                     "%7d %9.4f %8.4f %6s"
                      % (tag[:40], calls, total, mx, mn, ave, ncomp, comp,
-                        ahit, saved))
+                        ahit, saved, idle,
+                        "-" if util is None else "%.1f" % util))
     if rows:
         cs = cache_stats()
         lines.append(
@@ -145,6 +236,13 @@ def profile_report(sorted_key=None):
             "%.4fs compile time saved"
             % (cs["compiles"], cs["aot_hits"], cs["warm_calls"],
                cs["saved_s"]))
+        ss = sync_stats()
+        if ss["total"]:
+            lines.append(
+                "host syncs: %d total (%d on a dispatch path): %s"
+                % (ss["total"], ss["on_dispatch_path"],
+                   ", ".join("%s=%d" % kv
+                             for kv in sorted(ss["by_tag"].items()))))
     return "\n".join(lines)
 
 
@@ -166,7 +264,11 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 
 def reset_profiler():
+    global _syncs_on_dispatch
     _entries.clear()
+    with _sync_lock:
+        _syncs.clear()
+        _syncs_on_dispatch = 0
     _span[0] = _span[1] = None
 
 

@@ -1,21 +1,32 @@
-"""Dynamic micro-batching: a bounded request queue + one coalescing loop.
+"""Continuous micro-batching: bounded queue + pipelined form/dispatch.
 
 Requests enter via `submit()` (any thread) and wait at most
 `max_queue_delay_ms` — or until `max_batch_size` rows are pending — before
-the worker pops a contiguous batch, drops requests whose deadline already
-passed (answered with `DeadlineExceededError` BEFORE any padding/dispatch
-work is spent on them), and hands the rest to the engine's dispatch
-function in one call. Dispatch returns per-request result slices built on
-lazy FetchHandles: the device dispatch is enqueued but no D2H has
-happened; each future materializes only its own rows when asked.
+the FORMATION worker pops a contiguous batch. With `pipeline_depth >= 1`
+(the default) formation is decoupled from execution: formed batches ride
+a short queue to a DISPATCH worker that pads and enqueues them on the
+device behind a bounded in-flight window (core/dispatch.InflightWindow),
+so new rows admit into the *forming* batch while the current one
+executes, and the device always has the next batch queued behind the
+running one — continuous batching. Safe because dispatch returns
+per-request result slices over lazy pre-D2H FetchHandles (no sync on the
+dispatch path; the window's completion thread owns the only
+block_until_ready) and because row results at a fixed compiled shape
+depend only on that row (the engine's bucket-lattice invariant), so
+overlapping batches can't perturb each other. `pipeline_depth=0` keeps
+the PR-3 serial loop (form -> pad -> dispatch -> scatter on one thread)
+for comparison benches.
 
 Robustness contract (the parts of serving that are the subsystem, not an
 afterthought):
   * bounded queue — `submit()` on a full queue raises `QueueFullError`
     immediately (backpressure beats unbounded latency),
-  * per-request deadlines — expired requests never reach the device,
+  * per-request deadlines — expired requests never reach the device:
+    checked at batch formation AND re-checked when a formed batch is
+    popped for dispatch (it may have waited behind a full window),
   * graceful shutdown — `close(drain=True)` stops intake, drains every
-    in-flight and queued request, then joins the worker.
+    queued, formed and in-flight request, then joins both workers;
+    `close(drain=False)` fails queued AND formed requests immediately.
 """
 import collections
 import threading
@@ -127,19 +138,31 @@ class _Request(object):
 
 
 class Batcher(object):
-    """The coalescing loop. `dispatch_fn(requests)` (the engine) pads the
-    requests into one bucket, runs the executor once, and scatters
-    per-request results into `req.future` — the worker only decides WHAT
-    rides in a batch and WHEN it leaves."""
+    """The coalescing pipeline. `dispatch_fn(requests)` (the engine) pads
+    the requests into one bucket, runs the executor once, scatters
+    per-request results into `req.future`, and returns the batch's lazy
+    fetch handles — the batcher decides WHAT rides in a batch, WHEN it
+    leaves, and HOW MANY batches may be in flight on the device at once.
+
+    pipeline_depth >= 1: continuous batching — a formation worker owns
+    the request queue and a dispatch worker owns the device, joined by a
+    short formed-batch queue; up to `pipeline_depth` dispatches stay
+    outstanding (an InflightWindow completion thread recycles slots as
+    the device finishes, off the dispatch path). pipeline_depth=0: the
+    serial PR-3 loop, kept as the bench baseline."""
 
     def __init__(self, dispatch_fn, max_batch_size=32, max_queue_delay_ms=5,
-                 queue_capacity=256, metrics=None, name="batcher"):
+                 queue_capacity=256, metrics=None, name="batcher",
+                 pipeline_depth=2):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
+        if pipeline_depth < 0:
+            raise ValueError("pipeline_depth must be >= 0")
         self._dispatch = dispatch_fn
         self.max_batch_size = int(max_batch_size)
         self.max_queue_delay_s = float(max_queue_delay_ms) / 1e3
         self.queue_capacity = int(queue_capacity)
+        self.pipeline_depth = int(pipeline_depth)
         self._metrics = metrics
         self._queue = collections.deque()
         self._pending_rows = 0   # running sum over _queue (O(1) wakeups:
@@ -150,11 +173,27 @@ class Batcher(object):
         self._draining = False
         self._drainers = 0       # live drain() calls: worker skips the
         self._dispatching = False  # coalescing window while any waits
-        self._worker = threading.Thread(target=self._loop, daemon=True,
-                                        name="ptpu-" + name)
+        self._formed = collections.deque()  # formed, awaiting dispatch
+        self._formed_cap = max(1, self.pipeline_depth)
+        self._form_busy = False  # formation holds a popped batch
+        self._form_done = False  # formation worker exited
+        self._window = None
+        if self.pipeline_depth >= 1:
+            from ..core.dispatch import InflightWindow
+            self._window = InflightWindow(self.pipeline_depth,
+                                          tag="serving/%s/window" % name)
+            self._workers = [
+                threading.Thread(target=self._form_loop, daemon=True,
+                                 name="ptpu-%s-form" % name),
+                threading.Thread(target=self._dispatch_loop, daemon=True,
+                                 name="ptpu-%s-dispatch" % name)]
+        else:
+            self._workers = [threading.Thread(
+                target=self._loop, daemon=True, name="ptpu-" + name)]
         if metrics is not None:
             metrics.bind_queue_depth(lambda: len(self._queue))
-        self._worker.start()
+        for w in self._workers:
+            w.start()
 
     # ---------------------------------------------------------- intake --
     def submit(self, feed, rows, deadline_ms=None):
@@ -183,13 +222,27 @@ class Batcher(object):
             self._pending_rows += req.rows
             if req.deadline is not None:
                 self._deadlined += 1
-            self._cond.notify()
+            # notify_all: the formation worker, dispatch worker and any
+            # drainers share this condition — a single notify could land
+            # on a thread that isn't waiting for new requests
+            self._cond.notify_all()
         if self._metrics is not None:
             self._metrics.on_submit()
         return req.future
 
     def queue_depth(self):
         return len(self._queue)
+
+    def pipeline_stats(self):
+        """Continuous-batching window stats ({"depth", "completed",
+        "idle_s", "gaps"}), or None in serial mode — the public surface
+        for pool/engine observability (the window itself stays an
+        implementation detail)."""
+        if self._window is None:
+            return None
+        stats = self._window.stats()
+        stats["depth"] = self._window.depth
+        return stats
 
     # ---------------------------------------------------------- worker --
     def _collect_batch(self):
@@ -234,10 +287,14 @@ class Batcher(object):
                 batch.append(self._pop_head())
                 rows += req.rows
             # mark the worker busy while STILL holding the lock: between
-            # popping a batch and scattering its results the queue may be
-            # empty, and a drain() that declared victory in that window
-            # would return with requests mid-dispatch
-            self._dispatching = bool(batch)
+            # popping a batch and handing it on (formed queue or
+            # dispatch) the queue may be empty, and a drain() that
+            # declared victory in that window would return with requests
+            # mid-flight
+            if self._window is not None:
+                self._form_busy = bool(batch)
+            else:
+                self._dispatching = bool(batch)
             return batch, expired
 
     def _pop_head(self):
@@ -249,17 +306,67 @@ class Batcher(object):
             self._deadlined -= 1
         return req
 
+    def _fail_expired(self, expired):
+        for req in expired:
+            if not req.future.done():
+                req.future.set_exception(DeadlineExceededError(
+                    "deadline passed after %.1fms in queue"
+                    % ((time.monotonic() - req.enqueued_at) * 1e3)))
+        if expired and self._metrics is not None:
+            self._metrics.on_deadline_expired(len(expired))
+
+    def _run_batch(self, batch):
+        """Pad+dispatch one formed batch: deadline re-check (a formed
+        batch may have waited behind a full in-flight window), window
+        slot, dispatch, completion tracking. The dispatch call itself is
+        wrapped in profiler.dispatch_path() — any host sync inside is a
+        pipeline stall the no-premature-sync regression test catches."""
+        now = time.monotonic()
+        live = [r for r in batch
+                if r.deadline is None or r.deadline >= now]
+        if len(live) != len(batch):
+            self._fail_expired([r for r in batch if r not in live])
+        if not live:
+            return
+        window = self._window
+        if window is not None:
+            # bounded in-flight: park until the device finishes a batch.
+            # Poll so a hard close (drain=False) can't wedge this worker
+            # behind a slot that will never free.
+            while not window.acquire(timeout=0.1):
+                with self._cond:
+                    if self._closed and not self._draining:
+                        for req in live:
+                            if not req.future.done():
+                                req.future.set_exception(
+                                    ServingClosedError(
+                                        "serving engine shut down before "
+                                        "dispatch"))
+                        return
+        enq_t = time.monotonic()
+        try:
+            from .. import profiler as _prof
+            with _prof.dispatch_path():
+                handles = self._dispatch(live)
+        except Exception as e:  # noqa: BLE001 — fail the batch, not the
+            if window is not None:   # worker: serving must outlive one
+                window.release()     # bad request batch
+            for req in live:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            if self._metrics is not None:
+                self._metrics.on_error(len(live))
+        else:
+            if window is not None:
+                window.track(handles or (), enq_t)
+
     def _loop(self):
+        """Serial mode (pipeline_depth=0): form -> dispatch, one thread."""
         while True:
             batch, expired = self._collect_batch()
             if batch is None:
                 return
-            for req in expired:
-                req.future.set_exception(DeadlineExceededError(
-                    "deadline passed after %.1fms in queue"
-                    % ((time.monotonic() - req.enqueued_at) * 1e3)))
-            if expired and self._metrics is not None:
-                self._metrics.on_deadline_expired(len(expired))
+            self._fail_expired(expired)
             if not batch:
                 if expired:
                     # an expired-only collection may have just emptied
@@ -270,13 +377,62 @@ class Batcher(object):
                         self._cond.notify_all()
                 continue
             try:
-                self._dispatch(batch)
-            except Exception as e:  # noqa: BLE001 — fail the batch, not
-                for req in batch:   # the worker: serving must outlive one
-                    if not req.future.done():   # bad request batch
-                        req.future.set_exception(e)
-                if self._metrics is not None:
-                    self._metrics.on_error(len(batch))
+                self._run_batch(batch)
+            finally:
+                with self._cond:
+                    self._dispatching = False
+                    self._cond.notify_all()   # wake drain() waiters
+
+    def _form_loop(self):
+        """Pipelined formation: owns the request queue; hands formed
+        batches to the dispatch worker through the bounded formed
+        queue. While a batch dispatches, the NEXT one forms here."""
+        while True:
+            batch, expired = self._collect_batch()
+            if batch is None:
+                break
+            self._fail_expired(expired)
+            if not batch:
+                if expired:
+                    with self._cond:
+                        self._cond.notify_all()
+                continue
+            with self._cond:
+                while len(self._formed) >= self._formed_cap \
+                        and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._draining:
+                    # hard close caught us holding a formed batch
+                    self._form_busy = False
+                    self._cond.notify_all()
+                    for req in batch:
+                        if not req.future.done():
+                            req.future.set_exception(ServingClosedError(
+                                "serving engine shut down before "
+                                "dispatch"))
+                    continue
+                self._formed.append(batch)
+                self._form_busy = False
+                self._cond.notify_all()
+        with self._cond:
+            self._form_done = True
+            self._cond.notify_all()
+
+    def _dispatch_loop(self):
+        """Pipelined dispatch: pads and enqueues formed batches behind
+        the in-flight window; exits once formation has exited and the
+        formed queue is drained."""
+        while True:
+            with self._cond:
+                while not self._formed and not self._form_done:
+                    self._cond.wait()
+                if not self._formed:
+                    return  # formation exited, nothing left
+                batch = self._formed.popleft()
+                self._dispatching = True
+                self._cond.notify_all()  # formation may wait on space
+            try:
+                self._run_batch(batch)
             finally:
                 with self._cond:
                     self._dispatching = False
@@ -299,9 +455,11 @@ class Batcher(object):
             self._drainers += 1
             self._cond.notify_all()        # cut the coalescing wait short
             try:
-                while self._queue or self._dispatching:
-                    if not self._worker.is_alive() and not self._queue:
-                        return True        # worker exited post-dispatch
+                while self._queue or self._formed or self._form_busy \
+                        or self._dispatching:
+                    if not any(w.is_alive() for w in self._workers) \
+                            and not self._queue and not self._formed:
+                        return True        # workers exited post-dispatch
                     remaining = None
                     if deadline is not None:
                         remaining = deadline - time.monotonic()
@@ -328,9 +486,20 @@ class Batcher(object):
                     self._pop_head().future.set_exception(
                         ServingClosedError("serving engine shut down "
                                            "before dispatch"))
+                while self._formed:
+                    for req in self._formed.popleft():
+                        if not req.future.done():
+                            req.future.set_exception(ServingClosedError(
+                                "serving engine shut down before "
+                                "dispatch"))
             self._cond.notify_all()
         if already:
             return
         if drain:
             self.drain(timeout)
-        self._worker.join(timeout)
+        for w in self._workers:
+            w.join(timeout)
+        if self._window is not None:
+            # after the workers: every tracked dispatch gets its
+            # completion observed, then the completion thread exits
+            self._window.close(timeout)

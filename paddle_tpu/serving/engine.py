@@ -96,6 +96,8 @@ class ResultSlice(object):
         self.bucket = bucket  # (batch_bucket, seq_bucket | None)
 
     def numpy(self):
+        from .. import profiler as _prof
+        _prof.note_sync("serving/materialize")
         out = {}
         for name, h in zip(self._fetch_names, self._handles):
             policy = self._row_policy[name]
@@ -142,7 +144,8 @@ class InferenceEngine(object):
                  batch_buckets=None, seq_buckets=None, max_batch_size=None,
                  max_queue_delay_ms=None, queue_capacity=256,
                  default_deadline_ms=None, validate=True, warmup=True,
-                 latency_window=2048, apply_tuned=False):
+                 latency_window=2048, apply_tuned=False,
+                 pipeline_depth=None):
         from ..places import CPUPlace
         self.name = name or (os.path.basename(os.path.normpath(model_dir))
                              if model_dir else "model")
@@ -269,12 +272,26 @@ class InferenceEngine(object):
                             ([16, 32, 64, 128, 256] if self._seq_feeds
                              else []))
 
+        # continuous batching (ARCHITECTURE.md §22): how many dispatches
+        # may be outstanding on the device while the next batch forms.
+        # Default 2 — the device executes one batch while the next is
+        # already enqueued behind it. 0 = the serial PR-3 loop (bench
+        # baseline). FLAGS_serving_pipeline_depth overrides the default;
+        # an explicit constructor argument wins.
+        if pipeline_depth is None:
+            try:
+                pipeline_depth = int(os.environ.get(
+                    "FLAGS_serving_pipeline_depth", "2"))
+            except ValueError:
+                pipeline_depth = 2
+        self.pipeline_depth = int(pipeline_depth)
+
         self.metrics = ServingMetrics(latency_window=latency_window)
         self._batcher = Batcher(
             self._dispatch, max_batch_size=self.max_batch_size,
             max_queue_delay_ms=max_queue_delay_ms,
             queue_capacity=queue_capacity, metrics=self.metrics,
-            name=self.name)
+            name=self.name, pipeline_depth=self.pipeline_depth)
         if warmup:
             try:
                 self.warmup()
@@ -531,18 +548,22 @@ class InferenceEngine(object):
         """Batcher callback. Requests are grouped by concrete-shape
         signature (one group, in the common all-dims-declared case) and
         each group pads into one bucket dispatch; a group that fails
-        fails only ITS requests, never a co-batched group's."""
+        fails only ITS requests, never a co-batched group's. Returns the
+        batch's lazy fetch handles so the batcher's in-flight window can
+        observe device completion (off this thread)."""
         groups = {}
         for req in requests:
             groups.setdefault(req.feed.shape_sig, []).append(req)
+        all_handles = []
         for reqs in groups.values():
             try:
-                self._dispatch_group(reqs)
+                all_handles.extend(self._dispatch_group(reqs) or ())
             except Exception as e:  # noqa: BLE001 — isolate the group
                 for r in reqs:
                     if not r.future.done():
                         r.future.set_exception(e)
                 self.metrics.on_error(len(reqs))
+        return all_handles
 
     # pre-dispatch tap: the ReplicaPool points this at its per-replica
     # fault/bookkeeping hook (dispatch counting, injected replica faults).
@@ -581,6 +602,7 @@ class InferenceEngine(object):
                 self.name, batch_bucket,
                 "s%d" % seq_bucket if seq_bucket else "")
             _prof.record_run(tag, now - t0, compiled=compiled)
+        return handles
 
     # ---------------------------------------------------------- public --
     def submit(self, feed, deadline_ms=None):
@@ -699,6 +721,7 @@ class InferenceEngine(object):
             "batch_buckets": self.batch_buckets,
             "seq_buckets": self.seq_buckets,
             "max_batch_size": self.max_batch_size,
+            "pipeline_depth": self.pipeline_depth,
             "status": "closed" if self.closed else "serving",
             "metrics": self.metrics.snapshot(),
         }

@@ -855,10 +855,21 @@ class ReplicaPool(object):
         for rep in self._replicas:
             with rep.lock:
                 st = rep.state
-                reps.append({"replica": rep.idx, "state": st,
-                             "dead": rep.dead, "inflight": rep.inflight,
-                             "dispatches": rep.dispatches,
-                             "generation": rep.generation})
+                entry = {"replica": rep.idx, "state": st,
+                         "dead": rep.dead, "inflight": rep.inflight,
+                         "dispatches": rep.dispatches,
+                         "generation": rep.generation}
+                # continuous-batching window (ARCHITECTURE.md §22):
+                # per-replica device in-flight/idle accounting — the
+                # operator's view of whether this replica's device is
+                # actually kept busy behind the pipeline
+                ws = rep.engine._batcher.pipeline_stats()
+                if ws is not None:
+                    entry["pipeline"] = {
+                        "depth": ws["depth"],
+                        "completed": ws["completed"],
+                        "device_idle_s": round(ws["idle_s"], 4)}
+            reps.append(entry)
             counts[st] += 1
         out = {"replicas": reps, "healthy": counts[HEALTHY],
                "degraded": counts[DEGRADED], "ejected": counts[EJECTED],

@@ -109,6 +109,49 @@ def test_bench_serving_smoke():
         assert rec[k] >= 0
 
 
+def test_bench_pipeline_smoke():
+    """The BENCH_PIPELINE leg: one subprocess run on CPU driving the
+    same open-loop schedule through the serial and pipelined batchers
+    and the same recordio trainer through the serial and prefetched
+    prepass. The gates are the CORRECTNESS half of the acceptance
+    criteria — both divergences exactly 0.0 and every request/step
+    completed; the speed half (pipelined beats serial) needs hardware
+    where host and device overlap at all, i.e. the TPU sweep tier, not
+    this one-core CI box where both legs timeshare one core."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_PIPELINE": "1",
+        "BENCH_PIPELINE_REQUESTS": "64",
+        "BENCH_PIPELINE_RECORDS": "16",
+        "BENCH_PIPELINE_FEAT": "512",
+        "BENCH_SERVING_HIDDEN": "64", "BENCH_SERVING_LAYERS": "4",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "pipeline_dispatch_open_qps"
+    assert rec["unit"] == "requests/sec/chip"
+    assert "error" not in rec
+    # bit-exactness gates: pipelined serving == run_direct probe,
+    # prefetched training == serial prepass, exactly
+    assert rec["serving_divergence"] == 0.0
+    assert rec["train_divergence"] == 0.0
+    # all work completed and was measured
+    assert rec["value"] > 0 and rec["serial_open_qps"] > 0
+    assert rec["train_steps"] == 16
+    assert rec["train_serial_steps_s"] > 0
+    assert rec["train_prefetch_steps_s"] > 0
+    for k in ("serial_p50_ms", "serial_p99_ms",
+              "pipelined_p50_ms", "pipelined_p99_ms"):
+        assert rec[k] >= 0
+    assert rec["pipeline_depth"] == 2
+
+
 def test_bench_pool_smoke():
     """The BENCH_POOL leg: one subprocess run on CPU driving the same
     open-loop schedule through 1- and 2-replica pools with a mid-run

@@ -29,11 +29,12 @@ def test_profiler_records_per_entry_stats(capsys):
     assert "Calls" in out and "Compile(s)" in out
     report = profiler.profile_report(sorted_key="calls")
     # the training program entry ran 4 times; startup ran once each
-    # 9 numeric columns after the (possibly space-containing) tag; the
-    # "compile cache:" footer is a summary, not an entry row
-    counts = sorted(int(line.split()[-9]) for line in
+    # 11 numeric columns after the (possibly space-containing) tag; the
+    # "compile cache:" / "host syncs:" footers are summaries, not rows
+    counts = sorted(int(line.split()[-11]) for line in
                     report.splitlines()[1:]
-                    if not line.startswith("compile cache:"))
+                    if not line.startswith(("compile cache:",
+                                            "host syncs:")))
     assert counts[-1] == 4, report
     with pytest.raises(ValueError, match="sorted_key"):
         profiler.profile_report(sorted_key="bogus")

@@ -97,6 +97,14 @@ probe && run 1200 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=64 BENCH_WARMUP=2
 # --- tier 2b: sharded weight update on the real mesh (PR 9) ----------------
 probe && run 1200 BENCH_SHARDED=1 BENCH_STEPS=32 BENCH_WARMUP=2
 probe && run 1200 BENCH_SHARDED=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_SHARDED_DIM=1024
+# --- tier 2c: pipelined dispatch (PR 10) — the host/device overlap this
+# sweep finally measures on hardware where host and device are separate:
+# open-loop serving p50/p99 serial-vs-pipelined at fixed load, and
+# steps/s serial-vs-prefetch on a host-io-bound trainer (wide records,
+# narrow model; the H2D is the cost prefetch hides)
+probe && run 1200 BENCH_PIPELINE=1
+probe && run 1200 BENCH_PIPELINE=1 BENCH_PIPELINE_FEAT=8192 BENCH_PIPELINE_BATCH=64
+probe && run 1200 BENCH_PIPELINE=1 BENCH_PIPELINE_K=8 BENCH_PIPELINE_RECORDS=64
 # --- tier 3: big compile LAST — one unrolled TPU line (K copies of the step)
 probe && run 2400 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_MULTISTEP=8 FLAGS_multistep_unroll=1
 bank

@@ -201,6 +201,11 @@ def main(argv=None):
                     help="default per-request deadline (requests may "
                          "override per call)")
     ap.add_argument("--queue-capacity", type=int, default=256)
+    ap.add_argument("--pipeline-depth", type=int, default=None,
+                    metavar="D",
+                    help="continuous-batching in-flight window: up to D "
+                    "dispatches outstanding per engine while the next "
+                    "batch forms (default 2; 0 = the serial batcher)")
     ap.add_argument("--replicas", type=int, default=1, metavar="N",
                     help="serve N engine replicas behind one endpoint "
                          "(least-loaded routing, health-gated circuit "
@@ -258,7 +263,8 @@ def main(argv=None):
         batch_buckets=batch_buckets, seq_buckets=seq_buckets,
         max_batch_size=args.max_batch,
         max_queue_delay_ms=args.max_delay_ms,
-        queue_capacity=args.queue_capacity, warmup=not args.no_warmup)
+        queue_capacity=args.queue_capacity, warmup=not args.no_warmup,
+        pipeline_depth=args.pipeline_depth)
     try:
         if args.replicas > 1:
             # pool placement: None = TPUPlace(i) round-robin over the
