@@ -17,6 +17,21 @@ jax.sharding Meshes (parallel/).
 """
 from . import tpu_guard  # MUST be first: installs the exclusive TPU-client
                          # lock on jax backend init (see tpu_guard.py)
+
+# Sharding-invariant PRNG, process-wide: with the legacy (non-
+# partitionable) threefry, the SAME program traced under a tensor-
+# parallel mesh draws DIFFERENT random bits than single-device (XLA's
+# partition of the counter math changes the stream) — a dropout mask
+# that silently depends on the distribution plan would break every
+# mesh-1/replicated bit-exactness contract in parallel/plan.py. The
+# partitionable formulation makes every draw a pure function of
+# (key, position) regardless of mesh, at the cost of a one-time stream
+# change vs the legacy formulation (no test pins legacy absolute
+# values; trace_env_key() carries the flag so stale AOT artifacts
+# re-key rather than silently serving legacy-stream executables).
+import jax as _jax
+_jax.config.update("jax_threefry_partitionable", True)
+
 from .core import framework
 from .core.framework import (Program, Operator, Variable, Parameter,
                              default_main_program, default_startup_program,

@@ -347,6 +347,15 @@ def aot_entry_key(program, feed_sig, fetch_names, trace_env, multi,
         "jax_version": jax.__version__,
         "platform": getattr(device, "platform", str(device)),
         "device_kind": getattr(device, "device_kind", ""),
+        # device IDENTITY, not just kind: serialize_executable binds an
+        # artifact to the concrete devices it was compiled for, and
+        # deserialize_and_load rebinds to exactly those — an artifact
+        # compiled on chip 0 (or mesh span [0,1]) called with arrays on
+        # chip 2 (span [2,3]) fails at call time with a sharding
+        # mismatch whose reprs look identical (found by the tp=2
+        # 2-replica pool: replica 1 loaded replica 0's artifact).
+        # Multi-device spans additionally ride extra["mesh_device_ids"].
+        "device_id": getattr(device, "id", None),
         "num_devices": 1 if extra is None else extra.get("num_devices", 1),
         "program_sha256": prog_hash,
         "program_random_seed": int(getattr(program, "random_seed", 0) or 0),

@@ -940,19 +940,23 @@ class Executor(object):
                 fetches, new_state, errors = jitted(
                     [feed_arrays[n] for n in feed_names],
                     read_state(state_rw), read_state(state_ro), seed)
-        except TypeError:
+        except (TypeError, ValueError):
             if aot_entry is None and not isinstance(
                     jitted, jax.stages.Compiled):
                 raise  # a plain jit retraces by itself; this is real
-            # a fixed-aval Compiled rejected the live argument avals —
-            # either an AOT-loaded entry recorded under different aval
-            # promotion, or an in-process entry whose state avals
-            # drifted under an unchanged key (e.g. a persistable
-            # restored at a different dtype), which the donating jit
-            # used to absorb by retracing. Aval checking precedes
-            # execution, so nothing was donated/consumed — drop the
-            # disk entry and fall back to a fresh (retracing) compile,
-            # the cache's only failure mode.
+            # a fixed-aval Compiled rejected the live argument avals
+            # (TypeError) or their device placement (ValueError — a
+            # deserialized artifact is bound to the concrete devices it
+            # was compiled for, and a device-id key mismatch from an
+            # older cache schema surfaces here) — either an AOT-loaded
+            # entry recorded under different aval promotion, or an
+            # in-process entry whose state avals drifted under an
+            # unchanged key (e.g. a persistable restored at a different
+            # dtype), which the donating jit used to absorb by
+            # retracing. Aval/placement checking precedes execution, so
+            # nothing was donated/consumed — drop the disk entry and
+            # fall back to a fresh (retracing) compile, the cache's
+            # only failure mode.
             if aot_entry is None:
                 aot_dir = compile_cache.active_aot_cache_dir()
                 akey = compile_cache.aot_entry_key(

@@ -66,7 +66,13 @@ def trace_env_key():
     import os
     from ..ops.nn_ops import _conv_layout, _flash_min_seq
     return (_conv_layout(), _flash_min_seq(), remat_segment_len_flag(),
-            os.environ.get("PADDLE_TPU_PALLAS", ""))
+            os.environ.get("PADDLE_TPU_PALLAS", ""),
+            # the PRNG formulation is traced into every random op; the
+            # package __init__ pins it partitionable, so this entry's
+            # real job is re-keying AOT artifacts serialized under the
+            # legacy stream (they would otherwise hit and silently
+            # serve the other formulation's masks)
+            bool(jax.config.jax_threefry_partitionable))
 
 
 def register_special(type):

@@ -6,10 +6,14 @@ from .plan import ShardingPlan, VarPlan
 from .ring_attention import ring_attention, ring_attention_sharded, \
     attention_reference, sequence_parallel_specs
 from .pipeline import pipeline_apply, pipeline_stages_spec, \
-    stack_stage_params, sequential_reference
+    stack_stage_params, sequential_reference, mlp_block_init, \
+    mlp_block_apply, mlp_block_specs
 from .distributed import init_distributed, shutdown_distributed, \
     global_mesh, DeviceLayout, active_layout, set_active_layout, \
     is_initialized as distributed_is_initialized
 from .moe import moe_layer, init_moe_params, moe_param_specs
 from .ulysses import ulysses_attention, ulysses_attention_sharded
-from . import tp
+# (the seed-era `parallel.tp` module is gone: Program-level tensor
+# parallelism is ShardingPlan.build(tp_axis=...) — plan.py,
+# ARCHITECTURE.md §23 — and the surviving Megatron stage block lives in
+# pipeline.py. See MIGRATION.md.)

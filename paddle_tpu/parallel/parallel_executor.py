@@ -53,7 +53,8 @@ class ParallelExecutor(object):
                  num_threads=None, allow_op_delay=False, share_vars_from=None,
                  use_tpu=None, devices=None, mesh=None, param_shardings=None,
                  batch_axis=None, check_nan_inf=None,
-                 sharded_weight_update=False, plan=None, shard_axis=None):
+                 sharded_weight_update=False, plan=None, shard_axis=None,
+                 tp_axis=None):
         self._program = main_program if main_program is not None \
             else default_main_program()
         self._validated = set()  # strict-mode analysis cache (see run)
@@ -68,12 +69,12 @@ class ParallelExecutor(object):
                     "pass one or the other"
                     % (dict(plan.mesh.shape), dict(mesh.shape)))
             if param_shardings or sharded_weight_update \
-                    or shard_axis is not None:
+                    or shard_axis is not None or tp_axis is not None:
                 raise ValueError(
                     "plan= already decides param_shardings / "
-                    "sharded_weight_update / shard_axis; build the "
-                    "plan with those (ShardingPlan.build) instead of "
-                    "passing both")
+                    "sharded_weight_update / shard_axis / tp_axis; "
+                    "build the plan with those (ShardingPlan.build) "
+                    "instead of passing both")
             if batch_axis is not None and batch_axis != plan.batch_axis:
                 raise ValueError(
                     "plan= was built with batch_axis=%r but "
@@ -91,9 +92,11 @@ class ParallelExecutor(object):
         # params + accumulators split dim 0 over the shard axis, so GSPMD
         # turns the gradient all-reduce into reduce-scatter, each replica
         # updates only its 1/N shard, and the new weights all-gather on
-        # use — optimizer-state memory drops ~N-fold. Precedence inside
-        # the partitioner: explicit param_shardings > ParamAttr
-        # mesh_axes annotations (accumulators follow) > auto ZeRO.
+        # use — optimizer-state memory drops ~N-fold. tp_axis="tp" arms
+        # the intra-layer tensor-parallel per-family rule over that
+        # mesh axis (ARCHITECTURE.md §23). Precedence inside the
+        # partitioner: explicit param_shardings > ParamAttr mesh_axes
+        # annotations (accumulators follow) > auto TP > auto ZeRO.
         # shard_axis defaults to the batch axis, or to the active
         # DeviceLayout's recorded shard axis when one is set (the
         # elastic-training handoff: a resharded cohort keeps the
@@ -115,7 +118,7 @@ class ParallelExecutor(object):
             plan = ShardingPlan.build(
                 self._program, self.mesh, batch_axis=self._batch_axis,
                 shard_axis=shard_axis, shard_update=sharded_weight_update,
-                overrides=param_shardings)
+                overrides=param_shardings, tp_axis=tp_axis)
         self.plan = plan
         # legacy view: param name -> PartitionSpec for every var the plan
         # shards (or the caller pinned); anything absent is replicated
@@ -323,8 +326,15 @@ class ParallelExecutor(object):
             # the plan's gradient constraints pin each sharded param's
             # grad to the owner's shard layout inside the traced step, so
             # GSPMD lowers the cross-replica gradient sum as
-            # reduce-scatter straight onto the updating shard
-            constraints = self.plan.grad_constraints() or None
+            # reduce-scatter straight onto the updating shard; the
+            # tensor-parallel gather constraints pin each TP param's
+            # traced value replicated at the step's entry (weights
+            # sharded at rest, all-gathered on use — bit-exact compute,
+            # ARCHITECTURE.md §23). Param names and grad names never
+            # collide (GRAD_SUFFIX), so one dict carries both.
+            constraints = dict(self.plan.grad_constraints())
+            constraints.update(self.plan.param_gather_constraints())
+            constraints = constraints or None
             if steps > 1:
                 fn = lowering.lower_multi_step(
                     program, feed_names, fetch_names, state_rw,
@@ -360,6 +370,11 @@ class ParallelExecutor(object):
                     "num_devices": int(self.mesh.devices.size),
                     "mesh_axes": {a: int(s) for a, s in
                                   self.mesh.shape.items()},
+                    # the concrete span, in mesh order: two replicas of
+                    # one model over DIFFERENT device spans must store
+                    # separate artifacts (see aot_entry_key device_id)
+                    "mesh_device_ids": [int(getattr(d, "id", -1))
+                                        for d in self.mesh.devices.flat],
                     "batch_axis": self._batch_axis,
                     "plan": self.plan.to_json(),
                 })
@@ -429,7 +444,7 @@ class ParallelExecutor(object):
             _cache_put_lru(self._cache, key, entry, _jit_cache_capacity())
         jitted, state_rw, state_ro, state_out = entry
 
-        def read_state(names):
+        def read_state(names, commit=False):
             vals = []
             for n in names:
                 v = scope.get(n)
@@ -440,6 +455,21 @@ class ParallelExecutor(object):
                 want = self._state_sharding(n)
                 if not (isinstance(v, jax.Array) and v.sharding == want):
                     v = jax.device_put(v, want)
+                    if commit:
+                        # commit the re-placed value to the scope so the
+                        # at-rest layout IS the plan's: read-only state
+                        # (inference params on a TP serving mesh, the LR
+                        # var) would otherwise keep its full host/loader
+                        # copy forever and re-pay the transfer+reshard
+                        # every dispatch — for a sharded-at-rest plan
+                        # the scope copy is THE 1/N residency claim.
+                        # Never for rw state: those buffers are donated,
+                        # and a committed-then-donated array would leave
+                        # the scope holding a deleted buffer if the
+                        # dispatch raises before the post-step
+                        # write-back (the original host copy survives
+                        # that today).
+                        scope.set(n, v)
                 vals.append(v)
             return vals
 
@@ -477,13 +507,15 @@ class ParallelExecutor(object):
             with _donating_call_guard(jitted):
                 fetches, new_state, errors = jitted(
                     feed_vals, read_state(state_rw),
-                    read_state(state_ro), seed)
-        except TypeError:
+                    read_state(state_ro, commit=True), seed)
+        except (TypeError, ValueError):
             if aot_entry is None and not isinstance(
                     jitted, jax.stages.Compiled):
                 raise  # a plain jit retraces by itself; this is real
             # a fixed-aval Compiled (AOT-loaded, or in-process under
             # drifted state avals) rejected the live arguments — aval
+            # (TypeError) or device/sharding (ValueError: an artifact
+            # is bound to the concrete devices it was compiled for)
             # checking precedes execution, nothing was consumed; drop
             # the disk entry and fall back to a fresh donating jit
             # (see Executor._run_impl for the matching path)
@@ -505,7 +537,7 @@ class ParallelExecutor(object):
             with _donating_call_guard(jitted):
                 fetches, new_state, errors = jitted(
                     feed_vals, read_state(state_rw),
-                    read_state(state_ro), seed)
+                    read_state(state_ro, commit=True), seed)
         if cancelled is not None and cancelled.is_set():
             # caller already raised DispatchTimeoutError; a late scope
             # write would race its rollback (see Executor._run_impl)

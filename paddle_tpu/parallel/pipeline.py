@@ -37,7 +37,63 @@ except ImportError:  # older jax
 from .mesh import P, vary as _vary
 
 __all__ = ["pipeline_apply", "pipeline_stages_spec", "stack_stage_params",
-           "sequential_reference"]
+           "sequential_reference", "mlp_block_init", "mlp_block_apply",
+           "mlp_block_specs"]
+
+
+# ---------------------------------------------------------------------------
+# The homogeneous pipeline STAGE block (absorbed from the seed-era
+# parallel/tp.py — see MIGRATION.md). Program-level tensor parallelism
+# is `ShardingPlan.build(..., tp_axis=)` (plan.py, ARCHITECTURE.md §23);
+# these helpers survive only as the manual-mode stage math the pipeline
+# schedule composes with: a Megatron-style column/row two-matmul block
+# with one psum, runnable densely (tp_axis=None — the single-chip
+# reference) or manually inside shard_map (a pipeline stage, where the
+# 'pp' schedule is already manual and GSPMD can't place the collective).
+# ---------------------------------------------------------------------------
+
+def mlp_block_init(rng, d, d_hidden, scale=0.1):
+    """Params for one tanh MLP block: [d -> d_hidden -> d] (shape-
+    preserving, so it can serve as a homogeneous pipeline stage)."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(rng)
+                              if isinstance(rng, int) else rng)
+    return {
+        "w1": jax.random.normal(k1, (d, d_hidden), jnp.float32) * scale,
+        "b1": jnp.zeros((d_hidden,), jnp.float32),
+        "w2": jax.random.normal(k2, (d_hidden, d), jnp.float32) * scale,
+        "b2": jnp.zeros((d,), jnp.float32),
+    }
+
+
+def mlp_block_specs(tp_axis="mp", pp_axis=None):
+    """PartitionSpecs for (optionally stage-stacked) mlp_block params.
+
+    Column-parallel w1/b1 split the hidden dim over ``tp_axis``; the
+    row-parallel w2 splits its input (hidden) dim; b2 is replicated over
+    mp (added after the psum). With ``pp_axis`` set, a leading stacked
+    stage dim is sharded over it (pipeline composition — the
+    `pipeline_apply(param_specs=...)` hook)."""
+    def pp(*rest):
+        return P(pp_axis, *rest) if pp_axis else P(*rest)
+    return {
+        "w1": pp(None, tp_axis),
+        "b1": pp(tp_axis),
+        "w2": pp(tp_axis, None),
+        "b2": pp(None),
+    }
+
+
+def mlp_block_apply(params, x, tp_axis=None):
+    """y = w2ᵀ·tanh(w1ᵀx + b1) + b2, with the hidden dim sharded over
+    ``tp_axis`` when running manually inside shard_map (one psum — the
+    Megatron pattern). With tp_axis=None this is the dense math (the
+    single-chip reference, or a plain stage under the stacked 'pp'
+    placement)."""
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    z = h @ params["w2"]
+    if tp_axis is not None:
+        z = lax.psum(z, tp_axis)
+    return z + params["b2"]
 
 
 def sequential_reference(stage_fn, stacked_params, x):
@@ -111,9 +167,9 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, num_microbatches=None,
     param_specs: optional PartitionSpec pytree (same structure as
     stacked_params) overriding the default P(axis)-on-the-stage-dim
     placement — the dp×mp×pp composition hook: shard stage weights over
-    BOTH 'pp' and a tensor-parallel axis (e.g. tp.mlp_block_specs(
+    BOTH 'pp' and a tensor-parallel axis (e.g. mlp_block_specs(
     tp_axis='mp', pp_axis='pp')) and have stage_fn do its own mp
-    collectives (tp.mlp_block_apply(..., tp_axis='mp')).
+    collectives (mlp_block_apply(..., tp_axis='mp')).
 
     Differentiable end to end; jit-compatible (call under the mesh).
     """

@@ -34,6 +34,49 @@ owner param (exact `program._accumulator_owner` map first, longest-name
 pattern fallback for metadata-less deserialized programs). Per-var
 overrides — explicit `param_shardings` or `ParamAttr(mesh_axes=...)`
 annotations — always win over the automatic assignment.
+
+Tensor parallelism (ARCHITECTURE.md §23) is the same plan generalized
+from dim-0 weight-update sharding to intra-layer PartitionSpecs over a
+2D tp×dp mesh: `build(..., tp_axis="tp")` arms a per-family auto rule
+driven by each param's CONSUMER ops (the known op set):
+
+  matmul family (mul/matmul "Y", the fc weight):
+      [in, out] — column-parallel P(None, tp) when tp divides `out`,
+      else row-parallel P(tp, None) when tp divides `in`
+  embedding (lookup_table "W"):
+      [vocab, emb] — vocab-parallel P(tp, None), else P(None, tp)
+  conv family (conv2d / depthwise / transpose "Filter"):
+      [out_c, in_c, kh, kw] — output-channel-parallel P(tp, None, ...)
+
+Anything the rule can't place (biases, norms, non-dividing dims, ops
+outside the set) replicates over tp with the reason logged;
+`ParamAttr(mesh_axes=)` annotations and explicit overrides still win.
+Gradients mirror their param's spec and optimizer accumulators follow
+their owner, exactly as in the ZeRO case, so the SAME
+`grad_constraints()` seam pins the backward's collectives and GSPMD
+places the all-gather/reduce-scatter where the spec demands
+(arXiv:2004.13336's gather/scatter placement, generalized). The auto
+TP rule composes with `shard_update=True`: a param the TP rule placed
+keeps its intra-layer spec; the ZeRO dim-0 rule picks up the rest.
+
+Two placements per TP param, selected by `tp_placement`:
+
+  "gather" (default) — params AND their accumulators live SHARDED at
+      rest (1/tp of each per chip: the bigger-than-one-chip memory
+      claim) and `param_gather_constraints()` pins their traced values
+      replicated at the moment they enter the step, so GSPMD
+      materializes explicit all-gathers on use, every contraction AND
+      the optimizer update run on full arrays, and the math is
+      BIT-IDENTICAL to the replicated baseline; grads and updated
+      state land back on the shards at the executor's out_shardings
+      boundary (reduce-scatter). The at-REST footprint is 1/tp; the
+      in-STEP peak is shards + the gathered arrays XLA keeps live,
+      the classic weight-gather tradeoff of arXiv:2004.13336.
+  "compute" — no gather constraint: GSPMD partitions the contractions
+      themselves (Megatron-style partial products + all-reduce).
+      Cheaper activation traffic on wide layers, but the split
+      reduction tree rounds differently at the ulp level — a perf
+      mode for hardware sweeps, not a bit-exactness mode.
 """
 import hashlib
 import json
@@ -47,7 +90,12 @@ __all__ = ["ShardingPlan", "VarPlan", "PLAN_FORMAT_VERSION"]
 
 log = logging.getLogger("paddle_tpu.parallel.plan")
 
-PLAN_FORMAT_VERSION = 1
+# v2: intra-layer tensor-parallel specs (tp_axis in the JSON form, 2D
+# specs from the per-family auto rule) — a changed format version is a
+# changed digest, so v1-keyed AOT artifacts are not served to v2 plans
+PLAN_FORMAT_VERSION = 2
+
+TP_PLACEMENTS = ("gather", "compute")
 
 # entry kinds
 PARAM = "param"
@@ -66,6 +114,86 @@ def _match_accumulator_param(vname, params_by_len_desc):
         (p for p in params_by_len_desc
          if re.search(r"(^|_)%s(_\d+)?$" % re.escape(p), vname)),
         None)
+
+
+# The known op set the auto tensor-parallel rule covers, in precedence
+# order (a param consumed by several families takes the first match):
+# (family, {(op_type, input slot), ...}) — the slot is where the WEIGHT
+# rides, so an activation feeding a matmul's "X" never matches.
+_TP_FAMILIES = (
+    ("matmul", frozenset({("mul", "Y"), ("matmul", "Y")})),
+    ("embedding", frozenset({("lookup_table", "W")})),
+    ("conv", frozenset({("conv2d", "Filter"),
+                        ("depthwise_conv2d", "Filter"),
+                        ("conv2d_transpose", "Filter")})),
+)
+
+
+def _param_consumers(program):
+    """{var name: set of (op_type, input_slot)} over every forward op of
+    every block. grad_of ops are skipped: they replay the forward's
+    inputs, and double-counting them could not change a family match."""
+    cons = {}
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type == "grad_of":
+                continue
+            for slot, names in op.inputs.items():
+                for n in names:
+                    if n:
+                        cons.setdefault(n, set()).add((op.type, slot))
+    return cons
+
+
+def _auto_tp_spec(name, shape, consumers, tp_axis, n_tp):
+    """The per-family tensor-parallel assignment for one param, or
+    (None, reason) when no family rule places it (caller falls through
+    to the ZeRO rule / replicated). Pure function of
+    (name, shape, consumer set, axis, size) — deterministic, so the
+    plan digest is restart-stable like the rest of the partitioner."""
+    shape = tuple(shape or ())
+    uses = consumers.get(name, ())
+    family = next((fam for fam, sigs in _TP_FAMILIES
+                   if any(u in sigs for u in uses)), None)
+    if family is None:
+        return None, "no tensor-parallel family consumes it"
+    if any(d is None or d < 0 for d in shape):
+        return None, "%s family but no concrete shape" % family
+
+    def divides(d):
+        return d % n_tp == 0
+
+    if family == "matmul" and len(shape) == 2:
+        if divides(shape[1]):
+            return P(None, tp_axis), ("column-parallel: matmul out dim "
+                                      "%d / %d over %r"
+                                      % (shape[1], n_tp, tp_axis))
+        if divides(shape[0]):
+            return P(tp_axis, None), ("row-parallel: matmul in dim "
+                                      "%d / %d over %r"
+                                      % (shape[0], n_tp, tp_axis))
+        return None, ("matmul dims %r: %d divides neither -> replicated"
+                      % (shape, n_tp))
+    if family == "embedding" and len(shape) == 2:
+        if divides(shape[0]):
+            return P(tp_axis, None), ("vocab-parallel: embedding dim0 "
+                                      "%d / %d over %r"
+                                      % (shape[0], n_tp, tp_axis))
+        if divides(shape[1]):
+            return P(None, tp_axis), ("embedding-dim-parallel: dim1 "
+                                      "%d / %d over %r"
+                                      % (shape[1], n_tp, tp_axis))
+        return None, ("embedding dims %r: %d divides neither -> "
+                      "replicated" % (shape, n_tp))
+    if family == "conv" and len(shape) == 4:
+        if divides(shape[0]):
+            return P(tp_axis, None, None, None), (
+                "output-channel-parallel: conv out_c %d / %d over %r"
+                % (shape[0], n_tp, tp_axis))
+        return None, ("conv out_c %d %% %d != 0 -> replicated"
+                      % (shape[0], n_tp))
+    return None, ("%s family but unexpected rank %d -> replicated"
+                  % (family, len(shape)))
 
 
 def _spec_to_json(spec):
@@ -151,11 +279,12 @@ class ShardingPlan(object):
     same mesh axis ('dp'): reduce-scatter lands each gradient shard on
     the replica that owns the matching param shard."""
 
-    def __init__(self, mesh, entries=(), batch_axis="dp", shard_axis=None):
+    def __init__(self, mesh, entries=(), batch_axis="dp", shard_axis=None,
+                 tp_axis=None, tp_placement="gather"):
         self.mesh = mesh
         self.batch_axis = batch_axis
-        # an EXPLICIT shard_axis must name a real mesh axis — a typo
-        # would silently partition nothing (size-1 default) and the
+        # an EXPLICIT shard_axis/tp_axis must name a real mesh axis — a
+        # typo would silently partition nothing (size-1 default) and the
         # user would discover the full replicated footprint at OOM. The
         # batch-axis fallback stays lenient: a mesh without the batch
         # axis legitimately means "no update sharding here" (size 1).
@@ -163,8 +292,17 @@ class ShardingPlan(object):
             raise ValueError(
                 "shard_axis %r is not an axis of mesh %r"
                 % (shard_axis, dict(mesh.shape)))
+        if tp_axis is not None and tp_axis not in mesh.axis_names:
+            raise ValueError(
+                "tp_axis %r is not an axis of mesh %r"
+                % (tp_axis, dict(mesh.shape)))
+        if tp_placement not in TP_PLACEMENTS:
+            raise ValueError("tp_placement must be one of %r, got %r"
+                             % (TP_PLACEMENTS, tp_placement))
         self.shard_axis = shard_axis if shard_axis is not None \
             else batch_axis
+        self.tp_axis = tp_axis
+        self.tp_placement = tp_placement
         self.entries = {}
         for e in entries:
             self.entries[e.name] = e
@@ -172,32 +310,41 @@ class ShardingPlan(object):
     # ------------------------------------------------------------ build --
     @classmethod
     def build(cls, program, mesh, batch_axis="dp", shard_axis=None,
-              shard_update=False, overrides=None):
+              shard_update=False, overrides=None, tp_axis=None,
+              tp_placement="gather"):
         """Deterministic partitioner over `program`'s persistable state.
 
         Precedence per var: explicit `overrides` (any var name ->
         PartitionSpec — the executor's `param_shardings` arg) >
         `ParamAttr(mesh_axes=...)` annotations (accumulators follow their
-        annotated owner) > the automatic ZeRO assignment (only with
-        `shard_update=True`) > replicated. Params are walked in
-        sorted-name order and every decision depends only on
-        (name, shape, mesh axes), so the plan — and with it the
-        compile-cache key — is identical across process restarts
-        (see the canonical-order contract in optimizer.py /
-        core/backward.py for why the program bytes are too).
+        annotated owner) > the automatic tensor-parallel per-family rule
+        (only with `tp_axis=` set — see _auto_tp_spec) > the automatic
+        ZeRO assignment (only with `shard_update=True`) > replicated.
+        Params are walked in sorted-name order and every decision
+        depends only on (name, shape, consumer ops, mesh axes), so the
+        plan — and with it the compile-cache key — is identical across
+        process restarts (see the canonical-order contract in
+        optimizer.py / core/backward.py for why the program bytes are
+        too).
 
-        A param whose dim 0 the shard axis does not divide evenly falls
-        back to replicated with a logged reason — never an error: the
-        plan must accept any program, partial sharding is still a win.
+        A param no rule can split evenly falls back to replicated with
+        a logged reason — never an error: the plan must accept any
+        program, partial sharding is still a win.
         """
         if shard_axis is not None and shard_axis not in mesh.axis_names:
             # same guard as __init__: an explicit axis must exist
             raise ValueError(
                 "shard_axis %r is not an axis of mesh %r"
                 % (shard_axis, dict(mesh.shape)))
+        if tp_axis is not None and tp_axis not in mesh.axis_names:
+            raise ValueError(
+                "tp_axis %r is not an axis of mesh %r"
+                % (tp_axis, dict(mesh.shape)))
         shard_axis = shard_axis if shard_axis is not None else batch_axis
         overrides = dict(overrides or {})
         n_shard = int(mesh.shape.get(shard_axis, 1))
+        n_tp = int(mesh.shape.get(tp_axis, 1)) if tp_axis else 1
+        consumers = _param_consumers(program) if tp_axis else {}
         entries = []
         taken = set()
 
@@ -217,8 +364,20 @@ class ShardingPlan(object):
             return P(*resolved)
 
         def _auto_spec(name, shape):
+            tp_reason = ""
+            if tp_axis is not None and n_tp > 1:
+                spec, tp_reason = _auto_tp_spec(name, shape, consumers,
+                                                tp_axis, n_tp)
+                if spec is not None:
+                    return spec, tp_reason
+                log.info("sharding plan: %s not tensor-parallel: %s",
+                         name, tp_reason)
+                # fall through: the ZeRO dim-0 rule (below) may still
+                # shard the update of a param the TP rule passed on
+            elif tp_axis is not None and not shard_update:
+                return P(), "mesh axis %r has size 1" % tp_axis
             if not shard_update:
-                return P(), ""
+                return P(), tp_reason
             if n_shard <= 1:
                 return P(), "mesh axis %r has size 1" % shard_axis
             shape = tuple(shape or ())
@@ -342,7 +501,8 @@ class ShardingPlan(object):
                 shape=e.shape, dtype=e.dtype))
 
         return cls(mesh, entries, batch_axis=batch_axis,
-                   shard_axis=shard_axis)
+                   shard_axis=shard_axis, tp_axis=tp_axis,
+                   tp_placement=tp_placement)
 
     # ----------------------------------------------------------- query --
     def spec_for(self, name):
@@ -371,9 +531,53 @@ class ShardingPlan(object):
         constrained to the owner's shard layout, so GSPMD lowers the
         cross-replica gradient sum as reduce-scatter (each replica
         receives only the 1/N slice its update needs) instead of a full
-        all-reduce followed by a slice."""
+        all-reduce followed by a slice.
+
+        Gather-placed tensor-parallel params are EXEMPT: their step
+        computes replicated end-to-end (that is the placement's
+        bit-exactness contract — an in-graph sharded grad re-tiles the
+        backward dots and drifts at the ulp level on some backends);
+        their grads land on the shard at the executor's sharded
+        out_shardings boundary instead, where GSPMD still lowers the
+        dp-sum + scatter as one reduce-scatter."""
+        skip = frozenset(self.param_gather_constraints())
         return {e.name: NamedSharding(self.mesh, e.spec)
-                for e in self.entries.values() if e.kind == GRADIENT}
+                for e in self.entries.values()
+                if e.kind == GRADIENT and e.owner not in skip}
+
+    def _spec_uses_tp(self, spec):
+        for ent in tuple(spec):
+            axes = ent if isinstance(ent, (list, tuple)) else (
+                () if ent is None else (ent,))
+            if self.tp_axis in axes:
+                return True
+        return False
+
+    def param_gather_constraints(self):
+        """{param name: replicated NamedSharding} for every
+        tensor-parallel param under `tp_placement="gather"` — the gather
+        half of the placement (arXiv:2004.13336): the executor pins each
+        such param's traced value replicated at the step's entry
+        (Env.write, the same seam grad_constraints rides), so the param
+        lives 1/tp-sharded AT REST in the scope/in_shardings but every
+        contraction consumes the full gathered weight. Compute is then
+        bit-identical to the replicated baseline; the gradient's
+        reduce-scatter constraint (above) and the sharded out_shardings
+        land the update back on the shard. Empty for
+        tp_placement="compute" (GSPMD partitions the contractions) and
+        for plans with no tp axis — the ZeRO dim-0 case keeps PR-9
+        behavior, where GSPMD already gathers on use by itself."""
+        if not self.tp_axis or self.tp_placement != "gather":
+            return {}
+        rep = NamedSharding(self.mesh, P())
+        # accumulators riding a TP owner gather too: a moment sharded
+        # at rest but updated replicated keeps the whole optimizer step
+        # on full arrays — a partitioned elementwise update vectorizes
+        # (FMA-fuses) differently on some backends, which is exactly
+        # the ulp drift the gather placement exists to exclude
+        return {e.name: rep for e in self.entries.values()
+                if e.kind in (PARAM, ACCUMULATOR)
+                and self._spec_uses_tp(e.spec)}
 
     def __len__(self):
         return len(self.entries)
@@ -392,6 +596,8 @@ class ShardingPlan(object):
             "mesh_axes": [[a, int(s)] for a, s in self.mesh.shape.items()],
             "batch_axis": self.batch_axis,
             "shard_axis": self.shard_axis,
+            "tp_axis": self.tp_axis,
+            "tp_placement": self.tp_placement,
             "vars": {n: self.entries[n].to_json()
                      for n in sorted(self.entries)},
         }
@@ -432,6 +638,9 @@ class ShardingPlan(object):
                 "shard_axis": self.shard_axis,
                 "shard_axis_size": int(self.mesh.shape.get(
                     self.shard_axis, 1)),
+                "tp_axis": self.tp_axis,
+                "tp_axis_size": int(self.mesh.shape.get(
+                    self.tp_axis, 1)) if self.tp_axis else 1,
                 "params": rep["params"],
                 "update_state": rep["update_state"],
                 "sharded_vars": sorted(sharded_vars),
@@ -440,9 +649,10 @@ class ShardingPlan(object):
     def describe(self):
         """Human-readable plan table (one line per var + the memory
         footer) — what `print(pexe.plan.describe())` shows."""
-        lines = ["ShardingPlan over %s (batch=%r, shard=%r)"
+        lines = ["ShardingPlan over %s (batch=%r, shard=%r%s)"
                  % (dict(self.mesh.shape), self.batch_axis,
-                    self.shard_axis)]
+                    self.shard_axis,
+                    ", tp=%r" % self.tp_axis if self.tp_axis else "")]
         for e in self:
             lines.append("  %-40s %-12s %-18s %s" % (
                 e.name, e.kind, str(tuple(e.spec)),

@@ -18,9 +18,13 @@ deadlines, bounded-queue backpressure, graceful drain),
 `metrics.ServingMetrics` (QPS/latency/occupancy, Prometheus + profiler
 integration), `pool.ReplicaPool` (N replicas behind one endpoint:
 health-gated least-loaded routing, circuit breakers, failover retry +
-tail hedging, adaptive admission, zero-downtime weight reload). CLI:
-`tools/ptpu_serve.py` (`--replicas N`, `--selfcheck --kill-replica`).
-Design notes: ARCHITECTURE.md §15 (engine/batcher), §20 (the pool).
+tail hedging, adaptive admission, zero-downtime weight reload). Both
+engine and pool serve models BIGGER than one chip: `tp=M` spans a
+replica over M devices with weights sharded 1/M at rest by the
+tensor-parallel ShardingPlan, bit-identical to a mesh-1 engine. CLI:
+`tools/ptpu_serve.py` (`--replicas N`, `--tp M`, `--selfcheck
+--kill-replica`). Design notes: ARCHITECTURE.md §15 (engine/batcher),
+§20 (the pool), §23 (tensor-parallel replicas).
 """
 from .batcher import (Batcher, DeadlineExceededError, QueueFullError,
                       RequestFuture, RequestTooLargeError, ServingClosedError,

@@ -145,7 +145,7 @@ class InferenceEngine(object):
                  max_queue_delay_ms=None, queue_capacity=256,
                  default_deadline_ms=None, validate=True, warmup=True,
                  latency_window=2048, apply_tuned=False,
-                 pipeline_depth=None):
+                 pipeline_depth=None, tp=None, mesh_devices=None):
         from ..places import CPUPlace
         self.name = name or (os.path.basename(os.path.normpath(model_dir))
                              if model_dir else "model")
@@ -154,6 +154,28 @@ class InferenceEngine(object):
         self._run_lock = threading.Lock()   # Executor cache isn't
         self.default_deadline_ms = default_deadline_ms  # thread-safe
         self.closed = False
+        # tensor-parallel engine (ARCHITECTURE.md §23): tp=M spans this
+        # replica over M devices — one mesh {'dp': 1, 'tp': M}, params
+        # sharded 1/M per chip at rest by the ShardingPlan's auto
+        # row/col rule (gather placement: bit-identical results to a
+        # mesh-1 engine on the same weights), dispatch through a
+        # ParallelExecutor bound to this engine's program + Scope. The
+        # loader Executor above stays: model files load host-side; the
+        # first TP dispatch device_puts the scope per the plan.
+        # mesh_devices pins the exact device span (the ReplicaPool's
+        # per-replica slicing); default = the first M visible devices.
+        if tp is not None and int(tp) < 1:
+            # validate BEFORE the falsy-None mapping: tp=0 (a
+            # miscomputed ndev//replicas) silently serving single-device
+            # replicas would be the worst kind of "sharded" deployment
+            raise ValueError("tp must be >= 1, got %r" % (tp,))
+        self.tp = int(tp) if tp is not None else None
+        self._mesh_devices = list(mesh_devices) if mesh_devices else None
+        if self._mesh_devices is not None and self.tp is None:
+            self.tp = len(self._mesh_devices)
+        self.mesh = None
+        self.plan = None
+        self._pexe = None
         # device-side row slicing only pays for itself when there is a
         # transfer to shrink; on the CPU backend it's a pure ~200us
         # dispatch tax per request (np.asarray is zero-copy there)
@@ -255,6 +277,34 @@ class InferenceEngine(object):
                 self._fetch_row_policy[n] = "rows"
             else:
                 self._fetch_row_policy[n] = "dynamic"
+
+        if self.tp is not None:
+            import jax
+            from ..parallel.mesh import make_mesh
+            from ..parallel.parallel_executor import ParallelExecutor
+            from ..parallel.plan import ShardingPlan
+            devices = self._mesh_devices
+            if devices is None:
+                avail = jax.devices()
+                if len(avail) < self.tp:
+                    raise ValueError(
+                        "tp=%d needs %d devices but only %d are visible"
+                        % (self.tp, self.tp, len(avail)))
+                devices = avail[:self.tp]
+            elif len(devices) != self.tp:
+                raise ValueError(
+                    "tp=%d but mesh_devices has %d devices"
+                    % (self.tp, len(devices)))
+            # dp stays in the mesh at size 1 so the ParallelExecutor's
+            # feed sharding path is untouched: request batches replicate
+            # over the tp axis (no divisibility constraint on buckets)
+            self.mesh = make_mesh({"dp": 1, "tp": self.tp}, devices)
+            self.plan = ShardingPlan.build(self.program, self.mesh,
+                                           tp_axis="tp")
+            self._pexe = ParallelExecutor(main_program=self.program,
+                                          plan=self.plan)
+            self._pexe._scope = self._scope
+            self._device_slice = devices[0].platform != "cpu"
 
         if batch_buckets:
             self.batch_buckets = sorted(set(int(b) for b in batch_buckets))
@@ -530,8 +580,18 @@ class InferenceEngine(object):
         """One executor dispatch under the run lock; returns lazy
         FetchHandles and whether this call compiled a new bucket.
         Compile detection compares the cache KEY SET, not its length —
-        at LRU capacity an insert+evict keeps the length constant."""
+        at LRU capacity an insert+evict keeps the length constant.
+        A tensor-parallel engine dispatches through its mesh-bound
+        ParallelExecutor instead (same Scope, same bucket lattice,
+        same FetchHandle surface — the batcher can't tell)."""
         with self._run_lock:
+            if self._pexe is not None:
+                before = set(self._pexe._cache)
+                handles = self._pexe.run(self.fetch_names, feed=feed,
+                                         return_numpy=False)
+                compiled = any(k not in before
+                               for k in self._pexe._cache)
+                return handles, compiled
             before = set(self._exe._cache)
             # validate=False: the engine already verified the program at
             # load; re-validating per (bucket) feed signature would walk
@@ -706,10 +766,21 @@ class InferenceEngine(object):
     def queue_depth(self):
         return self._batcher.queue_depth()
 
+    def device_span(self):
+        """The devices this engine's dispatches own: the mesh's devices
+        for a tensor-parallel engine (M entries), else the single place
+        device — what the pool's `pool_state()` and `/metrics` expose so
+        an operator can see which chips a replica holds."""
+        if self.mesh is not None:
+            return [str(d) for d in self.mesh.devices.flat]
+        return [str(self._exe.place.device())]
+
     def describe(self):
         """The /v1/models entry for this engine."""
         return {
             "name": self.name,
+            "tp": self.tp,
+            "devices": self.device_span(),
             "feeds": [
                 {"name": n,
                  "shape": list(self._feed_vars[n].shape or []),

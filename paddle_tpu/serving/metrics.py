@@ -222,13 +222,30 @@ def render_prometheus_all(named_metrics, pools=None):
         lines.append("# HELP ptpu_serving_replica_state replica health "
                      "(0=healthy, 1=degraded, 2=ejected; +4 when dead)")
         lines.append("# TYPE ptpu_serving_replica_state gauge")
-        for name, pool in sorted(pools.items()):
+        pool_replica_states = {name: pool.pool_state()["replicas"]
+                               for name, pool in sorted(pools.items())}
+        for name, reps in pool_replica_states.items():
             model = _escape_label(name)
-            for r in pool.pool_state()["replicas"]:
+            for r in reps:
                 val = _STATE_GAUGE[r["state"]] + (4 if r["dead"] else 0)
                 lines.append('ptpu_serving_replica_state{model="%s",'
                              'replica="%s"} %d' % (model, r["replica"],
                                                    val))
+        # device ownership: one sample per (replica, device) — a
+        # tensor-parallel replica spans M devices, so operators can see
+        # exactly which chips each replica holds (ARCHITECTURE.md §23)
+        lines.append("# HELP ptpu_serving_replica_device 1 for each "
+                     "device in a replica's span (tensor-parallel "
+                     "replicas span tp devices)")
+        lines.append("# TYPE ptpu_serving_replica_device gauge")
+        for name, reps in pool_replica_states.items():
+            model = _escape_label(name)
+            for r in reps:
+                for dev in r.get("devices", ()):
+                    lines.append(
+                        'ptpu_serving_replica_device{model="%s",'
+                        'replica="%s",device="%s"} 1'
+                        % (model, r["replica"], _escape_label(dev)))
         psnaps = {name: pool.metrics.snapshot()
                   for name, pool in sorted(pools.items())}
         for family, mtype, help_text, key in _POOL_FAMILIES:

@@ -426,7 +426,7 @@ class ReplicaPool(object):
 
     def __init__(self, model_dir=None, replicas=2, place=None, name=None,
                  checkpoint_dir=None, fetch_list=None, feed_names=None,
-                 step=None, engine_factory=None,
+                 step=None, engine_factory=None, tp=None,
                  # failover / hedging
                  retries=2, retry_backoff_ms=5.0, attempt_timeout_s=30.0,
                  hedge_delay_ms=None, check_finite=True,
@@ -475,6 +475,17 @@ class ReplicaPool(object):
                         "feed_names": feed_names, "step": step}
         self._factory = engine_factory
         self._place = place
+        # tensor-parallel replicas (ARCHITECTURE.md §23): tp=M makes
+        # every replica an M-device engine — replica i owns the
+        # contiguous device span [i*M, (i+1)*M) (modulo the visible
+        # count: more replica-devices than chips share spans, same as
+        # the 1-device round-robin). Health/failover/reload all stay
+        # replica-granular: a replica IS its M-device engine.
+        if tp is not None and int(tp) < 1:
+            # before the falsy mapping: tp=0 must raise, not silently
+            # run single-device replicas (see InferenceEngine)
+            raise ValueError("tp must be >= 1, got %r" % (tp,))
+        self.tp = int(tp) if tp is not None else None
         self._engine_kw = dict(engine_kw)
 
         self._replicas = []
@@ -504,14 +515,40 @@ class ReplicaPool(object):
         """Round-robin placement over the visible devices. An explicit
         place (or list of places) wins; default is TPUPlace(idx), whose
         device() already wraps modulo the accelerator count and falls
-        back to CPU when none exist."""
-        from ..places import TPUPlace
+        back to CPU when none exist. Tensor-parallel replicas default
+        to CPUPlace instead: the place is only the LOADER's device (the
+        mesh owns dispatch), and materializing a bigger-than-one-chip
+        model's full weights on TPUPlace(idx) — a chip inside some
+        OTHER replica's span — would OOM exactly the models tp exists
+        for; loading host-side lets the first dispatch commit straight
+        to the sharded layout."""
+        from ..places import CPUPlace, TPUPlace
         place = self._place
         if isinstance(place, (list, tuple)):
             return place[idx % len(place)]
         if place is not None:
             return place
+        if self.tp is not None:
+            return CPUPlace()
         return TPUPlace(idx)
+
+    def _tp_span(self, idx):
+        """Replica idx's contiguous tp-device span. The span START wraps
+        modulo the visible device count (an over-subscribed pool shares
+        chips ACROSS replicas the way 1-device replicas already do
+        under round-robin), but one span can never exceed the visible
+        devices: a mesh with the same chip twice is not a bigger mesh,
+        and jax rejects it with an unhelpful construction error deep in
+        engine init — raise the same readable ValueError the bare
+        InferenceEngine gives for too-few devices."""
+        import jax
+        devs = jax.devices()
+        if self.tp > len(devs):
+            raise ValueError(
+                "tp=%d needs %d devices per replica but only %d are "
+                "visible" % (self.tp, self.tp, len(devs)))
+        return [devs[(idx * self.tp + k) % len(devs)]
+                for k in range(self.tp)]
 
     def _build_engine(self, idx):
         """One warmed replica engine off the current source. With the
@@ -521,6 +558,10 @@ class ReplicaPool(object):
         ename = "%s@%d" % (self.name, idx)
         if self._factory is not None:
             return self._factory(idx, place)
+        kw = dict(self._engine_kw)
+        if self.tp is not None:
+            kw["tp"] = self.tp
+            kw["mesh_devices"] = self._tp_span(idx)
         src = self._source
         if src["checkpoint_dir"] is not None:
             if src["fetch_list"] is None:
@@ -528,9 +569,9 @@ class ReplicaPool(object):
             return InferenceEngine.from_checkpoint(
                 src["checkpoint_dir"], src["fetch_list"],
                 feed_names=src["feed_names"], step=src["step"],
-                place=place, name=ename, **self._engine_kw)
+                place=place, name=ename, **kw)
         return InferenceEngine(src["model_dir"], place=place, name=ename,
-                               **self._engine_kw)
+                               **kw)
 
     def _attach_tap(self, rep, engine=None):
         # capture the engine the tap is ATTACHED to, never resolve
@@ -858,7 +899,13 @@ class ReplicaPool(object):
                 entry = {"replica": rep.idx, "state": st,
                          "dead": rep.dead, "inflight": rep.inflight,
                          "dispatches": rep.dispatches,
-                         "generation": rep.generation}
+                         "generation": rep.generation,
+                         # the device span this replica's engine owns —
+                         # M entries for a tensor-parallel replica, so
+                         # an operator can map replicas to chips
+                         "tp": getattr(rep.engine, "tp", None),
+                         "devices": rep.engine.device_span()
+                         if hasattr(rep.engine, "device_span") else []}
                 # continuous-batching window (ARCHITECTURE.md §22):
                 # per-replica device in-flight/idle accounting — the
                 # operator's view of whether this replica's device is

@@ -274,9 +274,17 @@ def test_bench_resil_smoke():
     the numerical guards (per-grad all-finite checks fused into the
     backward + one lax.cond gating the state updates) must cost < 10%
     on the smoke model in BOTH modes — otherwise "always-on guards" is
-    a lie and nobody ships them. The box is a single shared core, so
-    one noise-retry is allowed before the gate fails (the bench itself
-    already takes min-of-repeats)."""
+    a lie and nobody ships them.
+
+    Determinism under tier-1 run concurrency (this gate used to flake
+    when other collected tests' subprocesses timeshared the box —
+    PR 9/10 verification notes): (a) the bench itself now times the
+    four legs in INTERLEAVED rounds with a per-leg min, so a
+    contention burst slows every leg of its round together instead of
+    inflating exactly one leg's block; (b) five rounds instead of
+    three; (c) up to three attempts here, gating on the BEST attempt —
+    the claim under test is "the guards CAN run under 10%", and a
+    box-load counterexample is not a counterexample to that."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env.update({
@@ -284,12 +292,14 @@ def test_bench_resil_smoke():
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
         "BENCH_RESIL": "1",
         "BENCH_STEPS": "48", "BENCH_WARMUP": "2",
+        "BENCH_RESIL_REPEATS": "5",
         # lax.scan lowering for the K=8 leg (same reasoning as
         # test_bench_multistep_smoke: the CPU-default unroll compiles
         # K copies and belongs in a perf sweep, not CI)
         "FLAGS_multistep_unroll": "0",
     })
-    for attempt in (0, 1):
+    best = None
+    for attempt in range(3):
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
             env=env, capture_output=True, text=True, timeout=900)
@@ -303,11 +313,55 @@ def test_bench_resil_smoke():
                   "multistep_steps_per_sec",
                   "multistep_guarded_steps_per_sec"):
             assert rec[k] > 0
-        if max(rec["overhead_pct_plain"],
-               rec["overhead_pct_multistep"]) < 10.0:
+        worst = max(rec["overhead_pct_plain"],
+                    rec["overhead_pct_multistep"])
+        if best is None or worst < max(best["overhead_pct_plain"],
+                                       best["overhead_pct_multistep"]):
+            best = rec
+        if worst < 10.0:
             break
-    assert rec["overhead_pct_plain"] < 10.0, rec
-    assert rec["overhead_pct_multistep"] < 10.0, rec
+    assert best["overhead_pct_plain"] < 10.0, best
+    assert best["overhead_pct_multistep"] < 10.0, best
+
+
+def test_bench_tp_smoke():
+    """The BENCH_TP leg: one subprocess run on an 8-virtual-device CPU
+    mesh training the same Adam MLP at mesh-1 and tp=2/tp=4 under the
+    plan's auto row/col tensor-parallel specs (gather placement). The
+    acceptance gates ride here: fetch divergence EXACTLY 0.0 (weights
+    shard at rest and all-gather on use, so TP is a memory layout
+    change, never a numerics change) and per-chip PARAM bytes at
+    ratio <= ~(1/tp + eps) of the mesh-1 leg (eps = the replicated
+    biases + the non-dividing final head) — the number behind the
+    "serve models bigger than one chip" claim."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_TP": "1",
+        "BENCH_STEPS": "8", "BENCH_WARMUP": "1",
+        "BENCH_TP_DIM": "64", "BENCH_BATCH": "32",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "tp_train_steps_per_sec"
+    assert rec["unit"] == "steps/sec"
+    assert rec["vs_baseline"] is None
+    assert rec["tp_placement"] == "gather"
+    legs = rec["legs"]
+    assert set(legs) == {"1", "2", "4"}
+    for n, leg in legs.items():
+        assert leg["steps_per_sec"] > 0, leg
+        assert leg["params_bytes_per_chip"] > 0
+    # THE gates: bit-exactness and the per-chip memory ratio
+    assert rec["fetch_divergence"] == 0.0, rec
+    for n in (2, 4):
+        assert legs[str(n)]["params_ratio"] <= 1.0 / n + 0.05, legs
+    assert np.isfinite(rec["final_loss"])
 
 
 def test_bench_sharded_smoke():

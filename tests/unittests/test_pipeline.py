@@ -113,7 +113,7 @@ def test_pipeline_rejects_bad_shapes():
 # --- dp x mp x pp: three parallelism axes in ONE schedule -------------------
 
 def _tp_params(n_stages, d, h):
-    from paddle_tpu.parallel import tp, stack_stage_params
+    from paddle_tpu.parallel import pipeline as tp, stack_stage_params
     return stack_stage_params(
         [tp.mlp_block_init(7 + s, d, h) for s in range(n_stages)])
 
@@ -123,7 +123,7 @@ def test_pipeline_with_megatron_tp_stages_matches_sequential():
     BOTH 'pp' (stage dim) and 'mp' (hidden dim, Megatron column/row
     split), batch over 'dp' — forward must equal the dense sequential
     stack (parallelism is a schedule, not an approximation)."""
-    from paddle_tpu.parallel import tp
+    from paddle_tpu.parallel import pipeline as tp
     rng = np.random.RandomState(2)
     mesh = make_mesh({"dp": 2, "mp": 2, "pp": 2})
     params = _tp_params(2, 16, 32)
@@ -142,7 +142,7 @@ def test_pipeline_with_megatron_tp_stages_matches_sequential():
 def test_pipeline_with_tp_grads_match_sequential():
     """Backward through the 3-axis schedule: grads wrt every stage's
     sharded weights must match the dense sequential reference."""
-    from paddle_tpu.parallel import tp
+    from paddle_tpu.parallel import pipeline as tp
     rng = np.random.RandomState(3)
     mesh = make_mesh({"dp": 2, "mp": 2, "pp": 2})
     params = _tp_params(2, 8, 16)

@@ -1,0 +1,442 @@
+"""Tensor parallelism as a Plan (parallel/plan.py tp_axis,
+ARCHITECTURE.md §23): intra-layer row/col PartitionSpecs on the SAME
+first-class ShardingPlan the executors, AOT cache, checkpoint reshard
+and serving pool already understand.
+
+The contracts under test:
+  * per-family auto-TP spec goldens (matmul col > row > replicated,
+    embedding vocab-first, conv out-channel) with reasons, and the
+    precedence ladder (overrides > ParamAttr mesh_axes > auto TP >
+    auto ZeRO);
+  * mesh-1 TP plan is BIT-exact vs the plain replicated Executor (SGD
+    and Adam+LR-decay, plain and steps=K, dropout in graph) — the
+    acceptance line;
+  * tp×dp on the 8-virtual-device CPU mesh trains with fetch AND state
+    divergence EXACTLY 0.0 vs the replicated plan on the same mesh
+    (gather placement: weights sharded at rest, all-gathered on use —
+    a memory layout change, never a numerics change);
+  * memory_report prices TP-sharded params per chip and gates the
+    "bigger than one chip" claim (replicated bytes exceed a budget the
+    TP plan fits under at ratio ≈ 1/tp);
+  * accumulators follow their TP owner; gather placement exempts TP
+    grads from in-graph constraints while compute placement keeps
+    them; digests are deterministic and placement-sensitive;
+  * a TP-sharded snapshot reshards tp×dp N→M (both axes changing)
+    bit-exact vs an independent resume — the elastic/reload leg;
+  * the surviving Megatron stage block (absorbed into
+    parallel/pipeline.py from the deleted parallel/tp.py): spec
+    goldens and mesh-1 (dense) degeneracy.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu.checkpoint import CheckpointManager
+from paddle_tpu.parallel import ShardingPlan
+from paddle_tpu.parallel.mesh import make_mesh, P
+
+EXE = fluid.Executor(fluid.CPUPlace())
+R = np.random.RandomState(4)
+DIM = 16
+XS = R.rand(16, DIM).astype("float32")
+YS = (XS.sum(1, keepdims=True) * 0.1).astype("float32")
+
+
+def _mesh(axes):
+    n = int(np.prod(list(axes.values())))
+    return make_mesh(axes, jax.devices()[:n])
+
+
+def _build(opt="sgd", seed=11, dim=DIM, width=16, dropout=False):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[dim], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=width, act="tanh")
+        if dropout:
+            h = fluid.layers.dropout(h, dropout_prob=0.2)
+        h = fluid.layers.fc(input=h, size=width, act="tanh")
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            x=fluid.layers.square_error_cost(input=p, label=y))
+        if opt == "sgd":
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        elif opt == "adam_decay":
+            lr = fluid.layers.exponential_decay(0.01, 2, 0.9)
+            fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+        else:
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _init_like(scope, init):
+    for n, v in init.items():
+        scope.set(n, v)
+    scope._rng_counter = 0
+
+
+# --------------------------------------------------------------------------
+# auto-TP spec goldens per layer family
+# --------------------------------------------------------------------------
+def test_auto_tp_spec_goldens_per_family():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[32, 8])   # vocab 32 % 4
+        img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                dtype="float32")
+        cv = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                                 act="relu")               # out_c 8 % 4
+        x = fluid.layers.data(name="x", shape=[12], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16)              # col: out 16
+        h = fluid.layers.fc(input=h, size=1)               # row: in 16
+        tiny = fluid.layers.fc(input=fluid.layers.fc(input=x, size=5),
+                               size=3)                     # 5x3: neither
+        loss = fluid.layers.mean(h) + fluid.layers.mean(emb) \
+            + fluid.layers.mean(cv) + fluid.layers.mean(tiny)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    plan = ShardingPlan.build(main, _mesh({"dp": 2, "tp": 4}),
+                              tp_axis="tp")
+    spec = {e.name: e for e in plan if e.kind == "param"}
+    assert tuple(spec["embedding_0.w_0"].spec) == ("tp", None)
+    assert "vocab-parallel" in spec["embedding_0.w_0"].reason
+    assert tuple(spec["conv2d_0.w_0"].spec) == ("tp", None, None, None)
+    assert "output-channel-parallel" in spec["conv2d_0.w_0"].reason
+    assert tuple(spec["fc_0.w_0"].spec) == (None, "tp")
+    assert "column-parallel" in spec["fc_0.w_0"].reason
+    assert tuple(spec["fc_1.w_0"].spec) == ("tp", None)
+    assert "row-parallel" in spec["fc_1.w_0"].reason
+    # 5x3 divides by neither: replicated, with the family reason logged
+    assert tuple(spec["fc_3.w_0"].spec) == ()
+    assert not spec["fc_3.w_0"].sharded
+    # biases are outside every family: replicated
+    assert not spec["fc_0.w_1"].sharded
+    # and the tp axis is serialized (format v2)
+    j = plan.to_json()
+    assert j["tp_axis"] == "tp" and j["tp_placement"] == "gather"
+    assert j["version"] >= 2
+
+
+def test_tp_precedence_annotation_and_override_win():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(
+            input=x, size=8,
+            param_attr=fluid.ParamAttr(name="ann.w",
+                                       mesh_axes=("tp", None)))
+        h = fluid.layers.fc(input=h, size=8,
+                            param_attr=fluid.ParamAttr(name="auto.w"))
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    mesh = _mesh({"dp": 2, "tp": 4})
+    plan = ShardingPlan.build(main, mesh, tp_axis="tp")
+    # annotation wins over the (column-parallel) auto rule
+    assert tuple(plan.spec_for("ann.w")) == ("tp", None)
+    assert plan.entries["ann.w"].reason == "ParamAttr mesh_axes"
+    assert tuple(plan.spec_for("auto.w")) == (None, "tp")
+    # explicit override wins over both
+    plan2 = ShardingPlan.build(main, mesh, tp_axis="tp",
+                               overrides={"ann.w": P()})
+    assert plan2.spec_for("ann.w") == P()
+    assert plan2.entries["ann.w"].override
+    assert plan2.digest() != plan.digest()
+    # a typo'd explicit tp axis raises instead of silently replicating
+    with pytest.raises(ValueError, match="tp_axis"):
+        ShardingPlan.build(main, _mesh({"dp": 2}), tp_axis="tp")
+
+
+def test_tp_accumulators_follow_and_constraint_split():
+    """Accumulators mirror their TP owner's spec; gather placement
+    moves TP grads OUT of the in-graph constraint set (the step
+    computes replicated; the scatter lands at out_shardings) while
+    compute placement keeps the reduce-scatter constraint."""
+    main, _, _ = _build("adam")
+    mesh = _mesh({"dp": 2, "tp": 4})
+    from paddle_tpu.core.framework import GRAD_SUFFIX
+    gather = ShardingPlan.build(main, mesh, tp_axis="tp")
+    tp_params = [e.name for e in gather
+                 if e.kind == "param" and e.sharded]
+    assert tp_params
+    for e in gather:
+        if e.kind == "accumulator" and e.owner in tp_params:
+            assert e.spec == gather.spec_for(e.owner), e
+    # gather: every TP param (and its accumulators) pinned replicated
+    # at entry; none of their grads constrained in-graph
+    pinned = gather.param_gather_constraints()
+    for nm in tp_params:
+        assert nm in pinned and pinned[nm].spec == P()
+    assert not any(g[:-len(GRAD_SUFFIX)] in tp_params
+                   for g in gather.grad_constraints())
+    # compute: no gather pins, grads constrained to the shard layout
+    compute = ShardingPlan.build(main, mesh, tp_axis="tp",
+                                 tp_placement="compute")
+    assert compute.param_gather_constraints() == {}
+    assert set(g[:-len(GRAD_SUFFIX)]
+               for g in compute.grad_constraints()) >= set(tp_params)
+    # placement is digest-relevant (it changes the lowered step)
+    assert gather.digest() != compute.digest()
+    # determinism: identical rebuilds agree
+    assert ShardingPlan.build(main, mesh, tp_axis="tp").digest() \
+        == gather.digest()
+
+
+# --------------------------------------------------------------------------
+# mesh-1 bit-exactness (acceptance) + tp×dp divergence 0.0
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("opt", ["sgd", "adam_decay"])
+def test_mesh1_tp_plan_bit_exact_vs_replicated(opt, monkeypatch):
+    monkeypatch.setenv("FLAGS_multistep_unroll", "0")
+    steps_k = 3
+    main, startup, loss = _build(opt, dropout=True)
+
+    s1 = fluid.Scope()
+    with fluid.scope_guard(s1):
+        EXE.run(startup)
+        init = {n: np.array(s1.get(n), copy=True) for n in s1.names()}
+        s1._rng_counter = 0
+        ref = [np.asarray(EXE.run(main, feed={"x": XS, "y": YS},
+                                  fetch_list=[loss])[0]).copy()
+               for _ in range(3 + steps_k)]
+        ref_state = {n: np.asarray(s1.get(n)).copy() for n in s1.names()}
+
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        EXE.run(startup)
+        _init_like(s2, init)
+        pexe = fluid.ParallelExecutor(main_program=main,
+                                      loss_name=loss.name,
+                                      mesh=_mesh({"dp": 1, "tp": 1}),
+                                      tp_axis="tp")
+        assert pexe.plan.tp_axis == "tp"
+        # size-1 tp axis: every spec degenerates to replicated
+        assert not any(e.sharded for e in pexe.plan)
+        got = [np.asarray(pexe.run([loss.name],
+                                   feed={"x": XS, "y": YS})[0]).copy()
+               for _ in range(3)]
+        stacked = pexe.run([loss.name], feed={"x": XS, "y": YS},
+                           steps=steps_k, fetch_reduce="stack")[0]
+        got += [np.asarray(stacked)[i].copy() for i in range(steps_k)]
+        got_state = {n: np.asarray(s2.get(n)).copy() for n in s2.names()}
+
+    for i, (a, b) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(a, b, err_msg="step %d" % i)
+    assert set(ref_state) == set(got_state)
+    for n in ref_state:
+        np.testing.assert_array_equal(ref_state[n], got_state[n],
+                                      err_msg=n)
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adam_decay"])
+def test_tp_dp_training_divergence_zero(opt, monkeypatch):
+    """dp=2 × tp=4 over the 8 virtual devices, dropout in graph, plain
+    and steps=K: the TP plan's losses AND final state are bit-equal to
+    the replicated plan on the SAME mesh — gather placement makes
+    intra-layer sharding invisible in the numerics."""
+    monkeypatch.setenv("FLAGS_multistep_unroll", "0")
+    steps_k = 3
+    main, startup, loss = _build(opt, dropout=True)
+    mesh = _mesh({"dp": 2, "tp": 4})
+    outs, states = {}, {}
+    init = None
+    for tag, kw in (("repl", {}), ("tp", {"tp_axis": "tp"})):
+        s = fluid.Scope()
+        with fluid.scope_guard(s):
+            EXE.run(startup)
+            if init is None:
+                init = {n: np.array(s.get(n), copy=True)
+                        for n in s.names()}
+            _init_like(s, init)
+            pexe = fluid.ParallelExecutor(main_program=main,
+                                          loss_name=loss.name,
+                                          mesh=mesh, **kw)
+            if tag == "tp":
+                assert any(e.sharded for e in pexe.plan
+                           if e.kind == "param")
+            outs[tag] = [np.asarray(pexe.run(
+                [loss.name], feed={"x": XS, "y": YS})[0]).copy()
+                for _ in range(3)]
+            stacked = pexe.run([loss.name], feed={"x": XS, "y": YS},
+                               steps=steps_k, fetch_reduce="stack")[0]
+            outs[tag] += [np.asarray(stacked)[i].copy()
+                          for i in range(steps_k)]
+            states[tag] = {n: np.asarray(s.get(n)).copy()
+                           for n in s.names()}
+    for i, (a, b) in enumerate(zip(outs["repl"], outs["tp"])):
+        np.testing.assert_array_equal(a, b, err_msg="step %d" % i)
+    for n in states["repl"]:
+        np.testing.assert_array_equal(states["repl"][n],
+                                      states["tp"][n], err_msg=n)
+
+
+def test_tp_composes_with_zero_update_sharding():
+    """tp_axis + shard_update on one 2D mesh: TP-family params keep
+    their intra-layer specs, the rest (biases with a dividing dim 0)
+    pick up the ZeRO dim-0 assignment over 'dp' — and training still
+    runs finite."""
+    main, startup, loss = _build("adam", width=16)
+    mesh = _mesh({"dp": 2, "tp": 4})
+    plan = ShardingPlan.build(main, mesh, tp_axis="tp",
+                              shard_update=True)
+    by = {e.name: e for e in plan if e.kind == "param"}
+    assert tuple(by["fc_0.w_0"].spec) == (None, "tp")   # TP won
+    assert tuple(by["fc_0.w_1"].spec) == ("dp",)        # ZeRO picked up
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        EXE.run(startup)
+        pexe = fluid.ParallelExecutor(main_program=main,
+                                      loss_name=loss.name, plan=plan)
+        v, = pexe.run([loss.name], feed={"x": XS, "y": YS})
+        assert np.isfinite(np.asarray(v)).all()
+
+
+# --------------------------------------------------------------------------
+# memory accounting: the "bigger than one chip" gate
+# --------------------------------------------------------------------------
+def test_tp_memory_report_gates_bigger_than_one_chip():
+    """A model whose replicated per-chip param bytes EXCEED a per-device
+    budget fits under the tp=4 plan: per-chip bytes <= budget, at ratio
+    ≈ 1/tp (eps = replicated biases + the non-dividing head)."""
+    main, _, _ = _build("adam", dim=64, width=256)
+    tp = 4
+    repl = ShardingPlan.build(main, _mesh({"dp": 2, "tp": tp}))
+    plan = ShardingPlan.build(main, _mesh({"dp": 2, "tp": tp}),
+                              tp_axis="tp")
+    m_repl = repl.memory_report()
+    m_tp = plan.memory_report()
+    replicated_bytes = m_repl["params"]["per_chip_bytes"]
+    assert replicated_bytes == m_repl["params"][
+        "replicated_per_chip_bytes"]
+    # the per-device budget the replicated model does NOT fit
+    budget = replicated_bytes // 2
+    assert replicated_bytes > budget
+    assert m_tp["params"]["per_chip_bytes"] <= budget
+    ratio = m_tp["params"]["per_chip_bytes"] / replicated_bytes
+    assert ratio <= 1.0 / tp + 0.05, ratio
+    assert m_tp["tp_axis"] == "tp" and m_tp["tp_axis_size"] == tp
+    # update state (moments follow their owners) shrinks the same way
+    upd_ratio = m_tp["update_state"]["per_chip_bytes"] / max(
+        m_tp["update_state"]["replicated_per_chip_bytes"], 1)
+    assert upd_ratio <= 1.0 / tp + 0.1, upd_ratio
+
+
+# --------------------------------------------------------------------------
+# snapshots: TP-sharded capture, reshard tp×dp N→M (both axes), resume
+# --------------------------------------------------------------------------
+def test_tp_snapshot_reshard_both_axes_bit_exact(tmp_path):
+    """Train under a dp=2×tp=2 TP plan, snapshot (the live 2D specs ride
+    the manifest), restore through a dp=1×tp=4 world's plan — BOTH axes
+    changed — and continue: two independent restore+continue runs are
+    bit-identical, state lands exactly in the new plan's layout, and a
+    spec-adapted DeviceLayout restore loads the same values."""
+    main, startup, loss = _build("adam", dropout=True, seed=21)
+    data = [R.rand(8, DIM).astype("f") for _ in range(8)]
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        EXE.run(startup)
+        pexe = fluid.ParallelExecutor(
+            main_program=main, loss_name=loss.name,
+            mesh=_mesh({"dp": 2, "tp": 2}), tp_axis="tp")
+        assert any(e.sharded for e in pexe.plan if e.kind == "param")
+        for i in range(3):
+            pexe.run([loss.name], feed={"x": data[i],
+                                        "y": data[i][:, :1]})
+        ck = str(tmp_path / "ck")
+        mgr = CheckpointManager(ck, async_save=False)
+        mgr.save(3, program=main, scope=scope)
+        mgr.close()
+
+    plan2 = ShardingPlan.build(main, _mesh({"dp": 1, "tp": 4}),
+                               tp_axis="tp")
+
+    def resume():
+        s = fluid.Scope()
+        with fluid.scope_guard(s):
+            EXE.run(startup)
+            mgr = CheckpointManager(ck, async_save=False)
+            assert mgr.restore(program=main, scope=s, step=3,
+                               layout=plan2) == 3
+            mgr.close()
+            for e in plan2:
+                if e.kind == "gradient":
+                    continue
+                v = s.get(e.name)
+                if v is None:
+                    continue
+                assert isinstance(v, jax.Array), e.name
+                assert v.sharding.spec == plan2.sharding_for(
+                    e.name).spec, e.name
+            pexe = fluid.ParallelExecutor(main_program=main,
+                                          loss_name=loss.name,
+                                          plan=plan2)
+            out = [np.asarray(pexe.run(
+                [loss.name], feed={"x": data[i],
+                                   "y": data[i][:, :1]})[0]).copy()
+                for i in range(3, 6)]
+            return out, {n: np.asarray(s.get(n)).copy()
+                         for n in s.names()}, s.seed_state()
+
+    la, sa, ca = resume()
+    lb, sb, cb = resume()
+    assert ca == cb
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(a, b)
+    for n in sa:
+        np.testing.assert_array_equal(sa[n], sb[n], err_msg=n)
+
+    # a plain (no-layout) restore and the plan-target restore carry the
+    # same VALUES at restore time — the 2D reshard is placement only
+    def restore_state(layout):
+        s = fluid.Scope()
+        with fluid.scope_guard(s):
+            EXE.run(startup)
+            mgr = CheckpointManager(ck, async_save=False)
+            mgr.restore(program=main, scope=s, step=3, layout=layout)
+            mgr.close()
+            return {n: np.asarray(s.get(n)).copy() for n in s.names()
+                    if s.get(n) is not None}
+
+    plain = restore_state(None)
+    planned = restore_state(plan2)
+    assert set(plain) == set(planned)
+    for n in plain:
+        np.testing.assert_array_equal(plain[n], planned[n], err_msg=n)
+
+
+# --------------------------------------------------------------------------
+# the surviving Megatron stage block (pipeline.py, ex-parallel/tp.py)
+# --------------------------------------------------------------------------
+def test_mlp_block_spec_goldens_and_mesh1_degeneracy():
+    from paddle_tpu.parallel import (mlp_block_apply, mlp_block_init,
+                                     mlp_block_specs)
+    # spec goldens: col-parallel w1/b1, row-parallel w2, replicated b2;
+    # pp composition stacks a leading stage dim
+    specs = mlp_block_specs(tp_axis="mp")
+    assert tuple(specs["w1"]) == (None, "mp")
+    assert tuple(specs["b1"]) == ("mp",)
+    assert tuple(specs["w2"]) == ("mp", None)
+    assert tuple(specs["b2"]) == (None,)
+    stacked = mlp_block_specs(tp_axis="mp", pp_axis="pp")
+    assert tuple(stacked["w1"]) == ("pp", None, "mp")
+    assert tuple(stacked["b2"]) == ("pp", None)
+    # mesh-1 degeneracy: the manual (shard_map, tp_axis) apply over a
+    # size-1 mp axis equals the dense reference bit-for-bit
+    import jax.numpy as jnp
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    params = mlp_block_init(0, 8, 16)
+    x = jnp.asarray(R.rand(4, 8).astype("f"))
+    dense = mlp_block_apply(params, x)
+    mesh1 = make_mesh({"mp": 1}, jax.devices()[:1])
+    manual = shard_map(
+        lambda p, xb: mlp_block_apply(p, xb, tp_axis="mp"),
+        mesh=mesh1, in_specs=(P(), P()), out_specs=P())(params, x)
+    np.testing.assert_array_equal(np.asarray(dense), np.asarray(manual))

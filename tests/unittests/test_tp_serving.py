@@ -1,0 +1,305 @@
+"""Tensor-parallel serving replicas (ARCHITECTURE.md §23): an
+InferenceEngine/ReplicaPool replica that spans M devices, weights
+sharded 1/M per chip at rest by the ShardingPlan's auto row/col rule.
+
+The load-bearing invariants:
+  * a TP replica answers BIT-IDENTICAL to a mesh-1 engine on the same
+    weights (gather placement — sharding is a memory layout, never a
+    numerics change), through the real batcher and through run_direct;
+  * pool semantics are unchanged at the replica granularity: a
+    hard-killed TP replica's traffic fails over with zero
+    client-visible errors, and zero-downtime reload() promotes a new
+    snapshot with the TP span intact;
+  * `from_checkpoint` serves a TP-sharded training snapshot through a
+    TP engine (the train→serve promotion path for models bigger than
+    one chip);
+  * operators can SEE the spans: describe()/pool_state() carry tp +
+    devices, /metrics emits one ptpu_serving_replica_device sample per
+    (replica, device).
+"""
+import os
+import threading
+
+import numpy as np
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import serving
+
+
+def _save_dense_model(tmp_path, seed=0, feat=6, hidden=16, classes=4):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        h = fluid.layers.fc(input=x, size=hidden, act="relu")
+        pred = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "dense_model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe, main)
+    return d
+
+
+def test_tp_engine_bit_identical_vs_mesh1(tmp_path):
+    d = _save_dense_model(tmp_path)
+    ref = serving.InferenceEngine(d, batch_buckets=[4],
+                                  max_queue_delay_ms=1)
+    tpe = serving.InferenceEngine(d, batch_buckets=[4],
+                                  max_queue_delay_ms=2, tp=4)
+    try:
+        assert tpe.tp == 4
+        assert len(tpe.device_span()) == 4
+        assert tpe.describe()["tp"] == 4
+        assert len(tpe.describe()["devices"]) == 4
+        # the plan actually sharded the weights (at rest: 1/tp per chip)
+        assert any(e.sharded for e in tpe.plan if e.kind == "param")
+        m = tpe.plan.memory_report()
+        assert m["params"]["per_chip_bytes"] < \
+            m["params"]["replicated_per_chip_bytes"]
+        rng = np.random.RandomState(3)
+        feeds = [{"x": rng.rand(int(rng.randint(1, 4)), 6).astype("f")}
+                 for _ in range(12)]
+        # coalesced path: concurrent submits through the real batcher
+        futures = [None] * len(feeds)
+
+        def fire(i):
+            futures[i] = tpe.submit(feeds[i])
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(len(feeds))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        k = ref.fetch_names[0]
+        for i, fut in enumerate(futures):
+            got = fut.result(60).numpy()
+            want, _ = ref.run_direct(feeds[i],
+                                     batch_bucket=fut.bucket[0],
+                                     seq_bucket=fut.bucket[1])
+            np.testing.assert_array_equal(got[k], want[k],
+                                          err_msg="request %d" % i)
+        # and the TP run_direct reference path agrees with itself
+        a, _ = tpe.run_direct(feeds[0], batch_bucket=4)
+        b, _ = ref.run_direct(feeds[0], batch_bucket=4)
+        np.testing.assert_array_equal(a[k], b[k])
+        # the at-REST claim: after dispatch, the engine scope's sharded
+        # params are COMMITTED to the plan's layout (1/tp per chip) —
+        # not a full loader-device copy re-transferred every request
+        for e in tpe.plan:
+            if e.kind == "param" and e.sharded:
+                v = tpe._scope.get(e.name)
+                assert isinstance(v, jax.Array), e.name
+                assert v.sharding == tpe.plan.sharding_for(e.name), \
+                    e.name
+    finally:
+        ref.close()
+        tpe.close()
+
+
+def test_tp_pool_spans_kill_failover_and_metrics(tmp_path):
+    """A 2-replica tp=2 pool: distinct contiguous device spans, kill one
+    replica under traffic -> zero client-visible errors, every response
+    bit-identical to a mesh-1 reference; /metrics exposes the spans."""
+    d = _save_dense_model(tmp_path)
+    pool = serving.ReplicaPool(d, replicas=2, tp=2, batch_buckets=[4],
+                               max_queue_delay_ms=2,
+                               retry_backoff_ms=1.0)
+    ref = serving.InferenceEngine(d, batch_buckets=[4],
+                                  max_queue_delay_ms=1)
+    try:
+        st = pool.pool_state()
+        spans = {r["replica"]: r["devices"] for r in st["replicas"]}
+        assert all(r["tp"] == 2 for r in st["replicas"])
+        assert len(spans[0]) == 2 and len(spans[1]) == 2
+        assert set(spans[0]).isdisjoint(spans[1])
+
+        from paddle_tpu.serving.metrics import render_prometheus_all
+        text = render_prometheus_all({}, pools={"m": pool})
+        dev_lines = [l for l in text.splitlines()
+                     if l.startswith("ptpu_serving_replica_device{")]
+        assert len(dev_lines) == 4  # 2 replicas x 2 devices
+
+        rng = np.random.RandomState(7)
+        feeds = [{"x": rng.rand(int(rng.randint(1, 4)), 6).astype("f")}
+                 for _ in range(16)]
+        futures = [None] * len(feeds)
+
+        def fire(i):
+            try:
+                futures[i] = pool.submit(feeds[i])
+            except Exception as e:  # noqa: BLE001 — judged below
+                futures[i] = e
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(len(feeds))]
+        for t in threads[:8]:
+            t.start()
+        pool.kill_replica(0)
+        for t in threads[8:]:
+            t.start()
+        for t in threads:
+            t.join()
+        k = ref.fetch_names[0]
+        errors = []
+        for i, fut in enumerate(futures):
+            if not hasattr(fut, "result"):
+                errors.append((i, fut))
+                continue
+            try:
+                got = fut.result(60).numpy()
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+                continue
+            want, _ = ref.run_direct(feeds[i],
+                                     batch_bucket=fut.bucket[0],
+                                     seq_bucket=fut.bucket[1])
+            np.testing.assert_array_equal(got[k], want[k])
+        assert errors == []  # the acceptance leg: kill is invisible
+        assert pool.pool_state()["replicas"][0]["dead"]
+    finally:
+        ref.close()
+        pool.close()
+
+
+def _trainer(tmp_path, steps, ckdir):
+    """Train the dense model `steps` steps and snapshot each step."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=4, act="softmax")
+        loss = fluid.layers.mean(
+            x=fluid.layers.cross_entropy(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    scope = fluid.Scope()
+    mgr = CheckpointManager(ckdir, async_save=False)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in range(1, steps + 1):
+            exe.run(main, feed={
+                "x": rng.rand(8, 6).astype("f"),
+                "y": rng.randint(0, 4, (8, 1)).astype("int64")},
+                fetch_list=[loss])
+            mgr.save(step, program=main, scope=scope)
+    mgr.close()
+    return pred.name
+
+
+def test_tp_pool_from_checkpoint_and_zero_downtime_reload(tmp_path):
+    """The train→serve promotion path at tp=2: a checkpoint pool serves
+    the newest TP-sharded snapshot bit-identical to a mesh-1 engine on
+    the same snapshot; after more training, reload() promotes the new
+    step with the TP span intact and answers switch to the new
+    weights."""
+    ck = str(tmp_path / "ck")
+    fetch = _trainer(tmp_path, 1, ck)
+    pool = serving.ReplicaPool(checkpoint_dir=ck, fetch_list=[fetch],
+                               replicas=2, tp=2, batch_buckets=[4],
+                               max_queue_delay_ms=2)
+    try:
+        ref1 = serving.InferenceEngine.from_checkpoint(
+            ck, [fetch], step=1, batch_buckets=[4],
+            max_queue_delay_ms=1)
+        rng = np.random.RandomState(9)
+        feed = {"x": rng.rand(3, 6).astype("f")}
+        a = pool.infer(feed)
+        b = ref1.infer(feed)
+        np.testing.assert_array_equal(a[fetch], b[fetch])
+        ref1.close()
+
+        _trainer(tmp_path, 2, ck)       # steps 1..2 now on disk
+        served = pool.reload()
+        assert served == 2
+        st = pool.pool_state()
+        for r in st["replicas"]:
+            assert r["tp"] == 2 and len(r["devices"]) == 2
+        ref2 = serving.InferenceEngine.from_checkpoint(
+            ck, [fetch], step=2, batch_buckets=[4],
+            max_queue_delay_ms=1)
+        c = pool.infer(feed)
+        d = ref2.infer(feed)
+        np.testing.assert_array_equal(c[fetch], d[fetch])
+        # the weights really changed (training moved them)
+        assert not np.array_equal(a[fetch], c[fetch])
+        ref2.close()
+    finally:
+        pool.close()
+
+
+def test_tp_pool_distinct_spans_under_aot_cache(tmp_path, monkeypatch):
+    """Regression (found by the ptpu_serve --tp selfcheck drive, which
+    defaults the AOT cache on): two TP replicas of ONE model over
+    DIFFERENT device spans must not share a serialized executable — a
+    deserialized artifact is bound to the concrete devices it was
+    compiled for, and replica 1 loading replica 0's span-[0,1] artifact
+    used to fail its warmup with a call-time sharding mismatch. The
+    mesh device ids are in the AOT key now; both replicas must warm up
+    and answer bit-exact with the cache armed."""
+    monkeypatch.setenv("FLAGS_aot_cache_dir", str(tmp_path / "aot"))
+    d = _save_dense_model(tmp_path)
+    pool = serving.ReplicaPool(d, replicas=2, tp=2, batch_buckets=[4],
+                               max_queue_delay_ms=2)
+    ref = serving.InferenceEngine(d, batch_buckets=[4],
+                                  max_queue_delay_ms=1)
+    try:
+        spans = [r["devices"] for r in pool.pool_state()["replicas"]]
+        assert set(spans[0]).isdisjoint(spans[1])
+        rng = np.random.RandomState(2)
+        k = ref.fetch_names[0]
+        # route through BOTH replicas (least-loaded alternates under
+        # sequential submits; force it by pinning each engine directly)
+        for rep in pool._replicas:
+            feed = {"x": rng.rand(2, 6).astype("f")}
+            got = rep.engine.infer(feed)
+            want, _ = ref.run_direct(feed, batch_bucket=4)
+            np.testing.assert_array_equal(got[k], want[k],
+                                          err_msg="replica %d" % rep.idx)
+        # and the two spans really stored separate artifacts
+        aot_dir = str(tmp_path / "aot")
+        entries = [e for e in os.listdir(aot_dir)
+                   if e.startswith("aot_")]
+        assert len(entries) >= 2
+    finally:
+        ref.close()
+        pool.close()
+
+
+def test_tp_engine_oversubscription_and_validation(tmp_path):
+    d = _save_dense_model(tmp_path)
+    import pytest
+    with pytest.raises(ValueError, match="devices"):
+        serving.InferenceEngine(d, tp=len(jax.devices()) + 1)
+    # tp=0 raises loudly in both surfaces — a falsy tp silently serving
+    # single-device "sharded" replicas would be an operator trap
+    with pytest.raises(ValueError, match="tp must be"):
+        serving.InferenceEngine(d, tp=0)
+    with pytest.raises(ValueError, match="tp must be"):
+        serving.ReplicaPool(d, replicas=2, tp=0)
+    # one span can never exceed the visible devices (a mesh with the
+    # same chip twice is not a bigger mesh)
+    with pytest.raises(ValueError, match="devices"):
+        serving.ReplicaPool(d, replicas=1, tp=len(jax.devices()) + 1,
+                            batch_buckets=[4])
+    # a pool whose replica COUNT over-subscribes the chips wraps span
+    # STARTS across replicas (shared chips), same as 1-device
+    # round-robin placement
+    n = len(jax.devices())
+    pool = serving.ReplicaPool(d, replicas=2, tp=n, batch_buckets=[4],
+                               max_queue_delay_ms=2)
+    try:
+        spans = [r["devices"] for r in pool.pool_state()["replicas"]]
+        assert len(spans[0]) == n and len(spans[1]) == n
+        rng = np.random.RandomState(1)
+        out = pool.infer({"x": rng.rand(2, 6).astype("f")})
+        assert np.isfinite(list(out.values())[0]).all()
+    finally:
+        pool.close()

@@ -105,6 +105,15 @@ probe && run 1200 BENCH_SHARDED=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_SHARDED_DI
 probe && run 1200 BENCH_PIPELINE=1
 probe && run 1200 BENCH_PIPELINE=1 BENCH_PIPELINE_FEAT=8192 BENCH_PIPELINE_BATCH=64
 probe && run 1200 BENCH_PIPELINE=1 BENCH_PIPELINE_K=8 BENCH_PIPELINE_RECORDS=64
+# --- tier 2d: tensor-parallel plan (PR 11) — mesh-1 vs tp=2/4 on the real
+# chips: steps/s per leg + per-chip param bytes from the plan's memory
+# accounting + the fetch-divergence column (gather placement: must be 0.0).
+# CPU reference (8 virtual devices, dim-64 Adam MLP): divergence 0.0,
+# params ratio 0.26 at tp=4; steps/s CPU-parity (the gather win is memory,
+# the compute win needs real ICI).
+probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2
+probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_TP_DIM=1024
+probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_TP_DIM=1024 BENCH_TP_LEGS=1,2
 # --- tier 3: big compile LAST — one unrolled TPU line (K copies of the step)
 probe && run 2400 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_MULTISTEP=8 FLAGS_multistep_unroll=1
 bank

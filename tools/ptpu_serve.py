@@ -3,7 +3,7 @@
 
     tools/ptpu_serve.py <model-dir> [--port 8080] [--host 127.0.0.1]
         [--format auto|native|reference] [--params-filename NAME]
-        [--name NAME] [--place cpu|tpu] [--replicas N]
+        [--name NAME] [--place cpu|tpu] [--replicas N] [--tp M]
         [--warmup-buckets 1,4,8x32,8x64] [--max-batch 32]
         [--max-delay-ms 5] [--deadline-ms N] [--queue-capacity 256]
 
@@ -211,6 +211,14 @@ def main(argv=None):
                          "(least-loaded routing, health-gated circuit "
                          "breakers, failover, zero-downtime reload) — "
                          "round-robin over the visible devices")
+    ap.add_argument("--tp", type=int, default=None, metavar="M",
+                    help="tensor parallelism: each replica spans M "
+                         "devices (weights sharded 1/M per chip by the "
+                         "ShardingPlan's row/col rule — serve models "
+                         "bigger than one chip); replica i owns the "
+                         "contiguous device span [i*M, (i+1)*M). "
+                         "/metrics + /healthz expose each replica's "
+                         "span")
     ap.add_argument("--attempt-timeout-s", type=float, default=30.0,
                     help="pool failover: per-replica attempt timeout "
                          "(how long a wedged replica can hold a request "
@@ -272,7 +280,7 @@ def main(argv=None):
             # replicas to the host backend
             engine_kw.pop("name")
             engine = serving.ReplicaPool(
-                args.model_dir, replicas=args.replicas,
+                args.model_dir, replicas=args.replicas, tp=args.tp,
                 place=fluid.CPUPlace() if args.place == "cpu" else None,
                 name=args.name,
                 default_deadline_ms=args.deadline_ms,
@@ -282,7 +290,7 @@ def main(argv=None):
             place = (fluid.TPUPlace() if args.place == "tpu"
                      else fluid.CPUPlace())
             engine = serving.InferenceEngine(
-                args.model_dir, place=place,
+                args.model_dir, place=place, tp=args.tp,
                 default_deadline_ms=args.deadline_ms, **engine_kw)
     except fluid.ProgramVerificationError as e:
         print("ptpu_serve: model REJECTED by the static verifier:\n%s"
