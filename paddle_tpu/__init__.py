@@ -63,6 +63,7 @@ from .io import save_params, load_params, save_persistables, \
     load_persistables, save_inference_model, load_inference_model
 from . import metrics
 from . import profiler
+from . import observability
 from . import evaluator
 from . import average
 from .average import WeightedAverage

@@ -49,6 +49,8 @@ import time
 import numpy as np
 
 from . import snapshot as _snap
+from ..observability import registry as _obsreg
+from ..observability import trace as _otrace
 from .retention import RetentionPolicy, apply_retention
 
 __all__ = ["CheckpointManager", "SaveHandle"]
@@ -241,6 +243,50 @@ class CheckpointManager(object):
         restore(layout=...) can reshard onto a different mesh."""
         if self._closed:
             raise RuntimeError("CheckpointManager is closed")
+        # capture span (ARCHITECTURE.md §24): the synchronous cost the
+        # training loop pays — device-side copies + host dicts; the
+        # background write has its own span on the writer thread
+        csp = _otrace.span("checkpoint/capture", cat="checkpoint",
+                           step=int(step))
+        try:
+            job = self._capture_job(step, program, scope, extra, layout)
+        except BaseException as e:
+            # a failed capture (uninitialized persistable, a donated-
+            # and-deleted buffer) must not strand the span open — a
+            # phantom "open checkpoint/capture" in later bundles would
+            # point the postmortem at a save that died long ago
+            csp.end(error=type(e).__name__)
+            raise
+        csp.end(values=len(job.values),
+                sync=bool(wait or not self.async_save))
+        if wait or not self.async_save:
+            # inline write: raises on failure (the sync contract)
+            self._run_job(job, reraise=True)
+            return job.handle
+        with self._lock:
+            # prune finished handles (a day-long run must not accumulate
+            # one per save) and surface the first background failure HERE,
+            # loudly — a trainer that ignores its SaveHandles must not run
+            # for days believing checkpoints exist while every write fails
+            failed = [h for h in self._pending
+                      if h.done() and h.exception() is not None
+                      and not h._observed]
+            self._pending = [h for h in self._pending if not h.done()]
+            if not failed:
+                self._pending.append(job.handle)
+        if failed:
+            # this save is NOT enqueued: checkpointing is broken and the
+            # caller must know before trusting another interval to it
+            raise failed[0].exception()
+        self._inflight.acquire()  # bounded budget: backpressure here
+        self._ensure_thread()
+        self._queue.put(job)
+        return job.handle
+
+    def _capture_job(self, step, program, scope, extra, layout):
+        """The synchronous capture half of save(): quiesce staged
+        prefetches, snapshot every persistable + reader position + the
+        seed cursor, and return the _SaveJob the writer publishes."""
         from ..core.framework import Parameter, default_main_program
         from ..core.executor import global_scope
         from ..core.readers import ReaderBase
@@ -317,35 +363,15 @@ class CheckpointManager(object):
             meta["device_layout"] = layout.to_json()
         if extra:
             meta["extra"] = dict(extra)
-        job = _SaveJob(int(step), values, meta,
-                       _pd.program_to_bytes(program),
-                       self._resolve_validate(), SaveHandle(step))
-        if wait or not self.async_save:
-            # inline write: raises on failure (the sync contract)
-            self._run_job(job, reraise=True)
-            return job.handle
-        with self._lock:
-            # prune finished handles (a day-long run must not accumulate
-            # one per save) and surface the first background failure HERE,
-            # loudly — a trainer that ignores its SaveHandles must not run
-            # for days believing checkpoints exist while every write fails
-            failed = [h for h in self._pending
-                      if h.done() and h.exception() is not None
-                      and not h._observed]
-            self._pending = [h for h in self._pending if not h.done()]
-            if not failed:
-                self._pending.append(job.handle)
-        if failed:
-            # this save is NOT enqueued: checkpointing is broken and the
-            # caller must know before trusting another interval to it
-            raise failed[0].exception()
-        self._inflight.acquire()  # bounded budget: backpressure here
-        self._ensure_thread()
-        self._queue.put(job)
-        return job.handle
+        return _SaveJob(int(step), values, meta,
+                        _pd.program_to_bytes(program),
+                        self._resolve_validate(), SaveHandle(step))
 
     # ----------------------------------------------------------- write --
     def _run_job(self, job, reraise=False):
+        wsp = _otrace.span("checkpoint/write", cat="checkpoint",
+                           step=job.step)
+        reg = _obsreg.REGISTRY
         try:
             if job.validate:
                 # verify the program the snapshot RECORDS (parsed back
@@ -362,7 +388,20 @@ class CheckpointManager(object):
                             protect=(job.step,))
             job.handle.write_seconds = time.perf_counter() - t0
             job.handle._finish(path=path)
+            wsp.end()
+            # save-latency surface (ARCHITECTURE.md §24): the registry's
+            # histogram is what the bench-regression gate and /metrics
+            # read — one observation per published snapshot
+            reg.histogram(
+                "ptpu_checkpoint_save_seconds",
+                "background snapshot write+hash+fsync latency"
+            ).observe(job.handle.write_seconds)
+            reg.counter("ptpu_checkpoint_saves_total",
+                        "snapshot saves by outcome").inc(status="ok")
         except BaseException as e:  # surfaced via handle / wait()
+            wsp.end(error=type(e).__name__)
+            reg.counter("ptpu_checkpoint_saves_total",
+                        "snapshot saves by outcome").inc(status="error")
             job.handle._finish(exc=e)
             if reraise:
                 raise

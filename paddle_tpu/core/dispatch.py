@@ -39,6 +39,9 @@ import threading
 import time
 import weakref
 
+from ..observability import registry as _obsreg
+from ..observability import trace as _trace
+
 __all__ = ["InflightWindow", "HostIoPrefetcher", "rollback_all_staged",
            "CANCELLED"]
 
@@ -86,6 +89,9 @@ class InflightWindow(object):
             target=self._completion_loop, daemon=True,
             name="ptpu-window-%s" % (tag or "anon"))
         self._thread.start()
+        # observability: depth/completed/idle surface on /metrics for
+        # this window's lifetime (weakref — closed windows drop off)
+        _obsreg.note_window(self)
 
     # ------------------------------------------------------------ slots --
     def acquire(self, timeout=None):
@@ -99,14 +105,19 @@ class InflightWindow(object):
         any device work was enqueued)."""
         self._sem.release()
 
-    def track(self, handles, enqueued_at=None):
+    def track(self, handles, enqueued_at=None, on_complete=None):
         """Register an enqueued dispatch's fetch handles; the completion
         thread releases the slot (and accounts the idle gap) once the
         device finishes them. `handles` may be empty (a dispatch that
-        produced no device work releases immediately)."""
+        produced no device work releases immediately). `on_complete`
+        (kwargs-only; called with error=<exception class name> when the
+        device-side wait raised) runs on the completion thread right
+        after the device finishes — the trace layer rides it to close
+        the batch's window-occupancy span at the real completion
+        instant, carrying the device failure if there was one."""
         self._q.put((tuple(handles or ()),
                      time.monotonic() if enqueued_at is None
-                     else enqueued_at))
+                     else enqueued_at, on_complete))
 
     # ------------------------------------------------------- completion --
     def _completion_loop(self):
@@ -116,17 +127,25 @@ class InflightWindow(object):
             item = self._q.get()
             if item is _CLOSE:
                 return
-            handles, enq_t = item
+            handles, enq_t, on_complete = item
             arrays = [getattr(h, "array", h) for h in handles]
+            err = None
             try:
                 if arrays:
                     # the window's ONE host sync — on the completion
                     # thread, never the dispatch path
                     _prof.note_sync("window/completion")
                     jax.block_until_ready(arrays)
-            except Exception:
-                pass  # a failed batch already failed its futures; the
-                # slot must come back regardless
+            except Exception as e:  # noqa: BLE001 — a failed batch
+                # already failed its futures; the slot must come back
+                # regardless, but the EXECUTION span must not render as
+                # a clean completion in the postmortem timeline
+                err = type(e).__name__
+            if on_complete is not None:
+                try:
+                    on_complete(**({"error": err} if err else {}))
+                except Exception:  # noqa: BLE001 — an observer must
+                    pass           # never wedge slot recycling
             ready = time.monotonic()
             with self._lock:
                 if self._last_ready is not None and enq_t > self._last_ready:
@@ -275,6 +294,12 @@ class HostIoPrefetcher(object):
             cancel = _OrEvent(cancelled, self._abandon)
 
             def work():
+                # the overlap itself, made visible: this span runs on
+                # the staging thread concurrently with the consuming
+                # step's exec/dispatch span — the timeline SHOWS the
+                # host-io prepass hidden behind device execution
+                ssp = _trace.span("exec/prefetch_stage", cat="train",
+                                  prefetcher=self.name, steps=steps)
                 try:
                     ctx = None
                     if place is not None:
@@ -303,6 +328,8 @@ class HostIoPrefetcher(object):
                     # fence/retry invariants need)
                     block.refund()
                     block.error = e
+                ssp.end(**({"error": type(block.error).__name__}
+                           if block.error is not None else {}))
                 with self._lock:
                     self._staged = block
                     self._inflight = None
@@ -413,6 +440,39 @@ def kick_next_prepass(executor, program, scope, steps, host, cancelled,
         pf = executor._prefetcher = HostIoPrefetcher(name=name)
     pf.kick(program, scope, steps, host, cancelled=cancelled, **kick_kw)
     return pf
+
+
+def run_step_traced(label, cancelled, body_fn, **span_args):
+    """The executors' shared step-trace wrapper (ONE copy for
+    Executor._run_impl and ParallelExecutor._run_impl — its error
+    semantics changed three times during review hardening, exactly the
+    drift hand-mirrored copies invite): mint one trace per step —
+    inheriting the thread's ambient trace when a layer above (the
+    serving batcher's per-batch scope_trace) already owns one, so a
+    serving dispatch's exec/step span correlates with its batch — call
+    `body_fn(tspan)`, and close the trace honestly: a raise ends every
+    open span of the trace with the error name; a watchdog-cancelled
+    body that unwedged after the caller's DispatchTimeoutError must not
+    render as a clean step. Runs on the dispatching thread (the
+    monitored worker in watchdog mode), so a wedge leaves the step's
+    spans OPEN for the diagnostic bundle."""
+    tr = _trace.ambient()
+    tspan = _trace.span("exec/step", cat="train",
+                        trace=tr if tr is not None else _trace.new_trace(),
+                        executor=label, **span_args)
+    try:
+        out = body_fn(tspan)
+    except BaseException as e:
+        err = type(e).__name__
+        _trace.end_open(tspan.trace, error=err)
+        tspan.end(error=err)
+        raise
+    if cancelled is not None and cancelled.is_set():
+        _trace.end_open(tspan.trace, error="DispatchCancelled")
+        tspan.end(error="DispatchCancelled")
+        return out
+    tspan.end()
+    return out
 
 
 def rollback_all_staged(scope=None):

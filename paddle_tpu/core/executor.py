@@ -22,6 +22,7 @@ from . import readers
 from .framework import default_main_program, convert_dtype
 from .lod import LoDTensor
 from .utils import find_var as _find_feed_var
+from ..observability import trace as _trace
 
 
 class Scope(object):
@@ -720,6 +721,23 @@ class Executor(object):
                   use_program_cache, steps, fetch_reduce, validate,
                   cancelled=None, info=None, sync=False,
                   apply_tuned=False, prefetch=False):
+        # one trace per training step (ARCHITECTURE.md §24), via the
+        # executors' ONE shared wrapper (core/dispatch.run_step_traced):
+        # the root span lives on THIS thread — in watchdog mode that is
+        # the monitored worker, so a wedged dispatch leaves its step
+        # trace (and whichever child span it is stuck inside) OPEN for
+        # the diagnostic bundle's recorder dump to capture.
+        from .dispatch import run_step_traced
+        return run_step_traced(
+            "exe", cancelled,
+            lambda tspan: self._run_traced(
+                program, feed, fetch_list, scope, return_numpy,
+                use_program_cache, steps, fetch_reduce, validate,
+                cancelled, info, sync, apply_tuned, prefetch, tspan))
+
+    def _run_traced(self, program, feed, fetch_list, scope, return_numpy,
+                    use_program_cache, steps, fetch_reduce, validate,
+                    cancelled, info, sync, apply_tuned, prefetch, tspan):
         if program is None:
             program = default_main_program()
         feed = feed or {}
@@ -728,6 +746,8 @@ class Executor(object):
         steps = int(steps)
         if steps < 1:
             raise ValueError("steps must be >= 1, got %r" % (steps,))
+        tspan.set(program=str(program._uid),
+                  version=int(program._version), steps=steps)
         tuned_unroll = None
         if apply_tuned:
             from .. import tuning
@@ -782,26 +802,38 @@ class Executor(object):
         from . import dispatch as _dispatch
         stacked_names = set()
         staged = None
-        if pf is not None and pf.has_work():
-            # consult the prefetcher even on a prefetch=False call: a
-            # staged block for a different signature must be refunded
-            # BEFORE the inline prepass pops the stream, or the staged
-            # records would replay out of order
-            staged = pf.take(program, scope, steps, False,
-                             cancelled=cancelled)
-            if staged is _dispatch.CANCELLED:
-                return None  # deadline raised on the caller's thread
-        if staged is not None:
-            feed_arrays.update(staged.arrays)
-            stacked_names = set(staged.stacked)
-        else:
-            try:
-                run_host_io_prepass(program, scope, feed_arrays,
-                                    steps=steps,
-                                    stacked_out=stacked_names,
-                                    cancelled=cancelled, place=self.place)
-            except _DispatchCancelled:
-                return None  # deadline raised on the caller's thread
+        iosp = tspan.child("exec/host_io")
+        try:
+            if pf is not None and pf.has_work():
+                # consult the prefetcher even on a prefetch=False call: a
+                # staged block for a different signature must be refunded
+                # BEFORE the inline prepass pops the stream, or the staged
+                # records would replay out of order
+                staged = pf.take(program, scope, steps, False,
+                                 cancelled=cancelled)
+                if staged is _dispatch.CANCELLED:
+                    # deadline raised on the caller's thread; close the
+                    # span — this abandoned worker's host io is OVER,
+                    # and an early return skips the normal end below
+                    iosp.end(error="DispatchCancelled")
+                    return None
+            if staged is not None:
+                feed_arrays.update(staged.arrays)
+                stacked_names = set(staged.stacked)
+            else:
+                try:
+                    run_host_io_prepass(program, scope, feed_arrays,
+                                        steps=steps,
+                                        stacked_out=stacked_names,
+                                        cancelled=cancelled,
+                                        place=self.place)
+                except _DispatchCancelled:
+                    iosp.end(error="DispatchCancelled")
+                    return None  # deadline raised on the caller's thread
+        except BaseException as e:  # EOF / reader faults: close the
+            iosp.end(error=type(e).__name__)   # span, the fault rides up
+            raise
+        iosp.end(staged=staged is not None)
         if cancelled is not None and cancelled.is_set():
             return None
 
@@ -934,6 +966,11 @@ class Executor(object):
                          else scope.next_seed_block(steps))
         from .. import profiler as _prof
         profiling = _prof.is_active()
+        # device-enqueue span: async dispatch, so the duration is the
+        # host-side enqueue (+ trace/compile when compiling) — a hang
+        # inside leaves it OPEN, which is exactly what the bundle's
+        # recorder dump needs to show
+        dsp = tspan.child("exec/dispatch")
         t0 = time.perf_counter() if profiling else 0.0
         try:
             with jax.default_device(self.place.device()):
@@ -989,6 +1026,7 @@ class Executor(object):
                 fetches, new_state, errors = jitted(
                     [feed_arrays[n] for n in feed_names],
                     read_state(state_rw), read_state(state_ro), seed)
+        dsp.end(compiled=compiled, aot_hit=aot_hit)
         if cancelled is not None and cancelled.is_set():
             # the caller already raised DispatchTimeoutError and may be
             # mid-rollback: a late scope write here would race the
@@ -1003,7 +1041,9 @@ class Executor(object):
             # old donated-and-deleted buffers raise instead, which
             # write_bundle records per-var as state_unavailable)
             _prof.note_sync("executor/watchdog_sync")
+            wsp = tspan.child("exec/watchdog_sync")
             jax.block_until_ready((fetches, new_state))
+            wsp.end()
             if cancelled is not None and cancelled.is_set():
                 return None
         # write state back BEFORE anything that can raise (including the
@@ -1071,7 +1111,8 @@ class Executor(object):
             raise
         if return_numpy:
             _prof.note_sync("executor/return_numpy")
-            return [np.asarray(f) for f in fetches]
+            with tspan.child("exec/d2h"):
+                return [np.asarray(f) for f in fetches]
         return [FetchHandle(f) for f in fetches]
 
 

@@ -1,0 +1,28 @@
+"""paddle_tpu.observability — one telemetry layer across training,
+serving and the fleet (ARCHITECTURE.md §24).
+
+Two halves, one seam:
+
+  * `trace` — span-based tracing into an always-on bounded
+    flight-recorder ring (the `platform::Profiler`/`tools/timeline.py`
+    successor), with a Chrome-trace-event exporter for
+    chrome://tracing / Perfetto and a text timeline renderer
+    (`ptpu_doctor trace`). A span per serving request and per training
+    step; child spans for queue wait, formation, pad/H2D, window slot
+    occupancy, device enqueue, D2H/materialize and checkpoint
+    capture/write; instant events for guard/fault/recovery actions.
+  * `registry` — one counter/gauge/histogram registry fronting the
+    existing metric surfaces (profiler sync/cache counters, inflight
+    windows, batcher queues, supervisor events, checkpoint save
+    latency, cluster heartbeats), rendered through the Prometheus text
+    path — appended to serving `/metrics`, served standalone by
+    `serve_metrics()` for trainers, dumped by `write_textfile()`.
+"""
+from . import trace
+from . import registry
+from .registry import (REGISTRY, MetricsServer, serve_metrics,
+                       unwatch_cluster, watch_cluster, write_textfile)
+
+__all__ = ["trace", "registry", "REGISTRY", "MetricsServer",
+           "serve_metrics", "watch_cluster", "unwatch_cluster",
+           "write_textfile"]

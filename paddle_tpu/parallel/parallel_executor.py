@@ -33,6 +33,7 @@ from ..core.executor import (global_scope, _feed_signature,
                              convert_feeds, run_host_io_prepass,
                              _cache_put_lru, _jit_cache_capacity)
 from ..core.utils import find_var as _find_var
+from ..observability import trace as _otrace
 from .mesh import data_parallel_mesh, replicated, batch_sharded, NamedSharding, P
 from .plan import ShardingPlan, _match_accumulator_param  # noqa: F401
 # (_match_accumulator_param re-exported: the fallback attribution moved
@@ -183,6 +184,21 @@ class ParallelExecutor(object):
     def _run_impl(self, fetch_list, feed=None, feed_dict=None,
                   return_numpy=True, steps=1, fetch_reduce="stack",
                   cancelled=None, info=None, sync=False, prefetch=False):
+        # one trace per training step via the executors' ONE shared
+        # wrapper (core/dispatch.run_step_traced), on the dispatching
+        # thread (the watchdog worker in timeout mode — a wedge leaves
+        # the step's spans open for the bundle). See Executor._run_impl.
+        from ..core.dispatch import run_step_traced
+        return run_step_traced(
+            "pexe", cancelled,
+            lambda tspan: self._run_traced(
+                fetch_list, feed, feed_dict, return_numpy, steps,
+                fetch_reduce, cancelled, info, sync, prefetch, tspan),
+            devices=int(self.mesh.devices.size))
+
+    def _run_traced(self, fetch_list, feed, feed_dict, return_numpy,
+                    steps, fetch_reduce, cancelled, info, sync, prefetch,
+                    tspan):
         feed = feed if feed is not None else (feed_dict or {})
         program = self._program
         scope = self._scope
@@ -190,6 +206,8 @@ class ParallelExecutor(object):
         steps = int(steps)
         if steps < 1:
             raise ValueError("steps must be >= 1, got %r" % (steps,))
+        tspan.set(program=str(program._uid),
+                  version=int(program._version), steps=steps)
         if fetch_reduce not in lowering.FETCH_REDUCE_POLICIES:
             raise ValueError("fetch_reduce must be one of %r, got %r"
                              % (lowering.FETCH_REDUCE_POLICIES, fetch_reduce))
@@ -262,24 +280,38 @@ class ParallelExecutor(object):
         from ..core.executor import _DispatchCancelled
         stacked_names = set()
         staged = None
-        if pf is not None and pf.has_work():
-            # consult even on a prefetch=False call: a mismatched staged
-            # block must be refunded before the inline prepass pops
-            staged = pf.take(program, scope, steps, True,
-                             cancelled=cancelled)
-            if staged is _dispatch.CANCELLED:
-                return None  # deadline raised on the caller's thread
-        if staged is not None:
-            feed_arrays.update(staged.arrays)
-            stacked_names = set(staged.stacked)
-        else:
-            try:
-                run_host_io_prepass(program, scope, feed_arrays, host=True,
-                                    validate=_validate_record, steps=steps,
-                                    stacked_out=stacked_names,
-                                    cancelled=cancelled)
-            except _DispatchCancelled:
-                return None  # watchdog deadline raised on the caller
+        iosp = tspan.child("exec/host_io")
+        try:
+            if pf is not None and pf.has_work():
+                # consult even on a prefetch=False call: a mismatched
+                # staged block must be refunded before the inline
+                # prepass pops
+                staged = pf.take(program, scope, steps, True,
+                                 cancelled=cancelled)
+                if staged is _dispatch.CANCELLED:
+                    # deadline raised on the caller; an early return
+                    # skips the normal end below — close the span or it
+                    # haunts every later bundle as a phantom open span
+                    iosp.end(error="DispatchCancelled")
+                    return None
+            if staged is not None:
+                feed_arrays.update(staged.arrays)
+                stacked_names = set(staged.stacked)
+            else:
+                try:
+                    run_host_io_prepass(program, scope, feed_arrays,
+                                        host=True,
+                                        validate=_validate_record,
+                                        steps=steps,
+                                        stacked_out=stacked_names,
+                                        cancelled=cancelled)
+                except _DispatchCancelled:
+                    iosp.end(error="DispatchCancelled")
+                    return None  # watchdog deadline raised on the caller
+        except BaseException as e:  # EOF / reader faults ride up closed
+            iosp.end(error=type(e).__name__)
+            raise
+        iosp.end(staged=staged is not None)
         feed_names = sorted(feed_arrays)
 
         def _sharding_for(name, ndim, stacked):
@@ -502,6 +534,8 @@ class ParallelExecutor(object):
                 return compile_cache.donating_multidevice_compile_guard()
             return contextlib.nullcontext()
 
+        # device-enqueue span (async; see Executor) — open = wedged here
+        dsp = tspan.child("exec/dispatch")
         t0 = _time.perf_counter() if profiling else 0.0
         try:
             with _donating_call_guard(jitted):
@@ -538,6 +572,7 @@ class ParallelExecutor(object):
                 fetches, new_state, errors = jitted(
                     feed_vals, read_state(state_rw),
                     read_state(state_ro, commit=True), seed)
+        dsp.end(compiled=compiled, aot_hit=aot_hit)
         if cancelled is not None and cancelled.is_set():
             # caller already raised DispatchTimeoutError; a late scope
             # write would race its rollback (see Executor._run_impl)
@@ -546,7 +581,9 @@ class ParallelExecutor(object):
             # watchdog mode: device-sync BEFORE the scope write-back so
             # an execution-phase hang can't park unresolved arrays in
             # the scope (see Executor._run_impl)
+            wsp = tspan.child("exec/watchdog_sync")
             jax.block_until_ready((fetches, new_state))
+            wsp.end()
             if cancelled is not None and cancelled.is_set():
                 return None
         # state write-back precedes any raise point (incl. the sync below):
@@ -611,6 +648,7 @@ class ParallelExecutor(object):
             raise
         if return_numpy:
             _prof.note_sync("pexe/return_numpy")
-            return [np.asarray(f) for f in fetches]
+            with tspan.child("exec/d2h"):
+                return [np.asarray(f) for f in fetches]
         from ..core.executor import FetchHandle
         return [FetchHandle(f) for f in fetches]

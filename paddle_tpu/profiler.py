@@ -16,7 +16,7 @@ import jax
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "profile_report", "record_event", "cache_stats", "note_sync",
-           "sync_stats", "dispatch_path", "record_idle"]
+           "sync_stats", "dispatch_path", "record_idle", "snapshot"]
 
 _active = False
 _trace_dir = None
@@ -160,6 +160,31 @@ def record_event(tag, seconds=0.0):
     record_run(tag, seconds, compiled=False)
 
 
+def snapshot():
+    """Machine-readable export of everything the profiler tracks, in one
+    dict: {"entries": {tag: {calls, runs, total, max, min, ave,
+    compiles, compile_s, aot_hits, saved_s, idle_s, gaps}},
+    "sync_stats": sync_stats(), "cache_stats": cache_stats()}. This is
+    the PUBLIC surface for bench.py / the observability registry / CI
+    gates — nothing should read the private `_entries` dict (its
+    "min" sentinel and optional keys are internal). Values are plain
+    numbers (JSON-safe); `min` reads 0.0 for entries with no exec
+    calls, matching the report."""
+    entries = {}
+    for tag, e in list(_entries.items()):
+        d = {"calls": e["calls"], "runs": e["runs"],
+             "total": e["total"], "max": e["max"],
+             "min": 0.0 if e["min"] == float("inf") else e["min"],
+             "ave": e["total"] / max(e["runs"], 1),
+             "compiles": e["compiles"], "compile_s": e["compile_s"],
+             "aot_hits": e.get("aot_hits", 0),
+             "saved_s": e.get("saved_s", 0.0),
+             "idle_s": e.get("idle_s", 0.0), "gaps": e.get("gaps", 0)}
+        entries[tag] = d
+    return {"entries": entries, "sync_stats": sync_stats(),
+            "cache_stats": cache_stats()}
+
+
 _SORT_KEYS = ("calls", "total", "max", "min", "ave")
 
 
@@ -192,12 +217,19 @@ def start_profiler(state="All", profile_path="/tmp/profile"):
     _span[0] = time.time()
 
 
-def profile_report(sorted_key=None):
+def profile_report(sorted_key=None, json=False):
     """The Event-table equivalent: one row per jitted program entry.
 
     sorted_key: None (insertion order) | 'calls' | 'total' | 'max' | 'min'
-    | 'ave' (reference profiler.py sorted_key contract)."""
+    | 'ave' (reference profiler.py sorted_key contract).
+
+    json=True returns the `snapshot()` dict instead of the rendered
+    table — the machine-readable contract bench.py and the
+    observability registry consume (sorted_key is still validated but
+    irrelevant: consumers sort their own views)."""
     _check_sorted_key(sorted_key)
+    if json:
+        return snapshot()
     rows = []
     for tag, e in _entries.items():
         total = e["total"]

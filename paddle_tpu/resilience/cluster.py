@@ -70,6 +70,8 @@ from ..core import executor as _exe_mod
 from ..core.executor import DispatchTimeoutError, Scope, scope_guard
 from ..core.readers import EOFException
 from ..checkpoint import CheckpointManager, find_valid_snapshot
+from ..observability import registry as _obsreg
+from ..observability import trace as _otrace
 from ..parallel import distributed as _dist
 from ..parallel.distributed import DeviceLayout
 from . import faults as _faults
@@ -205,6 +207,10 @@ class ClusterCoordinator(object):
         ev = dict(detail, event=event, gen=self.gen,
                   wall_time=time.time())
         self.events.append(ev)
+        # flight-recorder instants (ARCHITECTURE.md §24): fence/rescale/
+        # grow/abort land in the same timeline as the dispatch spans
+        _otrace.instant("cluster/%s" % event, cat="cluster",
+                        gen=int(self.gen))
         if self.on_event is not None:
             try:
                 self.on_event(ev)
@@ -437,6 +443,13 @@ class ClusterCoordinator(object):
                     "events": self.events,
                     "plans": self._plans,
                     "heartbeats": _hb.read_heartbeats(self.cluster_dir)}
+            try:
+                # the coordinator's own flight-recorder ring (fence/
+                # rescale/abort instants); each worker's span timeline
+                # rides along inside its copied PR-5 bundles below
+                meta["trace"] = _otrace.dump_jsonable()
+            except Exception:  # noqa: BLE001
+                pass
             with open(os.path.join(path, "bundle.json"), "w") as f:
                 json.dump(meta, f, indent=1, sort_keys=True)
             wroot = os.path.join(self.cluster_dir, "bundles")
@@ -466,7 +479,8 @@ class ElasticWorker(object):
                  heartbeat_interval=0.2, poll_interval=0.02,
                  plan_timeout=180.0, record_results=True,
                  async_save=False, sharded_weight_update=False,
-                 step_delay=0.0):
+                 step_delay=0.0, metrics_port=None,
+                 metrics_host="127.0.0.1"):
         """One cohort member. `build_fn(layout)` -> dict with keys
         `main`, `startup`, `loss` (Variable or name) and optionally
         `feed_fn(step_index)` (deterministic feeds; omit for reader-fed
@@ -501,6 +515,17 @@ class ElasticWorker(object):
         # (a CI cohort of tiny models otherwise finishes before a
         # replacement worker can even import jax and join)
         self.step_delay = float(step_delay)
+        # trainer-side scrape endpoint (ARCHITECTURE.md §24): serve the
+        # observability registry's Prometheus rendering — including the
+        # heartbeat-derived fleet gauges for this cluster dir — on this
+        # port (0 = pick a free one, published in the heartbeat so
+        # `ptpu_elastic status` can point scrapers at it; None = off).
+        # metrics_host defaults loopback; a multi-host fleet whose
+        # scraper lives elsewhere passes "0.0.0.0" (the heartbeat's
+        # `host` field names the machine)
+        self.metrics_port = metrics_port
+        self.metrics_host = metrics_host
+        self._metrics_server = None
         self._hb_writer = _hb.HeartbeatWriter(
             cluster_dir, worker_id, interval=heartbeat_interval)
         self._plan_path = os.path.join(self.cluster_dir, PLAN_FILE)
@@ -559,6 +584,37 @@ class ElasticWorker(object):
         Returns {"steps": final step, "generations": n} on success;
         raises ClusterAborted when the coordinator aborts the job."""
         num_steps = int(num_steps)
+        if self.metrics_port is not None and self._metrics_server is None:
+            # best-effort like the teardown: a metrics bind failure
+            # (port taken) is an observability problem — it must not
+            # kill the worker and read to the coordinator as a host
+            # death burning a fence/rollback cycle
+            try:
+                # liveness window scaled to THIS fleet's beat cadence:
+                # the 3s default reads a healthy slow-beating worker
+                # (heartbeat_interval > 1s) as dead between beats
+                _obsreg.watch_cluster(
+                    self.cluster_dir,
+                    heartbeat_timeout=max(
+                        3.0, 3.0 * self._hb_writer.interval))
+                self._metrics_server = _obsreg.serve_metrics(
+                    port=int(self.metrics_port), host=self.metrics_host)
+                self._hb_writer.update(
+                    metrics_port=self._metrics_server.port)
+            except Exception as e:  # noqa: BLE001 — train anyway
+                _obsreg.unwatch_cluster(self.cluster_dir)
+                if self._metrics_server is not None:
+                    try:  # a bound server must not leak its port when
+                        # a later setup step (heartbeat publish) raises
+                        self._metrics_server.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._metrics_server = None
+                import logging
+                logging.getLogger(__name__).warning(
+                    "worker %s: metrics endpoint unavailable (%s); "
+                    "training continues without /metrics",
+                    self.worker_id, e)
         self._hb_writer.start()
         fault_plan = _faults.FaultPlan.from_env()
         if fault_plan is not None and _faults.active_plan() is None:
@@ -606,6 +662,16 @@ class ElasticWorker(object):
             if fault_plan is not None:
                 fault_plan.disarm()
             self._hb_writer.close("done" if self._done else "left")
+            if self._metrics_server is not None:
+                try:
+                    self._metrics_server.close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+                self._metrics_server = None
+                # drop the heartbeat collector with the endpoint: a
+                # process cycling through cluster dirs must not keep
+                # reading dead directories on every later render
+                _obsreg.unwatch_cluster(self.cluster_dir)
         return {"steps": num_steps if self._done else None,
                 "generations": generations}
 

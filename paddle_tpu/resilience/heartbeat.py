@@ -67,7 +67,12 @@ class HeartbeatWriter(object):
                          "status": "joining",
                          "step": -1,
                          "gen": 0,
-                         "gen_acked": 0}
+                         "gen_acked": 0,
+                         # published so liveness readers that don't set
+                         # a timeout (ptpu_elastic status) can scale
+                         # their staleness window to THIS fleet's beat
+                         # cadence instead of a fixed default
+                         "interval": self.interval}
         self._seq = 0
         self._stop = threading.Event()
         self._thread = None
@@ -209,6 +214,42 @@ class HeartbeatMonitor(object):
             except OSError:
                 pass  # EPERM etc: alive under another uid
         return hb["age"] <= self.timeout
+
+    def fleet_view(self):
+        """The fleet gauge rows derived from the heartbeats — ONE
+        implementation shared by `ptpu_elastic status` and the
+        observability registry's cluster collector (two copies drifted
+        once; never again): per worker the lifecycle status, liveness
+        (the monitor's staleness/pid verdict), step cursor, steps
+        behind the cohort's front-runner (None when the worker never
+        reported a step), plan generations, beat age, and the
+        metrics port it published (if any)."""
+        beats = self.poll()
+        # the front-runner is the furthest LIVE, still-participating
+        # worker: a dead worker's stale file (nothing ever deletes it)
+        # or a finished worker's terminal beat would otherwise pin
+        # `front` past a rollback forever and every healthy worker
+        # would read permanently behind
+        live_steps = [int(b.get("step", -1)) for b in beats.values()
+                      if int(b.get("step", -1)) >= 0 and b.get("alive")
+                      and b.get("status") not in TERMINAL_STATUSES]
+        front = max(live_steps) if live_steps else 0
+        rows = []
+        for wid, b in sorted(beats.items()):
+            step = int(b.get("step", -1))
+            rows.append({
+                "worker": wid,
+                "status": b.get("status"),
+                "alive": bool(b.get("alive")),
+                "step": step,
+                "steps_behind": (max(0, front - step)
+                                 if step >= 0 else None),
+                "gen": int(b.get("gen", 0) or 0),
+                "gen_acked": int(b.get("gen_acked", 0) or 0),
+                "beat_age_s": float(b.get("age", 0.0)),
+                "metrics_port": b.get("metrics_port"),
+            })
+        return rows
 
     def dead_workers(self, expected=None):
         """worker_ids considered dead: stale/vanished-pid heartbeats,

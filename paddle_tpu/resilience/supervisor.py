@@ -41,6 +41,8 @@ import time
 import numpy as np
 
 from .. import profiler as _prof
+from ..observability import registry as _obsreg
+from ..observability import trace as _otrace
 from ..core import readers as _readers
 from ..core.executor import (DispatchTimeoutError, NumericalGuardError,
                              global_scope)
@@ -208,6 +210,18 @@ class Supervisor(object):
               "wall_time": time.time()}
         ev.update(extra)
         self.events.append(ev)
+        # always-on observability (ARCHITECTURE.md §24): every recovery
+        # action is an instant event in the flight recorder (it lands in
+        # the same timeline as the dispatch spans it interrupted — a
+        # bundle shows the guard trip BETWEEN the steps) and a labeled
+        # counter on /metrics
+        _otrace.instant("resilience/%s:%s" % (cls, action),
+                        cat="resilience", step=int(self.step),
+                        error=ev["error"])
+        _obsreg.REGISTRY.counter(
+            "ptpu_supervisor_events_total",
+            "supervisor recovery events by fault class and action"
+        ).inc(**{"class": cls, "action": action})
         if _prof.is_active():
             # same gate as the executors' record_run: profiler rows
             # reflect the profiled window, the event log keeps everything

@@ -83,6 +83,16 @@ def write_bundle(bundle_dir, reason, fault_class=None, step=None,
         "state_unavailable": [],
         "has_program": program is not None,
     }
+    try:
+        # flight-recorder dump (ARCHITECTURE.md §24): the bounded span
+        # ring plus every span still OPEN at capture — for a hang this
+        # is "what the pipeline was doing when it wedged", rendered by
+        # `ptpu_doctor trace <bundle>`. Best-effort like everything
+        # else here: a capture must never fail the capture.
+        from ..observability import trace as _otrace
+        meta["trace"] = _otrace.dump_jsonable()
+    except Exception:  # noqa: BLE001
+        pass
 
     if program is not None:
         from ..core import program_desc as _pd

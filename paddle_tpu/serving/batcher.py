@@ -32,6 +32,9 @@ import collections
 import threading
 import time
 
+from ..observability import registry as _obsreg
+from ..observability import trace as _trace
+
 __all__ = ["Batcher", "RequestFuture", "ServingError", "QueueFullError",
            "DeadlineExceededError", "ServingClosedError",
            "RequestTooLargeError"]
@@ -127,7 +130,8 @@ _DEADLINE_MARGIN_S = 1e-3
 
 
 class _Request(object):
-    __slots__ = ("feed", "rows", "future", "deadline", "enqueued_at")
+    __slots__ = ("feed", "rows", "future", "deadline", "enqueued_at",
+                 "trace", "span", "qspan")
 
     def __init__(self, feed, rows, deadline):
         self.feed = feed
@@ -135,6 +139,22 @@ class _Request(object):
         self.future = RequestFuture()
         self.deadline = deadline          # monotonic seconds, or None
         self.enqueued_at = time.monotonic()
+        # distributed-trace identity (ARCHITECTURE.md §24): one trace
+        # per request; the root span + queue-wait child are armed at
+        # submit, downstream batch spans carry this trace in their args
+        self.trace = None
+        self.span = _trace._NOOP
+        self.qspan = _trace._NOOP
+
+
+def _span_closer(span):
+    """Future done-callback that ends the request's root span — runs on
+    the completing thread (scatter or failure), cheap by contract."""
+    def _cb(fut):
+        err = getattr(fut, "_error", None)
+        span.end(**({"error": type(err).__name__}
+                    if err is not None else {}))
+    return _cb
 
 
 class Batcher(object):
@@ -192,6 +212,7 @@ class Batcher(object):
                 target=self._loop, daemon=True, name="ptpu-" + name)]
         if metrics is not None:
             metrics.bind_queue_depth(lambda: len(self._queue))
+        _obsreg.note_batcher(self, name)  # queue depths on /metrics
         for w in self._workers:
             w.start()
 
@@ -209,12 +230,30 @@ class Batcher(object):
         deadline = (time.monotonic() + float(deadline_ms) / 1e3
                     if deadline_ms is not None else None)
         req = _Request(feed, rows, deadline)
+        # per-request trace: root span submit -> scatter (ended by the
+        # future's done callback, whatever thread completes it) with a
+        # queue-wait child ended when the formation worker pops the
+        # request. Armed BEFORE the lock: span creation is just an
+        # object + perf_counter, but no reason to hold the queue lock
+        req.trace = _trace.new_trace()
+        req.span = _trace.span("serving/request", cat="serving",
+                               trace=req.trace, rows=rows)
+        req.qspan = req.span.child("serving/queue")
+        if req.span is not _trace._NOOP:
+            # recorder disabled = genuinely zero per-request cost: the
+            # BENCH_OBS off leg is the baseline the <5% gate compares
+            # against, so it must not keep the callback overhead
+            req.future.add_done_callback(_span_closer(req.span))
         with self._cond:
             if self._closed:
+                req.qspan.end(error="ServingClosedError")
+                req.span.end(error="ServingClosedError")
                 raise ServingClosedError("serving engine is shut down")
             if len(self._queue) >= self.queue_capacity:
                 if self._metrics is not None:
                     self._metrics.on_queue_full()
+                req.qspan.end(error="QueueFullError")
+                req.span.end(error="QueueFullError")
                 raise QueueFullError(
                     "request queue at capacity (%d); retry with backoff"
                     % self.queue_capacity)
@@ -304,6 +343,7 @@ class Batcher(object):
         self._pending_rows -= req.rows
         if req.deadline is not None:
             self._deadlined -= 1
+        req.qspan.end()  # queue wait over: forming (or expiring) now
         return req
 
     def _fail_expired(self, expired):
@@ -328,14 +368,24 @@ class Batcher(object):
             self._fail_expired([r for r in batch if r not in live])
         if not live:
             return
+        traces = [r.trace for r in live]
+        # one BATCH trace groups this dispatch's spans — and is scoped
+        # ambient around the dispatch call, so the engine's pad/enqueue
+        # spans AND the Executor's exec/step span (minted layers below,
+        # no trace parameter in run()) inherit it instead of starting
+        # uncorrelated traces; the request traces ride in args
+        btrace = _trace.new_trace()
         window = self._window
         if window is not None:
             # bounded in-flight: park until the device finishes a batch.
             # Poll so a hard close (drain=False) can't wedge this worker
             # behind a slot that will never free.
+            wspan = _trace.span("serving/window_wait", cat="serving",
+                                trace=btrace, traces=traces)
             while not window.acquire(timeout=0.1):
                 with self._cond:
                     if self._closed and not self._draining:
+                        wspan.end(error="ServingClosedError")
                         for req in live:
                             if not req.future.done():
                                 req.future.set_exception(
@@ -343,12 +393,16 @@ class Batcher(object):
                                         "serving engine shut down before "
                                         "dispatch"))
                         return
+            wspan.end()
         enq_t = time.monotonic()
+        dspan = _trace.span("serving/dispatch", cat="serving",
+                            trace=btrace, reqs=len(live), traces=traces)
         try:
             from .. import profiler as _prof
-            with _prof.dispatch_path():
+            with _prof.dispatch_path(), _trace.scope_trace(btrace):
                 handles = self._dispatch(live)
         except Exception as e:  # noqa: BLE001 — fail the batch, not the
+            dspan.end(error=type(e).__name__)
             if window is not None:   # worker: serving must outlive one
                 window.release()     # bad request batch
             for req in live:
@@ -357,8 +411,17 @@ class Batcher(object):
             if self._metrics is not None:
                 self._metrics.on_error(len(live))
         else:
+            dspan.end()
             if window is not None:
-                window.track(handles or (), enq_t)
+                # window-slot occupancy span: enqueue -> the completion
+                # thread observes the device finish (its one host sync
+                # closes the span at the REAL completion instant — the
+                # overlap of these spans across batches IS the
+                # continuous-batching picture, bounded by the depth)
+                espan = _trace.span("serving/execute", cat="serving",
+                                    trace=btrace, traces=traces)
+                window.track(handles or (), enq_t,
+                             on_complete=espan.end)
 
     def _loop(self):
         """Serial mode (pipeline_depth=0): form -> dispatch, one thread."""
@@ -397,6 +460,11 @@ class Batcher(object):
                     with self._cond:
                         self._cond.notify_all()
                 continue
+            # formed-batch span: formation done -> popped for dispatch
+            # (the stage where a batch waits behind a full window)
+            fspan = _trace.span("serving/formed_wait", cat="serving",
+                                reqs=len(batch),
+                                traces=[r.trace for r in batch])
             with self._cond:
                 while len(self._formed) >= self._formed_cap \
                         and not self._closed:
@@ -405,13 +473,14 @@ class Batcher(object):
                     # hard close caught us holding a formed batch
                     self._form_busy = False
                     self._cond.notify_all()
+                    fspan.end(error="ServingClosedError")
                     for req in batch:
                         if not req.future.done():
                             req.future.set_exception(ServingClosedError(
                                 "serving engine shut down before "
                                 "dispatch"))
                     continue
-                self._formed.append(batch)
+                self._formed.append((batch, fspan))
                 self._form_busy = False
                 self._cond.notify_all()
         with self._cond:
@@ -428,7 +497,8 @@ class Batcher(object):
                     self._cond.wait()
                 if not self._formed:
                     return  # formation exited, nothing left
-                batch = self._formed.popleft()
+                batch, fspan = self._formed.popleft()
+                fspan.end()
                 self._dispatching = True
                 self._cond.notify_all()  # formation may wait on space
             try:
@@ -487,7 +557,9 @@ class Batcher(object):
                         ServingClosedError("serving engine shut down "
                                            "before dispatch"))
                 while self._formed:
-                    for req in self._formed.popleft():
+                    formed_batch, fspan = self._formed.popleft()
+                    fspan.end(error="ServingClosedError")
+                    for req in formed_batch:
                         if not req.future.done():
                             req.future.set_exception(ServingClosedError(
                                 "serving engine shut down before "

@@ -35,6 +35,7 @@ from ..core.executor import Executor, Scope, scope_guard
 from ..core.framework import convert_dtype
 from ..core.lod import LoDTensor
 from ..core.utils import find_var
+from ..observability import trace as _trace
 from .batcher import Batcher, ServingError
 from .metrics import ServingMetrics
 
@@ -82,10 +83,11 @@ class ResultSlice(object):
     strangers' rows)."""
 
     __slots__ = ("_fetch_names", "_handles", "_row_policy",
-                 "_device_slice", "_lo", "_hi", "_bucket_rows", "bucket")
+                 "_device_slice", "_lo", "_hi", "_bucket_rows", "bucket",
+                 "_trace")
 
     def __init__(self, fetch_names, handles, row_policy, lo, hi,
-                 bucket_rows, bucket, device_slice=True):
+                 bucket_rows, bucket, device_slice=True, trace=None):
         self._fetch_names = fetch_names
         self._handles = handles
         self._row_policy = row_policy  # name -> rows|whole|dynamic
@@ -94,23 +96,27 @@ class ResultSlice(object):
         self._hi = hi
         self._bucket_rows = bucket_rows
         self.bucket = bucket  # (batch_bucket, seq_bucket | None)
+        self._trace = trace   # the request's trace id: the materialize
+        # span records under it, completing the per-request timeline
 
     def numpy(self):
         from .. import profiler as _prof
         _prof.note_sync("serving/materialize")
-        out = {}
-        for name, h in zip(self._fetch_names, self._handles):
-            policy = self._row_policy[name]
-            slice_rows = policy == "rows" or (
-                policy == "dynamic" and h.shape
-                and h.shape[0] == self._bucket_rows)
-            if not slice_rows:
-                out[name] = np.asarray(h.array)
-            elif self._device_slice:
-                out[name] = np.asarray(h.array[self._lo:self._hi])
-            else:
-                out[name] = np.asarray(h.array)[self._lo:self._hi]
-        return out
+        with _trace.span("serving/materialize", cat="serving",
+                         trace=self._trace):
+            out = {}
+            for name, h in zip(self._fetch_names, self._handles):
+                policy = self._row_policy[name]
+                slice_rows = policy == "rows" or (
+                    policy == "dynamic" and h.shape
+                    and h.shape[0] == self._bucket_rows)
+                if not slice_rows:
+                    out[name] = np.asarray(h.array)
+                elif self._device_slice:
+                    out[name] = np.asarray(h.array[self._lo:self._hi])
+                else:
+                    out[name] = np.asarray(h.array)[self._lo:self._hi]
+            return out
 
     def __repr__(self):
         return "ResultSlice(rows=[%d:%d), bucket=%r)" % (
@@ -638,14 +644,24 @@ class InferenceEngine(object):
             tap()
         t0 = time.monotonic()
         normalized = [req.feed for req in requests]  # pre-normalized
+        traces = [getattr(req, "trace", None) for req in requests]
         rows = sum(r.rows for r in normalized)
         batch_bucket, seq_bucket = self._pick_buckets(
             rows, max(r.max_seq_len for r in normalized))
-        feed = self._pad_batch(normalized, batch_bucket, seq_bucket)
-        handles, compiled = self._run(feed)
+        # with-blocks, not manual end(): a raise here is the routine
+        # fail-this-group-not-the-worker path (the _dispatch wrapper
+        # catches it) and must not strand the spans open
+        with _trace.span("serving/pad_h2d", cat="serving",
+                         traces=traces, rows=rows) as psp:
+            feed = self._pad_batch(normalized, batch_bucket, seq_bucket)
+            psp.set(bucket=batch_bucket)
+        with _trace.span("serving/enqueue", cat="serving",
+                         traces=traces, bucket=batch_bucket) as esp:
+            handles, compiled = self._run(feed)
+            esp.set(compiled=compiled)
         now = time.monotonic()
         offset, latencies = 0, []
-        for req, norm in zip(requests, normalized):
+        for req, norm, rtrace in zip(requests, normalized, traces):
             req.future.bucket = (batch_bucket, seq_bucket)
             req.future.latency_s = now - req.enqueued_at
             latencies.append(req.future.latency_s)
@@ -653,7 +669,7 @@ class InferenceEngine(object):
                 self.fetch_names, handles, self._fetch_row_policy,
                 offset, offset + norm.rows, batch_bucket,
                 (batch_bucket, seq_bucket),
-                device_slice=self._device_slice))
+                device_slice=self._device_slice, trace=rtrace))
             offset += norm.rows
         self.metrics.on_batch(len(requests), rows, batch_bucket, latencies)
         from .. import profiler as _prof

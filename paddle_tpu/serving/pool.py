@@ -53,6 +53,7 @@ import time
 
 import numpy as np
 
+from ..observability import trace as _otrace
 from .batcher import (DeadlineExceededError, QueueFullError,
                       RequestTooLargeError, ServingClosedError,
                       ServingError)
@@ -158,12 +159,17 @@ class PoolMetrics(object):
 
     def on_reload(self):
         self._bump("reloads_total")
+        _otrace.instant("pool/reload", cat="serving")
 
     def on_kill(self):
         self._bump("replica_kills_total")
+        _otrace.instant("pool/kill_replica", cat="serving")
 
     def on_eject(self):
         self._bump("ejections_total")
+        # flight-recorder instant (ARCHITECTURE.md §24): breaker trips
+        # land in the same timeline as the dispatch spans they follow
+        _otrace.instant("pool/eject", cat="serving")
 
     def snapshot(self):
         from .metrics import _percentile

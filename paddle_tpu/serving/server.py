@@ -165,13 +165,20 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/metrics":
             from .metrics import render_prometheus_all
+            from ..observability.registry import REGISTRY
             plain, pools = {}, {}
             for name, e in self.registry.items():
                 if hasattr(e, "replica_metrics"):
                     pools[name] = e
                 else:
                     plain[name] = e.metrics
-            text = render_prometheus_all(plain, pools=pools)
+            # one exposition: the serving families + the runtime
+            # registry (windows, batcher queues, host syncs, compile
+            # cache, traces, supervisor/checkpoint/cluster families) —
+            # family names are disjoint by construction
+            # (ARCHITECTURE.md §24), so HELP/TYPE stays once each
+            text = (render_prometheus_all(plain, pools=pools)
+                    + REGISTRY.render_prometheus())
             self._reply(200, text.encode("utf-8"),
                         content_type="text/plain; version=0.0.4")
             return

@@ -467,6 +467,54 @@ def test_bench_transformer_decode_smoke():
     assert rec["value"] > 0
 
 
+def test_bench_obs_smoke():
+    """The BENCH_OBS leg: the always-on flight recorder's overhead gate
+    (ARCHITECTURE.md §24). Recorder on vs off, interleaved rounds with
+    per-leg best, on the millisecond-class smoke trainer and the
+    pipelined serving burst — tracing must cost < 5% on BOTH legs, or
+    "always-on" is a lie. Same best-of-3-attempts discipline as
+    test_bench_resil_smoke: the claim is "tracing CAN run under 5%",
+    and a box-load counterexample is not a counterexample to that.
+    The JSON line must also prove the recorder was actually live
+    (spans_recorded > 0) and that tracing added no dispatch-path host
+    syncs (sync_on_dispatch == 0, read from profiler.snapshot() — the
+    machine-readable surface this PR adds)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_OBS": "1",
+        "BENCH_OBS_ROUNDS": "4",
+        "BENCH_OBS_STEPS": "48",
+        "BENCH_OBS_REQUESTS": "48",
+    })
+    best = None
+    for attempt in range(3):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=900)
+        assert out.returncode == 0, out.stdout + out.stderr
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "observability_overhead"
+        assert rec["unit"] == "steps/sec/chip"
+        assert "error" not in rec
+        assert rec["value"] > 0
+        assert rec["train_sps_on"] > 0 and rec["train_sps_off"] > 0
+        assert rec["serving_p99_on_ms"] > 0
+        # the recorder was live, and stayed sync-free on dispatch paths
+        assert rec["spans_recorded"] > 0
+        assert rec["sync_on_dispatch"] == 0
+        worst = max(rec["train_overhead"], rec["serving_overhead"])
+        if best is None or worst < max(best["train_overhead"],
+                                       best["serving_overhead"]):
+            best = rec
+        if worst < 0.05:
+            break
+    assert best["train_overhead"] < 0.05, best
+    assert best["serving_overhead"] < 0.05, best
+
+
 def test_sweeps_only_set_flags_the_framework_reads():
     """FLAGS_* vars in sweep scripts must exist in paddle_tpu source —
     a typo'd flag would silently run the default configuration and bank

@@ -10,6 +10,15 @@ the event log, and every thread's stack at capture time.
         Human (or JSON) summary: reason, fault class, step, error,
         feed shapes, metrics ring, recovery events, thread stacks.
 
+    tools/ptpu_doctor.py trace <bundle-dir | trace-dump.json>
+            [--out chrome.json] [--last N]
+        Render the flight-recorder timeline a bundle embeds
+        (paddle_tpu.observability.trace, ARCHITECTURE.md §24): the
+        recorded span ring in time order plus every span still OPEN at
+        capture — for a hang bundle, the open spans ARE the answer to
+        "what was the pipeline doing when it wedged". --out writes
+        Chrome trace-event JSON for chrome://tracing / Perfetto.
+
     tools/ptpu_doctor.py replay <bundle-dir> [--fetch NAME ...]
         Re-run the RECORDED failing step offline: load the bundled
         program, put the bundled persistable state into a fresh scope,
@@ -66,6 +75,43 @@ def cmd_inspect(args):
         print("metric:      %s" % m)
     for name in sorted(meta.get("thread_stacks", {})):
         print("thread:      %s" % name)
+    return 0
+
+
+def cmd_trace(args):
+    from paddle_tpu.observability import trace as otrace
+    target = args.bundle
+    data = None
+    if os.path.isdir(target):
+        # a watchdog/supervisor bundle OR a cluster merged bundle —
+        # both carry their recorder dump under "trace" in bundle.json
+        meta_path = os.path.join(target, "bundle.json")
+        if not os.path.exists(meta_path):
+            print("ptpu_doctor: %r has no bundle.json" % target,
+                  file=sys.stderr)
+            return 2
+        with open(meta_path) as f:
+            meta = json.load(f)
+        data = meta.get("trace")
+        if data is None:
+            print("TRACE UNSUPPORTED: bundle predates the flight "
+                  "recorder (no 'trace' key in bundle.json)")
+            return 2
+    else:
+        with open(target) as f:
+            raw = json.load(f)
+        # accept a bundle.json, a bare dump(), or nothing usable
+        data = raw.get("trace") if "trace" in raw else raw
+        if not isinstance(data, dict) or "events" not in data:
+            print("ptpu_doctor: %r carries no recorder dump "
+                  "(want a bundle dir, bundle.json, or a "
+                  "trace.dump() JSON)" % target, file=sys.stderr)
+            return 2
+    if args.out:
+        otrace.export_chrome_trace(args.out, data=data)
+        print("chrome trace written: %s (load in chrome://tracing or "
+              "https://ui.perfetto.dev)" % args.out)
+    print(otrace.render_timeline(data, last=args.last))
     return 0
 
 
@@ -144,6 +190,15 @@ def main(argv=None):
     p.add_argument("bundle")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_inspect)
+    p = sub.add_parser("trace", help="render a bundle's flight-recorder "
+                                     "timeline")
+    p.add_argument("bundle", help="bundle dir, bundle.json, or a "
+                                  "trace dump JSON")
+    p.add_argument("--out", default=None,
+                   help="also write Chrome trace-event JSON here")
+    p.add_argument("--last", default=60, type=int,
+                   help="how many newest events to render (default 60)")
+    p.set_defaults(fn=cmd_trace)
     p = sub.add_parser("replay", help="re-run the recorded failing step")
     p.add_argument("bundle")
     p.add_argument("--fetch", action="append", default=[],

@@ -77,13 +77,71 @@ def cmd_worker(args):
         checkpoint_every=args.checkpoint_every,
         watchdog_timeout=args.watchdog_timeout,
         sharded_weight_update=args.sharded_weight_update,
-        step_delay=args.step_delay)
+        step_delay=args.step_delay,
+        metrics_port=args.metrics_port,
+        metrics_host=args.metrics_host)
     try:
         out = worker.run(args.steps)
     except ClusterAborted as e:
         print("worker %s: %s" % (args.worker_id, e), file=sys.stderr)
         return 1
     print("worker %s finished: %s" % (args.worker_id, out))
+    return 0
+
+
+def cmd_status(args):
+    """The fleet gauge table (ARCHITECTURE.md §24): every worker's
+    heartbeat-derived status — step cursor, generation acked, beat age,
+    steps behind the cohort's front-runner — plus the current plan.
+    Exactly the gauges the observability registry exports as
+    `ptpu_cluster_worker_*` when a worker serves /metrics."""
+    from paddle_tpu.resilience import heartbeat as hb
+    from paddle_tpu.resilience.cluster import read_plan
+    # scale the staleness window to the fleet's own published beat
+    # cadence (heartbeats carry `interval`) — but an operator's
+    # EXPLICIT --heartbeat-timeout always wins, tighter or looser
+    # (default None = auto)
+    if args.heartbeat_timeout is not None:
+        timeout = args.heartbeat_timeout
+    else:
+        intervals = [float(b.get("interval", 0) or 0) for b in
+                     hb.read_heartbeats(args.cluster_dir).values()]
+        timeout = max([3.0] + [3.0 * i for i in intervals])
+    mon = hb.HeartbeatMonitor(args.cluster_dir, timeout=timeout)
+    # ONE derivation, shared with the registry's cluster collector
+    # (HeartbeatMonitor.fleet_view) — this table and the exported
+    # ptpu_cluster_worker_* gauges can never disagree
+    rows = mon.fleet_view()
+    for r in rows:
+        r["beat_age_s"] = round(r["beat_age_s"], 3)
+    plan = read_plan(args.cluster_dir)
+    if args.json:
+        print(json.dumps({
+            "plan": None if plan is None else {
+                "gen": plan.get("gen"), "phase": plan.get("phase"),
+                "num_workers": plan.get("num_workers"),
+                "restore_step": plan.get("restore_step")},
+            "workers": rows}, indent=1, sort_keys=True))
+        return 0
+    if plan is not None:
+        print("plan: gen %s phase %s world=%d restore_step=%s"
+              % (plan.get("gen"), plan.get("phase"),
+                 plan.get("num_workers"), plan.get("restore_step")))
+    else:
+        print("plan: none published yet")
+    if not rows:
+        print("no heartbeats under %s" % args.cluster_dir)
+        return 0
+    hdr = "%-8s %-8s %-6s %6s %7s %5s %6s %9s %8s" % (
+        "WORKER", "STATUS", "ALIVE", "STEP", "BEHIND", "GEN",
+        "ACKED", "BEAT_AGE", "METRICS")
+    print(hdr)
+    for r in rows:
+        print("%-8s %-8s %-6s %6s %7s %5d %6d %7.2fs %8s"
+              % (r["worker"], r["status"], r["alive"], r["step"],
+                 "-" if r["steps_behind"] is None else r["steps_behind"],
+                 r["gen"], r["gen_acked"], r["beat_age_s"],
+                 r["metrics_port"] or "-"))
     return 0
 
 
@@ -97,11 +155,17 @@ class _WorkerPool(object):
         self._next = 0
         self._lock = threading.Lock()
 
-    def _worker_env(self, worker_id, with_fault):
+    def _worker_env(self, worker_id, with_fault, metrics_port=None):
         env = dict(os.environ)
         env["PTPU_CLUSTER_DIR"] = self.args.cluster_dir
         env["PTPU_WORKER_ID"] = worker_id
         env["PTPU_ELASTIC_STEPS"] = str(self.args.steps)
+        if metrics_port is not None:
+            # custom --worker-cmd workers read this env default; the
+            # built-in worker also gets the explicit flag below
+            env["PTPU_METRICS_PORT"] = str(metrics_port)
+        else:
+            env.pop("PTPU_METRICS_PORT", None)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env.setdefault("JAX_PLATFORMS", "cpu")
         if self.args.host_devices:
@@ -116,8 +180,12 @@ class _WorkerPool(object):
 
     def spawn(self, with_fault=False):
         with self._lock:
-            worker_id = "w%d" % self._next
+            idx = self._next
+            worker_id = "w%d" % idx
             self._next += 1
+        metrics_port = None
+        if getattr(self.args, "metrics_port_base", None):
+            metrics_port = int(self.args.metrics_port_base) + idx
         if self.args.worker_cmd:
             cmd = self.args.worker_cmd.split() + [
                 "--cluster-dir", self.args.cluster_dir,
@@ -135,9 +203,12 @@ class _WorkerPool(object):
                 cmd += ["--sharded-weight-update"]
             if self.args.step_delay:
                 cmd += ["--step-delay", str(self.args.step_delay)]
+            if metrics_port is not None:
+                cmd += ["--metrics-port", str(metrics_port)]
         proc = subprocess.Popen(cmd,
-                                env=self._worker_env(worker_id,
-                                                     with_fault))
+                                env=self._worker_env(
+                                    worker_id, with_fault,
+                                    metrics_port=metrics_port))
         self.procs[worker_id] = proc
         # reap immediately on exit: a SIGKILL'd worker must not linger
         # as a zombie pid the heartbeat monitor reads as alive
@@ -243,7 +314,19 @@ def main(argv=None):
     lp.add_argument("--max-replacements", type=int, default=1)
     lp.add_argument("--deadline", type=float, default=None,
                     help="abort the whole run after this many seconds")
+    lp.add_argument("--metrics-port-base", type=int, default=None,
+                    help="serve each worker's /metrics (observability "
+                         "registry incl. fleet gauges) on base+index")
     lp.set_defaults(fn=cmd_launch)
+
+    sp = sub.add_parser("status", help="fleet gauge table from "
+                                       "heartbeats")
+    sp.add_argument("--cluster-dir", required=True)
+    sp.add_argument("--heartbeat-timeout", type=float, default=None,
+                    help="staleness window (default: 3x the fleet's "
+                         "published beat interval, floor 3s)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_status)
 
     wp = sub.add_parser("worker", help="built-in demo worker")
     wp.add_argument("--cluster-dir",
@@ -257,6 +340,16 @@ def main(argv=None):
     wp.add_argument("--watchdog-timeout", type=float, default=None)
     wp.add_argument("--sharded-weight-update", action="store_true")
     wp.add_argument("--step-delay", type=float, default=0.0)
+    wp.add_argument("--metrics-port", type=int,
+                    default=(int(os.environ["PTPU_METRICS_PORT"])
+                             if os.environ.get("PTPU_METRICS_PORT")
+                             else None),
+                    help="serve the observability registry's /metrics "
+                         "on this port (0 = pick free)")
+    wp.add_argument("--metrics-host", default="127.0.0.1",
+                    help="bind address for /metrics (0.0.0.0 for a "
+                         "remote scraper; the heartbeat's host field "
+                         "names the machine)")
     wp.set_defaults(fn=cmd_worker)
 
     args = ap.parse_args(argv)
