@@ -11,3 +11,4 @@ from . import parallel_ops   # noqa: F401
 from . import tail_ops       # noqa: F401
 from . import volumetric_ops  # noqa: F401
 from . import guard_ops      # noqa: F401
+from . import quant_ops      # noqa: F401

@@ -231,10 +231,14 @@ def _layer_norm(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
     lead = int(np.prod(x.shape[:begin]))
-    if scale is not None and bias is not None and _pallas_enabled():
+    if scale is not None and bias is not None and _pallas_enabled("ln"):
         from . import pallas_kernels as pk
+        from .kernel_config import tiles_for
+        d_norm = int(np.prod(x.shape[begin:]))
         y, mean, var = pk.layer_norm(x.reshape(lead, -1), scale.reshape(-1),
-                                     bias.reshape(-1), eps=eps)
+                                     bias.reshape(-1), eps=eps,
+                                     block_n=tiles_for("ln",
+                                                       d_norm)["block_n"])
         return {"Y": [y.reshape(x.shape).astype(x.dtype)],
                 "Mean": [mean], "Variance": [var]}
     x2 = x.reshape(lead, -1).astype(jnp.float32)
@@ -325,28 +329,21 @@ def _cross_entropy(ctx, ins, attrs):
     return {"Y": [loss]}
 
 
-def _pallas_enabled():
-    """Pallas fused-kernel fast paths: default on when running on real TPU,
-    forced with PADDLE_TPU_PALLAS=1, disabled with =0."""
-    import os
-    flag = os.environ.get("PADDLE_TPU_PALLAS", "")
-    if flag in ("0", "false", "False"):
-        return False
-    if flag in ("1", "true", "True"):
-        return True
-    return jax.default_backend() == "tpu"
+def _pallas_enabled(op="xent"):
+    """Per-op pallas gating — delegates to ops.kernel_config.pallas_on,
+    the ONE owner of the PADDLE_TPU_PALLAS parse (0/1 and the
+    per-op allowlist form, e.g. PADDLE_TPU_PALLAS=attn,xent,ln)."""
+    from .kernel_config import pallas_on
+    return pallas_on(op)
 
 
 def _flash_min_seq():
-    """Flash-vs-dense attention dispatch crossover (FLAGS_flash_min_seq;
-    default 1024 from the round-4 v5e measurements — dense wins at 256,
-    flash at 2048). 0 forces flash always. Single owner of the flag read:
-    both the dispatch and trace_env_key() call this."""
-    import os
-    try:
-        return int(os.environ.get("FLAGS_flash_min_seq", "") or 1024)
-    except ValueError:
-        return 1024
+    """Flash-vs-dense attention dispatch crossover — delegates to
+    ops.kernel_config.flash_min_seq (env pin -> tuned store entry ->
+    1024 default). Kept as a name because trace_env_key() historically
+    imported it from here."""
+    from .kernel_config import flash_min_seq
+    return flash_min_seq()
 
 
 @register("softmax_with_cross_entropy")
@@ -354,12 +351,15 @@ def _softmax_xent(ctx, ins, attrs):
     logits = single(ins, "Logits")
     label = single(ins, "Label")
     if not attrs.get("soft_label", False) and logits.ndim == 2 \
-            and _pallas_enabled():
+            and _pallas_enabled("xent"):
         # fused pallas path: loss + logsumexp in one VMEM pass, softmax
         # never materialized in the forward (the dense Softmax slot below
         # is DCE'd by XLA unless the program actually consumes it)
         from . import pallas_kernels as pk
-        loss = pk.softmax_xent(logits, label.reshape(-1))
+        from .kernel_config import tiles_for
+        loss = pk.softmax_xent(
+            logits, label.reshape(-1),
+            block_n=tiles_for("xent", logits.shape[-1])["block_n"])
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return {"Softmax": [jnp.exp(logp).astype(logits.dtype)],
                 "Loss": [loss.astype(logits.dtype)]}
@@ -404,21 +404,28 @@ def _fused_attention(ctx, ins, attrs):
     # Per-shape dispatch (round-4 measurements, real v5e: dense XLA
     # attention beat the flash kernel at T=256 — 130.0k vs 102.0k tok/s —
     # while flash was 12.1x dense at T=2048): short sequences take the
-    # dense einsum path, long ones the pallas kernel. Crossover default
-    # 1024; override with FLAGS_flash_min_seq (0 forces flash always —
-    # used by kernel-coverage tests and the block-tune sweep).
+    # dense einsum path, long ones the pallas kernel. Crossover from
+    # kernel_config.flash_min_seq (FLAGS_flash_min_seq pin -> tuned
+    # store entry -> 1024 default; 0 forces flash always — used by
+    # kernel-coverage tests and the block-tune sweep). An explicit
+    # PADDLE_TPU_PALLAS opt-out (=0, or an allowlist without 'attn')
+    # forces the dense path regardless of length.
+    from .kernel_config import pallas_explicit, tiles_for
     min_seq = _flash_min_seq()
     t = q.shape[1]
-    if t is not None and t < min_seq:
+    if pallas_explicit("attn") is False or (t is not None and t < min_seq):
         from ..parallel.ring_attention import attention_reference
         return _out(attention_reference(
             q, k, v, causal=causal, scale=scale,
             kv_len=kv_len).astype(q.dtype))
     from . import pallas_kernels as pk
+    # explicit layer attrs pin the tiles; otherwise the per-shape tuned
+    # table (defaults = the old 128/128 literals) decides
+    tiles = tiles_for("attn", t if t else 128)
     out = pk.flash_attention(
         q, k, v, causal=causal, scale=scale, kv_len=kv_len,
-        block_q=attrs.get("block_q", 128),
-        block_k=attrs.get("block_k", 128))
+        block_q=attrs.get("block_q") or tiles["block_q"],
+        block_k=attrs.get("block_k") or tiles["block_k"])
     return _out(out)
 
 

@@ -37,6 +37,7 @@ except Exception:  # pragma: no cover - pallas tpu backend unavailable
     _VMEM = None
 
 __all__ = ["flash_attention", "softmax_xent", "layer_norm",
+           "fused_lstm", "fused_lstmp", "masked_softmax", "masked_pool",
            "attention_available"]
 
 _NEG = -1e30
@@ -514,6 +515,512 @@ def _ln_core_bwd(eps, block_n, interpret, res, g):
 
 
 _ln_core.defvjp(_ln_core_fwd, _ln_core_bwd)
+
+
+def _pad_rows(a, rows):
+    if a.shape[0] == rows:
+        return a
+    return jnp.pad(a, [(0, rows - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
+
+
+def _resolve_block_b(b, block_b):
+    """(block, padded_b) for a batch-blocked kernel. block_b=0 (the
+    default-table value) = the whole batch in one block; both forms pad
+    b up to a multiple of 8 (the f32 sublane tile)."""
+    if block_b and int(block_b) > 0:
+        blk = max(8, int(block_b))
+    else:
+        blk = int(-(-b // 8) * 8)
+    return blk, int(-(-b // blk) * blk)
+
+
+# ---------------------------------------------------------------------------
+# fused LSTM recurrence (reference: lstm_op.cc / lstmp_op.cc — a host loop
+# calling cuBLAS per step; here ONE pallas kernel walks the whole sequence:
+# grid (batch-block, T), carried (h, c) state resident in VMEM scratch, the
+# four gates + state update one VMEM pass per step, @SEQLEN-masked carries)
+# ---------------------------------------------------------------------------
+
+def _lstm_seq_kernel(x_ref, m_ref, w_ref, b_ref, h0_ref, c0_ref,
+                     h_out, c_out, h_scr, c_scr, *, d):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    h_prev = h_scr[:]
+    c_prev = c_scr[:]
+    xt = x_ref[0].astype(jnp.float32)                       # [bb, 4D]
+    gates = xt + jnp.dot(h_prev, w_ref[:],
+                         preferred_element_type=jnp.float32) + b_ref[0]
+    # reference gate order lstm_op.cc:125 {W_ch, W_ih, W_fh, W_oh}:
+    # candidate block FIRST
+    z = jnp.tanh(gates[:, :d])
+    i = jax.nn.sigmoid(gates[:, d:2 * d])
+    f = jax.nn.sigmoid(gates[:, 2 * d:3 * d])
+    o = jax.nn.sigmoid(gates[:, 3 * d:])
+    c_new = f * c_prev + i * z
+    h_new = o * jnp.tanh(c_new)
+    mt = m_ref[0]                                           # [bb, 1]
+    h = mt * h_new + (1 - mt) * h_prev
+    c = mt * c_new + (1 - mt) * c_prev
+    h_scr[:] = h
+    c_scr[:] = c
+    h_out[0] = h.astype(h_out.dtype)
+    c_out[0] = c.astype(c_out.dtype)
+
+
+def _lstm_fwd_call(xs, ms, w, b, h0, c0, block_b, interpret):
+    """xs [T, B, 4D] f32, ms [T, B, 1], w [D, 4D], b [4D], h0/c0 [B, D]
+    -> (hs, cs) [T, B, D]."""
+    if pltpu is None:  # pragma: no cover - VMEM scratch needs the backend
+        raise RuntimeError("fused_lstm needs the pallas TPU backend "
+                           "(guard dispatch on attention_available())")
+    t, bsz, four_d = xs.shape
+    d = four_d // 4
+    blk, b_pad = _resolve_block_b(bsz, block_b)
+    if b_pad != bsz:
+        xs = jnp.pad(xs, [(0, 0), (0, b_pad - bsz), (0, 0)])
+        ms = jnp.pad(ms, [(0, 0), (0, b_pad - bsz), (0, 0)])
+        h0 = _pad_rows(h0, b_pad)
+        c0 = _pad_rows(c0, b_pad)
+    hs, cs = pl.pallas_call(
+        functools.partial(_lstm_seq_kernel, d=d),
+        # batch blocks on the MAJOR grid axis: each block walks its
+        # full time loop before the next block reuses the state scratch
+        grid=(b_pad // blk, t),
+        in_specs=[
+            _vmem_spec((1, blk, four_d), lambda bb, i: (i, bb, 0)),
+            _vmem_spec((1, blk, 1), lambda bb, i: (i, bb, 0)),
+            _vmem_spec((d, four_d), lambda bb, i: (0, 0)),
+            _vmem_spec((1, four_d), lambda bb, i: (0, 0)),
+            _vmem_spec((blk, d), lambda bb, i: (bb, 0)),
+            _vmem_spec((blk, d), lambda bb, i: (bb, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, blk, d), lambda bb, i: (i, bb, 0)),
+            _vmem_spec((1, blk, d), lambda bb, i: (i, bb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((t, b_pad, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk, d), jnp.float32),
+            pltpu.VMEM((blk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xs, ms, w, b.reshape(1, -1), h0, c0)
+    return hs[:, :bsz], cs[:, :bsz]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _lstm_seq_core(xs, ms, w, b, h0, c0, block_b, interpret):
+    hs, cs = _lstm_fwd_call(xs, ms, w, b, h0, c0, block_b, interpret)
+    return hs, cs
+
+
+def _lstm_seq_core_fwd(xs, ms, w, b, h0, c0, block_b, interpret):
+    hs, cs = _lstm_fwd_call(xs, ms, w, b, h0, c0, block_b, interpret)
+    return (hs, cs), (xs, ms, w, b, h0, c0, hs, cs)
+
+
+def _lstm_seq_core_bwd(block_b, interpret, res, g):
+    """Exact reverse-mode through the recurrence from the SAVED states
+    (no forward recompute): one reverse scan, each step re-deriving the
+    gates from (h_{t-1}, c_{t-1}) with one matmul, then the standard
+    LSTM chain rule. Matches jax.grad of the unfused lax.scan path
+    (regression-tested)."""
+    xs, ms, w, b, h0, c0, hs, cs = res
+    ghs, gcs = g
+    d = w.shape[0]
+    h_prevs = jnp.concatenate([h0[None], hs[:-1]], axis=0)   # [T, B, D]
+    c_prevs = jnp.concatenate([c0[None], cs[:-1]], axis=0)
+
+    def step(carry, inp):
+        dh_c, dc_c, dw, db = carry
+        xt, mt, h_prev, c_prev, gh, gc_out = inp
+        dh = dh_c + gh
+        dc = dc_c + gc_out
+        gates = xt + h_prev @ w + b
+        z = jnp.tanh(gates[:, :d])
+        i = jax.nn.sigmoid(gates[:, d:2 * d])
+        f = jax.nn.sigmoid(gates[:, 2 * d:3 * d])
+        o = jax.nn.sigmoid(gates[:, 3 * d:])
+        c_new = f * c_prev + i * z
+        tc = jnp.tanh(c_new)
+        dh_new = dh * mt
+        dc_new = dc * mt + dh_new * o * (1 - tc * tc)
+        dgo = dh_new * tc * o * (1 - o)
+        dgf = dc_new * c_prev * f * (1 - f)
+        dgi = dc_new * z * i * (1 - i)
+        dgc = dc_new * i * (1 - z * z)
+        dg = jnp.concatenate([dgc, dgi, dgf, dgo], axis=-1)  # [B, 4D]
+        dw = dw + h_prev.T @ dg
+        db = db + jnp.sum(dg, axis=0)
+        dh_prev = dg @ w.T + dh * (1 - mt)
+        dc_prev = dc_new * f + dc * (1 - mt)
+        return (dh_prev, dc_prev, dw, db), dg
+
+    init = (jnp.zeros_like(h0), jnp.zeros_like(c0),
+            jnp.zeros_like(w), jnp.zeros_like(b))
+    (dh0, dc0, dw, db), dxs = lax.scan(
+        step, init, (xs, ms, h_prevs, c_prevs, ghs, gcs), reverse=True)
+    return dxs, jnp.zeros_like(ms), dw, db, dh0, dc0
+
+
+_lstm_seq_core.defvjp(_lstm_seq_core_fwd, _lstm_seq_core_bwd)
+
+
+def fused_lstm(x, w, gate_bias, h0, c0, xlen, reverse=False, block_b=0,
+               interpret=None):
+    """Fused-gate dynamic LSTM over the padded-dense layout: x [B, T, 4D]
+    (pre-projected gate inputs), w [D, 4D] recurrent weight, gate_bias
+    [4D]; returns (hidden, cell) [B, T, D] in x's dtype. Default
+    activations only (sigmoid gates, tanh candidate/cell — the
+    dispatching op falls back to the lax.scan path otherwise), @SEQLEN
+    masking via xlen [B] (padding steps carry state through),
+    differentiable (custom_vjp; saved-state reverse scan backward), and
+    runs the same kernel in interpret mode off-TPU."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, t, four_d = x.shape
+    d = four_d // 4
+    xs = jnp.swapaxes(x, 0, 1).astype(jnp.float32)           # [T, B, 4D]
+    lens = jnp.asarray(xlen, jnp.int32)
+    mask = (lax.broadcasted_iota(jnp.int32, (t, b), 0)
+            < lens[None, :]).astype(jnp.float32)[:, :, None]  # [T, B, 1]
+    if reverse:
+        xs = xs[::-1]
+        mask = mask[::-1]
+    h0 = jnp.zeros((b, d), jnp.float32) if h0 is None \
+        else h0.astype(jnp.float32)
+    c0 = jnp.zeros((b, d), jnp.float32) if c0 is None \
+        else c0.astype(jnp.float32)
+    hs, cs = _lstm_seq_core(xs, mask, w.astype(jnp.float32),
+                            gate_bias.reshape(-1).astype(jnp.float32),
+                            h0, c0, int(block_b), bool(interpret))
+    if reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return (jnp.swapaxes(hs, 0, 1).astype(x.dtype),
+            jnp.swapaxes(cs, 0, 1).astype(x.dtype))
+
+
+# --- lstmp: recurrent projection (the [B, P] projected state feeds the
+# next step's gate matmul; see ops/sequence_ops._lstmp for the layout) ---
+
+def _lstmp_seq_kernel(x_ref, m_ref, w_ref, wp_ref, b_ref, r0_ref, c0_ref,
+                      r_out, c_out, r_scr, c_scr, *, d):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        r_scr[:] = r0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    r_prev = r_scr[:]
+    c_prev = c_scr[:]
+    xt = x_ref[0].astype(jnp.float32)                       # [bb, 4D]
+    gates = xt + jnp.dot(r_prev, w_ref[:],
+                         preferred_element_type=jnp.float32) + b_ref[0]
+    z = jnp.tanh(gates[:, :d])
+    i = jax.nn.sigmoid(gates[:, d:2 * d])
+    f = jax.nn.sigmoid(gates[:, 2 * d:3 * d])
+    o = jax.nn.sigmoid(gates[:, 3 * d:])
+    c_new = f * c_prev + i * z
+    h_new = o * jnp.tanh(c_new)
+    r_new = jnp.tanh(jnp.dot(h_new, wp_ref[:],
+                             preferred_element_type=jnp.float32))
+    mt = m_ref[0]
+    r = mt * r_new + (1 - mt) * r_prev
+    c = mt * c_new + (1 - mt) * c_prev
+    r_scr[:] = r
+    c_scr[:] = c
+    r_out[0] = r.astype(r_out.dtype)
+    c_out[0] = c.astype(c_out.dtype)
+
+
+def _lstmp_fwd_call(xs, ms, w, w_proj, b, r0, c0, block_b, interpret):
+    if pltpu is None:  # pragma: no cover - VMEM scratch needs the backend
+        raise RuntimeError("fused_lstmp needs the pallas TPU backend "
+                           "(guard dispatch on attention_available())")
+    t, bsz, four_d = xs.shape
+    d = four_d // 4
+    p = w_proj.shape[1]
+    blk, b_pad = _resolve_block_b(bsz, block_b)
+    if b_pad != bsz:
+        xs = jnp.pad(xs, [(0, 0), (0, b_pad - bsz), (0, 0)])
+        ms = jnp.pad(ms, [(0, 0), (0, b_pad - bsz), (0, 0)])
+        r0 = _pad_rows(r0, b_pad)
+        c0 = _pad_rows(c0, b_pad)
+    rs, cs = pl.pallas_call(
+        functools.partial(_lstmp_seq_kernel, d=d),
+        grid=(b_pad // blk, t),
+        in_specs=[
+            _vmem_spec((1, blk, four_d), lambda bb, i: (i, bb, 0)),
+            _vmem_spec((1, blk, 1), lambda bb, i: (i, bb, 0)),
+            _vmem_spec((p, four_d), lambda bb, i: (0, 0)),
+            _vmem_spec((d, p), lambda bb, i: (0, 0)),
+            _vmem_spec((1, four_d), lambda bb, i: (0, 0)),
+            _vmem_spec((blk, p), lambda bb, i: (bb, 0)),
+            _vmem_spec((blk, d), lambda bb, i: (bb, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, blk, p), lambda bb, i: (i, bb, 0)),
+            _vmem_spec((1, blk, d), lambda bb, i: (i, bb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b_pad, p), jnp.float32),
+            jax.ShapeDtypeStruct((t, b_pad, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk, p), jnp.float32),
+            pltpu.VMEM((blk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xs, ms, w, w_proj, b.reshape(1, -1), r0, c0)
+    return rs[:, :bsz], cs[:, :bsz]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def _lstmp_seq_core(xs, ms, w, w_proj, b, r0, c0, block_b, interpret):
+    return _lstmp_fwd_call(xs, ms, w, w_proj, b, r0, c0, block_b,
+                           interpret)
+
+
+def _lstmp_seq_core_fwd(xs, ms, w, w_proj, b, r0, c0, block_b, interpret):
+    rs, cs = _lstmp_fwd_call(xs, ms, w, w_proj, b, r0, c0, block_b,
+                             interpret)
+    return (rs, cs), (xs, ms, w, w_proj, b, r0, c0, rs, cs)
+
+
+def _lstmp_seq_core_bwd(block_b, interpret, res, g):
+    xs, ms, w, w_proj, b, r0, c0, rs, cs = res
+    grs, gcs = g
+    d = w_proj.shape[0]
+    r_prevs = jnp.concatenate([r0[None], rs[:-1]], axis=0)
+    c_prevs = jnp.concatenate([c0[None], cs[:-1]], axis=0)
+
+    def step(carry, inp):
+        dr_c, dc_c, dw, dwp, db = carry
+        xt, mt, r_prev, c_prev, gr, gc_out = inp
+        dr = dr_c + gr
+        dc = dc_c + gc_out
+        gates = xt + r_prev @ w + b
+        z = jnp.tanh(gates[:, :d])
+        i = jax.nn.sigmoid(gates[:, d:2 * d])
+        f = jax.nn.sigmoid(gates[:, 2 * d:3 * d])
+        o = jax.nn.sigmoid(gates[:, 3 * d:])
+        c_new = f * c_prev + i * z
+        tc = jnp.tanh(c_new)
+        h_new = o * tc
+        r_new = jnp.tanh(h_new @ w_proj)
+        dr_new = dr * mt
+        dproj = dr_new * (1 - r_new * r_new)                 # [B, P]
+        dh_new = dproj @ w_proj.T
+        dwp = dwp + h_new.T @ dproj
+        dc_new = dc * mt + dh_new * o * (1 - tc * tc)
+        dgo = dh_new * tc * o * (1 - o)
+        dgf = dc_new * c_prev * f * (1 - f)
+        dgi = dc_new * z * i * (1 - i)
+        dgc = dc_new * i * (1 - z * z)
+        dg = jnp.concatenate([dgc, dgi, dgf, dgo], axis=-1)
+        dw = dw + r_prev.T @ dg
+        db = db + jnp.sum(dg, axis=0)
+        dr_prev = dg @ w.T + dr * (1 - mt)
+        dc_prev = dc_new * f + dc * (1 - mt)
+        return (dr_prev, dc_prev, dw, dwp, db), dg
+
+    init = (jnp.zeros_like(r0), jnp.zeros_like(c0), jnp.zeros_like(w),
+            jnp.zeros_like(w_proj), jnp.zeros_like(b))
+    (dr0, dc0, dw, dwp, db), dxs = lax.scan(
+        step, init, (xs, ms, r_prevs, c_prevs, grs, gcs), reverse=True)
+    return dxs, jnp.zeros_like(ms), dw, dwp, db, dr0, dc0
+
+
+_lstmp_seq_core.defvjp(_lstmp_seq_core_fwd, _lstmp_seq_core_bwd)
+
+
+def fused_lstmp(x, w, w_proj, gate_bias, r0, c0, xlen, reverse=False,
+                block_b=0, interpret=None):
+    """Fused LSTMP (recurrent projection): x [B, T, 4D], w [P, 4D],
+    w_proj [D, P], r0 [B, P] the PROJECTED initial state (the caller
+    projects h0 — its grads flow through that projection's own vjp),
+    c0 [B, D]. Returns (projection, cell) = ([B, T, P], [B, T, D]).
+    Default activations only, like fused_lstm."""
+    if interpret is None:
+        interpret = _interpret_default()
+    b, t, four_d = x.shape
+    d = w_proj.shape[0]
+    xs = jnp.swapaxes(x, 0, 1).astype(jnp.float32)
+    lens = jnp.asarray(xlen, jnp.int32)
+    mask = (lax.broadcasted_iota(jnp.int32, (t, b), 0)
+            < lens[None, :]).astype(jnp.float32)[:, :, None]
+    if reverse:
+        xs = xs[::-1]
+        mask = mask[::-1]
+    c0 = jnp.zeros((b, d), jnp.float32) if c0 is None \
+        else c0.astype(jnp.float32)
+    rs, cs = _lstmp_seq_core(xs, mask, w.astype(jnp.float32),
+                             w_proj.astype(jnp.float32),
+                             gate_bias.reshape(-1).astype(jnp.float32),
+                             r0.astype(jnp.float32), c0, int(block_b),
+                             bool(interpret))
+    if reverse:
+        rs, cs = rs[::-1], cs[::-1]
+    return (jnp.swapaxes(rs, 0, 1).astype(x.dtype),
+            jnp.swapaxes(cs, 0, 1).astype(x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# masked sequence softmax / pool (the @SEQLEN-dominated sequence ops: one
+# VMEM pass computes mask + reduce + normalize per row block, instead of
+# the where/softmax/mul chain XLA materializes between HBM round-trips)
+# ---------------------------------------------------------------------------
+
+def _masked_softmax_kernel(x_ref, len_ref, y_ref):
+    x = x_ref[:].astype(jnp.float32)                         # [bn, T]
+    lens = len_ref[:]                                        # [bn, 1] int32
+    cols = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    valid = cols < lens
+    s = jnp.where(valid, x, _NEG)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.where(valid, jnp.exp(s - m), 0.0)
+    denom = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    y_ref[:] = (p / denom).astype(y_ref.dtype)
+
+
+def _masked_softmax_call(x, lens, block_n, interpret):
+    n, t = x.shape
+    n_pad = int(-(-n // block_n) * block_n)
+    xp = _pad_rows(x, n_pad)
+    lp = _pad_rows(lens.reshape(-1, 1).astype(jnp.int32), n_pad)
+    y = pl.pallas_call(
+        _masked_softmax_kernel,
+        grid=(n_pad // block_n,),
+        in_specs=[
+            _vmem_spec((block_n, t), lambda i: (i, 0)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
+        ],
+        out_specs=_vmem_spec((block_n, t), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, t), x.dtype),
+        interpret=interpret,
+    )(xp, lp)
+    return y[:n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _masked_softmax_core(x, lens, block_n, interpret):
+    return _masked_softmax_call(x, lens, block_n, interpret)
+
+
+def _masked_softmax_core_fwd(x, lens, block_n, interpret):
+    y = _masked_softmax_call(x, lens, block_n, interpret)
+    return y, y
+
+
+def _masked_softmax_core_bwd(block_n, interpret, y, g):
+    yf = y.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    dx = yf * (gf - jnp.sum(gf * yf, axis=-1, keepdims=True))
+    return dx.astype(y.dtype), None
+
+
+_masked_softmax_core.defvjp(_masked_softmax_core_fwd,
+                            _masked_softmax_core_bwd)
+
+
+def masked_softmax(x, xlen, block_n=8, interpret=None):
+    """Sequence softmax over the time dim of x [B, T] with true lengths
+    xlen [B]: positions >= xlen contribute nothing and get 0. One VMEM
+    pass per row block; differentiable (custom_vjp from the saved
+    output — masked positions have y == 0, so their grads vanish
+    exactly like the unfused where-mask path)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    return _masked_softmax_core(x, jnp.asarray(xlen, jnp.int32),
+                                int(block_n), bool(interpret))
+
+
+def _masked_pool_kernel(x_ref, len_ref, o_ref, *, ptype):
+    x = x_ref[:].astype(jnp.float32)                         # [bn, T, F]
+    lens = len_ref[:]                                        # [bn, 1]
+    cols = lax.broadcasted_iota(jnp.int32, x.shape[:2], 1)
+    m = (cols < lens).astype(jnp.float32)[:, :, None]        # [bn, T, 1]
+    s = jnp.sum(x * m, axis=1)                               # [bn, F]
+    denom = jnp.maximum(lens.astype(jnp.float32), 1.0)       # [bn, 1]
+    if ptype == "AVERAGE":
+        s = s / denom
+    elif ptype == "SQRT":
+        s = s / jnp.sqrt(denom)
+    o_ref[:] = s.astype(o_ref.dtype)
+
+
+def _masked_pool_call(x, lens, ptype, block_n, interpret):
+    n, t, f = x.shape
+    n_pad = int(-(-n // block_n) * block_n)
+    xp = _pad_rows(x, n_pad)
+    lp = _pad_rows(lens.reshape(-1, 1).astype(jnp.int32), n_pad)
+    out = pl.pallas_call(
+        functools.partial(_masked_pool_kernel, ptype=ptype),
+        grid=(n_pad // block_n,),
+        in_specs=[
+            _vmem_spec((block_n, t, f), lambda i: (i, 0, 0)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
+        ],
+        out_specs=_vmem_spec((block_n, f), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, f), x.dtype),
+        interpret=interpret,
+    )(xp, lp)
+    return out[:n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _masked_pool_core(x, lens, ptype, block_n, interpret):
+    return _masked_pool_call(x, lens, ptype, block_n, interpret)
+
+
+def _masked_pool_core_fwd(x, lens, ptype, block_n, interpret):
+    out = _masked_pool_call(x, lens, ptype, block_n, interpret)
+    # residuals must be jax values: a 0-size sentinel carries x's
+    # shape[1:]/dtype (the layer_norm kernel's bias trick)
+    return out, (lens, jnp.zeros((0,) + x.shape[1:], x.dtype))
+
+
+def _masked_pool_core_bwd(ptype, block_n, interpret, res, g):
+    lens, x_like = res
+    t = x_like.shape[1]
+    n = lens.shape[0]
+    x_dtype = x_like.dtype
+    m = (lax.broadcasted_iota(jnp.int32, (n, t), 1)
+         < lens.reshape(-1, 1)).astype(jnp.float32)[:, :, None]
+    gf = g.astype(jnp.float32)[:, None, :]                   # [N, 1, F]
+    if ptype == "AVERAGE":
+        gf = gf / jnp.maximum(lens.astype(jnp.float32), 1.0
+                              ).reshape(-1, 1, 1)
+    elif ptype == "SQRT":
+        gf = gf / jnp.sqrt(jnp.maximum(lens.astype(jnp.float32), 1.0)
+                           ).reshape(-1, 1, 1)
+    return (gf * m).astype(x_dtype), None
+
+
+_masked_pool_core.defvjp(_masked_pool_core_fwd, _masked_pool_core_bwd)
+
+
+def masked_pool(x, xlen, ptype="AVERAGE", block_n=8, interpret=None):
+    """Masked sequence pool over the time dim of x [B, T, F]:
+    SUM / AVERAGE / SQRT (the linear pools — MAX/LAST/FIRST keep the
+    dense path, their grads are selection-shaped). Returns [B, F];
+    differentiable (custom_vjp, exact: the pools are linear in x)."""
+    if ptype not in ("SUM", "AVERAGE", "SQRT"):
+        raise ValueError("masked_pool handles SUM/AVERAGE/SQRT, got %r"
+                         % (ptype,))
+    if interpret is None:
+        interpret = _interpret_default()
+    return _masked_pool_core(x, jnp.asarray(xlen, jnp.int32), str(ptype),
+                             int(block_n), bool(interpret))
 
 
 def layer_norm(x, scale, bias, eps=1e-5, block_n=8, interpret=None):

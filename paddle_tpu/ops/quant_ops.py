@@ -1,0 +1,27 @@
+"""Quantization ops (TPU-native addition — the 2018 reference served
+fp32 only; this is the weight-only quantized serving path behind
+`InferenceEngine(weights_dtype=...)`, see serving/quantize.py).
+"""
+import jax.numpy as jnp
+
+from ..core.registry import register, single
+
+
+@register("dequantize_channel")
+def _dequantize_channel(ctx, ins, attrs):
+    """int8 per-channel weight dequantize: Out = X.astype(f32) * Scale
+    broadcast along `axis`. Inserted by serving.quantize in front of
+    each quantized matmul/conv param; XLA fuses the multiply into the
+    consumer, so the weight lives in HBM at 1/4 size and is widened
+    on the way into the MXU. The op is the whole runtime contract of
+    int8 serving: compute stays f32, only the weight's storage (and
+    its rounding, bounded by the per-channel scale) changes."""
+    q = single(ins, "X")          # int8 [param shape]
+    scale = single(ins, "Scale")  # f32 [C]
+    axis = attrs.get("axis", -1)
+    if axis < 0:
+        axis += q.ndim
+    bshape = [1] * q.ndim
+    bshape[axis] = q.shape[axis]
+    out = q.astype(jnp.float32) * scale.reshape(bshape)
+    return {"Out": [out]}

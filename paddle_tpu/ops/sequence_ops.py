@@ -26,6 +26,15 @@ def _mask(xlen, max_len, dtype=jnp.float32):
     return (t[None, :] < xlen.astype(jnp.int32)[:, None]).astype(dtype)
 
 
+def _seq_pallas_on(op):
+    """Pallas fast-path gate for the sequence ops (kernel_config owns
+    the flag parse; the kernels need the pallas TPU package importable
+    even for interpret mode)."""
+    from . import pallas_kernels as pk
+    from .kernel_config import pallas_on
+    return pk.attention_available() and pallas_on(op)
+
+
 def _feat_mask(x, xlen):
     """mask broadcastable over x's feature dims."""
     m = _mask(xlen, x.shape[1], x.dtype)
@@ -37,6 +46,23 @@ def _sequence_pool(ctx, ins, attrs):
     x = single(ins, "X")          # [B, T, ...]
     xlen = single(ins, "XLen")    # [B]
     ptype = attrs.get("pooltype", "AVERAGE").upper()
+    # fused path gates on f32 like the LSTM kernel: the kernel computes
+    # in f32, so an int accumulation (exact in the dense path) or a
+    # bf16 input must not silently change numerics under the flag
+    if ptype in ("SUM", "AVERAGE", "SQRT") and x.ndim >= 2 \
+            and x.dtype == jnp.float32 and _seq_pallas_on("seq"):
+        # fused masked pool: one VMEM pass builds the @SEQLEN mask and
+        # reduces (linear pools only — MAX/LAST/FIRST keep the dense
+        # path). Feature dims flatten to one trailing axis.
+        from . import pallas_kernels as pk
+        from .kernel_config import tiles_for
+        b, t = x.shape[:2]
+        feat = x.shape[2:]
+        f = int(np.prod(feat)) if feat else 1
+        out = pk.masked_pool(
+            x.reshape(b, t, f), xlen, ptype=ptype,
+            block_n=tiles_for("seq", t)["block_n"]).reshape((b,) + feat)
+        return {"Out": [out.astype(x.dtype)]}
     m = _feat_mask(x, xlen)
     denom = jnp.maximum(xlen.astype(x.dtype), 1).reshape(
         (-1,) + (1,) * (x.ndim - 2))
@@ -78,6 +104,19 @@ def _sequence_softmax(ctx, ins, attrs):
     xlen = single(ins, "XLen")
     squeeze = x.ndim == 3 and x.shape[-1] == 1
     logits = x.reshape(x.shape[0], x.shape[1]) if squeeze else x
+    if logits.ndim == 2 and logits.dtype == jnp.float32 \
+            and _seq_pallas_on("seq"):
+        # fused masked softmax: mask + online max + normalize in one
+        # VMEM pass per row block (bit-exact vs the where-mask path:
+        # masked lanes underflow exp to exactly 0 either way)
+        from . import pallas_kernels as pk
+        from .kernel_config import tiles_for
+        out = pk.masked_softmax(
+            logits, xlen,
+            block_n=tiles_for("seq", logits.shape[1])["block_n"])
+        if squeeze:
+            out = out.reshape(x.shape)
+        return {"Out": [out.astype(x.dtype)]}
     m = _mask(xlen, logits.shape[1], logits.dtype)
     neg = jnp.asarray(-1e30, logits.dtype)
     out = jax.nn.softmax(jnp.where(m > 0, logits, neg), axis=1) * m
@@ -310,6 +349,23 @@ def _lstm(ctx, ins, attrs):
     cand_act = _lstm_act(attrs.get("candidate_activation", "tanh"))
     is_rev = attrs.get("is_reverse", False)
 
+    if (not use_peep and x.dtype == jnp.float32
+            and not getattr(ctx, "amp", False)
+            and attrs.get("gate_activation", "sigmoid") == "sigmoid"
+            and attrs.get("cell_activation", "tanh") == "tanh"
+            and attrs.get("candidate_activation", "tanh") == "tanh"
+            and _seq_pallas_on("lstm")):
+        # fused pallas recurrence (default activations, no peepholes —
+        # the long tail keeps the scan): four gates + state update in
+        # one VMEM pass per step, carried state resident in VMEM
+        from . import pallas_kernels as pk
+        from .kernel_config import tiles_for
+        hidden, cell = pk.fused_lstm(
+            x, w, bias.reshape(-1)[:4 * d], h0, c0, xlen,
+            reverse=is_rev, block_b=tiles_for("lstm", d)["block_b"])
+        return {"Hidden": [hidden], "Cell": [cell],
+                "BatchGate": [x], "BatchCellPreAct": [cell]}
+
     state_dt, rmat2 = _amp_recurrence(ctx, x.dtype)
     rmat = lambda h: rmat2(h, w)
 
@@ -388,6 +444,27 @@ def _lstmp(ctx, ins, attrs):
     cand_act = _lstm_act(attrs.get("candidate_activation", "tanh"))
     pact = _lstm_act(attrs.get("proj_activation", "tanh"))
     is_rev = attrs.get("is_reverse", False)
+
+    if (not use_peep and x.dtype == jnp.float32
+            and not getattr(ctx, "amp", False)
+            and attrs.get("gate_activation", "sigmoid") == "sigmoid"
+            and attrs.get("cell_activation", "tanh") == "tanh"
+            and attrs.get("candidate_activation", "tanh") == "tanh"
+            and attrs.get("proj_activation", "tanh") == "tanh"
+            and _seq_pallas_on("lstm")):
+        from . import pallas_kernels as pk
+        from .kernel_config import tiles_for
+        if h0 is not None:
+            r0 = jnp.tanh(h0.astype(jnp.float32) @
+                          w_proj.astype(jnp.float32))
+        else:
+            r0 = jnp.zeros((b, p), jnp.float32)
+        proj, cell = pk.fused_lstmp(
+            x, w, w_proj, bias.reshape(-1)[:4 * d], r0, c0, xlen,
+            reverse=is_rev, block_b=tiles_for("lstm", d)["block_b"])
+        return {"Projection": [proj], "Cell": [cell],
+                "BatchGate": [x], "BatchCellPreAct": [cell],
+                "BatchHidden": [cell], "OrderedP0": [r0.astype(x.dtype)]}
 
     state_dt, rmat2 = _amp_recurrence(ctx, x.dtype)
 

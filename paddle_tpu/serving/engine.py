@@ -151,7 +151,8 @@ class InferenceEngine(object):
                  max_queue_delay_ms=None, queue_capacity=256,
                  default_deadline_ms=None, validate=True, warmup=True,
                  latency_window=2048, apply_tuned=False,
-                 pipeline_depth=None, tp=None, mesh_devices=None):
+                 pipeline_depth=None, tp=None, mesh_devices=None,
+                 weights_dtype=None):
         from ..places import CPUPlace
         self.name = name or (os.path.basename(os.path.normpath(model_dir))
                              if model_dir else "model")
@@ -213,6 +214,27 @@ class InferenceEngine(object):
             analysis.validate_or_raise(self.program,
                                        feed_names=self.feed_names,
                                        fetch_names=self.fetch_names)
+
+        # weight-dtype reduction (ARCHITECTURE.md §25 / serving/
+        # quantize.py): bf16 halves weight HBM + runs the MXU ops bf16;
+        # int8 stores matmul/conv weights quantized per channel behind
+        # an in-graph dequantize. Applied to the loaded scope before
+        # the first trace; fp32 master checkpoints/exports untouched.
+        self.quantize_report = None
+        self._set_weights_dtype(weights_dtype)
+        if model_dir is not None:
+            # params are in the scope already (loaded above)
+            self._apply_weights_dtype()
+        elif self.weights_dtype != "fp32":
+            # an in-memory program has no loaded weights to quantize;
+            # silently serving fp32 under an int8 label would pass every
+            # divergence gate trivially. from_checkpoint owns the one
+            # deferred path (it applies after its verified arrays land).
+            raise ValueError(
+                "weights_dtype=%r needs a model_dir load or "
+                "InferenceEngine.from_checkpoint; an in-memory program= "
+                "engine has no loaded weights to quantize"
+                % (self.weights_dtype,))
 
         # apply_tuned: start at the recorded batching config for this
         # model's content signature on this device (paddle_tpu.tuning).
@@ -405,6 +427,9 @@ class InferenceEngine(object):
                           and not v.persistable]
         fetch_vars = [inference.global_block().var(n)
                       for n in target_names]
+        # weights_dtype is handled HERE, not by the program= constructor
+        # (which rejects it: an in-memory program has no weights yet)
+        weights_dtype = engine_kw.pop("weights_dtype", None)
         engine = cls(program=inference, feed_names=feed_names,
                      fetch_vars=fetch_vars,
                      name=engine_kw.pop("name", None)
@@ -415,6 +440,11 @@ class InferenceEngine(object):
             # initialized persistables
             for name, arr in arrays.items():
                 engine._scope.set(name, arr)
+            # weights_dtype applies HERE, after the verified fp32 arrays
+            # land and before any trace — the checkpoint on disk stays
+            # the fp32 master copy
+            engine._set_weights_dtype(weights_dtype)
+            engine._apply_weights_dtype()
             if warmup:
                 engine.warmup()
         except Exception:
@@ -422,6 +452,33 @@ class InferenceEngine(object):
             raise
         engine.checkpoint_step = found_step
         return engine
+
+    def _set_weights_dtype(self, weights_dtype):
+        """Validate + record the weight-dtype contract (shared by the
+        constructor and from_checkpoint's deferred path)."""
+        from .quantize import WEIGHTS_DTYPES
+        self.weights_dtype = (weights_dtype or "fp32").lower()
+        if self.weights_dtype not in WEIGHTS_DTYPES:
+            raise ValueError("weights_dtype must be one of %s, got %r"
+                             % (WEIGHTS_DTYPES, weights_dtype))
+        if self.weights_dtype == "int8" and self.tp is not None:
+            raise ValueError(
+                "weights_dtype='int8' does not compose with "
+                "tensor-parallel engines yet (the sharding plan "
+                "partitions the fp32 param names, not the @QVAL "
+                "rewrite); use weights_dtype='bf16' for TP replicas")
+
+    def _apply_weights_dtype(self):
+        """Apply weights_dtype to the loaded (program, scope) pair —
+        once, before the first trace. __init__ calls it for model_dir
+        loads; from_checkpoint calls it after the verified arrays land
+        in the scope (the constructor defers — the values aren't there
+        yet). No-op for fp32 or when already applied."""
+        if self.weights_dtype == "fp32" or self.quantize_report is not None:
+            return
+        from .quantize import apply_weights_dtype
+        self.quantize_report = apply_weights_dtype(
+            self.program, self._scope, self.weights_dtype)
 
     def _load(self, model_dir, model_format, model_filename,
               params_filename):
@@ -796,6 +853,7 @@ class InferenceEngine(object):
         return {
             "name": self.name,
             "tp": self.tp,
+            "weights_dtype": self.weights_dtype,
             "devices": self.device_span(),
             "feeds": [
                 {"name": n,

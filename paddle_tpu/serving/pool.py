@@ -480,6 +480,16 @@ class ReplicaPool(object):
                         "fetch_list": fetch_list,
                         "feed_names": feed_names, "step": step}
         self._factory = engine_factory
+        if engine_factory is not None and \
+                (engine_kw.get("weights_dtype") or "fp32") != "fp32":
+            # a factory builds its engines itself — weights_dtype would
+            # be silently dropped, and fp32 replicas serving under a
+            # bf16/int8 label pass every divergence gate trivially (the
+            # same refusal InferenceEngine makes for program= builds)
+            raise ValueError(
+                "weights_dtype=%r is ignored with engine_factory: pass "
+                "it to InferenceEngine inside the factory instead"
+                % (engine_kw["weights_dtype"],))
         self._place = place
         # tensor-parallel replicas (ARCHITECTURE.md §23): tp=M makes
         # every replica an M-device engine — replica i owns the

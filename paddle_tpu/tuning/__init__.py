@@ -23,8 +23,8 @@ applies only to reader-fed programs where K steps consume K records
 caller did not pass explicit ones, and a store-version bump or device
 change reads as "untuned" — defaults, the safe fallback.
 """
-from .autotuner import (Autotuner, TuningResult, tune_serving_batching,
-                        tune_training_multistep)
+from .autotuner import (Autotuner, TuningResult, tune_kernels,
+                        tune_serving_batching, tune_training_multistep)
 from .store import (KNOWN_KNOBS, STORE_VERSION, TuningStore,
                     default_store_dir, device_key, program_signature,
                     resolve_store_dir)
@@ -32,8 +32,9 @@ from .store import (KNOWN_KNOBS, STORE_VERSION, TuningStore,
 __all__ = [
     "Autotuner", "TuningResult", "TuningStore", "KNOWN_KNOBS",
     "STORE_VERSION", "default_store_dir", "device_key",
-    "program_signature", "resolve_store_dir", "tune_serving_batching",
-    "tune_training_multistep", "lookup_program", "apply_to_run",
+    "program_signature", "resolve_store_dir", "tune_kernels",
+    "tune_serving_batching", "tune_training_multistep", "lookup_program",
+    "apply_to_run",
 ]
 
 

@@ -26,7 +26,7 @@ import time
 from .store import TuningStore, device_key, program_signature
 
 __all__ = ["Autotuner", "TuningResult", "tune_training_multistep",
-           "tune_serving_batching"]
+           "tune_serving_batching", "tune_kernels"]
 
 
 class TuningResult(object):
@@ -194,6 +194,216 @@ def tune_training_multistep(program, startup, feed, fetch_list,
                                  None if u is None else bool(u)
                                  for u in unroll_candidates]},
                    extra_knobs=extra)
+
+
+# ---------------------------------------------------------------------------
+# kernel-knob search (PR 13): the TVM idea one level further down —
+# tile/block sizes per (op, shape-bucket, device_kind)
+# ---------------------------------------------------------------------------
+
+# default representative shapes per op; the dict key is the op's
+# VMEM-pressure dimension (what kernel_config.shape_bucket buckets on)
+_KERNEL_SHAPES = {
+    "attn": [dict(b=4, h=8, d=64, t=t) for t in (512, 1024, 2048)],
+    "xent": [dict(n=256, v=v) for v in (1024, 8192, 32768)],
+    "ln": [dict(n=1024, d=d) for d in (256, 1024, 4096)],
+    "lstm": [dict(b=32, t=64, d=d) for d in (128, 256, 512)],
+    "seq": [dict(b=64, t=t) for t in (128, 512, 2048)],
+}
+_KERNEL_GRIDS = {
+    "attn": [{"block_q": bq, "block_k": bk}
+             for bq in (64, 128, 256) for bk in (64, 128, 256)],
+    "xent": [{"block_n": n} for n in (8, 16, 32, 64)],
+    "ln": [{"block_n": n} for n in (8, 16, 32, 64)],
+    "lstm": [{"block_b": b} for b in (0, 8, 16, 32)],
+    "seq": [{"block_n": n} for n in (8, 16, 32, 64)],
+}
+
+
+def _block_all(out):
+    import jax
+    for leaf in jax.tree_util.tree_leaves(out):
+        leaf.block_until_ready()
+
+
+def _time_best(fn, args, repeats):
+    """Min-of-repeats walltime of fn(*args), first (compile) call
+    excluded — the bench.py measurement discipline."""
+    _block_all(fn(*args))
+    best = None
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        _block_all(fn(*args))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def _kernel_measure(op, shape):
+    """(units, measure(knobs) -> units/sec) for one op at one shape.
+    Fresh jit per candidate (the knobs are trace-time statics)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import pallas_kernels as pk
+    rng = np.random.RandomState(0)
+
+    if op == "attn":
+        b, h, d, t = shape["b"], shape["h"], shape["d"], shape["t"]
+        q, k, v = (jnp.asarray(rng.randn(b, t, h, d), jnp.float32) * 0.3
+                   for _ in range(3))
+        units = b * t
+
+        def measure(knobs, _qkv=(q, k, v)):
+            fn = jax.jit(lambda q, k, v: pk.flash_attention(
+                q, k, v, causal=True,
+                block_q=int(knobs["block_q"]),
+                block_k=int(knobs["block_k"])))
+            return _qkv, fn
+        return units, measure
+    if op == "xent":
+        n, v = shape["n"], shape["v"]
+        logits = jnp.asarray(rng.randn(n, v), jnp.float32)
+        labels = jnp.asarray(rng.randint(0, v, (n,)), jnp.int32)
+        units = n
+
+        def measure(knobs, _args=(logits, labels)):
+            fn = jax.jit(lambda lg, lb: pk.softmax_xent(
+                lg, lb, block_n=int(knobs["block_n"])))
+            return _args, fn
+        return units, measure
+    if op == "ln":
+        n, d = shape["n"], shape["d"]
+        x = jnp.asarray(rng.randn(n, d), jnp.float32)
+        scale = jnp.asarray(rng.rand(d) + 0.5, jnp.float32)
+        bias = jnp.asarray(rng.randn(d), jnp.float32)
+        units = n
+
+        def measure(knobs, _args=(x, scale, bias)):
+            fn = jax.jit(lambda x, s, b: pk.layer_norm(
+                x, s, b, block_n=int(knobs["block_n"]))[0])
+            return _args, fn
+        return units, measure
+    if op == "lstm":
+        b, t, d = shape["b"], shape["t"], shape["d"]
+        x = jnp.asarray(rng.randn(b, t, 4 * d), jnp.float32) * 0.3
+        w = jnp.asarray(rng.randn(d, 4 * d), jnp.float32) * 0.2
+        bias = jnp.asarray(rng.randn(4 * d), jnp.float32) * 0.1
+        lens = jnp.full((b,), t, jnp.int32)
+        units = b * t
+
+        def measure(knobs, _args=(x, w, bias, lens)):
+            fn = jax.jit(lambda x, w, bias, lens: pk.fused_lstm(
+                x, w, bias, None, None, lens,
+                block_b=int(knobs["block_b"]))[0])
+            return _args, fn
+        return units, measure
+    if op == "seq":
+        b, t = shape["b"], shape["t"]
+        x = jnp.asarray(rng.randn(b, t), jnp.float32)
+        lens = jnp.asarray(
+            rng.randint(1, t + 1, (b,)), jnp.int32)
+        units = b
+
+        def measure(knobs, _args=(x, lens)):
+            fn = jax.jit(lambda x, lens: pk.masked_softmax(
+                x, lens, block_n=int(knobs["block_n"])))
+            return _args, fn
+        return units, measure
+    raise KeyError("unknown kernel op %r" % (op,))
+
+
+def tune_kernels(ops=("attn", "xent", "ln", "lstm", "seq"), shapes=None,
+                 repeats=3, store=True, include_crossover=True,
+                 verbose=False):
+    """Per-shape kernel block-knob search: for each op and each
+    representative shape, sweep the candidate tile grid (built from
+    kernel_config.DEFAULT_TILES — the old literals are always
+    candidate #0), measure min-of-repeats walltime through the real
+    kernel call, and record the winner in the TuningStore under
+    (kernel:<op>/b<bucket>, device_kind). The dispatch in ops/ reads
+    those entries at trace time, so every later process starts at the
+    tuned tiles — and re-compiles exactly once, because the store
+    digest is part of trace_env_key().
+
+    include_crossover: additionally measure dense-vs-flash attention
+    per seq bucket and record the measured crossover as the
+    `flash_min_seq` knob (CROSSOVER_SIGNATURE), replacing the env-only
+    default. shapes: {op: [shape dicts]} override (tests pass tiny
+    ones; on CPU the kernels run interpret mode — correct, slow).
+
+    Returns {"entries": {signature: TuningResult},
+             "crossover": int | None}."""
+    import jax
+
+    from ..ops import kernel_config as kc
+    st = None
+    if store is not False:
+        st = store if isinstance(store, TuningStore) else TuningStore(
+            root=store if isinstance(store, str) else None)
+    dev_key = device_key(jax.devices()[0])
+    shapes = dict(_KERNEL_SHAPES, **(shapes or {}))
+    out = {"entries": {}, "crossover": None}
+    flash_scores = {}  # t-bucket -> best flash units/sec
+
+    for op in ops:
+        hot_dim_key = {"attn": "t", "xent": "v", "ln": "d",
+                       "lstm": "d", "seq": "t"}[op]
+        for shape in shapes[op]:
+            units, build = _kernel_measure(op, shape)
+            default = dict(kc.DEFAULT_TILES[op])
+            candidates = [default] + [
+                c for c in _KERNEL_GRIDS[op] if c != default]
+
+            def measure(knobs):
+                args, fn = build(knobs)
+                return units / _time_best(fn, args, repeats)
+
+            result = Autotuner(measure, repeats=1,
+                               score_unit="units/sec",
+                               verbose=verbose).search(candidates)
+            bucket = kc.shape_bucket(shape[hot_dim_key])
+            sig = kc.kernel_signature(op, bucket)
+            if op == "attn":
+                flash_scores[bucket] = (shape, result.best_score)
+            if st is not None:
+                result.store_path = st.put(
+                    sig, dev_key, result.best,
+                    score=result.best_score, score_unit="units/sec",
+                    searched={"shape": dict(shape),
+                              "candidates": candidates})
+            out["entries"][sig] = result
+
+    if include_crossover and "attn" in ops and flash_scores:
+        from ..parallel.ring_attention import attention_reference
+        crossover = None
+        for bucket in sorted(flash_scores):
+            shape, flash = flash_scores[bucket]
+            # the SAME inputs the flash candidates measured on (one
+            # generator, _kernel_measure) — the crossover must compare
+            # matched workloads, not two hand-rolled ones
+            units, build = _kernel_measure("attn", shape)
+            args, _ = build(dict(kc.DEFAULT_TILES["attn"]))
+            dense_fn = jax.jit(lambda q, k, v: attention_reference(
+                q, k, v, causal=True))
+            dense = units / _time_best(dense_fn, args, repeats)
+            if verbose:
+                print("[ptpu_tune] crossover t=%d: flash %.0f vs "
+                      "dense %.0f units/sec" % (shape["t"], flash, dense))
+            if flash >= dense and crossover is None:
+                crossover = shape["t"]
+        if crossover is None:
+            # flash never won in the measured band: dispatch dense up
+            # to (and incl.) the largest measured bucket
+            crossover = 2 * max(s["t"] for s, _ in flash_scores.values())
+        out["crossover"] = int(crossover)
+        if st is not None:
+            st.put(kc.CROSSOVER_SIGNATURE, dev_key,
+                   {"flash_min_seq": int(crossover)},
+                   score=None, score_unit=None,
+                   searched={"buckets": sorted(flash_scores)})
+    return out
 
 
 def tune_serving_batching(engine_factory, request_feeds,

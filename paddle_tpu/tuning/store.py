@@ -46,6 +46,17 @@ KNOWN_KNOBS = frozenset({
     "seq_buckets",         # AUTO
     "max_batch_size",      # AUTO
     "max_queue_delay_ms",  # AUTO
+    # kernel-layer knobs (PR 13): recorded under "kernel:<op>/b<bucket>"
+    # signatures by tuning.tune_kernels and read AT TRACE TIME by
+    # ops.kernel_config.tiles_for — AUTO in the strongest sense (no
+    # apply_tuned needed; trace_env_key carries the store digest so
+    # compiled artifacts re-key when an entry changes)
+    "block_q",             # AUTO: flash attention q-tile rows
+    "block_k",             # AUTO: flash attention k-tile rows
+    "block_n",             # AUTO: row-block of xent/ln/seq kernels
+    "block_b",             # AUTO: batch-block of the fused LSTM kernel
+    "flash_min_seq",       # AUTO: flash-vs-dense crossover (per device,
+                           # signature kernel_config.CROSSOVER_SIGNATURE)
 })
 
 

@@ -1,0 +1,274 @@
+"""Quantized serving (weights_dtype bf16/int8, serving/quantize.py):
+the per-channel int8 rewrite, the bf16 AMP cast, the bounded-divergence
+gate vs the fp32 engine, and the invariants that keep it safe — the
+fp32 export untouched on disk, batched-vs-direct bit-exactness WITHIN a
+quantized engine, from_checkpoint pass-through, int8+tp rejected."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.serving.engine import InferenceEngine
+from paddle_tpu.serving.quantize import (QSCALE_SUFFIX, QVAL_SUFFIX,
+                                         apply_weights_dtype,
+                                         divergence_bound,
+                                         quantizable_params)
+
+rng = np.random.RandomState(17)
+
+
+def _save_mlp(tmp_path, feat=10, classes=3, seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "mlp")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe, main)
+    return d, feat
+
+
+def test_quantizable_params_census():
+    """Only matmul/conv weight params qualify; biases and embedding
+    tables stay fp32 (their error compounds differently)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        words = fluid.layers.data(name="w", shape=[1], dtype="int64",
+                                  lod_level=1)
+        emb = fluid.layers.embedding(input=words, size=[30, 8])
+        pool = fluid.layers.sequence_pool(input=emb, pool_type="sum")
+        pred = fluid.layers.fc(input=pool, size=4)
+    census = quantizable_params(main)
+    names = sorted(census)
+    assert len(names) == 1 and names[0].startswith("fc_")
+    assert census[names[0]] == 1  # mul weight: per-output-column scales
+
+
+def test_int8_rewrite_shapes_and_scope(tmp_path):
+    d, feat = _save_mlp(tmp_path)
+    eng = InferenceEngine(d, weights_dtype="int8", warmup=False)
+    try:
+        rep = eng.quantize_report
+        assert rep["mode"] == "int8" and len(rep["params"]) == 2
+        assert rep["bytes_after"] < rep["bytes_before"] / 2
+        block = eng.program.global_block()
+        for name in rep["params"]:
+            qv = block.var(name + QVAL_SUFFIX)
+            qs = block.var(name + QSCALE_SUFFIX)
+            assert qv.dtype == "int8" and qv.persistable
+            assert qs.dtype == "float32" and qs.persistable
+            # the param itself is now a computed intermediate
+            assert not block.var(name).persistable
+            vals = np.asarray(eng._scope.get(name + QVAL_SUFFIX))
+            assert vals.dtype == np.int8
+            assert np.abs(vals).max() <= 127
+            assert eng._scope.get(name) is None
+            scales = np.asarray(eng._scope.get(name + QSCALE_SUFFIX))
+            assert scales.shape == (qv.shape[-1],)
+            assert (scales > 0).all()
+        # the dequantize ops sit ahead of their consumers
+        assert block.ops[0].type == "dequantize_channel"
+    finally:
+        eng.close(drain=False)
+
+
+@pytest.mark.parametrize("wd", ["bf16", "int8"])
+def test_quantized_engine_divergence_gate(tmp_path, wd):
+    """The bounded-divergence acceptance gate, engine-level: quantized
+    outputs stay within divergence_bound of the fp32 engine, and the
+    fp32 model files on disk are untouched."""
+    d, feat = _save_mlp(tmp_path)
+    import hashlib
+    import glob
+    before = {p: hashlib.sha256(open(p, "rb").read()).hexdigest()
+              for p in sorted(glob.glob(os.path.join(d, "*")))}
+    ref = InferenceEngine(d, max_batch_size=4)
+    eng = InferenceEngine(d, weights_dtype=wd, max_batch_size=4)
+    try:
+        feed = {"x": rng.randn(3, feat).astype("float32")}
+        want = ref.infer(feed)
+        got = eng.infer(feed)
+        for name in want:
+            div = (np.abs(got[name].astype(np.float64)
+                          - want[name].astype(np.float64)).max()
+                   / (np.abs(want[name]).max() + 1e-6))
+            assert div <= divergence_bound(wd), (name, div)
+        after = {p: hashlib.sha256(open(p, "rb").read()).hexdigest()
+                 for p in sorted(glob.glob(os.path.join(d, "*")))}
+        assert after == before  # fp32 master export untouched
+    finally:
+        eng.close(drain=False)
+        ref.close(drain=False)
+
+
+def test_quantized_engine_batched_bit_identical_to_direct(tmp_path):
+    """The PR-3 serving invariant survives quantization: within ONE
+    int8 engine, coalesced rows == run_direct at the same bucket,
+    bit for bit (same compiled executable, same shapes)."""
+    import threading
+    d, feat = _save_mlp(tmp_path)
+    eng = InferenceEngine(d, weights_dtype="int8", batch_buckets=[1, 4],
+                          max_batch_size=4, max_queue_delay_ms=20)
+    try:
+        feeds = [{"x": rng.randn(1, feat).astype("float32")}
+                 for _ in range(4)]
+        futures = [None] * 4
+
+        def fire(i):
+            futures[i] = eng.submit(feeds[i])
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, fut in enumerate(futures):
+            got = fut.result(60).numpy()
+            want, _ = eng.run_direct(feeds[i],
+                                     batch_bucket=fut.bucket[0],
+                                     seq_bucket=fut.bucket[1])
+            for name in eng.fetch_names:
+                assert np.array_equal(got[name], want[name]), (i, name)
+    finally:
+        eng.close(drain=False)
+
+
+def test_from_checkpoint_weights_dtype(tmp_path):
+    """weights_dtype rides from_checkpoint: the verified fp32 arrays
+    quantize AFTER load, the checkpoint stays the fp32 master."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        p = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    pred_name = p.name
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    ck = str(tmp_path / "ck")
+    xb = rng.rand(4, 6).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with CheckpointManager(ck, async_save=False) as mgr:
+            exe.run(main, feed={"x": xb, "y": xb[:, :1]},
+                    fetch_list=[loss])
+            mgr.save(1, program=main, scope=scope)
+
+    ref = InferenceEngine.from_checkpoint(ck, fetch_list=[pred_name],
+                                          batch_buckets=[4],
+                                          max_batch_size=4)
+    eng = InferenceEngine.from_checkpoint(ck, fetch_list=[pred_name],
+                                          batch_buckets=[4],
+                                          max_batch_size=4,
+                                          weights_dtype="int8")
+    try:
+        assert eng.quantize_report["mode"] == "int8"
+        assert eng.quantize_report["params"]
+        q = rng.rand(2, 6).astype("float32")
+        want, _ = ref.run_direct({"x": q})
+        got, _ = eng.run_direct({"x": q})
+        div = (np.abs(got[pred_name].astype(np.float64)
+                      - want[pred_name].astype(np.float64)).max()
+               / (np.abs(want[pred_name]).max() + 1e-6))
+        assert div <= divergence_bound("int8")
+        # a second fp32 from_checkpoint still loads clean fp32 arrays
+        again = InferenceEngine.from_checkpoint(
+            ck, fetch_list=[pred_name], batch_buckets=[4],
+            max_batch_size=4)
+        out2, _ = again.run_direct({"x": q})
+        assert np.array_equal(out2[pred_name], want[pred_name])
+        again.close(drain=False)
+    finally:
+        eng.close(drain=False)
+        ref.close(drain=False)
+
+
+def test_int8_rejects_tensor_parallel(tmp_path):
+    d, _ = _save_mlp(tmp_path)
+    with pytest.raises(ValueError, match="int8"):
+        InferenceEngine(d, weights_dtype="int8", tp=1, warmup=False)
+
+
+def test_bad_weights_dtype_rejected(tmp_path):
+    d, _ = _save_mlp(tmp_path)
+    with pytest.raises(ValueError, match="weights_dtype"):
+        InferenceEngine(d, weights_dtype="fp8", warmup=False)
+
+
+def test_pool_engine_factory_weights_dtype_rejected():
+    """A factory pool builds engines itself — weights_dtype would be
+    silently dropped (fp32 serving under an int8 label), so the pool
+    refuses the combination up front."""
+    from paddle_tpu.serving.pool import ReplicaPool
+    with pytest.raises(ValueError, match="engine_factory"):
+        ReplicaPool(engine_factory=lambda idx, place: None, replicas=1,
+                    weights_dtype="int8")
+
+
+def test_inmemory_program_weights_dtype_rejected():
+    """program= engines have no loaded weights: a weights_dtype there
+    must raise, not silently serve fp32 under a quantized label."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=2)
+    with pytest.raises(ValueError, match="in-memory program"):
+        InferenceEngine(program=main, feed_names=["x"],
+                        fetch_vars=[pred], weights_dtype="int8",
+                        warmup=False)
+
+
+def test_apply_weights_dtype_missing_param_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(input=x, size=2)
+    with pytest.raises(ValueError, match="not initialized"):
+        apply_weights_dtype(main, fluid.Scope(), "int8")
+
+
+def test_divergence_bound_env_override(monkeypatch):
+    assert divergence_bound("int8") == 0.05
+    monkeypatch.setenv("PADDLE_TPU_QUANT_BOUND", "0.005")
+    assert divergence_bound("int8") == 0.005
+    assert divergence_bound("bf16") == 0.005
+
+
+@pytest.mark.slow
+def test_ptpu_serve_selfcheck_weights_dtype(tmp_path):
+    """The deploy gate end-to-end: ptpu_serve --selfcheck with
+    --weights-dtype int8 builds the fp32 twin, fires through the real
+    batcher, and reports the divergence it gated. Slow-marked: the
+    engine-level divergence tests above cover the gate math; this leg
+    only adds the argv surface + JSON record."""
+    import json
+    import subprocess
+    import sys
+    REPO = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    d, _ = _save_mlp(tmp_path)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep
+                + env.get("PYTHONPATH", "")})
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ptpu_serve.py"),
+         d, "--selfcheck", "6", "--weights-dtype", "int8",
+         "--max-batch", "4"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["selfcheck"] == "pass"
+    assert rec["weights_dtype"] == "int8"
+    assert rec["max_divergence"] <= rec["divergence_bound"]

@@ -114,6 +114,29 @@ probe && run 1200 BENCH_PIPELINE=1 BENCH_PIPELINE_K=8 BENCH_PIPELINE_RECORDS=64
 probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2
 probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_TP_DIM=1024
 probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_TP_DIM=1024 BENCH_TP_LEGS=1,2
+# --- tier 3k: kernel floor (PR 13) — fused-vs-unfused per op (+ the
+# int8/bf16 serving divergence gate riding the same JSON line), then a
+# hardware tile sweep (ptpu_tune kernels records per-(op, shape-bucket,
+# device_kind) tiles + the flash crossover into the TuningStore), then
+# the SAME leg again so tuned_vs_default is measured on the chip — the
+# ">=1.5x on >=2 hot ops" ROADMAP claim banks from these lines, never
+# from CPU interpret mode. CPU reference (2026-08-05, tiny dims):
+# divergence gates all pass; speedups <1 as expected off-hardware.
+probe && run 1800 BENCH_KERNELS=1
+if [ "$WEDGED" = 0 ]; then
+  echo "=== [tune] ptpu_tune kernels --place tpu" | tee -a $LOG
+  if bash "$LOCK" timeout -k 10 2400 python tools/ptpu_tune.py kernels \
+       --place tpu --json >/tmp/ptpu_tune_kernels.out 2>>$LOG; then
+    printf -- '- %s `ptpu_tune kernels --place tpu`\n  `%s`\n' \
+      "$(date -u +%FT%TZ)" "$(tail -1 /tmp/ptpu_tune_kernels.out)" \
+      >> BENCH_LOG.md
+  else
+    echo "- $(date -u +%FT%TZ) FAILED: ptpu_tune kernels (see $LOG)" \
+      >> BENCH_LOG.md
+  fi
+  bank
+fi
+probe && run 1800 BENCH_KERNELS=1
 # --- tier 3: big compile LAST — one unrolled TPU line (K copies of the step)
 probe && run 2400 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_MULTISTEP=8 FLAGS_multistep_unroll=1
 bank

@@ -61,7 +61,8 @@ def parse_buckets(spec):
     return sorted(batch) or None, sorted(seq) or None
 
 
-def selfcheck(engine, n_requests, rows_max=4, seed=0, kill_replica=None):
+def selfcheck(engine, n_requests, rows_max=4, seed=0, kill_replica=None,
+              reference=None, divergence_bound=0.0, stats=None):
     """Fire n random requests through the batcher concurrently; verify
     each against run_direct at the bucket the batch actually used.
     Returns the number of mismatches (submit failures count).
@@ -70,7 +71,14 @@ def selfcheck(engine, n_requests, rows_max=4, seed=0, kill_replica=None):
     the first half of the requests is in flight when the replica dies,
     the second half is submitted after. Any client-visible error or bit
     mismatch fails the gate: this is the failover invariant (traffic
-    redistributes with zero dropped requests) as a deploy check."""
+    redistributes with zero dropped requests) as a deploy check.
+
+    reference (quantized deploys): an fp32 engine over the SAME model —
+    each response is additionally compared against the fp32 run_direct
+    at the same bucket, and max |q - f| / (max|f| + 1e-6) over
+    `divergence_bound` counts as a mismatch (the bounded-divergence
+    gate of weights_dtype serving). stats, when passed, gets
+    {"max_divergence": float} filled in."""
     import time
 
     import numpy as np
@@ -148,6 +156,7 @@ def selfcheck(engine, n_requests, rows_max=4, seed=0, kill_replica=None):
     engine.default_deadline_ms = saved_deadline
 
     mismatches = 0
+    max_div = 0.0
     for i, fut in enumerate(futures):
         if not hasattr(fut, "result"):   # submit failed: counts as fail
             mismatches += 1
@@ -171,6 +180,25 @@ def selfcheck(engine, n_requests, rows_max=4, seed=0, kill_replica=None):
                       "(bucket %r)" % (i, name, fut.bucket),
                       file=sys.stderr)
                 break
+        if reference is not None:
+            ref, _ = reference.run_direct(requests[i],
+                                          batch_bucket=fut.bucket[0],
+                                          seq_bucket=fut.bucket[1])
+            for name in engine.fetch_names:
+                f = np.asarray(ref[name], dtype=np.float64)
+                q = np.asarray(got[name], dtype=np.float64)
+                div = float(np.abs(q - f).max()
+                            / (np.abs(f).max() + 1e-6)) if f.size else 0.0
+                max_div = max(max_div, div)
+                if div > divergence_bound:
+                    mismatches += 1
+                    print("selfcheck DIVERGENCE: request %d fetch %r: "
+                          "%.3e > bound %.3e" % (i, name, div,
+                                                 divergence_bound),
+                          file=sys.stderr)
+                    break
+    if stats is not None:
+        stats["max_divergence"] = max_div
     return mismatches
 
 
@@ -236,6 +264,14 @@ def main(argv=None):
                          "kill replica IDX mid-gate; ANY client-visible "
                          "error fails the gate (the failover invariant "
                          "as a deploy check)")
+    ap.add_argument("--weights-dtype", default=None,
+                    choices=["fp32", "bf16", "int8"],
+                    help="weight precision at load: bf16 halves weight "
+                         "HBM + runs MXU ops bf16; int8 stores matmul/"
+                         "conv weights per-channel quantized behind an "
+                         "in-graph dequantize (fp32 master files "
+                         "untouched). --selfcheck additionally gates "
+                         "max divergence vs a local fp32 engine")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     if args.kill_replica is not None and not args.selfcheck:
@@ -272,7 +308,8 @@ def main(argv=None):
         max_batch_size=args.max_batch,
         max_queue_delay_ms=args.max_delay_ms,
         queue_capacity=args.queue_capacity, warmup=not args.no_warmup,
-        pipeline_depth=args.pipeline_depth)
+        pipeline_depth=args.pipeline_depth,
+        weights_dtype=args.weights_dtype)
     try:
         if args.replicas > 1:
             # pool placement: None = TPUPlace(i) round-robin over the
@@ -298,8 +335,25 @@ def main(argv=None):
         return 2
 
     if args.selfcheck:
+        reference, bound = None, 0.0
+        if args.weights_dtype in ("bf16", "int8"):
+            # the bounded-divergence gate: a local fp32 twin of the
+            # model (no batcher needed — selfcheck drives run_direct)
+            from paddle_tpu.serving.quantize import divergence_bound
+            ref_kw = dict(engine_kw, weights_dtype=None, warmup=False,
+                          name="fp32-reference")
+            reference = serving.InferenceEngine(
+                args.model_dir,
+                place=(fluid.TPUPlace() if args.place == "tpu"
+                       else fluid.CPUPlace()), **ref_kw)
+            bound = divergence_bound(args.weights_dtype)
+        qstats = {}
         bad = selfcheck(engine, args.selfcheck,
-                        kill_replica=args.kill_replica)
+                        kill_replica=args.kill_replica,
+                        reference=reference, divergence_bound=bound,
+                        stats=qstats)
+        if reference is not None:
+            reference.close()
         if hasattr(engine, "replica_metrics"):   # pool: aggregate
             snaps = [m.snapshot()
                      for m in engine.replica_metrics().values()]
@@ -315,6 +369,12 @@ def main(argv=None):
             "selfcheck": "pass" if bad == 0 else "fail",
             "requests": args.selfcheck, "mismatches": bad,
             "mean_batch_occupancy": occupancy, "batches": batches}
+        if args.weights_dtype:
+            record["weights_dtype"] = args.weights_dtype
+        if reference is not None:
+            record["max_divergence"] = round(
+                qstats.get("max_divergence", 0.0), 6)
+            record["divergence_bound"] = bound
         if args.replicas > 1:
             record["replicas"] = args.replicas
             record["pool"] = engine.pool_state()

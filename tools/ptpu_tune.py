@@ -118,6 +118,52 @@ def cmd_train_smoke(args):
     return 0
 
 
+def cmd_kernels(args):
+    """Kernel block-knob sweep (tuning.tune_kernels): per (op,
+    shape-bucket, device_kind) tile search + the flash-vs-dense
+    crossover, recorded so every later process dispatches at the tuned
+    tiles (ops/kernel_config.py reads the store at trace time)."""
+    if args.place == "tpu":
+        # the module-level CPU pin must not leak into a hardware tune;
+        # jax has not initialized yet (it imports lazily below)
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            del os.environ["JAX_PLATFORMS"]
+    from paddle_tpu import tuning
+    ops = tuple(o.strip() for o in args.ops.split(",") if o.strip())
+    shapes = None
+    if args.smoke:
+        # tiny shapes: the subprocess-tested zero-to-tuned path (CPU
+        # interpret mode; real sweeps drop --smoke and run on TPU)
+        shapes = {"attn": [dict(b=1, h=1, d=8, t=16)],
+                  "xent": [dict(n=16, v=64)],
+                  "ln": [dict(n=16, d=32)],
+                  "lstm": [dict(b=4, t=8, d=8)],
+                  "seq": [dict(b=8, t=16)]}
+    store = (tuning.TuningStore(root=args.store) if args.store
+             else tuning.TuningStore())
+    result = tuning.tune_kernels(
+        ops=ops, shapes=shapes, repeats=args.repeats, store=store,
+        include_crossover=not args.no_crossover,
+        verbose=not args.json)
+    record = {
+        "entries": {sig: {"best": r.best, "best_score": r.best_score,
+                          "score_unit": r.score_unit,
+                          "store_path": r.store_path}
+                    for sig, r in result["entries"].items()},
+        "crossover": result["crossover"],
+        "store": store.root,
+    }
+    if args.json:
+        print(json.dumps(record))
+    else:
+        for sig, r in sorted(record["entries"].items()):
+            print("%s -> %s (%.1f %s)" % (sig, r["best"], r["best_score"],
+                                          r["score_unit"]))
+        if record["crossover"] is not None:
+            print("flash crossover -> min_seq=%d" % record["crossover"])
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="ptpu_tune",
@@ -147,6 +193,24 @@ def main(argv=None):
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_train_smoke)
+
+    p = sub.add_parser("kernels",
+                       help="sweep pallas tile/block knobs per "
+                            "(op, shape-bucket, device_kind)")
+    p.add_argument("--store", default=None)
+    p.add_argument("--ops", default="attn,xent,ln,lstm,seq")
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--place", default="cpu", choices=["cpu", "tpu"],
+                   help="tpu = tune on the real chip (the only numbers "
+                        "worth recording for deploy; cpu interpret mode "
+                        "exists for the smoke path)")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes (seconds on CPU) — the tested "
+                        "zero-to-tuned path")
+    p.add_argument("--no-crossover", action="store_true",
+                   help="skip the flash-vs-dense crossover measurement")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_kernels)
 
     args = ap.parse_args(argv)
     return args.fn(args)
