@@ -28,6 +28,10 @@ def _error_line(msg):
         return {"metric": "serving_pool_throughput", "value": 0.0,
                 "unit": "requests/sec/chip", "vs_baseline": None,
                 "error": msg}
+    if os.environ.get("BENCH_FLEET") == "1":
+        return {"metric": "serving_fleet_autoscale_qps", "value": 0.0,
+                "unit": "requests/sec/chip", "vs_baseline": None,
+                "error": msg}
     if os.environ.get("BENCH_CKPT") == "1":
         return {"metric": "ckpt_async_steps_per_sec", "value": 0.0,
                 "unit": "steps/sec", "vs_baseline": None, "error": msg}
@@ -1192,6 +1196,163 @@ def bench_pool():
         "device": str(jax.devices()[0])}))
 
 
+def bench_fleet():
+    """BENCH_FLEET=1: the self-scaling fleet leg (serving/autoscaler).
+    One load step, two pools, same closed-loop client schedule:
+
+      * FIXED leg — 1 replica, small queue, autoscale OFF: the load
+        step sheds sustained 429s for its whole duration (the
+        reference-era fixed-size deployment failure mode).
+      * AUTOSCALED leg — the same pool with autoscale [1,
+        BENCH_FLEET_MAX_REPLICAS]: the controller grows the pool off
+        the shed/queue signals (scale-up latency = engine build +
+        warmup, an AOT-cache disk load when the cache is armed) until
+        the shedding stops; after the load the pool drains back to 1.
+
+    One JSON line: per-leg qps, total and TAIL-third 429 rates (the
+    acceptance number: fixed stays shedding, autoscaled returns to
+    ~0), scale-up count + latency, final replica count, client errors
+    (must be 0). Clients retry 429s after the server's Retry-After
+    hint, so completed counts are comparable across legs. On the
+    1-core CPU container extra replicas add queue+admission capacity,
+    not compute — qps parity is expected there and the 429-rate drop
+    is the measured claim; on TPU the replicas land on distinct chips
+    and qps scales too. Knobs: BENCH_FLEET_CLIENTS,
+    BENCH_FLEET_SECONDS, BENCH_FLEET_MAX_REPLICAS,
+    BENCH_FLEET_QUEUE_CAP, BENCH_SERVING_LAYERS/HIDDEN/FEATURES."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import serving
+
+    n_clients = int(os.environ.get("BENCH_FLEET_CLIENTS", "12"))
+    seconds = float(os.environ.get("BENCH_FLEET_SECONDS", "3"))
+    max_replicas = int(os.environ.get("BENCH_FLEET_MAX_REPLICAS", "3"))
+    queue_cap = int(os.environ.get("BENCH_FLEET_QUEUE_CAP", "8"))
+    max_batch = int(os.environ.get("BENCH_POOL_MAX_BATCH", "8"))
+    feat = int(os.environ.get("BENCH_SERVING_FEATURES", "64"))
+    hidden = int(os.environ.get("BENCH_SERVING_HIDDEN", "64"))
+    n_layers = int(os.environ.get("BENCH_SERVING_LAYERS", "10"))
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main_prog,
+                                                        startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        h = x
+        for _ in range(n_layers):
+            h = fluid.layers.fc(input=h, size=hidden, act="relu")
+        pred = fluid.layers.fc(input=h, size=10, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace())
+    model_dir = tempfile.mkdtemp(prefix="ptpu_bench_fleet_")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe,
+                                      main_prog)
+    rng = np.random.RandomState(0)
+    inputs = [rng.rand(1, feat).astype("float32") for _ in range(64)]
+
+    def drive(pool):
+        """Closed-loop clients for `seconds`; 429s retried after the
+        pool's own Retry-After hint. Returns wall, completions,
+        reject timestamps, client errors."""
+        t0 = time.perf_counter()
+        done, rejects, errors = [], [], []
+        lock = threading.Lock()
+
+        def client(ci):
+            k = 0
+            while time.perf_counter() - t0 < seconds:
+                try:
+                    pool.submit({"x": inputs[(ci * 7 + k) % 64]}) \
+                        .result(60).numpy()
+                    with lock:
+                        done.append(time.perf_counter() - t0)
+                except serving.QueueFullError as e:
+                    with lock:
+                        rejects.append(time.perf_counter() - t0)
+                    time.sleep(min(e.retry_after_s or 0.003, 0.05))
+                except Exception as e:  # noqa: BLE001 — the acceptance
+                    with lock:          # count is 0
+                        errors.append(repr(e))
+                k += 1
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, done, rejects, errors
+
+    def leg_record(wall, done, rejects, errors):
+        tail_t = 2.0 * seconds / 3.0
+        tail_done = sum(1 for t in done if t >= tail_t)
+        tail_rej = sum(1 for t in rejects if t >= tail_t)
+        return {
+            "qps": round(len(done) / wall, 1),
+            "completed": len(done),
+            "rejects": len(rejects),
+            "reject_rate": round(len(rejects)
+                                 / max(len(done) + len(rejects), 1), 4),
+            "tail_reject_rate": round(
+                tail_rej / max(tail_done + tail_rej, 1), 4),
+            "errors": len(errors),
+            "error_samples": errors[:3],
+        }
+
+    pool_kw = dict(max_batch_size=max_batch, max_queue_delay_ms=2,
+                   queue_capacity=queue_cap, attempt_timeout_s=30.0)
+
+    # ---- fixed-size leg: the reference-era deployment, shedding
+    fixed_pool = serving.ReplicaPool(model_dir, replicas=1,
+                                     name="fleet-fixed", **pool_kw)
+    legs = {"fixed": leg_record(*drive(fixed_pool))}
+    fixed_pool.close()
+
+    # ---- autoscaled leg: same schedule, the controller absorbs it
+    auto_pool = serving.ReplicaPool(
+        model_dir, replicas=1, name="fleet-auto", autoscale=True,
+        min_replicas=1, max_replicas=max_replicas,
+        autoscale_kw=dict(interval_s=0.05, scale_up_cooldown_s=0.2,
+                          scale_down_cooldown_s=0.3, down_idle_s=0.5),
+        **pool_kw)
+    wall, done, rejects, errors = drive(auto_pool)
+    scaler = auto_pool._autoscaler
+    rec = leg_record(wall, done, rejects, errors)
+    rec.update({
+        "scale_ups": scaler.scale_ups,
+        "scale_up_latency_s": (round(scaler.last_scale_up_s, 3)
+                               if scaler.last_scale_up_s is not None
+                               else None),
+        "peak_replicas": auto_pool.live_replica_count(),
+    })
+    # contraction: idle drains back to min without failing anything
+    t_shrink = time.perf_counter()
+    while auto_pool.live_replica_count() > 1 \
+            and time.perf_counter() - t_shrink < 30:
+        time.sleep(0.1)
+    rec["final_replicas"] = auto_pool.live_replica_count()
+    rec["scale_downs"] = scaler.scale_downs
+    legs["autoscaled"] = rec
+    auto_pool.close()
+    shutil.rmtree(model_dir, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "serving_fleet_autoscale_qps",
+        "value": legs["autoscaled"]["qps"],
+        "unit": "requests/sec/chip",
+        "vs_baseline": None,
+        "clients": n_clients, "seconds": seconds,
+        "max_replicas": max_replicas, "queue_capacity": queue_cap,
+        "layers": n_layers, "hidden": hidden,
+        "legs": legs,
+        "total_errors": sum(l["errors"] for l in legs.values()),
+        "device": str(jax.devices()[0])}))
+
+
 # fwd FLOPs per 224x224 image (2x the usual MACs figure — VGG16's famous
 # "15.5G" is MACs, so fwd = 31e9); models build_train supports but this
 # table lacks still bench (mfu reported null)
@@ -2161,6 +2322,9 @@ def main():
         return
     if os.environ.get("BENCH_POOL") == "1":
         bench_pool()
+        return
+    if os.environ.get("BENCH_FLEET") == "1":
+        bench_fleet()
         return
     if os.environ.get("BENCH_CKPT") == "1":
         bench_ckpt()

@@ -1,4 +1,5 @@
-"""Pipelined dispatch: the shared host/device-overlap plumbing.
+"""The shared dispatch core: overlap, guard, watchdog and fault-tap
+plumbing both runtimes front.
 
 Both hot loops — the serving batcher and the training executors — used to
 leave the device idle behind host work: the batcher's one worker formed,
@@ -6,7 +7,13 @@ padded, dispatched and scattered strictly in sequence, and Executor.run
 performed the whole host-io prepass (reader pops, padding, H2D) serially
 before every dispatch. This module is the one seam both runtimes front
 instead of triplicating the overlap machinery (the first slice of the
-ROADMAP item-5 shared runtime core):
+ROADMAP item-5 shared runtime core) — and, since the fleet PR, also the
+ONE home of the per-dispatch guard/watchdog/fault-tap choreography that
+used to live three times (Executor, ParallelExecutor, serving/engine):
+`run_dispatch_hooks`, `consume_host_io`, `run_post_dispatch_checks`,
+`call_with_aval_fallback`, `run_with_deadline`/`dispatch_with_deadline`,
+`run_compile_probe` and `ReplicaTap` (see the "dispatch-guard seam"
+section below):
 
   * `InflightWindow` — bounds how many dispatches may be outstanding on
     the device at once (the serving batcher's continuous-batching window).
@@ -473,6 +480,271 @@ def run_step_traced(label, cancelled, body_fn, **span_args):
         return out
     tspan.end()
     return out
+
+
+# ---------------------------------------------------------------------------
+# The dispatch-guard seam: ONE copy of the per-dispatch plumbing that
+# `Executor._run_traced`, `ParallelExecutor._run_traced` and the serving
+# engine used to carry separately (guards, watchdog, fault taps, cache
+# fallback). The hook VARIABLES (`core.executor._fault_hook` /
+# `_barrier_hook`) stay where resilience/faults.py and
+# resilience/cluster.py install them; the choreography around them lives
+# here, once.
+# ---------------------------------------------------------------------------
+
+
+def run_dispatch_hooks(program, steps, feed_arrays, prefetcher=None,
+                       cancelled=None):
+    """The pre-dispatch hook choreography: the cluster step barrier
+    first (a fenced cohort stops before anything is consumed), then the
+    fault-injection seam (an injected dispatch failure or slow step
+    consumes no reader records and no rng — a retried step replays
+    bit-exactly). Either hook raising refunds anything a prefetcher
+    staged, so fence-consumes-nothing covers the staged block too."""
+    from . import executor as _exe
+    try:
+        if _exe._barrier_hook is not None:
+            _exe._barrier_hook("dispatch", program=program, steps=steps)
+        if _exe._fault_hook is not None:
+            _exe._fault_hook("dispatch", program=program, steps=steps,
+                             feed_arrays=feed_arrays)
+    except BaseException:
+        if prefetcher is not None:
+            prefetcher.rollback(cancelled=cancelled)
+        raise
+
+
+def consume_host_io(executor, program, scope, steps, host, cancelled,
+                    feed_arrays, stacked_names, tspan, **inline_kw):
+    """The host-io consume choreography, shared by both executors: claim
+    the prefetcher's staged block when its identity matches (refunding a
+    mismatched one BEFORE the inline prepass pops the stream, or the
+    staged records would replay out of order), else run the inline
+    prepass; the exec/host_io span closes honestly on every path.
+    Returns the staged block, None (inline prepass ran), or the
+    CANCELLED sentinel (the caller's watchdog fired — unwind without
+    touching more state). `inline_kw` carries the per-executor prepass
+    strategy (Executor pins place=; ParallelExecutor passes
+    validate=)."""
+    from .executor import run_host_io_prepass, _DispatchCancelled
+    pf = executor._prefetcher
+    staged = None
+    iosp = tspan.child("exec/host_io")
+    try:
+        if pf is not None and pf.has_work():
+            # consult the prefetcher even on a prefetch=False call: a
+            # staged block for a different signature must be refunded
+            # before the inline prepass pops the stream
+            staged = pf.take(program, scope, steps, host,
+                             cancelled=cancelled)
+            if staged is CANCELLED:
+                iosp.end(error="DispatchCancelled")
+                return CANCELLED
+        if staged is not None:
+            feed_arrays.update(staged.arrays)
+            stacked_names.update(staged.stacked)
+        else:
+            try:
+                run_host_io_prepass(program, scope, feed_arrays,
+                                    host=host, steps=steps,
+                                    stacked_out=stacked_names,
+                                    cancelled=cancelled, **inline_kw)
+            except _DispatchCancelled:
+                iosp.end(error="DispatchCancelled")
+                return CANCELLED
+    except BaseException as e:  # EOF / reader faults: close the span,
+        iosp.end(error=type(e).__name__)  # the fault rides up
+        raise
+    iosp.end(staged=staged is not None)
+    return staged
+
+
+def run_post_dispatch_checks(errors, fetches, fetch_names, new_state,
+                             state_out, array_safety, check_nan_inf,
+                             context, prefetcher=None, cancelled=None,
+                             sync_fn=None):
+    """The post-dispatch guard choreography: the in-graph assertion-flag
+    raise (guard flags raise even with FLAGS_tensor_array_safety=0 — a
+    program that INSTALLED guards opted into the one-fetch sync) and the
+    optional FLAGS_check_nan_inf sweep. Any raise — including from
+    `sync_fn`, the executor-specific profiling / CPU-collective sync
+    that precedes the checks — refunds the prefetcher's just-kicked next
+    block first, so the stream position is exactly what the failed step
+    left (its own records consumed, nothing more)."""
+    from .executor import (GUARD_MSG_PREFIX, _raise_program_errors,
+                           check_finite)
+    try:
+        if sync_fn is not None:
+            sync_fn()
+        has_guards = bool(errors) and any(
+            m.startswith(GUARD_MSG_PREFIX) for m in errors)
+        if array_safety or has_guards:
+            _raise_program_errors(errors, include_non_guard=array_safety)
+        if check_nan_inf:
+            check_finite(list(zip(fetch_names, fetches)) +
+                         list(zip(state_out, new_state)), context=context)
+    except BaseException:
+        if prefetcher is not None:
+            prefetcher.rollback(cancelled=cancelled)
+        raise
+
+
+def call_with_aval_fallback(call, jitted, aot_entry, find_aot_entry,
+                            rebuild):
+    """The fixed-aval Compiled call-time fallback, one copy for both
+    executors: a plain jit retraces by itself (a TypeError/ValueError
+    there is real), but a `jax.stages.Compiled` — AOT-loaded from disk,
+    or an in-process eager-AOT entry whose state avals drifted under an
+    unchanged key — rejects the live argument avals (TypeError) or their
+    device placement (ValueError: a deserialized artifact is bound to
+    the concrete devices it was compiled for). Aval/placement checking
+    precedes execution, so nothing was donated or consumed: discard the
+    disk entry and call `rebuild()`'s fresh (retracing, donating) jit —
+    the cache's only failure mode. Returns (result, fell_back)."""
+    import jax as _jax
+    try:
+        return call(jitted), False
+    except (TypeError, ValueError):
+        if aot_entry is None and not isinstance(jitted,
+                                                _jax.stages.Compiled):
+            raise
+        if aot_entry is None:
+            aot_entry = find_aot_entry()
+        if aot_entry is not None:
+            from . import compile_cache
+            compile_cache.discard_bad_entry(
+                *aot_entry, reason="argument avals rejected at call time")
+        return call(rebuild()), True
+
+
+def profile_dispatch(owner, tag, sync_tag, t0, arrays, compiled, aot_hit,
+                     aot_saved, aot_compile_s):
+    """Profiling-mode dispatch accounting (one copy): sync, per-tag
+    seconds (a compiled call's seconds include its eager-AOT compile —
+    it ran before t0, so add it back or Compile(s) reports a 30s compile
+    as free), and the device-idle gap — this dispatch STARTED after the
+    previous one had already completed, so the device sat with nothing
+    queued for (t0 - last_ready). `owner` carries `_last_ready_t`."""
+    import jax as _jax
+    from .. import profiler as _prof
+    _prof.note_sync(sync_tag)
+    _jax.block_until_ready(arrays)
+    t_ready = time.perf_counter()
+    idle = None
+    if owner._last_ready_t is not None and t0 > owner._last_ready_t:
+        idle = t0 - owner._last_ready_t
+    owner._last_ready_t = t_ready
+    _prof.record_run(tag, t_ready - t0 + (aot_compile_s if compiled
+                                          else 0.0),
+                     compiled=compiled, aot_hit=aot_hit,
+                     saved_s=aot_saved, idle_s=idle)
+
+
+def run_with_deadline(fn, timeout, what="dispatch"):
+    """Run fn(cancelled_event) on a watchdog-monitored worker thread and
+    join with `timeout` seconds. On expiry the worker is abandoned (its
+    cancelled event set, so it won't touch the scope when it eventually
+    unblocks) and DispatchTimeoutError raises on the caller's thread.
+    The jax context that matters (default_device) is thread-local, so fn
+    must establish it itself."""
+    from .executor import DispatchTimeoutError
+    box = {}
+    cancelled = threading.Event()
+
+    def work():
+        try:
+            box["value"] = fn(cancelled)
+        except BaseException as e:  # noqa: BLE001 — re-raised on caller
+            box["error"] = e
+
+    t = threading.Thread(target=work, daemon=True, name="ptpu-watchdog")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        cancelled.set()
+        raise DispatchTimeoutError(
+            "%s did not complete within %.3fs (hang watchdog)"
+            % (what, timeout))
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+def dispatch_with_deadline(run_impl, timeout, what):
+    """The executors' shared watchdog wrapper: run
+    `run_impl(cancelled, info)` under `run_with_deadline` and attach the
+    compile-cache key the impl recorded in `info` to a timeout raise —
+    ONE copy of the protocol for Executor.run and
+    ParallelExecutor.run."""
+    from .executor import DispatchTimeoutError
+    info = {}
+    try:
+        return run_with_deadline(
+            lambda cancelled: run_impl(cancelled, info), timeout,
+            what=what)
+    except DispatchTimeoutError as e:
+        e.cache_key = info.get("cache_key")
+        raise
+
+
+def run_compile_probe(cache, run_fn):
+    """Did `run_fn()` insert a new compiled entry into `cache`? Compares
+    the key SET, not its length — at LRU capacity an insert+evict keeps
+    the length constant. The serving engine's compile detection (warmup
+    accounting, the steady-state-never-compiles gate), one copy for its
+    Executor and ParallelExecutor paths. Returns (result, compiled)."""
+    before = set(cache)
+    out = run_fn()
+    return out, any(k not in before for k in cache)
+
+
+class TapCounter(object):
+    """A replica's monotone dispatch counter — the key serving faults
+    fire on. Owned by the pool's replica slot (NOT the tap) so the count
+    survives engine swaps: `reload()` attaches a fresh ReplicaTap per
+    engine generation, and a fault plan keyed on dispatch N must see one
+    consistent per-replica sequence across generations."""
+
+    __slots__ = ("_lock", "n")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def take(self):
+        with self._lock:
+            n, self.n = self.n, self.n + 1
+            return n
+
+
+class ReplicaTap(object):
+    """The serving-side fault-injection tap — the serving runtime's
+    frontend of the same fault registry the executor hooks above serve
+    (resilience/faults.py). The ReplicaPool attaches one per replica
+    engine (and one to a canary engine, replica_id="canary"); the engine
+    fires it at the top of every batch dispatch, BEFORE padding, so a
+    raise fails only that group and the batcher's isolation turns it
+    into per-request exceptions the pool can fail over.
+
+    The tap captures the engine it is ATTACHED to, never resolving the
+    replica's engine pointer at dispatch time: during a swap the
+    outgoing engine's drain still dispatches, and a replica_poison
+    landing there must poison the engine being drained — not NaN the
+    freshly promoted replacement's weights through a stale tap."""
+
+    __slots__ = ("replica_id", "engine", "counter")
+
+    def __init__(self, replica_id, engine, counter=None):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.counter = counter if counter is not None else TapCounter()
+
+    def __call__(self):
+        count = self.counter.take()
+        from ..resilience import faults as _faults
+        plan = _faults.active_plan()
+        if plan is not None:
+            plan.serving_fault(self.replica_id, count, engine=self.engine)
 
 
 def rollback_all_staged(scope=None):

@@ -432,50 +432,12 @@ class DispatchTimeoutError(RuntimeError):
         self.cache_key = cache_key
 
 
-def dispatch_with_deadline(run_impl, timeout, what):
-    """The executors' shared watchdog wrapper: run
-    `run_impl(cancelled, info)` under `run_with_deadline` and attach the
-    compile-cache key the impl recorded in `info` to a timeout raise —
-    ONE copy of the protocol for Executor.run and
-    ParallelExecutor.run."""
-    info = {}
-    try:
-        return run_with_deadline(
-            lambda cancelled: run_impl(cancelled, info), timeout,
-            what=what)
-    except DispatchTimeoutError as e:
-        e.cache_key = info.get("cache_key")
-        raise
-
-
-def run_with_deadline(fn, timeout, what="dispatch"):
-    """Run fn(cancelled_event) on a watchdog-monitored worker thread and
-    join with `timeout` seconds. On expiry the worker is abandoned (its
-    cancelled event set, so it won't touch the scope when it eventually
-    unblocks) and DispatchTimeoutError raises on the caller's thread.
-    The jax context that matters (default_device) is thread-local, so fn
-    must establish it itself."""
-    import threading
-    box = {}
-    cancelled = threading.Event()
-
-    def work():
-        try:
-            box["value"] = fn(cancelled)
-        except BaseException as e:  # noqa: BLE001 — re-raised on caller
-            box["error"] = e
-
-    t = threading.Thread(target=work, daemon=True, name="ptpu-watchdog")
-    t.start()
-    t.join(timeout)
-    if t.is_alive():
-        cancelled.set()
-        raise DispatchTimeoutError(
-            "%s did not complete within %.3fs (hang watchdog)"
-            % (what, timeout))
-    if "error" in box:
-        raise box["error"]
-    return box.get("value")
+# the watchdog plumbing lives ONCE in the shared dispatch core
+# (core/dispatch.py); re-exported here because DispatchTimeoutError and
+# every historical import site (resilience/watchdog.py, tests) live on
+# this module's surface
+from .dispatch import (dispatch_with_deadline,  # noqa: E402,F401
+                       run_with_deadline)
 
 
 # Fault-injection hook (resilience/faults.py): None in production. When a
@@ -778,62 +740,21 @@ class Executor(object):
                                  _feed_signature(feed_arrays),
                                  tuple(fetch_names))
 
-        # cluster step barrier (resilience/cluster.py): a fenced cohort
-        # stops HERE, before anything is consumed — including anything a
-        # prefetcher staged: a hook raise refunds the staged pops so a
-        # fenced/faulted attempt still consumes nothing
-        pf = self._prefetcher
-        try:
-            if _barrier_hook is not None:
-                _barrier_hook("dispatch", program=program, steps=steps)
-
-            # fault-injection seam (resilience/faults.py): BEFORE the io
-            # pre-pass and the seed draw, so an injected dispatch failure
-            # or slow step consumes no reader records and no rng — a
-            # retried step replays bit-exactly
-            if _fault_hook is not None:
-                _fault_hook("dispatch", program=program, steps=steps,
-                            feed_arrays=feed_arrays)
-        except BaseException:
-            if pf is not None:
-                pf.rollback(cancelled=cancelled)
-            raise
-
+        # pre-dispatch hooks + host-io consume: the shared dispatch-guard
+        # seam (core/dispatch.py) — the cluster fence and fault-injection
+        # hooks fire BEFORE the io pre-pass and seed draw (a fenced or
+        # faulted attempt consumes nothing), with any staged prefetch
+        # block refunded on a hook raise
         from . import dispatch as _dispatch
+        pf = self._prefetcher
+        _dispatch.run_dispatch_hooks(program, steps, feed_arrays,
+                                     prefetcher=pf, cancelled=cancelled)
         stacked_names = set()
-        staged = None
-        iosp = tspan.child("exec/host_io")
-        try:
-            if pf is not None and pf.has_work():
-                # consult the prefetcher even on a prefetch=False call: a
-                # staged block for a different signature must be refunded
-                # BEFORE the inline prepass pops the stream, or the staged
-                # records would replay out of order
-                staged = pf.take(program, scope, steps, False,
-                                 cancelled=cancelled)
-                if staged is _dispatch.CANCELLED:
-                    # deadline raised on the caller's thread; close the
-                    # span — this abandoned worker's host io is OVER,
-                    # and an early return skips the normal end below
-                    iosp.end(error="DispatchCancelled")
-                    return None
-            if staged is not None:
-                feed_arrays.update(staged.arrays)
-                stacked_names = set(staged.stacked)
-            else:
-                try:
-                    run_host_io_prepass(program, scope, feed_arrays,
-                                        steps=steps,
-                                        stacked_out=stacked_names,
-                                        cancelled=cancelled,
-                                        place=self.place)
-                except _DispatchCancelled:
-                    iosp.end(error="DispatchCancelled")
-                    return None  # deadline raised on the caller's thread
-        except BaseException as e:  # EOF / reader faults: close the
-            iosp.end(error=type(e).__name__)   # span, the fault rides up
-            raise
-        iosp.end(staged=staged is not None)
+        staged = _dispatch.consume_host_io(
+            self, program, scope, steps, False, cancelled, feed_arrays,
+            stacked_names, tspan, place=self.place)
+        if staged is _dispatch.CANCELLED:
+            return None  # deadline raised on the caller's thread
         if cancelled is not None and cancelled.is_set():
             return None
 
@@ -972,42 +893,25 @@ class Executor(object):
         # recorder dump needs to show
         dsp = tspan.child("exec/dispatch")
         t0 = time.perf_counter() if profiling else 0.0
-        try:
+
+        def _call(fn_obj):
             with jax.default_device(self.place.device()):
-                fetches, new_state, errors = jitted(
-                    [feed_arrays[n] for n in feed_names],
-                    read_state(state_rw), read_state(state_ro), seed)
-        except (TypeError, ValueError):
-            if aot_entry is None and not isinstance(
-                    jitted, jax.stages.Compiled):
-                raise  # a plain jit retraces by itself; this is real
-            # a fixed-aval Compiled rejected the live argument avals
-            # (TypeError) or their device placement (ValueError — a
-            # deserialized artifact is bound to the concrete devices it
-            # was compiled for, and a device-id key mismatch from an
-            # older cache schema surfaces here) — either an AOT-loaded
-            # entry recorded under different aval promotion, or an
-            # in-process entry whose state avals drifted under an
-            # unchanged key (e.g. a persistable restored at a different
-            # dtype), which the donating jit used to absorb by
-            # retracing. Aval/placement checking precedes execution, so
-            # nothing was donated/consumed — drop the disk entry and
-            # fall back to a fresh (retracing) compile, the cache's
-            # only failure mode.
-            if aot_entry is None:
-                aot_dir = compile_cache.active_aot_cache_dir()
-                akey = compile_cache.aot_entry_key(
-                    program, _feed_signature(feed_arrays),
-                    tuple(fetch_names), trace_env_key(), multi_sig,
-                    self.place.device()) if aot_dir else None
-                if akey is not None:
-                    aot_entry = (aot_dir, akey[0])
-            if aot_entry is not None:
-                compile_cache.discard_bad_entry(
-                    *aot_entry, reason="argument avals rejected at "
-                    "call time")
-            aot_hit, aot_saved, aot_entry = False, 0.0, None
-            compiled = True
+                return fn_obj([feed_arrays[n] for n in feed_names],
+                              read_state(state_rw), read_state(state_ro),
+                              seed)
+
+        def _find_aot_entry():
+            aot_dir = compile_cache.active_aot_cache_dir()
+            if not aot_dir:
+                return None
+            akey = compile_cache.aot_entry_key(
+                program, _feed_signature(feed_arrays),
+                tuple(fetch_names), trace_env_key(), multi_sig,
+                self.place.device())
+            return (aot_dir, akey[0])
+
+        def _rebuild():
+            # fresh (retracing, donating) jit — see call_with_aval_fallback
             if steps > 1:
                 fn = lowering.lower_multi_step(
                     program, feed_names, fetch_names, state_rw, state_ro,
@@ -1017,15 +921,19 @@ class Executor(object):
                 fn = lowering.build_program_fn(
                     program, feed_names, fetch_names, state_rw, state_ro,
                     state_out, collect_errors=True)
-            jitted = jax.jit(fn, donate_argnums=(1,))
-            entry = (jitted, state_rw, state_ro, state_out)
+            fresh = jax.jit(fn, donate_argnums=(1,))
             if use_program_cache:
-                _cache_put_lru(self._cache, key, entry,
+                _cache_put_lru(self._cache, key,
+                               (fresh, state_rw, state_ro, state_out),
                                _jit_cache_capacity())
-            with jax.default_device(self.place.device()):
-                fetches, new_state, errors = jitted(
-                    [feed_arrays[n] for n in feed_names],
-                    read_state(state_rw), read_state(state_ro), seed)
+            return fresh
+
+        (fetches, new_state, errors), fell_back = \
+            _dispatch.call_with_aval_fallback(
+                _call, jitted, aot_entry, _find_aot_entry, _rebuild)
+        if fell_back:
+            compiled, aot_hit, aot_saved, aot_entry = \
+                True, False, 0.0, None
         dsp.end(compiled=compiled, aot_hit=aot_hit)
         if cancelled is not None and cancelled.is_set():
             # the caller already raised DispatchTimeoutError and may be
@@ -1062,53 +970,24 @@ class Executor(object):
             pf = _dispatch.kick_next_prepass(
                 self, program, scope, steps, False, cancelled, "exe",
                 place=self.place)
-        try:
-            if profiling:
-                _prof.note_sync("executor/profiling")
-                jax.block_until_ready((fetches, new_state))
-                t_ready = time.perf_counter()
-                dt = t_ready - t0
-                # device-idle gap: this dispatch STARTED after the
-                # previous one had already completed — the device sat
-                # with nothing queued for (t0 - last_ready). Observable
-                # only in profiling mode, where completion times exist.
-                idle = None
-                if self._last_ready_t is not None and t0 > self._last_ready_t:
-                    idle = t0 - self._last_ready_t
-                self._last_ready_t = t_ready
-                tag = "program_%s(v%d)%s fetch=%s" % (
-                    getattr(program, "_uid", "?"), program._version,
-                    " x%d" % steps if steps > 1 else "",
-                    ",".join(fetch_names) or "-")
-                # a compiled call's seconds include its compile, like the
-                # lazy-jit path where tracing happens inside the timed
-                # dispatch — the eager AOT lower+compile ran before t0, so
-                # add it back or Compile(s) reports a 30s compile as free
-                _prof.record_run(tag, dt + (aot_compile_s if compiled
-                                            else 0.0),
-                                 compiled=compiled, aot_hit=aot_hit,
-                                 saved_s=aot_saved, idle_s=idle)
-            # guard flags raise even with FLAGS_tensor_array_safety=0: a
-            # program that INSTALLED guards opted into the one-fetch sync
-            has_guards = bool(errors) and any(
-                m.startswith(GUARD_MSG_PREFIX) for m in errors)
-            if self._array_safety or has_guards:
-                _raise_program_errors(errors,
-                                      include_non_guard=self._array_safety)
-            if self._check_nan_inf:
-                check_finite(
-                    list(zip(fetch_names, fetches)) +
-                    list(zip(state_out, new_state)),
-                    context="Executor.run")
-        except BaseException:
-            # a raise after the kick (tripped guard, nan check) hands
-            # control to a supervisor that may drop batches or restore
-            # reader positions: the just-staged next block must be
-            # refunded first so the stream position is exactly what the
-            # failed step left (its own records consumed, nothing more)
-            if pf is not None:
-                pf.rollback(cancelled=cancelled)
-            raise
+        def _sync_extra():
+            if not profiling:
+                return
+            tag = "program_%s(v%d)%s fetch=%s" % (
+                getattr(program, "_uid", "?"), program._version,
+                " x%d" % steps if steps > 1 else "",
+                ",".join(fetch_names) or "-")
+            _dispatch.profile_dispatch(
+                self, tag, "executor/profiling", t0,
+                (fetches, new_state), compiled, aot_hit, aot_saved,
+                aot_compile_s)
+
+        # guard-flag raise + FLAGS_check_nan_inf sweep + refund-on-raise:
+        # the shared post-dispatch choreography (core/dispatch.py)
+        _dispatch.run_post_dispatch_checks(
+            errors, fetches, fetch_names, new_state, state_out,
+            self._array_safety, self._check_nan_inf, "Executor.run",
+            prefetcher=pf, cancelled=cancelled, sync_fn=_sync_extra)
         if return_numpy:
             _prof.note_sync("executor/return_numpy")
             with tspan.child("exec/d2h"):

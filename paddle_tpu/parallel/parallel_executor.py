@@ -28,10 +28,9 @@ import jax.numpy as jnp
 from ..core import lowering
 from ..core.framework import default_main_program
 from ..core.executor import (global_scope, _feed_signature,
-                             _nan_inf_enabled, _raise_program_errors,
-                             _array_safety_enabled, check_finite,
-                             convert_feeds, run_host_io_prepass,
-                             _cache_put_lru, _jit_cache_capacity)
+                             _nan_inf_enabled, _array_safety_enabled,
+                             convert_feeds, _cache_put_lru,
+                             _jit_cache_capacity)
 from ..core.utils import find_var as _find_var
 from ..observability import trace as _otrace
 from .mesh import data_parallel_mesh, replicated, batch_sharded, NamedSharding, P
@@ -173,7 +172,7 @@ class ParallelExecutor(object):
         if timeout is None:
             return self._run_impl(fetch_list, feed, feed_dict, return_numpy,
                                   steps, fetch_reduce, prefetch=prefetch)
-        from ..core.executor import dispatch_with_deadline
+        from ..core.dispatch import dispatch_with_deadline
         return dispatch_with_deadline(
             lambda cancelled, info: self._run_impl(
                 fetch_list, feed, feed_dict, return_numpy, steps,
@@ -216,7 +215,6 @@ class ParallelExecutor(object):
 
         # strict mode (FLAGS_validate_program): same pre-lowering static
         # verification Executor.run performs
-        from ..core import executor as _exe_mod
         from ..core.executor import maybe_validate_program
         maybe_validate_program(program, feed_arrays, fetch_names, steps,
                                self._validated)
@@ -227,27 +225,14 @@ class ParallelExecutor(object):
                                  _feed_signature(feed_arrays),
                                  tuple(fetch_names))
 
-        # same cluster step barrier as Executor._run_impl: a fenced
-        # cohort stops before anything is consumed — a hook raise also
-        # refunds anything a prefetcher staged (fence-consumes-nothing
-        # covers the staged block too)
+        # pre-dispatch hooks (cluster fence + fault seam) via the shared
+        # dispatch-guard choreography — before the io pre-pass and seed
+        # draw, staged prefetch refunded on a hook raise (ONE copy with
+        # Executor: core/dispatch.run_dispatch_hooks)
+        from ..core import dispatch as _dispatch
         pf = self._prefetcher
-        try:
-            if _exe_mod._barrier_hook is not None:
-                _exe_mod._barrier_hook("dispatch", program=program,
-                                       steps=steps)
-
-            # same fault-injection seam as Executor._run_impl: before the
-            # io pre-pass and seed draw, so injected failures consume
-            # nothing
-            if _exe_mod._fault_hook is not None:
-                _exe_mod._fault_hook("dispatch", program=program,
-                                     steps=steps,
-                                     feed_arrays=feed_arrays)
-        except BaseException:
-            if pf is not None:
-                pf.rollback(cancelled=cancelled)
-            raise
+        _dispatch.run_dispatch_hooks(program, steps, feed_arrays,
+                                     prefetcher=pf, cancelled=cancelled)
 
         def _batch_leading(name):
             return _var_batch_leading(_find_var(program, name))
@@ -276,42 +261,15 @@ class ParallelExecutor(object):
                     _check_divisible(
                         f, "reader record field %r" % getattr(v, "name", "?"))
 
-        from ..core import dispatch as _dispatch
-        from ..core.executor import _DispatchCancelled
+        # host-io consume via the shared choreography (ONE copy with
+        # Executor: staged-block identity check, mismatch refund, inline
+        # prepass fallback, honest span closure)
         stacked_names = set()
-        staged = None
-        iosp = tspan.child("exec/host_io")
-        try:
-            if pf is not None and pf.has_work():
-                # consult even on a prefetch=False call: a mismatched
-                # staged block must be refunded before the inline
-                # prepass pops
-                staged = pf.take(program, scope, steps, True,
-                                 cancelled=cancelled)
-                if staged is _dispatch.CANCELLED:
-                    # deadline raised on the caller; an early return
-                    # skips the normal end below — close the span or it
-                    # haunts every later bundle as a phantom open span
-                    iosp.end(error="DispatchCancelled")
-                    return None
-            if staged is not None:
-                feed_arrays.update(staged.arrays)
-                stacked_names = set(staged.stacked)
-            else:
-                try:
-                    run_host_io_prepass(program, scope, feed_arrays,
-                                        host=True,
-                                        validate=_validate_record,
-                                        steps=steps,
-                                        stacked_out=stacked_names,
-                                        cancelled=cancelled)
-                except _DispatchCancelled:
-                    iosp.end(error="DispatchCancelled")
-                    return None  # watchdog deadline raised on the caller
-        except BaseException as e:  # EOF / reader faults ride up closed
-            iosp.end(error=type(e).__name__)
-            raise
-        iosp.end(staged=staged is not None)
+        staged = _dispatch.consume_host_io(
+            self, program, scope, steps, True, cancelled, feed_arrays,
+            stacked_names, tspan, validate=_validate_record)
+        if staged is _dispatch.CANCELLED:
+            return None  # watchdog deadline raised on the caller
         feed_names = sorted(feed_arrays)
 
         def _sharding_for(name, ndim, stacked):
@@ -537,41 +495,31 @@ class ParallelExecutor(object):
         # device-enqueue span (async; see Executor) — open = wedged here
         dsp = tspan.child("exec/dispatch")
         t0 = _time.perf_counter() if profiling else 0.0
-        try:
-            with _donating_call_guard(jitted):
-                fetches, new_state, errors = jitted(
-                    feed_vals, read_state(state_rw),
-                    read_state(state_ro, commit=True), seed)
-        except (TypeError, ValueError):
-            if aot_entry is None and not isinstance(
-                    jitted, jax.stages.Compiled):
-                raise  # a plain jit retraces by itself; this is real
-            # a fixed-aval Compiled (AOT-loaded, or in-process under
-            # drifted state avals) rejected the live arguments — aval
-            # (TypeError) or device/sharding (ValueError: an artifact
-            # is bound to the concrete devices it was compiled for)
-            # checking precedes execution, nothing was consumed; drop
-            # the disk entry and fall back to a fresh donating jit
-            # (see Executor._run_impl for the matching path)
-            if aot_entry is None:
-                aot_dir_, akey_ = aot_key()
-                if akey_ is not None:
-                    aot_entry = (aot_dir_, akey_[0])
-            if aot_entry is not None:
-                compile_cache.discard_bad_entry(
-                    *aot_entry, reason="argument avals rejected at "
-                    "call time")
-            aot_hit, aot_saved, aot_entry = False, 0.0, None
-            compiled = True
-            jitted = build_jitted(state_rw, state_ro, state_out,
-                                  donate=True)
-            entry = (jitted, state_rw, state_ro, state_out)
-            _cache_put_lru(self._cache, key, entry,
+
+        def _call(fn_obj):
+            with _donating_call_guard(fn_obj):
+                return fn_obj(feed_vals, read_state(state_rw),
+                              read_state(state_ro, commit=True), seed)
+
+        def _find_aot_entry():
+            aot_dir_, akey_ = aot_key()
+            return (aot_dir_, akey_[0]) if akey_ is not None else None
+
+        def _rebuild():
+            # fresh donating jit — see call_with_aval_fallback
+            fresh = build_jitted(state_rw, state_ro, state_out,
+                                 donate=True)
+            _cache_put_lru(self._cache, key,
+                           (fresh, state_rw, state_ro, state_out),
                            _jit_cache_capacity())
-            with _donating_call_guard(jitted):
-                fetches, new_state, errors = jitted(
-                    feed_vals, read_state(state_rw),
-                    read_state(state_ro, commit=True), seed)
+            return fresh
+
+        (fetches, new_state, errors), fell_back = \
+            _dispatch.call_with_aval_fallback(
+                _call, jitted, aot_entry, _find_aot_entry, _rebuild)
+        if fell_back:
+            compiled, aot_hit, aot_saved, aot_entry = \
+                True, False, 0.0, None
         dsp.end(compiled=compiled, aot_hit=aot_hit)
         if cancelled is not None and cancelled.is_set():
             # caller already raised DispatchTimeoutError; a late scope
@@ -606,46 +554,27 @@ class ParallelExecutor(object):
             pf = _dispatch.kick_next_prepass(
                 self, program, scope, steps, True, cancelled, "pexe",
                 validate=_validate_record, stage_fn=_stage)
-        try:
+        def _sync_extra():
             if self._sync_dispatch and not sync:
                 _prof.note_sync("pexe/cpu_collective_serialize")
                 jax.block_until_ready((fetches, new_state))
             if profiling:
-                _prof.note_sync("pexe/profiling")
-                jax.block_until_ready((fetches, new_state))
-                t_ready = _time.perf_counter()
-                idle = None
-                if self._last_ready_t is not None \
-                        and t0 > self._last_ready_t:
-                    idle = t0 - self._last_ready_t
-                self._last_ready_t = t_ready
                 tag = "pexe_program_%s(v%d)x%d fetch=%s" % (
                     program._uid, program._version, self.device_count,
                     ",".join(fetch_names) or "-")
-                # add the eager AOT compile time back for compiled calls —
-                # it ran before t0 (see Executor._run_impl)
-                _prof.record_run(tag, t_ready - t0
-                                 + (aot_compile_s if compiled else 0.0),
-                                 compiled=compiled, aot_hit=aot_hit,
-                                 saved_s=aot_saved, idle_s=idle)
-            from ..core.executor import GUARD_MSG_PREFIX
-            has_guards = bool(errors) and any(
-                m.startswith(GUARD_MSG_PREFIX) for m in errors)
-            if self._array_safety or has_guards:
-                _raise_program_errors(errors,
-                                      include_non_guard=self._array_safety)
-            if self._check_nan_inf:
-                check_finite(
-                    list(zip(fetch_names, fetches)) +
-                    list(zip(state_out, new_state)),
-                    context="ParallelExecutor.run")
-        except BaseException:
-            # raise after the kick (tripped guard, nan check): refund the
-            # staged next block so the stream position is exactly what
-            # the failed step left (see Executor._run_impl)
-            if pf is not None:
-                pf.rollback(cancelled=cancelled)
-            raise
+                _dispatch.profile_dispatch(
+                    self, tag, "pexe/profiling", t0,
+                    (fetches, new_state), compiled, aot_hit, aot_saved,
+                    aot_compile_s)
+
+        # guard-flag raise + FLAGS_check_nan_inf sweep + refund-on-raise
+        # via the shared post-dispatch choreography (ONE copy with
+        # Executor: core/dispatch.run_post_dispatch_checks)
+        _dispatch.run_post_dispatch_checks(
+            errors, fetches, fetch_names, new_state, state_out,
+            self._array_safety, self._check_nan_inf,
+            "ParallelExecutor.run", prefetcher=pf, cancelled=cancelled,
+            sync_fn=_sync_extra)
         if return_numpy:
             _prof.note_sync("pexe/return_numpy")
             with tspan.child("exec/d2h"):

@@ -44,9 +44,20 @@ Arming a plan installs hooks at three seams:
     case only per-attempt timeouts can detect — and `replica_poison@N`
     NaNs every float value in the replica's private Scope, the
     crashed-trainer-pushed-garbage-weights case the pool's finite-output
-    check must catch. One-shot entries fire on the FIRST replica to
-    reach count N; the recovery invariant (zero client-visible errors)
-    must hold whichever replica that is.
+    check must catch. The fleet chaos kinds ride the same tap:
+    `replica_slow@N[:secs]` sleeps a SHORT, repeatable latency (default
+    0.2s; arm with `*`) — the slow-but-alive replica the pool's latency
+    breaker must brown out, as opposed to the wedge only timeouts see;
+    `replica_crash@N` kills the engine abruptly MID-WINDOW (the batcher
+    closes drain=False from a side thread while this dispatch fails) —
+    queued and in-flight requests on it must all resolve via failover,
+    nothing may hang; `canary_poison@N` corrupts weights like
+    replica_poison but fires ONLY on a canary engine's tap
+    (replica_id == "canary") — the bad-canary case promotion gating
+    must catch and auto-roll-back with zero client errors. One-shot
+    entries fire on the FIRST replica to reach count N; the recovery
+    invariant (zero client-visible errors) must hold whichever replica
+    that is.
 
 Entries are ONE-SHOT by default (`kind@idx`); `kind@idx*` repeats every
 time the index matches. One plan may be armed per process at a time.
@@ -57,13 +68,15 @@ import threading
 import numpy as np
 
 __all__ = ["FaultPlan", "InjectedFault", "InjectedDispatchError",
-           "InjectedReaderError", "InjectedReplicaError", "active_plan"]
+           "InjectedReaderError", "InjectedReplicaError",
+           "InjectedReplicaCrash", "active_plan"]
 
 _KINDS = frozenset({
     "nan_feed", "dispatch_exc", "slow_step",
     "reader_nan", "reader_exc", "reader_stall", "reader_eof",
     "ckpt_kill", "host_death", "heartbeat_stall",
     "replica_exc", "replica_wedge", "replica_poison",
+    "replica_slow", "replica_crash", "canary_poison",
 })
 _READER_KINDS = frozenset({"reader_nan", "reader_exc", "reader_stall",
                            "reader_eof"})
@@ -88,6 +101,14 @@ class InjectedReplicaError(InjectedFault):
     """Injected serving-replica dispatch failure (fault kind
     `replica_exc`); tagged replica-class so the pool's failover logic
     and tests can tell an injected replica fault from an organic one."""
+    _replica_fault = True
+
+
+class InjectedReplicaCrash(InjectedFault):
+    """Injected abrupt replica death (fault kind `replica_crash`): the
+    replica's engine is force-closed (no drain) mid-window while this
+    dispatch fails — the pool must fail everything queued on it over
+    with zero client-visible errors and no hangs."""
     _replica_fault = True
 
 
@@ -316,9 +337,38 @@ class FaultPlan(object):
             # queued behind this dispatch stalls — only the pool's
             # per-attempt timeout can see it, exactly like a real wedge
             time.sleep(e.arg if e.arg is not None else 3600.0)
+        e = self._take(("replica_slow",), dispatch_count)
+        if e is not None:
+            import time
+            # SHORT, usually repeated (`replica_slow@0:0.2*`): the
+            # slow-but-answering replica — requests complete, latency
+            # collapses; the pool's latency breaker (and the fleet's
+            # brownout) must act on measurements, not timeouts
+            time.sleep(e.arg if e.arg is not None else 0.2)
+        if replica_id == "canary":
+            # canary-targeted corruption: fires only on the canary
+            # engine's tap, never a serving replica's — the bad-canary
+            # rollback leg must not depend on routing luck
+            e = self._take(("canary_poison",), dispatch_count)
+            if e is not None and engine is not None:
+                _poison_scope_floats(engine._scope)
         e = self._take(("replica_poison",), dispatch_count)
         if e is not None and engine is not None:
             _poison_scope_floats(engine._scope)
+        e = self._take(("replica_crash",), dispatch_count)
+        if e is not None and engine is not None:
+            import threading
+            # abrupt death mid-window: close(drain=False) fails every
+            # queued/formed request with ServingClosedError — from a
+            # SIDE thread, because close() joins the very batcher
+            # worker this tap runs on — while the current dispatch
+            # fails with the typed crash error
+            threading.Thread(
+                target=lambda: engine.close(drain=False, timeout=5.0),
+                daemon=True, name="ptpu-fault-crash").start()
+            raise InjectedReplicaCrash(
+                "injected replica crash on replica %s at dispatch %d "
+                "(fault plan)" % (replica_id, dispatch_count))
         e = self._take(("replica_exc",), dispatch_count)
         if e is not None:
             raise InjectedReplicaError(

@@ -21,15 +21,25 @@ health-gated least-loaded routing, circuit breakers, failover retry +
 tail hedging, adaptive admission, zero-downtime weight reload). Both
 engine and pool serve models BIGGER than one chip: `tp=M` spans a
 replica over M devices with weights sharded 1/M at rest by the
-tensor-parallel ShardingPlan, bit-identical to a mesh-1 engine. CLI:
-`tools/ptpu_serve.py` (`--replicas N`, `--tp M`, `--selfcheck
+tensor-parallel ShardingPlan, bit-identical to a mesh-1 engine. The
+fleet layer makes the pool self-driving: `autoscaler.PoolAutoscaler`
+grows/shrinks replicas off the admission/queue/idle signals
+(`ReplicaPool(autoscale=True, ...)`), `canary.CanaryController`
+(`pool.promote()`) gates snapshot promotion on a mirrored traffic
+slice with auto-rollback at zero client errors, and `fleet.ModelFleet`
+serves N models with per-model replica sets and priority brownout.
+CLI: `tools/ptpu_serve.py` (`--replicas N`, `--tp M`, `--autoscale
+MIN,MAX`, `--extra-model NAME=DIR@PRIO`, `--selfcheck
 --kill-replica`). Design notes: ARCHITECTURE.md §15 (engine/batcher),
-§20 (the pool), §23 (tensor-parallel replicas).
+§20 (the pool), §23 (tensor-parallel replicas), §26 (the fleet).
 """
+from .autoscaler import PoolAutoscaler
 from .batcher import (Batcher, DeadlineExceededError, QueueFullError,
                       RequestFuture, RequestTooLargeError, ServingClosedError,
                       ServingError)
+from .canary import CanaryController, CanaryFuture
 from .engine import InferenceEngine, InvalidRequestError, ResultSlice
+from .fleet import BrownoutError, ModelFleet
 from .metrics import ServingMetrics
 from .pool import (AttemptTimeoutError, PoisonedOutputError, PoolFuture,
                    PoolMetrics, PoolResult, ReplicaPool)
@@ -42,4 +52,6 @@ __all__ = [
     "InvalidRequestError",
     "ReplicaPool", "PoolFuture", "PoolResult", "PoolMetrics",
     "AttemptTimeoutError", "PoisonedOutputError",
+    "PoolAutoscaler", "CanaryController", "CanaryFuture",
+    "ModelFleet", "BrownoutError",
 ]

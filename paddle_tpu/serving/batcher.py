@@ -46,7 +46,11 @@ class ServingError(RuntimeError):
 
 
 class QueueFullError(ServingError):
-    """Fast rejection: the bounded request queue is at capacity."""
+    """Fast rejection: the bounded request queue is at capacity.
+    `retry_after_s`, when set (the ReplicaPool/fleet derive it from the
+    AIMD admission state), is the client backoff hint the HTTP layer
+    surfaces as a 429 `Retry-After` header."""
+    retry_after_s = None
 
 
 class DeadlineExceededError(ServingError):

@@ -647,25 +647,24 @@ class InferenceEngine(object):
         A tensor-parallel engine dispatches through its mesh-bound
         ParallelExecutor instead (same Scope, same bucket lattice,
         same FetchHandle surface — the batcher can't tell)."""
+        from ..core.dispatch import run_compile_probe
         with self._run_lock:
             if self._pexe is not None:
-                before = set(self._pexe._cache)
-                handles = self._pexe.run(self.fetch_names, feed=feed,
-                                         return_numpy=False)
-                compiled = any(k not in before
-                               for k in self._pexe._cache)
-                return handles, compiled
-            before = set(self._exe._cache)
+                return run_compile_probe(
+                    self._pexe._cache,
+                    lambda: self._pexe.run(self.fetch_names, feed=feed,
+                                           return_numpy=False))
             # validate=False: the engine already verified the program at
             # load; re-validating per (bucket) feed signature would walk
             # the whole program once more per warmup shape under
             # FLAGS_validate_program=1
-            handles = self._exe.run(self.program, feed=feed,
-                                    fetch_list=self.fetch_names,
-                                    scope=self._scope, return_numpy=False,
-                                    validate=False)
-            compiled = any(k not in before for k in self._exe._cache)
-        return handles, compiled
+            return run_compile_probe(
+                self._exe._cache,
+                lambda: self._exe.run(self.program, feed=feed,
+                                      fetch_list=self.fetch_names,
+                                      scope=self._scope,
+                                      return_numpy=False,
+                                      validate=False))
 
     def _dispatch(self, requests):
         """Batcher callback. Requests are grouped by concrete-shape

@@ -53,6 +53,7 @@ import time
 
 import numpy as np
 
+from ..core import dispatch as _dispatch
 from ..observability import trace as _otrace
 from .batcher import (DeadlineExceededError, QueueFullError,
                       RequestTooLargeError, ServingClosedError,
@@ -222,10 +223,32 @@ class _Admission(object):
         with self._lock:
             self.limit = max(self.lo, self.limit * self._decrease)
 
+    def set_bounds(self, hi, lo):
+        """Pool membership changed (autoscale / kill / restart). On a
+        GROWN ceiling the limit opens straight to it — the whole point
+        of scaling up under load is absorbing the overload NOW, not
+        after additive +1-per-success recovery crawls there; on a shrunk
+        ceiling the limit clamps into the new bounds."""
+        with self._lock:
+            grew = float(max(hi, lo)) > self.hi
+            self.hi = float(max(hi, lo))
+            self.lo = float(max(lo, 1))
+            self.limit = self.hi if grew else min(self.limit, self.hi)
+            self.limit = max(self.limit, self.lo)
+
+    def retry_after_s(self):
+        """The 429 `Retry-After` hint, derived from the AIMD state: the
+        deeper the limit has shrunk below the ceiling (= the more
+        overload signals the pool has absorbed recently), the longer
+        clients should back off. Bounded [0.05s, 5s]."""
+        with self._lock:
+            pressure = self.hi / max(self.limit, 1.0)
+        return min(5.0, max(0.05, 0.05 * pressure))
+
 
 class _Replica(object):
-    __slots__ = ("idx", "engine", "state", "dead", "inflight",
-                 "dispatches", "generation", "window",
+    __slots__ = ("idx", "engine", "state", "dead", "retired", "inflight",
+                 "tap_counter", "generation", "window",
                  "consecutive_failures", "ejected_until", "probe_inflight",
                  "lock", "swap_lock")
 
@@ -234,8 +257,12 @@ class _Replica(object):
         self.engine = engine
         self.state = HEALTHY
         self.dead = False          # hard-killed: never routed, no probes
+        self.retired = False       # autoscale drain-down: never routed,
+        # but in-flight/queued work still completes (then it is removed)
         self.inflight = 0          # attempts submitted, not yet completed
-        self.dispatches = 0        # pre-dispatch tap count (fault key)
+        # pre-dispatch tap count (the serving fault key) — pool-owned so
+        # the sequence survives engine swaps (core/dispatch.TapCounter)
+        self.tap_counter = _dispatch.TapCounter()
         self.generation = 0        # bumps on every engine swap
         self.window = collections.deque(maxlen=window)  # (ok, latency_s)
         self.consecutive_failures = 0
@@ -243,6 +270,10 @@ class _Replica(object):
         self.probe_inflight = False
         self.lock = threading.Lock()       # health state + counters
         self.swap_lock = threading.Lock()  # engine pointer flips
+
+    @property
+    def dispatches(self):
+        return self.tap_counter.n
 
 
 class _Attempt(object):
@@ -443,10 +474,41 @@ class ReplicaPool(object):
                  recover_samples=4,
                  # admission
                  admission=True, default_deadline_ms=None,
-                 latency_window=2048, **engine_kw):
+                 latency_window=2048,
+                 # autoscale (serving/autoscaler.py): replicas= is the
+                 # STARTING size; the controller grows/shrinks between
+                 # [min_replicas, max_replicas] off the admission/queue/
+                 # idle signals the pool already measures
+                 autoscale=False, min_replicas=None, max_replicas=None,
+                 autoscale_kw=None, **engine_kw):
         if int(replicas) < 1:
             raise ValueError("ReplicaPool needs replicas >= 1, got %r"
                              % (replicas,))
+        if not autoscale and (min_replicas is not None
+                              or max_replicas is not None):
+            # validate BEFORE any engine builds: a raise below this
+            # point would leak live batcher workers per failed ctor
+            raise ValueError("min_replicas/max_replicas need "
+                             "autoscale=True")
+        self._autoscale_bounds = None
+        if autoscale:
+            # `is not None`, not truthiness: an explicit 0 must hit the
+            # validation below, not silently fall back to the default
+            _mn = (int(min_replicas) if min_replicas is not None
+                   else int(replicas))
+            _mx = (int(max_replicas) if max_replicas is not None
+                   else 2 * int(replicas))
+            if _mn < 1 or _mx < _mn:
+                raise ValueError(
+                    "autoscale wants 1 <= min_replicas <= max_replicas, "
+                    "got [%r, %r]" % (min_replicas, max_replicas))
+            if int(replicas) > _mx:
+                raise ValueError(
+                    "replicas=%d starts ABOVE max_replicas=%d: the "
+                    "controller could never shrink past its own "
+                    "ceiling; raise max_replicas or start smaller"
+                    % (int(replicas), _mx))
+            self._autoscale_bounds = (_mn, _mx)
         if engine_factory is None and model_dir is None \
                 and checkpoint_dir is None:
             raise ValueError("need model_dir, checkpoint_dir or an "
@@ -505,6 +567,9 @@ class ReplicaPool(object):
         self._engine_kw = dict(engine_kw)
 
         self._replicas = []
+        self._next_idx = self.num_replicas   # stable ids across scaling
+        self._canary = None                  # CanaryController when a
+        # promotion is in flight (serving/canary.py)
         try:
             for i in range(self.num_replicas):
                 eng = self._build_engine(i)
@@ -518,6 +583,14 @@ class ReplicaPool(object):
         cap = sum(r.engine._batcher.queue_capacity for r in self._replicas)
         self._admission = _Admission(hi=cap, lo=self.num_replicas) \
             if admission else None
+        self._autoscaler = None
+        if autoscale:
+            from .autoscaler import PoolAutoscaler
+            mn, mx = self._autoscale_bounds
+            self._autoscaler = PoolAutoscaler(
+                self, min_replicas=mn, max_replicas=mx,
+                **(autoscale_kw or {}))
+            self._autoscaler.start()
 
     # ------------------------------------------------------------ build --
     @staticmethod
@@ -566,19 +639,20 @@ class ReplicaPool(object):
         return [devs[(idx * self.tp + k) % len(devs)]
                 for k in range(self.tp)]
 
-    def _build_engine(self, idx):
-        """One warmed replica engine off the current source. With the
-        AOT compile cache on (ptpu_serve defaults it on), warmup is a
-        disk load, not a recompile — what makes reload/restart cheap."""
+    def _build_engine(self, idx, source=None, ename=None):
+        """One warmed replica engine off the current source (or, for a
+        canary, an explicit candidate `source`). With the AOT compile
+        cache on (ptpu_serve defaults it on), warmup is a disk load,
+        not a recompile — what makes reload/restart/scale-up cheap."""
         place = self._place_for(idx)
-        ename = "%s@%d" % (self.name, idx)
+        ename = ename or "%s@%d" % (self.name, idx)
         if self._factory is not None:
             return self._factory(idx, place)
         kw = dict(self._engine_kw)
         if self.tp is not None:
             kw["tp"] = self.tp
             kw["mesh_devices"] = self._tp_span(idx)
-        src = self._source
+        src = source if source is not None else self._source
         if src["checkpoint_dir"] is not None:
             if src["fetch_list"] is None:
                 raise ValueError("checkpoint_dir serving needs fetch_list")
@@ -590,22 +664,15 @@ class ReplicaPool(object):
                                **kw)
 
     def _attach_tap(self, rep, engine=None):
-        # capture the engine the tap is ATTACHED to, never resolve
-        # rep.engine at dispatch time: during a swap the outgoing
-        # engine's drain still dispatches, and a replica_poison landing
-        # there must poison the engine being drained — not NaN the
-        # freshly promoted replacement's weights through the stale tap
+        # the fault-tap plumbing lives once in the shared dispatch core
+        # (core/dispatch.ReplicaTap): it captures the engine it is
+        # ATTACHED to (a replica_poison landing in a draining outgoing
+        # engine must not NaN the freshly promoted replacement), while
+        # the pool-owned TapCounter keeps the per-replica dispatch
+        # sequence consistent across engine swaps
         eng = engine if engine is not None else rep.engine
-
-        def tap():
-            with rep.lock:
-                count = rep.dispatches
-                rep.dispatches += 1
-            from ..resilience import faults as _faults
-            plan = _faults.active_plan()
-            if plan is not None:
-                plan.serving_fault(rep.idx, count, engine=eng)
-        eng._replica_tap = tap
+        eng._replica_tap = _dispatch.ReplicaTap(rep.idx, eng,
+                                                rep.tap_counter)
 
     def _event(self, kind, replica, detail=""):
         with self._events_lock:
@@ -767,7 +834,7 @@ class ReplicaPool(object):
             healthy, degraded, last_resort = [], [], []
             probe = None
             for rep in self._replicas:
-                if rep.dead or rep in exclude:
+                if rep.dead or rep.retired or rep in exclude:
                     continue
                 with rep.lock:
                     state, load = rep.state, rep.inflight
@@ -808,9 +875,12 @@ class ReplicaPool(object):
                     if self._admission is not None:
                         self._admission.on_overload()
                     self.metrics.on_queue_full()
-                raise QueueFullError(
+                exc = QueueFullError(
                     "no replica can accept the request (all full, "
                     "ejected or excluded); retry with backoff")
+                if rejected_any and self._admission is not None:
+                    exc.retry_after_s = self._admission.retry_after_s()
+                raise exc
             try:
                 with rep.swap_lock:
                     inner = rep.engine.submit_normalized(
@@ -849,9 +919,23 @@ class ReplicaPool(object):
         if self._admission is not None and not self._admission.allow(
                 self.total_inflight()):
             self.metrics.on_queue_full()
-            raise QueueFullError(
+            exc = QueueFullError(
                 "pool admission limit %.0f reached (overload shedding); "
                 "retry with backoff" % self._admission.limit)
+            # the 429 carries an intelligent backoff hint instead of
+            # letting every client hammer a saturated fleet in lockstep
+            exc.retry_after_s = self._admission.retry_after_s()
+            raise exc
+        can = self._canary
+        if can is not None:
+            # an in-flight promotion claims its deterministic traffic
+            # slice: the request rides the canary engine AND an
+            # incumbent mirror (serving/canary.py) — the mirror is what
+            # makes a corrupt canary invisible to the client
+            cfut = can.maybe_submit(norm, deadline_ms)
+            if cfut is not None:
+                self.metrics.on_submit()
+                return cfut
         fut = PoolFuture(self, norm, deadline_ms)
         self._submit_attempt(fut)
         self.metrics.on_submit()
@@ -869,13 +953,32 @@ class ReplicaPool(object):
             feed, batch_bucket=batch_bucket, seq_bucket=seq_bucket)
 
     def _any_engine(self):
-        for rep in self._replicas:
-            if not rep.dead and not rep.engine.closed:
+        for rep in list(self._replicas):
+            if not rep.dead and not rep.retired and not rep.engine.closed:
                 return rep.engine
         raise ServingClosedError("no live replica in the pool")
 
+    def _replica(self, idx):
+        """Replica by STABLE id (autoscaling means ids are not list
+        positions — a removed replica's id is never reused)."""
+        for rep in list(self._replicas):
+            if rep.idx == idx:
+                return rep
+        raise KeyError("no replica %r in the pool (have %r)"
+                       % (idx, [r.idx for r in self._replicas]))
+
     def total_inflight(self):
-        return sum(rep.inflight for rep in self._replicas)
+        return sum(rep.inflight for rep in list(self._replicas))
+
+    def live_replica_count(self):
+        """Replicas that can take NEW traffic (not dead, not retired)."""
+        return sum(1 for rep in list(self._replicas)
+                   if not rep.dead and not rep.retired)
+
+    def queue_capacity_total(self):
+        return sum(rep.engine._batcher.queue_capacity
+                   for rep in list(self._replicas)
+                   if not rep.dead and not rep.retired)
 
     @property
     def fetch_names(self):
@@ -898,24 +1001,33 @@ class ReplicaPool(object):
         return self._any_engine().seq_buckets
 
     def queue_depth(self):
-        return sum(rep.engine.queue_depth() for rep in self._replicas
+        return sum(rep.engine.queue_depth() for rep in list(self._replicas)
                    if not rep.dead)
 
     def replica_metrics(self):
         """{replica_index: ServingMetrics} for /metrics labeling."""
-        return {rep.idx: rep.engine.metrics for rep in self._replicas}
+        return {rep.idx: rep.engine.metrics
+                for rep in list(self._replicas)}
 
     def pool_state(self):
         """The /healthz payload: per-replica state + aggregate counts."""
         reps = []
         counts = {HEALTHY: 0, DEGRADED: 0, EJECTED: 0}
-        for rep in self._replicas:
+        for rep in list(self._replicas):
             with rep.lock:
                 st = rep.state
                 entry = {"replica": rep.idx, "state": st,
-                         "dead": rep.dead, "inflight": rep.inflight,
-                         "dispatches": rep.dispatches,
+                         "dead": rep.dead, "retired": rep.retired,
+                         "inflight": rep.inflight,
+                         "dispatches": rep.tap_counter.n,
                          "generation": rep.generation,
+                         # per-replica engine config (mixed-config pools
+                         # must be VISIBLE, not silent): dtype + depth
+                         # ride /healthz and ptpu_serve --selfcheck
+                         "weights_dtype": getattr(rep.engine,
+                                                  "weights_dtype", "fp32"),
+                         "pipeline_depth": getattr(rep.engine,
+                                                   "pipeline_depth", None),
                          # the device span this replica's engine owns —
                          # M entries for a tensor-parallel replica, so
                          # an operator can map replicas to chips
@@ -939,6 +1051,11 @@ class ReplicaPool(object):
                "inflight": self.total_inflight()}
         if self._admission is not None:
             out["admission_limit"] = round(self._admission.limit, 1)
+        if self._autoscaler is not None:
+            out["autoscale"] = self._autoscaler.state()
+        can = self._canary
+        if can is not None:
+            out["promotion"] = can.state()
         return out
 
     def describe(self):
@@ -966,6 +1083,13 @@ class ReplicaPool(object):
         with self._reload_lock:
             if self.closed:
                 raise ServingClosedError("replica pool is shut down")
+            can = self._canary
+            if can is not None and can.is_routing():
+                raise RuntimeError(
+                    "a canary promotion is in flight (%s); let it "
+                    "finish, or cancel_promotion() first — an unguarded "
+                    "reload would promote around the gate"
+                    % can.state()["state"])
             if checkpoint_dir is not None:
                 self._source["checkpoint_dir"] = checkpoint_dir
                 self._source["model_dir"] = None
@@ -975,10 +1099,11 @@ class ReplicaPool(object):
             if step is not None:
                 self._source["step"] = step
             served_step = None
-            for rep in self._replicas:
-                if rep.dead:
+            for rep in list(self._replicas):
+                if rep.dead or rep.retired:
                     continue    # killed replicas stay down (restart_
-                                # replica is the explicit revive)
+                                # replica is the explicit revive);
+                                # retired ones are mid-drain-out
                 fresh = self._build_engine(rep.idx)
                 served_step = getattr(fresh, "checkpoint_step",
                                       served_step)
@@ -1020,12 +1145,92 @@ class ReplicaPool(object):
             self.metrics.on_reload()
             return served_step
 
+    def promote(self, checkpoint_dir=None, model_dir=None, step=None,
+                traffic_fraction=0.05, shadow=False, **canary_kw):
+        """Gated promotion (serving/canary.py): build and WARM one
+        canary engine off the candidate (`checkpoint_dir`/`model_dir`/
+        `step`; no argument = the pool's current source re-read, i.e.
+        "newest valid snapshot NOW"), route `traffic_fraction` of
+        requests to it with incumbent mirroring, gate every canaried
+        request on finite outputs + output divergence
+        (PADDLE_TPU_CANARY_BOUND / divergence_bound()) + latency vs the
+        mirror, and:
+
+          * breaches >= max_breaches  -> AUTO-ROLLBACK, zero client
+            errors (breached requests already served mirror answers);
+          * oks >= min_requests       -> promote to 100% via the
+            ordinary zero-downtime reload().
+
+        shadow=True judges the canary entirely off the response path
+        (clients always get the incumbent). Returns the
+        CanaryController; watch it via pool_state()["promotion"].
+        canary_kw: min_requests, max_breaches, divergence_bound,
+        latency_ratio, latency_margin_s, canary_wait_s, auto_finalize."""
+        from .canary import CanaryController, CANARY, SHADOW
+        with self._reload_lock:
+            if self.closed:
+                raise ServingClosedError("replica pool is shut down")
+            old = self._canary
+            if old is not None and old.is_routing():
+                raise RuntimeError(
+                    "a promotion is already in flight (%s); cancel it "
+                    "first" % old.state()["state"])
+            source = dict(self._source)
+            if checkpoint_dir is not None:
+                source["checkpoint_dir"] = checkpoint_dir
+                source["model_dir"] = None
+            if model_dir is not None:
+                source["model_dir"] = model_dir
+                source["checkpoint_dir"] = None
+            if step is not None:
+                source["step"] = step
+            # RESERVE a placement id: peeking _next_idx would collide
+            # with a concurrent autoscale add_replica and stack the new
+            # replica on the canary's device span (ids need not be
+            # dense, so burning one is free)
+            with self._route_lock:
+                cidx = self._next_idx
+                self._next_idx += 1
+            eng = self._build_engine(cidx, source=source,
+                                     ename="%s@canary" % self.name)
+            # the canary fronts the same fault-tap seam as every
+            # replica, under the reserved id the canary_poison fault
+            # kind targets
+            eng._replica_tap = _dispatch.ReplicaTap("canary", eng)
+            ctrl = CanaryController(
+                self, eng,
+                # the final reload's source arguments (reload re-reads
+                # a checkpoint source, so a trainer that kept writing
+                # promotes the newest snapshot >= the judged one; pin
+                # step= to promote exactly the judged snapshot)
+                {"checkpoint_dir": checkpoint_dir,
+                 "model_dir": model_dir, "step": step},
+                mode=SHADOW if shadow else CANARY,
+                traffic_fraction=traffic_fraction, **canary_kw)
+            self._canary = ctrl
+        self._event("canary_start", "canary",
+                    "%s %.0f%% of traffic" % (ctrl.mode,
+                                              100 * traffic_fraction))
+        _otrace.instant("pool/canary_start", cat="serving")
+        return ctrl
+
+    def cancel_promotion(self, reason="operator cancel"):
+        can = self._canary
+        if can is not None:
+            can.cancel(reason)
+
+    def promotion_state(self):
+        """The current (or last finished) promotion's state dict, or
+        None if this pool never promoted."""
+        can = self._canary
+        return can.state() if can is not None else None
+
     def kill_replica(self, idx, drain=False):
         """Hard-eject one replica (deploy gates, ops): never routed
         again, no probes, engine closed. Queued requests on it fail
         with ServingClosedError and the pool fails them over — the
         kill-a-replica invariant is zero client-visible errors."""
-        rep = self._replicas[idx]
+        rep = self._replica(idx)
         with rep.lock:
             rep.dead = True
             rep.state = EJECTED
@@ -1033,13 +1238,18 @@ class ReplicaPool(object):
         self.metrics.on_kill()
         self._event("kill", idx)
         # drain=False by default: a kill simulates failure, and a WEDGED
-        # engine's close(drain=True) would never return
+        # engine's close(drain=True) would never return. Admission
+        # bounds deliberately NOT rebalanced: kill/restart are FAULT
+        # verbs — the pool should shed via real overload signals (AIMD
+        # shrink below the static ceiling, the PR-8 contract), not have
+        # the ceiling quietly redefined under it; only the SCALING
+        # verbs (add/remove_replica) move the bounds.
         rep.engine.close(drain=drain, timeout=1.0)
 
     def restart_replica(self, idx):
         """Revive a killed (or just unhealthy) replica with a freshly
         built engine off the current source."""
-        rep = self._replicas[idx]
+        rep = self._replica(idx)
         fresh = self._build_engine(idx)
         with rep.swap_lock:
             old, rep.engine = rep.engine, fresh
@@ -1056,9 +1266,93 @@ class ReplicaPool(object):
         if not old.closed:
             old.close(drain=True, timeout=1.0)
 
+    # ------------------------------------------------------- autoscale --
+    def _rebalance_admission(self):
+        """Re-derive the AIMD bounds from the CURRENT live membership.
+        Called by the SCALING verbs only (add/remove_replica): the
+        fault verbs (kill/restart) deliberately keep the original
+        bounds so overload after a kill still sheds via real AIMD
+        shrink below the static ceiling — the PR-8 contract."""
+        if self._admission is None:
+            return
+        self._admission.set_bounds(hi=max(self.queue_capacity_total(), 1),
+                                   lo=max(self.live_replica_count(), 1))
+
+    def add_replica(self):
+        """Grow the pool by one freshly built, WARMED replica (with the
+        AOT compile cache armed — ptpu_serve defaults it on — warmup is
+        a disk load, which is what makes scale-up seconds, not minutes).
+        The new replica gets a stable never-reused id, joins routing
+        atomically, and the admission ceiling opens to the grown
+        capacity immediately. Returns the new replica id."""
+        with self._reload_lock:
+            if self.closed:
+                raise ServingClosedError("replica pool is shut down")
+            with self._route_lock:
+                idx = self._next_idx
+                self._next_idx += 1
+            eng = self._build_engine(idx)     # build OUTSIDE the route
+            rep = _Replica(idx, eng, self.window)  # lock: it compiles/
+            self._attach_tap(rep)                  # loads artifacts
+            with self._route_lock:
+                self._replicas.append(rep)
+            self._rebalance_admission()
+            self._event("scale_up", idx)
+            _otrace.instant("pool/scale_up", cat="serving")
+            return idx
+
+    def remove_replica(self, idx=None, timeout=None):
+        """Shrink the pool by one replica — DRAINING, never killing:
+        the victim stops taking new traffic (retired), everything
+        already accepted on it completes against its engine, then the
+        engine closes and the replica leaves the pool. Default victim:
+        the youngest (highest-id) live replica. Refuses to remove the
+        last live replica. Returns the removed replica id."""
+        with self._reload_lock:
+            with self._route_lock:
+                live = [r for r in self._replicas
+                        if not r.dead and not r.retired]
+                if idx is None:
+                    if len(live) <= 1:
+                        raise ValueError(
+                            "cannot remove the last live replica")
+                    rep = max(live, key=lambda r: r.idx)
+                else:
+                    rep = self._replica(idx)
+                    if rep.dead or rep.retired:
+                        raise ValueError(
+                            "replica %r is already %s" % (
+                                idx, "dead" if rep.dead else "retired"))
+                    if len(live) <= 1:
+                        raise ValueError(
+                            "cannot remove the last live replica")
+                rep.retired = True   # _pick holds this lock: from here
+                # on no new attempt routes to it
+            self._event("scale_down", rep.idx)
+            _otrace.instant("pool/scale_down", cat="serving")
+            # drain completes every accepted request (zero dropped); an
+            # EJECTED victim may be wedged — fail its leftovers fast
+            # instead of holding the reload lock forever (its queued
+            # work was already failed over by attempt timeouts)
+            with rep.lock:
+                wedged = rep.state == EJECTED
+            rep.engine.close(drain=not wedged,
+                             timeout=1.0 if wedged else timeout)
+            with self._route_lock:
+                try:
+                    self._replicas.remove(rep)
+                except ValueError:
+                    pass
+            self._rebalance_admission()
+            return rep.idx
+
     def close(self, drain=True, timeout=None):
         self.closed = True
-        for rep in self._replicas:
+        if self._autoscaler is not None:
+            self._autoscaler.stop()
+        if self._canary is not None:
+            self._canary.cancel("pool closed")
+        for rep in list(self._replicas):
             if rep.dead:
                 continue
             # never drain an EJECTED replica: a wedged worker would hold

@@ -90,12 +90,15 @@ class _Handler(BaseHTTPRequestHandler):
         if self.server_ref is not None and self.server_ref.verbose:
             BaseHTTPRequestHandler.log_message(self, fmt, *args)
 
-    def _reply(self, status, payload, content_type="application/json"):
+    def _reply(self, status, payload, content_type="application/json",
+               headers=None):
         body = (payload if isinstance(payload, bytes)
                 else json.dumps(payload).encode("utf-8"))
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -103,7 +106,19 @@ class _Handler(BaseHTTPRequestHandler):
         if code is None:
             code = ("error" if isinstance(exc_or_msg, str)
                     else type(exc_or_msg).__name__)
-        self._reply(status, {"error": str(exc_or_msg), "code": code})
+        headers = None
+        payload = {"error": str(exc_or_msg), "code": code}
+        if status == 429:
+            # intelligent backoff instead of lockstep hammering: the
+            # pool's AIMD admission state prices the hint
+            # (QueueFullError.retry_after_s); plain-engine queue-full
+            # rejections default to 1s. HTTP wants integer delay
+            # seconds — round up, floor 1 — and the JSON carries the
+            # precise value for clients that parse bodies.
+            hint = getattr(exc_or_msg, "retry_after_s", None) or 1.0
+            payload["retry_after_s"] = round(float(hint), 3)
+            headers = {"Retry-After": str(max(1, int(-(-hint // 1))))}
+        self._reply(status, payload, headers=headers)
 
     @property
     def max_body_bytes(self):
@@ -161,6 +176,11 @@ class _Handler(BaseHTTPRequestHandler):
             payload = {"status": "ok" if alive else "unavailable"}
             if pool_states:
                 payload["pools"] = pool_states
+            fleet = getattr(self.server_ref, "fleet", None)
+            if fleet is not None:
+                payload["fleet"] = {
+                    "brownout_level": fleet.brownout_level(),
+                    "pressure": round(fleet._pressure(), 4)}
             self._reply(200 if alive else 503, payload)
             return
         if self.path == "/metrics":
@@ -262,7 +282,13 @@ class ModelServer(object):
 
     def __init__(self, engines, host="127.0.0.1", port=8080,
                  verbose=False, max_body_bytes=_DEFAULT_MAX_BODY_BYTES):
-        if not isinstance(engines, dict):
+        self.fleet = None
+        if hasattr(engines, "registry") and callable(engines.registry):
+            # a ModelFleet: per-model entries route submits through the
+            # fleet (priority brownout), metrics stay per-model
+            self.fleet = engines
+            engines = engines.registry()
+        elif not isinstance(engines, dict):
             engines = {engines.name: engines}
         self.registry = dict(engines)
         self.verbose = verbose
@@ -302,6 +328,8 @@ class ModelServer(object):
         engines AFTER server_close would deadlock: the join would wait
         on handlers that wait on futures only the drain resolves."""
         self.httpd.shutdown()
+        if self.fleet is not None:
+            self.fleet.closed = True   # stop fleet-routed intake first
         for engine in self.registry.values():
             engine.close(drain=drain)
         self.httpd.server_close()   # joins non-daemon handler threads

@@ -193,6 +193,52 @@ def test_bench_pool_smoke():
     assert not any(e.startswith("kill@") for e in legs["1"]["events"])
 
 
+def test_bench_fleet_smoke():
+    """The BENCH_FLEET leg: one subprocess run on CPU driving the same
+    closed-loop load step through a FIXED 1-replica pool and an
+    AUTOSCALED [1,3] pool. The acceptance gates ride here: the fixed
+    pool sheds sustained 429s through the load's tail while the
+    autoscaled pool's tail 429 rate returns to ~0 (the scale-up
+    absorbed the step, riding warm engine builds), the contraction
+    drains back to 1 replica, and NO leg fails an accepted request."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_FLEET": "1",
+        "BENCH_FLEET_CLIENTS": "12", "BENCH_FLEET_SECONDS": "2.5",
+        "BENCH_FLEET_MAX_REPLICAS": "3", "BENCH_FLEET_QUEUE_CAP": "4",
+        "BENCH_SERVING_LAYERS": "6", "BENCH_SERVING_HIDDEN": "64",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "serving_fleet_autoscale_qps"
+    assert rec["unit"] == "requests/sec/chip"
+    assert rec["vs_baseline"] is None
+    assert rec["value"] > 0
+    legs = rec["legs"]
+    assert set(legs) == {"fixed", "autoscaled"}
+    # zero accepted-request failures anywhere (429s are not errors:
+    # they are the signal, retried by the clients)
+    assert rec["total_errors"] == 0, rec
+    # the fixed pool keeps shedding through the tail of the load step
+    assert legs["fixed"]["tail_reject_rate"] > 0, legs["fixed"]
+    # the autoscaled pool absorbed it: scale-up happened and the tail
+    # 429 rate collapsed (~0; strictly below the fixed pool's)
+    auto = legs["autoscaled"]
+    assert auto["scale_ups"] >= 1, auto
+    assert auto["scale_up_latency_s"] is not None
+    assert auto["tail_reject_rate"] <= 0.05, auto
+    assert auto["tail_reject_rate"] < legs["fixed"]["tail_reject_rate"]
+    # contraction: drained back to the fixed floor after the load
+    assert auto["final_replicas"] == 1, auto
+    assert auto["scale_downs"] >= 1, auto
+
+
 def test_bench_ckpt_smoke():
     """The BENCH_CKPT leg: one subprocess run on CPU comparing no
     checkpointing vs sync saves vs async saves. The acceptance gate rides

@@ -1,0 +1,489 @@
+"""Self-driving fleet: autoscaling, multi-model brownout, canary
+promotion with auto-rollback, and the serving chaos legs (ISSUE 14).
+
+The load-bearing invariants:
+
+  * AUTOSCALE ABSORBS AND CONTRACTS — a load step that sheds (429s) at
+    the starting size grows the pool (riding warm engine builds) until
+    the shedding stops, and the contraction after the load DRAINS the
+    victim replica: no accepted request is ever failed by scaling in
+    either direction.
+  * A CRASHED REPLICA IS INVISIBLE — `replica_crash` mid-window (the
+    engine force-closed while dispatches are in flight) resolves every
+    future via failover: zero client-visible errors, zero hangs.
+  * A BAD CANARY IS INVISIBLE — a promotion whose canary weights are
+    corrupt (`canary_poison`) breaches the gate and auto-rolls-back
+    while every canaried client silently receives the incumbent
+    mirror's answer, bit-exact; a healthy canary promotes to 100%.
+  * LOW PRIORITY BROWNS OUT FIRST — under fleet pressure the lowest
+    priority tier sheds (typed 429 + Retry-After) while the top tier
+    keeps serving.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import serving
+from paddle_tpu.core import dispatch as core_dispatch
+from paddle_tpu.resilience.faults import FaultPlan
+from paddle_tpu.serving.pool import DEGRADED
+
+
+def _save_dense_model(tmp_path, seed=0, feat=6, classes=3):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[feat], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "dense_model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe, main)
+    return d
+
+
+def _pool(d, replicas=2, **kw):
+    kw.setdefault("batch_buckets", [4])
+    kw.setdefault("max_queue_delay_ms", 3)
+    kw.setdefault("place", fluid.CPUPlace())
+    return serving.ReplicaPool(d, replicas=replicas, **kw)
+
+
+def _feeds(n, rows_max=3, feat=6, seed=1):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.rand(int(rng.randint(1, rows_max + 1)),
+                           feat).astype("f")} for _ in range(n)]
+
+
+def _wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+# --------------------------------------------------------------------------
+# autoscaling: absorb under load, contract on idle, drain on the way down
+# --------------------------------------------------------------------------
+
+def test_autoscale_absorb_contract_roundtrip(tmp_path):
+    """THE autoscale acceptance leg (lean CPU cut): a closed-loop burst
+    against a min-size pool sheds 429s, the controller grows the pool
+    (admission ceiling opens with it) and the shedding stops; after the
+    burst the pool contracts back to min by DRAINING — every accepted
+    request completes, zero client-visible errors either direction."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=1, autoscale=True, min_replicas=1,
+                 max_replicas=3, queue_capacity=4, max_batch_size=4,
+                 autoscale_kw=dict(interval_s=0.05, down_idle_s=0.4,
+                                   scale_up_cooldown_s=0.15,
+                                   scale_down_cooldown_s=0.2))
+    feeds = _feeds(32)
+    errors, completed, rejected = [], [], []
+
+    def client(i):
+        t_end = time.monotonic() + 1.6
+        k = 0
+        while time.monotonic() < t_end:
+            try:
+                pool.submit(feeds[(i * 7 + k) % len(feeds)]) \
+                    .result(30).numpy()
+                completed.append(1)
+            except serving.QueueFullError:
+                rejected.append(1)   # the scale-up signal, retried
+                time.sleep(0.003)
+            except Exception as e:  # noqa: BLE001 — acceptance count
+                errors.append(repr(e))
+            k += 1
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    scaler = pool._autoscaler
+    assert scaler.scale_ups >= 1, \
+        "sustained 429s (%d) must have scaled the pool up" % len(rejected)
+    assert rejected, "the burst never shed: the leg measured nothing"
+    assert not errors, errors[:3]
+    assert scaler.last_scale_up_s is not None
+    # contraction: idle drains the pool back to min, failing nothing
+    _wait_for(lambda: pool.live_replica_count() == 1, timeout=10,
+              what="scale-down to min_replicas")
+    assert scaler.scale_downs >= 1
+    state = pool.pool_state()
+    assert state["autoscale"]["live_replicas"] == 1
+    assert state["autoscale"]["last_error"] is None
+    # the pool still serves after the round-trip
+    pool.submit(feeds[0]).result(10).numpy()
+    pool.close()
+
+
+# --------------------------------------------------------------------------
+# chaos: replica crash mid-window — every future resolves, no hang
+# --------------------------------------------------------------------------
+
+def test_replica_crash_mid_window_every_future_resolves(tmp_path):
+    """`replica_crash` force-closes one replica's engine while a wave of
+    pipelined dispatches is in flight: queued work fails over, nothing
+    hangs, zero client-visible errors, every answer bit-exact."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2, pipeline_depth=2, retries=3,
+                 attempt_timeout_s=10.0)
+    ref = serving.InferenceEngine(d, batch_buckets=[4],
+                                  max_queue_delay_ms=1)
+    feeds = _feeds(16)
+    fetch = ref.fetch_names[0]
+    with FaultPlan(["replica_crash@2"]):
+        futures = [None] * len(feeds)
+
+        def fire(i):
+            try:
+                futures[i] = pool.submit(feeds[i])
+            except Exception as e:  # noqa: BLE001
+                futures[i] = e
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(len(feeds))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        errors = []
+        for i, fut in enumerate(futures):
+            if not hasattr(fut, "result"):
+                errors.append((i, fut))
+                continue
+            try:
+                got = fut.result(60).numpy()   # bounded: no hangs
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+                continue
+            want, _ = ref.run_direct(feeds[i],
+                                     batch_bucket=fut.bucket[0],
+                                     seq_bucket=fut.bucket[1])
+            np.testing.assert_array_equal(got[fetch], want[fetch])
+        assert not errors, errors
+    # exactly one replica crashed; the pool says so and keeps serving
+    state = pool.pool_state()
+    crashed = [r for r in state["replicas"]
+               if not any(rep.idx == r["replica"]
+                          and not rep.engine.closed
+                          for rep in pool._replicas)]
+    assert len(crashed) == 1, state
+    pool.submit(feeds[0]).result(10).numpy()
+    # satellite: pool_state surfaces per-replica engine config
+    for r in state["replicas"]:
+        assert r["weights_dtype"] == "fp32"
+        assert r["pipeline_depth"] == 2
+    ref.close()
+    pool.close()
+
+
+# --------------------------------------------------------------------------
+# chaos: slow replica browns out of preferred routing
+# --------------------------------------------------------------------------
+
+def test_replica_slow_fault_kind(tmp_path):
+    """The `replica_slow` fault is a measurable latency injection (the
+    slow-but-answering replica), not a wedge: the request completes,
+    just late."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=1)
+    feed = _feeds(1)[0]
+    with FaultPlan(["replica_slow@0:0.15"]):
+        t0 = time.monotonic()
+        pool.submit(feed).result(10).numpy()
+        assert time.monotonic() - t0 >= 0.15
+    pool.close()
+
+
+def test_slow_replica_degrades_out_of_routing(tmp_path):
+    """A persistently slow replica (its tap delayed 60ms vs a ~ms-class
+    model) trips the latency breaker: it leaves preferred routing
+    (DEGRADED) while every request keeps succeeding on the fast
+    replica."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2, latency_degrade_s=0.03, min_samples=4,
+                 recover_samples=1000)   # don't flap back mid-assert
+    slow = pool._replica(1)
+    orig_tap = slow.engine._replica_tap
+
+    def slow_tap():
+        time.sleep(0.06)
+        orig_tap()
+    slow.engine._replica_tap = slow_tap
+
+    feeds = _feeds(8)
+    errors = []
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        futures = []
+        for f in feeds:   # concurrent wave so BOTH replicas take load
+            try:
+                futures.append(pool.submit(f))
+            except serving.QueueFullError:
+                continue
+        for fut in futures:
+            try:
+                fut.result(30).numpy()
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+        with slow.lock:
+            if slow.state == DEGRADED:
+                break
+    assert not errors, errors[:3]
+    with slow.lock:
+        assert slow.state == DEGRADED, \
+            "slow replica never left preferred routing"
+    # new sequential traffic routes to the healthy replica
+    before = pool._replica(0).dispatches
+    for _ in range(4):
+        pool.submit(feeds[0]).result(10).numpy()
+    assert pool._replica(0).dispatches > before
+    pool.close()
+
+
+# --------------------------------------------------------------------------
+# canary promotion: bad canary auto-rolls-back, healthy canary promotes
+# --------------------------------------------------------------------------
+
+def test_bad_canary_rolls_back_with_zero_client_errors(tmp_path):
+    """THE bad-canary acceptance leg: `canary_poison` corrupts the
+    canary engine's weights at its first dispatch. Every canaried
+    request silently serves the incumbent mirror's answer (bit-exact,
+    zero client errors), the gate counts non-finite breaches, and the
+    promotion auto-rolls-back; the incumbent fleet never blinks."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2)
+    feeds = _feeds(12, seed=7)
+    ref = {i: pool.run_direct(f)[0] for i, f in enumerate(feeds)}
+    with FaultPlan(["canary_poison@0"]):
+        ctrl = pool.promote(traffic_fraction=0.5, min_requests=50,
+                            max_breaches=2)
+        client_errors = []
+        for i, f in enumerate(feeds):
+            try:
+                out = pool.submit(f).result(30).numpy()
+            except Exception as e:  # noqa: BLE001
+                client_errors.append((i, repr(e)))
+                continue
+            for k, want in ref[i].items():
+                np.testing.assert_array_equal(
+                    out[k], want,
+                    err_msg="request %d: a corrupt canary's answer "
+                            "reached a client" % i)
+        assert not client_errors, client_errors
+    st = ctrl.state()
+    assert st["state"] == "rolled_back", st
+    assert st["breach_kinds"].get("non_finite", 0) >= 2, st
+    assert pool.promotion_state()["state"] == "rolled_back"
+    # incumbent keeps serving, reload is unblocked again after rollback
+    pool.submit(feeds[0]).result(10).numpy()
+    pool.close()
+
+
+def test_healthy_canary_promotes_to_full_fleet(tmp_path):
+    """A canary whose outputs match the incumbent (same weights)
+    promotes after min_requests clean samples: the pool reloads every
+    replica onto the candidate source (generation bumps), traffic was
+    bit-exact throughout, zero client errors."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=2)
+    feeds = _feeds(12, seed=9)
+    ref = {i: pool.run_direct(f)[0] for i, f in enumerate(feeds)}
+    gen_before = [r.generation for r in pool._replicas]
+    ctrl = pool.promote(model_dir=d, traffic_fraction=0.5,
+                        min_requests=4, max_breaches=1)
+    for i, f in enumerate(feeds):
+        out = pool.submit(f).result(30).numpy()
+        for k, want in ref[i].items():
+            np.testing.assert_array_equal(out[k], want)
+    _wait_for(lambda: ctrl.state()["state"] in ("promoted",
+                                                "rolled_back"),
+              timeout=15, what="promotion to settle")
+    st = ctrl.state()
+    assert st["state"] == "promoted", st
+    assert st["breaches"] == 0 and st["oks"] >= 4, st
+    assert st["max_divergence"] == 0.0, st
+    # the final reload flipped every replica (zero-downtime promote)
+    assert all(r.generation == g + 1
+               for r, g in zip(pool._replicas, gen_before))
+    out = pool.submit(feeds[0]).result(10).numpy()
+    for k, want in ref[0].items():
+        np.testing.assert_array_equal(out[k], want)
+    pool.close()
+
+
+def test_shadow_mode_always_serves_incumbent(tmp_path):
+    """Shadow promotion judges the canary off the response path: even a
+    poisoned canary at 100% duplication never touches a client answer;
+    the breaches still roll the promotion back."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=1)
+    feeds = _feeds(8, seed=11)
+    ref = {i: pool.run_direct(f)[0] for i, f in enumerate(feeds)}
+    with FaultPlan(["canary_poison@0"]):
+        ctrl = pool.promote(traffic_fraction=1.0, shadow=True,
+                            min_requests=50, max_breaches=2)
+        for i, f in enumerate(feeds):
+            out = pool.submit(f).result(30).numpy()
+            for k, want in ref[i].items():
+                np.testing.assert_array_equal(out[k], want)
+        _wait_for(lambda: ctrl.state()["state"] == "rolled_back",
+                  timeout=10, what="shadow breaches to roll back")
+    assert ctrl.state()["breach_kinds"].get("non_finite", 0) >= 2
+    pool.close()
+
+
+def test_wedged_canary_adds_no_client_latency_and_reaps(tmp_path):
+    """A canary that never answers must cost clients NOTHING: result()
+    never waits on the canary (mirror served immediately), and the
+    controller reaps the unanswered canaries as timeout breaches at its
+    next touchpoint — the promotion rolls back instead of stalling
+    forever."""
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=1)
+    feeds = _feeds(8, seed=13)
+    ref = {i: pool.run_direct(f)[0] for i, f in enumerate(feeds)}
+    ctrl = pool.promote(traffic_fraction=1.0, min_requests=50,
+                        max_breaches=2, canary_wait_s=0.3)
+    wedge = threading.Event()
+    orig_tap = ctrl.engine._replica_tap
+
+    def wedged_tap():
+        wedge.wait(30)     # parks the canary's dispatch worker
+        orig_tap()
+    ctrl.engine._replica_tap = wedged_tap
+    try:
+        for i, f in enumerate(feeds[:3]):
+            t0 = time.monotonic()
+            out = pool.submit(f).result(10).numpy()
+            assert time.monotonic() - t0 < 5.0, \
+                "client waited on a wedged canary"
+            for k, want in ref[i].items():
+                np.testing.assert_array_equal(out[k], want)
+        time.sleep(0.4)    # past canary_wait_s
+        # the next touchpoint (a new claim) reaps the timeouts
+        pool.submit(feeds[3]).result(10).numpy()
+        _wait_for(lambda: ctrl.state()["state"] == "rolled_back",
+                  timeout=5, what="timeout breaches to roll back")
+        assert ctrl.state()["breach_kinds"].get("timeout", 0) >= 2
+    finally:
+        wedge.set()        # unpark so close() can join the worker
+    pool.close()
+
+
+# --------------------------------------------------------------------------
+# multi-model fleet: the lowest priority tier browns out first
+# --------------------------------------------------------------------------
+
+def test_fleet_brownout_sheds_lowest_priority_first(tmp_path):
+    """Saturating the high-priority model's pool (a wedged replica plus
+    a closed-loop burst) drives fleet pressure to 1.0: the low-priority
+    model's submits get a typed BrownoutError 429 with a Retry-After
+    hint while the high tier keeps being admitted; when the pressure
+    clears the level steps back down and the low tier serves again."""
+    d = _save_dense_model(tmp_path)
+    fleet = serving.ModelFleet(pressure_high=0.8, pressure_low=0.3,
+                               shed_dwell_s=0.1)
+    kw = dict(model_dir=d, replicas=1, batch_buckets=[1],
+              max_batch_size=1, queue_capacity=4, max_queue_delay_ms=1,
+              place=fluid.CPUPlace())
+    fleet.add_model("hi", priority=1, **kw)
+    fleet.add_model("lo", priority=0, **kw)
+    feed = {"x": np.ones((1, 6), "float32")}
+    assert fleet.infer("hi", feed) and fleet.infer("lo", feed)
+
+    with FaultPlan(["replica_wedge@1:1.2"]):
+        futs = [fleet.submit("hi", feed) for _ in range(4)]
+        time.sleep(0.3)   # wedge holds the pool at pressure 1.0
+        with pytest.raises(serving.BrownoutError) as ei:
+            fleet.submit("lo", feed)
+        assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+        # the HIGH tier is never browned out: its pool's own admission
+        # may 429 (full is full) but the fleet does not shed it
+        try:
+            futs.append(fleet.submit("hi", feed))
+        except serving.BrownoutError:
+            raise AssertionError("top tier must never brown out")
+        except serving.QueueFullError:
+            pass   # the saturated pool's own backpressure: correct
+        st = fleet.fleet_state()
+        assert st["brownout_level"] == 1
+        assert st["models"]["lo"]["browned_out"]
+        assert not st["models"]["hi"]["browned_out"]
+        assert st["models"]["lo"]["shed_total"] == 1
+        for f in futs:
+            f.result(30).numpy()   # the wedge clears; nothing lost
+    time.sleep(0.15)
+    fleet.submit("lo", feed).result(10).numpy()  # steps the level down
+    time.sleep(0.15)
+    assert fleet.infer("lo", feed)
+    assert fleet.brownout_level() == 0
+    # the fleet registry is ModelServer-shaped: per-model describe
+    reg = fleet.registry()
+    assert reg["lo"].describe()["priority"] == 0
+    fleet.close()
+
+
+# --------------------------------------------------------------------------
+# satellites: Retry-After derivation, one-copy dispatch seam
+# --------------------------------------------------------------------------
+
+def test_retry_after_rides_admission_state(tmp_path):
+    """429s carry a backoff hint priced by the AIMD admission state:
+    a fully open limit hints the floor; a shrunken limit hints longer;
+    the hint is bounded."""
+    from paddle_tpu.serving.pool import _Admission
+    adm = _Admission(hi=100, lo=2)
+    floor = adm.retry_after_s()
+    assert floor == pytest.approx(0.05)
+    for _ in range(40):
+        adm.on_overload()
+    assert adm.retry_after_s() > floor
+    assert adm.retry_after_s() <= 5.0
+    # the pool stamps the hint on its admission 429
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=1)
+    pool._admission.limit = 0.0   # force the admission gate shut
+    with pytest.raises(serving.QueueFullError) as ei:
+        pool.submit(_feeds(1)[0])
+    assert ei.value.retry_after_s is not None
+    pool._admission.limit = pool._admission.hi
+    pool.close()
+
+
+def test_dispatch_guard_seam_is_one_copy(tmp_path):
+    """The guard/watchdog/fault-tap plumbing lives ONCE in
+    core/dispatch.py: the executor surface re-exports the watchdog, the
+    pool's replica taps are dispatch-owned objects, and both executors
+    route their hook choreography through the same functions."""
+    from paddle_tpu.core import executor as core_executor
+    assert core_executor.run_with_deadline \
+        is core_dispatch.run_with_deadline
+    assert core_executor.dispatch_with_deadline \
+        is core_dispatch.dispatch_with_deadline
+    d = _save_dense_model(tmp_path)
+    pool = _pool(d, replicas=1)
+    tap = pool._replica(0).engine._replica_tap
+    assert isinstance(tap, core_dispatch.ReplicaTap)
+    assert tap.counter is pool._replica(0).tap_counter
+    # an engine swap rebinds the tap to the NEW engine but keeps the
+    # pool-owned dispatch counter (fault keying survives reloads)
+    pool.submit(_feeds(1)[0]).result(10).numpy()
+    count_before = pool._replica(0).dispatches
+    assert count_before >= 1
+    pool.reload(model_dir=d)
+    tap2 = pool._replica(0).engine._replica_tap
+    assert tap2 is not tap and tap2.counter is tap.counter
+    assert pool._replica(0).dispatches == count_before
+    pool.close()

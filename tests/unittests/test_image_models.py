@@ -13,14 +13,21 @@ from paddle_tpu.models.image_classification import build_train
 
 # resnet101 and se_resnext50 are the two slowest builds (~60s/~50s of
 # pure XLA:CPU compile each) and exercise the SAME building blocks as
-# resnet50/googlenet, which stay in the fast tier — tier-1 was
-# overrunning its 870s verify budget, and a truncated run is worse
-# signal than a deferred depth-variant (PR 8 triage; the slow tier
-# still runs them by default)
+# resnet50, which stays in the fast tier — tier-1 was overrunning its
+# 870s verify budget, and a truncated run is worse signal than a
+# deferred depth-variant (PR 8 triage; the slow tier still runs them
+# by default). PR 14 re-audit: vgg16 (~13s) and googlenet (~21s) moved
+# to the slow tier too — both are pure compile-of-another-topology
+# legs whose building blocks (plain deep conv stacks / concat
+# branches) resnet50 + alexnet + the detection SSD pipeline keep
+# covered, and the fleet suite's budget had pushed tier-1 back over
+# its ceiling.
 @pytest.mark.parametrize("model", [
     "resnet50",
     pytest.param("resnet101", marks=pytest.mark.slow),
-    "vgg16", "alexnet", "googlenet",
+    pytest.param("vgg16", marks=pytest.mark.slow),
+    "alexnet",
+    pytest.param("googlenet", marks=pytest.mark.slow),
     pytest.param("se_resnext50", marks=pytest.mark.slow)])
 def test_model_one_step(model):
     main, startup = fluid.Program(), fluid.Program()
@@ -44,6 +51,11 @@ def test_model_one_step(model):
     assert abs(float(loss1[0]) - float(loss2[0])) > 1e-7
 
 
+@pytest.mark.slow   # PR 14 budget audit: a ~16s convergence gate is
+# exactly what the slow tier is FOR (pytest.ini's own definition);
+# resnet one-step training stays in tier-1 via test_model_one_step and
+# the uint8-parity leg, and the book suite keeps several end-to-end
+# convergence gates in the fast tier
 def test_resnet_cifar10_converges():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.unique_name.guard(), fluid.program_guard(main, startup):

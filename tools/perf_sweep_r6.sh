@@ -114,6 +114,13 @@ probe && run 1200 BENCH_PIPELINE=1 BENCH_PIPELINE_K=8 BENCH_PIPELINE_RECORDS=64
 probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2
 probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_TP_DIM=1024
 probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_TP_DIM=1024 BENCH_TP_LEGS=1,2
+# --- tier 2e: self-driving fleet (PR 14) — the fixed-vs-autoscaled 429
+# load step on real chips: new replicas land on DISTINCT devices, so qps
+# should scale alongside the 429-rate drop (on the 1-core CPU reference
+# only the 429 claim is measurable: fixed tail reject rate sustained,
+# autoscaled tail ~0, scale-up ~0.3-0.7s riding the AOT warm start,
+# contraction drains to 1 with 0 errors — 2026-08-05).
+probe && run 1200 BENCH_FLEET=1 BENCH_FLEET_SECONDS=6 BENCH_FLEET_MAX_REPLICAS=4
 # --- tier 3k: kernel floor (PR 13) — fused-vs-unfused per op (+ the
 # int8/bf16 serving divergence gate riding the same JSON line), then a
 # hardware tile sweep (ptpu_tune kernels records per-(op, shape-bucket,

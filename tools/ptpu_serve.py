@@ -239,6 +239,24 @@ def main(argv=None):
                          "(least-loaded routing, health-gated circuit "
                          "breakers, failover, zero-downtime reload) — "
                          "round-robin over the visible devices")
+    ap.add_argument("--autoscale", default=None, metavar="MIN,MAX",
+                    help="self-scaling pool: grow/shrink replicas "
+                         "between MIN and MAX off the admission/queue/"
+                         "idle signals (serving.PoolAutoscaler); "
+                         "--replicas is the starting size (default "
+                         "MIN). Scale-up rides the AOT warm start; "
+                         "scale-down drains, never drops")
+    ap.add_argument("--extra-model", action="append", default=[],
+                    metavar="NAME=DIR[@PRIORITY]",
+                    help="serve additional models from one process (a "
+                         "serving.ModelFleet): repeatable; each extra "
+                         "model gets its own replica pool with the "
+                         "same engine config. Priorities drive fleet "
+                         "brownout — the LOWEST priority tier sheds "
+                         "first under overload (default 0)")
+    ap.add_argument("--priority", type=int, default=0,
+                    help="the main model's fleet priority (only "
+                         "meaningful with --extra-model)")
     ap.add_argument("--tp", type=int, default=None, metavar="M",
                     help="tensor parallelism: each replica spans M "
                          "devices (weights sharded 1/M per chip by the "
@@ -279,6 +297,30 @@ def main(argv=None):
     if args.kill_replica is not None and args.replicas < 2:
         ap.error("--kill-replica needs --replicas >= 2 (killing the only "
                  "replica cannot redistribute anything)")
+    autoscale = None
+    if args.autoscale:
+        try:
+            lo_s, hi_s = args.autoscale.split(",", 1)
+            autoscale = (int(lo_s), int(hi_s))
+        except ValueError:
+            ap.error("--autoscale wants MIN,MAX (e.g. 1,4)")
+        if autoscale[0] < 1 or autoscale[1] < autoscale[0]:
+            ap.error("--autoscale wants 1 <= MIN <= MAX")
+        if args.replicas > autoscale[1]:
+            ap.error("--replicas %d starts above --autoscale MAX %d; "
+                     "the controller could never shrink past its own "
+                     "ceiling" % (args.replicas, autoscale[1]))
+    extra_models = []
+    for spec in args.extra_model:
+        if "=" not in spec:
+            ap.error("--extra-model wants NAME=DIR[@PRIORITY], got %r"
+                     % spec)
+        mname, _, rest = spec.partition("=")
+        mdir, _, prio = rest.partition("@")
+        extra_models.append((mname.strip(), mdir.strip(),
+                             int(prio) if prio else 0))
+    if extra_models and args.selfcheck:
+        ap.error("--selfcheck gates one model; run it per model dir")
 
     if args.place == "cpu":
         # only pin the platform for an explicitly-CPU server, and only
@@ -310,19 +352,33 @@ def main(argv=None):
         queue_capacity=args.queue_capacity, warmup=not args.no_warmup,
         pipeline_depth=args.pipeline_depth,
         weights_dtype=args.weights_dtype)
+    fleet = None
     try:
-        if args.replicas > 1:
+        if args.replicas > 1 or autoscale or extra_models:
             # pool placement: None = TPUPlace(i) round-robin over the
             # visible accelerators; an explicit --place cpu pins all
             # replicas to the host backend
             engine_kw.pop("name")
-            engine = serving.ReplicaPool(
-                args.model_dir, replicas=args.replicas, tp=args.tp,
+            pool_kw = dict(
+                replicas=args.replicas, tp=args.tp,
                 place=fluid.CPUPlace() if args.place == "cpu" else None,
-                name=args.name,
                 default_deadline_ms=args.deadline_ms,
                 attempt_timeout_s=args.attempt_timeout_s,
                 hedge_delay_ms=args.hedge_delay_ms, **engine_kw)
+            if autoscale:
+                pool_kw.update(autoscale=True,
+                               min_replicas=autoscale[0],
+                               max_replicas=autoscale[1],
+                               replicas=max(args.replicas, autoscale[0]))
+            engine = serving.ReplicaPool(args.model_dir, name=args.name,
+                                         **pool_kw)
+            if extra_models:
+                fleet = serving.ModelFleet()
+                fleet.add_model(engine.name, pool=engine,
+                                priority=args.priority)
+                for mname, mdir, prio in extra_models:
+                    fleet.add_model(mname, priority=prio,
+                                    model_dir=mdir, **pool_kw)
         else:
             place = (fluid.TPUPlace() if args.place == "tpu"
                      else fluid.CPUPlace())
@@ -375,20 +431,32 @@ def main(argv=None):
             record["max_divergence"] = round(
                 qstats.get("max_divergence", 0.0), 6)
             record["divergence_bound"] = bound
-        if args.replicas > 1:
+        if hasattr(engine, "pool_state"):
             record["replicas"] = args.replicas
+            # pool_state carries per-replica engine config
+            # (weights_dtype, pipeline_depth, tp, devices): a deploy
+            # that accidentally mixed configs is VISIBLE in the gate
+            # output, not silent
             record["pool"] = engine.pool_state()
             if args.kill_replica is not None:
                 record["killed_replica"] = args.kill_replica
+        else:
+            record["engine"] = {
+                "weights_dtype": engine.weights_dtype,
+                "pipeline_depth": engine.pipeline_depth,
+                "tp": engine.tp}
         print(json.dumps(record))
         engine.close()
         return 1 if bad else 0
 
-    server = serving.ModelServer(engine, host=args.host, port=args.port,
+    server = serving.ModelServer(fleet if fleet is not None else engine,
+                                 host=args.host, port=args.port,
                                  verbose=args.verbose)
-    print("ptpu_serve: %r (%s) on http://%s — buckets batch=%s seq=%s"
+    print("ptpu_serve: %r (%s) on http://%s — buckets batch=%s seq=%s%s"
           % (engine.name, args.format, server.address,
-             engine.batch_buckets, engine.seq_buckets or "-"))
+             engine.batch_buckets, engine.seq_buckets or "-",
+             " + %d extra models" % len(extra_models)
+             if extra_models else ""))
 
     def handle_sig(signum, frame):
         # only unblock serve_forever from a side thread here (calling the
