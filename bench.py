@@ -60,6 +60,13 @@ def _error_line(msg):
         return {"metric": "kernel_floor_speedup", "value": 0.0,
                 "unit": "x fused/unfused", "vs_baseline": None,
                 "error": msg}
+    if os.environ.get("BENCH_DECODE") == "1" \
+            and os.environ.get("BENCH_MODEL", "") != "transformer":
+        # the standalone continuous-batching leg (BENCH_MODEL=transformer
+        # BENCH_DECODE=1 is the older KV-cache beam-decode leg below)
+        return {"metric": "decode_continuous_tokens_per_sec", "value": 0.0,
+                "unit": "tokens/sec/chip", "vs_baseline": None,
+                "error": msg}
     model = os.environ.get("BENCH_MODEL", "resnet50")
     decode = os.environ.get("BENCH_DECODE") == "1"
     token_metric = {"transformer": "transformer_cached_decode_throughput"
@@ -572,6 +579,156 @@ def bench_serving():
         "open_p50_ms": _lat_ms(open_lat, 0.50),
         "open_p95_ms": _lat_ms(open_lat, 0.95),
         "open_p99_ms": _lat_ms(open_lat, 0.99),
+        "device": str(jax.devices()[0])}))
+
+
+def bench_decode():
+    """BENCH_DECODE=1 (BENCH_MODEL unset): the iteration-level
+    continuous-batching decode leg (ARCHITECTURE.md §27). Builds a
+    state-carrying decode-step program (greedy argmax feedback through an
+    MLP over carried hidden + context rows — the control shape of a
+    seq2seq decoder without the transformer bulk), serves it through a
+    DecodeEngine, and measures
+
+      * serial baseline — the SAME streams one at a time through a
+        solo_clone sharing the engine's weights (decode serving without
+        continuous batching). Doubles as the bit-exactness reference.
+      * open loop — a FIXED arrival schedule computed up front (i/rate
+        offsets), rate BENCH_DECODE_ARRIVAL_QPS streams/sec (default 2x
+        the serial baseline), streams admitted into free slots and
+        retired at iteration boundaries mid-flight. Mixed per-stream
+        token budgets force admits/retires while other streams decode.
+
+    One JSON line: continuous tokens/sec as the headline value plus the
+    serial baseline, inter-token p50/p99, mean slot occupancy and
+    divergence_vs_solo — HARD-gated: any stream whose token sequence
+    differs from its solo decode fails the leg (exit 2). Tokens count
+    only when materialized on the host (each iteration host-syncs the
+    token row — that sync IS the decode scheduling loop)."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import serving
+
+    slots = int(os.environ.get("BENCH_DECODE_SLOTS", "8"))
+    n_streams = int(os.environ.get("BENCH_DECODE_STREAMS", "48"))
+    base_tokens = int(os.environ.get("BENCH_DECODE_TOKENS", "24"))
+    hidden = int(os.environ.get("BENCH_DECODE_HIDDEN", "256"))
+    vocab = int(os.environ.get("BENCH_DECODE_VOCAB", "4096"))
+    n_layers = int(os.environ.get("BENCH_DECODE_LAYERS", "4"))
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 11
+    with fluid.unique_name.guard(), fluid.program_guard(main_prog, startup):
+        tok = fluid.layers.create_global_var([slots, 1], 0, "int64",
+                                             persistable=True, name="tok")
+        h = fluid.layers.create_global_var([slots, hidden], 0.0, "float32",
+                                           persistable=True, name="h")
+        ctx = fluid.layers.create_global_var([slots, hidden], 0.0,
+                                             "float32", persistable=True,
+                                             name="ctx")
+        z = fluid.layers.concat(
+            [fluid.layers.cast(tok, "float32"), h, ctx], axis=1)
+        for _ in range(n_layers):
+            z = fluid.layers.fc(input=z, size=hidden, act="tanh")
+        logits = fluid.layers.fc(input=z, size=vocab)
+        nxt = fluid.layers.reshape(
+            fluid.layers.argmax(logits, axis=1), shape=[slots, 1])
+        fin = fluid.layers.equal(
+            nxt, fluid.layers.fill_constant([slots, 1], "int64", 0))
+        fluid.layers.assign(nxt, output=tok)
+        fluid.layers.assign(z, output=h)
+
+    # mixed budgets: retires happen while other streams keep decoding, so
+    # the open loop provably admits INTO a half-full running batch
+    budgets = [max(4, base_tokens // 2 + (i * 7) % base_tokens)
+               for i in range(n_streams)]
+    rng = np.random.RandomState(0)
+    feeds = [{"tok": np.array([i % (vocab - 1) + 1], dtype="int64"),
+              "ctx": rng.randn(hidden).astype("float32")}
+             for i in range(n_streams)]
+
+    engine = serving.DecodeEngine(
+        program=main_prog, startup_program=startup,
+        token_var=nxt, finished_var=fin, max_slots=slots,
+        name="bench-decode", queue_capacity=max(1024, n_streams),
+        default_max_new_tokens=base_tokens)
+
+    # serial baseline + bit-exactness reference: one stream at a time
+    # through a clone sharing this engine's weights
+    solo = engine.solo_clone(name="bench-decode-solo")
+    serial_out = []
+    t0 = time.perf_counter()
+    try:
+        for f, budget in zip(feeds, budgets):
+            serial_out.append(np.asarray(
+                solo.decode(f, max_new_tokens=budget)).reshape(-1))
+    except Exception as e:  # noqa: BLE001 - reported as leg failure
+        print(json.dumps(_error_line(
+            "decode serial baseline failed after %d/%d streams: %r"
+            % (len(serial_out), n_streams, e))))
+        sys.stdout.flush()
+        os._exit(2)
+    serial_dt = time.perf_counter() - t0
+    solo.close()
+    serial_tokens = int(sum(len(s) for s in serial_out))
+    serial_tps = serial_tokens / serial_dt
+
+    # open loop: fixed schedule, rate defaults to 2x the serial
+    # stream-completion rate — pressure enough that slots stay multiply
+    # occupied without the pending queue growing unboundedly
+    rate = float(os.environ.get("BENCH_DECODE_ARRIVAL_QPS", "0")) \
+        or 2.0 * (n_streams / serial_dt)
+    schedule = [i / rate for i in range(n_streams)]
+    streams, cont_out = [], []
+    t0 = time.perf_counter()
+    try:
+        for i, offset in enumerate(schedule):
+            delay = t0 + offset - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            streams.append(engine.submit(feeds[i],
+                                         max_new_tokens=budgets[i]))
+        for s in streams:
+            cont_out.append(np.asarray(s.result(300)).reshape(-1))
+    except Exception as e:  # noqa: BLE001 - reported as leg failure
+        engine.close(drain=False)
+        print(json.dumps(_error_line(
+            "decode open loop failed after %d/%d streams: %r"
+            % (len(cont_out), n_streams, e))))
+        sys.stdout.flush()
+        os._exit(2)
+    cont_dt = time.perf_counter() - t0
+    cont_tokens = int(sum(len(s) for s in cont_out))
+    stats = engine.decode_stats()
+    engine.close()
+
+    mismatched = [i for i, (a, b) in enumerate(zip(cont_out, serial_out))
+                  if a.shape != b.shape or not np.array_equal(a, b)]
+    divergence = len(mismatched) / float(n_streams)
+    if mismatched:  # the per-stream bit-exactness contract is the POINT
+        print(json.dumps(_error_line(
+            "continuous decode diverged from solo on %d/%d streams "
+            "(first: stream %d)" % (len(mismatched), n_streams,
+                                    mismatched[0]))))
+        sys.stdout.flush()
+        os._exit(2)
+
+    print(json.dumps({
+        "metric": "decode_continuous_tokens_per_sec",
+        "value": round(cont_tokens / cont_dt, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "serial_tokens_per_s": round(serial_tps, 1),
+        "speedup_vs_serial": round((cont_tokens / cont_dt) / serial_tps, 2),
+        "divergence_vs_solo": divergence,
+        "streams": n_streams, "slots": slots,
+        "tokens": cont_tokens,
+        "open_arrival_streams_per_s": round(rate, 2),
+        "mean_slot_occupancy": stats["mean_slot_occupancy"],
+        "inter_token_p50_ms": stats["inter_token_p50_ms"],
+        "inter_token_p99_ms": stats["inter_token_p99_ms"],
+        "iterations": stats["iterations"],
+        "layers": n_layers, "hidden": hidden, "vocab": vocab,
         "device": str(jax.devices()[0])}))
 
 
@@ -2351,6 +2508,10 @@ def main():
             print(json.dumps(_error_line("kernels leg failed: %r" % (e,))))
             sys.stdout.flush()
             os._exit(2)
+        return
+    if os.environ.get("BENCH_DECODE") == "1" \
+            and os.environ.get("BENCH_MODEL", "") != "transformer":
+        bench_decode()
         return
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "transformer":

@@ -92,6 +92,7 @@ class InflightWindow(object):
         self._idle_s = 0.0
         self._gaps = 0
         self._completed = 0
+        self._iterations = 0  # decode iterations (note_iteration)
         self._thread = threading.Thread(
             target=self._completion_loop, daemon=True,
             name="ptpu-window-%s" % (tag or "anon"))
@@ -165,10 +166,22 @@ class InflightWindow(object):
                 self._completed += 1
             self._sem.release()
 
+    def note_iteration(self):
+        """Count one decode iteration against this window.  A decode
+        step-loop (serving.DecodeBatcher) runs MANY jitted steps per
+        tracked dispatch slot; the per-step count is the unit the
+        bucket-lattice invariant is proved at under slot reuse (every
+        iteration re-establishes 'row result depends only on that row at
+        this fixed shape'), so it surfaces in stats()/metrics distinctly
+        from `completed` (tracked dispatches)."""
+        with self._lock:
+            self._iterations += 1
+
     def stats(self):
         with self._lock:
             return {"idle_s": self._idle_s, "gaps": self._gaps,
-                    "completed": self._completed}
+                    "completed": self._completed,
+                    "iterations": self._iterations}
 
     def close(self, timeout=None):
         self._q.put(_CLOSE)

@@ -943,6 +943,33 @@ def analyze_state(program, feed_names, fetch_names=()):
     return state_rw, state_ro, state_out
 
 
+def build_slot_update_fn():
+    """One donated row-writer for decode slot state (serving.DecodeEngine).
+
+    fn(state_vals, slot, row_vals) -> new_state_vals
+
+    state_vals: tuple of [slots, ...] device arrays (the carried decode
+    state — KV caches, hidden state, token cursors); slot: scalar row
+    index; row_vals: tuple of per-var rows (shape state.shape[1:]).
+    Every state array gets ONE row overwritten via
+    dynamic_update_index_in_dim with the state buffers DONATED, so an
+    admit/reset touches one row in place without copying or host-syncing
+    the other slots' live state — the other rows' bits flow through
+    untouched, which is exactly the per-slot reset-on-admit obligation
+    of the bucket-lattice invariant (ARCHITECTURE §27).
+
+    One jit serves every (engine, admit) at the same avals; pass `slot`
+    as a numpy scalar so the index is traced, not baked into the
+    executable."""
+    def _update(state_vals, slot, row_vals):
+        out = []
+        for s, r in zip(state_vals, row_vals):
+            out.append(jax.lax.dynamic_update_index_in_dim(
+                s, jnp.asarray(r, s.dtype), slot, axis=0))
+        return tuple(out)
+    return jax.jit(_update, donate_argnums=(0,))
+
+
 def _all_ops(program):
     # grad_of ops list their reads (fwd inputs + out-grads) in op.inputs, so a
     # plain walk sees every data dependency (backward.py guarantees this).

@@ -11,6 +11,7 @@ __all__ = [
     "sequence_softmax", "sequence_conv", "sequence_expand", "sequence_reshape",
     "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit", "lstm_unit",
     "lod_reset", "row_conv", "beam_search", "beam_search_decode",
+    "sequence_cache_write",
 ]
 
 
@@ -319,6 +320,24 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
         outputs={"C": [c], "H": [h]},
         attrs={"forget_bias": forget_bias})
     return h, c
+
+
+def sequence_cache_write(cache, x, pos, name=None):
+    """Write each row of `x` [B, ...] into `cache` [B, T, ...] at that
+    row's position `pos` [B] (TPU-native addition — the KV-cache write
+    of a decode step).  Returns the updated cache; make `cache` (and
+    `pos`) persistable slot state and assign the result back so
+    serving.DecodeEngine keeps the cache device-resident and donated
+    across iterations (ARCHITECTURE §27)."""
+    helper = LayerHelper("sequence_cache_write", **locals())
+    out = helper.create_variable_for_type_inference(cache.dtype)
+    helper.append_op(
+        type="sequence_cache_write",
+        inputs={"Cache": [cache], "X": [x], "Pos": [pos]},
+        outputs={"Out": [out]})
+    if cache.shape is not None:
+        out.shape = tuple(cache.shape)
+    return out
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None):

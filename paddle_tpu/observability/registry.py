@@ -34,7 +34,8 @@ import threading
 import weakref
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "REGISTRY", "note_window", "note_batcher", "watch_cluster",
+           "REGISTRY", "note_window", "note_batcher", "note_decoder",
+           "watch_cluster",
            "serve_metrics", "MetricsServer", "write_textfile"]
 
 
@@ -282,8 +283,9 @@ REGISTRY = MetricsRegistry()
 
 _live_windows = weakref.WeakValueDictionary()   # label -> InflightWindow
 _live_batchers = weakref.WeakValueDictionary()  # label -> Batcher
+_live_decoders = weakref.WeakValueDictionary()  # label -> DecodeBatcher
 _note_lock = threading.Lock()
-_note_seq = {"window": 0, "batcher": 0}
+_note_seq = {"window": 0, "batcher": 0, "decoder": 0}
 
 
 def _note(kind, table, obj, name):
@@ -304,6 +306,15 @@ def note_window(window):
 def note_batcher(batcher, name):
     """Called by Batcher.__init__: expose queue/formed depths."""
     return _note("batcher", _live_batchers, batcher, name)
+
+
+def note_decoder(decoder, name):
+    """Called by serving.DecodeBatcher.__init__: expose the decode
+    step-loop's slot/stream/token gauges through the registry for the
+    batcher's lifetime (weakref, like windows).  The object contract is
+    one `decode_stats()` dict — the same snapshot `pool_state()`
+    carries per replica."""
+    return _note("decoder", _live_decoders, decoder, name)
 
 
 @REGISTRY.register_collector
@@ -344,6 +355,47 @@ def _batcher_collector():
          "requests waiting in the batcher queue", qdepth),
         ("ptpu_batcher_formed_depth", "gauge",
          "formed batches waiting for a dispatch slot", fdepth),
+    ]
+
+
+@REGISTRY.register_collector
+def _decoder_collector():
+    slots, occ, act, toks, iters, tps, p50, p99, done = (
+        [], [], [], [], [], [], [], [], [])
+    for label, d in sorted(_live_decoders.items()):
+        try:
+            s = d.decode_stats()
+        except Exception:  # noqa: BLE001 — a closing decoder is not news
+            continue
+        lbl = {"decoder": label}
+        slots.append((lbl, s["slots"]))
+        occ.append((lbl, s["occupied_slots"]))
+        act.append((lbl, s["active_streams"]))
+        toks.append((lbl, s["tokens_total"]))
+        iters.append((lbl, s["iterations"]))
+        tps.append((lbl, s["tokens_per_s"]))
+        p50.append((lbl, s["inter_token_p50_ms"]))
+        p99.append((lbl, s["inter_token_p99_ms"]))
+        done.append((lbl, s["streams_completed"]))
+    return [
+        ("ptpu_decode_slots", "gauge",
+         "compiled decode batch rows (max concurrent streams)", slots),
+        ("ptpu_decode_occupied_slots", "gauge",
+         "slots currently carrying a live stream", occ),
+        ("ptpu_decode_active_streams", "gauge",
+         "streams admitted and not yet retired", act),
+        ("ptpu_decode_tokens_total", "counter",
+         "tokens delivered to streams", toks),
+        ("ptpu_decode_iterations_total", "counter",
+         "decode step-loop iterations dispatched", iters),
+        ("ptpu_decode_tokens_per_s", "gauge",
+         "recent token throughput across all slots", tps),
+        ("ptpu_decode_inter_token_p50_ms", "gauge",
+         "median inter-token latency over the recent window", p50),
+        ("ptpu_decode_inter_token_p99_ms", "gauge",
+         "p99 inter-token latency over the recent window", p99),
+        ("ptpu_decode_streams_completed_total", "counter",
+         "streams retired after finishing normally", done),
     ]
 
 

@@ -49,8 +49,8 @@ import jax
 __all__ = [
     "KERNEL_OPS", "DEFAULT_TILES", "DEFAULT_FLASH_MIN_SEQ",
     "CROSSOVER_SIGNATURE", "pallas_explicit", "pallas_on",
-    "flash_min_seq", "shape_bucket", "kernel_signature", "tiles_for",
-    "kernel_env_key", "local_device_key",
+    "flash_min_seq", "flash_at", "shape_bucket", "kernel_signature",
+    "tiles_for", "kernel_env_key", "local_device_key",
 ]
 
 # the one shared default table — the pre-tuning literals.  Keys are the
@@ -202,6 +202,35 @@ def flash_min_seq():
         value = int(entry["knobs"]["flash_min_seq"])
     _crossover_cache[st.root] = (stamp, value)
     return value
+
+
+def flash_at(q_len):
+    """The one flash-vs-dense decision for fused_attention at query
+    length `q_len` (the traced q.shape[1]; None when symbolic).
+
+    Decode-shaped dispatch is STRUCTURAL, not a crossover knob:
+    at q_len <= 1 (one query row per step — the decode-serving shape)
+    the flash kernel's block_q tiling is wrong by construction (a
+    128-row q block for a 1-row query; the kernel grid degenerates and
+    the crossover knob was never measured there), so the dense path is
+    taken unconditionally — EVEN when FLAGS_flash_min_seq=0 pins
+    "flash always" for the coverage tests.  Above that:
+
+      * explicit PADDLE_TPU_PALLAS opt-out (=0 or allowlist without
+        'attn') -> dense, regardless of length;
+      * q_len >= flash_min_seq() -> flash;
+      * otherwise dense.
+
+    q_len=None (symbolic trace dim) keeps the historical behavior:
+    not decode-shaped, crossover can't be evaluated, flash unless
+    explicitly opted out."""
+    if q_len is not None and q_len <= 1:
+        return False
+    if pallas_explicit("attn") is False:
+        return False
+    if q_len is None:
+        return True
+    return q_len >= flash_min_seq()
 
 
 # ---------------------------------------------------------------------------

@@ -410,10 +410,13 @@ def _fused_attention(ctx, ins, attrs):
     # kernel-coverage tests and the block-tune sweep). An explicit
     # PADDLE_TPU_PALLAS opt-out (=0, or an allowlist without 'attn')
     # forces the dense path regardless of length.
-    from .kernel_config import pallas_explicit, tiles_for
-    min_seq = _flash_min_seq()
+    # kernel_config.flash_at owns the decision, including the structural
+    # decode rule: q_len <= 1 (decode serving steps one token at a time)
+    # is dense by construction — no flash tiling exists for a 1-row q
+    # block, so not even FLAGS_flash_min_seq=0 forces the kernel there.
+    from .kernel_config import flash_at, tiles_for
     t = q.shape[1]
-    if pallas_explicit("attn") is False or (t is not None and t < min_seq):
+    if not flash_at(t):
         from ..parallel.ring_attention import attention_reference
         return _out(attention_reference(
             q, k, v, causal=causal, scale=scale,

@@ -614,6 +614,25 @@ def _lstm_unit(ctx, ins, attrs):
     return {"C": [c], "H": [h]}
 
 
+@register("sequence_cache_write")
+def _sequence_cache_write(ctx, ins, attrs):
+    """Per-row timestep write into a [B, T, ...] cache (TPU-native
+    addition): Out[b, Pos[b]] = X[b], every other cell bit-identical to
+    Cache.  The KV-cache building block for decode-step programs —
+    Cache and Pos are persistable slot state under serving.DecodeEngine,
+    so the executor's donation machinery keeps the whole cache
+    device-resident and this lowers to one in-place scatter row write
+    per step, never a host round-trip or a full-cache copy.  Row b's
+    output depends only on row b of every input — the property the
+    decode batcher's slot-reuse invariant (ARCHITECTURE §27) leans on."""
+    cache = single(ins, "Cache")                      # [B, T, ...]
+    x = single(ins, "X")                              # [B, ...]
+    pos = single(ins, "Pos").astype(jnp.int32).reshape(-1)   # [B]
+    b = cache.shape[0]
+    out = cache.at[jnp.arange(b), pos].set(jnp.asarray(x, cache.dtype))
+    return {"Out": [out]}
+
+
 @register("sequence_mask")
 def _sequence_mask(ctx, ins, attrs):
     """lengths [N] -> [N, maxlen] mask. Parity: sequence_mask_op.h."""

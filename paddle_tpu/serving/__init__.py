@@ -30,19 +30,26 @@ slice with auto-rollback at zero client errors, and `fleet.ModelFleet`
 serves N models with per-model replica sets and priority brownout.
 CLI: `tools/ptpu_serve.py` (`--replicas N`, `--tp M`, `--autoscale
 MIN,MAX`, `--extra-model NAME=DIR@PRIO`, `--selfcheck
---kill-replica`). Design notes: ARCHITECTURE.md §15 (engine/batcher),
-§20 (the pool), §23 (tensor-parallel replicas), §26 (the fleet).
+--kill-replica`). Generative decode: `engine.DecodeEngine` +
+`batcher.DecodeBatcher` run a state-carrying step program with one
+batch-row slot per stream and admit/retire sequences BETWEEN decode
+iterations (Orca-style continuous batching) at one fixed compiled
+shape, each stream bit-exact vs a solo decode (`tools/ptpu_serve.py
+--decode`, ARCHITECTURE.md §27). Design notes: ARCHITECTURE.md §15
+(engine/batcher), §20 (the pool), §23 (tensor-parallel replicas), §26
+(the fleet), §27 (continuous-batched decode).
 """
 from .autoscaler import PoolAutoscaler
-from .batcher import (Batcher, DeadlineExceededError, QueueFullError,
-                      RequestFuture, RequestTooLargeError, ServingClosedError,
-                      ServingError)
+from .batcher import (Batcher, DeadlineExceededError, DecodeBatcher,
+                      DecodeStream, QueueFullError, RequestFuture,
+                      RequestTooLargeError, ServingClosedError, ServingError)
 from .canary import CanaryController, CanaryFuture
-from .engine import InferenceEngine, InvalidRequestError, ResultSlice
+from .engine import (DecodeEngine, InferenceEngine, InvalidRequestError,
+                     ResultSlice)
 from .fleet import BrownoutError, ModelFleet
-from .metrics import ServingMetrics
-from .pool import (AttemptTimeoutError, PoisonedOutputError, PoolFuture,
-                   PoolMetrics, PoolResult, ReplicaPool)
+from .metrics import DecodeMetrics, ServingMetrics
+from .pool import (AttemptTimeoutError, DecodePool, PoisonedOutputError,
+                   PoolFuture, PoolMetrics, PoolResult, ReplicaPool)
 from .server import ModelServer
 
 __all__ = [
@@ -54,4 +61,6 @@ __all__ = [
     "AttemptTimeoutError", "PoisonedOutputError",
     "PoolAutoscaler", "CanaryController", "CanaryFuture",
     "ModelFleet", "BrownoutError",
+    "DecodeEngine", "DecodeBatcher", "DecodeStream", "DecodeMetrics",
+    "DecodePool",
 ]

@@ -37,7 +37,7 @@ from ..observability import trace as _trace
 
 __all__ = ["Batcher", "RequestFuture", "ServingError", "QueueFullError",
            "DeadlineExceededError", "ServingClosedError",
-           "RequestTooLargeError"]
+           "RequestTooLargeError", "DecodeStream", "DecodeBatcher"]
 
 
 class ServingError(RuntimeError):
@@ -579,3 +579,456 @@ class Batcher(object):
             # after the workers: every tracked dispatch gets its
             # completion observed, then the completion thread exits
             self._window.close(timeout)
+
+
+# ---------------------------------------------------------------------------
+# Iteration-level continuous batching for autoregressive decode
+# ---------------------------------------------------------------------------
+
+class DecodeStream(object):
+    """Handle for ONE decoding sequence under a DecodeBatcher.
+
+    The request-shaped analogue of RequestFuture, except completion is
+    incremental: the step-loop worker `_deliver`s a token per iteration
+    while the stream occupies a slot, and `_finish`es it at retire.
+    Consumers read tokens as they land (`next_token` / iteration) or
+    wait for the whole sequence (`result`). Thread contract: `_deliver`/
+    `_finish` are worker-only; everything public is any-thread."""
+
+    __slots__ = ("stream_id", "feeds", "max_new_tokens", "deadline",
+                 "enqueued_at", "slot", "trace", "span", "qspan",
+                 "_cond", "_tokens", "_done", "_error", "_read",
+                 "_last_tok_t", "admitted_at")
+
+    def __init__(self, feeds, max_new_tokens, deadline):
+        self.stream_id = None        # assigned at submit
+        self.feeds = feeds           # per-slot init rows {var: row}
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = deadline     # monotonic seconds, or None
+        self.enqueued_at = time.monotonic()
+        self.admitted_at = None
+        self.slot = None             # batch row while resident
+        self.trace = None
+        self.span = _trace._NOOP
+        self.qspan = _trace._NOOP
+        self._cond = threading.Condition()
+        self._tokens = []
+        self._done = False
+        self._error = None
+        self._read = 0               # next_token cursor
+        self._last_tok_t = None      # for inter-token gap accounting
+
+    # ------------------------------------------------------- consumers --
+    def done(self):
+        with self._cond:
+            return self._done
+
+    def token_count(self):
+        with self._cond:
+            return len(self._tokens)
+
+    def tokens(self):
+        """Tokens delivered so far (list of per-step numpy values)."""
+        with self._cond:
+            return list(self._tokens)
+
+    def next_token(self, timeout=None):
+        """Block for the next undelivered token; returns it, or None
+        once the stream finished and every token was read. Raises the
+        stream's error (DeadlineExceededError / ServingClosedError /
+        dispatch failure) as soon as it is observed past the delivered
+        tokens — a consumer always sees every good token first."""
+        with self._cond:
+            if not self._cond.wait_for(
+                    lambda: self._read < len(self._tokens) or self._done,
+                    timeout):
+                raise TimeoutError(
+                    "no token within %rs (stream %r)"
+                    % (timeout, self.stream_id))
+            if self._read < len(self._tokens):
+                tok = self._tokens[self._read]
+                self._read += 1
+                return tok
+            if self._error is not None:
+                raise self._error
+            return None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        tok = self.next_token()
+        if tok is None:
+            raise StopIteration
+        return tok
+
+    def result(self, timeout=None):
+        """Block until the stream retires; returns ALL tokens stacked
+        into one np.ndarray [n_tokens, ...]. Raises the stream's error
+        (after a partial decode the delivered prefix stays readable via
+        `tokens()`)."""
+        import numpy as np
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._done, timeout):
+                raise TimeoutError(
+                    "stream not finished within %rs" % (timeout,))
+            if self._error is not None:
+                raise self._error
+            return np.stack(self._tokens) if self._tokens \
+                else np.zeros((0,))
+
+    # ---------------------------------------------------... worker-only --
+    def _deliver(self, token, now):
+        with self._cond:
+            if self._done:
+                return None
+            gap = (now - self._last_tok_t) if self._last_tok_t is not None \
+                else (now - (self.admitted_at or self.enqueued_at))
+            self._last_tok_t = now
+            self._tokens.append(token)
+            self._cond.notify_all()
+            return gap
+
+    def _finish(self, error=None):
+        with self._cond:
+            if self._done:
+                return False
+            self._done = True
+            self._error = error
+            self._cond.notify_all()
+        self.span.end(**({"error": type(error).__name__}
+                         if error is not None else {}))
+        return True
+
+
+class DecodeBatcher(object):
+    """Iteration-level (Orca-style) continuous batching for
+    autoregressive decode: one step-loop worker owns a fixed lattice of
+    `max_slots` batch rows and a compiled decode step at that ONE shape;
+    streams are admitted into free slots and retired from finished ones
+    BETWEEN iterations, so a long decode never blocks short strangers
+    and slots refill mid-flight instead of waiting for the whole batch
+    to drain.
+
+    The engine supplies the device halves:
+      admit_fn(slot, feeds) — reset slot `slot`'s carried state and
+        write the stream's init rows (per-slot reset-on-admit: the
+        invariant guard for slot reuse);
+      step_fn() — one fixed-shape decode step over all slots; returns
+        (tokens [slots, ...] np, finished [slots] bool np, handles)
+        where handles are the step's lazy fetch handles for window
+        completion tracking.
+
+    Correctness under slot sharing is the engine's bucket-lattice
+    invariant applied per step: at the fixed compiled shape a row's
+    outputs and carried state depend only on that row, so a stream's
+    token sequence is bit-identical to a solo decode regardless of who
+    shares the batch or what previously occupied its slot
+    (ARCHITECTURE.md §27). The step loop is intentionally serial
+    (depth-1 window): each iteration must observe `finished` before it
+    can schedule the next admit/retire, so decode pipelining happens
+    ACROSS slots, not across iterations."""
+
+    def __init__(self, step_fn, admit_fn, max_slots,
+                 queue_capacity=256, default_max_new_tokens=128,
+                 metrics=None, name="decode"):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1, got %r"
+                             % (max_slots,))
+        from ..core.dispatch import InflightWindow
+        from .metrics import DecodeMetrics
+        self._step = step_fn
+        self._admit = admit_fn
+        self.max_slots = int(max_slots)
+        self.queue_capacity = int(queue_capacity)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self._metrics = metrics if metrics is not None else DecodeMetrics()
+        self._slots = [None] * self.max_slots   # slot -> DecodeStream
+        self._free = list(range(self.max_slots - 1, -1, -1))
+        self._pending = collections.deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        self._draining = False
+        self._next_id = 0
+        # depth 1: iterations are serial by construction (see class
+        # docstring) but ride the window anyway — its completion thread
+        # observes per-step device completion and its stats carry the
+        # iteration counter to /metrics
+        self._window = InflightWindow(1, tag="serving/%s/decode" % name)
+        self._worker = threading.Thread(
+            target=self._step_loop, daemon=True,
+            name="ptpu-%s-decode" % name)
+        _obsreg.note_decoder(self, name)
+        self._worker.start()
+
+    # ---------------------------------------------------------- intake --
+    def submit(self, feeds, max_new_tokens=None, deadline_ms=None):
+        """Enqueue one sequence; returns its DecodeStream. Raises
+        QueueFullError / ServingClosedError without blocking."""
+        if max_new_tokens is None:
+            max_new_tokens = self.default_max_new_tokens
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1, got %r"
+                             % (max_new_tokens,))
+        deadline = (time.monotonic() + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        stream = DecodeStream(feeds, max_new_tokens, deadline)
+        stream.trace = _trace.new_trace()
+        stream.span = _trace.span("serving/stream", cat="serving",
+                                  trace=stream.trace,
+                                  max_new_tokens=int(max_new_tokens))
+        stream.qspan = stream.span.child("serving/queue")
+        with self._cond:
+            if self._closed:
+                stream.qspan.end(error="ServingClosedError")
+                stream.span.end(error="ServingClosedError")
+                raise ServingClosedError("decode engine is shut down")
+            if len(self._pending) >= self.queue_capacity:
+                self._metrics.on_queue_full()
+                stream.qspan.end(error="QueueFullError")
+                stream.span.end(error="QueueFullError")
+                raise QueueFullError(
+                    "decode queue at capacity (%d); retry with backoff"
+                    % self.queue_capacity)
+            self._next_id += 1
+            stream.stream_id = self._next_id
+            self._pending.append(stream)
+            self._cond.notify_all()
+        return stream
+
+    def queue_depth(self):
+        return len(self._pending)
+
+    def decode_stats(self):
+        """One snapshot joining slot occupancy (live) with the
+        DecodeMetrics counters — the per-replica decode block
+        `pool_state()` carries and the registry's decoder collector
+        renders on /metrics."""
+        with self._lock:
+            occupied = sum(1 for s in self._slots if s is not None)
+            pending = len(self._pending)
+        snap = self._metrics.snapshot()
+        snap.update({
+            "slots": self.max_slots,
+            "occupied_slots": occupied,
+            "active_streams": occupied,
+            "pending_streams": pending,
+            "window": self._window.stats(),
+        })
+        return snap
+
+    # ---------------------------------------------------------- worker --
+    def _fail_stream(self, stream, exc, deadline=False):
+        if stream._finish(exc):
+            if deadline:
+                self._metrics.on_deadline_expired()
+            else:
+                self._metrics.on_stream_failed()
+
+    def _expire_pending_locked(self, now):
+        """Drop overdue pending streams (typed, at the boundary)."""
+        kept = collections.deque()
+        while self._pending:
+            s = self._pending.popleft()
+            if s.deadline is not None and s.deadline < now:
+                s.qspan.end(error="DeadlineExceededError")
+                self._fail_stream(s, DeadlineExceededError(
+                    "deadline passed after %.1fms waiting for a slot"
+                    % ((now - s.enqueued_at) * 1e3)), deadline=True)
+            else:
+                kept.append(s)
+        self._pending = kept
+
+    def _collect_iteration(self):
+        """Admit pending streams into free slots; return (admits,
+        active) or (None, None) on shutdown. Blocks while idle."""
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                self._expire_pending_locked(now)
+                occupied = any(s is not None for s in self._slots)
+                if self._closed and not self._draining:
+                    return None, None           # hard close: streams
+                if occupied or self._pending:   # already failed
+                    break
+                if self._closed:
+                    return None, None           # drained dry
+                self._cond.wait(timeout=0.5)
+            admits = []
+            while self._free and self._pending:
+                s = self._pending.popleft()
+                if s.deadline is not None and s.deadline < now:
+                    s.qspan.end(error="DeadlineExceededError")
+                    self._fail_stream(s, DeadlineExceededError(
+                        "deadline passed after %.1fms waiting for a slot"
+                        % ((now - s.enqueued_at) * 1e3)), deadline=True)
+                    continue
+                slot = self._free.pop()
+                s.slot = slot
+                self._slots[slot] = s
+                admits.append(s)
+            active = [(i, s) for i, s in enumerate(self._slots)
+                      if s is not None]
+            return admits, active
+
+    def _retire_locked(self, slot, stream):
+        """Free `slot` iff `stream` still owns it (a hard close may have
+        reaped it concurrently — double-freeing would hand one slot to
+        two streams)."""
+        if self._slots[slot] is stream:
+            self._slots[slot] = None
+            self._free.append(slot)
+
+    def _step_loop(self):
+        from .. import profiler as _prof
+        while True:
+            admits, active = self._collect_iteration()
+            if admits is None:
+                return
+            # device-side admit: reset-on-admit + the stream's init rows,
+            # OUTSIDE the lock (submit/consumers must not wait on device
+            # writes). The worker is the only device-touching thread.
+            for s in admits:
+                s.qspan.end()      # slot granted: queue wait over
+                s.admitted_at = time.monotonic()
+                try:
+                    with _trace.span("serving/decode_admit", cat="serving",
+                                     trace=s.trace, slot=s.slot,
+                                     stream=s.stream_id):
+                        self._admit(s.slot, s.feeds)
+                    self._metrics.on_admit()
+                except Exception as e:  # noqa: BLE001 — fail THIS
+                    with self._cond:    # stream, not the loop
+                        self._retire_locked(s.slot, s)
+                        self._fail_stream(s, e)
+                        self._cond.notify_all()
+            # admits are already in the slot table (placed under the
+            # lock in _collect_iteration); drop any stream a failed
+            # admit or concurrent hard close finished meanwhile
+            active = [(i, s) for i, s in active if not s.done()]
+            if not active:
+                continue
+            # one decode iteration at the fixed compiled shape
+            if not self._acquire_slot_or_bail(active):
+                continue
+            btrace = _trace.new_trace()
+            enq_t = time.monotonic()
+            dspan = _trace.span(
+                "serving/decode_step", cat="serving", trace=btrace,
+                slots=len(active),
+                streams=[s.stream_id for _, s in active],
+                traces=[s.trace for _, s in active])
+            try:
+                with _prof.dispatch_path(), _trace.scope_trace(btrace):
+                    tokens, finished, handles = self._step()
+            except Exception as e:  # noqa: BLE001 — fail the resident
+                dspan.end(error=type(e).__name__)   # streams, keep the
+                self._window.release()              # loop serving
+                with self._cond:
+                    for slot, s in active:
+                        self._retire_locked(slot, s)
+                        self._fail_stream(s, e)
+                    self._cond.notify_all()
+                continue
+            dspan.end()
+            espan = _trace.span("serving/decode_execute", cat="serving",
+                                trace=btrace,
+                                streams=[s.stream_id for _, s in active])
+            self._window.track(handles or (), enq_t,
+                               on_complete=espan.end)
+            self._window.note_iteration()
+            self._deliver_iteration(active, tokens, finished)
+
+    def _acquire_slot_or_bail(self, active):
+        """Window slot for this iteration; a hard close while the
+        window is busy fails the resident streams instead of wedging."""
+        while not self._window.acquire(timeout=0.1):
+            with self._cond:
+                if self._closed and not self._draining:
+                    for slot, s in active:
+                        self._retire_locked(slot, s)
+                        self._fail_stream(s, ServingClosedError(
+                            "decode engine shut down mid-stream"))
+                    self._cond.notify_all()
+                    return False
+        return True
+
+    def _deliver_iteration(self, active, tokens, finished):
+        """Scatter this iteration's tokens to their streams and retire
+        finished ones — the admit/retire boundary the next
+        `_collect_iteration` sees."""
+        now = time.monotonic()
+        delivered, gaps = 0, []
+        with self._cond:
+            for slot, stream in active:
+                if stream.done():   # hard close raced the step
+                    self._retire_locked(slot, stream)
+                    continue
+                gap = stream._deliver(tokens[slot], now)
+                if gap is not None:
+                    delivered += 1
+                    gaps.append(gap)
+                n = stream.token_count()
+                if bool(finished[slot]) or n >= stream.max_new_tokens:
+                    self._retire_locked(slot, stream)
+                    if stream._finish():
+                        self._metrics.on_stream_completed()
+                elif stream.deadline is not None and stream.deadline < now:
+                    self._retire_locked(slot, stream)
+                    self._fail_stream(stream, DeadlineExceededError(
+                        "per-stream deadline passed after %d token(s)"
+                        % n), deadline=True)
+            self._cond.notify_all()   # admits may proceed; drain waiters
+        self._metrics.on_iteration(len(active), delivered, gaps)
+
+    # ----------------------------------------------------------- drain --
+    def drain(self, timeout=None):
+        """Block until every pending and resident stream has retired
+        (tokens delivered, futures finished). Intake stays open, like
+        Batcher.drain. Returns True when drained, False on timeout."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cond:
+            while self._pending \
+                    or any(s is not None for s in self._slots):
+                if not self._worker.is_alive():
+                    return True
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                self._cond.wait(timeout=remaining)
+            return True
+
+    # -------------------------------------------------------- shutdown --
+    def close(self, drain=True, timeout=None):
+        """Stop intake; drain=True finishes every pending and resident
+        stream first, drain=False fails them ALL with
+        ServingClosedError — typed, immediate, no hang: the worker bails
+        at the next boundary and mid-flight consumers wake with the
+        error after reading every already-delivered token."""
+        with self._cond:
+            already = self._closed
+            self._closed = True
+            if drain and not already:
+                self._draining = True
+            if not drain and not already:
+                while self._pending:
+                    s = self._pending.popleft()
+                    s.qspan.end(error="ServingClosedError")
+                    self._fail_stream(s, ServingClosedError(
+                        "decode engine shut down before admit"))
+                for slot, s in enumerate(self._slots):
+                    if s is not None:
+                        self._retire_locked(slot, s)
+                        self._fail_stream(s, ServingClosedError(
+                            "decode engine shut down mid-stream"))
+            self._cond.notify_all()
+        if already:
+            return
+        if drain:
+            self.drain(timeout)
+        self._worker.join(timeout)
+        self._window.close(timeout)

@@ -36,10 +36,11 @@ from ..core.framework import convert_dtype
 from ..core.lod import LoDTensor
 from ..core.utils import find_var
 from ..observability import trace as _trace
-from .batcher import Batcher, ServingError
+from .batcher import Batcher, DecodeBatcher, ServingError
 from .metrics import ServingMetrics
 
-__all__ = ["InferenceEngine", "ResultSlice", "InvalidRequestError"]
+__all__ = ["InferenceEngine", "ResultSlice", "InvalidRequestError",
+           "DecodeEngine"]
 
 SEQLEN_SUFFIX = "@SEQLEN"
 
@@ -882,5 +883,347 @@ class InferenceEngine(object):
     def close(self, drain=True, timeout=None):
         """Graceful shutdown: stop intake, drain queued requests (every
         in-flight batch completes and scatters), join the worker."""
+        self.closed = True
+        self._batcher.close(drain=drain, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# DecodeEngine: slot-resident generative serving (ARCHITECTURE.md §27)
+# ---------------------------------------------------------------------------
+
+class DecodeEngine(object):
+    """A decode-step program + private Scope + iteration-level batcher.
+
+    The served artifact is ONE step of an autoregressive loop, authored
+    (or exported) at a fixed [max_slots, ...] batch shape with its
+    carried state — KV caches, hidden state, token cursors — held in
+    persistable "slot vars" (one slot per batch row). Every iteration is
+    one `Executor.run` of that step at the ONE compiled shape: the
+    executor's state machinery keeps the slot state device-resident and
+    DONATES the read-and-written arrays (the KV cache never round-trips
+    the host), the AOT compile cache / tuned-kernel trace keys compose
+    unchanged because a step IS an ordinary run, and the DecodeBatcher
+    admits/retires streams between iterations (Orca-style continuous
+    batching — see serving/batcher.DecodeBatcher).
+
+    Bit-exactness contract: the program must be deterministic (greedy
+    decode — no dropout/sampling ops), and then a stream's token
+    sequence is bit-identical to a solo decode of that stream on a
+    fresh engine, whatever shared the batch or previously used its
+    slot: at the fixed shape a row's outputs and next state depend only
+    on that row, and admit rewrites EVERY slot var's row (init rows
+    provided by the stream, zeros otherwise), so no previous resident
+    can leak through carried state.
+
+    Export caveat: `save_inference_model` prunes to the fetch subgraph —
+    a decode step must be saved with its state-writing outputs among the
+    fetch targets (token and finished vars first; the engine takes
+    fetch[0]/fetch[1] as token/finished by default) or the state
+    `assign`s would be silently pruned."""
+
+    def __init__(self, model_dir=None, model_format="auto",
+                 model_filename=None, params_filename=None, place=None,
+                 name=None, program=None, startup_program=None,
+                 token_var=None,
+                 finished_var=None, slot_vars=None, max_slots=8,
+                 queue_capacity=256, default_max_new_tokens=128,
+                 default_deadline_ms=None, validate=True, warmup=True,
+                 latency_window=4096):
+        from ..places import CPUPlace
+        from .metrics import DecodeMetrics
+        self.name = name or (os.path.basename(os.path.normpath(model_dir))
+                             if model_dir else "decode")
+        self._scope = Scope()
+        self._exe = Executor(place if place is not None else CPUPlace())
+        self._run_lock = threading.Lock()
+        self.closed = False
+        self.max_slots = int(max_slots)
+        if self.max_slots < 1:
+            raise ValueError("max_slots must be >= 1, got %r"
+                             % (max_slots,))
+        self.default_deadline_ms = default_deadline_ms
+
+        if program is None:
+            if model_dir is None:
+                raise ValueError("need model_dir or an in-memory program")
+            program, _feeds, fetch_vars = InferenceEngine._load(
+                self, model_dir, model_format, model_filename,
+                params_filename)
+            fetch_names = [v if isinstance(v, str) else v.name
+                           for v in fetch_vars]
+            if token_var is None or finished_var is None:
+                if len(fetch_names) < 2:
+                    raise ValueError(
+                        "a decode model dir must be saved with at least "
+                        "[token, finished] fetch targets (got %r); or "
+                        "pass token_var/finished_var explicitly"
+                        % (fetch_names,))
+                token_var = token_var or fetch_names[0]
+                finished_var = finished_var or fetch_names[1]
+        elif token_var is None or finished_var is None:
+            raise ValueError("an in-memory decode program needs "
+                             "token_var and finished_var")
+        self.program = program
+        self.token_name = token_var if isinstance(token_var, str) \
+            else token_var.name
+        self.finished_name = finished_var if isinstance(finished_var, str) \
+            else finished_var.name
+        self.fetch_names = [self.token_name, self.finished_name]
+        for n in self.fetch_names:
+            if find_var(self.program, n) is None:
+                raise ValueError("decode program has no variable %r" % n)
+        if validate:
+            from .. import analysis
+            analysis.validate_or_raise(self.program, feed_names=[],
+                                       fetch_names=self.fetch_names)
+        if startup_program is not None:
+            # in-memory authoring path: initialize weights into the
+            # private scope (deterministic given the program seeds, so
+            # two engines over the same pair decode identically). Slot
+            # vars re-zero below regardless — slot state always starts
+            # from the same zeros a fresh solo engine starts from.
+            self._exe.run(startup_program, scope=self._scope)
+
+        # state classification: the step feeds on NOTHING (everything
+        # it consumes is carried persistable state), so analyze_state
+        # sees every scope read/write
+        from ..core.lowering import analyze_state, build_slot_update_fn
+        self._state_rw, self._state_ro, self._state_out = analyze_state(
+            self.program, feed_names=[], fetch_names=self.fetch_names)
+        state_read = list(self._state_rw) + list(self._state_ro)
+
+        # slot vars: explicit list wins; else every WRITTEN persistable
+        # (inference programs never write weights, so written state is
+        # carried decode state) plus read-only state whose leading dim
+        # is exactly max_slots (per-slot context set at admit). The
+        # leading-dim heuristic can mistake a [max_slots, d] weight for
+        # slot state — pass slot_vars explicitly in that case.
+        if slot_vars is None:
+            slot_vars = list(self._state_out)
+            for n in self._state_ro:
+                var = find_var(self.program, n)
+                shape = list(var.shape or []) if var is not None else []
+                if shape and shape[0] in (-1, self.max_slots):
+                    slot_vars.append(n)
+        self.slot_vars = [v if isinstance(v, str) else v.name
+                          for v in slot_vars]
+        if not self.slot_vars:
+            raise ValueError(
+                "decode program carries no slot state (no persistable "
+                "var is written and none matches max_slots=%d); a decode "
+                "step must carry its loop state in persistables"
+                % self.max_slots)
+        self._slot_var_meta = {}   # name -> (row_shape, dtype)
+        for n in self.slot_vars:
+            var = find_var(self.program, n)
+            if var is None or not var.persistable:
+                raise ValueError(
+                    "slot var %r is not a persistable variable of the "
+                    "decode program" % n)
+            shape = list(var.shape or [])
+            if not shape or shape[0] not in (-1, self.max_slots):
+                raise ValueError(
+                    "slot var %r has shape %r; its leading dim must be "
+                    "the slot count (max_slots=%d, or -1)"
+                    % (n, shape, self.max_slots))
+            feat = shape[1:]
+            if any(d < 0 for d in feat):
+                raise ValueError(
+                    "slot var %r has free feature dims %r; decode slot "
+                    "state needs concrete per-slot shapes" % (n, feat))
+            dtype = convert_dtype(var.dtype) if var.dtype else "float32"
+            self._slot_var_meta[n] = (tuple(feat), dtype)
+        # non-slot state the step reads must exist in the scope too
+        # (zero-init whatever the model load didn't provide)
+        self._reset_slot_state()
+        for n in state_read:
+            if n not in self._slot_var_meta \
+                    and self._scope.get(n) is None:
+                var = find_var(self.program, n)
+                shape = [d if d >= 0 else 1 for d in (var.shape or [1])]
+                dtype = convert_dtype(var.dtype) if var.dtype \
+                    else "float32"
+                self._scope.set(n, np.zeros(shape, dtype=dtype))
+
+        self._update_rows = build_slot_update_fn()
+        self.metrics = DecodeMetrics(latency_window=latency_window)
+        self._batcher = DecodeBatcher(
+            self._step, self._admit, self.max_slots,
+            queue_capacity=queue_capacity,
+            default_max_new_tokens=default_max_new_tokens,
+            metrics=self.metrics, name=self.name)
+        if warmup:
+            try:
+                self.warmup()
+            except Exception:
+                self.close(drain=False)   # no thread leak per failed
+                raise                     # constructor
+
+    # ----------------------------------------------------- slot state --
+    def _zero_row(self, name):
+        feat, dtype = self._slot_var_meta[name]
+        return np.zeros(feat, dtype=dtype)
+
+    def _reset_slot_state(self):
+        """All slots to zeros — startup and post-warmup (a warmup step
+        mutates carried state; serving must start from the same zeros a
+        fresh solo engine starts from)."""
+        for n, (feat, dtype) in self._slot_var_meta.items():
+            self._scope.set(n, np.zeros((self.max_slots,) + feat,
+                                        dtype=dtype))
+
+    def _admit(self, slot, feeds):
+        """DecodeBatcher admit callback: overwrite row `slot` of EVERY
+        slot var — the stream's init rows where provided, zeros
+        otherwise. One donated jitted row-write per admit; rows of other
+        slots flow through bit-untouched (the slot-reuse half of the
+        invariant)."""
+        feeds = feeds or {}
+        names = list(self.slot_vars)
+        with self._run_lock:
+            vals = tuple(self._scope.get(n) for n in names)
+            rows = tuple(feeds[n] if n in feeds else self._zero_row(n)
+                         for n in names)
+            new_vals = self._update_rows(vals, np.int32(slot), rows)
+            for n, v in zip(names, new_vals):
+                self._scope.set(n, v)
+
+    def _step(self):
+        """DecodeBatcher step callback: ONE fixed-shape decode
+        iteration through the ordinary Executor path (donated rw state,
+        AOT cache, dispatch guards all compose). Returns host copies of
+        the token/finished fetches — the per-iteration host sync is
+        inherent to decode scheduling (the loop must see `finished` to
+        admit/retire) — plus the lazy handles for window tracking."""
+        with self._run_lock:
+            handles = self._exe.run(self.program, feed={},
+                                    fetch_list=self.fetch_names,
+                                    scope=self._scope,
+                                    return_numpy=False, validate=False)
+        tokens = np.asarray(handles[0].array)
+        finished = np.asarray(handles[1].array).reshape(-1).astype(bool)
+        return tokens, finished, handles
+
+    def warmup(self):
+        """Compile the step (one run) and reset slot state to zeros, so
+        the first admitted stream never pays the trace/compile."""
+        from ..core.dispatch import run_compile_probe
+        with self._run_lock:
+            _, compiled = run_compile_probe(
+                self._exe._cache,
+                lambda: self._exe.run(self.program, feed={},
+                                      fetch_list=self.fetch_names,
+                                      scope=self._scope,
+                                      return_numpy=False,
+                                      validate=False))
+        self._reset_slot_state()
+        return int(bool(compiled))
+
+    # ---------------------------------------------------------- public --
+    def normalize_stream_feed(self, feeds):
+        """Validate one stream's init rows: {slot var: row} with row
+        shape == the var's per-slot shape (dtype cast here). Unknown
+        names and shape mismatches are client faults (400s)."""
+        feeds = dict(feeds or {})
+        out = {}
+        for n, value in feeds.items():
+            if n not in self._slot_var_meta:
+                raise InvalidRequestError(
+                    "unknown slot var %r (decode slot state: %r)"
+                    % (n, self.slot_vars))
+            feat, dtype = self._slot_var_meta[n]
+            row = np.asarray(value).astype(dtype, copy=False)
+            if tuple(row.shape) != feat:
+                raise InvalidRequestError(
+                    "init row for %r has shape %r but the slot carries "
+                    "%r per stream" % (n, tuple(row.shape), feat))
+            out[n] = row
+        return out
+
+    def submit(self, feeds=None, max_new_tokens=None, deadline_ms=None):
+        """Admit one sequence for continuous-batched decode; returns its
+        DecodeStream (tokens arrive incrementally). `feeds` are per-slot
+        init rows for a subset of `slot_vars` (e.g. the start token and
+        an encoder context vector); everything else resets to zeros."""
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        return self._batcher.submit(self.normalize_stream_feed(feeds),
+                                    max_new_tokens=max_new_tokens,
+                                    deadline_ms=deadline_ms)
+
+    def decode(self, feeds=None, max_new_tokens=None, deadline_ms=None,
+               timeout=120.0):
+        """Synchronous convenience: submit + wait; returns the stacked
+        token array."""
+        return self.submit(feeds, max_new_tokens=max_new_tokens,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    def solo_clone(self, name=None, warmup=True):
+        """A fresh engine over the SAME program and weights — the
+        bit-exactness reference: decode one stream at a time on the
+        clone and compare against the continuously-batched original.
+        Read-only persistables (the weights — never donated) are shared
+        by reference; writable non-slot state is copied (two engines
+        must not donate one buffer); slot state starts from zeros, as
+        always."""
+        clone = DecodeEngine(
+            program=self.program, token_var=self.token_name,
+            finished_var=self.finished_name,
+            slot_vars=list(self.slot_vars), max_slots=self.max_slots,
+            place=self._exe.place, name=name or (self.name + "-solo"),
+            validate=False, warmup=False,
+            default_max_new_tokens=self._batcher.default_max_new_tokens)
+        for n in self._state_ro:
+            if n not in self._slot_var_meta:
+                v = self._scope.get(n)
+                if v is not None:
+                    clone._scope.set(n, v)
+        for n in set(self._state_rw) | set(self._state_out):
+            if n not in self._slot_var_meta:
+                v = self._scope.get(n)
+                if v is not None:
+                    clone._scope.set(n, np.array(np.asarray(v)))
+        if warmup:
+            try:
+                clone.warmup()
+            except Exception:
+                clone.close(drain=False)
+                raise
+        return clone
+
+    def decode_stats(self):
+        return self._batcher.decode_stats()
+
+    def queue_depth(self):
+        return self._batcher.queue_depth()
+
+    def device_span(self):
+        return [str(self._exe.place.device())]
+
+    def describe(self):
+        """The /v1/models entry for this engine."""
+        return {
+            "name": self.name,
+            "mode": "decode",
+            "devices": self.device_span(),
+            "slot_vars": [
+                {"name": n, "row_shape": list(feat), "dtype": dtype}
+                for n, (feat, dtype) in sorted(
+                    self._slot_var_meta.items())],
+            "token_var": self.token_name,
+            "finished_var": self.finished_name,
+            "max_slots": self.max_slots,
+            "default_max_new_tokens":
+                self._batcher.default_max_new_tokens,
+            "status": "closed" if self.closed else "serving",
+            "metrics": self.decode_stats(),
+        }
+
+    def drain(self, timeout=None):
+        return self._batcher.drain(timeout)
+
+    def close(self, drain=True, timeout=None):
+        """Stop intake; drain=True retires every pending and resident
+        stream first, drain=False fails them typed (no hang)."""
         self.closed = True
         self._batcher.close(drain=drain, timeout=timeout)

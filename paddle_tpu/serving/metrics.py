@@ -15,7 +15,7 @@ import collections
 import threading
 import time
 
-__all__ = ["ServingMetrics"]
+__all__ = ["ServingMetrics", "DecodeMetrics"]
 
 
 def _percentile(sorted_vals, q):
@@ -126,6 +126,96 @@ class ServingMetrics(object):
         — concatenating per-model expositions would repeat each family's
         HELP/TYPE header, which Prometheus rejects as a whole scrape."""
         return render_prometheus_all({model: self})
+
+
+class DecodeMetrics(object):
+    """Counters for one decode step-loop (serving.DecodeEngine).
+
+    The unit of work is the ITERATION (one fixed-shape step over all
+    slots), not the request: occupancy is slots-carrying-streams per
+    iteration (the continuous-batching win — admits refill slots
+    mid-flight, so mean occupancy > 1 under concurrent load), the
+    latency window holds inter-token gaps (wall time between a stream's
+    consecutive tokens — the latency a generative client feels), and
+    tokens/s is measured over a recent bounded window so the gauge
+    tracks current load, not lifetime average.  Readers: the
+    observability-registry decoder collector (`/metrics`),
+    `pool_state()`, and bench.py."""
+
+    def __init__(self, latency_window=4096):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.streams_admitted = 0      # admitted into a slot
+        self.streams_completed = 0     # retired after finishing
+        self.streams_failed = 0        # retired with an error/deadline
+        self.rejected_queue_full = 0   # pending-queue backpressure
+        self.deadline_expired = 0      # per-stream deadline retires
+        self.tokens_total = 0          # tokens delivered to streams
+        self.iterations_total = 0      # step-loop dispatches
+        self.occupied_rows_total = 0   # sum of occupied slots per iter
+        self._inter_token = collections.deque(maxlen=latency_window)
+        self._rate = collections.deque(maxlen=latency_window)  # (t, n)
+
+    def on_admit(self, n=1):
+        with self._lock:
+            self.streams_admitted += n
+
+    def on_queue_full(self):
+        with self._lock:
+            self.rejected_queue_full += 1
+
+    def on_deadline_expired(self, n=1):
+        with self._lock:
+            self.deadline_expired += n
+            self.streams_failed += n
+
+    def on_stream_failed(self, n=1):
+        with self._lock:
+            self.streams_failed += n
+
+    def on_stream_completed(self, n=1):
+        with self._lock:
+            self.streams_completed += n
+
+    def on_iteration(self, occupied, tokens, inter_token_gaps_s=()):
+        """One decode step delivered: `occupied` slots carried live
+        streams, `tokens` tokens went out, `inter_token_gaps_s` are the
+        per-stream gaps since each stream's previous token."""
+        with self._lock:
+            self.iterations_total += 1
+            self.occupied_rows_total += occupied
+            self.tokens_total += tokens
+            self._inter_token.extend(inter_token_gaps_s)
+            self._rate.append((time.monotonic(), tokens))
+
+    def snapshot(self):
+        with self._lock:
+            gaps = sorted(self._inter_token)
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            if len(self._rate) >= 2:
+                span = max(self._rate[-1][0] - self._rate[0][0], 1e-9)
+                recent = sum(n for _, n in self._rate) / span
+            else:
+                recent = self.tokens_total / elapsed
+            iters = max(self.iterations_total, 1)
+            return {
+                "uptime_s": round(elapsed, 3),
+                "streams_admitted": self.streams_admitted,
+                "streams_completed": self.streams_completed,
+                "streams_failed": self.streams_failed,
+                "rejected_queue_full": self.rejected_queue_full,
+                "deadline_expired": self.deadline_expired,
+                "tokens_total": self.tokens_total,
+                "iterations": self.iterations_total,
+                "tokens_per_s": round(recent, 3),
+                "mean_slot_occupancy":
+                    round(self.occupied_rows_total / iters, 3),
+                "inter_token_p50_ms":
+                    round(_percentile(gaps, 0.50) * 1e3, 3),
+                "inter_token_p99_ms":
+                    round(_percentile(gaps, 0.99) * 1e3, 3),
+                "inter_token_window": len(gaps),
+            }
 
 
 # (family, type, help, snapshot key) — one HELP/TYPE per family in the

@@ -61,7 +61,7 @@ from .batcher import (DeadlineExceededError, QueueFullError,
 from .engine import InferenceEngine, InvalidRequestError
 
 __all__ = ["ReplicaPool", "PoolFuture", "PoolResult", "PoolMetrics",
-           "AttemptTimeoutError", "PoisonedOutputError",
+           "AttemptTimeoutError", "PoisonedOutputError", "DecodePool",
            "HEALTHY", "DEGRADED", "EJECTED"]
 
 HEALTHY, DEGRADED, EJECTED = "healthy", "degraded", "ejected"
@@ -1361,3 +1361,141 @@ class ReplicaPool(object):
             rep_drain = drain and rep.state != EJECTED
             rep.engine.close(drain=rep_drain,
                              timeout=timeout if rep_drain else 1.0)
+
+
+class DecodePool(object):
+    """N DecodeEngine replicas behind one ``submit()`` surface.
+
+    Continuous-batched decode (ARCHITECTURE.md §27) shifts what
+    "least-loaded" means: an engine's capacity is its FREE SLOTS, not
+    its queue depth — a replica with 6 of 8 slots open can absorb six
+    new streams at the very next iteration boundary, while a full one
+    parks them in its pending queue.  Routing therefore picks the
+    replica with the most free slots (free = max_slots - occupied -
+    already-pending streams, floored at the pending backlog penalty),
+    breaking ties by fewest pending.  Because every replica compiles
+    the SAME fixed-[max_slots] step and per-stream results depend only
+    on that stream's row (the bucket-lattice invariant, §27), routing
+    is invisible in the tokens: any replica decodes any stream
+    bit-identically.
+
+    Deliberately thinner than :class:`ReplicaPool`: a decode stream is
+    STATEFUL (its KV rows live in one replica's scope), so there is no
+    mid-stream failover, hedging, or retry — a replica failure fails
+    its resident streams typed and the caller resubmits.  What it does
+    share: ``pool_state()`` for /healthz (per-replica
+    ``decode_stats()``), drain/close semantics, and the observability
+    registry gauges each engine already exports.
+    """
+
+    def __init__(self, engines, name="decode-pool"):
+        if not engines:
+            raise ValueError("DecodePool needs at least one DecodeEngine")
+        self.name = name
+        self._engines = list(engines)
+        self._route_lock = threading.Lock()
+        self._rr = 0  # tiebreak rotation so equal replicas share load
+        self.closed = False
+
+    # ---------------------------------------------------- routing --
+    def _free_slots(self, eng):
+        st = eng.decode_stats()
+        return (st.get("slots", 0) - st.get("occupied_slots", 0)
+                - st.get("pending_streams", 0))
+
+    def _pick(self):
+        with self._route_lock:
+            engines = list(self._engines)
+            n = len(engines)
+            order = [engines[(self._rr + i) % n] for i in range(n)]
+            self._rr = (self._rr + 1) % n
+        best, best_key = None, None
+        for eng in order:
+            try:
+                st = eng.decode_stats()
+            except Exception:
+                continue
+            key = (st.get("slots", 0) - st.get("occupied_slots", 0)
+                   - st.get("pending_streams", 0),
+                   -st.get("pending_streams", 0))
+            if best_key is None or key > best_key:
+                best, best_key = eng, key
+        if best is None:
+            raise ServingClosedError("no live decode replicas")
+        return best
+
+    def submit(self, feeds=None, max_new_tokens=None, deadline_ms=None):
+        if self.closed:
+            raise ServingClosedError("decode pool %r is closed" % self.name)
+        return self._pick().submit(feeds=feeds, max_new_tokens=max_new_tokens,
+                                   deadline_ms=deadline_ms)
+
+    def decode(self, feeds=None, max_new_tokens=None, deadline_ms=None,
+               timeout=None):
+        return self.submit(feeds=feeds, max_new_tokens=max_new_tokens,
+                           deadline_ms=deadline_ms).result(timeout=timeout)
+
+    # ------------------------------------------------ introspection --
+    @property
+    def replicas(self):
+        return list(self._engines)
+
+    def queue_depth(self):
+        return sum(e.queue_depth() for e in self._engines)
+
+    def decode_stats(self):
+        """Aggregate decode stats (sums over replicas; rates summed)."""
+        total = {"replicas": len(self._engines), "slots": 0,
+                 "occupied_slots": 0, "active_streams": 0,
+                 "pending_streams": 0, "tokens_total": 0,
+                 "streams_completed": 0, "tokens_per_s": 0.0}
+        for eng in self._engines:
+            st = eng.decode_stats()
+            for k in ("slots", "occupied_slots", "active_streams",
+                      "pending_streams", "tokens_total",
+                      "streams_completed"):
+                total[k] += st.get(k, 0)
+            total["tokens_per_s"] += st.get("tokens_per_s", 0.0)
+        total["tokens_per_s"] = round(total["tokens_per_s"], 3)
+        return total
+
+    def pool_state(self):
+        """The /healthz payload: per-replica decode stats + aggregate."""
+        reps = []
+        for i, eng in enumerate(self._engines):
+            st = eng.decode_stats()
+            reps.append({"replica": i, "name": eng.name,
+                         "slots": st.get("slots", 0),
+                         "occupied_slots": st.get("occupied_slots", 0),
+                         "active_streams": st.get("active_streams", 0),
+                         "pending_streams": st.get("pending_streams", 0),
+                         "tokens_total": st.get("tokens_total", 0),
+                         "tokens_per_s": st.get("tokens_per_s", 0.0),
+                         "inter_token_p50_ms":
+                             st.get("inter_token_p50_ms", 0.0),
+                         "inter_token_p99_ms":
+                             st.get("inter_token_p99_ms", 0.0),
+                         "devices": eng.device_span()})
+        agg = self.decode_stats()
+        agg["mode"] = "decode"
+        agg["replicas"] = reps
+        return agg
+
+    def describe(self):
+        base = self._engines[0].describe()
+        base["name"] = self.name
+        base["status"] = "closed" if self.closed else "serving"
+        base["pool"] = self.pool_state()
+        return base
+
+    # ----------------------------------------------------- lifecycle --
+    def drain(self, timeout=None):
+        ok = True
+        for eng in self._engines:
+            ok = eng.drain(timeout=timeout) and ok
+        return ok
+
+    def close(self, drain=True, timeout=None):
+        self.closed = True
+        for eng in self._engines:
+            eng.close(drain=drain, timeout=timeout)

@@ -7,6 +7,11 @@ device work) serving a small JSON protocol:
     GET  /v1/models                      model list + live metrics
     POST /v1/models/<name>:predict       {"inputs": {...},
                                           "deadline_ms": optional}
+    POST /v1/models/<name>:decode        {"inputs": {...},
+                                          "max_new_tokens": optional,
+                                          "deadline_ms": optional}
+                                         -> NDJSON chunked stream, one
+                                         line per decoded token
     GET  /healthz                        200 while serving, 503 after close
     GET  /metrics                        Prometheus text exposition
 
@@ -167,7 +172,9 @@ class _Handler(BaseHTTPRequestHandler):
                 if e.closed:
                     return False
                 s = pool_states.get(name)
-                if s is not None:
+                if s is not None and "healthy" in s:
+                    # decode pools (mode=decode) carry no health machine;
+                    # they serve while open
                     return (s["healthy"] + s["degraded"]) > 0
                 return True
 
@@ -188,6 +195,11 @@ class _Handler(BaseHTTPRequestHandler):
             from ..observability.registry import REGISTRY
             plain, pools = {}, {}
             for name, e in self.registry.items():
+                if hasattr(e, "decode_stats"):
+                    # decode engines/pools publish through the runtime
+                    # REGISTRY collector (ptpu_decode_* families) — their
+                    # DecodeMetrics snapshot is not ServingMetrics-shaped
+                    continue
                 if hasattr(e, "replica_metrics"):
                     pools[name] = e
                 else:
@@ -225,17 +237,31 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._check_body_size(length):
             return
         raw = self.rfile.read(length) if length else b""
-        prefix, suffix = "/v1/models/", ":predict"
-        if not (self.path.startswith(prefix)
-                and self.path.endswith(suffix)):
+        prefix = "/v1/models/"
+        if self.path.startswith(prefix) and self.path.endswith(":predict"):
+            name, action = self.path[len(prefix):-len(":predict")], "predict"
+        elif self.path.startswith(prefix) and self.path.endswith(":decode"):
+            name, action = self.path[len(prefix):-len(":decode")], "decode"
+        else:
             self._error(404, "no route %r" % self.path, code="not_found")
             return
-        name = self.path[len(prefix):-len(suffix)]
         engine = self.registry.get(name)
         if engine is None:
             self._error(404, "no model %r (have: %s)"
                         % (name, sorted(self.registry)),
                         code="unknown_model")
+            return
+        is_decode = hasattr(engine, "decode_stats")
+        if action == "decode":
+            if not is_decode:
+                self._error(400, "model %r is not a decode deploy; use "
+                                 ":predict" % name, code="not_a_decoder")
+                return
+            self._stream_decode(name, engine, raw)
+            return
+        if is_decode:
+            self._error(400, "model %r is a decode deploy; use :decode"
+                        % name, code="decode_only")
             return
         try:  # client phase: decode + normalize + enqueue
             req = json.loads(raw or b"{}")
@@ -274,6 +300,67 @@ class _Handler(BaseHTTPRequestHandler):
                         code="non_finite_output")
             return
         self._reply(200, body)
+
+    def _stream_decode(self, name, engine, raw):
+        """POST :decode — admit one stream into the continuous batcher
+        and stream its tokens back as chunked NDJSON, one JSON line per
+        token as the decode loop delivers it (ARCHITECTURE.md §27).
+        Inter-token latency is the wire-visible contract here: the first
+        line arrives after ONE decode iteration, not after the whole
+        sequence. A mid-stream failure (deadline, hard close) becomes a
+        final {"error": ...} line — the status code already went out
+        with the first chunk, so errors ride the body. A client that
+        disconnects mid-stream stops the writes; the stream itself
+        decodes on to its token budget server-side (no cancel channel)."""
+        try:  # client phase: decode + normalize + enqueue
+            req = json.loads(raw or b"{}")
+            if not isinstance(req, dict):
+                raise InvalidRequestError(
+                    "request body must be a JSON object, got %s"
+                    % type(req).__name__)
+            feed = _decode_inputs(req.get("inputs", {}))
+            deadline_ms = req.get("deadline_ms")
+            stream = engine.submit(feeds=feed,
+                                   max_new_tokens=req.get("max_new_tokens"),
+                                   deadline_ms=deadline_ms)
+        except Exception as e:  # noqa: BLE001 — mapped to a status code
+            self._error(_status_for(e, client_phase=True), e)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def _chunk(obj):
+            data = (json.dumps(obj) + "\n").encode("utf-8")
+            self.wfile.write(("%x\r\n" % len(data)).encode("ascii"))
+            self.wfile.write(data)
+            self.wfile.write(b"\r\n")
+            self.wfile.flush()
+
+        wait = _DEFAULT_RESULT_TIMEOUT_S
+        if deadline_ms is not None:
+            wait = min(wait, float(deadline_ms) / 1e3 + 5.0)
+        n = 0
+        try:
+            try:
+                while True:
+                    tok = stream.next_token(timeout=wait)
+                    if tok is None:
+                        break
+                    _chunk({"index": n,
+                            "token": np.asarray(tok).reshape(-1).tolist()})
+                    n += 1
+            except Exception as e:  # noqa: BLE001 — typed error line
+                _chunk({"error": str(e), "code": type(e).__name__,
+                        "status": _status_for(e), "tokens": n})
+                self.close_connection = True
+            else:
+                _chunk({"done": True, "model": name, "tokens": n,
+                        "stream_id": stream.stream_id})
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
 
 
 class ModelServer(object):

@@ -549,6 +549,41 @@ def test_bench_transformer_decode_smoke():
     assert rec["value"] > 0
 
 
+def test_bench_decode_smoke():
+    """The BENCH_DECODE continuous-batching leg (no BENCH_MODEL): one
+    subprocess run on CPU at tiny dims through the real DecodeEngine.
+    The acceptance gates ride here: divergence_vs_solo must be exactly
+    0.0 (the leg itself hard-fails otherwise — bit-exactness per stream
+    is the contract, not a tolerance) and mean slot occupancy > 1 (the
+    open loop must actually SHARE iterations across streams; occupancy
+    pinned at 1 means admits only ever landed in an empty batch and
+    continuous batching never engaged)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_DECODE": "1",
+        "BENCH_DECODE_STREAMS": "16", "BENCH_DECODE_SLOTS": "4",
+        "BENCH_DECODE_TOKENS": "8", "BENCH_DECODE_HIDDEN": "32",
+        "BENCH_DECODE_VOCAB": "64", "BENCH_DECODE_LAYERS": "2",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "decode_continuous_tokens_per_sec"
+    assert rec["unit"] == "tokens/sec/chip"
+    assert rec["vs_baseline"] is None
+    assert rec["divergence_vs_solo"] == 0.0
+    assert rec["mean_slot_occupancy"] > 1.0
+    assert rec["value"] > 0 and rec["serial_tokens_per_s"] > 0
+    assert rec["iterations"] > 0 and rec["tokens"] > 0
+    for k in ("inter_token_p50_ms", "inter_token_p99_ms"):
+        assert rec[k] >= 0
+
+
 def test_bench_obs_smoke():
     """The BENCH_OBS leg: the always-on flight recorder's overhead gate
     (ARCHITECTURE.md §24). Recorder on vs off, interleaved rounds with

@@ -104,6 +104,62 @@ def test_flash_min_seq_resolution(monkeypatch, tmp_path):
     assert kc.flash_min_seq() == 64        # explicit env pin wins
 
 
+def test_flash_at_decode_shape_is_structurally_dense(monkeypatch):
+    """q_len <= 1 (the decode-serving shape) takes the dense path by
+    construction — not even FLAGS_flash_min_seq=0 ("flash always")
+    forces the kernel there, because no valid flash q-tiling exists for
+    a one-row query block."""
+    monkeypatch.setenv("FLAGS_flash_min_seq", "0")
+    monkeypatch.delenv("PADDLE_TPU_PALLAS", raising=False)
+    assert kc.flash_at(1) is False
+    assert kc.flash_at(0) is False
+    # above the decode shape, min_seq=0 still means flash always
+    assert kc.flash_at(2) is True
+    assert kc.flash_at(4096) is True
+    # explicit opt-out beats length at any shape
+    monkeypatch.setenv("PADDLE_TPU_PALLAS", "xent,ln")
+    assert kc.flash_at(4096) is False
+    # crossover behavior preserved above the structural rule
+    monkeypatch.delenv("PADDLE_TPU_PALLAS", raising=False)
+    monkeypatch.setenv("FLAGS_flash_min_seq", "256")
+    assert kc.flash_at(128) is False
+    assert kc.flash_at(256) is True
+    # symbolic (None) keeps the historical not-decode default: flash
+    assert kc.flash_at(None) is True
+
+
+def test_fused_attention_decode_shape_never_calls_flash(monkeypatch):
+    """End-to-end: a q_len=1 fused_attention never reaches the pallas
+    kernel even under the flash-always pin, and matches the dense
+    reference (same math; jit-vs-eager only differs at ulp level)."""
+    monkeypatch.setenv("FLAGS_flash_min_seq", "0")
+    monkeypatch.delenv("PADDLE_TPU_PALLAS", raising=False)
+    called = []
+    real = pk.flash_attention
+    monkeypatch.setattr(pk, "flash_attention",
+                        lambda *a, **k: called.append(1) or real(*a, **k))
+    rng = np.random.RandomState(7)
+    qn = (rng.randn(2, 1, 2, 8) * 0.5).astype("float32")
+    kn = (rng.randn(2, 16, 2, 8) * 0.5).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        q = fluid.layers.data(name="q", shape=[1, 2, 8], dtype="float32")
+        k = fluid.layers.data(name="k", shape=[16, 2, 8],
+                              dtype="float32")
+        out = fluid.layers.fused_attention(q, k, k)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        called.clear()
+        got, = exe.run(main, feed={"q": qn, "k": kn}, fetch_list=[out])
+    assert not called
+    from paddle_tpu.parallel.ring_attention import attention_reference
+    np.testing.assert_allclose(
+        np.asarray(got),
+        np.asarray(attention_reference(qn, kn, kn).astype("float32")),
+        rtol=2e-6, atol=2e-7)
+
+
 # ---------------------------------------------------------------------------
 # the re-key invariant
 # ---------------------------------------------------------------------------

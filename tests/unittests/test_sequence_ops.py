@@ -159,3 +159,33 @@ def _make_feeder():
         label = fluid.layers.data(name="label", shape=[1], dtype="int64")
         return fluid.DataFeeder(feed_list=[words, label],
                                 place=fluid.CPUPlace(), program=main)
+
+
+def test_sequence_cache_write():
+    """TPU-native KV-cache write: Out[b, pos[b]] = x[b], all other cells
+    bit-identical to the input cache, and row b independent of row a —
+    the property serving.DecodeEngine's slot reuse leans on (§27)."""
+    B, T, D = 3, 5, 4
+    cache_in = rng.randn(B, T, D).astype("float32")
+    x_in = rng.randn(B, D).astype("float32")
+    pos_in = np.array([[0], [4], [2]], dtype="int64")
+
+    def build():
+        cache = fluid.layers.data(name="cache", shape=[T, D],
+                                  dtype="float32")
+        x = fluid.layers.data(name="xrow", shape=[D], dtype="float32")
+        pos = fluid.layers.data(name="pos", shape=[1], dtype="int64")
+        return fluid.layers.sequence_cache_write(cache, x, pos)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        out = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={"cache": cache_in, "xrow": x_in,
+                                   "pos": pos_in}, fetch_list=[out])
+    want = cache_in.copy()
+    for b in range(B):
+        want[b, pos_in[b, 0]] = x_in[b]
+    np.testing.assert_array_equal(got, want)

@@ -144,6 +144,15 @@ if [ "$WEDGED" = 0 ]; then
   bank
 fi
 probe && run 1800 BENCH_KERNELS=1
+# --- tier 2f: continuous-batched decode (PR 16, ARCHITECTURE.md §27) —
+# open-loop streams admitted/retired at iteration boundaries vs the same
+# streams decoded one at a time. Headline = continuous tokens/sec; the
+# line also carries speedup_vs_serial, mean_slot_occupancy and
+# divergence_vs_solo (the leg HARD-FAILS on any nonzero divergence, so a
+# banked line is a banked bit-exactness proof). CPU reference
+# (2026-08-06, tiny dims): ~2x vs serial at occupancy ~1.5, divergence 0.
+probe && run 1200 BENCH_DECODE=1 BENCH_DECODE_STREAMS=64 BENCH_DECODE_SLOTS=8
+probe && run 1200 BENCH_DECODE=1 BENCH_DECODE_STREAMS=96 BENCH_DECODE_SLOTS=16 BENCH_DECODE_TOKENS=48
 # --- tier 3: big compile LAST — one unrolled TPU line (K copies of the step)
 probe && run 2400 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_MULTISTEP=8 FLAGS_multistep_unroll=1
 bank

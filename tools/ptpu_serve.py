@@ -30,6 +30,16 @@ exits nonzero on any mismatch — wire it before flipping traffic. With
 the first wave of requests is in flight and submits a second wave after:
 any client-visible error fails the deploy — the failover invariant
 (traffic redistributes with zero dropped requests) as a gate.
+
+Generative decode deploys (`--decode`): serve a state-carrying decode-
+step export through iteration-level continuous batching
+(serving.DecodeEngine, ARCHITECTURE.md §27) — `--max-slots` concurrent
+streams per replica, `--max-new-tokens` default token budget,
+`--stream-deadline-ms` per-stream deadline; POST :decode streams NDJSON.
+`--decode --selfcheck N` fires N concurrent streams with mixed token
+budgets through the REAL continuous batcher and compares every stream
+token-for-token against a solo decode of the same feed (a clone sharing
+the weights) — bit-exactness under slot reuse as the deploy gate.
 """
 import argparse
 import json
@@ -202,6 +212,78 @@ def selfcheck(engine, n_requests, rows_max=4, seed=0, kill_replica=None,
     return mismatches
 
 
+def decode_selfcheck(engine, n_streams, seed=0, max_new_tokens=16,
+                     rows_from=None):
+    """The --decode deploy gate: N concurrent streams with mixed token
+    budgets through the real continuous batcher (admits/retires under
+    slot reuse), each compared token-for-token against a solo decode of
+    the same feed through a clone sharing the weights. Returns the
+    number of mismatched streams (submit/stream failures count)."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    solo_src = rows_from or (engine.replicas[0]
+                             if hasattr(engine, "replicas") else engine)
+    specs = solo_src.describe()["slot_vars"]
+    feeds, budgets = [], []
+    for i in range(n_streams):
+        f = {}
+        for spec in specs:
+            shape, dtype = spec["row_shape"], spec["dtype"] or "float32"
+            if "bool" in dtype:
+                f[spec["name"]] = rng.randint(0, 2, shape).astype(dtype)
+            elif "int" in dtype:
+                f[spec["name"]] = rng.randint(0, 4, shape).astype(dtype)
+            else:
+                f[spec["name"]] = rng.randn(*shape).astype(dtype)
+        feeds.append(f)
+        budgets.append(int(rng.randint(max(2, max_new_tokens // 2),
+                                       max_new_tokens + 1)))
+
+    streams = [None] * n_streams
+
+    def fire(i):
+        try:
+            streams[i] = engine.submit(feeds[i],
+                                       max_new_tokens=budgets[i])
+        except Exception as e:  # noqa: BLE001 — a gate must report,
+            streams[i] = e      # not die with a thread traceback
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(n_streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    mismatches, got = 0, {}
+    for i, s in enumerate(streams):
+        if not hasattr(s, "result"):
+            mismatches += 1
+            print("decode selfcheck FAILED SUBMIT: stream %d: %r"
+                  % (i, s), file=sys.stderr)
+            continue
+        try:
+            got[i] = np.asarray(s.result(300)).reshape(-1)
+        except Exception as e:  # noqa: BLE001
+            mismatches += 1
+            print("decode selfcheck FAILED STREAM: %d: %r" % (i, e),
+                  file=sys.stderr)
+
+    solo = solo_src.solo_clone(name="selfcheck-solo")
+    try:
+        for i, toks in sorted(got.items()):
+            want = np.asarray(solo.decode(
+                feeds[i], max_new_tokens=budgets[i])).reshape(-1)
+            if toks.shape != want.shape or not np.array_equal(toks, want):
+                mismatches += 1
+                print("decode selfcheck MISMATCH: stream %d: batched %s "
+                      "vs solo %s" % (i, toks.tolist(), want.tolist()),
+                      file=sys.stderr)
+    finally:
+        solo.close()
+    return mismatches
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="ptpu_serve",
@@ -290,8 +372,29 @@ def main(argv=None):
                          "in-graph dequantize (fp32 master files "
                          "untouched). --selfcheck additionally gates "
                          "max divergence vs a local fp32 engine")
+    ap.add_argument("--decode", action="store_true",
+                    help="serve a state-carrying decode-step export with "
+                         "iteration-level continuous batching (one batch "
+                         "row slot per stream, admits/retires between "
+                         "decode iterations; POST :decode streams "
+                         "NDJSON). --replicas N builds a DecodePool")
+    ap.add_argument("--max-slots", type=int, default=8, metavar="S",
+                    help="--decode: concurrent streams per replica (the "
+                         "fixed compiled batch dimension)")
+    ap.add_argument("--max-new-tokens", type=int, default=128,
+                    metavar="T",
+                    help="--decode: default per-stream token budget "
+                         "(requests may override per call)")
+    ap.add_argument("--stream-deadline-ms", type=float, default=None,
+                    help="--decode: default per-stream deadline, "
+                         "admission to last token")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
+    if args.decode and (args.autoscale or args.extra_model
+                        or args.weights_dtype or args.tp
+                        or args.kill_replica is not None):
+        ap.error("--decode does not compose with --autoscale/"
+                 "--extra-model/--weights-dtype/--tp/--kill-replica")
     if args.kill_replica is not None and not args.selfcheck:
         ap.error("--kill-replica requires --selfcheck")
     if args.kill_replica is not None and args.replicas < 2:
@@ -354,7 +457,30 @@ def main(argv=None):
         weights_dtype=args.weights_dtype)
     fleet = None
     try:
-        if args.replicas > 1 or autoscale or extra_models:
+        if args.decode:
+            place = (fluid.TPUPlace() if args.place == "tpu"
+                     else fluid.CPUPlace())
+            base = args.name or os.path.basename(
+                os.path.normpath(args.model_dir))
+            dec_kw = dict(
+                model_format=args.format,
+                model_filename=args.model_filename,
+                params_filename=args.params_filename, place=place,
+                max_slots=args.max_slots,
+                queue_capacity=args.queue_capacity,
+                default_max_new_tokens=args.max_new_tokens,
+                default_deadline_ms=args.stream_deadline_ms,
+                warmup=not args.no_warmup)
+            if args.replicas > 1:
+                engine = serving.DecodePool(
+                    [serving.DecodeEngine(args.model_dir,
+                                          name="%s-%d" % (base, i),
+                                          **dec_kw)
+                     for i in range(args.replicas)], name=base)
+            else:
+                engine = serving.DecodeEngine(args.model_dir, name=base,
+                                              **dec_kw)
+        elif args.replicas > 1 or autoscale or extra_models:
             # pool placement: None = TPUPlace(i) round-robin over the
             # visible accelerators; an explicit --place cpu pins all
             # replicas to the host backend
@@ -389,6 +515,33 @@ def main(argv=None):
         print("ptpu_serve: model REJECTED by the static verifier:\n%s"
               % e, file=sys.stderr)
         return 2
+
+    if args.selfcheck and args.decode:
+        bad = decode_selfcheck(engine, args.selfcheck,
+                               max_new_tokens=min(args.max_new_tokens,
+                                                  16))
+        reps = (engine.replicas if hasattr(engine, "replicas")
+                else [engine])
+        snaps = [r.decode_stats() for r in reps]
+        iters = sum(s["iterations"] for s in snaps)
+        record = {
+            "selfcheck": "pass" if bad == 0 else "fail",
+            "mode": "decode", "streams": args.selfcheck,
+            "mismatches": bad,
+            "max_slots": args.max_slots,
+            "iterations": iters,
+            "tokens_total": sum(s["tokens_total"] for s in snaps),
+            # >1 proves streams actually SHARED iterations (continuous
+            # batching engaged), not that they queued up serially
+            "mean_slot_occupancy": round(
+                sum(s["iterations"] * s["mean_slot_occupancy"]
+                    for s in snaps) / max(iters, 1), 3)}
+        if hasattr(engine, "pool_state"):
+            record["replicas"] = args.replicas
+            record["pool"] = engine.pool_state()
+        print(json.dumps(record))
+        engine.close()
+        return 1 if bad else 0
 
     if args.selfcheck:
         reference, bound = None, 0.0
@@ -452,11 +605,18 @@ def main(argv=None):
     server = serving.ModelServer(fleet if fleet is not None else engine,
                                  host=args.host, port=args.port,
                                  verbose=args.verbose)
-    print("ptpu_serve: %r (%s) on http://%s — buckets batch=%s seq=%s%s"
-          % (engine.name, args.format, server.address,
-             engine.batch_buckets, engine.seq_buckets or "-",
-             " + %d extra models" % len(extra_models)
-             if extra_models else ""))
+    if args.decode:
+        print("ptpu_serve: %r (decode, %d slots x %d replicas) on "
+              "http://%s — POST /v1/models/%s:decode streams NDJSON"
+              % (engine.name, args.max_slots, args.replicas,
+                 server.address, engine.name))
+    else:
+        print("ptpu_serve: %r (%s) on http://%s — buckets batch=%s "
+              "seq=%s%s"
+              % (engine.name, args.format, server.address,
+                 engine.batch_buckets, engine.seq_buckets or "-",
+                 " + %d extra models" % len(extra_models)
+                 if extra_models else ""))
 
     def handle_sig(signum, frame):
         # only unblock serve_forever from a side thread here (calling the
