@@ -15,7 +15,7 @@ it is still a graph:
         print(d.format())
     result.raise_if_errors()    # ProgramVerificationError
 
-Pipeline (pass_base.PASS_REGISTRY, registration order):
+Base pipeline (pass_base.PASS_REGISTRY, registration order):
   op-registry       unregistered op types (+ close-name suggestions)
   reader-placement  host-io ops outside the io pre-pass's reach
   carriers          feed/fetch well-formedness, sequence companions
@@ -24,31 +24,54 @@ Pipeline (pass_base.PASS_REGISTRY, registration order):
   shape-infer       declared vs re-inferred shapes/dtypes (first
                     inconsistent op)
 
+Deployment tier (deployment.DEPLOYMENT_PASS_REGISTRY) — runs only when
+a `DeploymentContext` is supplied, checking the program against how it
+will be DEPLOYED rather than against the IR alone:
+  row-independence      batch-dim taint: row-sliced fetches depend only
+                        on their own row (the batching contract), with
+                        per-fetch certificates on the result
+  sharding-consistency  ShardingPlan vs program coherence
+  dtype-flow            @QVAL/@QSCALE pairing, AMP flags, stray fp64
+  decode-invariants     slot write-once/static-shape/aliasing contract
+  donation-safety       scope state read after its in-step update
+
 Entry points: `Executor.run(validate=True)` / FLAGS_validate_program=1
-(errors raise before any reader record is consumed), `tools/pplint.py`
-for saved programs (native desc, pickle, or era-wire protobuf), and the
-op_test harness (every op test validates its program for free). See
-ARCHITECTURE.md §2c for how to add a pass.
+(errors raise before any reader record is consumed), engine load
+(`InferenceEngine`/`DecodeEngine` run the deployment tier under their
+own context before the empirical probes), `ParallelExecutor` plan
+arming, `CheckpointManager` save, `tools/pplint.py` for saved programs
+(native desc, pickle, or era-wire protobuf; `--deploy` picks the
+context), and the op_test harness. See ARCHITECTURE.md §2c.
 """
 from .diagnostics import (AnalysisResult, Diagnostic, ERROR, WARNING,
                           ProgramVerificationError)
 from .pass_base import (AnalysisContext, AnalysisPass, PASS_REGISTRY,
                         default_passes, register_pass)
+from .deployment import (DEPLOYMENT_PASS_REGISTRY, DeploymentContext,
+                         DeploymentPass, PlanView, deployment_passes,
+                         infer_slot_vars, register_deployment_pass)
 from . import structural  # registers op-registry/reader-placement/carriers
 from . import def_use     # registers def-use
 from . import shape_infer  # registers shape-infer
+from . import row_independence      # registers row-independence
+from . import sharding_consistency  # registers sharding-consistency
+from . import dtype_flow            # registers dtype-flow
+from . import decode_invariants     # registers decode-invariants
+from . import donation_safety       # registers donation-safety
 from .structural import check_wire_carriers
 
 __all__ = [
-    "analyze", "validate_or_raise", "Diagnostic", "AnalysisResult",
-    "AnalysisContext", "AnalysisPass", "ProgramVerificationError",
-    "ERROR", "WARNING", "PASS_REGISTRY", "default_passes",
-    "register_pass", "check_wire_carriers",
+    "analyze", "analyze_deployment", "validate_or_raise", "Diagnostic",
+    "AnalysisResult", "AnalysisContext", "AnalysisPass",
+    "ProgramVerificationError", "ERROR", "WARNING", "PASS_REGISTRY",
+    "DEPLOYMENT_PASS_REGISTRY", "DeploymentContext", "DeploymentPass",
+    "PlanView", "default_passes", "deployment_passes", "infer_slot_vars",
+    "register_pass", "register_deployment_pass", "check_wire_carriers",
 ]
 
 
 def analyze(program, feed_names=None, fetch_names=None, steps=1,
-            passes=None):
+            passes=None, deploy=None):
     """Run the analysis pipeline over `program`; returns AnalysisResult.
 
     feed_names: names the caller will feed (None = assume every is_data
@@ -56,19 +79,40 @@ def analyze(program, feed_names=None, fetch_names=None, steps=1,
     precise dead-code/fetchability checks). steps: the Executor steps=K
     setting (K>1 arms the multi-step reader-placement rule). passes:
     explicit pass instances (default: the registered pipeline).
+    deploy: a DeploymentContext — appends the applicable deployment
+    passes after the base/explicit pipeline.
     """
     ctx = AnalysisContext(program, feed_names=feed_names,
-                          fetch_names=fetch_names, steps=steps)
-    for p in (passes if passes is not None else default_passes()):
+                          fetch_names=fetch_names, steps=steps,
+                          deploy=deploy)
+    pipeline = list(passes if passes is not None else default_passes())
+    if deploy is not None:
+        pipeline.extend(deployment_passes(deploy))
+    for p in pipeline:
+        p.run(ctx)
+    return ctx.result
+
+
+def analyze_deployment(program, deploy, feed_names=None, fetch_names=None,
+                       steps=1):
+    """Run ONLY the deployment tier under `deploy` — the engines' load
+    path, where the base pipeline already ran on the pristine program
+    and only the deployment contracts (possibly against a REWRITTEN
+    program: int8, bf16) still need proving."""
+    ctx = AnalysisContext(program, feed_names=feed_names,
+                          fetch_names=fetch_names, steps=steps,
+                          deploy=deploy)
+    for p in deployment_passes(deploy):
         p.run(ctx)
     return ctx.result
 
 
 def validate_or_raise(program, feed_names=None, fetch_names=None, steps=1,
-                      passes=None):
+                      passes=None, deploy=None):
     """analyze() + raise ProgramVerificationError on any error-severity
     finding (strict mode). Returns the AnalysisResult when clean."""
     result = analyze(program, feed_names=feed_names,
-                     fetch_names=fetch_names, steps=steps, passes=passes)
+                     fetch_names=fetch_names, steps=steps, passes=passes,
+                     deploy=deploy)
     result.raise_if_errors()
     return result

@@ -1,0 +1,119 @@
+"""Decode-invariants: the slot-resident step contract, proven on the IR.
+
+DecodeEngine (PR-16) keeps every stream's state in persistable slot
+vars with leading dim max_slots and runs ONE step program for all
+slots; between steps it writes admitted rows in place with a donated
+slot update. That only works if the step program treats slot state the
+way the engine assumes:
+
+  slot-double-write  a slot var written more than once per step: the
+                     engine snapshots state_out ONCE after the step, so
+                     the first write is at best dead and at worst races
+                     the donated update ordering
+  slot-shape         a slot var whose leading dim is not the slot dim
+                     (max_slots or -1) or with non-static feature dims:
+                     the step recompiles per occupancy, or the slot
+                     update indexes garbage
+  slot-fetch-alias   a fetch that IS an updated slot var: the fetched
+                     value aliases a buffer build_slot_update_fn donates,
+                     so the caller's array is invalidated by the next
+                     admit — the engine must fetch step OUTPUTS (token,
+                     finished), never carried state
+
+DecodeEngine enforces some of this dynamically at load; this pass makes
+the same contract checkable for a SAVED step program (pplint --deploy
+decode) and turns the engine's load failures into named diagnostics.
+"""
+from .deployment import DeploymentPass, register_deployment_pass
+
+
+@register_deployment_pass
+class DecodeInvariantsPass(DeploymentPass):
+    name = "decode-invariants"
+
+    @classmethod
+    def applicable(cls, deploy):
+        return deploy.kind == "decode" and bool(deploy.slot_vars)
+
+    def run(self, ctx):
+        deploy = ctx.deploy
+        slot = deploy.slot_vars
+        gb = ctx.program.global_block()
+        writes = {}
+        for block in ctx.program.blocks:
+            for op_idx, op in enumerate(block.ops):
+                for n in op.all_output_vars():
+                    if n in slot:
+                        writes.setdefault(n, []).append(
+                            (block, op_idx, op))
+
+        for name in sorted(slot):
+            self._check_shape(ctx, gb, name, deploy.max_slots)
+            ws = writes.get(name, ())
+            if len(ws) > 1:
+                block, op_idx, op = ws[-1]
+                first = ws[0]
+                ctx.error(
+                    "slot-double-write",
+                    "slot var %r is written %d times in one step (ops %s"
+                    ") — the engine snapshots carried state once per "
+                    "step, so every write but the last is unobservable "
+                    "and the donated slot update's ordering is undefined"
+                    % (name, len(ws),
+                       ", ".join("%d (%s)" % (w[1], w[2].type)
+                                 for w in ws)),
+                    block=block, op_idx=op_idx, op=op, var_names=(name,),
+                    hint="fold the updates into one assign per step "
+                         "(first write at op %d (%s))"
+                         % (first[1], first[2].type))
+
+        written = frozenset(writes)
+        for fetch in ctx.fetch_names:
+            if fetch in written:
+                block, op_idx, op = writes[fetch][-1]
+                ctx.error(
+                    "slot-fetch-alias",
+                    "fetch %r is an updated slot var: its value aliases "
+                    "a buffer the donated slot update invalidates on the "
+                    "next admit — the caller would read freed memory "
+                    "semantics" % fetch,
+                    block=block, op_idx=op_idx, op=op,
+                    var_names=(fetch,),
+                    hint="fetch a step OUTPUT (assign the slot var to a "
+                         "fresh non-persistable fetch var) instead of "
+                         "the carried state itself")
+
+    def _check_shape(self, ctx, gb, name, max_slots):
+        var = ctx.lookup(gb, name)
+        if var is None:
+            ctx.error(
+                "slot-shape",
+                "slot var %r is not declared in the step program" % name,
+                var_names=(name,))
+            return
+        shape = tuple(getattr(var, "shape", ()) or ())
+        bad_lead = (not shape or
+                    (max_slots is not None and
+                     shape[0] not in (-1, max_slots)))
+        if not var.persistable:
+            ctx.error(
+                "slot-shape",
+                "slot var %r is not persistable — it cannot carry state "
+                "across steps, every step would read zeros" % name,
+                var_names=(name,),
+                hint="create it with create_global_var(persistable=True)")
+        if bad_lead:
+            ctx.error(
+                "slot-shape",
+                "slot var %r has shape %r; its leading dim must be the "
+                "slot dim (%r) so every stream owns row i" % (
+                    name, shape, max_slots),
+                var_names=(name,))
+        if any(d < 0 for d in shape[1:]):
+            ctx.error(
+                "slot-shape",
+                "slot var %r has non-static feature dims %r — the step "
+                "would recompile per occupancy and the slot update "
+                "cannot index a stable row" % (name, shape),
+                var_names=(name,),
+                hint="pad feature dims to compile-time constants")

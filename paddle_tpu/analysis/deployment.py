@@ -1,0 +1,199 @@
+"""Deployment-pass tier: context-parameterized verification.
+
+The base pipeline (structural / def-use / shape-infer) checks a Program
+against the IR's own rules. The passes in this tier instead check it
+against a DEPLOYMENT — the serving lattice, the decode slot layout, a
+ShardingPlan, a weights dtype — captured in a `DeploymentContext`. They
+turn contracts that PR-3/9/13/16 could only probe empirically (load-time
+row sweeps, bit-exactness checks) into properties proven on the graph:
+
+  row-independence      every row-sliced fetch depends only on its own
+                        input row (the Batcher's coalescing contract)
+  sharding-consistency  ShardingPlan entries match the program's vars
+                        (existence/shape/dtype, grad coverage, int8
+                        conflicts, silent replication)
+  dtype-flow            @QVAL/@QSCALE pairing + dequantize_channel
+                        placement, AMP-flag consistency, stray fp64
+  decode-invariants     slot vars written exactly once per step, static
+                        slot shapes, fetch/donation aliasing
+  donation-safety       scope state read after its in-step update
+
+A deployment pass subclasses DeploymentPass and self-selects on the
+context (`applicable(deploy)`), so one pipeline serves all four seams:
+InferenceEngine / DecodeEngine load, ParallelExecutor plan arming,
+CheckpointManager save, and `tools/pplint.py --deploy ...`. None of
+these passes run unless a DeploymentContext is supplied — plain
+`analysis.analyze(program)` behavior is unchanged.
+"""
+import collections
+
+from .pass_base import AnalysisPass
+
+DEPLOYMENT_PASS_REGISTRY = collections.OrderedDict()
+
+
+def register_deployment_pass(cls):
+    """Class decorator: add a DeploymentPass to the deployment tier
+    (keyed by `name`, run in registration order after the base tier)."""
+    DEPLOYMENT_PASS_REGISTRY[cls.name] = cls
+    return cls
+
+
+def deployment_passes(deploy):
+    """Fresh instances of every registered deployment pass that declares
+    itself applicable to `deploy`, pipeline order."""
+    return [cls() for cls in DEPLOYMENT_PASS_REGISTRY.values()
+            if cls.applicable(deploy)]
+
+
+class DeploymentPass(AnalysisPass):
+    """Base for context-parameterized passes; `ctx.deploy` is always a
+    DeploymentContext when run() is called."""
+
+    @classmethod
+    def applicable(cls, deploy):  # pragma: no cover - interface default
+        return True
+
+
+class DeploymentContext(object):
+    """How the program will be DEPLOYED — everything the deployment tier
+    checks against that the program desc itself doesn't carry.
+
+    kind           "serving" | "decode" | "training" | "generic"
+    row_fetches    fetch names sliced back per request row (the engine's
+                   "rows" fetch policy) — MIXED taint here is an ERROR
+    whole_fetches  fetches returned whole to every request ("whole" /
+                   "dynamic" policy) — MIXED taint is only a WARNING
+    row_sources    var names that carry per-row data INTO the step; None
+                   means "the feed set" (serving). Decode contexts list
+                   the slot-resident state instead.
+    slot_vars      persistable slot-major state of a decode step
+    max_slots      leading dim of every slot var
+    plan           ShardingPlan (or PlanView) the program runs under
+    weights_dtype  serving weights dtype ("f32" | "bf16" | "int8")
+    amp            expected program AMP flag (None = don't check)
+    steps          Executor steps=K setting
+    """
+
+    __slots__ = ("kind", "row_fetches", "whole_fetches", "row_sources",
+                 "slot_vars", "max_slots", "plan", "weights_dtype", "amp",
+                 "steps")
+
+    def __init__(self, kind="generic", row_fetches=(), whole_fetches=(),
+                 row_sources=None, slot_vars=(), max_slots=None, plan=None,
+                 weights_dtype=None, amp=None, steps=1):
+        self.kind = kind
+        self.row_fetches = tuple(row_fetches)
+        self.whole_fetches = tuple(whole_fetches)
+        self.row_sources = (None if row_sources is None
+                            else frozenset(row_sources))
+        self.slot_vars = frozenset(slot_vars)
+        self.max_slots = max_slots
+        self.plan = plan
+        self.weights_dtype = weights_dtype
+        self.amp = amp
+        self.steps = int(steps)
+
+    # ---- constructors for the four seams -----------------------------
+    @classmethod
+    def for_serving(cls, row_fetches, whole_fetches=(), weights_dtype=None,
+                    plan=None, amp=None):
+        return cls(kind="serving", row_fetches=row_fetches,
+                   whole_fetches=whole_fetches, weights_dtype=weights_dtype,
+                   plan=plan, amp=amp)
+
+    @classmethod
+    def for_decode(cls, slot_vars, max_slots, row_fetches=(),
+                   weights_dtype=None):
+        return cls(kind="decode", row_fetches=row_fetches,
+                   row_sources=slot_vars, slot_vars=slot_vars,
+                   max_slots=max_slots, weights_dtype=weights_dtype)
+
+    @classmethod
+    def for_training(cls, plan=None, amp=None, steps=1):
+        return cls(kind="training", plan=plan, amp=amp, steps=steps)
+
+    @classmethod
+    def generic(cls):
+        return cls(kind="generic")
+
+    def cache_key(self):
+        """Hashable identity for maybe_validate_program's per-program
+        validation cache: same program + same deployment = one analysis."""
+        plan = self.plan
+        plan_key = None
+        if plan is not None:
+            digest = getattr(plan, "digest", None)
+            plan_key = digest() if callable(digest) else id(plan)
+        return (self.kind, self.row_fetches, self.whole_fetches,
+                self.row_sources, tuple(sorted(self.slot_vars)),
+                self.max_slots, plan_key, self.weights_dtype, self.amp,
+                self.steps)
+
+    def __repr__(self):
+        return "DeploymentContext(%s%s%s)" % (
+            self.kind,
+            ", plan" if self.plan is not None else "",
+            ", %s" % self.weights_dtype if self.weights_dtype else "")
+
+
+class PlanView(object):
+    """Device-free stand-in for a ShardingPlan, for linting a saved plan
+    on a machine that cannot build the real mesh (pplint on a 1-CPU box
+    checking an 8-chip plan). Carries exactly what sharding-consistency
+    reads: entries, mesh axis sizes, and the axis roles. Built from the
+    plan's canonical JSON (`ShardingPlan.to_json()`)."""
+
+    def __init__(self, mesh_shape, entries=(), batch_axis=None,
+                 shard_axis=None, tp_axis=None, tp_placement="gather"):
+        self.mesh_shape = dict(mesh_shape)
+        self.batch_axis = batch_axis
+        self.shard_axis = shard_axis
+        self.tp_axis = tp_axis
+        self.tp_placement = tp_placement
+        self.entries = {}
+        for e in entries:
+            self.entries[e.name] = e
+
+    @classmethod
+    def from_json(cls, doc):
+        from ..parallel.plan import VarPlan, _spec_from_json
+        entries = []
+        for name in sorted(doc.get("vars", ())):
+            d = doc["vars"][name]
+            entries.append(VarPlan(
+                name, tuple(_spec_from_json(d["spec"])), d["kind"],
+                owner=d.get("owner"), override=d.get("override", False),
+                reason=d.get("reason", "")))
+        return cls(dict(doc.get("mesh_axes", ())), entries,
+                   batch_axis=doc.get("batch_axis"),
+                   shard_axis=doc.get("shard_axis"),
+                   tp_axis=doc.get("tp_axis"),
+                   tp_placement=doc.get("tp_placement", "gather"))
+
+
+def plan_axis_sizes(plan):
+    """{axis: size} for a ShardingPlan (real mesh) or PlanView (sizes
+    recorded in JSON)."""
+    shape = getattr(plan, "mesh_shape", None)
+    if shape is None:
+        shape = plan.mesh.shape
+    return dict(shape)
+
+
+def infer_slot_vars(program, fetch_names, max_slots):
+    """Slot-resident state of a decode step program, by the same rule
+    DecodeEngine uses at load: persistable vars the step reads or writes
+    whose leading dim is the slot dim (max_slots or -1). Lets pplint
+    build a decode context for a saved step program without an engine."""
+    from ..core.lowering import analyze_state
+    rw, ro, out = analyze_state(program, [], tuple(fetch_names or ()))
+    slot = set()
+    for name in set(rw) | set(ro) | set(out):
+        v = program.global_block().vars.get(name)
+        if v is None or not v.persistable:
+            continue
+        shape = tuple(getattr(v, "shape", ()) or ())
+        if shape and shape[0] in (-1, max_slots):
+            slot.add(name)
+    return slot

@@ -68,10 +68,17 @@ class Diagnostic(object):
 
 
 class AnalysisResult(object):
-    """Ordered collection of diagnostics from one analyzer run."""
+    """Ordered collection of diagnostics from one analyzer run.
+
+    `certificates` is the deployment tier's per-fetch row-independence
+    verdict: {fetch: {"status": "row"|"const"|"mixed", "cause": str}}.
+    Empty unless the row-independence pass ran. "row"/"const" is the
+    proof the Batcher's coalescing relies on; consumers (engine,
+    pplint --json) treat a missing entry as unproven, not safe."""
 
     def __init__(self, diagnostics=None):
         self.diagnostics = list(diagnostics or [])
+        self.certificates = {}
 
     def add(self, diag):
         self.diagnostics.append(diag)

@@ -1,0 +1,74 @@
+"""Donation-safety: scope state read after its in-step update.
+
+The executor donates scope buffers into the step where it can (the
+state_rw fast path, decode's slot update): after the update writes a
+persistable var, the PRE-update buffer is gone. Inside one traced step
+that is fine — dataflow is by value — but an op that reads the var
+AFTER its update observes the NEW value, while the same read placed
+before it observes the OLD one. Programs that mix the two orderings
+around an in-place update are almost always one reorder away from a
+silent semantic change (and are exactly the shape that breaks when a
+fetch aliases a donated buffer), so this pass flags them:
+
+  read-after-update  (WARNING) persistable var read both BEFORE and
+                     AFTER an op updates it in the same step — the two
+                     reads observe different values of one name, the
+                     pre/post ambiguity donation turns into
+                     use-after-free
+
+A var whose every read follows its single write (the lr-decay counter:
+increment, then read everywhere) is unambiguous and NOT flagged — only
+mixed-order reads are. Exempt: the numeric-guard machinery
+(guard_backup/guard_select_all re-read updated params by design — that
+is the rollback contract), gradient accumulation, and reads inside the
+updating op itself (sgd/adam read-modify-write their param in one op).
+"""
+from ..core.framework import GRAD_SUFFIX
+from .deployment import DeploymentPass, register_deployment_pass
+
+_GUARD_OPS = frozenset({"guard_backup", "guard_select_all"})
+
+
+@register_deployment_pass
+class DonationSafetyPass(DeploymentPass):
+    name = "donation-safety"
+
+    def run(self, ctx):
+        gb = ctx.program.global_block()
+        last_write = {}  # persistable name -> (op_idx, op)
+        read_before = set()  # names read before any in-step write
+        reported = set()
+        for op_idx, op in enumerate(gb.ops):
+            if op.type in _GUARD_OPS:
+                continue
+            reads = [n for n in op.all_input_vars() if n]
+            outs = frozenset(n for n in op.all_output_vars() if n)
+            for name in reads:
+                if name in outs or name in reported:
+                    continue  # in-op read-modify-write is one update
+                prev = last_write.get(name)
+                if prev is None:
+                    read_before.add(name)
+                    continue
+                if name not in read_before:
+                    continue  # write-then-read only: unambiguous
+                widx, wop = prev
+                reported.add(name)
+                ctx.warning(
+                    "read-after-update",
+                    "persistable %r is updated by op %d (%s) and read "
+                    "again by op %d (%s) in the same step: the read "
+                    "observes the post-update value, and a donated "
+                    "buffer makes the pre-update value unrecoverable — "
+                    "one reorder (or a fetch of this var) away from a "
+                    "silent semantic change"
+                    % (name, widx, wop.type, op_idx, op.type),
+                    block=gb, op_idx=op_idx, op=op, var_names=(name,),
+                    hint="read the var before its update, or route the "
+                         "updated value through a fresh intermediate")
+            for name in outs:
+                if name.endswith(GRAD_SUFFIX) or op.type == "grad_of":
+                    continue  # accumulation, not an update
+                var = ctx.lookup(gb, name)
+                if var is not None and var.persistable:
+                    last_write[name] = (op_idx, op)

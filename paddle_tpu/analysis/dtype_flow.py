@@ -1,0 +1,196 @@
+"""Dtype-flow: quantization pair well-formedness, AMP flags, stray fp64.
+
+The base shape-infer pass already re-derives every op's output dtype
+forward through the graph and flags declared-vs-inferred conflicts; this
+deployment pass layers the DEPLOYMENT dtype contracts on top:
+
+  quant-pair   the int8 rewrite's structural invariant (PR-13): every
+               X@QVAL has an X@QSCALE twin, both persistable with the
+               storage dtypes ops/quant_ops.DEQUANTIZE_SLOTS pins
+               (int8 values, f32 per-channel scales), the scale length
+               matches the quantized axis, exactly one
+               dequantize_channel consumes the pair, and the base var
+               it reconstitutes is a plain intermediate written by that
+               op alone — so every consumer reads the dequantized value,
+               never a stale fp32 master shadowing it from the scope
+  amp-flag     (WARNING) the deployment says bf16 / AMP but the program
+               was built without enable_mixed_precision — weights get
+               demoted while every intermediate stays f32, the worst of
+               both precisions
+  stray-fp64   (WARNING) a declared float64 var: without jax_enable_x64
+               it silently truncates to f32; with it, it doubles HBM and
+               falls off the fast matmul path on TPU
+"""
+import collections
+
+from ..ops.quant_ops import DEQUANTIZE_SLOTS
+from .deployment import DeploymentPass, register_deployment_pass
+from .shape_infer import _canonical
+
+# mirrors serving.quantize.{QVAL,QSCALE}_SUFFIX — NOT imported, because
+# analysis loads before the serving package in paddle_tpu/__init__ and
+# pulling serving.quantize here would initialize the whole serving stack
+# mid-import; test_deployment_analysis pins the two pairs equal
+QVAL_SUFFIX = "@QVAL"
+QSCALE_SUFFIX = "@QSCALE"
+
+
+@register_deployment_pass
+class DtypeFlowPass(DeploymentPass):
+    name = "dtype-flow"
+
+    def run(self, ctx):
+        self._check_quant_pairs(ctx)
+        self._check_amp(ctx)
+        self._check_fp64(ctx)
+
+    # ---- @QVAL/@QSCALE structure -------------------------------------
+    def _check_quant_pairs(self, ctx):
+        gb = ctx.program.global_block()
+        dequants = collections.defaultdict(list)  # qval name -> ops
+        writers = collections.defaultdict(list)   # any name -> writer ops
+        for block in ctx.program.blocks:
+            for op_idx, op in enumerate(block.ops):
+                for n in op.all_output_vars():
+                    if n:
+                        writers[n].append((block, op_idx, op))
+                if op.type == "dequantize_channel":
+                    for n in op.inputs.get("X", ()):
+                        dequants[n].append((block, op_idx, op))
+
+        names = {v.name for v in ctx.program.list_vars()}
+        for qv in sorted(n for n in names if n.endswith(QVAL_SUFFIX)):
+            base = qv[:-len(QVAL_SUFFIX)]
+            self._check_pair(ctx, gb, qv, base, dequants, writers)
+        for qs in sorted(n for n in names if n.endswith(QSCALE_SUFFIX)):
+            base = qs[:-len(QSCALE_SUFFIX)]
+            if base + QVAL_SUFFIX not in names:
+                ctx.error(
+                    "quant-pair",
+                    "scale %r has no %r twin — the dequantize has "
+                    "nothing to widen" % (qs, base + QVAL_SUFFIX),
+                    var_names=(qs,),
+                    hint="re-run the int8 rewrite; a partial rewrite "
+                         "artifact was saved")
+
+    def _check_pair(self, ctx, gb, qv, base, dequants, writers):
+        qs = base + QSCALE_SUFFIX
+        qv_var, qs_var = ctx.lookup(gb, qv), ctx.lookup(gb, qs)
+        if qs_var is None:
+            ctx.error(
+                "quant-pair",
+                "quantized values %r have no %r scales — consumers "
+                "would read raw int8 codes as if they were weights"
+                % (qv, qs),
+                var_names=(qv,),
+                hint="re-run the int8 rewrite; a partial rewrite "
+                     "artifact was saved")
+            return
+        for name, var, slot in ((qv, qv_var, "X"), (qs, qs_var, "Scale")):
+            want = DEQUANTIZE_SLOTS[slot]
+            if var.dtype is not None and \
+                    _canonical(var.dtype) != _canonical(want):
+                ctx.error(
+                    "quant-pair",
+                    "%r is declared %s but the int8 storage contract "
+                    "(dequantize_channel %s slot) is %s"
+                    % (name, var.dtype, slot, want), var_names=(name,))
+            if not var.persistable:
+                ctx.error(
+                    "quant-pair",
+                    "%r must be persistable — the quantized storage IS "
+                    "the scope state int8 serving exists for" % name,
+                    var_names=(name,))
+        users = dequants.get(qv, ())
+        if not users:
+            ctx.error(
+                "quant-pair",
+                "no dequantize_channel consumes %r: the quantized "
+                "weight is dead and consumers of %r read something else "
+                "entirely" % (qv, base), var_names=(qv, base),
+                hint="the rewrite inserts dequantize_channel(X=%s, "
+                     "Scale=%s) -> %s in front of the first consumer"
+                     % (qv, qs, base))
+            return
+        block, op_idx, op = users[0]
+        if len(users) > 1:
+            ctx.warning(
+                "quant-pair",
+                "%d dequantize_channel ops consume %r — one widen fused "
+                "into the consumer is the contract; extras waste HBM "
+                "bandwidth" % (len(users), qv),
+                block=block, op_idx=op_idx, op=op, var_names=(qv,))
+        outs = [n for n in op.all_output_vars() if n]
+        scale_shape = tuple(getattr(qs_var, "shape", ()) or ())
+        q_shape = tuple(getattr(qv_var, "shape", ()) or ())
+        axis = op.attrs.get("axis", -1)
+        if q_shape and len(scale_shape) == 1 and scale_shape[0] >= 0:
+            chan = q_shape[axis if axis >= 0 else axis + len(q_shape)]
+            if chan >= 0 and scale_shape[0] != chan:
+                ctx.error(
+                    "quant-pair",
+                    "%r has %d scales but %r has %d channels along the "
+                    "quantized axis %d" % (qs, scale_shape[0], qv, chan,
+                                           axis),
+                    block=block, op_idx=op_idx, op=op,
+                    var_names=(qv, qs))
+        for out in outs:
+            out_var = ctx.lookup(gb, out)
+            if out_var is not None and out_var.persistable:
+                ctx.error(
+                    "quant-pair",
+                    "dequantize_channel writes %r which is still "
+                    "persistable: the scope's fp32 master would shadow "
+                    "(or be clobbered by) the dequantized value "
+                    "depending on donation order" % out,
+                    block=block, op_idx=op_idx, op=op, var_names=(out,),
+                    hint="the rewrite demotes the base param to a plain "
+                         "intermediate; re-run it")
+            extra = [w for w in writers.get(out, ()) if w[2] is not op]
+            if extra:
+                eb, ei, eop = extra[0]
+                ctx.error(
+                    "quant-pair",
+                    "%r is written both by dequantize_channel and by op "
+                    "%d (%s) — consumers race between the dequantized "
+                    "weight and something else" % (out, ei, eop.type),
+                    block=eb, op_idx=ei, op=eop, var_names=(out,))
+
+    # ---- AMP flag vs deployment --------------------------------------
+    def _check_amp(self, ctx):
+        deploy = ctx.deploy
+        program_amp = bool(getattr(ctx.program, "_amp", False))
+        wants_amp = deploy.amp if deploy.amp is not None else (
+            True if deploy.weights_dtype == "bf16" else None)
+        if wants_amp is True and not program_amp:
+            ctx.warning(
+                "amp-flag",
+                "deployment expects bf16/AMP but the program was built "
+                "without enable_mixed_precision: weights demote to bf16 "
+                "while every intermediate stays f32 — the bandwidth win "
+                "without the compute win, plus a cast per weight use",
+                hint="build with "
+                     "fluid.default_main_program()."
+                     "enable_mixed_precision(), or serve f32")
+        elif wants_amp is False and program_amp:
+            ctx.warning(
+                "amp-flag",
+                "the program was built WITH enable_mixed_precision but "
+                "this deployment pins full f32 — intermediates compute "
+                "bf16 against f32 expectations",
+                hint="match the deployment's amp flag to the program")
+
+    # ---- stray fp64 ---------------------------------------------------
+    def _check_fp64(self, ctx):
+        seen = set()
+        for v in ctx.program.list_vars():
+            if v.name in seen or str(v.dtype) not in ("float64", "double"):
+                continue
+            seen.add(v.name)
+            ctx.warning(
+                "stray-fp64",
+                "variable %r is declared float64: without jax_enable_x64 "
+                "it silently truncates to f32, with it it computes at "
+                "1/10th matmul throughput on TPU" % v.name,
+                var_names=(v.name,),
+                hint="declare f32 (or int64 for ids) explicitly")

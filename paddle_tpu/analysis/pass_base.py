@@ -37,11 +37,13 @@ class AnalysisContext(object):
     outputs are injected by the io pre-pass, not listed in `feed`.
     """
 
-    def __init__(self, program, feed_names=None, fetch_names=None, steps=1):
+    def __init__(self, program, feed_names=None, fetch_names=None, steps=1,
+                 deploy=None):
         self.program = program
         self.fetch_names = tuple(
             f if isinstance(f, str) else f.name for f in (fetch_names or ()))
         self.steps = int(steps)
+        self.deploy = deploy  # DeploymentContext; None = base tier only
         self.result = AnalysisResult()
         feeds = set(feed_names or ())
         for v in program.list_vars():
@@ -81,17 +83,24 @@ class AnalysisContext(object):
             b = b.parent_block
         return None
 
+    def state_sets(self):
+        """(state_rw, state_ro, state_out) of lowering.analyze_state —
+        the executor's own classification of the program's scope state,
+        cached per analysis run."""
+        if self._state is None:
+            from ..core.lowering import analyze_state
+            rw, ro, out = analyze_state(
+                self.program, sorted(self.feed_names), self.fetch_names)
+            self._state = (frozenset(rw), frozenset(ro), frozenset(out))
+        return self._state
+
     def state_in(self):
         """Persistable vars the executor's state analysis would READ from
         the Scope (state_rw + state_ro of lowering.analyze_state) — the
         single source of truth for which read-before-write names are
         legitimately scope-provided."""
-        if self._state is None:
-            from ..core.lowering import analyze_state
-            rw, ro, out = analyze_state(
-                self.program, sorted(self.feed_names), self.fetch_names)
-            self._state = (frozenset(rw) | frozenset(ro), frozenset(out))
-        return self._state[0]
+        rw, ro, _ = self.state_sets()
+        return rw | ro
 
     def sub_blocks(self, op):
         """Blocks an op's attrs reference (framework._sub_block_indices)."""

@@ -1,0 +1,304 @@
+"""Row-independence: batch-dim dataflow taint over the Program.
+
+The Batcher's whole contract (PR-3) — and DecodeBatcher's slot variant
+(PR-16) — is that at a fixed compiled shape, row i of every row-sliced
+fetch depends only on row i of the inputs, so requests coalesced into
+one device batch cannot observe each other. Until now that was checked
+empirically (load-time identity probes). This pass proves it on the
+graph with a three-point taint lattice per var name:
+
+    CONST < ROW < MIXED
+
+  CONST  row-constant: params, scope state, fill_constant results —
+         identical for every row, so sharing it across rows is safe
+  ROW    row-aligned: leading dim is the batch/slot dim and row i is a
+         function of row i of the sources only
+  MIXED  cross-row-dependent: some row reflects another request's data
+
+Feeds (or the decode slot vars) start ROW; everything else starts
+CONST. The default transfer is join (max) over an op's inputs — correct
+for every elementwise/rowwise op. A table of explicit rules covers the
+ops that genuinely move data across the batch dim: reductions over dim
+0, train-mode batch_norm, axis-0 concat/split/stack, batch transposes
+and reshapes, cross-row gathers/scatters, and the lod machinery
+(beam search, rank-table reordering) whose whole purpose is cross-row
+traffic. Sub-blocks are walked inline at their owner's position and the
+whole walk iterates to a fixpoint (the lattice is finite and transfers
+monotone, so <=3 sweeps).
+
+Every fetch gets a certificate {status: row|const|mixed, cause} on
+`AnalysisResult.certificates`; a MIXED row-sliced fetch is an ERROR
+naming the mixing op AND the poisoned fetch, a MIXED whole/dynamic
+fetch a WARNING. The engine records the certificate and the Batcher
+consumes it: an uncertified engine (validate=False on a mixing program)
+stops coalescing rows from different requests into one device batch.
+"""
+from ..core.framework import GRAD_SUFFIX
+from ..core.readers import is_host_io_op
+from .deployment import (DeploymentPass, register_deployment_pass)
+
+CONST, ROW, MIXED = 0, 1, 2
+_STATUS = {CONST: "const", ROW: "row", MIXED: "mixed"}
+
+# ops whose entire job is cross-row traffic: any ROW input poisons
+_CROSS_ROW_OPS = frozenset({
+    "beam_search", "beam_search_decode", "lod_rank_table",
+    "reorder_lod_tensor_by_rank", "shrink_rnn_memory",
+    "split_lod_tensor", "merge_lod_tensor", "scatter",
+    "sequence_expand", "sequence_reshape", "im2sequence",
+})
+
+# ops whose output depends only on input SHAPE (fixed per compiled
+# bucket), never on row values
+_SHAPE_ONLY_OPS = frozenset({
+    "shape", "fill_constant_batch_size_like", "fill_zeros_like",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+})
+
+_REDUCE_OPS = frozenset({"reduce_sum", "reduce_mean", "reduce_max",
+                         "reduce_min", "reduce_prod", "reduce_all",
+                         "reduce_any"})
+
+# ops with a single `axis` attr that mixes rows iff it names dim 0
+# (NOT elementwise_*: their `axis` is a broadcast alignment offset)
+_AXIS_OPS = frozenset({"cumsum", "arg_max", "arg_min", "l2_normalize",
+                       "norm", "log_softmax"})
+
+_MATMUL_OPS = frozenset({"mul", "matmul"})
+
+
+@register_deployment_pass
+class RowIndependencePass(DeploymentPass):
+    name = "row-independence"
+
+    @classmethod
+    def applicable(cls, deploy):
+        return deploy.kind in ("serving", "decode") and (
+            deploy.row_fetches or deploy.whole_fetches)
+
+    def run(self, ctx):
+        self.ctx = ctx
+        deploy = ctx.deploy
+        sources = deploy.row_sources
+        if sources is None:
+            sources = ctx.feed_names
+        # name -> (level, cause); cause = (block, op_idx, op, reason) for
+        # the op that first raised the name to MIXED
+        self.states = {n: (ROW, None) for n in sources}
+        for _ in range(3):  # fixpoint over backward-carried loop state
+            before = dict(self.states)
+            self._walk(ctx.program.global_block())
+            if self.states == before:
+                break
+        self._certify()
+
+    # ---- lattice plumbing --------------------------------------------
+    def _level(self, name):
+        return self.states.get(name, (CONST, None))
+
+    def _raise_to(self, name, level, cause):
+        cur, cur_cause = self._level(name)
+        if level > cur:
+            self.states[name] = (level, cause if level == MIXED else None)
+        elif level == cur == MIXED and cur_cause is None:
+            self.states[name] = (level, cause)
+
+    def _join_inputs(self, op, skip_slots=()):
+        level, cause = CONST, None
+        for slot, names in op.inputs.items():
+            if slot in skip_slots:
+                continue
+            for n in names:
+                if not n:
+                    continue
+                lv, cs = self._level(n)
+                if lv > level:
+                    level, cause = lv, cs
+        return level, cause
+
+    # ---- walk ---------------------------------------------------------
+    def _walk(self, block):
+        ctx = self.ctx
+        for op_idx, op in enumerate(block.ops):
+            if is_host_io_op(op.type):
+                for ns in op.outputs.values():
+                    for n in ns:
+                        if n:
+                            self._raise_to(n, ROW, None)
+                continue
+            for sub in ctx.sub_blocks(op):
+                self._walk(sub)
+            level, cause = self._transfer(block, op_idx, op)
+            for ns in op.outputs.values():
+                for n in ns:
+                    if n:
+                        self._raise_to(n, level, cause)
+
+    def _shape_of(self, block, name):
+        v = self.ctx.lookup(block, name)
+        return tuple(getattr(v, "shape", ()) or ()) if v is not None else ()
+
+    def _first(self, op, slot):
+        names = op.inputs.get(slot) or ()
+        return names[0] if names else None
+
+    def _transfer(self, block, op_idx, op):
+        """-> (level, cause) of the op's outputs."""
+        t = op.type
+        join, join_cause = self._join_inputs(op)
+
+        def mixed(reason):
+            return MIXED, (block, op_idx, op, reason)
+
+        if t in _SHAPE_ONLY_OPS:
+            return CONST, None
+        if join == CONST:
+            return CONST, None  # no row data flows in at all
+        if join == MIXED:
+            return MIXED, join_cause
+        # join == ROW from here: does THIS op mix rows?
+        if t in _CROSS_ROW_OPS:
+            return mixed("%s moves data across the batch dim by design"
+                         % t)
+        if t in _REDUCE_OPS:
+            if self._reduces_dim0(block, op):
+                return mixed("reduction over dim 0 folds all rows "
+                             "together")
+            return ROW, None
+        if t == "mean":
+            return mixed("mean reduces over every dim including the "
+                         "batch dim")
+        if t == "batch_norm" and not op.attrs.get("is_test", False):
+            return mixed("train-mode batch_norm normalizes with "
+                         "statistics computed ACROSS the batch")
+        if t in ("concat", "stack") and op.attrs.get("axis", 0) == 0:
+            return mixed("%s along axis 0 splices rows from different "
+                         "inputs" % t)
+        if t in ("split", "unstack") and op.attrs.get("axis", 0) == 0:
+            return mixed("%s along axis 0 redistributes rows across "
+                         "outputs" % t)
+        if t in ("transpose", "transpose2"):
+            perm = op.attrs.get("axis") or ()
+            if tuple(perm[:1]) not in ((), (0,)):
+                return mixed("transpose moves the batch dim off axis 0")
+        if t in ("reshape", "reshape2"):
+            if not self._reshape_keeps_rows(block, op):
+                return mixed("reshape regroups the batch dim")
+        if t in ("squeeze", "unsqueeze"):
+            if 0 in (op.attrs.get("axes") or ()):
+                return mixed("%s touches axis 0 (the batch dim)" % t)
+        if t == "flatten" and op.attrs.get("axis", 1) == 0:
+            return mixed("flatten(axis=0) folds the batch dim into the "
+                         "feature dim")
+        if t in _AXIS_OPS:
+            if self._axis_is_dim0(block, op):
+                return mixed("%s over axis 0 couples rows" % t)
+        if t == "expand":
+            times = op.attrs.get("expand_times") or ()
+            if times and times[0] != 1:
+                return mixed("expand tiles the batch dim")
+        if t == "pad":
+            pads = op.attrs.get("paddings") or ()
+            if tuple(pads[:2]) not in ((), (0, 0)):
+                return mixed("pad shifts rows along the batch dim")
+        if t in ("gather", "lookup_table"):
+            table = self._first(op, "X" if t == "gather" else "W")
+            if table is not None and self._level(table)[0] >= ROW:
+                return mixed("%s indexes into row-dependent data — row i "
+                             "of the result can read another request's "
+                             "row" % t)
+            return ROW, None  # CONST table + ROW index: per-row lookup
+        if t in _MATMUL_OPS:
+            return self._matmul(block, op_idx, op)
+        # default: elementwise / rowwise (activations, cast, softmax over
+        # the feature axis, sequence ops on the batch-major layout, ...)
+        return ROW, None
+
+    def _reduces_dim0(self, block, op):
+        if op.attrs.get("reduce_all", False):
+            return True
+        dims = op.attrs.get("dim", 0)
+        if not isinstance(dims, (list, tuple)):
+            dims = [dims]
+        x = self._first(op, "X")
+        rank = len(self._shape_of(block, x)) if x else 0
+        return any((d + rank if (d < 0 and rank) else d) == 0
+                   for d in dims)
+
+    def _axis_is_dim0(self, block, op):
+        axis = op.attrs.get("axis", -1)
+        x = self._first(op, "X")
+        rank = len(self._shape_of(block, x)) if x else 0
+        if axis < 0:
+            if not rank:
+                return False
+            axis += rank
+        return axis == 0
+
+    def _reshape_keeps_rows(self, block, op):
+        shape = tuple(op.attrs.get("shape") or ())
+        if not shape:
+            return False
+        if shape[0] in (0, -1):
+            return True  # leading dim copied / inferred: rows intact
+        x = self._first(op, "X")
+        in_shape = self._shape_of(block, x) if x else ()
+        # concrete-but-equal leading dim (the decode slot case: slot
+        # programs reshape [slots] -> [slots, 1] with slots literal)
+        return bool(in_shape) and in_shape[0] == shape[0]
+
+    def _matmul(self, block, op_idx, op):
+        xl = max([self._level(n)[0] for n in op.inputs.get("X", ()) if n]
+                 or [CONST])
+        yl = max([self._level(n)[0] for n in op.inputs.get("Y", ()) if n]
+                 or [CONST])
+        if MIXED in (xl, yl):
+            lv, cs = self._join_inputs(op)
+            return lv, cs
+        if CONST in (xl, yl):
+            return max(xl, yl), None  # data x const weights: rowwise
+        # both operands row-tainted: only a BATCHED matmul (both rank>=3,
+        # contraction inside each row) keeps rows independent
+        xr = len(self._shape_of(block, self._first(op, "X")))
+        yr = len(self._shape_of(block, self._first(op, "Y")))
+        if xr >= 3 and yr >= 3:
+            return ROW, None
+        return MIXED, (block, op_idx, op,
+                       "%s contracts two row-dependent operands over the "
+                       "batch dim" % op.type)
+
+    # ---- certificates -------------------------------------------------
+    def _certify(self):
+        ctx = self.ctx
+        deploy = ctx.deploy
+        certs = ctx.result.certificates
+        gb = ctx.program.global_block()
+        for fetch in list(deploy.row_fetches) + list(deploy.whole_fetches):
+            level, cause = self._level(fetch)
+            cert = {"status": _STATUS[level], "cause": None}
+            if level == MIXED:
+                row_sliced = fetch in deploy.row_fetches
+                if cause is not None:
+                    cblock, cop_idx, cop, reason = cause
+                else:
+                    cblock, cop_idx, cop, reason = gb, None, None, \
+                        "cross-row dataflow"
+                cert["cause"] = "%s (block %d op %s)" % (
+                    reason, cblock.idx,
+                    cop_idx if cop_idx is not None else "?")
+                report = ctx.error if row_sliced else ctx.warning
+                report(
+                    "cross-row-mix",
+                    "fetch %r is cross-row-dependent: %s — %s"
+                    % (fetch, reason,
+                       "coalesced requests could observe each other's "
+                       "rows, so the batcher contract CANNOT hold"
+                       if row_sliced else
+                       "it is returned whole to every request, which is "
+                       "only safe if callers expect a batch-level value"),
+                    block=cblock, op_idx=cop_idx, op=cop,
+                    var_names=(fetch,),
+                    hint="make the fetch rowwise (reduce over feature "
+                         "dims only, is_test batch_norm), or serve it "
+                         "with batching disabled")
+            certs[fetch] = cert

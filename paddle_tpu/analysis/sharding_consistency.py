@@ -1,0 +1,217 @@
+"""Sharding-consistency: prove a ShardingPlan coheres with the program.
+
+PR-9/11 made the distribution plan explicit (VarPlan per persistable,
+grad reduce-scatter constraints, tp gather placement) but only the
+executor's device_put would notice a plan that no longer matches the
+program it was built for — at run time, per var, as an opaque shape
+error or (worse) a silently replicated footprint. This pass checks the
+whole plan against the graph statically:
+
+  plan-var-missing    a PARAM entry names a var the program doesn't
+                      declare (stale plan / renamed param). Gradient /
+                      accumulator entries for absent vars are inert —
+                      build() mirrors sharded params into @GRAD entries
+                      so one plan serves train AND serve, and an
+                      inference program declares neither — so they are
+                      skipped, not flagged
+  plan-int8-conflict  an entry targets a param the int8 rewrite has
+                      demoted — the plan would shard a var the scope no
+                      longer holds while X@QVAL/X@QSCALE ride unplanned
+  plan-shape-mismatch entry's recorded shape differs from the var, the
+                      spec outranks the var, or a sharded dim is not
+                      divisible by its mesh-axis product
+  plan-dtype-mismatch entry's recorded dtype differs from the var
+  plan-grad-coverage  a sharded param whose @GRAD the program writes has
+                      no GRADIENT entry — the reduce-scatter constraint
+                      would silently degrade to all-reduce + slice
+  plan-replicated     (WARNING) update sharding is on, the param is big
+                      enough to shard, but its dim 0 doesn't divide the
+                      shard axis — it silently replicates; the plan's
+                      recorded reason is surfaced
+
+Works on a real ShardingPlan or a deployment.PlanView (saved plan JSON
+linted on a machine without the mesh).
+"""
+from ..core.framework import GRAD_SUFFIX
+from .deployment import (DeploymentPass, plan_axis_sizes,
+                         register_deployment_pass)
+from .shape_infer import _canonical
+
+_QVAL = "@QVAL"
+
+
+def _spec_axes(spec):
+    """Per-dim tuples of mesh-axis names ((),) for None dims."""
+    out = []
+    for ent in tuple(spec or ()):
+        if isinstance(ent, (list, tuple)):
+            out.append(tuple(ent))
+        else:
+            out.append(() if ent is None else (ent,))
+    return out
+
+
+@register_deployment_pass
+class ShardingConsistencyPass(DeploymentPass):
+    name = "sharding-consistency"
+
+    @classmethod
+    def applicable(cls, deploy):
+        return deploy.plan is not None
+
+    def run(self, ctx):
+        plan = ctx.deploy.plan
+        axis_sizes = plan_axis_sizes(plan)
+        gb = ctx.program.global_block()
+        written = set()
+        for block in ctx.program.blocks:
+            for op in block.ops:
+                written.update(n for n in op.all_output_vars() if n)
+        entries = plan.entries
+
+        for name in sorted(entries):
+            e = entries[name]
+            var = ctx.lookup(gb, name)
+            demoted = ctx.lookup(gb, name + _QVAL) is not None
+            if var is None and e.kind != "param" and not demoted:
+                # inert entry: build() mirrors sharded params into @GRAD
+                # GRADIENT entries (and owners into accumulators) so ONE
+                # plan serves train and serve — an inference program
+                # declares none of them, and an entry for an absent var
+                # is never consulted at lowering. Only a missing PARAM
+                # means the plan no longer matches the program.
+                continue
+            if var is None or (demoted and not var.persistable):
+                if demoted:
+                    ctx.error(
+                        "plan-int8-conflict",
+                        "plan entry %r (%s) targets a param the int8 "
+                        "rewrite demoted: the scope holds %r/%r now, and "
+                        "sharding the dequantized intermediate is not "
+                        "what this entry means" % (
+                            name, e.kind, name + _QVAL, name + "@QSCALE"),
+                        var_names=(name, name + _QVAL),
+                        hint="rebuild the plan from the REWRITTEN "
+                             "program, or serve this model with "
+                             "weights_dtype != int8 under this plan")
+                else:
+                    ctx.error(
+                        "plan-var-missing",
+                        "plan entry %r (%s) names a variable the program "
+                        "does not declare — the plan is stale or built "
+                        "for a different program" % (name, e.kind),
+                        var_names=(name,),
+                        hint="rebuild the plan (ShardingPlan.build) "
+                             "against this program")
+                continue
+            self._check_entry(ctx, e, var, axis_sizes)
+
+        self._check_grad_coverage(ctx, plan, entries, written)
+        self._warn_silent_replication(ctx, plan, entries, gb, axis_sizes)
+
+    def _check_entry(self, ctx, e, var, axis_sizes):
+        shape = tuple(getattr(var, "shape", ()) or ())
+        if e.shape is not None and tuple(e.shape) != shape:
+            ctx.error(
+                "plan-shape-mismatch",
+                "plan entry %r was built for shape %r but the program "
+                "declares %r" % (e.name, tuple(e.shape), shape),
+                var_names=(e.name,),
+                hint="rebuild the plan against this program")
+            return
+        if e.dtype is not None and shape is not None:
+            try:
+                planned, actual = _canonical(e.dtype), _canonical(var.dtype)
+            except Exception:  # noqa: BLE001 — unknown dtype string
+                planned = actual = None
+            if planned is not None and planned != actual:
+                ctx.error(
+                    "plan-dtype-mismatch",
+                    "plan entry %r was built for dtype %s but the "
+                    "program declares %s" % (e.name, e.dtype, var.dtype),
+                    var_names=(e.name,),
+                    hint="rebuild the plan against this program")
+        per_dim = _spec_axes(e.spec)
+        if len(per_dim) > len(shape):
+            ctx.error(
+                "plan-shape-mismatch",
+                "plan entry %r has a rank-%d spec %r for a rank-%d "
+                "variable" % (e.name, len(per_dim), tuple(e.spec),
+                              len(shape)),
+                var_names=(e.name,),
+                hint="trim the spec or rebuild the plan")
+            return
+        for d, axes in enumerate(per_dim):
+            factor = 1
+            for a in axes:
+                factor *= int(axis_sizes.get(a, 1))
+            if factor > 1 and shape[d] >= 0 and shape[d] % factor:
+                ctx.error(
+                    "plan-shape-mismatch",
+                    "plan entry %r shards dim %d (size %d) %d-ways over "
+                    "%r — not divisible, GSPMD would reject or pad this "
+                    "at lowering" % (e.name, d, shape[d], factor,
+                                     tuple(axes)),
+                    var_names=(e.name,),
+                    hint="pad the dim, shard a different dim, or drop "
+                         "the constraint")
+
+    def _tp_gather_exempt(self, plan, e):
+        """Gather-placed TP params keep their grads un-constrained by
+        contract (ShardingPlan.grad_constraints docstring)."""
+        tp_axis = getattr(plan, "tp_axis", None)
+        if not tp_axis or getattr(plan, "tp_placement", None) != "gather":
+            return False
+        return any(tp_axis in axes for axes in _spec_axes(e.spec))
+
+    def _check_grad_coverage(self, ctx, plan, entries, written):
+        for name in sorted(entries):
+            e = entries[name]
+            if e.kind != "param" or not e.sharded:
+                continue
+            grad = name + GRAD_SUFFIX
+            if grad not in written or grad in entries:
+                continue
+            if self._tp_gather_exempt(plan, e):
+                continue
+            ctx.error(
+                "plan-grad-coverage",
+                "param %r is sharded %r but its gradient %r (which this "
+                "program writes) has no plan entry: without the "
+                "reduce-scatter constraint the gradient sum lowers as a "
+                "full all-reduce plus slice, and the update reads an "
+                "unconstrained layout" % (name, tuple(e.spec), grad),
+                var_names=(name, grad),
+                hint="rebuild the plan (build() mirrors every sharded "
+                     "param into a GRADIENT entry) or add the entry")
+
+    def _warn_silent_replication(self, ctx, plan, entries, gb, axis_sizes):
+        shard_axis = getattr(plan, "shard_axis", None)
+        n_shard = int(axis_sizes.get(shard_axis, 1)) if shard_axis else 1
+        if n_shard <= 1:
+            return
+        for name in sorted(entries):
+            e = entries[name]
+            if e.kind != "param" or e.sharded or e.override:
+                continue
+            var = ctx.lookup(gb, name)
+            shape = tuple(getattr(var, "shape", ()) or ()) if var else ()
+            if not shape or shape[0] < 0:
+                continue
+            numel = 1
+            for d in shape:
+                numel *= max(int(d), 1)
+            if numel < n_shard or shape[0] % n_shard == 0:
+                continue  # too small to matter / divisible, so by policy
+            ctx.warning(
+                "plan-replicated",
+                "param %r (shape %r, %d elements) replicates on every "
+                "chip under this plan%s — dim 0 does not divide the "
+                "%d-way shard axis %r" % (
+                    name, shape, numel,
+                    ": %s" % e.reason if e.reason else "",
+                    n_shard, shard_axis),
+                var_names=(name,),
+                hint="pad dim 0 to a multiple of %d, or pin a spec via "
+                     "ParamAttr(mesh_axes=...) / param_shardings if the "
+                     "replication is intended" % n_shard)

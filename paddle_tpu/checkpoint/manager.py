@@ -378,8 +378,14 @@ class CheckpointManager(object):
                 # from its own bytes, so what is checked is what a resume
                 # will actually load)
                 from ..core import program_desc as _pd
-                from ..analysis import validate_or_raise
-                validate_or_raise(_pd.program_from_bytes(job.program_bytes))
+                from ..analysis import DeploymentContext, validate_or_raise
+                # generic deployment tier rides along: a snapshot with a
+                # torn int8 rewrite (@QVAL without scales) or donation-
+                # unsafe state ordering is the artifact a RESUME or a
+                # from_checkpoint engine will load — cheaper to refuse
+                # the write than to debug the load
+                validate_or_raise(_pd.program_from_bytes(job.program_bytes),
+                                  deploy=DeploymentContext.generic())
             t0 = time.perf_counter()
             path = _snap.write_snapshot(
                 self.checkpoint_dir, job.step, job.values, job.meta,

@@ -512,23 +512,27 @@ def _validate_program_flag():
 
 
 def maybe_validate_program(program, feed_arrays, fetch_names, steps,
-                           cache, validate=None):
+                           cache, validate=None, deploy=None):
     """Shared strict-mode gate for Executor.run and ParallelExecutor.run:
     resolve the validate setting (explicit arg wins over the env flag),
     run the static analyzer once per (program version, feed/fetch
-    signature, multi-step) — `cache` is the caller's set — and raise
-    ProgramVerificationError on findings. Must run BEFORE the io
-    pre-pass: a raise here consumes no reader records."""
+    signature, multi-step, deployment) — `cache` is the caller's set —
+    and raise ProgramVerificationError on findings. Must run BEFORE the
+    io pre-pass: a raise here consumes no reader records. `deploy` (a
+    DeploymentContext) arms the deployment tier on top of the base
+    pipeline — ParallelExecutor passes its armed ShardingPlan through
+    here, so plan/program drift fails at the run() boundary."""
     if not (_validate_program_flag() if validate is None
             else bool(validate)):
         return
     vkey = (program._uid, program._version, tuple(sorted(feed_arrays)),
-            tuple(fetch_names), steps > 1)
+            tuple(fetch_names), steps > 1,
+            deploy.cache_key() if deploy is not None else None)
     if vkey in cache:
         return
     from ..analysis import validate_or_raise
     validate_or_raise(program, feed_names=list(feed_arrays),
-                      fetch_names=fetch_names, steps=steps)
+                      fetch_names=fetch_names, steps=steps, deploy=deploy)
     cache.add(vkey)
 
 

@@ -34,9 +34,18 @@ def _op_callstack(limit=4):
     op_callstack attr, framework.py Operator.__init__). Raw
     (filename, lineno, function) triples — no source lines are read here,
     keeping op creation cheap; core.utils.format_callstack renders them
-    lazily. FLAGS_op_callstack=0 disables recording entirely."""
-    if os.environ.get("FLAGS_op_callstack", "1") in ("0", "false", "False"):
+    lazily. FLAGS_op_callstack=0 disables recording entirely; any other
+    integer value is a frame-depth override (FLAGS_op_callstack=8 walks
+    8 user frames — deep wrapper stacks around the layers API need more
+    than the default 4 for the diagnostic to reach the caller)."""
+    flag = os.environ.get("FLAGS_op_callstack", "1")
+    if flag in ("0", "false", "False"):
         return ()
+    try:
+        if int(flag) > 1:
+            limit = int(flag)
+    except ValueError:
+        pass  # FLAGS_op_callstack=true/... : default depth
     try:
         f = sys._getframe(1)
     except ValueError:  # pragma: no cover - no caller frame

@@ -6,6 +6,11 @@ import jax.numpy as jnp
 
 from ..core.registry import register, single
 
+# input-slot storage dtypes of dequantize_channel — the static half of
+# the int8 contract. analysis.dtype_flow verifies saved programs against
+# THIS table, so a storage-format change here is a lint-rule change too.
+DEQUANTIZE_SLOTS = {"X": "int8", "Scale": "float32"}
+
 
 @register("dequantize_channel")
 def _dequantize_channel(ctx, ins, attrs):

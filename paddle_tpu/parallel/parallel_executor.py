@@ -214,10 +214,16 @@ class ParallelExecutor(object):
         feed_arrays = convert_feeds(program, feed, host=True)
 
         # strict mode (FLAGS_validate_program): same pre-lowering static
-        # verification Executor.run performs
+        # verification Executor.run performs, plus the deployment tier
+        # against the ARMED plan — a stale/mismatched ShardingPlan fails
+        # here with a named entry instead of as a device_put shape error
+        # per var mid-dispatch
         from ..core.executor import maybe_validate_program
-        maybe_validate_program(program, feed_arrays, fetch_names, steps,
-                               self._validated)
+        from ..analysis import DeploymentContext
+        maybe_validate_program(
+            program, feed_arrays, fetch_names, steps, self._validated,
+            deploy=DeploymentContext.for_training(plan=self.plan,
+                                                  steps=steps))
 
         if info is not None:
             # preliminary watchdog identity (refined after the prepass)

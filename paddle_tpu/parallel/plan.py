@@ -207,6 +207,20 @@ def _spec_to_json(spec):
     return out
 
 
+def _spec_from_json(spec):
+    """Inverse of _spec_to_json: JSON list -> plain per-dim tuple of axis
+    names / axis tuples / None. Deliberately NOT a PartitionSpec — this
+    feeds analysis.PlanView, which must work on machines that cannot
+    build the plan's mesh (linting an 8-chip plan on a 1-CPU box)."""
+    out = []
+    for p in spec:
+        if isinstance(p, (list, tuple)):
+            out.append(tuple(str(a) for a in p))
+        else:
+            out.append(None if p is None else str(p))
+    return tuple(out)
+
+
 def _spec_shard_factor(spec, mesh):
     """How many ways `spec` splits a value over `mesh` (the per-chip
     memory divisor): product of the sizes of every mesh axis the spec

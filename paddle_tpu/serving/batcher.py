@@ -177,12 +177,19 @@ class Batcher(object):
 
     def __init__(self, dispatch_fn, max_batch_size=32, max_queue_delay_ms=5,
                  queue_capacity=256, metrics=None, name="batcher",
-                 pipeline_depth=2):
+                 pipeline_depth=2, coalesce=True):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         if pipeline_depth < 0:
             raise ValueError("pipeline_depth must be >= 0")
         self._dispatch = dispatch_fn
+        # coalesce=False is the row-independence certificate's fallback
+        # (analysis/row_independence.py): the engine could not prove that
+        # row i of every sliced fetch depends only on input row i, so
+        # requests from different callers must not share a device batch.
+        # Each batch then carries exactly one request — dispatch overhead
+        # returns to per-request, but nobody reads a stranger's rows.
+        self.coalesce = bool(coalesce)
         self.max_batch_size = int(max_batch_size)
         self.max_queue_delay_s = float(max_queue_delay_ms) / 1e3
         self.queue_capacity = int(queue_capacity)
@@ -305,6 +312,8 @@ class Batcher(object):
             # (waiting the full window would 504 every such request under
             # light load).
             leave_at = self._queue[0].enqueued_at + self.max_queue_delay_s
+            if not self.coalesce:
+                leave_at = self._queue[0].enqueued_at  # nothing to wait for
             while not (self._closed or self._draining or self._drainers):
                 if self._pending_rows >= self.max_batch_size \
                         or leave_at <= time.monotonic():
@@ -327,6 +336,8 @@ class Batcher(object):
                     continue
                 if rows + req.rows > self.max_batch_size:
                     break
+                if batch and not self.coalesce:
+                    break  # one request per batch: see coalesce above
                 batch.append(self._pop_head())
                 rows += req.rows
             # mark the worker busy while STILL holding the lock: between

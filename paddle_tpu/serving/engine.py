@@ -335,6 +335,46 @@ class InferenceEngine(object):
             self._pexe._scope = self._scope
             self._device_slice = devices[0].platform != "cpu"
 
+        # deployment tier (analysis/deployment.py): prove the serving
+        # contracts on the REWRITTEN program — row-independence of every
+        # sliced fetch (the Batcher's coalescing contract), quant-pair
+        # well-formedness after _apply_weights_dtype, plan coherence for
+        # tp engines — then let warmup's empirical probes confirm what
+        # was already proven. The per-fetch certificates are recorded
+        # and CONSUMED below: a sliced fetch the analysis could not
+        # certify row-independent (a warning-severity mix on a
+        # "dynamic"/"whole" fetch — error-severity mixes on "rows"
+        # fetches raise here) disables cross-request coalescing, so
+        # correctness degrades to per-request batches instead of letting
+        # strangers' rows bleed into each other. validate=False skips
+        # the tier entirely and keeps full coalescing — the caller owns
+        # the contract, exactly as before this tier existed.
+        self.deployment_report = None
+        self.row_certificates = {}
+        self._row_safe = True
+        if validate:
+            from .. import analysis
+            sliced = [n for n in self.fetch_names
+                      if self._fetch_row_policy[n] != "whole"]
+            deploy = analysis.DeploymentContext.for_serving(
+                row_fetches=[n for n in self.fetch_names
+                             if self._fetch_row_policy[n] == "rows"],
+                whole_fetches=[n for n in self.fetch_names
+                               if self._fetch_row_policy[n] != "rows"],
+                weights_dtype=("bf16" if self.weights_dtype == "bf16"
+                               else "int8" if self.weights_dtype == "int8"
+                               else None),
+                plan=self.plan)
+            self.deployment_report = analysis.analyze_deployment(
+                self.program, deploy, feed_names=self.feed_names,
+                fetch_names=self.fetch_names)
+            self.deployment_report.raise_if_errors()
+            self.row_certificates = dict(
+                self.deployment_report.certificates)
+            self._row_safe = all(
+                self.row_certificates.get(n, {}).get("status") != "mixed"
+                for n in sliced)
+
         if batch_buckets:
             self.batch_buckets = sorted(set(int(b) for b in batch_buckets))
             self.max_batch_size = (int(max_batch_size) if max_batch_size
@@ -370,7 +410,8 @@ class InferenceEngine(object):
             self._dispatch, max_batch_size=self.max_batch_size,
             max_queue_delay_ms=max_queue_delay_ms,
             queue_capacity=queue_capacity, metrics=self.metrics,
-            name=self.name, pipeline_depth=self.pipeline_depth)
+            name=self.name, pipeline_depth=self.pipeline_depth,
+            coalesce=self._row_safe)
         if warmup:
             try:
                 self.warmup()
@@ -1033,6 +1074,29 @@ class DecodeEngine(object):
                     "state needs concrete per-slot shapes" % (n, feat))
             dtype = convert_dtype(var.dtype) if var.dtype else "float32"
             self._slot_var_meta[n] = (tuple(feat), dtype)
+
+        # deployment tier with the DECODE context: slot vars are the row
+        # sources (row i of every fetch may depend only on slot i's own
+        # state — the DecodeBatcher's isolation contract), slot state
+        # must be written exactly once per step with static shapes, and
+        # no fetch may alias a donated slot update. Runs after slot
+        # inference so the context describes what the engine will
+        # actually carry; errors here name the offending op instead of
+        # surfacing as a wrong token three streams later.
+        self.deployment_report = None
+        self.row_certificates = {}
+        if validate:
+            from .. import analysis
+            deploy = analysis.DeploymentContext.for_decode(
+                slot_vars=self.slot_vars, max_slots=self.max_slots,
+                row_fetches=self.fetch_names)
+            self.deployment_report = analysis.analyze_deployment(
+                self.program, deploy, feed_names=[],
+                fetch_names=self.fetch_names)
+            self.deployment_report.raise_if_errors()
+            self.row_certificates = dict(
+                self.deployment_report.certificates)
+
         # non-slot state the step reads must exist in the scope too
         # (zero-init whatever the model load didn't provide)
         self._reset_slot_state()

@@ -1,0 +1,530 @@
+"""Deployment-invariant static analysis (ARCHITECTURE.md §2c): the
+mutation suite. Each invariant gets a known-good program that must
+certify clean AND one seeded corruption that must trip EXACTLY the
+expected pass at the expected severity — proving the deployment tier
+catches real drift, not just that it stays quiet:
+
+  row-independence       cross-row reduce poisons a sliced fetch
+  sharding-consistency   ghost entry / tampered shape / tampered dtype
+                         / dropped gradient entry / silent replication
+  dtype-flow             torn int8 rewrite (@QVAL without @QSCALE),
+                         AMP-flag drift, stray fp64
+  decode-invariants      double-written slot, slot/fetch aliasing,
+                         max_slots mismatch
+  donation-safety        persistable read both before and after its
+                         in-step update
+
+Plus the seams that consume the tier: engine load raises on errors and
+the Batcher consumes the row certificates (coalesce=False fallback),
+CheckpointManager refuses to record a torn rewrite, the strict-mode
+gate arms the tier, pplint's exit codes / --json, and the tier-1
+`pplint --all-models` sweep with its latency budgets.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis
+from paddle_tpu.analysis import (DeploymentContext, PlanView,
+                                 ProgramVerificationError)
+from paddle_tpu.core.framework import GRAD_SUFFIX
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.plan import ShardingPlan, VarPlan
+
+L = fluid.layers
+SLOTS, D, V, EOS = 4, 8, 16, 0
+
+
+# ------------------------------------------------------------ builders --
+def _dense_model(poison=False):
+    """fc/relu/fc serving model; poison=True seeds a cross-row mix: a
+    dim-0 reduction folded back into the per-row activations."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[4], dtype="float32")
+        h = L.fc(input=x, size=8, act="relu")
+        if poison:
+            s = L.reduce_sum(h, dim=0, keep_dim=True)
+            fetch = L.elementwise_add(h, s)
+        else:
+            fetch = L.fc(input=h, size=3, act="softmax")
+    return main, startup, fetch
+
+
+def _save_model(tmp_path, poison=False, name="m"):
+    main, startup, fetch = _dense_model(poison=poison)
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / name)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [fetch], exe, main)
+    return d
+
+
+def _decode_program(double_write=False):
+    """Greedy-argmax decode step (the test_decode_serving shape):
+    slot-major carried tok/h, one Executor.run per iteration."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        tok = L.create_global_var([SLOTS, 1], 0, "int64",
+                                  persistable=True, name="tok")
+        h = L.create_global_var([SLOTS, D], 0.0, "float32",
+                                persistable=True, name="h")
+        x = L.cast(tok, "float32")
+        z = L.fc(input=L.concat([x, h], axis=1), size=D, act="tanh")
+        logits = L.fc(input=z, size=V)
+        nxt = L.reshape(L.argmax(logits, axis=1), shape=[SLOTS, 1])
+        fin = L.equal(nxt, L.fill_constant([SLOTS, 1], "int64", EOS))
+        L.assign(nxt, output=tok)
+        L.assign(z, output=h)
+        if double_write:
+            L.assign(nxt, output=tok)
+    return main, startup, nxt, fin
+
+
+def _trainer_and_plan():
+    """Tiny sgd trainer + the 8-way plan it runs under. fc_0.w_0 is
+    [16,10] (16 % 8 == 0: sharded); the size-10 params don't divide."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[16], dtype="float32")
+        y = L.data(name="y", shape=[1], dtype="float32")
+        h = L.fc(input=x, size=10, act="relu")
+        p = L.fc(input=h, size=1)
+        loss = L.mean(L.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    plan = ShardingPlan.build(main, make_mesh({"dp": 8}),
+                              shard_update=True)
+    return main, plan
+
+
+def _torn_quant_program():
+    """A quant rewrite torn mid-way: @QVAL values persisted with no
+    @QSCALE twin — exactly what a partial save/copy produces."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        main.global_block().create_var(name="w@QVAL", shape=[4, 4],
+                                       dtype="int8", persistable=True)
+    return main
+
+
+def _codes(result, severity=None):
+    diags = result.diagnostics if severity is None else (
+        result.errors if severity == "error" else result.warnings)
+    return sorted({d.code for d in diags})
+
+
+# ----------------------------------------------------- row-independence --
+def test_dense_model_certifies_row():
+    main, _, fetch = _dense_model()
+    dep = DeploymentContext.for_serving(row_fetches=[fetch.name])
+    r = analysis.analyze_deployment(main, dep, feed_names=["x"],
+                                    fetch_names=[fetch.name])
+    assert not r.diagnostics
+    assert r.certificates[fetch.name] == {"status": "row", "cause": None}
+
+
+def test_cross_row_mutation_fires_with_exact_location():
+    main, _, fetch = _dense_model(poison=True)
+    dep = DeploymentContext.for_serving(row_fetches=[fetch.name])
+    r = analysis.analyze_deployment(main, dep, feed_names=["x"],
+                                    fetch_names=[fetch.name])
+    assert _codes(r, "error") == ["cross-row-mix"]
+    d = r.errors[0]
+    # the Diagnostic must name BOTH the offending op and the poisoned
+    # fetch (the acceptance contract: actionable, not just "mixed")
+    assert d.op_type == "reduce_sum"
+    assert fetch.name in d.message
+    cert = r.certificates[fetch.name]
+    assert cert["status"] == "mixed" and "dim 0" in cert["cause"]
+
+
+def test_whole_fetch_mix_downgrades_to_warning():
+    main, _, fetch = _dense_model(poison=True)
+    dep = DeploymentContext.for_serving(row_fetches=(),
+                                        whole_fetches=[fetch.name])
+    r = analysis.analyze_deployment(main, dep, feed_names=["x"],
+                                    fetch_names=[fetch.name])
+    assert not r.errors
+    assert _codes(r, "warning") == ["cross-row-mix"]
+
+
+def test_engine_load_rejects_cross_row(tmp_path):
+    d = _save_model(tmp_path, poison=True)
+    from paddle_tpu.serving.engine import InferenceEngine
+    with pytest.raises(ProgramVerificationError, match="cross-row-mix"):
+        InferenceEngine(d, warmup=False)
+
+
+def test_engine_load_certifies_and_keeps_coalescing(tmp_path):
+    d = _save_model(tmp_path)
+    from paddle_tpu.serving.engine import InferenceEngine
+    eng = InferenceEngine(d, warmup=False)
+    try:
+        fetch = eng.fetch_names[0]
+        assert eng.row_certificates[fetch]["status"] == "row"
+        assert eng.deployment_report.ok
+        assert eng._row_safe and eng._batcher.coalesce
+    finally:
+        eng.close(drain=False)
+
+
+def test_batcher_coalesce_false_one_request_per_batch():
+    """The certificate's fallback, functionally: an uncertified engine
+    must never let strangers share a device batch."""
+    from paddle_tpu.serving.batcher import Batcher
+
+    def run(coalesce):
+        sizes = []
+
+        def dispatch(reqs):
+            sizes.append(len(reqs))
+            for req in reqs:
+                req.future.set_result(len(reqs))
+            return ()
+
+        b = Batcher(dispatch, max_batch_size=8, max_queue_delay_ms=150,
+                    pipeline_depth=0, coalesce=coalesce)
+        try:
+            futs = [b.submit({"x": np.zeros((1, 4), "f")}, rows=1)
+                    for _ in range(4)]
+            for f in futs:
+                f.result(timeout=10)
+        finally:
+            b.close(drain=True)
+        return sizes
+
+    assert all(s == 1 for s in run(False))     # one request per batch
+    assert max(run(True)) > 1                  # coalescing still works
+
+
+# ---------------------------------------------------- decode-invariants --
+def test_decode_program_certifies_and_slot_inference():
+    main, _, nxt, _ = _decode_program()
+    assert sorted(analysis.infer_slot_vars(main, [nxt.name], SLOTS)) == \
+        ["h", "tok"]
+    dep = DeploymentContext.for_decode(slot_vars={"tok", "h"},
+                                       max_slots=SLOTS,
+                                       row_fetches=[nxt.name])
+    r = analysis.analyze_deployment(main, dep, fetch_names=[nxt.name])
+    assert not r.errors
+    assert r.certificates[nxt.name]["status"] == "row"
+
+
+def test_slot_double_write_fires_and_engine_rejects():
+    main, startup, nxt, fin = _decode_program(double_write=True)
+    dep = DeploymentContext.for_decode(slot_vars={"tok", "h"},
+                                       max_slots=SLOTS,
+                                       row_fetches=[nxt.name])
+    r = analysis.analyze_deployment(main, dep, fetch_names=[nxt.name])
+    assert "slot-double-write" in _codes(r, "error")
+    from paddle_tpu import serving
+    with pytest.raises(ProgramVerificationError, match="slot-double-write"):
+        serving.DecodeEngine(program=main, startup_program=startup,
+                             token_var=nxt, finished_var=fin,
+                             max_slots=SLOTS, name="dep-bad")
+
+
+def test_slot_fetch_alias_fires():
+    main, _, nxt, _ = _decode_program()
+    dep = DeploymentContext.for_decode(slot_vars={"tok", "h"},
+                                       max_slots=SLOTS,
+                                       row_fetches=["tok"])
+    r = analysis.analyze_deployment(main, dep, fetch_names=["tok"])
+    assert "slot-fetch-alias" in _codes(r, "error")
+
+
+def test_slot_shape_fires_on_max_slots_mismatch():
+    main, _, nxt, _ = _decode_program()
+    dep = DeploymentContext.for_decode(slot_vars={"tok", "h"},
+                                       max_slots=SLOTS - 1,
+                                       row_fetches=[nxt.name])
+    r = analysis.analyze_deployment(main, dep, fetch_names=[nxt.name])
+    assert "slot-shape" in _codes(r, "error")
+
+
+# ----------------------------------------------------------- dtype-flow --
+def test_int8_rewrite_certifies(tmp_path):
+    d = _save_model(tmp_path)
+    from paddle_tpu.serving.engine import InferenceEngine
+    eng = InferenceEngine(d, weights_dtype="int8", warmup=False)
+    try:
+        assert eng.deployment_report.ok
+        assert "quant-pair" not in _codes(eng.deployment_report)
+    finally:
+        eng.close(drain=False)
+
+
+def test_torn_quant_pair_fires_and_strict_mode_raises():
+    main = _torn_quant_program()
+    r = analysis.analyze_deployment(main, DeploymentContext.generic())
+    assert _codes(r, "error") == ["quant-pair"]
+    with pytest.raises(ProgramVerificationError, match="quant-pair"):
+        analysis.validate_or_raise(main, deploy=DeploymentContext.generic())
+
+
+def test_checkpoint_save_refuses_torn_rewrite(tmp_path):
+    """The CheckpointManager seam: a snapshot recording a torn rewrite
+    is a failed save, not a surprise at resume."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    main = _torn_quant_program()
+    scope = fluid.Scope()
+    scope.set("w@QVAL", np.zeros((4, 4), np.int8))
+    with CheckpointManager(str(tmp_path / "ck"), async_save=False,
+                           validate=True) as mgr:
+        with pytest.raises(ProgramVerificationError, match="quant-pair"):
+            mgr.save(1, program=main, scope=scope).result(60)
+
+
+def test_quant_suffixes_stay_in_sync():
+    """dtype_flow pins its own copies of the suffixes (importing
+    serving from analysis would cycle package init); this is the tripwire
+    that keeps them equal to the rewrite's."""
+    from paddle_tpu.analysis import dtype_flow
+    from paddle_tpu.ops.quant_ops import DEQUANTIZE_SLOTS
+    from paddle_tpu.serving.quantize import QSCALE_SUFFIX, QVAL_SUFFIX
+    assert dtype_flow.QVAL_SUFFIX == QVAL_SUFFIX
+    assert dtype_flow.QSCALE_SUFFIX == QSCALE_SUFFIX
+    assert DEQUANTIZE_SLOTS == {"X": "int8", "Scale": "float32"}
+
+
+def test_amp_flag_and_stray_fp64_warn():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        L.data(name="d", shape=[3], dtype="float64")
+    dep = DeploymentContext.for_serving(row_fetches=(),
+                                        weights_dtype="bf16")
+    r = analysis.analyze_deployment(main, dep)
+    assert not r.errors
+    assert _codes(r, "warning") == ["amp-flag", "stray-fp64"]
+
+
+# ------------------------------------------------- sharding-consistency --
+def test_plan_certifies_clean():
+    main, plan = _trainer_and_plan()
+    r = analysis.analyze_deployment(
+        main, DeploymentContext.for_training(plan=plan))
+    assert not r.errors
+
+
+def test_plan_grad_mirrors_inert_on_inference_program():
+    """The tp-serving shape: ShardingPlan.build mirrors sharded params
+    into @GRAD entries, but an inference program declares no gradients —
+    those entries are inert, NOT plan-var-missing (the false positive
+    that would reject every tp engine load)."""
+    main, _, fetch = _dense_model()
+    plan = ShardingPlan.build(main, make_mesh({"dp": 8}),
+                              shard_update=True)
+    assert any(e.kind == "gradient" for e in plan)  # mirrors exist
+    r = analysis.analyze_deployment(
+        main, DeploymentContext.for_serving(row_fetches=[fetch.name],
+                                            plan=plan),
+        feed_names=["x"], fetch_names=[fetch.name])
+    assert not r.errors
+
+
+def test_plan_ghost_entry_fires():
+    main, plan = _trainer_and_plan()
+    plan.entries["ghost"] = VarPlan("ghost", (None,), "param")
+    r = analysis.analyze_deployment(
+        main, DeploymentContext.for_training(plan=plan))
+    assert _codes(r, "error") == ["plan-var-missing"]
+    assert "ghost" in r.errors[0].message
+
+
+def test_plan_tampered_shape_and_dtype_fire():
+    main, plan = _trainer_and_plan()
+    e = next(e for e in plan if e.kind == "param" and e.sharded)
+    e.shape = (3, 5)
+    r = analysis.analyze_deployment(
+        main, DeploymentContext.for_training(plan=plan))
+    assert _codes(r, "error") == ["plan-shape-mismatch"]
+
+    main, plan = _trainer_and_plan()
+    e = next(e for e in plan if e.kind == "param" and e.sharded)
+    e.dtype = "int8"
+    r = analysis.analyze_deployment(
+        main, DeploymentContext.for_training(plan=plan))
+    assert _codes(r, "error") == ["plan-dtype-mismatch"]
+
+
+def test_plan_dropped_gradient_entry_fires():
+    main, plan = _trainer_and_plan()
+    e = next(e for e in plan if e.kind == "param" and e.sharded)
+    del plan.entries[e.name + GRAD_SUFFIX]
+    r = analysis.analyze_deployment(
+        main, DeploymentContext.for_training(plan=plan))
+    assert _codes(r, "error") == ["plan-grad-coverage"]
+    assert e.name in r.errors[0].message
+
+
+def test_plan_silent_replication_warns_with_reason():
+    main, plan = _trainer_and_plan()
+    r = analysis.analyze_deployment(
+        main, DeploymentContext.for_training(plan=plan))
+    warns = r.by_code("plan-replicated")
+    # the size-10 fc params can't divide the 8-way shard axis
+    assert warns and all(d.severity == "warning" for d in warns)
+    assert any("dim0" in d.message for d in warns)  # plan's reason quoted
+
+
+def test_plan_view_round_trips_through_json():
+    """A saved plan linted WITHOUT the mesh (PlanView) must reach the
+    same verdicts as the live ShardingPlan."""
+    main, plan = _trainer_and_plan()
+    view = PlanView.from_json(json.loads(json.dumps(plan.to_json())))
+    live = analysis.analyze_deployment(
+        main, DeploymentContext.for_training(plan=plan))
+    offline = analysis.analyze_deployment(
+        main, DeploymentContext.for_training(plan=view))
+    assert _codes(live) == _codes(offline)
+    del view.entries[next(iter(sorted(view.entries)))]
+    view.entries["ghost"] = VarPlan("ghost", (None,), "param")
+    r = analysis.analyze_deployment(
+        main, DeploymentContext.for_training(plan=view))
+    assert "plan-var-missing" in _codes(r, "error")
+
+
+# ------------------------------------------------------ donation-safety --
+def test_read_after_update_flags_only_mixed_order():
+    def build(read_before):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            c = L.fill_constant([4], "float32", 2.0)
+            w = L.create_global_var([4], 1.0, "float32",
+                                    persistable=True, name="w")
+            if read_before:
+                c = L.elementwise_add(c, w)
+            L.assign(c, output=w)
+            L.elementwise_mul(c, w)
+        return main
+
+    mixed = analysis.analyze_deployment(build(True),
+                                        DeploymentContext.generic())
+    assert _codes(mixed, "warning") == ["read-after-update"]
+    assert mixed.warnings[0].var_names == ("w",)
+    # write-then-read-only (the lr-decay counter shape) is unambiguous
+    clean = analysis.analyze_deployment(build(False),
+                                        DeploymentContext.generic())
+    assert "read-after-update" not in _codes(clean)
+
+
+# ------------------------------------------------------ flags and seams --
+def test_op_callstack_flag_depth(monkeypatch):
+    def one_op_stack():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+            L.fill_constant([2], "float32", 1.0)
+        return main.global_block().ops[-1].callstack
+
+    monkeypatch.setenv("FLAGS_op_callstack", "0")
+    assert one_op_stack() == ()
+    monkeypatch.setenv("FLAGS_op_callstack", "2")
+    depth2 = one_op_stack()
+    assert 0 < len(depth2) <= 2
+    monkeypatch.setenv("FLAGS_op_callstack", "8")
+    assert len(one_op_stack()) >= len(depth2)
+
+
+def test_strict_mode_gate_arms_deployment_tier(monkeypatch):
+    """maybe_validate_program (the Executor/ParallelExecutor gate) must
+    run the deployment tier when handed a context — and stay silent with
+    the flag off, whatever the program looks like."""
+    from paddle_tpu.core.executor import maybe_validate_program
+    main, _, fetch = _dense_model(poison=True)
+    dep = DeploymentContext.for_serving(row_fetches=[fetch.name])
+    feed = {"x": np.zeros((2, 4), "float32")}
+
+    monkeypatch.setenv("FLAGS_validate_program", "1")
+    with pytest.raises(ProgramVerificationError, match="cross-row-mix"):
+        maybe_validate_program(main, feed, [fetch.name], 1, set(),
+                               deploy=dep)
+    monkeypatch.setenv("FLAGS_validate_program", "0")
+    maybe_validate_program(main, feed, [fetch.name], 1, set(), deploy=dep)
+
+
+# ------------------------------------------------------------ pplint CLI --
+def _pplint():
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "pplint", pathlib.Path(__file__).resolve().parents[2]
+        / "tools" / "pplint.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pplint_exit_codes_and_json(tmp_path, capsys):
+    pplint = _pplint()
+    good = _save_model(tmp_path, name="good")
+    bad = _save_model(tmp_path, poison=True, name="bad")
+
+    assert pplint.main([good, "--deploy", "serving", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["errors"] == 0
+    certs = doc["certificates"]
+    assert all(c["status"] == "row" for c in certs.values())
+
+    assert pplint.main([bad, "--deploy", "serving", "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert any(d["code"] == "cross-row-mix"
+               for d in doc["diagnostics"])
+    # generic context: no row contract asserted, the mix is legal
+    assert pplint.main([bad]) == 0
+    capsys.readouterr()
+    assert pplint.main([str(tmp_path / "nope")]) == 2
+    capsys.readouterr()
+
+
+def test_pplint_fail_on_warning(tmp_path, capsys):
+    pplint = _pplint()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = L.data(name="x", shape=[3], dtype="float64")
+        pred = L.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "warny")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe, main)
+    assert pplint.main([d, "--deploy", "generic"]) == 0  # warnings pass
+    capsys.readouterr()
+    assert pplint.main([d, "--deploy", "generic",
+                        "--fail-on", "warning"]) == 1
+    out = capsys.readouterr().out
+    assert "stray-fp64" in out
+    assert pplint.main([d, "--deploy", "generic", "--strict"]) == 1
+    capsys.readouterr()
+
+
+def test_pplint_all_models_tier1_budget(capsys):
+    """The tier-1 lint sweep (ROADMAP): every bundled model under every
+    applicable deployment context, green, inside the 15 s budget."""
+    pplint = _pplint()
+    t0 = time.monotonic()
+    rc = pplint.main(["--all-models"])
+    elapsed = time.monotonic() - t0
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert elapsed < 15.0, "all-models sweep took %.1fs" % elapsed
+
+
+def test_deployment_tier_latency_largest_model():
+    """Load-path acceptance: the deployment tier on the largest bundled
+    model in < 100 ms, so engine-load validation stays effectively free."""
+    from paddle_tpu.models import zoo
+    main, _ = zoo.build("transformer")
+    dep = DeploymentContext.generic()
+    best = min(_timed(analysis.analyze_deployment, main, dep)
+               for _ in range(3))
+    assert best < 0.1, "deployment tier took %.1f ms" % (best * 1e3)
+
+
+def _timed(fn, *args):
+    t0 = time.perf_counter()
+    fn(*args)
+    return time.perf_counter() - t0

@@ -2,73 +2,19 @@
 net_drawer, and the versioned desc serializer must handle every model
 family's program (full op vocabulary incl. sub-blocks, CRF, CTC,
 detection, beam decode) without error, and the desc must round-trip to an
-equal op list.
+equal op list. The zoo itself lives in paddle_tpu.models.zoo — the same
+registry tools/pplint.py --all-models sweeps.
 """
-import numpy as np
 import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.core import program_desc as _desc
+from paddle_tpu.models import zoo
 
 
-def _builders():
-    L = fluid.layers
-
-    def mnist():
-        from paddle_tpu.models import recognize_digits
-        recognize_digits.build(nn_type="conv")
-
-    def sentiment():
-        from paddle_tpu.models.understand_sentiment import stacked_lstm_net
-        data = L.data(name="words", shape=[1], dtype="int64", lod_level=1)
-        stacked_lstm_net(data, dict_dim=100, class_dim=2, emb_dim=16,
-                         hid_dim=16, stacked_num=3)
-
-    def seq2seq():
-        from paddle_tpu.models.machine_translation import build_train
-        build_train(dict_size=30, word_dim=8, hidden_dim=16,
-                    decoder_size=16)
-
-    def transformer():
-        from paddle_tpu.models import transformer as tfm
-        tfm.build_train(src_vocab_size=20, trg_vocab_size=20, max_length=8,
-                        n_layer=1, n_head=2, d_key=8, d_value=8, d_model=16,
-                        d_inner_hid=32)
-
-    def srl():
-        from paddle_tpu.models import label_semantic_roles
-        label_semantic_roles.build_train(
-            word_dict_len=50, label_dict_len=9, pred_dict_len=20,
-            word_dim=8, mark_dim=4, hidden_dim=16, depth=2, lr=0.03,
-            mix_hidden_lr=1.0)
-
-    def ctr():
-        from paddle_tpu.models import ctr as m
-        m.build(sparse_feature_dim=1000, embedding_size=8)
-
-    def word2vec():
-        from paddle_tpu.models import word2vec as m
-        m.build(dict_size=100, embed_size=8, hidden_size=16)
-
-    def recommender():
-        from paddle_tpu.models import recommender_system as m
-        m.build_train(emb_dim=8, fc_dim=16)
-
-    def language_model():
-        from paddle_tpu.models import language_model as m
-        m.build(vocab_size=120, emb_size=8, hidden_size=8, num_layers=2)
-
-    return {"mnist": mnist, "sentiment": sentiment, "seq2seq": seq2seq,
-            "transformer": transformer, "srl": srl, "ctr": ctr,
-            "word2vec": word2vec, "recommender": recommender,
-            "language_model": language_model}
-
-
-@pytest.mark.parametrize("name", sorted(_builders()))
+@pytest.mark.parametrize("name", zoo.names())
 def test_tooling_on_model_program(name, tmp_path):
-    main, startup = fluid.Program(), fluid.Program()
-    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
-        _builders()[name]()
+    main, startup = zoo.build(name)
 
     # 1. debugger printer (both modes)
     text = fluid.debuger.pprint_program_codes(main)

@@ -3,7 +3,9 @@
 
 Runs the paddle_tpu/analysis pass pipeline (use-before-def, shape/dtype
 consistency, unregistered ops, reader placement, feed/fetch carriers)
-over a SERIALIZED program, without executing it:
+over a SERIALIZED program, without executing it — plus, on request, the
+deployment tier (row-independence, sharding-consistency, dtype-flow,
+decode-invariants, donation-safety) under a deployment context:
 
     tools/pplint.py <model-dir>              # save_inference_model /
                                              # save_reference_model dir
@@ -13,7 +15,15 @@ over a SERIALIZED program, without executing it:
                                              # in the newest VALID snapshot
     tools/pplint.py <ckpt>/step_100          # one snapshot (its program
                                              # hash-verified before lint)
-    tools/pplint.py path --strict            # warnings also fail
+    tools/pplint.py dir --deploy serving     # + row-independence etc.
+                                             # under the serving context
+    tools/pplint.py dir --deploy decode --max-slots 8
+    tools/pplint.py dir --deploy training --plan plan.json
+    tools/pplint.py dir --json               # machine-readable findings
+    tools/pplint.py dir --fail-on warning    # CI severity threshold
+    tools/pplint.py --all-models             # sweep the bundled model
+                                             # zoo under every applicable
+                                             # context (the tier-1 leg)
 
 Accepted formats (auto-detected from the first bytes):
   * native versioned JSON desc (core/program_desc.py)        -> b'{'
@@ -23,8 +33,16 @@ Accepted formats (auto-detected from the first bytes):
     is parsed, then the parsed program goes through the full pipeline.
 
 Feed/fetch targets come from __model_meta__.json (native dirs) or the
-era feed/fetch plumbing ops (strip_feed_fetch). Exit codes: 0 clean,
-1 findings, 2 bad invocation / unreadable model.
+era feed/fetch plumbing ops (strip_feed_fetch).
+
+Exit codes:
+  0  no findings at or above the --fail-on threshold
+     (default threshold: error)
+  1  findings at/above the threshold (details on stdout; in --json
+     mode, as one JSON document)
+  2  bad invocation / unreadable or unverifiable model artifact
+
+--strict is kept as an alias for --fail-on warning.
 """
 import argparse
 import json
@@ -134,23 +152,152 @@ def load_program(path, model_filename=None, allow_pickle=False):
     return program, meta_feeds or feeds, meta_fetches or fetches, wire_diags
 
 
+def build_deploy_context(kind, program, feeds, fetches, plan_path=None,
+                         max_slots=8, weights_dtype=None):
+    """DeploymentContext for a SAVED program, mirroring what the engines
+    derive at load: serving classifies each fetch by the engine's row
+    policy (leading -1 = sliced rows), decode infers the slot vars from
+    the executor's own state analysis, training arms a saved plan JSON
+    through the device-free PlanView."""
+    from paddle_tpu import analysis
+    from paddle_tpu.core.utils import find_var
+    if kind == "serving":
+        row, whole = [], []
+        for n in fetches or ():
+            var = find_var(program, n)
+            shape = list(getattr(var, "shape", None) or []) \
+                if var is not None else []
+            if (var is not None and not var.persistable and shape
+                    and shape[0] == -1):
+                row.append(n)
+            else:
+                whole.append(n)
+        return analysis.DeploymentContext.for_serving(
+            row_fetches=row, whole_fetches=whole,
+            weights_dtype=weights_dtype)
+    if kind == "decode":
+        slots = analysis.infer_slot_vars(program, fetches, max_slots)
+        return analysis.DeploymentContext.for_decode(
+            slot_vars=slots, max_slots=max_slots,
+            row_fetches=list(fetches or ()))
+    if kind == "training":
+        plan = None
+        if plan_path:
+            with open(plan_path) as f:
+                plan = analysis.PlanView.from_json(json.load(f))
+        return analysis.DeploymentContext.for_training(plan=plan)
+    return analysis.DeploymentContext.generic()
+
+
+def _diag_json(d):
+    return {"severity": d.severity, "code": d.code, "message": d.message,
+            "block": d.block_idx, "op": d.op_idx, "op_type": d.op_type,
+            "vars": list(d.var_names), "hint": d.hint,
+            "callstack": [list(fr) for fr in d.callstack]}
+
+
+def _result_json(target, result):
+    return {"target": target,
+            "errors": len(result.errors),
+            "warnings": len(result.warnings),
+            "certificates": dict(result.certificates),
+            "diagnostics": [_diag_json(d) for d in result.diagnostics]}
+
+
+def _fails(result, fail_on):
+    return bool(result.errors
+                or (fail_on == "warning" and result.warnings))
+
+
+def _lint_all_models(args):
+    """Sweep the bundled model zoo: every model's training program under
+    the generic deployment context AND under an auto-built ShardingPlan
+    (1-device mesh — the plan/program coherence rules are device-count
+    independent). One process, <15s: this is the tier-1 CI leg."""
+    from paddle_tpu import analysis
+    from paddle_tpu.models import zoo
+    from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.parallel.plan import ShardingPlan
+
+    mesh = make_mesh({"dp": 1})
+    reports, bad = [], 0
+    for name in zoo.names():
+        main, _startup = zoo.build(name)
+        contexts = [("generic", analysis.DeploymentContext.generic())]
+        try:
+            plan = ShardingPlan.build(main, mesh, shard_update=True)
+            contexts.append(("training+plan",
+                             analysis.DeploymentContext.for_training(
+                                 plan=plan)))
+        except Exception as e:  # pragma: no cover - partitioner gap
+            print("pplint: %s: plan build failed (%s); generic only"
+                  % (name, e), file=sys.stderr)
+        for ckind, deploy in contexts:
+            result = analysis.analyze(main, deploy=deploy)
+            target = "%s[%s]" % (name, ckind)
+            reports.append((target, result))
+            if _fails(result, args.fail_on):
+                bad += 1
+    if args.json:
+        print(json.dumps({"models": [_result_json(t, r)
+                                     for t, r in reports]}, indent=2))
+    else:
+        for target, result in reports:
+            for d in result:
+                print("%s: %s" % (
+                    target, d.format(with_callstack=not args.no_callstack)))
+            print("pplint: %d error(s), %d warning(s) in %s"
+                  % (len(result.errors), len(result.warnings), target))
+    return 1 if bad else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="pplint", description="static verifier for saved programs")
-    ap.add_argument("path", help="model directory or program desc file")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="model directory or program desc file")
     ap.add_argument("--model-filename", default=None,
                     help="desc filename inside a model dir "
                          "(default __model__)")
     ap.add_argument("--steps", type=int, default=1,
                     help="validate for Executor.run(steps=K) semantics")
+    ap.add_argument("--deploy", default=None,
+                    choices=["serving", "decode", "training", "generic"],
+                    help="also run the deployment-pass tier under this "
+                         "context (row-independence, sharding, dtype "
+                         "flow, decode invariants, donation safety)")
+    ap.add_argument("--plan", default=None, metavar="PLAN_JSON",
+                    help="ShardingPlan JSON (plan.to_json()) to check "
+                         "the program against (--deploy training)")
+    ap.add_argument("--max-slots", type=int, default=8,
+                    help="decode slot count for --deploy decode")
+    ap.add_argument("--weights-dtype", default=None,
+                    choices=["fp32", "bf16", "int8"],
+                    help="serving weights dtype the deployment expects")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as one JSON document on stdout")
+    ap.add_argument("--fail-on", default="error",
+                    choices=["error", "warning"],
+                    help="lowest severity that makes the exit code 1 "
+                         "(default: error)")
+    ap.add_argument("--all-models", action="store_true",
+                    help="lint every bundled model zoo program under "
+                         "all applicable deployment contexts")
     ap.add_argument("--strict", action="store_true",
-                    help="exit non-zero on warnings too")
+                    help="alias for --fail-on warning")
     ap.add_argument("--no-callstack", action="store_true",
                     help="omit op creation stacks from output")
     ap.add_argument("--allow-pickle", action="store_true",
                     help="permit loading round-1 legacy pickle descs "
                          "(unpickling executes code — trusted files only)")
     args = ap.parse_args(argv)
+    if args.strict:
+        args.fail_on = "warning"
+
+    if args.all_models:
+        return _lint_all_models(args)
+    if args.path is None:
+        ap.error("need a model path (or --all-models)")
 
     try:
         program, feeds, fetches, wire_diags = load_program(
@@ -167,17 +314,30 @@ def main(argv=None):
         # are the explanation — report them instead of a bare load error
         result = analysis.AnalysisResult(wire_diags)
     else:
+        deploy = None
+        if args.deploy:
+            try:
+                deploy = build_deploy_context(
+                    args.deploy, program, feeds, fetches,
+                    plan_path=args.plan, max_slots=args.max_slots,
+                    weights_dtype=args.weights_dtype)
+            except Exception as e:
+                print("pplint: cannot build %s deployment context: %s"
+                      % (args.deploy, e), file=sys.stderr)
+                return 2
         result = analysis.analyze(program, feed_names=feeds,
-                                  fetch_names=fetches, steps=args.steps)
+                                  fetch_names=fetches, steps=args.steps,
+                                  deploy=deploy)
         result.diagnostics[:0] = wire_diags  # wire findings lead, in order
 
-    for d in result:
-        print(d.format(with_callstack=not args.no_callstack))
-    print("pplint: %d error(s), %d warning(s) in %s"
-          % (len(result.errors), len(result.warnings), args.path))
-    if result.errors or (args.strict and result.warnings):
-        return 1
-    return 0
+    if args.json:
+        print(json.dumps(_result_json(args.path, result), indent=2))
+    else:
+        for d in result:
+            print(d.format(with_callstack=not args.no_callstack))
+        print("pplint: %d error(s), %d warning(s) in %s"
+              % (len(result.errors), len(result.warnings), args.path))
+    return 1 if _fails(result, args.fail_on) else 0
 
 
 if __name__ == "__main__":
