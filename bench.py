@@ -16,6 +16,15 @@ import time
 import numpy as np
 
 
+def _emit(rec):
+    """Every record line — success AND error placeholder — goes out
+    through here: schema-checked against paddle_tpu.benchd.schema (the
+    store/gate contract, ARCHITECTURE.md §28) so a malformed leg is a
+    loud tier-1 failure, not a silently unreadable store entry."""
+    from paddle_tpu.benchd.schema import check_record
+    print(json.dumps(check_record(rec)))
+
+
 def _error_line(msg):
     """The one-JSON-line error payload, with the SAME metric/unit mapping
     as the success paths so downstream aggregators keyed on metric names
@@ -109,7 +118,7 @@ def _await_devices(timeout_s):
             out["error"] = repr(e)
 
     def fail(msg):
-        print(json.dumps(_error_line(msg)))
+        _emit(_error_line(msg))
         sys.stdout.flush()
         # skip atexit: jax teardown can block on the same wedged runtime
         os._exit(3)
@@ -258,7 +267,7 @@ def bench_transformer():
     flops_per_token = 72.0 * n_layer * d_model ** 2 \
         + 12.0 * n_layer * seq * d_model \
         + 6.0 * d_model * vocab
-    print(json.dumps({
+    _emit({
         "metric": "transformer_train_throughput",
         "value": round(tps, 1), "unit": "tokens/sec/chip",
         "vs_baseline": None, "batch": batch, "seq": seq,
@@ -268,7 +277,7 @@ def bench_transformer():
         "device": str(jax.devices()[0]),
         "mfu": _mfu(tps * flops_per_token),
         "peak_tflops": _peak_tflops(),
-        "loss": float(loss.reshape(-1)[0])}))
+        "loss": float(loss.reshape(-1)[0])})
 
 
 def bench_transformer_decode():
@@ -323,12 +332,12 @@ def bench_transformer_decode():
     # tokens are not output, so counting them would inflate tokens/sec
     # (ADVICE r4 #4); beam is in the JSON for FLOP reconstruction.
     tps = batch * (seq - 1) * steps / dt
-    print(json.dumps({
+    _emit({
         "metric": "transformer_cached_decode_throughput",
         "value": round(tps, 1), "unit": "emitted tokens/sec/chip",
         "vs_baseline": None, "batch": batch, "beam": beam, "seq": seq,
         "layers": n_layer, "d_model": d_model,
-        "device": str(jax.devices()[0])}))
+        "device": str(jax.devices()[0])})
 
 
 def bench_stacked_lstm():
@@ -396,7 +405,7 @@ def bench_stacked_lstm():
     # hidden == hid and overcounted MFU ~6x.)
     h = hid // 4
     flops_per_token = 3 * (40.0 * h * h + (stacked - 1) * 48.0 * h * h)
-    print(json.dumps({
+    _emit({
         "metric": "stacked_lstm_train_throughput",
         "value": round(tps, 1), "unit": "tokens/sec/chip",
         "vs_baseline": None, "batch": batch, "seq": seq,
@@ -405,7 +414,7 @@ def bench_stacked_lstm():
         "device": str(jax.devices()[0]),
         "mfu": _mfu(tps * flops_per_token),
         "peak_tflops": _peak_tflops(),
-        "loss": float(loss.reshape(-1)[0])}))
+        "loss": float(loss.reshape(-1)[0])})
 
 
 def _lat_ms(latencies, q):
@@ -525,9 +534,9 @@ def bench_serving():
     closed_dt = time.perf_counter() - t0
     if client_errors:
         engine.close(drain=False)
-        print(json.dumps(_error_line(
+        _emit(_error_line(
             "serving closed loop: %d client(s) failed: %s"
-            % (len(client_errors), "; ".join(client_errors[:3])))))
+            % (len(client_errors), "; ".join(client_errors[:3]))))
         sys.stdout.flush()
         os._exit(2)
     closed_qps = (per_client * n_clients) / closed_dt
@@ -550,9 +559,9 @@ def bench_serving():
             open_lat.append(time.perf_counter() - ts)
     except Exception as e:  # noqa: BLE001 - reported as leg failure
         engine.close(drain=False)
-        print(json.dumps(_error_line(
+        _emit(_error_line(
             "serving open loop failed after %d/%d results: %r"
-            % (len(open_lat), n_requests, e))))
+            % (len(open_lat), n_requests, e)))
         sys.stdout.flush()
         os._exit(2)
     open_dt = time.perf_counter() - t0
@@ -560,7 +569,7 @@ def bench_serving():
 
     snap = engine.metrics.snapshot()
     engine.close()
-    print(json.dumps({
+    _emit({
         "metric": "serving_throughput",
         "value": round(closed_qps, 1),
         "unit": "requests/sec/chip",
@@ -579,7 +588,7 @@ def bench_serving():
         "open_p50_ms": _lat_ms(open_lat, 0.50),
         "open_p95_ms": _lat_ms(open_lat, 0.95),
         "open_p99_ms": _lat_ms(open_lat, 0.99),
-        "device": str(jax.devices()[0])}))
+        "device": str(jax.devices()[0])})
 
 
 def bench_decode():
@@ -663,9 +672,9 @@ def bench_decode():
             serial_out.append(np.asarray(
                 solo.decode(f, max_new_tokens=budget)).reshape(-1))
     except Exception as e:  # noqa: BLE001 - reported as leg failure
-        print(json.dumps(_error_line(
+        _emit(_error_line(
             "decode serial baseline failed after %d/%d streams: %r"
-            % (len(serial_out), n_streams, e))))
+            % (len(serial_out), n_streams, e)))
         sys.stdout.flush()
         os._exit(2)
     serial_dt = time.perf_counter() - t0
@@ -692,9 +701,9 @@ def bench_decode():
             cont_out.append(np.asarray(s.result(300)).reshape(-1))
     except Exception as e:  # noqa: BLE001 - reported as leg failure
         engine.close(drain=False)
-        print(json.dumps(_error_line(
+        _emit(_error_line(
             "decode open loop failed after %d/%d streams: %r"
-            % (len(cont_out), n_streams, e))))
+            % (len(cont_out), n_streams, e)))
         sys.stdout.flush()
         os._exit(2)
     cont_dt = time.perf_counter() - t0
@@ -706,14 +715,14 @@ def bench_decode():
                   if a.shape != b.shape or not np.array_equal(a, b)]
     divergence = len(mismatched) / float(n_streams)
     if mismatched:  # the per-stream bit-exactness contract is the POINT
-        print(json.dumps(_error_line(
+        _emit(_error_line(
             "continuous decode diverged from solo on %d/%d streams "
             "(first: stream %d)" % (len(mismatched), n_streams,
-                                    mismatched[0]))))
+                                    mismatched[0])))
         sys.stdout.flush()
         os._exit(2)
 
-    print(json.dumps({
+    _emit({
         "metric": "decode_continuous_tokens_per_sec",
         "value": round(cont_tokens / cont_dt, 1),
         "unit": "tokens/sec/chip",
@@ -729,7 +738,7 @@ def bench_decode():
         "inter_token_p99_ms": stats["inter_token_p99_ms"],
         "iterations": stats["iterations"],
         "layers": n_layers, "hidden": hidden, "vocab": vocab,
-        "device": str(jax.devices()[0])}))
+        "device": str(jax.devices()[0])})
 
 
 def bench_pipeline():
@@ -874,7 +883,7 @@ def bench_pipeline():
         serving_div = max(ser_div, pipe_div)
     except Exception as e:  # noqa: BLE001 — one JSON error line
         shutil.rmtree(model_dir, ignore_errors=True)
-        print(json.dumps(_error_line("serving leg failed: %r" % (e,))))
+        _emit(_error_line("serving leg failed: %r" % (e,)))
         sys.stdout.flush()
         os._exit(2)
     shutil.rmtree(model_dir, ignore_errors=True)
@@ -976,12 +985,12 @@ def bench_pipeline():
             for k in ser_params)
     except Exception as e:  # noqa: BLE001 — one JSON error line
         shutil.rmtree(tdir, ignore_errors=True)
-        print(json.dumps(_error_line("training leg failed: %r" % (e,))))
+        _emit(_error_line("training leg failed: %r" % (e,)))
         sys.stdout.flush()
         os._exit(2)
     shutil.rmtree(tdir, ignore_errors=True)
 
-    print(json.dumps({
+    _emit({
         "metric": "pipeline_dispatch_open_qps",
         "value": round(pipe_qps, 1),
         "unit": "requests/sec/chip",
@@ -1002,7 +1011,7 @@ def bench_pipeline():
         "train_prefetch_steps_s": round(pre_sps, 2),
         "train_speedup": round(pre_sps / ser_sps, 3),
         "train_divergence": train_div,
-        "device": str(jax.devices()[0])}))
+        "device": str(jax.devices()[0])})
 
 
 def bench_obs():
@@ -1099,7 +1108,7 @@ def bench_obs():
             spans_recorded = len(trace.dump()["events"])
     except Exception as e:  # noqa: BLE001 — one JSON error line
         trace.set_enabled(True)
-        print(json.dumps(_error_line("training leg failed: %r" % (e,))))
+        _emit(_error_line("training leg failed: %r" % (e,)))
         sys.stdout.flush()
         os._exit(2)
 
@@ -1169,7 +1178,7 @@ def bench_obs():
     except Exception as e:  # noqa: BLE001 — one JSON error line
         trace.set_enabled(True)
         shutil.rmtree(model_dir, ignore_errors=True)
-        print(json.dumps(_error_line("serving leg failed: %r" % (e,))))
+        _emit(_error_line("serving leg failed: %r" % (e,)))
         sys.stdout.flush()
         os._exit(2)
     shutil.rmtree(model_dir, ignore_errors=True)
@@ -1178,7 +1187,7 @@ def bench_obs():
     train_overhead = (train_sps[False] - train_sps[True]) \
         / max(train_sps[False], 1e-9)
     serving_overhead = (p99[True] - p99[False]) / max(p99[False], 1e-9)
-    print(json.dumps({
+    _emit({
         "metric": "observability_overhead",
         "value": round(train_sps[True], 2),
         "unit": "steps/sec/chip",
@@ -1194,7 +1203,7 @@ def bench_obs():
         "serving_overhead": round(serving_overhead, 4),
         "spans_recorded": spans_recorded,
         "sync_on_dispatch": snap["sync_stats"]["on_dispatch_path"],
-        "device": str(jax.devices()[0])}))
+        "device": str(jax.devices()[0])})
 
 
 def bench_pool():
@@ -1339,7 +1348,7 @@ def bench_pool():
 
     shutil.rmtree(model_dir, ignore_errors=True)
     headline = legs[str(replica_counts[-1])]
-    print(json.dumps({
+    _emit({
         "metric": "serving_pool_throughput",
         "value": headline["qps"],
         "unit": "requests/sec/chip",
@@ -1350,7 +1359,7 @@ def bench_pool():
         "layers": n_layers, "hidden": hidden,
         "legs": legs,
         "total_errors": sum(l["errors"] for l in legs.values()),
-        "device": str(jax.devices()[0])}))
+        "device": str(jax.devices()[0])})
 
 
 def bench_fleet():
@@ -1497,7 +1506,7 @@ def bench_fleet():
     auto_pool.close()
     shutil.rmtree(model_dir, ignore_errors=True)
 
-    print(json.dumps({
+    _emit({
         "metric": "serving_fleet_autoscale_qps",
         "value": legs["autoscaled"]["qps"],
         "unit": "requests/sec/chip",
@@ -1507,7 +1516,7 @@ def bench_fleet():
         "layers": n_layers, "hidden": hidden,
         "legs": legs,
         "total_errors": sum(l["errors"] for l in legs.values()),
-        "device": str(jax.devices()[0])}))
+        "device": str(jax.devices()[0])})
 
 
 # fwd FLOPs per 224x224 image (2x the usual MACs figure — VGG16's famous
@@ -1624,7 +1633,7 @@ def bench_ckpt():
         }
         shutil.rmtree(ckdir, ignore_errors=True)
 
-    print(json.dumps({
+    _emit({
         "metric": "ckpt_async_steps_per_sec",
         "value": results["async"]["steps_per_sec"],
         "unit": "steps/sec",
@@ -1632,7 +1641,7 @@ def bench_ckpt():
         "batch": batch, "dim": dim, "steps": steps, "every": every,
         "modes": results,
         "device": str(jax.devices()[0]),
-    }))
+    })
 
 
 def bench_sharded():
@@ -1658,10 +1667,10 @@ def bench_sharded():
     n = int(os.environ.get("BENCH_SHARDED_DEVICES",
                            str(len(jax.devices()))))
     if n < 2:
-        print(json.dumps(_error_line(
+        _emit(_error_line(
             "BENCH_SHARDED needs a multi-device mesh (%d visible); on "
             "CPU run under XLA_FLAGS=--xla_force_host_platform_device_"
-            "count=N" % n)))
+            "count=N" % n))
         sys.stdout.flush()
         os._exit(2)
     batch = int(os.environ.get("BENCH_BATCH", "64"))
@@ -1738,7 +1747,7 @@ def bench_sharded():
                      zip(losses["replicated"], losses["sharded"]))
     upd_r = mem["replicated"]["update_state"]["per_chip_bytes"]
     upd_s = mem["sharded"]["update_state"]["per_chip_bytes"]
-    print(json.dumps({
+    _emit({
         "metric": "sharded_update_steps_per_sec",
         "value": results["sharded"],
         "unit": "steps/sec",
@@ -1755,7 +1764,7 @@ def bench_sharded():
         "fetch_divergence": divergence,
         "final_loss": losses["sharded"][-1],
         "device": str(jax.devices()[0]),
-    }))
+    })
 
 
 def bench_tp():
@@ -1785,10 +1794,10 @@ def bench_tp():
         legs_cfg = [1] + legs_cfg  # mesh-1 is the divergence baseline
     need = max(legs_cfg)
     if len(jax.devices()) < need:
-        print(json.dumps(_error_line(
+        _emit(_error_line(
             "BENCH_TP legs %r need %d devices (%d visible); on CPU run "
             "under XLA_FLAGS=--xla_force_host_platform_device_count=N"
-            % (legs_cfg, need, len(jax.devices())))))
+            % (legs_cfg, need, len(jax.devices()))))
         sys.stdout.flush()
         os._exit(2)
     batch = int(os.environ.get("BENCH_BATCH", "64"))
@@ -1856,7 +1865,7 @@ def bench_tp():
                      default=0.0)
     tp_max = max(legs_cfg)
     par_1 = mem[1]["params"]["replicated_per_chip_bytes"]
-    print(json.dumps({
+    _emit({
         "metric": "tp_train_steps_per_sec",
         "value": results[tp_max],
         "unit": "steps/sec",
@@ -1872,7 +1881,7 @@ def bench_tp():
         "final_loss": losses[tp_max][-1],
         "tp_placement": "gather",
         "device": str(jax.devices()[0]),
-    }))
+    })
 
 
 def bench_resil():
@@ -1990,7 +1999,7 @@ def bench_resil():
     def overhead(off, on):
         return round((off / on - 1.0) * 100.0, 2)
 
-    print(json.dumps({
+    _emit({
         "metric": "resil_guarded_steps_per_sec",
         "value": round(plain_on, 2),
         "unit": "steps/sec",
@@ -2004,7 +2013,7 @@ def bench_resil():
         "overhead_pct_plain": overhead(plain_off, plain_on),
         "overhead_pct_multistep": overhead(multi_off, multi_on),
         "device": str(jax.devices()[0]),
-    }))
+    })
 
 
 def _ccache_build_trainer(fluid, dim, layers):
@@ -2174,14 +2183,16 @@ def bench_compile_cache():
             cold = run_child(kind)
             warm = run_child(kind)
             speedup = (cold[field] / warm[field]) if warm[field] else None
-            print(json.dumps({
+            _emit({
                 "metric": metric,
-                "value": round(speedup, 2) if speedup else None,
+                # value must be a number (benchd schema); a zero warm
+                # time (speedup indeterminate) reports 0.0, never None
+                "value": round(speedup, 2) if speedup else 0.0,
                 "unit": "x cold/warm %s" % field,
                 "vs_baseline": None,
                 "cold": cold, "warm": warm,
                 "warm_recompiles": warm["stores"],
-            }))
+            })
             sys.stdout.flush()
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
@@ -2405,7 +2416,7 @@ def bench_kernels():
         raise RuntimeError(
             "TPU speed gate: no fused op beat its unfused path by %.2fx "
             "(best %.2fx)" % (min_speedup, max(speedups)))
-    print(json.dumps({
+    _emit({
         "metric": "kernel_floor_speedup",
         "value": round(geomean, 3), "unit": "x fused/unfused",
         "vs_baseline": None,
@@ -2416,7 +2427,7 @@ def bench_kernels():
         "per_op": per_op,
         "tuned_vs_default": tuned,
         "quantized": quant,
-        "dims": {"seq": t, "vocab": vocab, "dim": d, "batch": batch}}))
+        "dims": {"seq": t, "vocab": vocab, "dim": d, "batch": batch}})
 
 
 def main():
@@ -2435,7 +2446,7 @@ def main():
         try:
             bench_compile_cache()
         except Exception as e:  # noqa: BLE001 — one JSON error line
-            print(json.dumps(_error_line(repr(e))))
+            _emit(_error_line(repr(e)))
             sys.stdout.flush()
             sys.exit(3)
         return
@@ -2450,7 +2461,7 @@ def main():
             tpu_guard.acquire_tpu_lock(timeout=float(
                 os.environ.get("PTPU_LOCK_TIMEOUT", "3600")))
         except tpu_guard.TPULockTimeout as e:
-            print(json.dumps(_error_line(str(e))))
+            _emit(_error_line(str(e)))
             sys.stdout.flush()
             os._exit(4)
     # Persistent executable cache: repeat configs (sweep re-runs, the
@@ -2470,8 +2481,8 @@ def main():
     # Loud-failure rule: never emit CPU numbers dressed up as TPU data
     # (axon init failure falls back to CPU silently otherwise).
     if tpu_guard.accelerator_missing():
-        print(json.dumps(_error_line(
-            "accelerator expected but only CPU devices initialized")))
+        _emit(_error_line(
+            "accelerator expected but only CPU devices initialized"))
         sys.stdout.flush()
         os._exit(3)
     if os.environ.get("BENCH_SERVING") == "1":
@@ -2505,7 +2516,7 @@ def main():
         try:
             bench_kernels()
         except Exception as e:  # noqa: BLE001 — one JSON error line
-            print(json.dumps(_error_line("kernels leg failed: %r" % (e,))))
+            _emit(_error_line("kernels leg failed: %r" % (e,)))
             sys.stdout.flush()
             os._exit(2)
         return
@@ -2599,10 +2610,10 @@ def main():
         # crediting K steps of throughput to 1/K of the staging work.
         # (The in-graph-reader path measures the pipeline under the
         # loop honestly; bench.py doesn't build one yet.)
-        print(json.dumps(_error_line(
+        _emit(_error_line(
             "BENCH_MULTISTEP>1 with BENCH_FEED=%s would replay one "
             "staged batch per K-step block and overstate pipeline "
-            "throughput; use BENCH_FEED=device" % feed_mode)))
+            "throughput; use BENCH_FEED=device" % feed_mode))
         sys.stdout.flush()
         os._exit(2)
     outer, total_steps = _step_plan(steps, multistep)
@@ -2656,7 +2667,7 @@ def main():
     if not headline:
         rec["image_hw"] = hw
         rec["class_dim"] = class_dim
-    print(json.dumps(rec))
+    _emit(rec)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,57 @@
+"""paddle_tpu.benchd — autonomous hardware-bench daemon, bench store and
+perf-regression gate (ARCHITECTURE.md §28, ROADMAP item 5).
+
+Hardware benching used to be a manually-queued event: sweep scripts
+(`tools/perf_sweep_r*.sh`) + a NEXT_SWEEP pointer waiting for a human to
+notice a healthy tunnel window, and BENCH_* numbers that nothing could
+regress against.  This package makes measurement a runtime-owned product
+feature (the TensorFlow-system-paper framing — the runtime, not the
+user, owns measurement decisions; arXiv:1605.08695) with TVM-lesson
+records: *measured* values, never modeled guesses (arXiv:1802.04799):
+
+  * `schema`  — the ONE bench record schema (metric/value/unit/error)
+                every bench.py leg's success and error lines validate
+                against, and the store/gate read.
+  * `store`   — `BenchStore`: append-only JSONL keyed by
+                (metric, device_kind, config digest), `last_good()`
+                baseline resolution that skips `"error"` records (the
+                rule BENCH_LOG.md documents, now implemented), and
+                first-open backfill of the committed BENCH_r*.json /
+                BENCH_LOG.md lines.
+  * `tiers`   — the sweep queue (perf_sweep_r4b/r4c/r5/r6 + NEXT_SWEEP)
+                as one declarative registry with per-tier done markers
+                so an interrupted sweep resumes instead of restarting.
+  * `probe`   — device-health probe with a hard timeout and
+                wedged-vs-healthy classification (env-injectable fake
+                for hardware-free tests).
+  * `daemon`  — `BenchDaemon`: resident probe loop that, on the first
+                healthy window, takes the tpu_guard window lock, drains
+                queued tiers cheapest-first, commits JSON lines to the
+                store and appends BENCH_LOG.md autonomously; publishes
+                `ptpu_bench_*` gauges through the observability
+                registry and wraps every sweep in a flight-recorder
+                span.
+  * `gate`    — the perf-regression gate: fresh lines vs
+                last-good-hardware baselines with per-metric relative
+                noise bands and min-of-repeats, so perf regressions
+                fail CI the way correctness does.
+
+CLI: `tools/ptpu_bench.py` (run / gate / daemon / status).
+"""
+from .schema import (RECORD_KEYS, check_record, config_digest,
+                     device_kind, is_error, validate_record)
+from .store import BenchStore
+from .tiers import SWEEP_TIERS, SweepQueue, Tier
+from .probe import ProbeResult, probe_device
+from .gate import run_gate
+from .daemon import BenchDaemon
+
+__all__ = [
+    "RECORD_KEYS", "validate_record", "check_record", "is_error",
+    "config_digest", "device_kind",
+    "BenchStore",
+    "Tier", "SWEEP_TIERS", "SweepQueue",
+    "ProbeResult", "probe_device",
+    "run_gate",
+    "BenchDaemon",
+]

@@ -1,0 +1,317 @@
+"""The resident bench daemon: probe → window lock → drain → commit
+(ARCHITECTURE.md §28).
+
+This replaces the probe_loop_r5.sh + NEXT_SWEEP + perf_sweep_r*.sh
+relay with one loop that owns the whole protocol:
+
+  1. PROBE  device health in a hard-deadlined subprocess (probe.py);
+     a wedged probe is a wedged tunnel — sleep, never queue behind it.
+  2. LOCK   on the first healthy window, take the exclusive client
+     window lock (tpu_guard.acquire_window_lock — stale dead-pid
+     holders are broken, live holders honored with a short timeout).
+  3. DRAIN  queued sweep tiers cheapest-first (tiers.SweepQueue);
+     each run is a subprocess with the tier's own hard budget; done
+     markers mean a daemon killed mid-drain resumes at the first
+     unmeasured tier next window.
+  4. COMMIT every banked JSON line into the BenchStore AND append the
+     human entry to BENCH_LOG.md (same `- <ts> \\`ENV..\\`` shape the
+     shell sweeps wrote, so the log stays grep-stable) — the log-
+     keeping the workflow docs used to assign to whoever ran the sweep.
+
+A mid-drain "device init" failure re-classifies the window as wedged:
+the drain stops, un-done tiers stay queued, and the loop goes back to
+probing.  The daemon process itself NEVER initializes jax — every
+device touch happens in a child with a kill deadline, so the daemon
+survives any tunnel state.
+
+Observability: `ptpu_bench_*` gauges through the PR-12 registry
+(probe counts, window health, queue depth, banked/failed runs, store
+size, last-good values), each sweep wrapped in a flight-recorder span
+(`benchd.window` / `benchd.sweep`).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+from paddle_tpu import tpu_guard
+from paddle_tpu.observability import trace
+from paddle_tpu.observability.registry import REGISTRY
+
+from . import schema
+from .probe import probe_device
+from .store import BenchStore
+from .tiers import SweepQueue
+
+__all__ = ["BenchDaemon"]
+
+_STATUS = "status.json"
+
+
+def _iso_z(ts=None):
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                         time.gmtime(time.time() if ts is None else ts))
+
+
+class BenchDaemon(object):
+    """One resident bencher.  Tests inject `runner(tier) -> (rc,
+    last_line)` and a fake probe (probe.FAKE_PROBE_ENV); production
+    uses the subprocess runner below and the real probe."""
+
+    def __init__(self, repo_root=None, store=None, tiers=None,
+                 state_dir=None, probe_timeout_s=120, interval_s=1200,
+                 lock_timeout_s=30.0, lockfile=None, bench_log=None,
+                 runner=None, git_bank=False):
+        self.repo_root = os.path.abspath(
+            repo_root if repo_root is not None
+            else os.path.join(os.path.dirname(__file__), "..", ".."))
+        root = state_dir if state_dir is not None \
+            else os.path.join(self.repo_root, "bench_store")
+        self.state_dir = os.path.abspath(str(root))
+        os.makedirs(self.state_dir, exist_ok=True)
+        self.store = store if store is not None else BenchStore(
+            self.state_dir, repo_root=self.repo_root)
+        self.queue = SweepQueue(
+            os.path.join(self.state_dir, "sweep_state"), tiers=tiers)
+        self.probe_timeout_s = probe_timeout_s
+        self.interval_s = interval_s
+        self.lock_timeout_s = lock_timeout_s
+        self.lockfile = lockfile or tpu_guard.LOCKFILE
+        self.bench_log = bench_log or os.path.join(self.repo_root,
+                                                   "BENCH_LOG.md")
+        self._runner = runner or self._subprocess_runner
+        self.git_bank = git_bank
+        # counters behind the ptpu_bench_* gauge families
+        self.counts = {"probes": {"healthy": 0, "wedged": 0, "down": 0},
+                       "windows": 0, "lock_busy": 0,
+                       "runs_banked": 0, "runs_failed": 0}
+        self.last_probe = None
+        self.window_open = False
+        self._collector = self._make_collector()
+        REGISTRY.register_collector(self._collector)
+
+    # --------------------------------------------------------- lifecycle --
+    def close(self):
+        """Unregister the metrics collector (a daemon's gauges must not
+        outlive it — the watch_cluster rule)."""
+        if self._collector is not None:
+            REGISTRY.unregister_collector(self._collector)
+            self._collector = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------- loop --
+    def run_once(self):
+        """One cycle: probe; on healthy, lock + drain.  Returns the
+        cycle summary (also persisted to status.json for `ptpu_bench
+        status`)."""
+        result = probe_device(timeout_s=self.probe_timeout_s)
+        self.last_probe = result
+        self.counts["probes"][result.status] = \
+            self.counts["probes"].get(result.status, 0) + 1
+        cycle = {"ts": time.time(), "probe": result.describe(),
+                 "window": None}
+        if result.healthy:
+            cycle["window"] = self._window()
+        self._persist_status(cycle)
+        return cycle
+
+    def run_forever(self, max_cycles=None, sleep_fn=time.sleep):
+        cycles = 0
+        while True:
+            cycle = self.run_once()
+            cycles += 1
+            if max_cycles is not None and cycles >= max_cycles:
+                return cycle
+            if not self.queue.pending():
+                return cycle   # everything measured: the daemon's done
+            sleep_fn(self.interval_s)
+
+    # ----------------------------------------------------------- window --
+    def _window(self):
+        """Healthy probe: take the window lock and drain the queue."""
+        lock = tpu_guard.acquire_window_lock(
+            self.lockfile, timeout=self.lock_timeout_s, owner="benchd")
+        if lock is None:
+            self.counts["lock_busy"] += 1
+            return {"state": "lock-busy",
+                    "detail": "live client holds %s" % self.lockfile}
+        self.counts["windows"] += 1
+        self.window_open = True
+        try:
+            with lock, trace.span("benchd.window", cat="benchd",
+                                  pending=len(self.queue.pending())):
+                return self._drain()
+        finally:
+            self.window_open = False
+
+    def _drain(self):
+        ran, banked, failed = [], [], []
+        wedged = False
+        for tier in self.queue.pending():
+            with trace.span("benchd.sweep", cat="benchd",
+                            tier=tier.name, kind=tier.kind):
+                rc, last_line = self._runner(tier)
+            ran.append(tier.name)
+            rec = self._parse_record(last_line)
+            if rc == 0 and rec is not None and not schema.is_error(rec):
+                env = self.store.append(rec, source="daemon:%s"
+                                        % tier.name)
+                self._log_banked(tier, rec)
+                self.queue.mark_done(tier, {"seq": env["seq"],
+                                            "rc": rc})
+                self.counts["runs_banked"] += 1
+                banked.append(tier.name)
+                if self.git_bank:
+                    self._git_bank(tier)
+                continue
+            # failure: the tier stays QUEUED (no done marker) so the
+            # next window retries it
+            err = (rec or {}).get("error") or ("rc=%s" % rc)
+            self._log_failed(tier, rc, err)
+            self.counts["runs_failed"] += 1
+            failed.append({"tier": tier.name, "rc": rc,
+                           "error": str(err)[:200]})
+            if "device init" in str(err):
+                # the tunnel wedged mid-window: stop burning budget on
+                # runs that will all hang — back to probing
+                wedged = True
+                break
+        return {"state": "wedged" if wedged else "drained",
+                "ran": ran, "banked": banked, "failed": failed,
+                "pending_after": [t.name for t in self.queue.pending()]}
+
+    # ----------------------------------------------------------- runner --
+    def _subprocess_runner(self, tier):
+        """Production runner: the tier as a child process under its own
+        hard budget, stdout's final line as the candidate record (the
+        bench.py contract).  The child inherits the held window lock
+        via PTPU_LOCK_HELD (the tools/tpu_lock.sh protocol)."""
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)    # children dial the device
+        env["PTPU_LOCK_HELD"] = "1"
+        env.setdefault("BENCH_DEVICE_TIMEOUT", "300")
+        if tier.kind == "tune":
+            argv = [sys.executable,
+                    os.path.join(self.repo_root, "tools", "ptpu_tune.py")
+                    ] + tier.argv
+        else:
+            env.update(tier.env)
+            argv = [sys.executable,
+                    os.path.join(self.repo_root, "bench.py")]
+        try:
+            proc = subprocess.run(argv, env=env, cwd=self.repo_root,
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.DEVNULL,
+                                  timeout=tier.timeout_s)
+        except subprocess.TimeoutExpired:
+            return (124, json.dumps({
+                "metric": "unknown", "value": 0.0, "unit": "none",
+                "error": "tier %s exceeded %ds budget (killed)"
+                         % (tier.name, tier.timeout_s)}))
+        lines = [l for l in proc.stdout.decode(
+            "utf-8", "replace").splitlines() if l.strip()]
+        return (proc.returncode, lines[-1] if lines else "")
+
+    @staticmethod
+    def _parse_record(last_line):
+        try:
+            rec = json.loads(last_line)
+        except (TypeError, ValueError):
+            return None
+        return rec if not schema.validate_record(rec) else None
+
+    # -------------------------------------------------------- bench log --
+    def _log_banked(self, tier, rec):
+        """Append the classic two-line BENCH_LOG.md entry the shell
+        sweeps wrote: `- <ts> \\`ENV..\\`` then the indented record."""
+        with open(self.bench_log, "a") as f:
+            f.write("- %s `%s`\n  `%s`\n"
+                    % (_iso_z(), tier.env_summary(), json.dumps(rec)))
+
+    def _log_failed(self, tier, rc, err):
+        with open(self.bench_log, "a") as f:
+            f.write("- %s FAILED(rc=%s, err=%s): %s\n"
+                    % (_iso_z(), rc, str(err)[:160],
+                       tier.env_summary()))
+
+    def _git_bank(self, tier):
+        """Commit the banked line immediately (the r6 bank-per-line
+        rule: a wedge mid-sweep must not lose measured lines). Off by
+        default; the CLI daemon opts in."""
+        try:
+            subprocess.run(["git", "add", "BENCH_LOG.md"],
+                           cwd=self.repo_root, check=True,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+            subprocess.run(["git", "commit", "-q", "-m",
+                            "bench: bank %s" % tier.name],
+                           cwd=self.repo_root, check=True,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+        except (subprocess.CalledProcessError, OSError):
+            pass  # banking is best-effort; the store line already landed
+
+    # ----------------------------------------------------------- status --
+    def _persist_status(self, cycle):
+        status = {"cycle": cycle, "counts": self.counts,
+                  "queue": self.queue.describe(),
+                  "pid": os.getpid()}
+        tmp = os.path.join(self.state_dir,
+                           _STATUS + ".tmp.%d" % os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(status, f, indent=1, default=str)
+        os.replace(tmp, os.path.join(self.state_dir, _STATUS))
+
+    # ------------------------------------------------------------ gauges --
+    def _make_collector(self):
+        def collect():
+            c = self.counts
+            probe_samples = [({"status": s}, float(n))
+                             for s, n in sorted(c["probes"].items())]
+            summ = self.store.summary()
+            lg_samples = []
+            for (metric, dk), slot in sorted(summ["keys"].items()):
+                lg = slot["last_good"]
+                if lg is not None and dk != "cpu":
+                    lg_samples.append((
+                        {"metric": str(metric), "device_kind": str(dk)},
+                        float(lg["record"]["value"])))
+            return [
+                ("ptpu_bench_window_healthy", "gauge",
+                 "1 while a bench hardware window is open",
+                 [({}, 1.0 if self.window_open else 0.0)]),
+                ("ptpu_bench_probes_total", "counter",
+                 "device health probes by outcome", probe_samples),
+                ("ptpu_bench_windows_total", "counter",
+                 "hardware windows opened (lock taken)",
+                 [({}, float(c["windows"]))]),
+                ("ptpu_bench_lock_busy_total", "counter",
+                 "healthy probes skipped: live client held the lock",
+                 [({}, float(c["lock_busy"]))]),
+                ("ptpu_bench_tiers_pending", "gauge",
+                 "sweep tiers still queued",
+                 [({}, float(len(self.queue.pending())))]),
+                ("ptpu_bench_tiers_done", "gauge",
+                 "sweep tiers with done markers",
+                 [({}, float(len(self.queue.done())))]),
+                ("ptpu_bench_runs_total", "counter",
+                 "sweep runs by result",
+                 [({"result": "banked"}, float(c["runs_banked"])),
+                  ({"result": "failed"}, float(c["runs_failed"]))]),
+                ("ptpu_bench_store_records", "gauge",
+                 "records in the bench store",
+                 [({}, float(summ["records"]))]),
+                ("ptpu_bench_store_errors", "gauge",
+                 "error placeholders in the bench store",
+                 [({}, float(summ["errors"]))]),
+                ("ptpu_bench_last_good_value", "gauge",
+                 "newest non-error hardware value per metric",
+                 lg_samples),
+            ]
+        return collect

@@ -1,0 +1,115 @@
+"""Device-health probe with a hard timeout (ARCHITECTURE.md §28).
+
+The axon tunnel's failure mode is a never-returning device-claim RPC —
+a wedged lease hangs `jax.devices()` forever, so health must be probed
+in a SUBPROCESS with a kill deadline, never in the daemon's own
+process (a wedged in-process probe would wedge the daemon with it, and
+jax backend init is once-per-process anyway).
+
+Classification:
+
+  healthy  rc=0 within the deadline and a NON-CPU device initialized
+           (jax's silent CPU fallback must read as DOWN, not healthy —
+           the probe_loop_r5.sh rule)
+  wedged   the probe outlived its deadline (killed): the tunnel holds
+           the claim RPC open — the classic lease wedge
+  down     the probe exited nonzero promptly (init error, no
+           accelerator, plugin failure)
+
+Tests (and any hardware-free environment) inject transitions instead:
+`PTPU_BENCHD_FAKE_PROBE=<file>` names a file of one status per line
+("healthy"/"wedged"/"down"); each probe consumes the next line (cursor
+persisted next to the file) and the last line repeats forever — a
+scripted wedged→healthy transition drives a full daemon cycle in CI.
+"""
+import os
+import subprocess
+import sys
+import time
+
+__all__ = ["ProbeResult", "probe_device", "FAKE_PROBE_ENV"]
+
+FAKE_PROBE_ENV = "PTPU_BENCHD_FAKE_PROBE"
+
+# health = any non-CPU device actually initialized (probe_loop_r5.sh)
+_PROBE_SNIPPET = ("import jax,sys; "
+                  "sys.exit(0 if any(d.platform!='cpu' "
+                  "for d in jax.devices()) else 1)")
+
+
+class ProbeResult(object):
+    def __init__(self, status, rc=None, elapsed_s=0.0, detail=""):
+        self.status = status          # healthy | wedged | down
+        self.rc = rc
+        self.elapsed_s = float(elapsed_s)
+        self.detail = detail
+
+    @property
+    def healthy(self):
+        return self.status == "healthy"
+
+    def describe(self):
+        return {"status": self.status, "rc": self.rc,
+                "elapsed_s": round(self.elapsed_s, 3),
+                "detail": self.detail}
+
+    def __repr__(self):
+        return "ProbeResult(%s, rc=%r, %.1fs)" % (self.status, self.rc,
+                                                  self.elapsed_s)
+
+
+def _fake_probe(path):
+    """Consume the next scripted status. The cursor lives in
+    `<path>.cursor` so transitions survive across daemon cycles AND
+    across the daemon being killed and restarted (the resume tests)."""
+    try:
+        with open(path) as f:
+            statuses = [l.strip() for l in f if l.strip()]
+    except OSError as e:
+        return ProbeResult("down", detail="fake probe unreadable: %r" % e)
+    if not statuses:
+        return ProbeResult("down", detail="fake probe file empty")
+    cursor_path = path + ".cursor"
+    try:
+        with open(cursor_path) as f:
+            idx = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        idx = 0
+    status = statuses[min(idx, len(statuses) - 1)]
+    with open(cursor_path, "w") as f:
+        f.write(str(idx + 1))
+    if status not in ("healthy", "wedged", "down"):
+        return ProbeResult("down",
+                           detail="fake probe bad status %r" % status)
+    return ProbeResult(status, rc=0 if status == "healthy" else 1,
+                       detail="fake[%d]" % idx)
+
+
+def probe_device(timeout_s=120):
+    """One health probe. The caller holds the exclusive client lock —
+    the probe subprocess is itself a TPU client and two clients wedge
+    the lease (it inherits PTPU_LOCK_HELD semantics via env)."""
+    fake = os.environ.get(FAKE_PROBE_ENV)
+    if fake:
+        return _fake_probe(fake)
+    env = dict(os.environ)
+    # the probe must dial the real accelerator even if this process was
+    # started CPU-pinned (the daemon itself never initializes jax)
+    env.pop("JAX_PLATFORMS", None)
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return ProbeResult("wedged", rc=None,
+                           elapsed_s=time.monotonic() - t0,
+                           detail="probe killed at %ds (device claim "
+                                  "hung — tunnel wedged?)" % timeout_s)
+    elapsed = time.monotonic() - t0
+    if proc.returncode == 0:
+        return ProbeResult("healthy", rc=0, elapsed_s=elapsed)
+    return ProbeResult("down", rc=proc.returncode, elapsed_s=elapsed,
+                       detail="probe rc=%d (init error or CPU-only "
+                              "fallback)" % proc.returncode)

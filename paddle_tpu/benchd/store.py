@@ -1,0 +1,287 @@
+"""BenchStore: the append-only home for measured bench records
+(ARCHITECTURE.md §28).
+
+One JSONL file (`records.jsonl`) of envelopes:
+
+    {"v": 1, "seq": N, "ts": <epoch s>, "source": "...",
+     "metric": "...", "device_kind": "...", "digest": "...",
+     "record": {<the bench.py JSON line, schema-checked>}}
+
+Keying is (metric, device_kind, config digest) — see schema.py — so
+repeat runs of one configuration accumulate under one baseline key and
+`last_good()` never compares across configurations unless explicitly
+asked to fall back.
+
+`last_good()` implements the rule BENCH_LOG.md has documented since
+PR 12 but nothing enforced: any record carrying an `"error"` key is a
+failure placeholder (a wedged-tunnel probe, a timeout), never a
+baseline.  BENCH_r02–r05 therefore read as probe failures, not as a
+100% throughput regression.
+
+First open (no records.jsonl yet) backfills the committed repo
+artifacts when given a `repo_root`: every `BENCH_r*.json` driver
+artifact (its `parsed` record) and every JSON record line in
+BENCH_LOG.md, ordered by timestamp, with lines that don't conform to
+the record schema (kernel microbench lines, partial flash-fix notes)
+skipped and counted in `backfill_report.json`.
+"""
+import fcntl
+import json
+import os
+import re
+import time
+
+from . import schema
+
+__all__ = ["BenchStore"]
+
+_RECORDS = "records.jsonl"
+_BACKFILL_REPORT = "backfill_report.json"
+
+# `- 2026-07-31T01:05:19Z ...` BENCH_LOG.md entry timestamps (seconds
+# optional: some round-4 notes log minute resolution)
+_TS_RE = re.compile(r"^-\s+(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}(?::\d{2})?Z)")
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+_TAIL_TS_RE = re.compile(r"(\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2})")
+
+
+def _parse_iso_z(ts):
+    import calendar
+    for fmt in ("%Y-%m-%dT%H:%M:%SZ", "%Y-%m-%dT%H:%MZ",
+                "%Y-%m-%d %H:%M:%S"):
+        try:
+            return float(calendar.timegm(time.strptime(ts, fmt)))
+        except ValueError:
+            continue
+    return None
+
+
+class BenchStore(object):
+    def __init__(self, root, repo_root=None):
+        self.root = os.path.abspath(str(root))
+        os.makedirs(self.root, exist_ok=True)
+        self.path = os.path.join(self.root, _RECORDS)
+        if repo_root and not os.path.exists(self.path):
+            self._backfill(os.path.abspath(str(repo_root)))
+
+    # ------------------------------------------------------------ append --
+    def append(self, record, source="bench", ts=None):
+        """Schema-check `record` and append one envelope line.  The
+        whole read-count + write happens under an exclusive flock on
+        the records file, so a daemon and a CLI appending concurrently
+        can neither interleave half-lines nor duplicate seq numbers."""
+        schema.check_record(record)
+        env = {
+            "v": 1,
+            "ts": float(time.time() if ts is None else ts),
+            "source": str(source),
+            "metric": record["metric"],
+            "device_kind": schema.device_kind(record),
+            "digest": schema.config_digest(record),
+            "record": record,
+        }
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o666)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            with open(self.path, "r") as f:
+                env["seq"] = sum(1 for _ in f)
+            line = json.dumps(env, sort_keys=True)
+            os.lseek(fd, 0, os.SEEK_END)
+            os.write(fd, (line + "\n").encode("utf-8"))
+            os.fsync(fd)
+        finally:
+            os.close(fd)  # closes the fd's flock with it
+        return env
+
+    def _append_many(self, triples):
+        """Backfill path: [(record, source, ts)] appended in one locked
+        pass (sorted by ts before the call)."""
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o666)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            with open(self.path, "r") as f:
+                seq = sum(1 for _ in f)
+            buf = []
+            for record, source, ts in triples:
+                schema.check_record(record)
+                buf.append(json.dumps({
+                    "v": 1, "seq": seq,
+                    "ts": float(time.time() if ts is None else ts),
+                    "source": str(source),
+                    "metric": record["metric"],
+                    "device_kind": schema.device_kind(record),
+                    "digest": schema.config_digest(record),
+                    "record": record,
+                }, sort_keys=True))
+                seq += 1
+            os.lseek(fd, 0, os.SEEK_END)
+            os.write(fd, ("".join(l + "\n" for l in buf)).encode("utf-8"))
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    # -------------------------------------------------------------- read --
+    def entries(self, metric=None, device_kind=None, digest=None,
+                source_prefix=None):
+        """Envelopes in append order, optionally filtered. Corrupt
+        lines (a torn concurrent write survived a crash) are skipped,
+        not fatal — the store must stay readable after any kill."""
+        out = []
+        try:
+            with open(self.path, "r") as f:
+                lines = f.readlines()
+        except OSError:
+            return out
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                env = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(env, dict) or "record" not in env:
+                continue
+            if metric is not None and env.get("metric") != metric:
+                continue
+            if device_kind is not None \
+                    and env.get("device_kind") != device_kind:
+                continue
+            if digest is not None and env.get("digest") != digest:
+                continue
+            if source_prefix is not None and not str(
+                    env.get("source", "")).startswith(source_prefix):
+                continue
+            out.append(env)
+        return out
+
+    def last_good(self, metric, device_kind=None, digest=None,
+                  before_seq=None):
+        """Newest entry for the key whose record does NOT carry an
+        "error" key (the BENCH_LOG.md baseline rule) — or None.
+        `before_seq` restricts to strictly-older entries so a fresh
+        line never resolves itself as its own baseline."""
+        best = None
+        for env in self.entries(metric=metric, device_kind=device_kind,
+                                digest=digest):
+            if schema.is_error(env["record"]):
+                continue
+            if before_seq is not None and env.get("seq", 0) >= before_seq:
+                continue
+            if best is None or (env.get("ts", 0), env.get("seq", 0)) \
+                    >= (best.get("ts", 0), best.get("seq", 0)):
+                best = env
+        return best
+
+    def summary(self):
+        """Status surface: counts plus per-(metric, device_kind) last
+        good / error tallies."""
+        entries = self.entries()
+        per_key = {}
+        errors = 0
+        for env in entries:
+            err = schema.is_error(env["record"])
+            errors += bool(err)
+            key = (env.get("metric"), env.get("device_kind"))
+            slot = per_key.setdefault(key, {"records": 0, "errors": 0,
+                                            "last_good": None})
+            slot["records"] += 1
+            slot["errors"] += bool(err)
+            if not err:
+                lg = slot["last_good"]
+                if lg is None or (env.get("ts", 0), env.get("seq", 0)) \
+                        >= (lg.get("ts", 0), lg.get("seq", 0)):
+                    slot["last_good"] = env
+        return {"records": len(entries), "errors": errors,
+                "keys": per_key}
+
+    def backfill_report(self):
+        try:
+            with open(os.path.join(self.root, _BACKFILL_REPORT)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    # ---------------------------------------------------------- backfill --
+    def _backfill(self, repo_root):
+        """First-open ingest of the committed artifacts: BENCH_r*.json
+        (driver bench series — r02–r05 are the rc=3 tunnel-wedge
+        placeholders, ingested as the probe failures they are) and
+        BENCH_LOG.md JSON lines, in timestamp order."""
+        triples, skipped = [], []
+        for name in sorted(os.listdir(repo_root)
+                           if os.path.isdir(repo_root) else []):
+            if not (name.startswith("BENCH_r") and name.endswith(".json")):
+                continue
+            path = os.path.join(repo_root, name)
+            try:
+                with open(path) as f:
+                    art = json.load(f)
+            except (OSError, ValueError) as e:
+                skipped.append({"source": name, "reason": repr(e)})
+                continue
+            rec = art.get("parsed") if isinstance(art, dict) else None
+            problems = schema.validate_record(rec)
+            if problems:
+                skipped.append({"source": name, "reason": problems})
+                continue
+            # artifact order is the n sequence; a timestamp inside the
+            # captured tail refines it when present
+            ts = None
+            m = _TAIL_TS_RE.search(str(art.get("tail", "")))
+            if m:
+                ts = _parse_iso_z(m.group(1))
+            if ts is None:
+                ts = float(art.get("n", 0))
+            triples.append((rec, "backfill:%s" % name, ts))
+        log_path = os.path.join(repo_root, "BENCH_LOG.md")
+        triples.extend(self._parse_bench_log(log_path, skipped))
+        triples.sort(key=lambda t: t[2])
+        self._append_many(triples)
+        report = {"ingested": len(triples), "skipped": skipped,
+                  "repo_root": repo_root}
+        tmp = os.path.join(self.root, _BACKFILL_REPORT + ".tmp.%d"
+                           % os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(tmp, os.path.join(self.root, _BACKFILL_REPORT))
+        return report
+
+    @staticmethod
+    def _parse_bench_log(log_path, skipped):
+        """[(record, source, ts)] from BENCH_LOG.md: each backticked
+        `{...}` segment is a candidate record; the nearest preceding
+        `- <iso>Z` line stamps it. Non-conforming JSON (microbench
+        lines carry "kernel" not "metric") is counted, not ingested —
+        the schema decides what the store can read."""
+        triples = []
+        try:
+            with open(log_path) as f:
+                lines = f.readlines()
+        except OSError:
+            return triples
+        last_ts = None
+        for line in lines:
+            m = _TS_RE.match(line.strip())
+            if m:
+                last_ts = _parse_iso_z(m.group(1)) or last_ts
+            for seg in _BACKTICK_RE.findall(line):
+                seg = seg.strip()
+                if not seg.startswith("{"):
+                    continue
+                try:
+                    rec = json.loads(seg)
+                except ValueError:
+                    skipped.append({"source": "BENCH_LOG.md",
+                                    "reason": "unparseable JSON",
+                                    "line": seg[:120]})
+                    continue
+                problems = schema.validate_record(rec)
+                if problems:
+                    skipped.append({"source": "BENCH_LOG.md",
+                                    "reason": problems,
+                                    "line": seg[:120]})
+                    continue
+                triples.append((rec, "backfill:BENCH_LOG.md",
+                                last_ts if last_ts is not None else 0.0))
+        return triples

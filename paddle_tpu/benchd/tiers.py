@@ -1,0 +1,206 @@
+"""The sweep queue as one declarative registry (ARCHITECTURE.md §28).
+
+This is the r6 sweep (`tools/perf_sweep_r6.sh`, the NEXT_SWEEP target)
+plus the r5 remat/flash remainder migrated out of four copy-pasted
+shell scripts into data: each tier is an env/cmd/budget row, ordered
+cheapest-first (the round-4 lesson: bank the cheap known-good configs
+before anything risky burns the window), with a per-tier done marker so
+an interrupted sweep RESUMES at the first unmeasured tier instead of
+re-burning tunnel time on re-runs.
+
+`perf_sweep_r*.sh` survive as deprecated shims over
+`tools/ptpu_bench.py run`.
+"""
+import json
+import os
+import time
+
+__all__ = ["Tier", "SWEEP_TIERS", "SweepQueue", "tier_by_name"]
+
+
+class Tier(object):
+    """One queued sweep run.
+
+    kind="bench": `python bench.py` under `env` with a hard `timeout_s`
+    budget.  kind="tune": `python tools/ptpu_tune.py <argv>` (the
+    hardware tile search between the pre/post kernel legs).  `priority`
+    orders the drain (lower first = cheaper first); ties break on
+    registry order.
+    """
+
+    def __init__(self, name, env=None, timeout_s=1200, priority=50,
+                 kind="bench", argv=None, note=""):
+        if kind not in ("bench", "tune"):
+            raise ValueError("unknown tier kind %r" % (kind,))
+        self.name = str(name)
+        self.env = {str(k): str(v) for k, v in (env or {}).items()}
+        self.timeout_s = int(timeout_s)
+        self.priority = int(priority)
+        self.kind = kind
+        self.argv = list(argv or [])
+        self.note = note
+
+    def describe(self):
+        return {"name": self.name, "kind": self.kind, "env": self.env,
+                "timeout_s": self.timeout_s, "priority": self.priority,
+                "argv": self.argv, "note": self.note}
+
+    def env_summary(self):
+        """The `ENV=V ...` string BENCH_LOG.md entries carry — same
+        shape the shell sweeps logged, so the log stays grep-stable."""
+        if self.kind == "tune":
+            return "ptpu_tune " + " ".join(self.argv)
+        return " ".join("%s=%s" % kv for kv in sorted(self.env.items()))
+
+    def __repr__(self):
+        return "Tier(%s, prio=%d, %ds)" % (self.name, self.priority,
+                                           self.timeout_s)
+
+
+# ---------------------------------------------------------------------------
+# The queue (from perf_sweep_r6.sh; priorities keep its cheapest-first
+# order, spaced by 10 so a later PR can slot tiers in between).
+# ---------------------------------------------------------------------------
+SWEEP_TIERS = [
+    # tier 1: single-step baselines for the day (cheap, known compiles)
+    Tier("t1-resnet-base",
+         {"BENCH_BATCH": 256, "BENCH_DTYPE": "bf16", "BENCH_STEPS": 16,
+          "BENCH_WARMUP": 2}, timeout_s=900, priority=10,
+         note="single-step resnet50 bf16@256 baseline"),
+    Tier("t1-transformer-base",
+         {"BENCH_MODEL": "transformer", "BENCH_DTYPE": "bf16",
+          "BENCH_STEPS": 16, "BENCH_WARMUP": 2}, timeout_s=900,
+         priority=20, note="single-step transformer baseline"),
+    # tier 2: the K-step scan loop, same configs (PR 1)
+    Tier("t2-resnet-k8",
+         {"BENCH_BATCH": 256, "BENCH_DTYPE": "bf16", "BENCH_STEPS": 32,
+          "BENCH_WARMUP": 2, "BENCH_MULTISTEP": 8}, priority=30,
+         note="device-resident K=8 scan vs t1-resnet-base"),
+    Tier("t2-transformer-k8",
+         {"BENCH_MODEL": "transformer", "BENCH_DTYPE": "bf16",
+          "BENCH_STEPS": 32, "BENCH_WARMUP": 2, "BENCH_MULTISTEP": 8},
+         priority=40),
+    Tier("t2-resnet-k32",
+         {"BENCH_BATCH": 256, "BENCH_DTYPE": "bf16", "BENCH_STEPS": 64,
+          "BENCH_WARMUP": 2, "BENCH_MULTISTEP": 32}, priority=50,
+         note="K sensitivity"),
+    # tier 2b: sharded weight update on the real mesh (PR 9)
+    Tier("t2b-sharded",
+         {"BENCH_SHARDED": 1, "BENCH_STEPS": 32, "BENCH_WARMUP": 2},
+         priority=60),
+    Tier("t2b-sharded-dim1024",
+         {"BENCH_SHARDED": 1, "BENCH_STEPS": 32, "BENCH_WARMUP": 2,
+          "BENCH_SHARDED_DIM": 1024}, priority=70),
+    # tier 2c: pipelined dispatch — host/device overlap on hardware
+    # where host and device are actually separate (PR 10)
+    Tier("t2c-pipeline", {"BENCH_PIPELINE": 1}, priority=80),
+    Tier("t2c-pipeline-wide",
+         {"BENCH_PIPELINE": 1, "BENCH_PIPELINE_FEAT": 8192,
+          "BENCH_PIPELINE_BATCH": 64}, priority=90,
+         note="wide records: the H2D cost prefetch hides"),
+    Tier("t2c-pipeline-k8",
+         {"BENCH_PIPELINE": 1, "BENCH_PIPELINE_K": 8,
+          "BENCH_PIPELINE_RECORDS": 64}, priority=100),
+    # tier 2d: tensor-parallel plan (PR 11)
+    Tier("t2d-tp",
+         {"BENCH_TP": 1, "BENCH_STEPS": 32, "BENCH_WARMUP": 2},
+         priority=110),
+    Tier("t2d-tp-dim1024",
+         {"BENCH_TP": 1, "BENCH_STEPS": 32, "BENCH_WARMUP": 2,
+          "BENCH_TP_DIM": 1024}, priority=120),
+    Tier("t2d-tp-dim1024-legs12",
+         {"BENCH_TP": 1, "BENCH_STEPS": 32, "BENCH_WARMUP": 2,
+          "BENCH_TP_DIM": 1024, "BENCH_TP_LEGS": "1,2"}, priority=130),
+    # tier 2e: self-driving fleet (PR 14): fixed-vs-autoscaled load step
+    Tier("t2e-fleet",
+         {"BENCH_FLEET": 1, "BENCH_FLEET_SECONDS": 6,
+          "BENCH_FLEET_MAX_REPLICAS": 4}, priority=140),
+    # tier 2f: continuous-batched decode (PR 16)
+    Tier("t2f-decode",
+         {"BENCH_DECODE": 1, "BENCH_DECODE_STREAMS": 64,
+          "BENCH_DECODE_SLOTS": 8}, priority=150),
+    Tier("t2f-decode-16slots",
+         {"BENCH_DECODE": 1, "BENCH_DECODE_STREAMS": 96,
+          "BENCH_DECODE_SLOTS": 16, "BENCH_DECODE_TOKENS": 48},
+         priority=160),
+    # tier 3k: kernel floor (PR 13) — fused-vs-unfused BEFORE the tile
+    # sweep, the hardware tile search, then the SAME leg again so
+    # tuned_vs_default is measured on the chip
+    Tier("t3k-kernels-pretune", {"BENCH_KERNELS": 1}, timeout_s=1800,
+         priority=170),
+    Tier("t3k-tune-kernels", kind="tune",
+         argv=["kernels", "--place", "tpu", "--json"], timeout_s=2400,
+         priority=180,
+         note="per-(op, shape-bucket, device_kind) tile search into "
+              "the TuningStore"),
+    Tier("t3k-kernels-tuned", {"BENCH_KERNELS": 1}, timeout_s=1800,
+         priority=190,
+         note="tuned_vs_default banks from this line, never CPU"),
+    # tier 3: big compile LAST — one unrolled line (K copies of the step)
+    Tier("t3-unroll",
+         {"BENCH_BATCH": 256, "BENCH_DTYPE": "bf16", "BENCH_STEPS": 32,
+          "BENCH_WARMUP": 2, "BENCH_MULTISTEP": 8,
+          "FLAGS_multistep_unroll": 1}, timeout_s=2400, priority=200),
+]
+
+
+def tier_by_name(name, tiers=None):
+    for t in (tiers if tiers is not None else SWEEP_TIERS):
+        if t.name == name:
+            return t
+    raise KeyError("no sweep tier named %r" % (name,))
+
+
+class SweepQueue(object):
+    """Done-marker persistence over a tier list: `pending()` is the
+    priority-ordered remainder, `mark_done()` writes
+    `<state_dir>/done/<tier>.json` so a daemon killed mid-drain (or a
+    window that closed halfway) resumes at the first unmeasured tier.
+    Markers survive process death by construction (one file per tier,
+    written atomically)."""
+
+    def __init__(self, state_dir, tiers=None):
+        self.state_dir = os.path.abspath(str(state_dir))
+        self.done_dir = os.path.join(self.state_dir, "done")
+        os.makedirs(self.done_dir, exist_ok=True)
+        self.tiers = list(SWEEP_TIERS if tiers is None else tiers)
+
+    def _marker(self, tier_name):
+        return os.path.join(self.done_dir, "%s.json" % tier_name)
+
+    def is_done(self, tier):
+        name = tier.name if isinstance(tier, Tier) else str(tier)
+        return os.path.exists(self._marker(name))
+
+    def pending(self):
+        return sorted((t for t in self.tiers if not self.is_done(t)),
+                      key=lambda t: (t.priority,
+                                     self.tiers.index(t)))
+
+    def done(self):
+        return [t for t in self.tiers if self.is_done(t)]
+
+    def mark_done(self, tier, info=None):
+        name = tier.name if isinstance(tier, Tier) else str(tier)
+        payload = {"tier": name, "ts": time.time()}
+        payload.update(info or {})
+        tmp = self._marker(name) + ".tmp.%d" % os.getpid()
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, self._marker(name))
+
+    def reset(self, tier=None):
+        """Re-queue one tier (or all) — the next-round re-queue verb
+        (what editing NEXT_SWEEP used to be)."""
+        names = [tier.name if isinstance(tier, Tier) else str(tier)] \
+            if tier is not None else [t.name for t in self.tiers]
+        for name in names:
+            try:
+                os.remove(self._marker(name))
+            except OSError:
+                pass
+
+    def describe(self):
+        return {"state_dir": self.state_dir,
+                "pending": [t.name for t in self.pending()],
+                "done": [t.name for t in self.done()]}

@@ -29,7 +29,9 @@ CUDA context + nccl communicator setup (paddle/fluid/platform/device_context.cc)
 a remote-tunnel TPU needs the serialization at the *host* level instead,
 which is what this flock provides.
 """
+import errno
 import fcntl
+import json
 import os
 import time
 
@@ -123,6 +125,146 @@ def require_accelerator(tool_name):
         import sys
         sys.exit("%s: accelerator expected but only CPU devices "
                  "initialized; refusing to emit CPU numbers" % tool_name)
+
+
+# ---------------------------------------------------------------------------
+# Bounded window locks with stale-holder recovery (PR 19, benchd).
+#
+# acquire_tpu_lock() above holds for process LIFETIME — right for a
+# one-shot bench run, wrong for a resident daemon that must release the
+# tunnel between hardware windows.  WindowLock is the bounded variant:
+# acquire at window open, release at window close.
+#
+# Stale-holder recovery: flock itself auto-releases on process death, so
+# a plain flock can't go stale — but an fd INHERITED by a forgotten
+# child (a sweep's backgrounded subprocess surviving a SIGKILLed
+# tpu_lock.sh wrapper) holds the flock with no live holder recorded.
+# Mirroring checkpoint/snapshot.py clean_stale_tmp, the holder writes
+# ``{"pid": ..., "owner": ..., "ts": ...}`` into the lockfile on
+# acquire and truncates it on clean release; a contender that finds the
+# lock held AND the recorded pid dead breaks the lock by unlinking the
+# file and retrying on a fresh inode (the dead holder's flock pins only
+# the old, now-unreachable inode).  A live recorded pid — or an
+# unparseable/empty lockfile (can't prove staleness) — is always
+# honored.
+# ---------------------------------------------------------------------------
+
+class WindowLock(object):
+    """A held window lock: release() truncates the holder record and
+    drops the flock.  Usable as a context manager."""
+
+    def __init__(self, fd, path):
+        self.fd = fd
+        self.path = path
+
+    def release(self):
+        if self.fd is None:
+            return
+        fd, self.fd = self.fd, None
+        try:
+            os.ftruncate(fd, 0)
+        except OSError:
+            pass
+        os.close(fd)  # drops the flock
+
+    @property
+    def held(self):
+        return self.fd is not None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return "WindowLock(%s, %s)" % (self.path,
+                                       "held" if self.held else "released")
+
+
+def _lock_holder_pid(path):
+    """The pid recorded in the lockfile, or None when absent/unparseable
+    (prose in the lockfile proves nothing — hands off)."""
+    try:
+        with open(path, "r") as f:
+            data = json.loads(f.read() or "null")
+    except (OSError, ValueError):
+        return None
+    if isinstance(data, dict) and isinstance(data.get("pid"), int):
+        return data["pid"]
+    return None
+
+
+def break_stale_lock(path=LOCKFILE):
+    """Unlink `path` iff its recorded holder pid is provably dead —
+    the clean_stale_tmp liveness idiom: ProcessLookupError = dead (safe
+    to break), PermissionError = alive under another uid (honor),
+    no/any-other evidence = honor.  Returns True when broken."""
+    pid = _lock_holder_pid(path)
+    if pid is None or pid == os.getpid():
+        return False
+    try:
+        os.kill(pid, 0)
+        return False          # alive, same uid
+    except ProcessLookupError:
+        pass                  # provably dead — break below
+    except PermissionError:
+        return False          # alive, another uid
+    except OSError:
+        return False
+    try:
+        os.unlink(path)
+        return True
+    except OSError:
+        return False
+
+
+def acquire_window_lock(path=LOCKFILE, timeout=0.0, owner="benchd",
+                        poll_s=0.5):
+    """Acquire the client lock for a bounded window.  Returns a
+    WindowLock, or None when the lock stayed busy past `timeout`
+    seconds (a live client is measuring — the caller waits for the
+    next window, it never queues behind hardware time).
+
+    On contention the recorded holder's liveness is checked first: a
+    dead holder's lockfile is broken (unlinked) and the acquire retried
+    on the fresh inode, so a SIGKILLed sweep whose orphaned child pins
+    the old flock cannot wedge every future window.
+    """
+    deadline = time.monotonic() + max(0.0, float(timeout))
+    while True:
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o666)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            os.close(fd)
+            if e.errno not in (errno.EAGAIN, errno.EACCES):
+                raise
+            if break_stale_lock(path):
+                continue      # fresh inode now; retry immediately
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(poll_s)
+            continue
+        # Locked — but only the inode this fd points at.  If a stale-
+        # breaker unlinked the path between our open and flock, the
+        # path now names a DIFFERENT inode (or none) and our lock
+        # guards nothing: retry on the current file.
+        try:
+            st_fd = os.fstat(fd)
+            st_path = os.stat(path)
+            same = (st_fd.st_ino == st_path.st_ino
+                    and st_fd.st_dev == st_path.st_dev)
+        except OSError:
+            same = False      # path unlinked beneath us
+        if not same:
+            os.close(fd)
+            continue
+        record = json.dumps({"pid": os.getpid(), "owner": str(owner),
+                             "ts": time.time()})
+        os.ftruncate(fd, 0)
+        os.pwrite(fd, record.encode("utf-8"), 0)
+        return WindowLock(fd, path)
 
 
 def install():

@@ -1,89 +1,9 @@
 #!/bin/bash
-# Round-4 second-window sweep: ONLY the configs that failed or never ran
-# while the tunnel was wedged (01:15-01:52Z failures all predate the flash
-# Mosaic fix at 01:33Z or were undiagnosable because stderr went to
-# /dev/null). Differences vs perf_sweep.sh:
-#   - stderr is KEPT per run (/tmp/bench_err_N.log) so a failure is
-#     diagnosable without re-burning tunnel time
-#   - already-banked configs are not re-run
+# DEPRECATED SHIM (PR 19): the round-4b sweep script (the first
+# cheapest-first banked sweep, whose BENCH_r01 line is still the
+# driver-series last-good baseline) was superseded by r4c/r5/r6 and
+# finally by the declarative tier queue in paddle_tpu/benchd/tiers.py.
+# Kept as a shim so stale references still bank through the store.
 set -u
 cd "$(dirname "$0")/.."
-LOG=/tmp/perf_sweep_r4b.log
-: > $LOG
-WEDGED=0
-N=0
-LOCK="tools/tpu_lock.sh"
-tunnel_ok() {
-  bash "$LOCK" timeout 120 python -c "import jax; print(jax.devices())" \
-    >/dev/null 2>&1
-}
-probe() {
-  [ "$WEDGED" = 1 ] && return 1
-  tunnel_ok && return 0
-  local rc=$?
-  if [ $rc -eq 75 ]; then
-    echo "- $(date -u +%FT%TZ) r4b sweep stopped: tpu_lock busy (rc=75)" >> BENCH_LOG.md
-  else
-    echo "- $(date -u +%FT%TZ) tunnel probe FAILED mid-r4b-sweep" >> BENCH_LOG.md
-  fi
-  WEDGED=1
-  return 1
-}
-bank() {
-  git commit -q -m "perf sweep: bank measured bench lines" \
-    -- BENCH_LOG.md 2>/dev/null || true
-}
-run() {
-  [ "$WEDGED" = 1 ] && { echo "skip (wedged): $*" | tee -a $LOG; return; }
-  N=$((N+1))
-  echo "=== [$N] $*" | tee -a $LOG
-  local line rc
-  bash "$LOCK" env "$@" BENCH_DEVICE_TIMEOUT=300 timeout -k 10 1200 \
-    python bench.py >/tmp/bench_run.out 2>/tmp/bench_err_$N.log
-  rc=$?
-  if [ $rc -eq 75 ]; then
-    echo "- $(date -u +%FT%TZ) r4b sweep stopped mid-run: tpu_lock busy" >> BENCH_LOG.md
-    WEDGED=1
-    return
-  fi
-  line=$(tail -1 /tmp/bench_run.out)
-  echo "$line" | tee -a $LOG
-  case "$line" in
-    *'"error"'*|"")
-      echo "- $(date -u +%FT%TZ) FAILED(rc=$rc, err=/tmp/bench_err_$N.log): $*" >> BENCH_LOG.md
-      tail -3 /tmp/bench_err_$N.log >> $LOG
-      case "$line" in
-        *"device init"*) WEDGED=1 ;;
-        "") tunnel_ok || WEDGED=1 ;;
-      esac ;;
-    *) printf -- '- %s `%s`\n  `%s`\n' "$(date -u +%FT%TZ)" "$*" "$line" \
-         >> BENCH_LOG.md
-       bank ;;
-  esac
-}
-echo "- $(date -u +%FT%TZ) TUNNEL RECOVERED (probe rc=0 at 03:15Z); r4b sweep of previously-failed configs starts" >> BENCH_LOG.md
-probe || exit 1
-# flash's regime: long sequence. 01:19Z failure predates the Mosaic fix.
-run BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2
-probe && run BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2 BENCH_FUSED_ATTN=0
-# pallas microbench: 01:15Z failure predates the Mosaic fix
-if probe; then
-  echo "=== pallas microbench" | tee -a $LOG
-  bash "$LOCK" timeout 900 python tools/pallas_microbench.py \
-    2>/tmp/bench_err_micro.log | tee -a $LOG | \
-    while read -r line; do
-      printf -- '- %s microbench `%s`\n' "$(date -u +%FT%TZ)" "$line" >> BENCH_LOG.md
-    done
-  [ "${PIPESTATUS[0]:-0}" = 0 ] || \
-    echo "- $(date -u +%FT%TZ) FAILED: pallas_microbench (err=/tmp/bench_err_micro.log)" >> BENCH_LOG.md
-  bank
-fi
-# latency-hiding flag: the 01:11Z invocation mis-quoted XLA_FLAGS (empty
-# first token); pass it as ONE token this time
-probe && run BENCH_BATCH=256 BENCH_DTYPE=bf16 \
-  XLA_FLAGS=--xla_tpu_enable_latency_hiding_scheduler=true
-# big compiles dead-last
-probe && run BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3 BENCH_REMAT=1
-probe && run BENCH_BATCH=1024 BENCH_DTYPE=bf16 BENCH_STEPS=10 BENCH_WARMUP=3 BENCH_REMAT=1
-bank
-echo "=== r4b sweep done (wedged=$WEDGED) ===" | tee -a $LOG
+exec python tools/ptpu_bench.py run --git-bank "$@"

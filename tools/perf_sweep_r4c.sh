@@ -1,104 +1,9 @@
 #!/bin/bash
-# Round-4 third-window sweep: everything still unmeasured after the 03:15Z
-# window. SUPERSEDES perf_sweep.sh / perf_sweep_r4b.sh (historical records
-# of earlier windows — do not re-run them; this copy carries the harness
-# fixes: rc-gated banking, probe-before-recovery-log). Cheapest-first; ONE client at a time via tools/tpu_lock.sh;
-# stderr kept per run. New since the last window: pallas flash BACKWARD
-# kernels (dK/dV + dQ, causal skipping) and segment-level remat replaced
-# the per-op jax.checkpoint that OOM'd at 29G.
+# DEPRECATED SHIM (PR 19): the round-4c sweep script was superseded by
+# r5 then r6 and finally by the declarative tier queue in
+# paddle_tpu/benchd/tiers.py (drained by tools/ptpu_bench.py run /
+# daemon).  Kept as a shim so any stale crontab or notes pointing here
+# still bank lines through the store instead of silently diverging.
 set -u
 cd "$(dirname "$0")/.."
-LOG=/tmp/perf_sweep_r4c.log
-: > $LOG
-WEDGED=0
-N=0
-LOCK="tools/tpu_lock.sh"
-tunnel_ok() {
-  bash "$LOCK" timeout 120 python -c "import jax; print(jax.devices())" \
-    >/dev/null 2>&1
-}
-probe() {
-  [ "$WEDGED" = 1 ] && return 1
-  tunnel_ok && return 0
-  local rc=$?
-  if [ $rc -eq 75 ]; then
-    echo "- $(date -u +%FT%TZ) r4c sweep stopped: tpu_lock busy (rc=75)" >> BENCH_LOG.md
-  else
-    echo "- $(date -u +%FT%TZ) tunnel probe FAILED mid-r4c-sweep" >> BENCH_LOG.md
-  fi
-  WEDGED=1
-  return 1
-}
-bank() {
-  git commit -q -m "perf sweep: bank measured bench lines" \
-    -- BENCH_LOG.md 2>/dev/null || true
-}
-run() {  # run <timeout_s> ENV=V...
-  [ "$WEDGED" = 1 ] && { echo "skip (wedged): $*" | tee -a $LOG; return; }
-  local to=$1; shift
-  N=$((N+1))
-  echo "=== [$N] $*" | tee -a $LOG
-  local line rc
-  bash "$LOCK" env "$@" BENCH_DEVICE_TIMEOUT=300 timeout -k 10 "$to" \
-    python bench.py >/tmp/bench_run.out 2>/tmp/bench_err_c$N.log
-  rc=$?
-  if [ $rc -eq 75 ]; then
-    echo "- $(date -u +%FT%TZ) r4c sweep stopped mid-run: tpu_lock busy" >> BENCH_LOG.md
-    WEDGED=1
-    return
-  fi
-  line=$(tail -1 /tmp/bench_run.out)
-  echo "$line" | tee -a $LOG
-  # rc gates banking: a timeout-killed run's last stdout line must never
-  # be banked as a measurement (r4c review finding)
-  if [ $rc -ne 0 ]; then
-    line='{"error": "rc='$rc'"}'"$line"
-  fi
-  case "$line" in
-    *'"error"'*|"")
-      echo "- $(date -u +%FT%TZ) FAILED(rc=$rc, err=/tmp/bench_err_c$N.log): $*" >> BENCH_LOG.md
-      tail -3 /tmp/bench_err_c$N.log >> $LOG
-      case "$line" in
-        *"device init"*) WEDGED=1 ;;
-        *) tunnel_ok || WEDGED=1 ;;
-      esac ;;
-    *) printf -- '- %s `%s`\n  `%s`\n' "$(date -u +%FT%TZ)" "$*" "$line" \
-         >> BENCH_LOG.md
-       bank ;;
-  esac
-}
-probe || exit 1
-echo "- $(date -u +%FT%TZ) TUNNEL RECOVERED; r4c sweep starts" >> BENCH_LOG.md
-# tier 1: headline re-confirmation (the round-4 Env/lowering changes sit
-# on every trace path) then cheap re-measures through the NEW flash
-# backward kernels
-run 900 BENCH_BATCH=256 BENCH_DTYPE=bf16
-probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
-probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2
-probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256 BENCH_FUSED_QKV=1
-probe && run 900 BENCH_MODEL=transformer BENCH_DECODE=1 BENCH_BATCH=16 BENCH_SEQ=128
-# tier 2: new bench models
-probe && run 900 BENCH_MODEL=stacked_lstm BENCH_BATCH=128 BENCH_SEQ=64
-probe && run 900 BENCH_MODEL=vgg16 BENCH_BATCH=128
-# tier 3: flash block-size tuning sweep (one process, many small compiles)
-if probe; then
-  echo "=== flash tune" | tee -a $LOG
-  bash "$LOCK" env MB_TUNE=1 timeout 1500 python tools/pallas_microbench.py \
-    2>/tmp/bench_err_ctune.log | tee -a $LOG | \
-    while read -r line; do
-      printf -- '- %s flash_tune `%s`\n' "$(date -u +%FT%TZ)" "$line" >> BENCH_LOG.md
-    done
-  [ "${PIPESTATUS[0]:-0}" = 0 ] || \
-    echo "- $(date -u +%FT%TZ) FAILED: flash tune (err=/tmp/bench_err_ctune.log)" >> BENCH_LOG.md
-  bank
-fi
-# tier 4: big compiles LAST — segment-remat graphs compile long (the
-# 03:48Z remat@512 attempt died at its own 20-min timeout, no OOM)
-probe && run 2400 BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=5 BENCH_WARMUP=2 BENCH_REMAT=1
-probe && run 1200 BENCH_BATCH=1024 BENCH_DTYPE=bf16 BENCH_STEPS=5 BENCH_WARMUP=2
-probe && run 2400 BENCH_BATCH=1024 BENCH_DTYPE=bf16 BENCH_STEPS=5 BENCH_WARMUP=2 BENCH_REMAT=1
-bank
-echo "=== r4c sweep done (wedged=$WEDGED) ===" | tee -a $LOG
-# propagate wedge status so the probe loop can leave the sweep queued
-# (a wedged run refires on the next healthy window)
-exit $WEDGED
+exec python tools/ptpu_bench.py run --git-bank "$@"

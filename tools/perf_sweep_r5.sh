@@ -1,150 +1,9 @@
 #!/bin/bash
-# Round-5 sweep. SUPERSEDES perf_sweep_r4c.sh (kept as the historical
-# record of the r4 queue). Cheapest-first; ONE client at a time via
-# tools/tpu_lock.sh; rc-gated banking (a timeout-killed run's stdout is
-# never banked); stderr kept per run. Exits nonzero when wedged so the
-# probe loop leaves the sweep queued for the next healthy window.
-#
-# New since r4c:
-# - flash per-shape dispatch landed (FLAGS_flash_min_seq, default 1024):
-#   tier-1 transformer lines measure the AUTO dispatch (the headline
-#   config); kernel-forced comparisons set FLAGS_flash_min_seq=0.
-# - remat segment-length knob (FLAGS_remat_segment_len) — remat configs
-#   probe seg lengths informed by the CPU compile probe.
+# DEPRECATED SHIM (PR 19): the round-5 sweep (remat/flash tiers; never
+# got a healthy window — see BENCH_LOG.md 2026-08-02) was folded into
+# the declarative queue in paddle_tpu/benchd/tiers.py.  Historical
+# results context lives in BENCH_LOG.md; the protocol (probe → lock →
+# cheapest-first drain → rc-gated bank) is now paddle_tpu/benchd.
 set -u
 cd "$(dirname "$0")/.."
-LOG=/tmp/perf_sweep_r5.log
-: > $LOG
-WEDGED=0
-N=0
-LOCK="tools/tpu_lock.sh"
-tunnel_ok() {
-  bash "$LOCK" timeout 120 python -c \
-    'import jax,sys; sys.exit(0 if any(d.platform!="cpu" for d in jax.devices()) else 1)' \
-    >/dev/null 2>&1
-}
-probe() {
-  [ "$WEDGED" = 1 ] && return 1
-  tunnel_ok && return 0
-  local rc=$?
-  if [ $rc -eq 75 ]; then
-    echo "- $(date -u +%FT%TZ) r5 sweep stopped: tpu_lock busy (rc=75)" >> BENCH_LOG.md
-  else
-    echo "- $(date -u +%FT%TZ) tunnel probe FAILED mid-r5-sweep" >> BENCH_LOG.md
-  fi
-  WEDGED=1
-  return 1
-}
-bank() {
-  git commit -q -m "perf sweep: bank measured bench lines" \
-    -- BENCH_LOG.md 2>/dev/null || true
-}
-run() {  # run <timeout_s> ENV=V...
-  [ "$WEDGED" = 1 ] && { echo "skip (wedged): $*" | tee -a $LOG; return; }
-  local to=$1; shift
-  N=$((N+1))
-  echo "=== [$N] $*" | tee -a $LOG
-  local line rc
-  bash "$LOCK" env "$@" BENCH_DEVICE_TIMEOUT=300 timeout -k 10 "$to" \
-    python bench.py >/tmp/bench_run.out 2>/tmp/bench_err_r5_$N.log
-  rc=$?
-  if [ $rc -eq 75 ]; then
-    echo "- $(date -u +%FT%TZ) r5 sweep stopped mid-run: tpu_lock busy" >> BENCH_LOG.md
-    WEDGED=1
-    return
-  fi
-  line=$(tail -1 /tmp/bench_run.out)
-  if [ $rc -ne 0 ]; then
-    line='{"error": "rc='$rc'"}'"$line"
-  fi
-  case "$line" in
-    *'"error"'*|"")
-      echo "- $(date -u +%FT%TZ) FAILED(rc=$rc, err=/tmp/bench_err_r5_$N.log): $*" >> BENCH_LOG.md
-      tail -3 /tmp/bench_err_r5_$N.log >> $LOG
-      case "$line" in
-        *"device init"*) WEDGED=1 ;;
-        *) tunnel_ok || WEDGED=1 ;;
-      esac ;;
-    *) printf -- '- %s `%s`\n  `%s`\n' "$(date -u +%FT%TZ)" "$*" "$line" \
-         >> BENCH_LOG.md
-       bank ;;
-  esac
-}
-mb() {  # mb <timeout_s> <label> ENV=V... -- run pallas_microbench with env
-  [ "$WEDGED" = 1 ] && { echo "skip (wedged): mb $*" | tee -a $LOG; return; }
-  local to=$1 label=$2; shift 2
-  echo "=== mb:$label $*" | tee -a $LOG
-  bash "$LOCK" env "$@" timeout -k 10 "$to" python tools/pallas_microbench.py \
-    >/tmp/mb_run.out 2>/tmp/mb_err_$label.log
-  local rc=$?
-  if [ $rc -eq 75 ]; then
-    echo "- $(date -u +%FT%TZ) r5 sweep stopped mid-mb: tpu_lock busy" >> BENCH_LOG.md
-    WEDGED=1
-    return
-  fi
-  if [ $rc -eq 0 ]; then
-    while read -r line; do
-      printf -- '- %s microbench(%s) `%s`\n' "$(date -u +%FT%TZ)" "$label" "$line" >> BENCH_LOG.md
-    done < /tmp/mb_run.out
-    bank
-  else
-    echo "- $(date -u +%FT%TZ) FAILED(rc=$rc): microbench $label (err=/tmp/mb_err_$label.log)" >> BENCH_LOG.md
-    tunnel_ok || WEDGED=1
-  fi
-}
-probe || exit 1
-echo "- $(date -u +%FT%TZ) TUNNEL RECOVERED; r5 sweep starts" >> BENCH_LOG.md
-# --- tier 1: headline re-confirmation (cheapest, banked first) -------------
-run 900 BENCH_BATCH=256 BENCH_DTYPE=bf16
-probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=32 BENCH_SEQ=256
-# --- tier 2: the round's MFU target — transformer at T>=1024 through the
-# NEW pallas bwd kernels (auto dispatch runs flash at these lengths) ------
-probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=8 BENCH_SEQ=1024 BENCH_STEPS=5 BENCH_WARMUP=2
-probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2
-probe && run 900 BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_STEPS=5 BENCH_WARMUP=2 BENCH_FUSED_QKV=1
-# MFU scales with model width — the big config (d_model 1024, 16 heads)
-# is the fairer MXU-utilization number at long T
-probe && run 1200 BENCH_MODEL=transformer BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_DMODEL=1024 BENCH_HEADS=16 BENCH_STEPS=5 BENCH_WARMUP=2
-# kernel-level: flash fwd+bwd vs XLA dense at the long lengths (the r4
-# lax bwd measured 0.75x dense; the pallas bwd must beat 1x to stay)
-probe && mb 1200 bwd MB_SHAPES="8x1024x8x64,8x2048x8x64,4x4096x8x64"
-# --- tier 3: decode + remaining model families -----------------------------
-probe && run 900 BENCH_MODEL=transformer BENCH_DECODE=1 BENCH_BATCH=16 BENCH_SEQ=128
-probe && run 900 BENCH_MODEL=stacked_lstm BENCH_BATCH=128 BENCH_SEQ=64
-probe && run 900 BENCH_MODEL=vgg16 BENCH_BATCH=128
-probe && run 900 BENCH_MODEL=resnet101 BENCH_BATCH=128 BENCH_DTYPE=bf16
-# host-feed pair: float32 (link-bandwidth-bound on the tunnel: 40.4 img/s
-# = ~24MB/s in r4) vs uint8-normalize-on-device (4x less traffic). If
-# host_u8 lands ~4x host, the feeder machinery is proven and the ceiling
-# is the link, closing r4 weak #5's open question.
-probe && run 900 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_FEED=host BENCH_STEPS=5 BENCH_WARMUP=2
-probe && run 900 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_FEED=host_u8 BENCH_STEPS=5 BENCH_WARMUP=2
-# --- tier 4: flash block-size tune (one process, many small compiles) ------
-if probe; then
-  echo "=== flash tune" | tee -a $LOG
-  bash "$LOCK" env MB_TUNE=1 FLAGS_flash_min_seq=0 timeout 1500 \
-    python tools/pallas_microbench.py 2>/tmp/bench_err_r5tune.log | \
-    tee -a $LOG | while read -r line; do
-      printf -- '- %s flash_tune `%s`\n' "$(date -u +%FT%TZ)" "$line" >> BENCH_LOG.md
-    done
-  [ "${PIPESTATUS[0]:-0}" = 0 ] || \
-    echo "- $(date -u +%FT%TZ) FAILED: flash tune (err=/tmp/bench_err_r5tune.log)" >> BENCH_LOG.md
-  bank
-fi
-# --- tier 5: big compiles LAST — remat with the segment-length knob.
-# Segment lengths from the CPU compile probe (tools/remat_compile_probe.py);
-# 40-min budget for the first compile of each.
-# CPU compile probe (tools/remat_compile_probe.py, banked in BENCH_LOG):
-# XLA:CPU compiles every remat config in 16-21s at batch 64..1024
-# (barriers 22/13/4 for seg_len 8/sqrt/44) — the >20-min blowup is
-# TPU-pass-specific. Longest segments (fewest barriers) first, then a
-# scheduler-off variant (the latency-hiding scheduler is the prime
-# suspect for barrier-sensitive compile cost).
-probe && run 2400 BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=5 BENCH_WARMUP=2 BENCH_REMAT=1 FLAGS_remat_segment_len=44
-probe && run 2400 BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=5 BENCH_WARMUP=2 BENCH_REMAT=1
-probe && run 2400 BENCH_BATCH=512 BENCH_DTYPE=bf16 BENCH_STEPS=5 BENCH_WARMUP=2 BENCH_REMAT=1 FLAGS_remat_segment_len=44 XLA_FLAGS=--xla_tpu_enable_latency_hiding_scheduler=false
-probe && run 1200 BENCH_BATCH=1024 BENCH_DTYPE=bf16 BENCH_STEPS=5 BENCH_WARMUP=2
-probe && run 2400 BENCH_BATCH=1024 BENCH_DTYPE=bf16 BENCH_STEPS=5 BENCH_WARMUP=2 BENCH_REMAT=1 FLAGS_remat_segment_len=44
-bank
-echo "=== r5 sweep done (wedged=$WEDGED) ===" | tee -a $LOG
-exit $WEDGED
+exec python tools/ptpu_bench.py run --git-bank "$@"

@@ -1,164 +1,14 @@
 #!/bin/bash
-# Round-6 sweep: multi-step device-resident execution (PR 1). SUPERSEDES
-# perf_sweep_r5.sh as the NEXT_SWEEP target; r5's queue ran (or stays in
-# the historical record if the tunnel never healed). Cheapest-first; ONE
-# client at a time via tools/tpu_lock.sh; rc-gated banking; stderr kept
-# per run. Exits nonzero when wedged so the probe loop leaves the sweep
-# queued for the next healthy window.
-#
-# What r6 measures (BENCH_MULTISTEP, Executor.run(steps=K)):
-# - the TPU lax.scan K-step loop vs single-step dispatch, same configs —
-#   the dispatch-overhead win every later kernel PR is stacked on top of.
-#   CPU reference (2026-08-04, tunnel wedged): +65% tok/s at K=8 on the
-#   dispatch-bound tiny transformer; parity on compute-bound resnet50.
-# - K sensitivity (8/32) and fetch_reduce is 'last' in bench.py, so the
-#   JSON "multistep" field labels every line.
-# - one FLAGS_multistep_unroll=1 line: full unroll ALSO lets XLA fuse
-#   across step boundaries on TPU; worth one compile to know.
-# - re-queued 2026-08-05 with tier 2b (BENCH_SHARDED, PR 9): replicated
-#   vs ZeRO-style sharded weight update on the real multi-chip mesh —
-#   steps/s both legs + per-chip update-state bytes from the plan's
-#   memory accounting + the fetch-divergence column. CPU reference
-#   (8 virtual devices, 2-layer dim-256 Adam MLP): sharded ~2.1x
-#   steps/s of replicated (update math on 1/8 shards beats 8x
-#   redundant updates even with the gathers), update-state bytes/chip
-#   ratio 0.125, divergence 2.4e-7 (ulp-level reduction-tree
-#   difference, see test_bench_sharded_smoke).
+# DEPRECATED SHIM (PR 19): the r6 sweep queue now lives as data in
+# paddle_tpu/benchd/tiers.py (SWEEP_TIERS — same tiers, same
+# cheapest-first order, same budgets) and the probe/lock/drain/bank
+# protocol in paddle_tpu/benchd/daemon.py.  This script remains only
+# because tools/NEXT_SWEEP and the probe loop name it; it execs one
+# `ptpu_bench run` window, which drains the queued tiers with per-tier
+# done markers (an interrupted sweep resumes — something the shell
+# version never did) and exits nonzero when the window wedged so the
+# probe loop leaves the sweep queued.  New rounds: re-queue with
+# `tools/ptpu_bench.py reset-queue`, not by editing this file.
 set -u
 cd "$(dirname "$0")/.."
-LOG=/tmp/perf_sweep_r6.log
-: > $LOG
-WEDGED=0
-N=0
-LOCK="tools/tpu_lock.sh"
-tunnel_ok() {
-  bash "$LOCK" timeout 120 python -c \
-    'import jax,sys; sys.exit(0 if any(d.platform!="cpu" for d in jax.devices()) else 1)' \
-    >/dev/null 2>&1
-}
-probe() {
-  [ "$WEDGED" = 1 ] && return 1
-  tunnel_ok && return 0
-  local rc=$?
-  if [ $rc -eq 75 ]; then
-    echo "- $(date -u +%FT%TZ) r6 sweep stopped: tpu_lock busy (rc=75)" >> BENCH_LOG.md
-  else
-    echo "- $(date -u +%FT%TZ) tunnel probe FAILED mid-r6-sweep" >> BENCH_LOG.md
-  fi
-  WEDGED=1
-  return 1
-}
-bank() {
-  git commit -q -m "perf sweep: bank measured bench lines" \
-    -- BENCH_LOG.md 2>/dev/null || true
-}
-run() {  # run <timeout_s> ENV=V...
-  [ "$WEDGED" = 1 ] && { echo "skip (wedged): $*" | tee -a $LOG; return; }
-  local to=$1; shift
-  N=$((N+1))
-  echo "=== [$N] $*" | tee -a $LOG
-  local line rc
-  bash "$LOCK" env "$@" BENCH_DEVICE_TIMEOUT=300 timeout -k 10 "$to" \
-    python bench.py >/tmp/bench_run.out 2>/tmp/bench_err_r6_$N.log
-  rc=$?
-  if [ $rc -eq 75 ]; then
-    echo "- $(date -u +%FT%TZ) r6 sweep stopped mid-run: tpu_lock busy" >> BENCH_LOG.md
-    WEDGED=1
-    return
-  fi
-  line=$(tail -1 /tmp/bench_run.out)
-  if [ $rc -ne 0 ]; then
-    line='{"error": "rc='$rc'"}'"$line"
-  fi
-  case "$line" in
-    *'"error"'*|"")
-      echo "- $(date -u +%FT%TZ) FAILED(rc=$rc, err=/tmp/bench_err_r6_$N.log): $*" >> BENCH_LOG.md
-      tail -3 /tmp/bench_err_r6_$N.log >> $LOG
-      case "$line" in
-        *"device init"*) WEDGED=1 ;;
-        *) tunnel_ok || WEDGED=1 ;;
-      esac ;;
-    *) printf -- '- %s `%s`\n  `%s`\n' "$(date -u +%FT%TZ)" "$*" "$line" \
-         >> BENCH_LOG.md
-       bank ;;
-  esac
-}
-# --- tier 1: single-step baselines for the day (cheap, known compiles) -----
-probe && run 900 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=16 BENCH_WARMUP=2
-probe && run 900 BENCH_MODEL=transformer BENCH_DTYPE=bf16 BENCH_STEPS=16 BENCH_WARMUP=2
-# --- tier 2: the K-step scan loop, same configs -----------------------------
-probe && run 1200 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_MULTISTEP=8
-probe && run 1200 BENCH_MODEL=transformer BENCH_DTYPE=bf16 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_MULTISTEP=8
-probe && run 1200 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=64 BENCH_WARMUP=2 BENCH_MULTISTEP=32
-# (no host-feed multistep tier: run(steps=K) replays an explicit feed
-# for all K steps, so BENCH_FEED=host* would credit K steps to 1/K of
-# the staging work — bench.py refuses the combination; measuring the
-# pipeline under the loop needs an in-graph-reader bench mode first)
-# --- tier 2b: sharded weight update on the real mesh (PR 9) ----------------
-probe && run 1200 BENCH_SHARDED=1 BENCH_STEPS=32 BENCH_WARMUP=2
-probe && run 1200 BENCH_SHARDED=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_SHARDED_DIM=1024
-# --- tier 2c: pipelined dispatch (PR 10) — the host/device overlap this
-# sweep finally measures on hardware where host and device are separate:
-# open-loop serving p50/p99 serial-vs-pipelined at fixed load, and
-# steps/s serial-vs-prefetch on a host-io-bound trainer (wide records,
-# narrow model; the H2D is the cost prefetch hides)
-probe && run 1200 BENCH_PIPELINE=1
-probe && run 1200 BENCH_PIPELINE=1 BENCH_PIPELINE_FEAT=8192 BENCH_PIPELINE_BATCH=64
-probe && run 1200 BENCH_PIPELINE=1 BENCH_PIPELINE_K=8 BENCH_PIPELINE_RECORDS=64
-# --- tier 2d: tensor-parallel plan (PR 11) — mesh-1 vs tp=2/4 on the real
-# chips: steps/s per leg + per-chip param bytes from the plan's memory
-# accounting + the fetch-divergence column (gather placement: must be 0.0).
-# CPU reference (8 virtual devices, dim-64 Adam MLP): divergence 0.0,
-# params ratio 0.26 at tp=4; steps/s CPU-parity (the gather win is memory,
-# the compute win needs real ICI).
-probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2
-probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_TP_DIM=1024
-probe && run 1200 BENCH_TP=1 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_TP_DIM=1024 BENCH_TP_LEGS=1,2
-# --- tier 2e: self-driving fleet (PR 14) — the fixed-vs-autoscaled 429
-# load step on real chips: new replicas land on DISTINCT devices, so qps
-# should scale alongside the 429-rate drop (on the 1-core CPU reference
-# only the 429 claim is measurable: fixed tail reject rate sustained,
-# autoscaled tail ~0, scale-up ~0.3-0.7s riding the AOT warm start,
-# contraction drains to 1 with 0 errors — 2026-08-05).
-probe && run 1200 BENCH_FLEET=1 BENCH_FLEET_SECONDS=6 BENCH_FLEET_MAX_REPLICAS=4
-# --- tier 3k: kernel floor (PR 13) — fused-vs-unfused per op (+ the
-# int8/bf16 serving divergence gate riding the same JSON line), then a
-# hardware tile sweep (ptpu_tune kernels records per-(op, shape-bucket,
-# device_kind) tiles + the flash crossover into the TuningStore), then
-# the SAME leg again so tuned_vs_default is measured on the chip — the
-# ">=1.5x on >=2 hot ops" ROADMAP claim banks from these lines, never
-# from CPU interpret mode. CPU reference (2026-08-05, tiny dims):
-# divergence gates all pass; speedups <1 as expected off-hardware.
-probe && run 1800 BENCH_KERNELS=1
-if [ "$WEDGED" = 0 ]; then
-  echo "=== [tune] ptpu_tune kernels --place tpu" | tee -a $LOG
-  if bash "$LOCK" timeout -k 10 2400 python tools/ptpu_tune.py kernels \
-       --place tpu --json >/tmp/ptpu_tune_kernels.out 2>>$LOG; then
-    printf -- '- %s `ptpu_tune kernels --place tpu`\n  `%s`\n' \
-      "$(date -u +%FT%TZ)" "$(tail -1 /tmp/ptpu_tune_kernels.out)" \
-      >> BENCH_LOG.md
-  else
-    echo "- $(date -u +%FT%TZ) FAILED: ptpu_tune kernels (see $LOG)" \
-      >> BENCH_LOG.md
-  fi
-  bank
-fi
-probe && run 1800 BENCH_KERNELS=1
-# --- tier 2f: continuous-batched decode (PR 16, ARCHITECTURE.md §27) —
-# open-loop streams admitted/retired at iteration boundaries vs the same
-# streams decoded one at a time. Headline = continuous tokens/sec; the
-# line also carries speedup_vs_serial, mean_slot_occupancy and
-# divergence_vs_solo (the leg HARD-FAILS on any nonzero divergence, so a
-# banked line is a banked bit-exactness proof). CPU reference
-# (2026-08-06, tiny dims): ~2x vs serial at occupancy ~1.5, divergence 0.
-probe && run 1200 BENCH_DECODE=1 BENCH_DECODE_STREAMS=64 BENCH_DECODE_SLOTS=8
-probe && run 1200 BENCH_DECODE=1 BENCH_DECODE_STREAMS=96 BENCH_DECODE_SLOTS=16 BENCH_DECODE_TOKENS=48
-# --- tier 3: big compile LAST — one unrolled TPU line (K copies of the step)
-probe && run 2400 BENCH_BATCH=256 BENCH_DTYPE=bf16 BENCH_STEPS=32 BENCH_WARMUP=2 BENCH_MULTISTEP=8 FLAGS_multistep_unroll=1
-bank
-# r5's queue never got a healthy window (wedged all round): if this
-# window is still alive, run it too — its remat/flash-tune items are
-# still unmeasured and it probes/banks/exits on its own.
-[ "$WEDGED" = 0 ] && bash tools/perf_sweep_r5.sh
-echo "=== r6 sweep done (wedged=$WEDGED) ===" | tee -a $LOG
-exit $WEDGED
+exec python tools/ptpu_bench.py run --git-bank "$@"

@@ -1,0 +1,273 @@
+#!/usr/bin/env python3
+"""ptpu_bench — continuous hardware benching (paddle_tpu.benchd).
+
+    tools/ptpu_bench.py run [--store DIR] [--tier NAME] [--probe-timeout S]
+                        [--git-bank] [--json]
+        One hardware window NOW: probe the device once; when healthy,
+        take the client window lock and drain the queued sweep tiers
+        cheapest-first (resuming at the first tier without a done
+        marker), committing each banked JSON line to the bench store
+        and appending BENCH_LOG.md.  This is what perf_sweep_r*.sh
+        became: the shims exec it.  Exits nonzero when the window is
+        wedged/lock-busy so a probe loop leaves the sweep queued.
+
+    tools/ptpu_bench.py daemon [--store DIR] [--interval S]
+                        [--probe-timeout S] [--max-cycles N] [--git-bank]
+        The resident loop: probe every --interval seconds, drain on
+        each healthy window, publish ptpu_bench_* gauges, until the
+        queue is empty (or --max-cycles).
+
+    tools/ptpu_bench.py gate [--store DIR] [--fresh FILE.jsonl]
+                        [--json]
+        Perf-regression gate.  With --fresh, each line of FILE is a
+        bench record gated against the store's last-good baseline for
+        its (metric, device_kind, config) key; without it, the store
+        self-gates its newest record per key (the CI smoke mode over
+        the committed artifacts).  Error placeholders skip, never fail.
+
+    tools/ptpu_bench.py status [--store DIR] [--json]
+        The store summarized: the BENCH_r* driver series classified
+        (last-good baseline vs probe failures), last-good values per
+        key, queued/done sweep tiers, last daemon cycle.
+
+    tools/ptpu_bench.py reset-queue [--store DIR] [--tier NAME]
+        Re-queue one tier (or all) for the next window — the new-round
+        verb that editing NEXT_SWEEP used to be.
+
+Store/state default to <repo>/bench_store (first open backfills the
+committed BENCH_r*.json + BENCH_LOG.md lines).  `gate` and `status`
+never dial the tunnel; `run`/`daemon` probe it in a hard-deadlined
+subprocess and only ever touch the device from child processes.
+
+Exit codes: 0 ok (gate: no regressions; run: window drained), 1 gate
+regression / run window not drained (wedged, down, lock-busy), 2 bad
+invocation.
+"""
+import argparse
+import json
+import os
+import sys
+
+# the CLI process itself never initializes a device backend — probes
+# and sweep runs are subprocesses that drop this pin (benchd.probe)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _store_root(args):
+    return args.store or os.path.join(_REPO, "bench_store")
+
+
+def _open_store(args):
+    from paddle_tpu.benchd import BenchStore
+    return BenchStore(_store_root(args), repo_root=_REPO)
+
+
+def _tier_list(args):
+    from paddle_tpu.benchd import SWEEP_TIERS, tiers as _tiers
+    if getattr(args, "tier", None):
+        return [_tiers.tier_by_name(args.tier)]
+    return list(SWEEP_TIERS)
+
+
+def cmd_run(args):
+    from paddle_tpu.benchd import BenchDaemon
+    with BenchDaemon(repo_root=_REPO, state_dir=_store_root(args),
+                     tiers=_tier_list(args),
+                     probe_timeout_s=args.probe_timeout,
+                     git_bank=args.git_bank) as d:
+        cycle = d.run_once()
+    window = cycle.get("window") or {"state": cycle["probe"]["status"]}
+    if args.json:
+        print(json.dumps(cycle, indent=1, default=str))
+    else:
+        print("probe: %s" % cycle["probe"]["status"])
+        print("window: %s" % window.get("state"))
+        for name in window.get("banked", []):
+            print("  banked %s" % name)
+        for f in window.get("failed", []):
+            print("  FAILED %s: %s" % (f["tier"], f["error"]))
+        if window.get("pending_after"):
+            print("still queued: %s"
+                  % " ".join(window["pending_after"]))
+    return 0 if window.get("state") == "drained" else 1
+
+
+def cmd_daemon(args):
+    from paddle_tpu.benchd import BenchDaemon
+    with BenchDaemon(repo_root=_REPO, state_dir=_store_root(args),
+                     probe_timeout_s=args.probe_timeout,
+                     interval_s=args.interval,
+                     git_bank=args.git_bank) as d:
+        cycle = d.run_forever(max_cycles=args.max_cycles)
+    pending = (cycle.get("window") or {}).get("pending_after")
+    print("benchd: stopped; pending=%s" % (pending or "none"))
+    return 0
+
+
+def _load_fresh(path):
+    from paddle_tpu.benchd import schema
+    fresh = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            # accept bare records or store envelopes
+            if isinstance(rec, dict) and "record" in rec and "v" in rec:
+                env, rec = rec, rec["record"]
+            else:
+                env = {"record": rec}
+            schema.check_record(rec)
+            env.setdefault("metric", rec.get("metric"))
+            env.setdefault("device_kind", schema.device_kind(rec))
+            env.setdefault("digest", schema.config_digest(rec))
+            fresh.append(env)
+    return fresh
+
+
+def cmd_gate(args):
+    from paddle_tpu.benchd import run_gate
+    store = _open_store(args)
+    fresh = None
+    if args.fresh:
+        try:
+            fresh = _load_fresh(args.fresh)
+        except (OSError, ValueError) as e:
+            print("ptpu_bench gate: bad --fresh file: %s" % e,
+                  file=sys.stderr)
+            return 2
+    report = run_gate(store, fresh=fresh)
+    if args.json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        for v in report["verdicts"]:
+            mark = {"regression": "FAIL", "improvement": "GOOD"}.get(
+                v["verdict"], "ok")
+            print("%-4s %s" % (mark, v["detail"]))
+        print("gate: %d regression(s) across %d key(s)"
+              % (report["regressions"], len(report["verdicts"])))
+    return report["exit_code"]
+
+
+def cmd_status(args):
+    from paddle_tpu.benchd import SweepQueue, is_error
+    store = _open_store(args)
+    summ = store.summary()
+    driver = store.entries(source_prefix="backfill:BENCH_r")
+    driver_rows = []
+    for env in driver:
+        rec = env["record"]
+        driver_rows.append({
+            "source": env["source"].split(":", 1)[1],
+            "class": ("probe-failure" if is_error(rec)
+                      else "hardware-baseline"),
+            "value": rec.get("value"),
+            "error": rec.get("error"),
+        })
+    good_driver = [r["source"] for r in driver_rows
+                   if r["class"] == "hardware-baseline"]
+    queue = SweepQueue(os.path.join(_store_root(args), "sweep_state"))
+    status_path = os.path.join(_store_root(args), "status.json")
+    try:
+        with open(status_path) as f:
+            daemon_status = json.load(f)
+    except (OSError, ValueError):
+        daemon_status = None
+    out = {
+        "store": {"records": summ["records"], "errors": summ["errors"]},
+        "driver_series": {"rows": driver_rows,
+                          "last_good": good_driver},
+        "last_good": {
+            "%s @ %s" % k: {
+                "value": slot["last_good"]["record"]["value"],
+                "source": slot["last_good"]["source"],
+            }
+            for k, slot in sorted(summ["keys"].items())
+            if slot["last_good"] is not None},
+        "queue": queue.describe(),
+        "daemon": daemon_status,
+    }
+    if args.json:
+        print(json.dumps(out, indent=1, default=str))
+        return 0
+    print("bench store: %d record(s), %d error placeholder(s)"
+          % (summ["records"], summ["errors"]))
+    print("driver series (BENCH_r*.json):")
+    for row in driver_rows:
+        print("  %-16s %-18s %s"
+              % (row["source"], row["class"],
+                 row["error"] or row["value"]))
+    print("last-good baselines:")
+    for key, slot in sorted(out["last_good"].items()):
+        print("  %-60s %s  (%s)" % (key, slot["value"], slot["source"]))
+    q = out["queue"]
+    print("sweep queue: %d pending, %d done"
+          % (len(q["pending"]), len(q["done"])))
+    if daemon_status:
+        probe = daemon_status.get("cycle", {}).get("probe", {})
+        print("last daemon cycle: probe=%s counts=%s"
+              % (probe.get("status"), daemon_status.get("counts")))
+    return 0
+
+
+def cmd_reset_queue(args):
+    from paddle_tpu.benchd import SweepQueue
+    queue = SweepQueue(os.path.join(_store_root(args), "sweep_state"))
+    queue.reset(args.tier)
+    print("re-queued: %s" % (args.tier or "all tiers"))
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="ptpu_bench",
+        description="continuous hardware benching (paddle_tpu.benchd)")
+    p.add_argument("--store", default=None,
+                   help="store/state dir (default <repo>/bench_store)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    runp = sub.add_parser("run", help="drain one hardware window now")
+    runp.add_argument("--tier", default=None,
+                      help="run only this tier")
+    runp.add_argument("--probe-timeout", type=int, default=120)
+    runp.add_argument("--git-bank", action="store_true",
+                      help="git-commit BENCH_LOG.md after each banked "
+                           "line (the r6 rule)")
+    runp.add_argument("--json", action="store_true")
+    runp.set_defaults(fn=cmd_run)
+
+    dp = sub.add_parser("daemon", help="resident probe/drain loop")
+    dp.add_argument("--interval", type=int, default=1200,
+                    help="seconds between probes (default 1200 — the "
+                         "probe_loop_r5 cadence)")
+    dp.add_argument("--probe-timeout", type=int, default=120)
+    dp.add_argument("--max-cycles", type=int, default=None)
+    dp.add_argument("--git-bank", action="store_true")
+    dp.set_defaults(fn=cmd_daemon)
+
+    gp = sub.add_parser("gate", help="perf-regression gate")
+    gp.add_argument("--fresh", default=None,
+                    help="JSONL of fresh records to gate (default: "
+                         "self-gate the store's newest per key)")
+    gp.add_argument("--json", action="store_true")
+    gp.set_defaults(fn=cmd_gate)
+
+    sp = sub.add_parser("status", help="store + queue + daemon status")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_status)
+
+    rp = sub.add_parser("reset-queue", help="re-queue tiers")
+    rp.add_argument("--tier", default=None)
+    rp.set_defaults(fn=cmd_reset_queue)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
