@@ -47,6 +47,9 @@ def _error_line(msg):
     if os.environ.get("BENCH_RESIL") == "1":
         return {"metric": "resil_guarded_steps_per_sec", "value": 0.0,
                 "unit": "steps/sec", "vs_baseline": None, "error": msg}
+    if os.environ.get("BENCH_SENTINEL") == "1":
+        return {"metric": "sentinel_steps_per_sec", "value": 0.0,
+                "unit": "steps/sec", "vs_baseline": None, "error": msg}
     if os.environ.get("BENCH_COMPILE_CACHE") == "1":
         return {"metric": "compile_cache_serving_warmup", "value": 0.0,
                 "unit": "x cold/warm warmup_s", "vs_baseline": None,
@@ -1920,7 +1923,10 @@ def bench_resil():
     n_layers = int(os.environ.get("BENCH_RESIL_LAYERS", "10"))
     hidden = int(os.environ.get("BENCH_RESIL_HIDDEN", "64"))
     k = max(2, int(os.environ.get("BENCH_MULTISTEP", "8")))
-    repeats = max(1, int(os.environ.get("BENCH_RESIL_REPEATS", "3")))
+    # five rounds by default (was three): the PR-10-era flake analysis
+    # showed a single contention burst can survive three mins on a
+    # loaded CI box; with five, the min has slack to drop two bad rounds
+    repeats = max(1, int(os.environ.get("BENCH_RESIL_REPEATS", "5")))
 
     rng = np.random.RandomState(0)
     xs = jnp.asarray(rng.rand(batch, hidden).astype("float32"))
@@ -2012,6 +2018,161 @@ def bench_resil():
         "multistep_guarded_steps_per_sec": round(multi_on, 2),
         "overhead_pct_plain": overhead(plain_off, plain_on),
         "overhead_pct_multistep": overhead(multi_off, multi_on),
+        "device": str(jax.devices()[0]),
+    })
+
+
+def bench_sentinel():
+    """BENCH_SENTINEL=1: training-health monitoring overhead
+    (ARCHITECTURE.md §29). Trains the deep-narrow smoke MLP with the
+    sentinel's guard configuration (guards + the grad-norm stat channel)
+    and times four legs:
+
+        baseline        the gn-channel program, nothing watching it
+        sentinel        same PROGRAM + TrainingSentinel.observe per step
+                        (loss z-score + grad-norm z over the stat tap)
+        sentinel_canary same + one CanaryChecker dispatch every
+                        BENCH_SDC_EVERY steps (the SDC cadence cost)
+        nochannel       guards WITHOUT the stat channel (informational:
+                        what install_numeric_guards(grad_norm=True)
+                        itself adds in-graph)
+
+    The number this leg exists to defend is overhead_pct_sentinel <= 3%
+    (test_bench_sentinel_smoke asserts it): the monitor reads a loss the
+    loop already fetched and a grad norm that rode an existing transfer,
+    so its cost is host arithmetic on two floats. baseline and sentinel
+    deliberately run the SAME program (two scopes, one executable) so
+    the gated ratio isolates exactly that monitoring cost — XLA:CPU
+    run-to-run executable layout variance between two separately
+    compiled programs was measured at +-5% on this smoke model, which
+    would drown a 3% gate in compile-lottery noise. The in-graph channel
+    cost (two executables, unavoidably noisy at smoke scale) is emitted
+    as overhead_pct_channel for the benchd TPU tier to track, not gated.
+
+    Knobs: BENCH_STEPS, BENCH_WARMUP, BENCH_BATCH, BENCH_RESIL_LAYERS,
+    BENCH_RESIL_HIDDEN, BENCH_SDC_EVERY (canary cadence, default 16),
+    BENCH_SENTINEL_REPEATS (timed rounds; per-leg min taken).
+
+    Same deflake discipline as bench_resil (this leg also gates a
+    ratio on a shared CI box): the legs are timed in INTERLEAVED
+    rounds, each keeping its min across rounds, so a host-contention
+    burst slows a whole round together and the min drops the round."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as fluid
+    from paddle_tpu.resilience import install_numeric_guards
+    from paddle_tpu.resilience.sdc import CanaryChecker
+    from paddle_tpu.resilience.sentinel import TrainingSentinel
+
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    steps = max(1, int(os.environ.get("BENCH_STEPS", "64")))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    n_layers = int(os.environ.get("BENCH_RESIL_LAYERS", "10"))
+    hidden = int(os.environ.get("BENCH_RESIL_HIDDEN", "64"))
+    sdc_every = max(1, int(os.environ.get("BENCH_SDC_EVERY", "16")))
+    repeats = max(1, int(os.environ.get("BENCH_SENTINEL_REPEATS", "5")))
+
+    rng = np.random.RandomState(0)
+    xs = jnp.asarray(rng.rand(batch, hidden).astype("float32"))
+    ys = jnp.asarray(rng.rand(batch, 1).astype("float32"))
+    jax.block_until_ready((xs, ys))
+    feed = {"x": xs, "y": ys}
+
+    def build(grad_norm):
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard(), fluid.program_guard(main_prog,
+                                                            startup):
+            x = fluid.layers.data(name="x", shape=[hidden],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = x
+            for _ in range(n_layers):
+                h = fluid.layers.fc(input=h, size=hidden, act="relu")
+            p = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                x=fluid.layers.square_error_cost(input=p, label=y))
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        install_numeric_guards(main_prog, loss=loss, grad_norm=grad_norm)
+        return main_prog, startup, loss
+
+    exe = fluid.Executor(fluid.TPUPlace())
+
+    # detection intentionally lobotomized: the leg measures MONITORING
+    # cost, and a real verdict (a z spike, or the divergence trend — a
+    # converged loss oscillating around 1e-5 trips a 3x-median factor
+    # honestly) would divert a round into recovery bookkeeping
+    def fresh_sentinel():
+        return TrainingSentinel(window=64, warmup=8, z_threshold=1e9,
+                                divergence_patience=10 ** 9)
+
+    canary = CanaryChecker(shape=(64, 64), iters=2)
+    canary.record_reference()
+
+    gn_prog, gn_startup, gn_loss = build(True)
+    nc_prog, nc_startup, nc_loss = build(False)
+    legs = {}
+    for name, prog, startup, loss, monitored, with_canary in (
+            ("baseline", gn_prog, gn_startup, gn_loss, False, False),
+            ("sentinel", gn_prog, gn_startup, gn_loss, True, False),
+            ("sentinel_canary", gn_prog, gn_startup, gn_loss, True, True),
+            ("nochannel", nc_prog, nc_startup, nc_loss, False, False)):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(warmup):
+                exe.run(prog, feed=feed, fetch_list=[loss])
+        legs[name] = {"prog": prog, "loss": loss, "scope": scope,
+                      "monitored": monitored, "canary": with_canary,
+                      "best": None, "out": None}
+
+    for _ in range(repeats):
+        for leg in legs.values():
+            sentinel = fresh_sentinel() if leg["monitored"] else None
+            with fluid.scope_guard(leg["scope"]):
+                t0 = time.perf_counter()
+                for i in range(steps):
+                    out = exe.run(leg["prog"], feed=feed,
+                                  fetch_list=[leg["loss"]])
+                    leg["out"] = out
+                    if sentinel is not None:
+                        gn = exe.last_stats.get("grad_norm")
+                        err = sentinel.observe(
+                            float(np.asarray(out[0]).reshape(-1)[0]),
+                            grad_norm=None if gn is None
+                            else float(np.asarray(gn)), step=i)
+                        assert err is None, err
+                    if leg["canary"] and (i + 1) % sdc_every == 0:
+                        canary.check()
+                dt = time.perf_counter() - t0
+            leg["best"] = dt if leg["best"] is None \
+                else min(leg["best"], dt)
+    for name, leg in legs.items():
+        assert np.isfinite(np.asarray(leg["out"][0])).all(), \
+            "non-finite loss in %s leg" % name
+
+    baseline = steps / legs["baseline"]["best"]
+    monitored = steps / legs["sentinel"]["best"]
+    canaried = steps / legs["sentinel_canary"]["best"]
+    nochannel = steps / legs["nochannel"]["best"]
+
+    def overhead(off, on):
+        return round((off / on - 1.0) * 100.0, 2)
+
+    _emit({
+        "metric": "sentinel_steps_per_sec",
+        "value": round(monitored, 2),
+        "unit": "steps/sec",
+        "vs_baseline": None,
+        "batch": batch, "layers": n_layers, "hidden": hidden,
+        "steps": steps, "repeats": repeats, "sdc_every": sdc_every,
+        "baseline_steps_per_sec": round(baseline, 2),
+        "sentinel_steps_per_sec": round(monitored, 2),
+        "canary_steps_per_sec": round(canaried, 2),
+        "nochannel_steps_per_sec": round(nochannel, 2),
+        "overhead_pct_sentinel": overhead(baseline, monitored),
+        "overhead_pct_canary": overhead(baseline, canaried),
+        "overhead_pct_channel": overhead(nochannel, baseline),
+        "canary_checks": int(canary.checks),
         "device": str(jax.devices()[0]),
     })
 
@@ -2499,6 +2660,9 @@ def main():
         return
     if os.environ.get("BENCH_RESIL") == "1":
         bench_resil()
+        return
+    if os.environ.get("BENCH_SENTINEL") == "1":
+        bench_sentinel()
         return
     if os.environ.get("BENCH_SHARDED") == "1":
         bench_sharded()

@@ -46,7 +46,14 @@ NOISE_BANDS = {
     "pipeline_dispatch_open_qps": 0.20,
     "decode_continuous_tokens_per_sec": 0.15,
     "ckpt_async_steps_per_sec": 0.15,
-    "resil_guarded_steps_per_sec": 0.15,
+    # 0.20 (was 0.15): the PR-10 flake post-mortem — the resil leg
+    # gates a guard/no-guard RATIO on a dispatch-bound smoke model,
+    # where one executable relayout between bench store entries moves
+    # the headline past 15% with no code change; bench.py's min-of-five
+    # interleaved rounds shrinks within-run noise but cannot touch
+    # across-run compile lottery
+    "resil_guarded_steps_per_sec": 0.20,
+    "sentinel_steps_per_sec": 0.15,
 }
 
 # metrics where a SMALLER value is better. Every current headline is

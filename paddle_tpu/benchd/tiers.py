@@ -123,6 +123,13 @@ SWEEP_TIERS = [
          {"BENCH_DECODE": 1, "BENCH_DECODE_STREAMS": 96,
           "BENCH_DECODE_SLOTS": 16, "BENCH_DECODE_TOKENS": 48},
          priority=160),
+    # tier 2g: training-health sentinel (ARCHITECTURE.md §29) — monitor
+    # + canary-cadence overhead on the hardware; overhead_pct_channel
+    # (the in-graph grad-norm stat tap, too compile-noisy to gate on a
+    # CPU smoke box) is the number this tier exists to track
+    Tier("t2g-sentinel",
+         {"BENCH_SENTINEL": 1, "BENCH_STEPS": 32, "BENCH_WARMUP": 2},
+         priority=165),
     # tier 3k: kernel floor (PR 13) — fused-vs-unfused BEFORE the tile
     # sweep, the hardware tile search, then the SAME leg again so
     # tuned_vs_default is measured on the chip

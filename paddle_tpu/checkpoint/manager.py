@@ -145,6 +145,32 @@ def _live_sharding_spec(val):
     return spec if any(p is not None for p in spec) else None
 
 
+def skip_reader_records(scope, reader_names, skip):
+    """Advance live reader streams past `skip` records each (or
+    per-reader counts when `skip` is a {name: count} dict) by pulling
+    and DISCARDING records — the data-routing half of
+    rollback_skip_data. A discarded record that raises while being read
+    still counts (skipping a poisoned record is the point); EOF
+    propagates. Returns the total number of records discarded."""
+    from ..core.readers import EOFException
+    per = skip if isinstance(skip, dict) else None
+    total = 0
+    for rname in reader_names:
+        live = scope.get(rname)
+        if live is None or not hasattr(live, "next"):
+            continue
+        want = int(per.get(rname, 0)) if per is not None else int(skip)
+        for _ in range(max(0, want)):
+            try:
+                live.next()
+            except EOFException:
+                raise
+            except Exception:
+                pass
+            total += 1
+    return total
+
+
 class SaveHandle(object):
     """One in-flight (or finished) save. `result()` blocks until the
     snapshot is published and returns its directory; a failed save
@@ -485,7 +511,8 @@ class CheckpointManager(object):
         return [s for s, _ in _snap.list_steps(self.checkpoint_dir)]
 
     def restore(self, program=None, scope=None, executor=None, step=None,
-                allow_missing=False, before=None, layout=None):
+                allow_missing=False, before=None, layout=None,
+                skip_records=None):
         """Load the newest VALID snapshot (or `step`) into `scope`:
         persistable values, reader positions, seed cursor. Returns the
         restored step, or None when no snapshot exists at all. A snapshot
@@ -523,7 +550,18 @@ class CheckpointManager(object):
         (M>N) and same-shape (M=N) all load the same bytes; at M=N the
         values are bit-identical to a plain restore. A layout the live
         process cannot satisfy (fewer devices than it names) raises
-        before anything lands in the scope."""
+        before anything lands in the scope.
+
+        `skip_records` (int, or {reader_name: int}) advances each
+        restored reader stream PAST that many records after its position
+        is replayed — the data half of the sentinel's
+        rollback_skip_data action (ARCHITECTURE.md §29): restore the
+        newest snapshot, then route every stream around the offending
+        batch window, so the resumed run is bit-exact vs a from-scratch
+        run that never saw those records. EOF while skipping propagates
+        (the window ran off the end of the epoch); a record that raises
+        while being discarded is still counted as skipped — discarding
+        a poisoned record is the point."""
         del executor  # parity with io signatures; scope is the store
         from ..core.executor import global_scope
         scope = scope if scope is not None else global_scope()
@@ -619,6 +657,8 @@ class CheckpointManager(object):
                 live = scope.get(rname)
                 if hasattr(live, "load_state_dict"):
                     live.load_state_dict(rstate)
+            if skip_records:
+                skip_reader_records(scope, reader_states, skip_records)
             return found_step
         if step is not None:
             raise ValueError(

@@ -471,12 +471,16 @@ def _raise_program_errors(errors, include_non_guard=True):
     installed) only guard messages are considered. A \\x00-joined key
     carries a VECTOR of flags (check_finite_guard packs its per-var
     checks into one output); it is unpacked here, one sync, after
-    __any__ tripped."""
-    if not errors or not bool(errors["__any__"]):
+    __any__ tripped. GUARD_STAT_PREFIX keys are float statistics, not
+    assertions — normally peeled off by pop_guard_stats before this
+    runs, but skipped here too so a caller that didn't peel stays
+    correct."""
+    from .lowering import is_stat_key
+    if not errors or not bool(errors.get("__any__", False)):
         return
     tripped = []
     for msg, flag in errors.items():
-        if msg == "__any__":
+        if msg == "__any__" or is_stat_key(msg):
             continue
         if "\x00" in msg:
             vals = np.asarray(flag)
@@ -499,6 +503,22 @@ def _raise_program_errors(errors, include_non_guard=True):
     raise cls(
         "%d in-graph assertions tripped in this run:\n- %s"
         % (len(ordered), "\n- ".join(ordered)))
+
+
+def pop_guard_stats(errors):
+    """Peel GUARD_STAT_PREFIX float statistics out of a dispatch's error
+    dict (in place), returning {short_name: device_value}. Called right
+    after the jitted call, BEFORE any error sync — the values stay
+    device-resident (no host sync here); the sentinel materializes them
+    lazily after the executor's existing __any__ sync, so the grad-norm
+    watch adds zero host round-trips to the dispatch path."""
+    if not errors:
+        return {}
+    from .lowering import GUARD_STAT_PREFIX, is_stat_key
+    stats = {}
+    for msg in [m for m in errors if is_stat_key(m)]:
+        stats[msg[len(GUARD_STAT_PREFIX):]] = errors.pop(msg)
+    return stats
 
 
 def _validate_program_flag():
@@ -608,6 +628,9 @@ class Executor(object):
         self._has_read = {}  # (uid, version) -> program has `read` ops
         self._last_ready_t = None  # profiling: previous dispatch's
         # completion time, for the device-idle-gap column
+        self.last_stats = {}  # guard stat channel (grad_norm, ...):
+        # device-resident values peeled off the newest dispatch's error
+        # dict — the sentinel's zero-extra-sync tap
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, steps=1,
@@ -938,6 +961,9 @@ class Executor(object):
         if fell_back:
             compiled, aot_hit, aot_saved, aot_entry = \
                 True, False, 0.0, None
+        # sentinel stat tap: peel float statistics (grad norm) off the
+        # error dict before any error sync; values stay device-resident
+        self.last_stats = pop_guard_stats(errors)
         dsp.end(compiled=compiled, aot_hit=aot_hit)
         if cancelled is not None and cancelled.is_set():
             # the caller already raised DispatchTimeoutError and may be

@@ -151,11 +151,21 @@ class LowerCtx(object):
     def add_error(self, message, flag):
         """Record an in-graph assertion (checkify-style). Only valid at the
         top trace level — flags minted inside lax sub-block traces cannot
-        escape them, so callers inside loops are skipped."""
+        escape them, so callers inside loops are skipped.
+
+        A GUARD_STAT_PREFIX message carries a float STATISTIC, not a
+        boolean assertion: it rides the same error channel (so it costs
+        zero extra host syncs — the executor peels it off after dispatch)
+        but folds with max instead of OR and never trips __any__."""
         if self._loop_iters:
             return
         prev = self.op_errors.get(message)
-        self.op_errors[message] = flag if prev is None else (prev | flag)
+        if prev is None:
+            self.op_errors[message] = flag
+        elif is_stat_key(message):
+            self.op_errors[message] = jnp.maximum(prev, flag)
+        else:
+            self.op_errors[message] = prev | flag
 
     def begin_op(self, salt):
         self._op_salt = salt
@@ -415,6 +425,26 @@ def _lower_block_remat(ctx, ops, env):
 # overflow flags. Control-flow lowerings thread it through their loop
 # carries so flags raised inside nested lax bodies reach the top level.
 PROGRAM_ERR = "__tensor_array_overflow__"
+
+# Error-channel keys with this prefix carry float STATISTICS (e.g. the
+# sentinel's global grad-norm scalar) instead of boolean assertion
+# flags: they fold across steps with max (the K-block's worst value —
+# exactly what a spike detector wants), are excluded from the __any__
+# reduction, and are peeled off by the executor into `last_stats`
+# before error unpacking. The \x00 prefix keeps the namespace disjoint
+# from every human-readable assertion message.
+GUARD_STAT_PREFIX = "\x00stat\x00"
+
+
+def is_stat_key(message):
+    return message.startswith(GUARD_STAT_PREFIX)
+
+
+def fold_errors(acc, errors):
+    """Accumulate one step's error dict into the running accumulator:
+    sticky OR for assertion flags, max for GUARD_STAT_PREFIX stats."""
+    return {m: (jnp.maximum(acc[m], errors[m]) if is_stat_key(m)
+                else acc[m] | errors[m]) for m in acc}
 
 
 def accumulate_error(env, flag):
@@ -687,8 +717,12 @@ def build_program_fn(program, feed_names, fetch_names, state_rw, state_ro,
                 # its per-var flags into one [N] output — N+1 scalar
                 # outputs cost real per-dispatch marshalling time);
                 # vectors fold in via .any() so __any__ stays scalar.
+                # GUARD_STAT_PREFIX entries are float statistics riding
+                # the channel, not assertions — they never trip __any__.
                 any_flag = jnp.asarray(False)
-                for f in errors.values():
+                for m, f in errors.items():
+                    if is_stat_key(m):
+                        continue
                     any_flag = any_flag | (
                         f.any() if getattr(f, "ndim", 0) else f)
                 errors["__any__"] = any_flag
@@ -811,7 +845,7 @@ def lower_multi_step(program, feed_names, fetch_names, state_rw, state_ro,
                     cur_feeds, rw_vals, state_ro_vals,
                     jnp.asarray(seed, jnp.uint32) + jnp.uint32(i))
                 err_acc = errors if err_acc is None else \
-                    {m: err_acc[m] | errors[m] for m in err_acc}
+                    fold_errors(err_acc, errors)
                 if fetch_reduce == "mean":
                     fetch_acc = (
                         [f.astype(_mean_acc_dtype(f.dtype)) for f in fetches]
@@ -869,7 +903,7 @@ def lower_multi_step(program, feed_names, fetch_names, state_rw, state_ro,
             rw_vals = [state_vals[out_pos[n]] for n in state_rw]
             fetches, new_state, errors = step_fn(
                 cur_feeds, rw_vals, state_ro_vals, step_seed)
-            err_acc = {m: err_acc[m] | errors[m] for m in err_acc}
+            err_acc = fold_errors(err_acc, errors)
             if fetch_reduce == "mean":
                 fetch_acc = [a + f.astype(a.dtype)
                              for a, f in zip(fetch_acc, fetches)]

@@ -486,6 +486,7 @@ def watch_cluster(cluster_dir, heartbeat_timeout=3.0, registry=None):
         rows = HeartbeatMonitor(cdir,
                                 timeout=heartbeat_timeout).fleet_view()
         gen, age, step, behind, alive = [], [], [], [], []
+        zscores, spikes, checks, mism = [], [], [], []
         for r in rows:
             lbl = {"cluster": cluster_label, "worker": r["worker"]}
             gen.append((lbl, r["gen"]))
@@ -498,6 +499,23 @@ def watch_cluster(cluster_dir, heartbeat_timeout=3.0, registry=None):
                 # '-' for the same row
                 behind.append((lbl, r["steps_behind"]))
             alive.append((lbl, 1.0 if r["alive"] else 0.0))
+            sent = r.get("sentinel") or {}
+            if sent.get("z") is not None:
+                zscores.append((lbl, float(sent["z"])))
+            if sent:
+                spikes.append((lbl, int(sent.get("spikes", 0))))
+            sdc = r.get("sdc") or {}
+            if sdc:
+                checks.append((lbl, int(sdc.get("checks", 0))))
+                mism.append((lbl, int(sdc.get("mismatches", 0))))
+        # the per-device quarantine list lives in the PLAN, not in any
+        # worker's heartbeat (the convicted worker may be gone)
+        quar = []
+        from ..resilience.cluster import read_plan
+        plan = read_plan(cdir) or {}
+        for wid, devs in sorted((plan.get("quarantine") or {}).items()):
+            quar.append(({"cluster": cluster_label, "worker": wid},
+                         len(devs)))
         return [
             ("ptpu_cluster_worker_generation", "gauge",
              "plan generation each worker last reported", gen),
@@ -510,6 +528,20 @@ def watch_cluster(cluster_dir, heartbeat_timeout=3.0, registry=None):
             ("ptpu_cluster_worker_alive", "gauge",
              "the heartbeat monitor's liveness verdict (staleness + "
              "same-host pid check)", alive),
+            ("ptpu_cluster_worker_loss_zscore", "gauge",
+             "the training sentinel's last robust loss z-score",
+             zscores),
+            ("ptpu_cluster_worker_loss_spikes_total", "counter",
+             "loss/grad spikes the sentinel detected on this worker",
+             spikes),
+            ("ptpu_cluster_worker_sdc_checks_total", "counter",
+             "SDC canary checks this worker ran", checks),
+            ("ptpu_cluster_worker_sdc_mismatches_total", "counter",
+             "canary digest mismatches (silent-data-corruption "
+             "convictions)", mism),
+            ("ptpu_cluster_quarantined_devices", "gauge",
+             "devices the coordinator quarantined per worker (from the "
+             "published plan)", quar),
         ]
 
     with registry._watch_lock:

@@ -20,7 +20,13 @@ into a self-protecting one without touching any optimizer rule:
 """
 import jax.numpy as jnp
 
+from ..core.lowering import GUARD_STAT_PREFIX
 from ..core.registry import register, single
+
+# stat-channel key for the sentinel's global gradient norm (see
+# resilience/sentinel.py): a float scalar riding the guard error
+# channel, peeled into Executor.last_stats after dispatch
+GRAD_NORM_STAT = GUARD_STAT_PREFIX + "grad_norm"
 
 
 @register("check_finite_guard")
@@ -29,6 +35,18 @@ def _check_finite_guard(ctx, ins, attrs):
     vals = ins.get("X", [])
     floats = [(n, v) for n, v in zip(names, vals)
               if jnp.issubdtype(jnp.result_type(v), jnp.floating)]
+    if attrs.get("grad_norm_vars"):
+        # sentinel tap: ONE f32 global L2 norm over the named subset
+        # (the param grads), emitted on the stat channel — it shares
+        # the existing fetch of the error dict, so the sentinel's
+        # grad-norm watch costs zero additional host syncs. f32
+        # accumulation so bf16 grads don't overflow the square.
+        watch = frozenset(attrs["grad_norm_vars"])
+        sq = [jnp.sum(jnp.square(v.astype(jnp.float32)))
+              for n, v in floats if n in watch]
+        if sq:
+            gn = jnp.sqrt(sum(sq[1:], sq[0]))
+            ctx.add_error(GRAD_NORM_STAT, gn)
     if not floats:
         return {"Out": [jnp.ones((1,), jnp.bool_)]}
     if attrs.get("granular", True):

@@ -67,11 +67,12 @@ class DeviceLayout(object):
     re-divide that axis on the target layout's mesh."""
 
     __slots__ = ("num_processes", "process_index", "local_device_count",
-                 "mesh_axes", "batch_axis", "shard_axis")
+                 "mesh_axes", "batch_axis", "shard_axis",
+                 "skip_local_devices")
 
     def __init__(self, num_processes=1, process_index=0,
                  local_device_count=None, mesh_axes=None, batch_axis="dp",
-                 shard_axis=None):
+                 shard_axis=None, skip_local_devices=None):
         self.num_processes = int(num_processes)
         self.process_index = int(process_index)
         if not (0 <= self.process_index < self.num_processes):
@@ -87,6 +88,13 @@ class DeviceLayout(object):
                 "shard_axis %r is not one of the layout's mesh axes %r"
                 % (shard_axis, sorted(self.mesh_axes)))
         self.shard_axis = shard_axis
+        # local device indices this process must NOT use — the cluster
+        # coordinator's per-device QUARANTINE list (a chip the SDC
+        # canary convicted, resilience/sdc.py): the local mesh is built
+        # from the remaining devices, so a resharded generation trains
+        # around the bad chip without dropping the whole host
+        self.skip_local_devices = tuple(
+            sorted(set(int(i) for i in (skip_local_devices or ()))))
 
     @property
     def total_device_count(self):
@@ -97,19 +105,32 @@ class DeviceLayout(object):
 
     def resolved_local_device_count(self):
         return (self.local_device_count if self.local_device_count
-                is not None else len(jax.devices()))
+                is not None
+                else len(jax.devices()) - len(self.skip_local_devices))
+
+    def local_devices(self):
+        """This process's usable devices in index order — every live
+        device minus the quarantined indices. The canary checker and
+        `local_mesh()` draw from the same list, so a convicted chip is
+        neither trained on nor re-canaried."""
+        skip = set(self.skip_local_devices)
+        return [d for i, d in enumerate(jax.devices()) if i not in skip]
 
     def local_mesh(self):
         """The Mesh over this process's slice of devices. With fewer
-        live devices than the layout asks for, raises — a silent
-        smaller mesh would break the cohort's divisibility contract."""
+        live (non-quarantined) devices than the layout asks for, raises
+        — a silent smaller mesh would break the cohort's divisibility
+        contract."""
         want = self.resolved_local_device_count()
-        devices = jax.devices()
-        if len(devices) < want:
+        devices = self.local_devices()
+        if len(devices) < want or want < 1:
             raise ValueError(
-                "DeviceLayout wants %d local devices but only %d exist "
+                "DeviceLayout wants %d local devices but only %d usable "
+                "(%d quarantined) "
                 "(XLA_FLAGS=--xla_force_host_platform_device_count=%d "
-                "for a virtual CPU mesh)" % (want, len(devices), want))
+                "for a virtual CPU mesh)"
+                % (want, len(devices), len(self.skip_local_devices),
+                   max(1, want)))
         return make_mesh(self.mesh_axes, devices[:want])
 
     def resolved_shard_axis(self):
@@ -119,12 +140,15 @@ class DeviceLayout(object):
             else self.batch_axis
 
     def to_json(self):
-        return {"num_processes": self.num_processes,
-                "process_index": self.process_index,
-                "local_device_count": self.local_device_count,
-                "mesh_axes": dict(self.mesh_axes),
-                "batch_axis": self.batch_axis,
-                "shard_axis": self.shard_axis}
+        out = {"num_processes": self.num_processes,
+               "process_index": self.process_index,
+               "local_device_count": self.local_device_count,
+               "mesh_axes": dict(self.mesh_axes),
+               "batch_axis": self.batch_axis,
+               "shard_axis": self.shard_axis}
+        if self.skip_local_devices:
+            out["skip_local_devices"] = list(self.skip_local_devices)
+        return out
 
     @classmethod
     def from_json(cls, d):
@@ -133,7 +157,8 @@ class DeviceLayout(object):
                    local_device_count=d.get("local_device_count"),
                    mesh_axes=d.get("mesh_axes"),
                    batch_axis=d.get("batch_axis", "dp"),
-                   shard_axis=d.get("shard_axis"))
+                   shard_axis=d.get("shard_axis"),
+                   skip_local_devices=d.get("skip_local_devices"))
 
     def __eq__(self, other):
         return isinstance(other, DeviceLayout) \
@@ -144,11 +169,13 @@ class DeviceLayout(object):
 
     def __repr__(self):
         return ("DeviceLayout(procs=%d, rank=%d, local_devices=%s, "
-                "axes=%r%s)" % (
+                "axes=%r%s%s)" % (
                     self.num_processes, self.process_index,
                     self.local_device_count, self.mesh_axes,
                     ", shard_axis=%r" % self.shard_axis
-                    if self.shard_axis is not None else ""))
+                    if self.shard_axis is not None else "",
+                    ", quarantined=%r" % list(self.skip_local_devices)
+                    if self.skip_local_devices else ""))
 
 
 def active_layout():

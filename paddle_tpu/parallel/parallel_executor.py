@@ -124,6 +124,7 @@ class ParallelExecutor(object):
         # shards (or the caller pinned); anything absent is replicated
         self._param_shardings = plan.spec_map()
         self._cache = collections.OrderedDict()
+        self.last_stats = {}  # guard stat channel (see Executor)
         # XLA:CPU collectives deadlock when several executions are in
         # flight at once (each rendezvous needs one thread per virtual
         # device; concurrent programs starve the pool and abort). Real TPU
@@ -526,6 +527,10 @@ class ParallelExecutor(object):
         if fell_back:
             compiled, aot_hit, aot_saved, aot_entry = \
                 True, False, 0.0, None
+        # sentinel stat tap: peel float statistics (grad norm) off the
+        # error dict before any error sync (see Executor._run_impl)
+        from ..core.executor import pop_guard_stats
+        self.last_stats = pop_guard_stats(errors)
         dsp.end(compiled=compiled, aot_hit=aot_hit)
         if cancelled is not None and cancelled.is_set():
             # caller already raised DispatchTimeoutError; a late scope

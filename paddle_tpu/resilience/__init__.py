@@ -17,13 +17,25 @@ PR-4 checkpoints, and the reader stack:
                 reader / dispatch) an escalation chain of skip_batch →
                 retry(backoff) → rollback(lr_scale) → abort(bundle),
                 every action in a structured event log + profiler tags.
+  * sentinel  — the training-health layer (ARCHITECTURE.md §29):
+                streaming robust statistics (median/MAD z-scores) over
+                the loss and the guard-stat grad norm catching
+                finite-but-WRONG steps — loss spikes (→ the PaLM-style
+                rollback_skip_data: restore + route the reader streams
+                past the bad window) and slow divergence.
+  * sdc       — silent-data-corruption detection: a deterministic
+                canary dispatch on a rotating device, digest-compared
+                against a recorded reference; in the elastic cluster a
+                mismatch quarantines the device (fence/rollback/
+                reshard, per-device).
   * faults    — a deterministic fault plan (`PTPU_FAULT_PLAN` env or
                 programmatic) injecting NaN feeds, reader stalls/EOFs/
                 errors, dispatch exceptions, slow steps, checkpoint
-                kills — and cluster faults: whole-worker SIGKILLs
-                (`host_death`) and heartbeat stalls — at chosen
-                indices, so every recovery path above is provable in
-                CI.
+                kills, finite bad batches (`loss_spike`/`grad_blowup`),
+                canary bit flips (`bitflip`) — and cluster faults:
+                whole-worker SIGKILLs (`host_death`) and heartbeat
+                stalls — at chosen indices, so every recovery path
+                above is provable in CI.
   * cluster   — the elastic multi-host layer (ARCHITECTURE.md §19): a
                 ClusterCoordinator that heartbeat-monitors a cohort of
                 ElasticWorkers, fences it on host death, rolls every
@@ -50,9 +62,12 @@ from .faults import (FaultPlan, InjectedDispatchError, InjectedFault,
                      InjectedReaderError, active_plan)
 from .guards import (DivergenceDetector, DivergenceFault,
                      install_numeric_guards)
+from .sentinel import (DivergenceError, LossSpikeError, RobustWindow,
+                       TrainingSentinel)
+from .sdc import CanaryChecker, SilentCorruptionError
 from .supervisor import (DEFAULT_POLICIES, FAULT_CLASSES, Action,
                          Supervisor, TrainingAborted, abort, retry,
-                         rollback, skip_batch)
+                         rollback, rollback_skip_data, skip_batch)
 from .watchdog import read_bundle, write_bundle
 from .heartbeat import HeartbeatMonitor, HeartbeatWriter, read_heartbeats
 from .cluster import (ClusterAborted, ClusterCoordinator, ClusterFenced,
@@ -60,7 +75,10 @@ from .cluster import (ClusterAborted, ClusterCoordinator, ClusterFenced,
 
 __all__ = [
     "Supervisor", "TrainingAborted", "Action", "skip_batch", "retry",
-    "rollback", "abort", "DEFAULT_POLICIES", "FAULT_CLASSES",
+    "rollback", "rollback_skip_data", "abort", "DEFAULT_POLICIES",
+    "FAULT_CLASSES",
+    "TrainingSentinel", "RobustWindow", "LossSpikeError",
+    "DivergenceError", "CanaryChecker", "SilentCorruptionError",
     "install_numeric_guards", "DivergenceDetector", "DivergenceFault",
     "NumericalGuardError", "DispatchTimeoutError",
     "FaultPlan", "InjectedFault", "InjectedDispatchError",

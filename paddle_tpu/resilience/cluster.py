@@ -38,6 +38,16 @@ recorded under N devices re-split onto the new M-device mesh. Training
 resumes bit-exact with a from-scratch run on the small mesh resumed
 from the same snapshot.
 
+Silent data corruption (ARCHITECTURE.md §29) follows the same flow at
+DEVICE granularity: a worker whose SDC canary (resilience/sdc.py)
+convicts a chip escalates with `sdc_device` in its heartbeat; the
+coordinator QUARANTINES that device — records it, publishes the
+quarantine list in every plan, subtracts it from the member's device
+budget — and runs the ordinary fence/rollback/reshard. The member's
+next DeviceLayout builds its mesh around the bad chip
+(skip_local_devices); a member with no devices left drops out of the
+world entirely. `ptpu_elastic status` surfaces the list.
+
 Growth (replacement-worker join) is the same fence, minus the rollback:
 the coordinator fences AT a step barrier with `save_step` set, rank 0
 snapshots its current step, and the new run-phase plan pins exactly
@@ -76,6 +86,8 @@ from ..parallel import distributed as _dist
 from ..parallel.distributed import DeviceLayout
 from . import faults as _faults
 from . import heartbeat as _hb
+from .sdc import CanaryChecker, SilentCorruptionError
+from .sentinel import TrainingSentinel
 from .supervisor import Supervisor, TrainingAborted, abort as _abort_action
 
 __all__ = ["ClusterCoordinator", "ElasticWorker", "ClusterFenced",
@@ -189,6 +201,12 @@ class ClusterCoordinator(object):
         self.events = []
         self.gen = 0
         self.world = {}       # worker_id -> {"rank", "local_device_count"}
+        # worker_id -> sorted list of local device indices the SDC
+        # canary convicted (resilience/sdc.py). Published in every
+        # plan; _assign_world subtracts them from the member's device
+        # budget and the member's DeviceLayout builds its mesh around
+        # them. A member with no devices left is dropped entirely.
+        self.quarantine = {}
         self.rescales = 0
         self._plans = []      # published plan history (merged bundle)
         # a restarted cluster reuses its directory (that is how it finds
@@ -223,7 +241,9 @@ class ClusterCoordinator(object):
         plan = dict(extra, gen=self.gen, phase=phase, world=world,
                     num_workers=len(world),
                     checkpoint_dir=self.checkpoint_dir,
-                    batch_axis=self.batch_axis)
+                    batch_axis=self.batch_axis,
+                    quarantine={w: sorted(d)
+                                for w, d in self.quarantine.items() if d})
         if self.mesh_axes:
             plan["mesh_axes"] = self.mesh_axes
         if self.shard_axis is not None:
@@ -236,20 +256,59 @@ class ClusterCoordinator(object):
     def _assign_world(self, worker_ids):
         """Deterministic rank + device assignment for a cohort: ranks in
         sorted worker_id order; local device counts per the configured
-        policy (fixed total budget re-split, or uniform)."""
-        world = {}
-        n = max(1, len(worker_ids))
-        for rank, wid in enumerate(sorted(worker_ids)):
-            if self.total_device_count is not None:
-                local = max(1, int(self.total_device_count) // n)
-            else:
-                local = self.local_device_count
-            world[wid] = {"rank": rank, "local_device_count": local}
-        return world
+        policy (fixed total budget re-split, or uniform), MINUS each
+        member's quarantined devices. A member whose quarantine covers
+        its whole device budget is dropped from the world (and the
+        budget re-split over the rest — which can re-trip the check, so
+        iterate to a fixed point); with an unconfigured device count the
+        member's own DeviceLayout subtracts, worker-side."""
+        ids = sorted(set(worker_ids))
+        while True:
+            n = max(1, len(ids))
+            dropped = []
+            world = {}
+            for rank, wid in enumerate(ids):
+                if self.total_device_count is not None:
+                    local = max(1, int(self.total_device_count) // n)
+                else:
+                    local = self.local_device_count
+                lost = len(self.quarantine.get(wid, ()))
+                if local is not None and lost:
+                    local -= lost
+                    if local < 1:
+                        dropped.append(wid)
+                        continue
+                world[wid] = {"rank": rank, "local_device_count": local}
+            if not dropped:
+                return world
+            self._log("member_out_of_devices", dropped=sorted(dropped),
+                      quarantine={w: sorted(self.quarantine.get(w, ()))
+                                  for w in dropped})
+            ids = [w for w in ids if w not in dropped]
+            if not ids:
+                return {}
 
     def _newest_snapshot_step(self):
         found = find_valid_snapshot(self.checkpoint_dir)
         return None if found is None else int(found[0])
+
+    def _note_quarantine(self, faulted, beats):
+        """A faulted member whose heartbeat names an `sdc_device` (the
+        canary convicted a chip, resilience/sdc.py) gets that device
+        QUARANTINED: recorded here, subtracted from the member's budget
+        by _assign_world, published in every later plan so the member's
+        DeviceLayout builds its mesh around it. The rescale that follows
+        is the ordinary fence/rollback/reshard — a bad chip is handled
+        exactly like a dead host, but at device granularity."""
+        for w in faulted:
+            dev = beats.get(w, {}).get("sdc_device")
+            if dev is None:
+                continue
+            devs = self.quarantine.setdefault(w, [])
+            if int(dev) not in devs:
+                devs.append(int(dev))
+                self._log("quarantine", worker=w, device=int(dev),
+                          fault=beats[w].get("fault"))
 
     # -------------------------------------------------------- main loop --
     def run(self, deadline=None):
@@ -281,6 +340,7 @@ class ClusterCoordinator(object):
                        and beats[w].get("status") == "fault"
                        and beats[w].get("gen") == self.gen]
             if dead or faulted:
+                self._note_quarantine(faulted, beats)
                 self._rescale(dead, faulted, beats)
                 continue
             joiners = [w for w, hb in beats.items()
@@ -338,10 +398,15 @@ class ClusterCoordinator(object):
             self._abort("no survivors after: %s" % reason)
         restore = self._newest_snapshot_step()
         self.world = self._assign_world(survivors)
+        if not self.world:
+            self._abort("quarantine left no usable devices after: %s"
+                        % reason)
         self._publish("run", self.world, restore_step=restore,
                       reason="rescale: " + reason)
         self._log("rescale", survivors=sorted(survivors),
-                  restore_step=restore, reason=reason)
+                  restore_step=restore, reason=reason,
+                  quarantine={w: sorted(d)
+                              for w, d in self.quarantine.items() if d})
 
     def _fence(self, members, reason, save_step=False):
         """Publish a fence-phase plan and wait for every member's ack
@@ -480,7 +545,8 @@ class ElasticWorker(object):
                  plan_timeout=180.0, record_results=True,
                  async_save=False, sharded_weight_update=False,
                  step_delay=0.0, metrics_port=None,
-                 metrics_host="127.0.0.1"):
+                 metrics_host="127.0.0.1", sentinel=None, sdc=None,
+                 sdc_every=64):
         """One cohort member. `build_fn(layout)` -> dict with keys
         `main`, `startup`, `loss` (Variable or name) and optionally
         `feed_fn(step_index)` (deterministic feeds; omit for reader-fed
@@ -526,6 +592,20 @@ class ElasticWorker(object):
         self.metrics_port = metrics_port
         self.metrics_host = metrics_host
         self._metrics_server = None
+        # training-health layer (ARCHITECTURE.md §29). `sentinel` /
+        # `sdc`: True for defaults, or a kwargs dict for the
+        # TrainingSentinel / CanaryChecker constructors. Both are
+        # rebuilt per generation (the sentinel's window restarts with
+        # the restored stream; the canary's device rotation follows the
+        # resharded, quarantine-filtered mesh) but the canary's
+        # REFERENCE digest persists across generations — it must, or a
+        # degraded chip joining a new generation would record its own
+        # wrong answer as truth.
+        self.sentinel_opts = sentinel
+        self.sdc_opts = sdc
+        self.sdc_every = sdc_every
+        self._sdc_state = None
+        self._sdc_device_map = None
         self._hb_writer = _hb.HeartbeatWriter(
             cluster_dir, worker_id, interval=heartbeat_interval)
         self._plan_path = os.path.join(self.cluster_dir, PLAN_FILE)
@@ -687,7 +767,11 @@ class ElasticWorker(object):
             # the cohort's update-state shard axis (parallel/plan.py)
             # rides the cluster plan so a resharded generation keeps
             # the sharded-update layout the snapshot recorded
-            shard_axis=plan.get("shard_axis"))
+            shard_axis=plan.get("shard_axis"),
+            # devices the coordinator quarantined on THIS worker (SDC
+            # canary convictions): the local mesh is built around them
+            skip_local_devices=plan.get("quarantine", {}).get(
+                self.worker_id))
 
     def _run_generation(self, plan, num_steps):
         from ..parallel.parallel_executor import ParallelExecutor
@@ -729,7 +813,10 @@ class ElasticWorker(object):
                     watchdog_timeout=self.watchdog_timeout,
                     bundle_dir=os.path.join(self.cluster_dir, "bundles",
                                             self.worker_id),
-                    restore_layout=layout)
+                    restore_layout=layout,
+                    sentinel=self._make_sentinel(),
+                    sdc=self._make_sdc(layout),
+                    sdc_every=self.sdc_every)
                 sup.step = step
                 self._hb_writer.update(status="ok", step=step)
                 _exe_mod._barrier_hook = self._barrier_check
@@ -739,11 +826,46 @@ class ElasticWorker(object):
             _exe_mod._barrier_hook = prev_hook
             self._armed_gen = None
             if sup is not None:
+                if sup.sdc is not None:
+                    # the reference digest survives the generation; the
+                    # next generation's canary compares against it
+                    self._sdc_state = sup.sdc.state_dict()
                 sup.close()
             try:
                 mgr.close()
             except Exception:  # noqa: BLE001 — a failed final save must
                 pass           # not mask the loop's own outcome
+
+    def _make_sentinel(self):
+        if not self.sentinel_opts:
+            return None
+        opts = (dict(self.sentinel_opts)
+                if isinstance(self.sentinel_opts, dict) else {})
+        return TrainingSentinel(**opts)
+
+    def _make_sdc(self, layout):
+        """This generation's canary checker: rotation over exactly the
+        devices the generation's mesh uses (quarantined chips excluded
+        — a convicted device is neither trained on nor re-canaried),
+        reference digest carried over from the previous generation."""
+        if not self.sdc_opts:
+            self._sdc_device_map = None
+            return None
+        opts = dict(self.sdc_opts) if isinstance(self.sdc_opts, dict) \
+            else {}
+        import jax
+        skip = set(layout.skip_local_devices)
+        usable = [i for i in range(len(jax.devices())) if i not in skip]
+        mesh_n = layout.resolved_local_device_count()
+        # rotation index -> GLOBAL local-device index: the quarantine
+        # list the coordinator keeps is in global indices, so an SDC
+        # escalation must translate before stamping sdc_device
+        self._sdc_device_map = usable[:mesh_n]
+        canary = CanaryChecker(
+            devices=layout.local_devices()[:mesh_n], **opts)
+        if self._sdc_state:
+            canary.load_state_dict(self._sdc_state)
+        return canary
 
     def _restore_or_init(self, plan, mgr, main, scope, layout, rank, exe):
         """Land the generation's starting state: the plan's pinned
@@ -795,10 +917,18 @@ class ElasticWorker(object):
             if out is not None and sup.step > idx \
                     and self.record_results:
                 self._record(gen, idx, out)
+            hb_extra = {}
+            if sup.sentinel is not None:
+                # last z-scores / spike count ride the heartbeat so
+                # `ptpu_elastic status` shows WHY a worker fenced
+                hb_extra["sentinel"] = sup.sentinel.status()
+            if sup.sdc is not None:
+                hb_extra["sdc"] = sup.sdc.status()
             self._hb_writer.update(
                 status="ok", step=sup.step, gen=gen,
                 watchdog=self.watchdog_timeout,
-                reader_positions=self._reader_positions(main, scope))
+                reader_positions=self._reader_positions(main, scope),
+                **hb_extra)
             if rank == 0 and self.checkpoint_every \
                     and sup.step % int(self.checkpoint_every) == 0:
                 # re-check the fence right before writing: a fenced-out
@@ -848,9 +978,24 @@ class ElasticWorker(object):
 
     def _escalate_cluster_fault(self, exc, gen):
         """A fault the local chain could not (or must not) absorb — the
-        wedged-dispatch case. Report it cluster-level and wait for the
-        coordinator's fence; the cohort rolls back together."""
-        self._hb_writer.update(status="fault", gen=gen, fault=repr(exc))
+        wedged-dispatch case, and SDC convictions (a bad chip cannot be
+        fixed in-process). Report it cluster-level and wait for the
+        coordinator's fence; the cohort rolls back together. An SDC
+        fault additionally stamps `sdc_device` (the GLOBAL local-device
+        index of the convicted chip) so the coordinator quarantines the
+        device rather than treating this as a whole-host problem."""
+        fields = {"status": "fault", "gen": gen, "fault": repr(exc)}
+        for e in (exc, getattr(exc, "cause", None),
+                  getattr(exc, "__cause__", None)):
+            if isinstance(e, SilentCorruptionError) \
+                    and e.device_index is not None:
+                dev = int(e.device_index)
+                if self._sdc_device_map \
+                        and dev < len(self._sdc_device_map):
+                    dev = int(self._sdc_device_map[dev])
+                fields["sdc_device"] = dev
+                break
+        self._hb_writer.update(**fields)
         t0 = time.monotonic()
         while True:
             plan = self._current_plan()

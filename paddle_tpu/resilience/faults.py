@@ -21,14 +21,27 @@ Arming a plan installs hooks at three seams:
     `heartbeat_stall@N[:secs]` stops the heartbeat thread's writes
     from step N for `secs` seconds (default: forever) WITHOUT touching
     the training loop — the "wedged but not dead" host the coordinator
-    must fence out on missed heartbeats alone.
+    must fence out on missed heartbeats alone. The sentinel faults
+    (ARCHITECTURE.md §29) ride here for FEED-FED programs:
+    `loss_spike@N[:mag]` / `grad_blowup@N[:mag]` scale every float feed
+    of step N by a large-but-FINITE magnitude (defaults 1e3 / 1e6) —
+    no guard trips, only the statistical monitors can see it.
   * `core.readers._fault_hook` — fires per RECORD, keyed on each
     reader's own delivered-record counter (deterministic even when a
     DoubleBufferReader worker pre-stages ahead of the training loop):
     `reader_nan` poisons the record's float fields, `reader_exc` raises
     InjectedReaderError (from the worker thread for buffered readers —
     exercising the immediate fault channel), `reader_stall` sleeps,
-    `reader_eof` ends the stream early.
+    `reader_eof` ends the stream early. For READER-FED programs the
+    sentinel faults key here instead: `loss_spike@N[:mag]` /
+    `grad_blowup@N[:mag]` scale record N's float fields — the bad
+    batch lands at a known stream position, which is exactly what
+    rollback_skip_data's bit-exactness proof needs.
+  * `resilience.sdc._fault_hook` — `bitflip@N[:device]` flips ONE bit
+    of canary check >= N's result (waiting, with `device`, until the
+    rotation lands on that local device index): the minimal silent
+    corruption, invisible to every guard, that must trip the digest
+    compare and get the device quarantined.
   * `checkpoint.snapshot._fault_hook` — `ckpt_kill@N` SIGKILLs at the
     Nth durability crossing of the write protocol, subsuming PR-4's
     `PTPU_CKPT_FAULT_AT` (which keeps working unchanged) under this
@@ -77,6 +90,7 @@ _KINDS = frozenset({
     "ckpt_kill", "host_death", "heartbeat_stall",
     "replica_exc", "replica_wedge", "replica_poison",
     "replica_slow", "replica_crash", "canary_poison",
+    "loss_spike", "grad_blowup", "bitflip",
 })
 _READER_KINDS = frozenset({"reader_nan", "reader_exc", "reader_stall",
                            "reader_eof"})
@@ -222,6 +236,7 @@ class FaultPlan(object):
         from ..core import executor as _exe
         from ..core import readers as _rdr
         from ..checkpoint import snapshot as _snap
+        from . import sdc as _sdc
         with _lock:
             if _active is not None and _active is not self:
                 raise RuntimeError("another FaultPlan is already armed")
@@ -229,6 +244,7 @@ class FaultPlan(object):
             _exe._fault_hook = self._executor_hook
             _rdr._fault_hook = self._reader_hook
             _snap._fault_hook = self._ckpt_hook
+            _sdc._fault_hook = self._sdc_hook
         return self
 
     def disarm(self):
@@ -236,12 +252,14 @@ class FaultPlan(object):
         from ..core import executor as _exe
         from ..core import readers as _rdr
         from ..checkpoint import snapshot as _snap
+        from . import sdc as _sdc
         with _lock:
             if _active is self:
                 _active = None
                 _exe._fault_hook = None
                 _rdr._fault_hook = None
                 _snap._fault_hook = None
+                _sdc._fault_hook = None
 
     def __enter__(self):
         return self.arm()
@@ -283,6 +301,16 @@ class FaultPlan(object):
         e = self._take(("nan_feed",), self._step)
         if e is not None and feed_arrays is not None:
             _poison_first_float(feed_arrays)
+        # sentinel faults, feed-fed seam: scale the float feeds by a
+        # large-but-FINITE magnitude — no guard trips, only statistics
+        # can see it. Taken only when explicit feeds exist; a reader-fed
+        # program's records are injected at the reader seam instead
+        # (same kinds, keyed on the source reader's record counter), so
+        # a one-shot entry is never burned against an empty feed dict.
+        if feed_arrays:
+            e = self._take(("loss_spike", "grad_blowup"), self._step)
+            if e is not None:
+                _scale_float_feeds(feed_arrays, _spike_mag(e))
 
     def _reader_hook(self, phase, reader, record=None):
         # fire only at SOURCE readers (no `_under` wrapper): in a
@@ -310,6 +338,17 @@ class FaultPlan(object):
                     % at)
             return None
         # phase == "record": poison the popped record's float fields
+        e = self._take(("loss_spike", "grad_blowup"), at)
+        if e is not None:
+            # sentinel faults, reader seam: the "bad batch" — every
+            # float field scaled by a finite magnitude at a KNOWN
+            # record index, so rollback_skip_data's bit-exactness leg
+            # can reconstruct exactly which records to never see
+            mag = _spike_mag(e)
+            return tuple(
+                np.array(f, copy=True) * mag
+                if np.issubdtype(np.asarray(f).dtype, np.floating)
+                else f for f in record)
         e = self._take(("reader_nan",), at)
         if e is None:
             return None
@@ -383,6 +422,33 @@ class FaultPlan(object):
             import signal
             os.kill(os.getpid(), signal.SIGKILL)
 
+    def _sdc_hook(self, check_index, device_index, result):
+        """SDC seam (resilience/sdc.py CanaryChecker): `bitflip@N[:dev]`
+        corrupts the result of canary check >= N — waiting, when `dev`
+        is given, until the round-robin rotation lands on that local
+        device index, so the quarantine leg deterministically blames
+        the device the plan names. One bit of one element flips: the
+        minimal silent corruption, far below any statistical monitor's
+        floor and invisible to every finiteness guard."""
+        taken = None
+        with self._take_lock:
+            for en in self.entries:
+                if en.kind == "bitflip" and (en.repeat or not en.fired) \
+                        and check_index >= en.at \
+                        and (en.arg is None
+                             or int(en.arg) == device_index):
+                    en.fired = True
+                    taken = en
+                    break
+        if taken is None:
+            return result
+        a = np.array(result, copy=True)
+        flat = a.reshape(-1)
+        bits = flat[:1].view(np.uint32 if flat.dtype == np.float32
+                             else np.uint64)
+        bits[0] ^= np.asarray(1 << 20, bits.dtype)
+        return a
+
 
 def _poison_scope_floats(scope):
     """NaN the first element of EVERY float array in a Scope — the
@@ -400,6 +466,31 @@ def _poison_scope_floats(scope):
         a = np.array(a, copy=True)
         a.reshape(-1)[0] = np.nan
         scope.set(name, a)
+
+
+def _spike_mag(entry):
+    """Magnitude for the sentinel fault kinds: the entry's arg, or a
+    kind-specific default — loss_spike 1e3 (a clear statistical outlier
+    that stays well inside float range through the loss), grad_blowup
+    1e6 (big enough that the grad-norm monitor, watching a noisier
+    stream, trips before the loss z-score does)."""
+    if entry.arg is not None:
+        return float(entry.arg)
+    return 1e6 if entry.kind == "grad_blowup" else 1e3
+
+
+def _scale_float_feeds(feed_arrays, mag):
+    """Scale every float feed by `mag` in place in the feed dict — the
+    finite 'bad batch' payload (contrast _poison_first_float: NaN)."""
+    import jax.numpy as jnp
+    for name in sorted(feed_arrays):
+        v = feed_arrays[name]
+        dt = np.dtype(getattr(v, "dtype", np.asarray(v).dtype))
+        if not np.issubdtype(dt, np.floating):
+            continue
+        a = np.array(np.asarray(v), copy=True) * dt.type(mag)
+        feed_arrays[name] = jnp.asarray(a) if not isinstance(
+            v, np.ndarray) else a
 
 
 def _poison_first_float(feed_arrays):

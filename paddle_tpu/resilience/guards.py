@@ -44,7 +44,7 @@ BACKUP_SUFFIX = "@GUARD_BK"
 
 def install_numeric_guards(program, loss=None, check_params=False,
                            extra_vars=(), gate_updates=True,
-                           granular=True):
+                           granular=True, grad_norm=False):
     """Install device-side numerical guards into `program` (in place).
 
     Watched vars: `loss` (Variable or name, optional), every parameter
@@ -65,6 +65,15 @@ def install_numeric_guards(program, loss=None, check_params=False,
     into ONE reduction with one combined message; it forces the grads
     to materialize for the concat, so use it only when the watched set
     is so large that per-var flag plumbing dominates.
+
+    grad_norm=True additionally emits ONE f32 global L2 norm over the
+    watched parameter gradients on the guard stat channel
+    (ops/guard_ops.py GRAD_NORM_STAT): the executor peels it into
+    `last_stats["grad_norm"]` after every dispatch, so the training
+    sentinel (resilience/sentinel.py) watches gradient health with zero
+    additional host syncs. Across a steps=K block the channel folds
+    with max — the block's worst norm, exactly what a blowup detector
+    wants.
 
     Idempotent per program. Returns {"checked": [...], "gated": [...]}.
     """
@@ -125,10 +134,14 @@ def install_numeric_guards(program, loss=None, check_params=False,
             block.prepend_op(
                 "guard_backup", inputs={"X": [n]},
                 outputs={"Out": [n + BACKUP_SUFFIX]}, infer_shape=False)
+    attrs = {"var_names": list(checked), "granular": bool(granular)}
+    if grad_norm:
+        attrs["grad_norm_vars"] = [n for n in checked
+                                   if n.endswith(GRAD_SUFFIX)]
     block.append_op(
         "check_finite_guard", inputs={"X": list(checked)},
         outputs={"Out": [flag]},
-        attrs={"var_names": list(checked), "granular": bool(granular)},
+        attrs=attrs,
         infer_shape=False)
     if gated:
         # ONE fused select (a lax.cond with identity branches) over the

@@ -222,8 +222,13 @@ class HeartbeatMonitor(object):
         once; never again): per worker the lifecycle status, liveness
         (the monitor's staleness/pid verdict), step cursor, steps
         behind the cohort's front-runner (None when the worker never
-        reported a step), plan generations, beat age, and the
-        metrics port it published (if any)."""
+        reported a step), plan generations, beat age, the metrics port
+        it published (if any), and the training-health fields
+        (ARCHITECTURE.md §29): the worker's last sentinel status dict
+        (z-scores, spike count), canary status dict, the fault repr a
+        faulted worker escalated with, and the `sdc_device` a canary
+        conviction named — the WHY behind a fence, not just the
+        that."""
         beats = self.poll()
         # the front-runner is the furthest LIVE, still-participating
         # worker: a dead worker's stale file (nothing ever deletes it)
@@ -248,6 +253,10 @@ class HeartbeatMonitor(object):
                 "gen_acked": int(b.get("gen_acked", 0) or 0),
                 "beat_age_s": float(b.get("age", 0.0)),
                 "metrics_port": b.get("metrics_port"),
+                "sentinel": b.get("sentinel"),
+                "sdc": b.get("sdc"),
+                "fault": b.get("fault"),
+                "sdc_device": b.get("sdc_device"),
             })
         return rows
 

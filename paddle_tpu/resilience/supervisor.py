@@ -50,12 +50,15 @@ from ..core.readers import EOFException
 from . import faults as _faults
 from . import watchdog as _watchdog
 from .guards import DivergenceFault
+from .sentinel import DivergenceError, LossSpikeError
+from .sdc import SilentCorruptionError
 
 __all__ = ["Supervisor", "TrainingAborted", "Action",
-           "skip_batch", "retry", "rollback", "abort",
-           "DEFAULT_POLICIES", "FAULT_CLASSES"]
+           "skip_batch", "retry", "rollback", "rollback_skip_data",
+           "abort", "DEFAULT_POLICIES", "FAULT_CLASSES"]
 
-FAULT_CLASSES = ("numeric", "hang", "reader", "dispatch")
+FAULT_CLASSES = ("numeric", "hang", "reader", "dispatch",
+                 "loss_spike", "divergence", "sdc")
 
 
 class TrainingAborted(RuntimeError):
@@ -74,15 +77,17 @@ class Action(object):
     """One escalation-chain entry. `times` is the per-class budget this
     action absorbs before the chain escalates past it."""
 
-    __slots__ = ("kind", "times", "backoff", "lr_scale", "bundle_dir")
+    __slots__ = ("kind", "times", "backoff", "lr_scale", "bundle_dir",
+                 "skip")
 
     def __init__(self, kind, times=1, backoff=0.0, lr_scale=None,
-                 bundle_dir=None):
+                 bundle_dir=None, skip=0):
         self.kind = kind
         self.times = max(1, int(times))
         self.backoff = float(backoff)
         self.lr_scale = lr_scale
         self.bundle_dir = bundle_dir
+        self.skip = max(0, int(skip))
 
     def __repr__(self):
         return "Action(%s, times=%d)" % (self.kind, self.times)
@@ -110,6 +115,19 @@ def rollback(times=1, lr_scale=None):
     return Action("rollback", times=times, lr_scale=lr_scale)
 
 
+def rollback_skip_data(times=1, skip=0, lr_scale=None):
+    """The PaLM-style bad-batch remedy: restore the newest valid
+    snapshot AND advance every in-graph reader stream past the
+    offending batch window — the records the faulted attempt (and
+    everything since the snapshot) consumed, plus `skip` further
+    K-blocks for margin. The resumed run is bit-exact vs a from-scratch
+    resume over a stream that never contained those records
+    (tests/unittests/test_sentinel.py pins this). A feed-fed program
+    (no readers) degrades to a plain rollback with a logged note."""
+    return Action("rollback_skip", times=times, skip=skip,
+                  lr_scale=lr_scale)
+
+
 def abort(bundle_dir=None):
     """Capture a diagnostic bundle (to `bundle_dir`, falling back to the
     Supervisor's) and raise TrainingAborted."""
@@ -125,6 +143,17 @@ DEFAULT_POLICIES = {
     "hang": (rollback(times=2), abort()),
     "reader": (skip_batch(times=2), abort()),
     "dispatch": (retry(times=2, backoff=0.05), rollback(times=1), abort()),
+    # sentinel classes (ARCHITECTURE.md §29). A loss spike's update
+    # ALREADY landed (it is only visible after the fetch), so skip/
+    # retry can't help: roll back and route the stream around the bad
+    # window. Divergence is drift, not one batch — skipping data won't
+    # fix it; rollback (configure lr_scale where the program has a
+    # persistable LR) then abort. SDC is hardware: locally terminal —
+    # the elastic worker escalates it so the coordinator quarantines
+    # the device instead.
+    "loss_spike": (rollback_skip_data(times=2), abort()),
+    "divergence": (rollback(times=2), abort()),
+    "sdc": (abort(),),
 }
 
 
@@ -132,7 +161,8 @@ class Supervisor(object):
     def __init__(self, executor, program, scope=None,
                  checkpoint_manager=None, policies=None,
                  watchdog_timeout=None, divergence=None, bundle_dir=None,
-                 metrics_window=64, restore_layout=None):
+                 metrics_window=64, restore_layout=None, sentinel=None,
+                 sdc=None, sdc_every=64):
         """Wrap `executor` dispatches of `program` in detection +
         recovery. `policies` maps fault class -> escalation chain
         (missing classes use DEFAULT_POLICIES). `watchdog_timeout` arms
@@ -146,7 +176,15 @@ class Supervisor(object):
         local rollback lands state exactly where the cohort's current
         mesh shape wants it. Registers itself on the reader fault
         channel so worker-thread errors surface in the event log the
-        moment they happen."""
+        moment they happen.
+
+        `sentinel` (a sentinel.TrainingSentinel) is fed every healthy
+        step's first fetch plus the executor's guard-stat grad norm
+        (`last_stats`, populated when guards were installed with
+        grad_norm=True); its detections route through the loss_spike/
+        divergence fault classes. `sdc` (an sdc.CanaryChecker) runs a
+        deterministic canary dispatch every `sdc_every` completed
+        steps; a digest mismatch routes through the sdc class."""
         self.exe = executor
         self.program = program
         # ParallelExecutor owns its scope and takes no program/scope per
@@ -166,7 +204,8 @@ class Supervisor(object):
         # construction, not from inside the first real fault's recovery
         # (a scheduler-derived rate is recomputed in-graph every step
         # and cannot be damped by scaling scope state)
-        if any(a.kind == "rollback" and a.lr_scale is not None
+        if any(a.kind in ("rollback", "rollback_skip")
+               and a.lr_scale is not None
                for chain in self.policies.values() for a in chain):
             from ..optimizer import persistable_lr_names
             if not persistable_lr_names(program):
@@ -177,6 +216,10 @@ class Supervisor(object):
                     "build with a float learning_rate to use lr_scale)")
         self.watchdog_timeout = watchdog_timeout
         self.divergence = divergence
+        self.sentinel = sentinel
+        self.sdc = sdc
+        self.sdc_every = None if not sdc_every else max(1, int(sdc_every))
+        self._sdc_last = 0
         self.bundle_dir = bundle_dir
         self.restore_layout = restore_layout
         self.step = 0          # completed training steps (save label)
@@ -302,12 +345,40 @@ class Supervisor(object):
                     return None  # caller re-feeds the restored step
                 # skip/retry cannot undo an applied update: accept the
                 # step (the event log carries the warning) and move on
+            if self.sentinel is not None and fetch0 is not None:
+                # the grad-norm scalar rode the guard stat channel in
+                # the dispatch that just returned (Executor.last_stats)
+                # — materializing it here syncs an already-computed
+                # device scalar, not a new program output
+                gn = None
+                stats = getattr(self.exe, "last_stats", None) or {}
+                if "grad_norm" in stats:
+                    gn = float(np.asarray(stats["grad_norm"]))
+                err = self.sentinel.observe(fetch0, grad_norm=gn,
+                                            step=self.step)
+                if err is not None:
+                    outcome = self._handle_fault(
+                        self._classify(err), err, feed=feed,
+                        steps=steps, applied=True)
+                    if outcome == "rolled_back":
+                        return None  # caller re-feeds the restored step
             if fetch0 is not None:
                 self.metrics.append(
                     {"step": int(self.step), "fetch0": fetch0,
                      "seconds": time.perf_counter() - t0})
             self.step += steps
             self._made_progress = True
+            if self.sdc is not None and self.sdc_every \
+                    and self.step - self._sdc_last >= self.sdc_every:
+                self._sdc_last = self.step
+                try:
+                    self.sdc.check()
+                except SilentCorruptionError as e:
+                    outcome = self._handle_fault("sdc", e, feed=feed,
+                                                 steps=steps,
+                                                 applied=True)
+                    if outcome == "rolled_back":
+                        return None
             return fetches
 
     def train(self, num_steps, feed_fn=None, fetch_list=None, steps=1,
@@ -342,6 +413,12 @@ class Supervisor(object):
 
     # ------------------------------------------------------ escalation --
     def _classify(self, exc):
+        if isinstance(exc, LossSpikeError):
+            return "loss_spike"
+        if isinstance(exc, DivergenceError):
+            return "divergence"
+        if isinstance(exc, SilentCorruptionError):
+            return "sdc"
         if isinstance(exc, (NumericalGuardError, DivergenceFault)):
             return "numeric"
         if isinstance(exc, DispatchTimeoutError):
@@ -422,6 +499,11 @@ class Supervisor(object):
                 if restored is None:
                     continue  # no manager / no snapshot: escalate
                 return "rolled_back"
+            if act.kind == "rollback_skip":
+                restored = self._rollback_skip(act, exc, t0, steps)
+                if restored is None:
+                    continue  # no manager / no snapshot: escalate
+                return "rolled_back"
             # abort (also the terminal fallthrough)
             bdir = act.bundle_dir or self.bundle_dir
             if bundle is None and bdir:
@@ -477,12 +559,70 @@ class Supervisor(object):
                 self._log("_", "lr_scale_failed", error=se)
         if self.divergence is not None:
             self.divergence.reset()
+        if self.sentinel is not None:
+            # the restored state replays an earlier stream — the
+            # window's samples come from a future that will now unfold
+            # differently, so the baseline restarts (warmup included)
+            self.sentinel.reset()
         self._log(self._classify(exc), "rollback", error=exc,
                   detail="restored step %d%s" % (
                       restored,
                       "; lr x%g on %s" % (act.lr_scale, scaled)
                       if scaled else ""),
                   seconds=time.perf_counter() - t0)
+        return restored
+
+    def _reader_states(self):
+        """(name, state) per distinct in-graph reader with a position
+        cursor — the PR-4 machinery rollback_skip_data rides."""
+        out, seen = [], set()
+        for op in self.program.global_block().ops:
+            if op.type != "read":
+                continue
+            name = op.inputs["Reader"][0]
+            if name in seen:
+                continue
+            seen.add(name)
+            state = self.scope.get(name)
+            if state is not None and hasattr(state, "_consumed"):
+                out.append((name, state))
+        return out
+
+    def _rollback_skip(self, act, exc, t0, steps):
+        """rollback_skip_data: capture every reader's CURRENT position
+        (one past the offending window — the records of the faulted
+        attempt are already consumed when a spike is observed), restore
+        the newest snapshot (which rewinds the readers to the
+        snapshot's positions), then advance each stream back to the
+        captured position plus `act.skip` further K-blocks. The resumed
+        run therefore trains over exactly the stream a from-scratch
+        resume that never saw those records would: restore + skip is
+        deterministic replay, not approximation."""
+        readers = self._reader_states()
+        targets = {n: int(s._consumed) + act.skip * int(steps)
+                   for n, s in readers}
+        restored = self._rollback(act, exc, t0)
+        if restored is None:
+            return None
+        from ..checkpoint.manager import skip_reader_records
+        want = {}
+        for n, _ in readers:
+            state = self.scope.get(n)
+            if state is None or not hasattr(state, "_consumed"):
+                continue
+            want[n] = max(0, targets[n] - int(state._consumed))
+        # EOF while skipping propagates: end of data, the caller's
+        # loop ends cleanly
+        total = skip_reader_records(self.scope, want, want)
+        detail = ("skipped %d records across %d reader(s) past the "
+                  "fault window (skip=%d x steps=%d)"
+                  % (total, len(readers), act.skip, int(steps))
+                  if readers else
+                  "no in-graph readers: degraded to a plain rollback "
+                  "(feed-fed program — the caller's feed_fn decides "
+                  "what the restored step sees)")
+        self._log(self._classify(exc), "rollback_skip", error=exc,
+                  detail=detail, seconds=time.perf_counter() - t0)
         return restored
 
     def _drop_batch(self, steps):

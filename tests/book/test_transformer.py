@@ -23,6 +23,9 @@ def synth_batch(rng, n=16):
     return transformer.prepare_batch(srcs, trgs, MAX_LEN, N_HEAD)
 
 
+@pytest.mark.slow   # PR 20 tier-1 budget audit: a ~10s convergence gate
+# (pytest.ini's own slow-tier definition); the eight other legs in this
+# file keep transformer build/decode/fusion numerics in the fast tier
 def test_transformer_converges():
     """Book-style smoke: tiny fixed dataset, loss must collapse and
     teacher-forced token accuracy must be high on the training data."""

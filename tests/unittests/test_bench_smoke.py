@@ -370,6 +370,57 @@ def test_bench_resil_smoke():
     assert best["overhead_pct_multistep"] < 10.0, best
 
 
+def test_bench_sentinel_smoke():
+    """The BENCH_SENTINEL leg: one subprocess run on CPU measuring the
+    training-health sentinel (ARCHITECTURE.md §29). The acceptance gate
+    rides here: watching a trainer — the loss robust z-score plus the
+    grad-norm stat riding the guard-flag vector — must cost <= 3%
+    steps/s, or "the sentinel is on everywhere" dies in review. The
+    bench isolates that ratio by running baseline and monitored legs on
+    the SAME compiled program (only host-side monitoring differs), so
+    the 3% gate is not hostage to the +-5% executable-layout lottery
+    between two separately compiled programs; the in-graph channel cost
+    is emitted (overhead_pct_channel) for the benchd t2g tier, not
+    gated. Same anti-flake treatment as test_bench_resil_smoke:
+    interleaved min-of-five rounds in-process, best of three attempts
+    here."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_SENTINEL": "1",
+        "BENCH_STEPS": "48", "BENCH_WARMUP": "2",
+        "BENCH_SENTINEL_REPEATS": "5",
+        "FLAGS_multistep_unroll": "0",
+    })
+    best = None
+    for attempt in range(3):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=900)
+        assert out.returncode == 0, out.stdout + out.stderr
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "sentinel_steps_per_sec"
+        assert rec["unit"] == "steps/sec"
+        assert rec["value"] > 0
+        assert rec["vs_baseline"] is None
+        for k in ("baseline_steps_per_sec", "sentinel_steps_per_sec",
+                  "canary_steps_per_sec", "nochannel_steps_per_sec"):
+            assert rec[k] > 0
+        # the canary cadence actually ran (48 steps / every 16 = 3 per
+        # round x 5 rounds, + the startup reference)
+        assert rec["canary_checks"] >= 3
+        if best is None or (rec["overhead_pct_sentinel"]
+                            < best["overhead_pct_sentinel"]):
+            best = rec
+        if best["overhead_pct_sentinel"] <= 3.0:
+            break
+    # THE gate: monitoring is host arithmetic on two already-fetched
+    # floats — <= 3% or the always-on story is fiction
+    assert best["overhead_pct_sentinel"] <= 3.0, best
+
+
 def test_bench_tp_smoke():
     """The BENCH_TP leg: one subprocess run on an 8-virtual-device CPU
     mesh training the same Adam MLP at mesh-1 and tp=2/tp=4 under the

@@ -90,6 +90,10 @@ def test_ring_kv_len_matches_masked_dense(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow   # PR 20 tier-1 budget audit: ~16s of 2x transformer
+# compile + an 8-device pjit; the ring/ulysses numerics are gated by the
+# unit tests above and the Program-path seam by the (much cheaper)
+# ulysses variant below, so the fast tier keeps the coverage
 def test_fused_attention_program_path_sp():
     """SP from the fluid Program path: the SAME fused-attention transformer
     program runs single-device (pallas kernel) and on a dp×sp mesh via

@@ -79,7 +79,10 @@ def cmd_worker(args):
         sharded_weight_update=args.sharded_weight_update,
         step_delay=args.step_delay,
         metrics_port=args.metrics_port,
-        metrics_host=args.metrics_host)
+        metrics_host=args.metrics_host,
+        sentinel=bool(args.sentinel) or None,
+        sdc=bool(args.sdc_every) or None,
+        sdc_every=args.sdc_every or 64)
     try:
         out = worker.run(args.steps)
     except ClusterAborted as e:
@@ -115,33 +118,51 @@ def cmd_status(args):
     for r in rows:
         r["beat_age_s"] = round(r["beat_age_s"], 3)
     plan = read_plan(args.cluster_dir)
+    quarantine = (plan or {}).get("quarantine") or {}
     if args.json:
         print(json.dumps({
             "plan": None if plan is None else {
                 "gen": plan.get("gen"), "phase": plan.get("phase"),
                 "num_workers": plan.get("num_workers"),
-                "restore_step": plan.get("restore_step")},
+                "restore_step": plan.get("restore_step"),
+                "quarantine": quarantine},
             "workers": rows}, indent=1, sort_keys=True))
         return 0
     if plan is not None:
-        print("plan: gen %s phase %s world=%d restore_step=%s"
+        print("plan: gen %s phase %s world=%d restore_step=%s%s"
               % (plan.get("gen"), plan.get("phase"),
-                 plan.get("num_workers"), plan.get("restore_step")))
+                 plan.get("num_workers"), plan.get("restore_step"),
+                 " quarantine=%s" % json.dumps(quarantine,
+                                               sort_keys=True)
+                 if quarantine else ""))
     else:
         print("plan: none published yet")
     if not rows:
         print("no heartbeats under %s" % args.cluster_dir)
         return 0
-    hdr = "%-8s %-8s %-6s %6s %7s %5s %6s %9s %8s" % (
+    hdr = "%-8s %-8s %-6s %6s %7s %5s %6s %9s %8s %7s %7s %6s" % (
         "WORKER", "STATUS", "ALIVE", "STEP", "BEHIND", "GEN",
-        "ACKED", "BEAT_AGE", "METRICS")
+        "ACKED", "BEAT_AGE", "METRICS", "LOSS_Z", "SPIKES", "QUAR")
     print(hdr)
     for r in rows:
-        print("%-8s %-8s %-6s %6s %7s %5d %6d %7.2fs %8s"
+        sent = r.get("sentinel") or {}
+        z = sent.get("z")
+        qdevs = quarantine.get(r["worker"]) or []
+        print("%-8s %-8s %-6s %6s %7s %5d %6d %7.2fs %8s %7s %7s %6s"
               % (r["worker"], r["status"], r["alive"], r["step"],
                  "-" if r["steps_behind"] is None else r["steps_behind"],
                  r["gen"], r["gen_acked"], r["beat_age_s"],
-                 r["metrics_port"] or "-"))
+                 r["metrics_port"] or "-",
+                 "-" if z is None else "%.1f" % z,
+                 sent.get("spikes", "-") if sent else "-",
+                 ",".join(str(d) for d in qdevs) if qdevs else "-"))
+        # a faulted worker's WHY, when it escalated one (the sentinel/
+        # canary message is the operator's first clue)
+        if r.get("fault") and r.get("status") == "fault":
+            extra = ""
+            if r.get("sdc_device") is not None:
+                extra = " [sdc_device=%s]" % r["sdc_device"]
+            print("  `- fault: %.100s%s" % (r["fault"], extra))
     return 0
 
 
@@ -205,6 +226,10 @@ class _WorkerPool(object):
                 cmd += ["--step-delay", str(self.args.step_delay)]
             if metrics_port is not None:
                 cmd += ["--metrics-port", str(metrics_port)]
+            if getattr(self.args, "sentinel", False):
+                cmd += ["--sentinel"]
+            if getattr(self.args, "sdc_every", 0):
+                cmd += ["--sdc-every", str(self.args.sdc_every)]
         proc = subprocess.Popen(cmd,
                                 env=self._worker_env(
                                     worker_id, with_fault,
@@ -317,6 +342,14 @@ def main(argv=None):
     lp.add_argument("--metrics-port-base", type=int, default=None,
                     help="serve each worker's /metrics (observability "
                          "registry incl. fleet gauges) on base+index")
+    lp.add_argument("--sentinel", action="store_true",
+                    help="arm the training-health sentinel in every "
+                         "demo worker (loss-spike rollback_skip_data, "
+                         "divergence detection)")
+    lp.add_argument("--sdc-every", type=int, default=0,
+                    help="run the SDC canary every N steps in every "
+                         "demo worker (0 = off); a conviction "
+                         "quarantines the device")
     lp.set_defaults(fn=cmd_launch)
 
     sp = sub.add_parser("status", help="fleet gauge table from "
@@ -350,6 +383,10 @@ def main(argv=None):
                     help="bind address for /metrics (0.0.0.0 for a "
                          "remote scraper; the heartbeat's host field "
                          "names the machine)")
+    wp.add_argument("--sentinel", action="store_true",
+                    help="arm the training-health sentinel")
+    wp.add_argument("--sdc-every", type=int, default=0,
+                    help="SDC canary cadence in steps (0 = off)")
     wp.set_defaults(fn=cmd_worker)
 
     args = ap.parse_args(argv)
